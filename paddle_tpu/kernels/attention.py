@@ -1,0 +1,261 @@
+"""Flash attention for TPU.
+
+Reference parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu (the
+FlashAttention-2 CUDA binding used by paddle.nn.functional.
+scaled_dot_product_attention / flash_attention). TPU-native design: a
+Pallas kernel implementing blockwise online-softmax attention (the
+flash-attention recurrence) tiled for the MXU: Q blocks stay resident in
+VMEM while K/V blocks stream through; running max `m`, normalizer `l`
+and the f32 accumulator live in VMEM scratch across the KV grid axis.
+
+The backward pass recomputes attention blockwise (flash-style: no S×S
+materialization) using the saved `lse` — expressed in XLA ops, which the
+compiler fuses per-block; a dedicated Pallas backward kernel is a later
+optimization.
+
+Gradient plumbing goes through jax.custom_vjp so the kernel composes with
+the eager tape AND jax.grad under jit.
+"""
+from __future__ import annotations
+
+import functools
+import math as pymath
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..framework.flags import flag_value
+
+_NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    if not flag_value("use_pallas_kernels"):
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel: works on [BH, S, D]
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, seq_k):
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    i = pl.program_id(1)
+
+    def _compute():
+        q = q_ref[0]  # (bq, d)
+        k = k_ref[0]  # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+
+        if causal:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]  # (bq,)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_scr[:] = (acc_scr[:] * alpha[:, None] +
+                      jax.lax.dot_general(
+                          p.astype(v_ref.dtype), v_ref[0],
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32))
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    if causal:
+        # skip fully-masked KV blocks (block start beyond the last q row)
+        @pl.when(j * block_k <= (i + 1) * block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, 0] + jnp.log(safe_l)).astype(lse_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128):
+    """q,k,v: [BH, S, D] → (out [BH,S,D], lse [BH,S])."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=sk)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),    # accumulator
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (used on CPU, with masks/dropout, and as bwd recompute)
+# ---------------------------------------------------------------------------
+
+def _xla_attention(q, k, v, scale, causal, mask=None, dropout_p=0.0,
+                   dropout_key=None):
+    """q,k,v: [B, S, H, D] (paddle flash layout)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            s = jnp.where(mask, s, _NEG_INF)
+        else:
+            s = s + mask.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (pure jax level, [B,S,H,D] layout)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, scale, causal):
+    return _flash_fwd(q, k, v, scale, causal)[0]
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    b, sq, h, d = q.shape
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, k.shape[1], d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, v.shape[1], d)
+    out, lse = _flash_fwd_pallas(qt, kt, vt, scale, causal)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v, out, lse.reshape(b, h, sq))
+
+
+def _flash_bwd(scale, causal, res, g):
+    """Blockwise recompute backward (flash-style, no S×S live tensor after
+    XLA scheduling; a handwritten Pallas bwd kernel can replace this)."""
+    q, k, v, out, lse = res
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])  # recomputed softmax via saved lse
+    gf = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", gf,
+                    v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (b, sq, h)
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_core.defvjp(lambda q, k, v, scale, causal: _flash_fwd(q, k, v, scale, causal),
+                   _flash_bwd)
+
+
+def flash_attention_jax(query, key, value, *, causal=False, scale=None,
+                        mask=None, dropout_p=0.0, dropout_key=None):
+    """Pure-jax entry ([B,S,H,D] arrays). Chooses Pallas vs XLA."""
+    d = query.shape[-1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    plausible = (_use_pallas() and mask is None and dropout_p == 0.0
+                 and query.shape[1] >= 8 and d % 128 == 0)
+    if plausible:
+        return _flash_core(query, key, value, sc, causal)
+    return _xla_attention(query, key, value, sc, causal, mask=mask,
+                          dropout_p=dropout_p, dropout_key=dropout_key)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-level API (tape-aware)
+# ---------------------------------------------------------------------------
+
+def flash_attention_bshd(query, key, value, attn_mask=None, dropout_p=0.0,
+                         is_causal=False, training=True, scale=None):
+    """paddle scaled_dot_product_attention parity: [B, S, H, D] in/out."""
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+    from ..framework.random import next_key
+
+    args = [_coerce(query), _coerce(key), _coerce(value)]
+    has_mask = attn_mask is not None
+    if has_mask:
+        args.append(_coerce(attn_mask))
+    key_drop = next_key() if (dropout_p > 0.0 and training) else None
+
+    def fn(q, k, v, *m):
+        return flash_attention_jax(
+            q, k, v, causal=is_causal, scale=scale,
+            mask=m[0] if has_mask else None,
+            dropout_p=dropout_p if training else 0.0,
+            dropout_key=key_drop)
+    return apply(fn, *args, _name="flash_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = flash_attention_bshd(query, key, value, dropout_p=dropout,
+                               is_causal=causal, training=training)
+    return out, None

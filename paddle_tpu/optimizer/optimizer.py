@@ -1,0 +1,392 @@
+"""Optimizers (parity: python/paddle/optimizer/{optimizer,sgd,momentum,adam,
+adamw,adagrad,adamax,rmsprop,lamb}.py).
+
+TPU-native design: each optimizer's math is a pure function over
+(param, grad, *state) → (param', *state'), jit-compiled once per
+(shape, dtype) with donated buffers — so an eager `step()` is one fused
+XLA kernel per parameter (replacing paddle's fused_adam CUDA kernels).
+The same pure functions drive the functional training path, where the
+whole step (fwd+bwd+update) is a single jitted program and these updates
+fuse into it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, Parameter
+from .._grad_mode import no_grad
+from .lr import LRScheduler
+
+
+def _as_float(lr):
+    return lr() if isinstance(lr, LRScheduler) else float(lr)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in this framework (dygraph mode)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._regularization_coeff = float(weight_decay)
+        else:
+            self._regularization_coeff = 0.0 if weight_decay is None else weight_decay
+        # accumulators: name -> {param_id -> jax array}
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._accum_meta: Dict[int, str] = {}
+
+    # ------------------------------------------------------------ LR API --
+    def get_lr(self):
+        return _as_float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # ------------------------------------------------------- accumulators --
+    def _get_accumulator(self, name, p, init=None):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(p)
+        if pid not in store:
+            store[pid] = (jnp.zeros_like(p._value) if init is None
+                          else init(p._value))
+            self._accum_meta[pid] = getattr(p, "name", None) or str(pid)
+        return store[pid]
+
+    def _set_accumulator(self, name, p, value):
+        self._accumulators[name][id(p)] = value
+
+    # -------------------------------------------------------------- hooks --
+    def _update(self, p, g, lr):
+        """Return the new param value (and update accumulators)."""
+        raise NotImplementedError
+
+    def _params_grads(self):
+        pg = []
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            pg.append((p, p.grad))
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        return pg
+
+    @no_grad()
+    def step(self):
+        lr = self.get_lr()
+        for p, g in self._params_grads():
+            if g is None:
+                continue
+            gv = g._value
+            if gv.dtype != p._value.dtype:
+                gv = gv.astype(p._value.dtype)
+            new_val = self._update(p, gv, lr)
+            p._value = new_val
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ----------------------------------------------------------- state io --
+    def state_dict(self):
+        out = {}
+        for name, store in self._accumulators.items():
+            for pid, arr in store.items():
+                out[f"{self._accum_meta.get(pid, pid)}_{name}"] = Tensor(arr)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        # rebuild accumulators by matching "<pname>_<accum>" keys
+        for p in self._parameter_list:
+            pname = getattr(p, "name", None) or str(id(p))
+            for name in list(self._accumulators.keys()) or []:
+                key = f"{pname}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    self._accumulators[name][id(p)] = (
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v))
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        return _sgd_kernel(p._value, g, lr)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_kernel(p, g, lr):
+    return p - lr * g
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        vel = self._get_accumulator("velocity", p)
+        new_p, new_v = _momentum_kernel(p._value, g, vel, lr, self._momentum,
+                                        self._use_nesterov)
+        self._set_accumulator("velocity", p, new_v)
+        return new_p
+
+
+@functools.partial(jax.jit, static_argnums=(5,), donate_argnums=(0, 2))
+def _momentum_kernel(p, g, v, lr, mu, nesterov):
+    v2 = mu * v + g
+    if nesterov:
+        p2 = p - lr * (g + mu * v2)
+    else:
+        p2 = p - lr * v2
+    return p2, v2
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new_p, new_m, new_v, new_t = _adam_kernel(
+            p._value, g, m, v, t, lr, self.beta1, self.beta2, self.epsilon,
+            0.0)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+@functools.partial(jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))
+def _adam_kernel(p, g, m, v, t, lr, b1, b2, eps, wd):
+    t2 = t + 1
+    gf = g.astype(m.dtype)
+    m2 = b1 * m + (1 - b1) * gf
+    v2 = b2 * v + (1 - b2) * (gf * gf)
+    tf = t2.astype(m.dtype)
+    mhat = m2 / (1 - b1 ** tf)
+    vhat = v2 / (1 - b2 ** tf)
+    upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+    if wd:  # decoupled decay (AdamW)
+        upd = upd + lr * wd * p.astype(m.dtype)
+    p2 = (p.astype(m.dtype) - upd).astype(p.dtype)
+    return p2, m2, v2, t2
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update(self, p, g, lr):
+        wd = self._wd
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(getattr(p, "name", "") or ""):
+            wd = 0.0
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new_p, new_m, new_v, new_t = _adam_kernel(
+            p._value, g, m, v, t, lr, self.beta1, self.beta2, self.epsilon,
+            wd)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        acc = self._get_accumulator(
+            "moment", p, init=lambda x: jnp.full_like(x, self._init_acc))
+        new_p, new_acc = _adagrad_kernel(p._value, g, acc, lr, self.epsilon)
+        self._set_accumulator("moment", p, new_acc)
+        return new_p
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def _adagrad_kernel(p, g, acc, lr, eps):
+    acc2 = acc + g * g
+    return p - lr * g / (jnp.sqrt(acc2) + eps), acc2
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new = _adamax_kernel(p._value, g, m, u, t, lr, self.beta1, self.beta2,
+                             self.epsilon)
+        new_p, new_m, new_u, new_t = new
+        self._set_accumulator("moment", p, new_m)
+        self._set_accumulator("inf_norm", p, new_u)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4))
+def _adamax_kernel(p, g, m, u, t, lr, b1, b2, eps):
+    t2 = t + 1
+    m2 = b1 * m + (1 - b1) * g
+    u2 = jnp.maximum(b2 * u, jnp.abs(g))
+    lr_t = lr / (1 - b1 ** t2.astype(m.dtype))
+    return p - lr_t * m2 / (u2 + eps), m2, u2, t2
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        ms = self._get_accumulator("mean_square", p)
+        mg = self._get_accumulator("mean_grad", p)
+        mom = self._get_accumulator("momentum", p)
+        new_p, new_ms, new_mg, new_mom = _rmsprop_kernel(
+            p._value, g, ms, mg, mom, lr, self.rho, self.epsilon,
+            self.momentum, self.centered)
+        self._set_accumulator("mean_square", p, new_ms)
+        self._set_accumulator("mean_grad", p, new_mg)
+        self._set_accumulator("momentum", p, new_mom)
+        return new_p
+
+
+@functools.partial(jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))
+def _rmsprop_kernel(p, g, ms, mg, mom, lr, rho, eps, mu, centered):
+    ms2 = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg2 = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms2 - mg2 * mg2 + eps)
+    else:
+        mg2 = mg
+        denom = jnp.sqrt(ms2 + eps)
+    mom2 = mu * mom + lr * g / denom
+    return p - mom2, ms2, mg2, mom2
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, p, g, lr):
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new_p, new_m, new_v, new_t = _lamb_kernel(
+            p._value, g, m, v, t, lr, self.beta1, self.beta2, self.epsilon, wd)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4))
+def _lamb_kernel(p, g, m, v, t, lr, b1, b2, eps, wd):
+    t2 = t + 1
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    tf = t2.astype(m.dtype)
+    mhat = m2 / (1 - b1 ** tf)
+    vhat = v2 / (1 - b2 ** tf)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    w_norm = jnp.linalg.norm(p)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return p - lr * ratio * r, m2, v2, t2

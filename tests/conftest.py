@@ -1,0 +1,20 @@
+"""Test harness: force the CPU backend with 8 virtual devices so
+sharding/collective tests run without TPU hardware (SURVEY.md §4: the
+reference simulates multi-device with N local processes; we simulate with
+N virtual XLA host devices).
+
+Note: this sandbox's `axon` TPU plugin force-sets jax_platforms at import,
+so the JAX_PLATFORMS env var alone is NOT enough — we must override the
+config after importing jax, before any backend is initialized.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

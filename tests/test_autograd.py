@@ -1,0 +1,223 @@
+"""Autograd engine tests: backward, accumulation, hooks, paddle.grad,
+double-grad, PyLayer (parity model: test/legacy_test autograd suites and
+the OpTest check_grad oracle: numeric finite-difference vs analytic)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite difference wrt x (float64 for stability)."""
+    x = x.astype(np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_backward():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.exp(x)
+    z = (y * 3.0).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3 * np.exp([1.0, 2.0]), rtol=1e-5)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_matmul_grad_numeric():
+    rng = np.random.RandomState(0)
+    a_np = rng.rand(3, 4).astype(np.float64)
+    b_np = rng.rand(4, 2).astype(np.float64)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    ga = numeric_grad(lambda v: (v @ b_np).sum(), a_np)
+    gb = numeric_grad(lambda v: (a_np @ v).sum(), b_np)
+    np.testing.assert_allclose(a.grad.numpy(), ga, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), gb, rtol=1e-5, atol=1e-5)
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3.0] * 4)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x.detach()
+    z = (y * 5).sum()
+    assert z.stop_gradient
+    w = (x * 2 + y).sum()
+    w.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_non_scalar_backward_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    with pytest.raises(RuntimeError):
+        y.backward()  # graph freed
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # functional API must not touch .grad
+
+
+def test_double_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x  # y = x^3, dy/dx = 3x^2, d2y/dx2 = 6x
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [12.0])
+    (g2,) = paddle.grad(g1, x)
+    np.testing.assert_allclose(g2.numpy(), [12.0])
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    h = x.register_hook(lambda g: seen.append(g.numpy()))
+    (x * 2).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [2.0])
+    h.remove()
+    x.clear_grad()
+    (x * 2).sum().backward()
+    assert len(seen) == 1
+
+
+def test_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    a, b, c = paddle.split(x, 3)
+    (a.sum() * 1 + b.sum() * 2 + c.sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 2, 2, 3, 3])
+
+
+def test_partial_multi_output_use():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32), stop_gradient=False)
+    a, b = paddle.split(x, 2)
+    a.sum().backward()  # b unused — engine must zero-fill its cotangent
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 0, 0])
+
+
+def test_int_output_op_no_grad_crash():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1])
+
+
+def test_gather_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    i = paddle.to_tensor([2, 2, 0])
+    y = paddle.gather(x, i)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 2])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_pylayer_multi_io():
+    class AddMul(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a + b, a * b
+
+        @staticmethod
+        def backward(ctx, ga, gb):
+            a, b = ctx.saved_tensor
+            return ga + gb * b, ga + gb * a
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=False)
+    s, p = AddMul.apply(x, y)
+    (s + p).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])  # 1 + 3
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])  # 1 + 2
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 1.0  # non-leaf
+    y[0] = 10.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1])

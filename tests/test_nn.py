@@ -1,0 +1,336 @@
+"""nn.Layer / layers / functional tests (parity model: test/legacy_test
+layer suites; numpy goldens; train-step smoke)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(7)
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        l = nn.Linear(4, 3)
+        names = dict(l.named_parameters())
+        assert set(names) == {"weight", "bias"}
+        assert l.weight.shape == [4, 3]
+        assert l.bias.shape == [3]
+        assert not l.weight.stop_gradient
+
+    def test_sublayer_traversal(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        params = m.parameters()
+        assert len(params) == 4
+        names = [n for n, _ in m.named_parameters()]
+        assert "0.weight" in names and "2.bias" in names
+        assert len(m.sublayers()) == 3
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(4, 3)
+        m2 = nn.Linear(4, 3)
+        sd = m1.state_dict()
+        missing, unexpected = m2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_allclose(m2.weight.numpy(), m1.weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        assert m.training
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(paddle.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        l(paddle.ones([1, 2]))
+        assert calls == [1]
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        bufs = dict(bn.named_buffers())
+        assert "_mean" in bufs and "_variance" in bufs
+        sd = bn.state_dict()
+        assert "_mean" in sd
+
+    def test_to_dtype(self):
+        l = nn.Linear(2, 2)
+        l.bfloat16()
+        assert l.weight.dtype == paddle.bfloat16
+
+
+class TestLayers:
+    def test_linear_golden(self):
+        l = nn.Linear(3, 2)
+        x = rng.rand(5, 3).astype(np.float32)
+        out = l(paddle.to_tensor(x))
+        ref = x @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_conv2d_golden_vs_scipy(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = rng.rand(1, 2, 8, 8).astype(np.float32)
+        out = conv(paddle.to_tensor(x))
+        assert out.shape == [1, 3, 8, 8]
+        # golden: direct correlation
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        ref = np.zeros((1, 3, 8, 8), np.float32)
+        for oc in range(3):
+            for i in range(8):
+                for j in range(8):
+                    ref[0, oc, i, j] = (xp[0, :, i:i + 3, j:j + 3] * w[oc]).sum() + b[oc]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_stride_groups(self):
+        conv = nn.Conv2D(4, 4, 3, stride=2, groups=2)
+        x = paddle.randn([2, 4, 9, 9])
+        assert conv(x).shape == [2, 4, 4, 4]
+
+    def test_conv2d_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+        x = paddle.randn([1, 3, 8, 8])
+        assert deconv(x).shape == [1, 2, 16, 16]
+
+    def test_layernorm_golden(self):
+        ln = nn.LayerNorm(6)
+        x = rng.rand(4, 6).astype(np.float32)
+        out = ln(paddle.to_tensor(x))
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_rmsnorm_golden(self):
+        rn = nn.RMSNorm(8)
+        x = rng.rand(3, 8).astype(np.float32)
+        out = rn(paddle.to_tensor(x))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_batchnorm_train_and_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = rng.rand(4, 3, 5, 5).astype(np.float32)
+        out = bn(paddle.to_tensor(x))
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out2 = bn(paddle.to_tensor(x))
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = paddle.randn([2, 4, 3, 3])
+        out = gn(x)
+        assert out.shape == [2, 4, 3, 3]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor([[1, 2], [3, 4]])
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([0, 1]))
+        np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+
+    def test_dropout_modes(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        paddle.seed(0)
+        out = d(x)
+        vals = np.unique(out.numpy())
+        assert set(np.round(vals, 5)).issubset({0.0, 2.0})
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_pools(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        gap = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(gap.numpy()[0, 0, 0, 0], 7.5)
+
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+        np.testing.assert_allclose(
+            nn.GELU()(x).numpy(),
+            [-0.158655, 0.0, 1.954500], rtol=1e-4, atol=1e-5)
+        s = nn.Softmax()(paddle.to_tensor([[1.0, 2.0, 3.0]]))
+        np.testing.assert_allclose(s.numpy().sum(), 1.0, rtol=1e-6)
+
+    def test_rnn_lstm_gru(self):
+        for cls, state_is_tuple in [(nn.SimpleRNN, False), (nn.LSTM, True),
+                                    (nn.GRU, False)]:
+            m = cls(4, 8, num_layers=2)
+            x = paddle.randn([3, 5, 4])
+            out, st = m(x)
+            assert out.shape == [3, 5, 8]
+            if state_is_tuple:
+                h, c = st
+                assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+            else:
+                assert st.shape == [2, 3, 8]
+
+    def test_lstm_bidirectional(self):
+        m = nn.LSTM(4, 8, direction="bidirect")
+        out, (h, c) = m(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 8]
+
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 6, 16])
+        out = mha(x, x, x)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.randn([2, 5, 16])
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.randn([2, 4, 16])
+        tgt = paddle.randn([2, 3, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+
+class TestFunctional:
+    def test_softmax_cross_entropy_golden(self):
+        logits = rng.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        ref = -logp[np.arange(4), labels].mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rng.rand(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        ref = -(logp[0, 0] + logp[2, 4]) / 2
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = rng.rand(3, 4).astype(np.float32)
+        soft = rng.dirichlet(np.ones(4), 3).astype(np.float32)
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(soft), soft_label=True)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        ref = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_mse_l1(self):
+        a = rng.rand(3, 3).astype(np.float32)
+        b = rng.rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                      reduction="sum").numpy(),
+            np.abs(a - b).sum(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        z = rng.randn(4).astype(np.float32)
+        y = (rng.rand(4) > 0.5).astype(np.float32)
+        loss = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(z), paddle.to_tensor(y))
+        p = 1 / (1 + np.exp(-z))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-4)
+
+    def test_one_hot(self):
+        oh = F.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_sdpa_matches_reference(self):
+        b, s, h, d = 2, 8, 2, 4
+        q = rng.rand(b, s, h, d).astype(np.float32)
+        k = rng.rand(b, s, h, d).astype(np.float32)
+        v = rng.rand(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+        # numpy reference
+        scale = 1 / np.sqrt(d)
+        sc = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        b, s, h, d = 1, 6, 1, 4
+        q = rng.rand(b, s, h, d).astype(np.float32)
+        k = rng.rand(b, s, h, d).astype(np.float32)
+        v = rng.rand(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True)
+        scale = 1 / np.sqrt(d)
+        sc = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = np.tril(np.ones((s, s), bool))
+        sc = np.where(mask, sc, -1e30)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_interpolate(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+        out = F.interpolate(x, size=[4, 4], mode="nearest")
+        assert out.shape == [1, 1, 4, 4]
+        out2 = F.interpolate(x, scale_factor=2, mode="bilinear")
+        assert out2.shape == [1, 1, 4, 4]
+
+
+class TestTrainingSmoke:
+    def test_mlp_learns_xor(self):
+        paddle.seed(42)
+        x = paddle.to_tensor(np.array(
+            [[0, 0], [0, 1], [1, 0], [1, 1]], np.float32))
+        y = paddle.to_tensor(np.array([[0.0], [1.0], [1.0], [0.0]], np.float32))
+        model = nn.Sequential(nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = paddle.optimizer.Adam(0.05, parameters=model.parameters())
+        loss_first = None
+        for i in range(200):
+            pred = model(x)
+            loss = F.mse_loss(pred, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if loss_first is None:
+                loss_first = float(loss)
+        assert float(loss) < 0.05 < loss_first
+
+    def test_grad_flow_through_conv_bn(self):
+        m = nn.Sequential(nn.Conv2D(1, 2, 3), nn.BatchNorm2D(2), nn.ReLU())
+        x = paddle.randn([2, 1, 6, 6])
+        out = m(x)
+        out.mean().backward()
+        for p in m.parameters():
+            assert p.grad is not None, p.name

@@ -1,0 +1,340 @@
+"""Op library tests in OpTest style (SURVEY.md §4: numpy golden + grad
+check), covering creation/math/manip/logic/linalg/search/random."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+rng = np.random.RandomState(42)
+
+
+def check(op, np_ref, *arrays, rtol=1e-5, atol=1e-6, **kw):
+    ts = [paddle.to_tensor(a) for a in arrays]
+    out = op(*ts, **kw)
+    ref = np_ref(*arrays, **kw)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=rtol, atol=atol)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([4]).numpy().sum() == 4
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.arange(5).dtype == np.dtype(np.int64)
+        np.testing.assert_allclose(paddle.arange(0, 1, 0.25).numpy(),
+                                   np.arange(0, 1, 0.25), rtol=1e-6)
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_eye_diag_tri(self):
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.diag(paddle.to_tensor(v)).numpy(),
+                                   np.diag(v))
+        m = rng.rand(4, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.tril(paddle.to_tensor(m)).numpy(),
+                                   np.tril(m))
+        np.testing.assert_allclose(
+            paddle.triu(paddle.to_tensor(m), 1).numpy(), np.triu(m, 1))
+
+    def test_like_family(self):
+        x = paddle.ones([2, 3], dtype="float32")
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.ones_like(x, dtype="int64").dtype == np.dtype(np.int64)
+        np.testing.assert_allclose(paddle.full_like(x, 5).numpy(),
+                                   np.full((2, 3), 5.0))
+
+
+class TestMath:
+    def test_elementwise_unary(self):
+        x = rng.rand(3, 4).astype(np.float32) + 0.1
+        for op, ref in [
+            (paddle.exp, np.exp), (paddle.log, np.log),
+            (paddle.sqrt, np.sqrt), (paddle.tanh, np.tanh),
+            (paddle.sin, np.sin), (paddle.cos, np.cos),
+            (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+            (paddle.abs, np.abs), (paddle.square, np.square),
+        ]:
+            check(op, ref, x, rtol=1e-3, atol=1e-5)
+
+    def test_binary_broadcast(self):
+        a = rng.rand(3, 1, 4).astype(np.float32)
+        b = rng.rand(2, 4).astype(np.float32)
+        check(paddle.add, np.add, a, b)
+        check(paddle.multiply, np.multiply, a, b)
+        check(paddle.maximum, np.maximum, a, b)
+        check(paddle.subtract, np.subtract, a, b)
+
+    def test_reductions(self):
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        check(paddle.sum, lambda v: np.sum(v), x)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(),
+                                   x.sum(axis=1), rtol=1e-6)
+        np.testing.assert_allclose(paddle.mean(t, axis=[0, 2]).numpy(),
+                                   x.mean(axis=(0, 2)), rtol=1e-6)
+        np.testing.assert_allclose(paddle.max(t, axis=-1, keepdim=True).numpy(),
+                                   x.max(-1, keepdims=True))
+        np.testing.assert_allclose(paddle.prod(t, axis=0).numpy(),
+                                   x.prod(0), rtol=1e-5)
+        np.testing.assert_allclose(paddle.logsumexp(t).numpy(),
+                                   np.log(np.exp(x).sum()), rtol=1e-5)
+
+    def test_std_var_unbiased(self):
+        x = rng.rand(5, 6).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.std(t).numpy(), x.std(ddof=1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.var(t, unbiased=False).numpy(),
+                                   x.var(), rtol=1e-5)
+
+    def test_cumsum_cumprod(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-6)
+        np.testing.assert_allclose(paddle.cumsum(t).numpy(),
+                                   np.cumsum(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.cumprod(t, dim=0).numpy(),
+                                   np.cumprod(x, 0), rtol=1e-6)
+
+    def test_clip_lerp(self):
+        x = np.array([-1.0, 0.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            paddle.clip(paddle.to_tensor(x), 0.0, 1.0).numpy(), [0, 0.5, 1])
+        a = np.zeros(3, np.float32)
+        b = np.ones(3, np.float32)
+        np.testing.assert_allclose(
+            paddle.lerp(paddle.to_tensor(a), paddle.to_tensor(b), 0.25).numpy(),
+            [0.25] * 3)
+
+    def test_einsum(self):
+        a = rng.rand(2, 3).astype(np.float32)
+        b = rng.rand(3, 4).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_add_n(self):
+        xs = [rng.rand(2, 2).astype(np.float32) for _ in range(3)]
+        out = paddle.add_n([paddle.to_tensor(x) for x in xs])
+        np.testing.assert_allclose(out.numpy(), sum(xs), rtol=1e-6)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(x)
+        assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+        assert paddle.reshape(t, [-1, 12]).shape == [2, 12]
+        np.testing.assert_allclose(
+            paddle.transpose(t, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        a = rng.rand(2, 3).astype(np.float32)
+        b = rng.rand(2, 3).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(paddle.concat([ta, tb], axis=0).numpy(),
+                                   np.concatenate([a, b], 0))
+        np.testing.assert_allclose(paddle.stack([ta, tb], axis=1).numpy(),
+                                   np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(np.arange(10.0)), [3, 3, -1])
+        assert [p.shape[0] for p in parts] == [3, 3, 4]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = paddle.ones([2, 1, 3, 1])
+        assert paddle.squeeze(x).shape == [2, 3]
+        assert paddle.squeeze(x, axis=1).shape == [2, 3, 1]
+        assert paddle.unsqueeze(x, [0, 4]).shape == [1, 2, 1, 3, 1, 1]
+        assert paddle.flatten(x, 1, 2).shape == [2, 3, 1]
+
+    def test_expand_tile_flip(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        assert paddle.expand(x, [2, 4]).shape == [2, 4]
+        assert paddle.expand(x, [-1, 3]).shape == [2, 3]
+        np.testing.assert_allclose(
+            paddle.tile(x, [1, 2]).numpy(), np.tile(x.numpy(), (1, 2)))
+        np.testing.assert_allclose(
+            paddle.flip(x, [0]).numpy(), x.numpy()[::-1])
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        t = paddle.to_tensor(x)
+        i = paddle.to_tensor([3, 1])
+        np.testing.assert_allclose(paddle.gather(t, i).numpy(), x[[3, 1]])
+        upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = paddle.scatter(t, i, upd)
+        ref = x.copy(); ref[[3, 1]] = 1.0
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_gather_nd(self):
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        idx = np.array([[0, 1], [2, 3]], np.int64)
+        out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+
+    def test_where_masked(self):
+        x = np.array([1.0, -2.0, 3.0], np.float32)
+        t = paddle.to_tensor(x)
+        out = paddle.where(t > 0, t, paddle.zeros_like(t))
+        np.testing.assert_allclose(out.numpy(), [1, 0, 3])
+        mf = paddle.masked_fill(t, t < 0, 0.0)
+        np.testing.assert_allclose(mf.numpy(), [1, 0, 3])
+        ms = paddle.masked_select(t, t > 0)
+        np.testing.assert_allclose(ms.numpy(), [1, 3])
+
+    def test_pad(self):
+        x = rng.rand(1, 2, 3, 4).astype(np.float32)
+        out = paddle.ops.manipulation.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert out.shape == [1, 2, 7, 6]
+
+    def test_take_along_put_along(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        i = np.argmax(x, axis=1, keepdims=True)
+        out = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(i), 1)
+        np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, i, 1))
+
+    def test_roll_rot90(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(paddle.roll(paddle.to_tensor(x), 1).numpy(),
+                                   np.roll(x, 1))
+        np.testing.assert_allclose(
+            paddle.rot90(paddle.to_tensor(x)).numpy(), np.rot90(x))
+
+
+class TestLogic:
+    def test_comparisons(self):
+        a = np.array([1, 2, 3])
+        b = np.array([3, 2, 1])
+        check(paddle.equal, np.equal, a, b)
+        check(paddle.less_than, np.less, a, b)
+        check(paddle.greater_equal, np.greater_equal, a, b)
+
+    def test_logical(self):
+        a = np.array([True, False, True])
+        b = np.array([True, True, False])
+        check(paddle.logical_and, np.logical_and, a, b)
+        check(paddle.logical_or, np.logical_or, a, b)
+        check(paddle.logical_not, np.logical_not, a)
+
+    def test_allclose_isclose(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([1.0, 2.0 + 1e-9])
+        assert bool(paddle.allclose(a, b))
+        assert paddle.isclose(a, b).numpy().all()
+
+    def test_bitwise(self):
+        a = np.array([5, 3], np.int32)
+        b = np.array([3, 5], np.int32)
+        check(paddle.bitwise_and, np.bitwise_and, a, b)
+        check(paddle.bitwise_xor, np.bitwise_xor, a, b)
+
+
+class TestLinalg:
+    def test_matmul_variants(self):
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(4, 5).astype(np.float32)
+        check(paddle.matmul, np.matmul, a, b)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                            transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+        # batched
+        x = rng.rand(2, 3, 4).astype(np.float32)
+        y = rng.rand(2, 4, 5).astype(np.float32)
+        check(paddle.bmm, np.matmul, x, y)
+
+    def test_norm(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.norm(t).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.norm(t, p=1, axis=1).numpy(),
+                                   np.abs(x).sum(1), rtol=1e-5)
+
+    def test_solve_inv_det(self):
+        a = rng.rand(3, 3).astype(np.float64) + 3 * np.eye(3)
+        b = rng.rand(3, 2).astype(np.float64)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.linalg.det(paddle.to_tensor(a)).numpy(),
+            np.linalg.det(a), rtol=1e-6)
+
+    def test_cholesky_qr_svd(self):
+        a = rng.rand(4, 4).astype(np.float64)
+        spd = a @ a.T + 4 * np.eye(4)
+        L = paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(L @ L.T, spd, rtol=1e-6)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-6, atol=1e-8)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, rtol=1e-6, atol=1e-8)
+
+    def test_eigh(self):
+        a = rng.rand(3, 3).astype(np.float64)
+        sym = (a + a.T) / 2
+        w, v = paddle.linalg.eigh(paddle.to_tensor(sym))
+        wr = np.linalg.eigvalsh(sym)
+        np.testing.assert_allclose(np.sort(w.numpy()), np.sort(wr), rtol=1e-6)
+
+
+class TestSearch:
+    def test_argmax_sort_topk(self):
+        x = rng.rand(3, 5).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.argmax(t, axis=1).numpy(),
+                                   x.argmax(1))
+        np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(),
+                                   np.sort(x, 1))
+        np.testing.assert_allclose(paddle.argsort(t, axis=1).numpy(),
+                                   np.argsort(x, 1, kind="stable"))
+        vals, idx = paddle.topk(t, 2, axis=1)
+        ref = np.sort(x, 1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_nonzero_unique(self):
+        x = np.array([0.0, 1.0, 0.0, 2.0])
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_allclose(nz.numpy().ravel(), [1, 3])
+        u = paddle.unique(paddle.to_tensor(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+
+    def test_searchsorted(self):
+        s = paddle.to_tensor(np.array([1.0, 3.0, 5.0, 7.0]))
+        v = paddle.to_tensor(np.array([2.0, 5.0]))
+        np.testing.assert_allclose(paddle.searchsorted(s, v).numpy(), [1, 2])
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(123)
+        a = paddle.rand([4])
+        paddle.seed(123)
+        b = paddle.rand([4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_shapes_dtypes(self):
+        assert paddle.randn([2, 3]).shape == [2, 3]
+        r = paddle.randint(0, 10, [100])
+        assert r.dtype == np.dtype(np.int64)
+        assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_uniform_range(self):
+        u = paddle.uniform([1000], min=2.0, max=3.0)
+        assert (u.numpy() >= 2.0).all() and (u.numpy() < 3.0).all()
+
+    def test_bernoulli(self):
+        paddle.seed(0)
+        b = paddle.bernoulli(paddle.full([1000], 0.5))
+        m = b.numpy().mean()
+        assert 0.4 < m < 0.6

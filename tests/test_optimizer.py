@@ -1,0 +1,285 @@
+"""Optimizer + LR scheduler + clip + amp + io + save/load tests."""
+import os
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def quad_problem():
+    paddle.seed(0)
+    w = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    target = np.array([1.0, 2.0], np.float32)
+
+    def loss_fn():
+        return ((w - paddle.to_tensor(target)) ** 2).sum()
+    return w, target, loss_fn
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("opt_cls,kw,steps,lr", [
+        (paddle.optimizer.SGD, {}, 200, 0.1),
+        (paddle.optimizer.Momentum, {"momentum": 0.9}, 100, 0.05),
+        (paddle.optimizer.Adam, {}, 300, 0.1),
+        (paddle.optimizer.AdamW, {"weight_decay": 0.0}, 300, 0.1),
+        (paddle.optimizer.RMSProp, {}, 300, 0.05),
+        (paddle.optimizer.Adagrad, {}, 300, 0.5),
+        (paddle.optimizer.Adamax, {}, 300, 0.2),
+        (paddle.optimizer.Lamb, {"lamb_weight_decay": 0.0}, 1200, 0.05),
+    ])
+    def test_converges_on_quadratic(self, opt_cls, kw, steps, lr):
+        w, target, loss_fn = quad_problem()
+        opt = opt_cls(lr, parameters=[w], **kw)
+        for _ in range(steps):
+            loss = loss_fn()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(w.numpy(), target, atol=0.05)
+
+    def test_sgd_matches_manual(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(0.1, parameters=[w])
+        (w * 3.0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 3.0], rtol=1e-6)
+
+    def test_adam_matches_reference_step(self):
+        w = paddle.Parameter(np.array([2.0], np.float32))
+        opt = paddle.optimizer.Adam(0.1, parameters=[w])
+        (w * 1.0).sum().backward()
+        opt.step()
+        # first adam step: mhat=g, vhat=g^2 → upd = lr*g/(|g|+eps) ≈ lr
+        np.testing.assert_allclose(w.numpy(), [2.0 - 0.1], rtol=1e-4)
+
+    def test_weight_decay_l2(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(0.1, parameters=[w], weight_decay=0.5)
+        paddle.ops.math.mean(w * 0.0).backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.AdamW(0.1, parameters=[w], weight_decay=0.1)
+        (w * 0.0).sum().backward()
+        opt.step()
+        # zero grad → pure decay: w - lr*wd*w
+        np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.1 * 1.0],
+                                   rtol=1e-4)
+
+    def test_optimizer_state_dict(self):
+        w = paddle.Parameter(np.array([1.0], np.float32), name="w0")
+        opt = paddle.optimizer.Adam(0.1, parameters=[w])
+        (w * 2.0).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        assert any("moment1" in k for k in sd)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        s = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_warmup(self):
+        s = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10,
+                                             start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(12):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 0.0
+        assert abs(vals[5] - 0.05) < 1e-6
+        assert vals[11] == 0.1
+
+    def test_scheduler_with_optimizer(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(sched, parameters=[w])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-9
+
+    def test_noam(self):
+        s = paddle.optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+        for _ in range(20):
+            s.step()
+        assert s() > 0
+
+
+class TestGradClip:
+    def test_clip_by_global_norm(self):
+        w = paddle.Parameter(np.array([3.0, 4.0], np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = paddle.optimizer.SGD(1.0, parameters=[w], grad_clip=clip)
+        (w * paddle.to_tensor([3.0, 4.0])).sum().backward()
+        # grad = [3,4], gnorm 5 → scaled to [0.6, 0.8]
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [3.0 - 0.6, 4.0 - 0.8],
+                                   rtol=1e-5)
+
+    def test_clip_by_value(self):
+        w = paddle.Parameter(np.array([0.0], np.float32))
+        clip = nn.ClipGradByValue(0.5)
+        opt = paddle.optimizer.SGD(1.0, parameters=[w], grad_clip=clip)
+        (w * 10.0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [-0.5], rtol=1e-6)
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == paddle.bfloat16
+        out2 = paddle.matmul(a, b)
+        assert out2.dtype == paddle.float32
+
+    def test_autocast_black_list(self):
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast():
+            s = F.softmax(a)
+        assert s.dtype == paddle.float32
+
+    def test_grad_scaler_roundtrip(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        loss = (w * 2.0).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        np.testing.assert_allclose(w.numpy(), [1.0 - 0.2], rtol=1e-5)
+
+    def test_grad_scaler_skips_on_inf(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (w * float("inf")).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+        assert scaler.get_loss_scaling() < 4.0  # scale decreased
+
+
+class TestIO:
+    def test_dataloader_basic(self):
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i % 2)
+
+        dl = paddle.io.DataLoader(DS(), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3]
+        assert y.shape == [4]
+
+    def test_dataloader_shuffle_drop_last(self):
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        dl = paddle.io.DataLoader(DS(), batch_size=3, shuffle=True,
+                                  drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 3
+
+    def test_dataloader_workers(self):
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        dl = paddle.io.DataLoader(DS(), batch_size=2, num_workers=2)
+        vals = sorted(float(v) for b in list(dl) for v in b.numpy())
+        assert vals == list(range(8))
+
+    def test_distributed_batch_sampler(self):
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        s0 = paddle.io.DistributedBatchSampler(DS(), 2, num_replicas=2, rank=0)
+        s1 = paddle.io.DistributedBatchSampler(DS(), 2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 5
+        assert not (set(i0) & set(i1))
+
+    def test_random_split_subset(self):
+        class DS(paddle.io.Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return i
+
+        a, b = paddle.io.random_split(DS(), [7, 3])
+        assert len(a) == 7 and len(b) == 3
+
+
+class TestSaveLoad:
+    def test_save_load_state_dict(self, tmp_path):
+        m = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        p = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), p)
+        loaded = paddle.load(p)
+        m2 = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        m2.set_state_dict(loaded)
+        x = paddle.randn([2, 3])
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_save_load_bfloat16(self, tmp_path):
+        t = paddle.ones([4], dtype="bfloat16")
+        p = str(tmp_path / "t.pd")
+        paddle.save({"t": t}, p)
+        back = paddle.load(p)["t"]
+        assert back.dtype == paddle.bfloat16
+        np.testing.assert_allclose(back.astype("float32").numpy(), np.ones(4))
+
+    def test_save_load_optimizer_state(self, tmp_path):
+        w = paddle.Parameter(np.array([1.0], np.float32), name="w")
+        opt = paddle.optimizer.Adam(0.1, parameters=[w])
+        (w * 2.0).sum().backward()
+        opt.step()
+        p = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), p)
+        sd = paddle.load(p)
+        assert any("moment1" in k for k in sd)
+
+
+class TestMetric:
+    def test_accuracy(self):
+        acc = paddle.metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        label = paddle.to_tensor(np.array([[1], [1]]))
+        correct = acc.compute(pred, label)
+        acc.update(correct)
+        assert abs(acc.accumulate() - 0.5) < 1e-6

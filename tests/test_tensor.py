@@ -1,0 +1,121 @@
+"""Core Tensor semantics (parity model: Paddle eager Tensor tests in
+test/legacy_test/test_egr_python_api.py et al.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_defaults():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == np.dtype(np.int64)  # paddle: python ints -> int64
+    f = paddle.to_tensor([1.0, 2.0])
+    assert f.dtype == np.dtype(np.float32)  # default dtype
+    a = paddle.to_tensor(np.zeros((2, 2), dtype=np.float64))
+    assert a.dtype == np.dtype(np.float64)  # numpy dtype preserved
+
+
+def test_shape_and_meta():
+    t = paddle.zeros([2, 3, 4])
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numel() == 24
+    assert t.stop_gradient is True
+
+
+def test_numpy_roundtrip():
+    x = np.random.rand(3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t.numpy(), x)
+    assert float(paddle.to_tensor(3.5)) == 3.5
+    assert int(paddle.to_tensor(7)) == 7
+
+
+def test_astype_cast():
+    t = paddle.ones([2], dtype="float32")
+    u = t.astype("int64")
+    assert u.dtype == np.dtype(np.int64)
+    v = t.cast("bfloat16")
+    assert v.dtype == paddle.bfloat16
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose(abs(paddle.to_tensor([-1.0, 2.0])).numpy(), [1, 2])
+
+
+def test_comparison_returns_tensor():
+    a = paddle.to_tensor([1.0, 5.0])
+    b = paddle.to_tensor([2.0, 2.0])
+    assert (a < b).numpy().tolist() == [True, False]
+    assert (a == b).numpy().tolist() == [False, False]
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+    np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(t[0:2, 1].numpy(), [1, 5])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(t[idx].numpy(), t.numpy()[[0, 2]])
+    t[0, 0] = 99.0
+    assert t.numpy()[0, 0] == 99.0
+    t[2] = 0.0
+    np.testing.assert_allclose(t.numpy()[2], np.zeros(4))
+
+
+def test_inplace_methods():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(t.numpy(), [2, 3])
+    t.scale_(2.0)
+    np.testing.assert_allclose(t.numpy(), [4, 6])
+    t.zero_()
+    np.testing.assert_allclose(t.numpy(), [0, 0])
+
+
+def test_inplace_leaf_requires_grad_raises():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        t.add_(paddle.to_tensor([1.0]))
+    with paddle.no_grad():
+        t.add_(paddle.to_tensor([1.0]))  # allowed under no_grad
+    np.testing.assert_allclose(t.numpy(), [2.0])
+
+
+def test_detach_clone():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    assert not c.stop_gradient  # clone is differentiable
+
+
+def test_parameter():
+    p = paddle.Parameter(np.ones((2, 2), dtype=np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
+    p.trainable = False
+    assert p.stop_gradient
+
+
+def test_device_roundtrip():
+    t = paddle.ones([2])
+    c = t.cpu()
+    np.testing.assert_allclose(c.numpy(), t.numpy())
+
+
+def test_default_dtype():
+    paddle.set_default_dtype("float64")
+    try:
+        assert paddle.to_tensor(1.0).dtype == np.dtype(np.float64)
+    finally:
+        paddle.set_default_dtype("float32")

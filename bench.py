@@ -1,0 +1,98 @@
+"""Benchmark: flagship Llama causal-LM pretraining step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: training throughput in tokens/sec/chip (the driver's Fleet
+pretrain metric, BASELINE.json). MFU is included in the auxiliary fields
+computed from 6*N_params FLOPs/token against the chip's peak.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import nn
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.jit.bridge import TrainStep
+
+    on_tpu = jax.default_backend() != "cpu"
+    # sized for one v5e-lite chip in bf16
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        batch, seq, iters, warmup = 8, 1024, 20, 3
+    else:  # smoke mode for CPU dev runs
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        batch, seq, iters, warmup = 2, 64, 3, 1
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    crit = LlamaPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    step = TrainStep(model, opt, lambda lg, lb: crit(lg, lb))
+
+    n_params = sum(p.size for p in model.parameters())
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)))
+
+    for _ in range(warmup):
+        loss = step(ids, ids)
+    float(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    final_loss = float(loss)  # device sync
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tps = tokens / dt
+    # MFU: ~6*N flops/token (fwd+bwd) vs chip peak (v5e ≈ 197e12 bf16)
+    peak = 197e12 if on_tpu else 1e12
+    mfu = (6.0 * n_params * tps) / peak
+
+    # vs_baseline: ratio against the best previous round, else 1.0
+    baseline = None
+    for i in range(9, 0, -1):
+        p = f"BENCH_r{i}.json"
+        if os.path.exists(p):
+            try:
+                prev = json.load(open(p))
+                baseline = float(prev.get("value"))
+                break
+            except Exception:
+                pass
+    vs = tps / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 4),
+        "aux": {
+            "params": n_params,
+            "mfu_est": round(mfu, 4),
+            "final_loss": round(final_loss, 4),
+            "batch": batch, "seq": seq, "iters": iters,
+            "backend": jax.default_backend(),
+            "dtype": "bfloat16" if on_tpu else "float32",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,8 +54,19 @@ from . import metric
 from .framework_io import save, load
 from .nn.initializer import ParamAttr
 
-# Subsystem imports appended as milestones land (M2+): vision, jit, static,
-# inference, distributed, incubate, profiler, hapi (Model).
+from . import jit
+from . import static
+from .static.api import enable_static, disable_static, in_dynamic_mode
+from . import device
+from . import vision
+from . import inference
+from . import incubate
+from . import profiler
+from .hapi import Model, summary
+from .hapi import callbacks
+
+from . import distributed
+from .distributed.parallel import DataParallel
 
 
 def is_grad_enabled_():

@@ -1,0 +1,140 @@
+"""fleet.init / distributed_model / distributed_optimizer
+(parity: fleet/fleet.py, fleet/model.py, fleet/optimizer.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn.layer_base import Layer
+from ..env import get_rank, get_world_size, init_parallel_env
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import HybridCommunicateGroup
+from .dist_step import DistTrainStep
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """fleet.init"""
+    strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+    hcg = HybridCommunicateGroup(strategy=strategy)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return None
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _fleet_state["hcg"]
+
+
+def _strategy() -> DistributedStrategy:
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+class DistributedModel(Layer):
+    """The wrapped model returned by fleet.distributed_model. Forward runs
+    the underlying model; `build_train_step(opt, loss_fn)` (or the first
+    train_batch call) compiles the hybrid-parallel step."""
+
+    def __init__(self, model: Layer, strategy: DistributedStrategy):
+        super().__init__()
+        self._layers = model
+        self._strategy = strategy
+        self._train_step = None
+        self._dist_opt = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def named_parameters(self, *a, **kw):
+        return self._layers.named_parameters(*a, **kw)
+
+    def build_train_step(self, optimizer, loss_fn, n_model_inputs=1,
+                         batch_specs=None):
+        opt = optimizer._inner_opt if isinstance(optimizer,
+                                                 DistributedOptimizer) else optimizer
+        st = self._strategy
+        stage = st.sharding_stage
+        self._train_step = DistTrainStep(
+            self._layers, opt, loss_fn, n_model_inputs=n_model_inputs,
+            sharding_stage=stage,
+            mesh=_fleet_state["hcg"].mesh if _fleet_state["hcg"] else None,
+            batch_specs=batch_specs)
+        return self._train_step
+
+    def train_batch(self, data, optimizer=None, lr_scheduler=None,
+                    scaler=None, loss_fn=None):
+        """Pipeline/hybrid one-step API (parity: PipelineParallel.
+        train_batch). `data` = [inputs..., labels...]."""
+        if self._train_step is None:
+            if loss_fn is None or optimizer is None:
+                raise RuntimeError(
+                    "first train_batch needs optimizer and loss_fn (or call "
+                    "build_train_step)")
+            self.build_train_step(optimizer, loss_fn,
+                                  n_model_inputs=max(len(data) - 1, 1))
+        loss = self._train_step(*data)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+
+class DistributedOptimizer:
+    def __init__(self, optimizer, strategy: DistributedStrategy):
+        self._inner_opt = optimizer
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        # eager fallback path — grads are already correct on a single
+        # logical rank; the compiled path goes through DistTrainStep
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **kw):
+        self._inner_opt.clear_grad(*a, **kw)
+
+    def minimize(self, loss, **kw):
+        return self._inner_opt.minimize(loss, **kw)
+
+
+def distributed_model(model: Layer) -> DistributedModel:
+    return DistributedModel(model, _strategy())
+
+
+def distributed_optimizer(optimizer, strategy=None) -> DistributedOptimizer:
+    return DistributedOptimizer(optimizer, strategy or _strategy())
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+def stop_worker():
+    pass

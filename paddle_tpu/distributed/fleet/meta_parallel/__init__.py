@@ -1,0 +1,4 @@
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding, ParallelCrossEntropy)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc
+from .random_ import get_rng_state_tracker, model_parallel_random_seed

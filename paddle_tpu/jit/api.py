@@ -1,0 +1,281 @@
+"""to_static / jit.save / jit.load.
+
+Reference parity: python/paddle/jit/api.py (to_static decorator,
+paddle.jit.save → inference model) and dy2static/program_translator.py
+(StaticFunction with per-input-spec program cache). Here the "program" is
+a jitted XLA executable cached per (shapes, dtypes) signature; jit.save
+exports via jax AOT serialization + weights (loaded by inference.Predictor
+or jit.load).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, Parameter
+from ..framework.random import default_generator
+from .._grad_mode import no_grad
+
+_IN_TO_STATIC = False
+
+
+def _in_to_static():
+    return _IN_TO_STATIC
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        from ..framework.dtype import convert_dtype
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _flatten_tensors(obj, acc):
+    if isinstance(obj, Tensor):
+        acc.append(obj)
+        return "*"
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten_tensors(o, acc) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _flatten_tensors(v, acc) for k, v in obj.items()}
+    return obj
+
+
+def _rebuild(struct, it, wrap):
+    if struct == "*":
+        return wrap(next(it))
+    if isinstance(struct, (list, tuple)):
+        return type(struct)(_rebuild(s, it, wrap) for s in struct)
+    if isinstance(struct, dict):
+        return {k: _rebuild(v, it, wrap) for k, v in struct.items()}
+    return struct
+
+
+class StaticFunction:
+    """Wraps a python function/Layer method; compiles per input signature."""
+
+    def __init__(self, fn, layer=None, input_spec=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    @property
+    def concrete_programs(self):
+        return list(self._cache.values())
+
+    def _state(self):
+        if self._layer is None:
+            return [], []
+        named_p = list(self._layer.named_parameters())
+        named_b = list(self._layer.named_buffers())
+        return named_p, named_b
+
+    def __call__(self, *args, **kwargs):
+        global _IN_TO_STATIC
+        named_p, named_b = self._state()
+        p_tensors = [p for _, p in named_p]
+        b_tensors = [b for _, b in named_b]
+
+        struct = _flatten_tensors((args, kwargs), acc := [])
+        in_tensors = acc
+        in_arrays = [t._value for t in in_tensors]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays)
+
+        if sig not in self._cache:
+            fn = self._fn
+            training = self._layer.training if self._layer is not None else False
+
+            def jax_fn(p_vals, b_vals, rng_key, arg_vals):
+                global _IN_TO_STATIC
+                gen = default_generator()
+                old_key = gen._key
+                gen._key = rng_key
+                olds = [t._value for t in p_tensors + b_tensors]
+                for t, v in zip(p_tensors, p_vals):
+                    t._value = v
+                for t, v in zip(b_tensors, b_vals):
+                    t._value = v
+                prev_flag = _IN_TO_STATIC
+                _IN_TO_STATIC = True
+                try:
+                    it = iter(arg_vals)
+                    a2, kw2 = _rebuild(struct, it, lambda v: Tensor(v))
+                    out = fn(*a2, **kw2)
+                    out_struct = _flatten_tensors(out, out_acc := [])
+                    out_arrays = [t._value for t in out_acc]
+                    new_b = [t._value for t in b_tensors]
+                    new_key = gen._key
+                    return out_arrays, new_b, new_key, out_struct
+                finally:
+                    _IN_TO_STATIC = prev_flag
+                    for t, v in zip(p_tensors + b_tensors, olds):
+                        t._value = v
+                    gen._key = old_key
+
+            out_struct_box = {}
+
+            @functools.partial(jax.jit)
+            def compiled(p_vals, b_vals, rng_key, arg_vals):
+                outs, new_b, new_key, ostruct = jax_fn(p_vals, b_vals,
+                                                       rng_key, arg_vals)
+                out_struct_box["s"] = ostruct
+                return outs, new_b, new_key
+
+            self._cache[sig] = (compiled, out_struct_box)
+
+        compiled, out_struct_box = self._cache[sig]
+        gen = default_generator()
+        key_in = gen.split()
+        outs, new_b, new_key = compiled(
+            [t._value for t in p_tensors], [t._value for t in b_tensors],
+            key_in, in_arrays)
+        # propagate buffer mutations (BN running stats) & rng advance
+        for t, v in zip(b_tensors, new_b):
+            t._value = v
+        it = iter(outs)
+        result = _rebuild(out_struct_box["s"], it, lambda v: Tensor(v))
+        return result
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """@paddle.jit.to_static"""
+    from ..nn.layer_base import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, layer=layer,
+                                input_spec=input_spec)
+            layer.forward = sf
+            return layer
+        # plain function or unbound method
+        layer = getattr(fn, "__self__", None)
+        if layer is not None and isinstance(layer, Layer):
+            return StaticFunction(fn, layer=layer, input_spec=input_spec)
+
+        # late-bound: resolve the owning layer at first call when used as a
+        # method decorator inside a Layer subclass
+        sf_holder = {}
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            if args and isinstance(args[0], Layer):
+                key = id(args[0])
+                if key not in sf_holder:
+                    sf_holder[key] = StaticFunction(
+                        fn.__get__(args[0]), layer=args[0],
+                        input_spec=input_spec)
+                return sf_holder[key](*args[1:], **kw)
+            if "plain" not in sf_holder:
+                sf_holder["plain"] = StaticFunction(fn, input_spec=input_spec)
+            return sf_holder["plain"](*args, **kw)
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+# --------------------------------------------------------------- save/load --
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — exports weights + a pickled net spec. The XLA AOT
+    executable is (re)built at load/predict time from the traced function
+    (compile cache makes this fast), replacing the reference's serialized
+    ProgramDesc + Paddle Inference model format
+    (paddle/fluid/inference/api/analysis_predictor.cc)."""
+    from ..nn.layer_base import Layer
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    if isinstance(layer, Layer):
+        for k, v in layer.state_dict().items():
+            arr = np.asarray(v._value)
+            state[k] = arr.view(np.uint16) if str(v.dtype) == "bfloat16" else arr
+    meta = {
+        "class": type(layer).__name__,
+        "input_spec": [
+            {"shape": s.shape, "dtype": str(s.dtype), "name": s.name}
+            for s in (input_spec or [])
+        ],
+        "bf16_keys": [k for k, v in (layer.state_dict().items()
+                                     if isinstance(layer, Layer) else [])
+                      if str(v.dtype) == "bfloat16"],
+    }
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+    # keep a live-layer registry so load() in the same process can rebuild
+    _saved_layers[os.path.abspath(path)] = layer
+
+
+_saved_layers = {}
+
+
+class TranslatedLayer:
+    """Parity shim for paddle.jit.load's return: callable inference layer."""
+
+    def __init__(self, layer, meta):
+        self._layer = layer
+        self._meta = meta
+
+    def __call__(self, *args, **kw):
+        with no_grad():
+            return self._layer(*args, **kw)
+
+    def eval(self):
+        if hasattr(self._layer, "eval"):
+            self._layer.eval()
+        return self
+
+    def state_dict(self):
+        return self._layer.state_dict()
+
+
+def load(path, **configs):
+    """paddle.jit.load — same-process reload (cross-process model-zoo load
+    goes through paddle_tpu.inference.Predictor with a model factory)."""
+    ap = os.path.abspath(path)
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    if ap in _saved_layers:
+        layer = _saved_layers[ap]
+        with open(path + ".pdiparams", "rb") as f:
+            state = pickle.load(f)
+        from ..framework import dtype as dtypes
+        sd = {}
+        for k, arr in state.items():
+            if k in set(meta.get("bf16_keys", [])):
+                arr = arr.view(dtypes.bfloat16)
+            sd[k] = Tensor(jnp.asarray(arr))
+        layer.set_state_dict(sd)
+        return TranslatedLayer(layer, meta)
+    raise RuntimeError(
+        "paddle_tpu.jit.load requires the layer class in-process; use "
+        "paddle_tpu.inference.create_predictor(config, model_factory=...) "
+        "for deployment loads")

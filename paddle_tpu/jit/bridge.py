@@ -1,0 +1,187 @@
+"""Functional training bridge: one jitted XLA program per train step.
+
+This is the TPU hot path (SURVEY.md §3.1 note: "the whole step becomes one
+jax.jit program") replacing the reference's per-op eager dispatch +
+InterpreterCore. `TrainStep(model, opt, loss_fn)` lifts the imperative
+Layer/Optimizer state into a pure function
+
+    step(params, buffers, opt_state, rng, lr, batch)
+        -> (loss, params', buffers', opt_state', rng')
+
+jit-compiled with donated state (zero-copy in-place update on TPU), then
+writes the results back into the live objects so eager code (metrics,
+checkpointing, LR schedulers) sees the updated state. The same pure
+function is what the distributed engine shards with pjit over a Mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, Parameter
+from ..framework.random import default_generator
+from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+
+def functionalize(layer, fn=None, training=None):
+    """Return (pure_fn, p_arrays, b_arrays, names): pure_fn(p, b, key, *args)
+    runs `fn` (default layer.forward) with params/buffers temporarily bound
+    to the given arrays, returning (outputs, new_buffers, new_key)."""
+    fn = fn or layer.forward
+    named_p = [(n, p) for n, p in layer.named_parameters()]
+    named_b = [(n, b) for n, b in layer.named_buffers()]
+    p_tensors = [p for _, p in named_p]
+    b_tensors = [b for _, b in named_b]
+
+    def pure_fn(p_vals, b_vals, rng_key, *arg_vals):
+        gen = default_generator()
+        old_key = gen._key
+        olds = [t._value for t in p_tensors + b_tensors]
+        old_training = layer.training
+        if training is not None:
+            layer.train() if training else layer.eval()
+        gen._key = rng_key
+        for t, v in zip(p_tensors, p_vals):
+            t._value = v
+        for t, v in zip(b_tensors, b_vals):
+            t._value = v
+        try:
+            args = [Tensor(a) if not isinstance(a, Tensor) else a
+                    for a in arg_vals]
+            out = fn(*args)
+            new_b = [t._value for t in b_tensors]
+            return out, new_b, gen._key
+        finally:
+            for t, v in zip(p_tensors + b_tensors, olds):
+                t._value = v
+            gen._key = old_key
+            layer.training = old_training
+            if training is not None:
+                layer.train() if old_training else layer.eval()
+
+    return (pure_fn, [p._value for p in p_tensors],
+            [b._value for b in b_tensors],
+            [n for n, _ in named_p], [n for n, _ in named_b])
+
+
+def _clip_grads_functional(grads, grad_clip):
+    if grad_clip is None:
+        return grads
+    if isinstance(grad_clip, ClipGradByGlobalNorm):
+        total = functools.reduce(
+            jnp.add, [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in grads])
+        gn = jnp.sqrt(total)
+        c = grad_clip.clip_norm
+        scale = jnp.where(gn > c, c / jnp.maximum(gn, 1e-12), 1.0)
+        return [g * scale.astype(g.dtype) for g in grads]
+    if isinstance(grad_clip, ClipGradByNorm):
+        out = []
+        for g in grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            s = jnp.where(n > grad_clip.clip_norm, grad_clip.clip_norm / n, 1.0)
+            out.append(g * s)
+        return out
+    if isinstance(grad_clip, ClipGradByValue):
+        return [jnp.clip(g, grad_clip.min, grad_clip.max) for g in grads]
+    raise TypeError(f"unsupported grad_clip {type(grad_clip)}")
+
+
+class TrainStep:
+    """Compiled train step. Call with the batch tensors; the loss Tensor is
+    returned and model/optimizer state advance exactly as in eager mode.
+
+    loss_fn(model_outputs, *labels) -> scalar Tensor. The first
+    `n_model_inputs` batch args feed the model; the rest feed loss_fn.
+    """
+
+    def __init__(self, model, optimizer, loss_fn: Callable,
+                 n_model_inputs: int = 1, donate_state: bool = True):
+        self._model = model
+        self._opt = optimizer
+        self._loss_fn = loss_fn
+        self._n_in = n_model_inputs
+
+        self._named_p = [(n, p) for n, p in model.named_parameters()
+                         if not p.stop_gradient]
+        self._named_b = [(n, b) for n, b in model.named_buffers()]
+        self._p = [p for _, p in self._named_p]
+        self._b = [b for _, b in self._named_b]
+        self._p_names = [n for n, _ in self._named_p]
+        self._opt_state = optimizer._fn_init_all(
+            [p._value for p in self._p], self._p_names, self._p)
+        self._compiled = {}
+        self._donate = donate_state
+
+    def _build(self, sig):
+        model = self._model
+        loss_fn = self._loss_fn
+        opt = self._opt
+        p_tensors = self._p
+        b_tensors = self._b
+        n_in = self._n_in
+        p_names = self._p_names
+        grad_clip = opt._grad_clip
+
+        def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch):
+            gen = default_generator()
+            model_in = batch[:n_in]
+            labels = batch[n_in:]
+
+            def loss_of(pv):
+                old_key = gen._key
+                olds = [t._value for t in p_tensors + b_tensors]
+                gen._key = rng_key
+                for t, v in zip(p_tensors, pv):
+                    t._value = v
+                for t, v in zip(b_tensors, b_vals):
+                    t._value = v
+                try:
+                    outs = model(*[Tensor(a) for a in model_in])
+                    outs = outs if isinstance(outs, tuple) else (outs,)
+                    loss = loss_fn(*outs, *[Tensor(a) for a in labels])
+                    new_b = [t._value for t in b_tensors]
+                    return loss._value, (new_b, gen._key)
+                finally:
+                    for t, v in zip(p_tensors + b_tensors, olds):
+                        t._value = v
+                    gen._key = old_key
+
+            (loss_val, (new_b, new_key)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(p_vals))
+            grads = _clip_grads_functional(grads, grad_clip)
+            new_p, new_state = opt._fn_apply_all(
+                list(p_vals), grads, opt_state, lr, p_names, p_tensors)
+            return loss_val, new_p, new_b, new_state, new_key
+
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        if sig not in self._compiled:
+            self._compiled[sig] = self._build(sig)
+        gen = default_generator()
+        key_in = gen.split()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        loss, new_p, new_b, new_state, new_key = self._compiled[sig](
+            [p._value for p in self._p], [b._value for b in self._b],
+            self._opt_state, key_in, lr, arrays)
+        for t, v in zip(self._p, new_p):
+            t._value = v
+        for t, v in zip(self._b, new_b):
+            t._value = v
+        self._opt_state = new_state
+        # keep the eager accumulators in sync so optimizer.state_dict()
+        # (checkpointing) observes the compiled step's state
+        self._opt._fn_sync_to_accumulators(self._p, new_state)
+        return Tensor(loss)
+
+    @property
+    def opt_state(self):
+        return self._opt_state

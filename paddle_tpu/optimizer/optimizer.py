@@ -147,8 +147,7 @@ class SGD(Optimizer):
         return _sgd_kernel(p._value, g, lr)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _sgd_kernel(p, g, lr):
+def _sgd_math(p, g, lr):
     return p - lr * g
 
 
@@ -171,8 +170,7 @@ class Momentum(Optimizer):
         return new_p
 
 
-@functools.partial(jax.jit, static_argnums=(5,), donate_argnums=(0, 2))
-def _momentum_kernel(p, g, v, lr, mu, nesterov):
+def _momentum_math(p, g, v, lr, mu, nesterov):
     v2 = mu * v + g
     if nesterov:
         p2 = p - lr * (g + mu * v2)
@@ -209,8 +207,7 @@ class Adam(Optimizer):
         return new_p
 
 
-@functools.partial(jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))
-def _adam_kernel(p, g, m, v, t, lr, b1, b2, eps, wd):
+def _adam_math(p, g, m, v, t, lr, b1, b2, eps, wd):
     t2 = t + 1
     gf = g.astype(m.dtype)
     m2 = b1 * m + (1 - b1) * gf
@@ -276,8 +273,7 @@ class Adagrad(Optimizer):
         return new_p
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2))
-def _adagrad_kernel(p, g, acc, lr, eps):
+def _adagrad_math(p, g, acc, lr, eps):
     acc2 = acc + g * g
     return p - lr * g / (jnp.sqrt(acc2) + eps), acc2
 
@@ -306,8 +302,7 @@ class Adamax(Optimizer):
         return new_p
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4))
-def _adamax_kernel(p, g, m, u, t, lr, b1, b2, eps):
+def _adamax_math(p, g, m, u, t, lr, b1, b2, eps):
     t2 = t + 1
     m2 = b1 * m + (1 - b1) * g
     u2 = jnp.maximum(b2 * u, jnp.abs(g))
@@ -339,8 +334,7 @@ class RMSProp(Optimizer):
         return new_p
 
 
-@functools.partial(jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))
-def _rmsprop_kernel(p, g, ms, mg, mom, lr, rho, eps, mu, centered):
+def _rmsprop_math(p, g, ms, mg, mom, lr, rho, eps, mu, centered):
     ms2 = rho * ms + (1 - rho) * g * g
     if centered:
         mg2 = rho * mg + (1 - rho) * g
@@ -377,8 +371,7 @@ class Lamb(Optimizer):
         return new_p
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 3, 4))
-def _lamb_kernel(p, g, m, v, t, lr, b1, b2, eps, wd):
+def _lamb_math(p, g, m, v, t, lr, b1, b2, eps, wd):
     t2 = t + 1
     m2 = b1 * m + (1 - b1) * g
     v2 = b2 * v + (1 - b2) * g * g
@@ -390,3 +383,201 @@ def _lamb_kernel(p, g, m, v, t, lr, b1, b2, eps, wd):
     r_norm = jnp.linalg.norm(r)
     ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
     return p - lr * ratio * r, m2, v2, t2
+
+
+# Eager-path jitted kernels (donated buffers → true in-place on device).
+_sgd_kernel = functools.partial(jax.jit, donate_argnums=(0,))(_sgd_math)
+_momentum_kernel = functools.partial(
+    jax.jit, static_argnums=(5,), donate_argnums=(0, 2))(_momentum_math)
+_adam_kernel = functools.partial(
+    jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))(_adam_math)
+_adagrad_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2))(_adagrad_math)
+_adamax_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3, 4))(_adamax_math)
+_rmsprop_kernel = functools.partial(
+    jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))(_rmsprop_math)
+_lamb_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3, 4))(_lamb_math)
+
+
+# ---------------------------------------------------------------------------
+# Functional optimizer API — used by jit.bridge.TrainStep and the
+# distributed engine, where the optimizer update must be a pure function of
+# (params, grads, state) so the whole train step jits/pjits as one program.
+# ---------------------------------------------------------------------------
+
+def _fn_init_all(self, p_arrays, p_names, params=None):
+    """Build per-param functional state. Seeds from existing eager
+    accumulators (same keys) so a loaded checkpoint's moments carry into
+    the compiled step instead of restarting from zero."""
+    states = []
+    for i, a in enumerate(p_arrays):
+        st = self._fn_init(a)
+        if params is not None and isinstance(st, dict):
+            pid = id(params[i])
+            for k in st:
+                store = self._accumulators.get(k)
+                if store and pid in store:
+                    st[k] = store[pid]
+        states.append(st)
+    return states
+
+
+def _fn_apply_all(self, p_arrays, grads, states, lr, p_names, params=None):
+    new_p, new_s = [], []
+    for i, (p, g, s, n) in enumerate(zip(p_arrays, grads, states, p_names)):
+        if g.dtype != p.dtype:
+            g = g.astype(p.dtype)
+        param = params[i] if params is not None else None
+        p2, s2 = self._fn_apply(p, g, s, lr, n, param)
+        new_p.append(p2)
+        new_s.append(s2)
+    return new_p, new_s
+
+
+def _fn_sync_to_accumulators(self, params, states):
+    """Write the compiled step's state back into the eager accumulators so
+    Optimizer.state_dict()/checkpointing observe it."""
+    for p, st in zip(params, states):
+        if isinstance(st, dict):
+            pid = id(p)
+            for k, v in st.items():
+                self._accumulators.setdefault(k, {})[pid] = v
+            self._accum_meta[pid] = getattr(p, "name", None) or str(pid)
+
+
+Optimizer._fn_init_all = _fn_init_all
+Optimizer._fn_apply_all = _fn_apply_all
+Optimizer._fn_sync_to_accumulators = _fn_sync_to_accumulators
+
+
+def _sgd_fn_init(self, a):
+    return ()
+
+
+def _sgd_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    return _sgd_math(p, g, lr), ()
+
+
+SGD._fn_init = _sgd_fn_init
+SGD._fn_apply = _sgd_fn_apply
+
+
+def _momentum_fn_init(self, a):
+    return {"velocity": jnp.zeros_like(a)}
+
+
+def _momentum_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, v2 = _momentum_math(p, g, s["velocity"], lr, self._momentum,
+                            self._use_nesterov)
+    return p2, {"velocity": v2}
+
+
+Momentum._fn_init = _momentum_fn_init
+Momentum._fn_apply = _momentum_fn_apply
+
+
+def _adam_fn_init(self, a):
+    return {"moment1": jnp.zeros_like(a), "moment2": jnp.zeros_like(a),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _adam_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, m2, v2, t2 = _adam_math(p, g, s["moment1"], s["moment2"], s["step"],
+                                lr, self.beta1, self.beta2, self.epsilon, 0.0)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+Adam._fn_init = _adam_fn_init
+Adam._fn_apply = _adam_fn_apply
+
+
+def _adamw_fn_apply(self, p, g, s, lr, name, param=None):
+    wd = self._wd
+    if self._apply_decay_param_fun is not None and \
+            not self._apply_decay_param_fun(name or ""):
+        wd = 0.0
+    if self._lr_ratio is not None and param is not None:
+        lr = lr * self._lr_ratio(param)
+    p2, m2, v2, t2 = _adam_math(p, g, s["moment1"], s["moment2"], s["step"],
+                                lr, self.beta1, self.beta2, self.epsilon, wd)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+AdamW._fn_apply = _adamw_fn_apply
+
+
+def _adagrad_fn_init(self, a):
+    return {"moment": jnp.full_like(a, self._init_acc)}
+
+
+def _adagrad_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, acc2 = _adagrad_math(p, g, s["moment"], lr, self.epsilon)
+    return p2, {"moment": acc2}
+
+
+Adagrad._fn_init = _adagrad_fn_init
+Adagrad._fn_apply = _adagrad_fn_apply
+
+
+def _adamax_fn_init(self, a):
+    return {"moment": jnp.zeros_like(a), "inf_norm": jnp.zeros_like(a),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _adamax_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, m2, u2, t2 = _adamax_math(p, g, s["moment"], s["inf_norm"], s["step"],
+                                  lr, self.beta1, self.beta2, self.epsilon)
+    return p2, {"moment": m2, "inf_norm": u2, "step": t2}
+
+
+Adamax._fn_init = _adamax_fn_init
+Adamax._fn_apply = _adamax_fn_apply
+
+
+def _rmsprop_fn_init(self, a):
+    return {"mean_square": jnp.zeros_like(a), "mean_grad": jnp.zeros_like(a),
+            "momentum": jnp.zeros_like(a)}
+
+
+def _rmsprop_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, ms2, mg2, mom2 = _rmsprop_math(
+        p, g, s["mean_square"], s["mean_grad"], s["momentum"], lr, self.rho,
+        self.epsilon, self.momentum, self.centered)
+    return p2, {"mean_square": ms2, "mean_grad": mg2, "momentum": mom2}
+
+
+RMSProp._fn_init = _rmsprop_fn_init
+RMSProp._fn_apply = _rmsprop_fn_apply
+
+
+def _lamb_fn_init(self, a):
+    return {"moment1": jnp.zeros_like(a), "moment2": jnp.zeros_like(a),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _lamb_fn_apply(self, p, g, s, lr, name, param=None):
+    wd = self._wd
+    if self._exclude_fn is not None and param is not None \
+            and self._exclude_fn(param):
+        wd = 0.0
+    p2, m2, v2, t2 = _lamb_math(p, g, s["moment1"], s["moment2"], s["step"],
+                                lr, self.beta1, self.beta2, self.epsilon, wd)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+Lamb._fn_init = _lamb_fn_init
+Lamb._fn_apply = _lamb_fn_apply

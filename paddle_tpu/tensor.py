@@ -32,6 +32,7 @@ class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "grad", "_grad_node", "_out_index",
         "name", "persistable", "_hooks", "_pylayer_ctx", "__weakref__",
+        "__dict__",  # extension attrs (partition specs, dist metadata, ...)
     )
 
     def __init__(self, value, stop_gradient: bool = True,

@@ -1,0 +1,7 @@
+"""paddle.vision.models (parity: python/paddle/vision/models/)."""
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, wide_resnet50_2, resnext50_32x4d,
+                     BasicBlock, BottleneckBlock)
+from .lenet import LeNet
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenetv2 import MobileNetV2, mobilenet_v2

@@ -1,0 +1,265 @@
+"""to_static / TrainStep bridge / static control flow / predictor tests
+(parity model: test/dygraph_to_static — eager vs to_static equality)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+class TestToStatic:
+    def test_layer_eager_static_parity(self):
+        paddle.seed(1)
+        net = SmallNet()
+        x = paddle.randn([3, 4])
+        eager_out = net(x)
+        snet = paddle.jit.to_static(SmallNet())
+        snet.set_state_dict(net.state_dict())
+        static_out = snet(x)
+        np.testing.assert_allclose(static_out.numpy(), eager_out.numpy(),
+                                   rtol=1e-5)
+
+    def test_function_to_static(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return a * 2 + b
+
+        out = f(paddle.to_tensor([1.0]), paddle.to_tensor([3.0]))
+        np.testing.assert_allclose(out.numpy(), [5.0])
+        out2 = f(paddle.to_tensor([2.0]), paddle.to_tensor([1.0]))
+        np.testing.assert_allclose(out2.numpy(), [5.0])
+
+    def test_to_static_recompiles_per_shape(self):
+        @paddle.jit.to_static
+        def f(a):
+            return a.sum()
+
+        f(paddle.ones([2]))
+        f(paddle.ones([3]))  # new signature, no crash
+
+    def test_buffer_mutation_propagates(self):
+        net = nn.BatchNorm1D(4)
+        snet = paddle.jit.to_static(net)
+        before = net._mean.numpy().copy()
+        snet(paddle.randn([8, 4]))
+        after = net._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_dropout_varies_under_jit(self):
+        net = nn.Dropout(0.5)
+        snet = paddle.jit.to_static(net)
+        paddle.seed(7)
+        a = snet(paddle.ones([64]))
+        b = snet(paddle.ones([64]))
+        assert not np.allclose(a.numpy(), b.numpy())
+
+
+class TestTrainStepBridge:
+    def test_matches_eager_training(self):
+        paddle.seed(3)
+        x_np = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        y_np = np.random.RandomState(1).rand(8, 2).astype(np.float32)
+
+        def make():
+            paddle.seed(123)
+            net = SmallNet()
+            opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+            return net, opt
+
+        # eager loop
+        net_e, opt_e = make()
+        for _ in range(5):
+            loss_e = F.mse_loss(net_e(paddle.to_tensor(x_np)),
+                                paddle.to_tensor(y_np))
+            loss_e.backward()
+            opt_e.step()
+            opt_e.clear_grad()
+
+        # compiled loop
+        net_c, opt_c = make()
+        step = paddle.jit.TrainStep(net_c, opt_c,
+                                    lambda out, y: F.mse_loss(out, y))
+        for _ in range(5):
+            loss_c = step(paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+
+        np.testing.assert_allclose(loss_c.numpy(), loss_e.numpy(), rtol=1e-4)
+        for (n1, p1), (n2, p2) in zip(net_e.named_parameters(),
+                                      net_c.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=2e-3,
+                                       atol=1e-5, err_msg=n1)
+
+    def test_with_grad_clip(self):
+        net = SmallNet()
+        opt = paddle.optimizer.SGD(
+            0.1, parameters=net.parameters(),
+            grad_clip=nn.ClipGradByGlobalNorm(0.1))
+        step = paddle.jit.TrainStep(net, opt,
+                                    lambda out, y: F.mse_loss(out, y))
+        loss = step(paddle.randn([4, 4]), paddle.randn([4, 2]))
+        assert np.isfinite(float(loss))
+
+
+class TestStaticControlFlow:
+    def test_cond_eager(self):
+        x = paddle.to_tensor(3.0)
+        out = paddle.static.nn.cond(x > 2.0,
+                                    lambda: paddle.to_tensor(1.0),
+                                    lambda: paddle.to_tensor(0.0))
+        assert float(out) == 1.0
+
+    def test_while_loop_eager(self):
+        i = paddle.to_tensor(0)
+        out = paddle.static.nn.while_loop(
+            lambda i: i < 5, lambda i: (i + 1,), [i])
+        assert int(out[0]) == 5
+
+    def test_cond_under_jit(self):
+        @paddle.jit.to_static
+        def f(x):
+            return paddle.static.nn.cond(
+                x.sum() > 0, lambda: x * 2, lambda: x * -1)
+
+        out = f(paddle.to_tensor([1.0, 2.0]))
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        out2 = f(paddle.to_tensor([-5.0, 1.0]))
+        np.testing.assert_allclose(out2.numpy(), [5.0, -1.0])
+
+    def test_while_under_jit(self):
+        @paddle.jit.to_static
+        def f(n):
+            i = paddle.to_tensor(0, dtype="int64")
+            s = paddle.to_tensor(0, dtype="int64")
+            i, s, n = paddle.static.nn.while_loop(
+                lambda i, s, n: i < n,
+                lambda i, s, n: (i + 1, s + i, n),
+                [i, s, n])
+            return s
+
+        out = f(paddle.to_tensor(5, dtype="int64"))
+        assert int(out) == 10
+
+
+class TestJitSaveLoadPredictor:
+    def test_jit_save_load_roundtrip(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        x = paddle.randn([2, 4])
+        ref = net(x).numpy()
+        path = str(tmp_path / "m/model")
+        paddle.jit.save(net, path)
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-6)
+
+    def test_predictor(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        x = np.random.rand(2, 4).astype(np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+        cfg = paddle.inference.Config()
+        cfg.set_model_factory(lambda: net)
+        pred = paddle.inference.create_predictor(cfg)
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+
+    def test_predictor_handles_api(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        x = np.random.rand(2, 4).astype(np.float32)
+        cfg = paddle.inference.Config()
+        cfg.set_model_factory(lambda: net)
+        pred = paddle.inference.create_predictor(cfg)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5)
+
+
+class TestVisionAndModel:
+    def test_lenet_forward(self):
+        net = paddle.vision.LeNet()
+        out = net(paddle.randn([2, 1, 28, 28]))
+        assert out.shape == [2, 10]
+
+    def test_resnet18_forward(self):
+        net = paddle.vision.resnet18(num_classes=10)
+        net.eval()
+        out = net(paddle.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 10]
+
+    def test_resnet50_param_count(self):
+        net = paddle.vision.resnet50()
+        n = sum(p.size for p in net.parameters())
+        assert abs(n - 25_557_032) < 60_000, n  # torchvision resnet50 ≈ 25.56M
+
+    def test_model_fit_evaluate(self):
+        from paddle_tpu.vision.datasets import FakeData
+        paddle.seed(0)
+        ds = FakeData(size=32, image_shape=(1, 28, 28), num_classes=10)
+        model = paddle.Model(paddle.vision.LeNet())
+        opt = paddle.optimizer.Adam(0.001,
+                                    parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(),
+                      paddle.metric.Accuracy())
+        model.fit(ds, epochs=1, batch_size=8, verbose=0)
+        res = model.evaluate(ds, batch_size=8, verbose=0)
+        assert "loss" in res and "acc" in res
+
+    def test_model_save_load(self, tmp_path):
+        model = paddle.Model(paddle.vision.LeNet())
+        opt = paddle.optimizer.Adam(0.001, parameters=model.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        p = str(tmp_path / "ckpt/final")
+        model.save(p)
+        model2 = paddle.Model(paddle.vision.LeNet())
+        model2.prepare(paddle.optimizer.Adam(
+            0.001, parameters=model2.parameters()), nn.CrossEntropyLoss())
+        model2.load(p)
+        np.testing.assert_allclose(
+            model.network.features[0].weight.numpy(),
+            model2.network.features[0].weight.numpy())
+
+    def test_transforms(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+        pipeline = T.Compose([
+            T.Resize(40), T.CenterCrop(32), T.RandomHorizontalFlip(),
+            T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        out = pipeline(img)
+        assert out.shape == [3, 32, 32]
+
+    def test_summary(self):
+        info = paddle.summary(paddle.vision.LeNet())
+        assert info["total_params"] > 0
+
+
+class TestAmpEndToEnd:
+    def test_autocast_training_converges(self):
+        paddle.seed(5)
+        net = SmallNet()
+        opt = paddle.optimizer.SGD(0.05, parameters=net.parameters())
+        x = paddle.randn([16, 4])
+        y = paddle.randn([16, 2])
+        losses = []
+        for _ in range(30):
+            with paddle.amp.auto_cast():
+                out = net(x)
+                loss = F.mse_loss(out.astype("float32"), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

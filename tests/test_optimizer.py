@@ -27,7 +27,9 @@ class TestOptimizers:
         (paddle.optimizer.RMSProp, {}, 300, 0.05),
         (paddle.optimizer.Adagrad, {}, 300, 0.5),
         (paddle.optimizer.Adamax, {}, 300, 0.2),
-        (paddle.optimizer.Lamb, {"lamb_weight_decay": 0.0}, 1200, 0.05),
+        # note: Lamb's trust ratio makes step size ∝ lr·‖w‖, so it oscillates
+        # at that radius — needs a small lr to converge tightly
+        (paddle.optimizer.Lamb, {"lamb_weight_decay": 0.0}, 2000, 0.005),
     ])
     def test_converges_on_quadratic(self, opt_cls, kw, steps, lr):
         w, target, loss_fn = quad_problem()

@@ -5,6 +5,7 @@ Holds the fused-op python API names PaddleNLP-style code imports
 are delivered by the Pallas kernels + XLA fusion.
 """
 from . import nn
+from . import distributed
 from ..ops import math as _m
 
 softmax_mask_fuse = None

@@ -9,3 +9,7 @@ fallbacks used on CPU or when `FLAGS_use_pallas_kernels=0`.
 from .attention import flash_attention, flash_attention_bshd
 from .norm import fused_rms_norm, fused_layer_norm
 from .rope import apply_rotary_emb
+from .ring_attention import (
+    RingFlashAttention, UlyssesAttention, ring_flash_attention,
+    ring_attention_jax, ulysses_attention_jax, split_inputs_sequence_dim,
+)

@@ -1,0 +1,27 @@
+// Common helpers for the paddle_tpu native runtime library.
+//
+// Reference parity (capability, not code): the reference framework's C++
+// runtime layer — paddle/phi/core/distributed/store/tcp_store.cc (rendezvous
+// KV store), paddle/phi/core/flags.cc (gflags-style registry),
+// paddle/fluid/memory/allocation (allocator stats), and the DataLoader
+// shared-memory worker pool (python/paddle/io + fluid shm utils).
+//
+// TPU-native stance: device memory is owned by XLA; this library provides the
+// HOST-side native runtime (rendezvous, flags, host-stats, shm IPC) exported
+// through a plain C ABI consumed via ctypes (no pybind11 in this image).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#define PD_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace pd {
+
+// Last-error slot (thread-local) so Python can fetch a message after a
+// failed call instead of parsing errno.
+void set_last_error(const std::string& msg);
+const char* last_error();
+
+}  // namespace pd

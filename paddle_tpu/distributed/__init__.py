@@ -31,6 +31,14 @@ from .fleet.sharding import group_sharded_parallel, save_group_sharded_model
 from .fleet import sharding
 
 
+def TCPStore(host, port, is_master=False, world_size=1, timeout=90.0):
+    """Native rendezvous KV store (csrc/tcp_store.cc). Parity:
+    paddle.distributed.TCPStore backed by phi's C++ TCPStore."""
+    from .._native import TCPStore as _Store
+    return _Store(host, port, is_master=is_master, world_size=world_size,
+                  timeout=timeout)
+
+
 def get_backend():
     return "xla"
 

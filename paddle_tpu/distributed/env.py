@@ -101,11 +101,36 @@ def init_parallel_env():
     if master and nprocs > 1:
         port = os.environ.get("MASTER_PORT")
         addr = master if ":" in master else f"{master}:{port or 8476}"
+        _tcp_rendezvous(addr, nprocs, pid)
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=nprocs, process_id=pid)
     _initialized = True
     from .mesh import ensure_mesh
     ensure_mesh()
+
+
+def _tcp_rendezvous(addr: str, nprocs: int, pid: int):
+    """Pre-init rendezvous over the native TCPStore (parity: the reference's
+    TCPStore comm-id exchange before ProcessGroup construction). Rank 0
+    hosts the store one port above the coordinator; every rank checks in so
+    misconfigured world sizes fail fast with a clear error instead of a
+    coordination-service hang. Best-effort when the native lib is absent."""
+    try:
+        from .._native import TCPStore, available
+        if not available():
+            return
+        host, port = addr.rsplit(":", 1)
+        store = TCPStore(host, int(port) + 1, is_master=(pid == 0),
+                         world_size=nprocs)
+        store.barrier("init_parallel_env", nprocs)
+        _store_ref[0] = store  # keep alive: server daemon lives on rank 0
+    except Exception as e:  # rendezvous is advisory; jax.distributed decides
+        import logging
+        logging.getLogger(__name__).warning("TCPStore rendezvous skipped: %s",
+                                            e)
+
+
+_store_ref = [None]
 
 
 def is_available():

@@ -33,6 +33,36 @@ def _coerce(ty, raw):
     return ty(raw)
 
 
+def _native_mirror(name, ty, value, help_=""):
+    """Mirror a flag into the native registry (csrc/flags.cc) so native
+    components see framework flag state. Deferred: no-op until something
+    actually loads the native lib (so `import paddle_tpu` never triggers a
+    compile); load() calls resync_native() to catch up."""
+    try:
+        from .. import _native
+        if not _native.is_loaded():
+            return
+        code = {bool: _native.FLAG_BOOL, int: _native.FLAG_INT,
+                float: _native.FLAG_DOUBLE}.get(ty, _native.FLAG_STRING)
+        # define (idempotent; applies env default) then set the explicit
+        # current value so set_flags wins over a stale FLAGS_* env override.
+        if code == _native.FLAG_STRING:
+            _native.flag_define(name, code, str(value), 0.0, help_)
+            _native.flag_set(name, str(value))
+        else:
+            _native.flag_define(name, code, "", float(value), help_)
+            _native.flag_set(name, float(value))
+    except Exception:
+        pass
+
+
+def resync_native():
+    """Push the whole Python registry into the native one (called by
+    _native.load() after the library comes up)."""
+    for f in _REGISTRY.values():
+        _native_mirror(f.name, f.type, f.value, f.help)
+
+
 def define_flag(name: str, default, help: str = "", type_: type | None = None,
                 on_change=None):
     ty = type_ or type(default)
@@ -41,6 +71,7 @@ def define_flag(name: str, default, help: str = "", type_: type | None = None,
     flag = _Flag(name=name, default=default, type=ty, help=help,
                  on_change=on_change, value=value)
     _REGISTRY[name] = flag
+    _native_mirror(name, ty, value, help)
     if on_change is not None and env is not None:
         on_change(value)
     return flag
@@ -54,6 +85,7 @@ def set_flags(flags: Dict[str, Any]):
             raise ValueError(f"unknown flag {k!r}")
         f = _REGISTRY[k]
         f.value = _coerce(f.type, v)
+        _native_mirror(k, f.type, f.value, f.help)
         if f.on_change is not None:
             f.on_change(f.value)
 
@@ -97,3 +129,5 @@ define_flag("use_pallas_kernels", True,
             "Use Pallas fused kernels (attention/LN/RoPE) when on TPU.")
 define_flag("max_inplace_grad_add", 0, "Parity stub.")
 define_flag("eager_delete_tensor_gb", 0.0, "Parity stub; XLA GC is automatic.")
+define_flag("shm_channel_capacity_mb", 64,
+            "Per-DataLoader shared-memory ring capacity (native worker pool).")

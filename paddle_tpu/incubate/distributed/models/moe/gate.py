@@ -17,12 +17,6 @@ import jax.numpy as jnp
 
 from .....nn.layer_base import Layer
 from .....nn.initializer import XavierUniform
-from .....ops._dispatch import apply
-from .....ops.creation import _coerce
-
-
-def _router_probs(logits):
-    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
 
 def load_balance_loss(probs, expert_mask):

@@ -2,11 +2,16 @@
 
 Reference parity: python/paddle/io/ (Dataset, IterableDataset, DataLoader
 with multiprocess workers, BatchSampler, DistributedBatchSampler, Subset,
-random_split). TPU-native note: the reference's shared-memory worker pool
-exists to feed GPUs from python; on TPU the equivalent is background
-thread prefetch + (optionally) grain for heavy pipelines. `num_workers>0`
-maps to a thread-pool prefetcher — the jax host program stays
-single-process (XLA owns the device), matching jax data-loading practice.
+random_split).
+
+TPU-native worker story: with num_workers>0 and use_shared_memory=True the
+loader forks numpy-only worker processes that collate batches and ship them
+through the native shm ring channel (csrc/shm_channel.cc) — the same
+transport design as the reference's shared-memory worker pool — while the
+parent process alone owns JAX/XLA and does host→device placement. With
+use_shared_memory=False (or if the native lib is unavailable) it falls back
+to a background-thread prefetcher, which is the common jax practice when the
+per-sample work is light.
 """
 from __future__ import annotations
 
@@ -258,6 +263,19 @@ class DistributedBatchSampler(BatchSampler):
 
 
 # -------------------------------------------------------------- collation ---
+def _np_tree_to_tensor(obj):
+    """Convert a numpy-collated tree (from a worker process) to Tensors."""
+    if isinstance(obj, np.ndarray):
+        return to_tensor(obj)
+    if isinstance(obj, tuple):
+        return tuple(_np_tree_to_tensor(o) for o in obj)
+    if isinstance(obj, list):
+        return [_np_tree_to_tensor(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _np_tree_to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (Tensor,)):
@@ -285,7 +303,10 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._user_collate = collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self.prefetch_factor = prefetch_factor
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -306,22 +327,57 @@ class DataLoader:
 
     def _iter_batches(self):
         if self._iterable_mode:
-            batch = []
-            for item in self.dataset:
-                batch.append(item)
-                if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
-                    batch = []
-            if batch and not self.drop_last:
-                yield self.collate_fn(batch)
+            # Single-pass contract: expose worker info (id=0, num_workers=1)
+            # so sharding IterableDatasets behave identically here and in
+            # the multiprocess path (where each worker streams its shard).
+            from . import _worker as _w
+            prev = _w._WORKER_INFO
+            _w._WORKER_INFO = _w.WorkerInfo(id=0, num_workers=1, seed=0,
+                                            dataset=self.dataset)
+            try:
+                yield from self._iter_iterable_batches()
+            finally:
+                _w._WORKER_INFO = prev
         else:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _iter_iterable_batches(self):
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_multiprocess(self):
+        """Fork numpy-only workers feeding batches through the native shm
+        channel; order is restored to match the single-process loader."""
+        from ._worker import WorkerPool
+        if self._iterable_mode:
+            batch_indices = None
+            bs, dl = self.batch_size, self.drop_last
+        else:
+            batch_indices = list(self.batch_sampler)
+            bs, dl = 1, False
+        pool = WorkerPool(
+            self.dataset, batch_indices, self.num_workers,
+            self._user_collate, self.worker_init_fn,
+            seed=int(np.random.randint(0, 2 ** 31)),
+            batch_size=bs, drop_last=dl)
+        yield from pool
 
     def __iter__(self):
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return
+        if self.use_shared_memory:
+            from .._native import available as _native_ok
+            if _native_ok():
+                yield from self._iter_multiprocess()
+                return
         # background-thread prefetch pipeline
         q: "queue.Queue" = queue.Queue(
             maxsize=self.num_workers * self.prefetch_factor)
@@ -344,4 +400,6 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    """Worker metadata inside DataLoader worker processes (else None)."""
+    from ._worker import get_worker_info as _gwi
+    return _gwi()

@@ -1,0 +1,238 @@
+"""Native runtime library tests: TCPStore, shm channel, flags/stats,
+multiprocess DataLoader. Parity model: test/cpp store tests +
+test/legacy_test dataloader tests (reference runs these as gtest + spawned
+subprocess python; here the C ABI is driven through ctypes)."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import _native
+
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="native toolchain unavailable")
+
+
+class TestTCPStore:
+    def test_set_get_add_wait(self):
+        s = _native.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        try:
+            s.set("k", b"v1")
+            assert s.get("k") == b"v1"
+            s.set("k", "v2")
+            assert s.get("k") == b"v2"
+            assert s.add("cnt", 3) == 3
+            assert s.add("cnt", -1) == 2
+            s.wait(["k", "cnt"])
+            assert s.num_keys() >= 2
+            assert s.delete_key("k")
+            with pytest.raises(KeyError):
+                s.get("k", timeout_ms=100)
+        finally:
+            s.close()
+
+    def test_second_client_sees_master_data(self):
+        master = _native.TCPStore("127.0.0.1", 0, is_master=True,
+                                  world_size=2)
+        try:
+            worker = _native.TCPStore("127.0.0.1", master.port,
+                                      is_master=False, world_size=2)
+            master.set("from_master", b"hello")
+            assert worker.get("from_master") == b"hello"
+            worker.set("from_worker", b"yo")
+            assert master.get("from_worker") == b"yo"
+            worker.close()
+        finally:
+            master.close()
+
+    def test_barrier_across_processes(self):
+        master = _native.TCPStore("127.0.0.1", 0, is_master=True,
+                                  world_size=2)
+
+        def child(port, q):
+            from paddle_tpu import _native as n
+            st = n.TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+            st.barrier("b", 2)
+            q.put("done")
+            st.close()
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        p = ctx.Process(target=child, args=(master.port, q), daemon=True)
+        p.start()
+        try:
+            master.barrier("b", 2)
+            assert q.get(timeout=30) == "done"
+        finally:
+            p.join(timeout=10)
+            master.close()
+
+    def test_master_close_with_live_client(self):
+        # regression: server_stop must unblock handler threads parked in
+        # recv() on still-open client connections (no join hang)
+        master = _native.TCPStore("127.0.0.1", 0, is_master=True)
+        worker = _native.TCPStore("127.0.0.1", master.port, is_master=False)
+        worker.set("k", b"v")
+        master.close()  # worker's connection still open — must return
+        worker._client and worker._lib.pd_store_client_free(worker._client)
+        worker._client = None
+
+    def test_wait_timeout(self):
+        s = _native.TCPStore("127.0.0.1", 0, is_master=True)
+        try:
+            with pytest.raises(TimeoutError):
+                s.wait("never", timeout_ms=150)
+        finally:
+            s.close()
+
+
+class TestShmChannel:
+    def test_roundtrip_and_order(self):
+        ch = _native.ShmChannel(f"/pd_t_{os.getpid()}_a", 1 << 20,
+                                create=True)
+        try:
+            for i in range(50):
+                ch.push_obj(("msg", i, np.full((100,), i)))
+            for i in range(50):
+                kind, idx, arr = ch.pop_obj()
+                assert kind == "msg" and idx == i
+                np.testing.assert_array_equal(arr, np.full((100,), i))
+        finally:
+            ch.close()
+
+    def test_wraparound(self):
+        # ring smaller than total traffic → exercises wraparound
+        ch = _native.ShmChannel(f"/pd_t_{os.getpid()}_b", 4096, create=True)
+        try:
+            payload = os.urandom(1000)
+            for _ in range(20):
+                ch.push(payload)
+                assert ch.pop() == payload
+        finally:
+            ch.close()
+
+    def test_close_drain(self):
+        ch = _native.ShmChannel(f"/pd_t_{os.getpid()}_c", 1 << 16,
+                                create=True)
+        try:
+            ch.push(b"last")
+            ch.close_write()
+            assert ch.pop() == b"last"
+            assert ch.pop() is None
+        finally:
+            ch.close()
+
+    def test_cross_process(self):
+        name = f"/pd_t_{os.getpid()}_d"
+        ch = _native.ShmChannel(name, 1 << 20, create=True)
+
+        def producer(nm):
+            from paddle_tpu import _native as n
+            c = n.ShmChannel(nm)
+            for i in range(10):
+                c.push_obj(i * i)
+            c.close()
+
+        ctx = mp.get_context("fork")
+        p = ctx.Process(target=producer, args=(name,), daemon=True)
+        p.start()
+        try:
+            got = sorted(ch.pop_obj(timeout_ms=30000) for _ in range(10))
+            assert got == [i * i for i in range(10)]
+        finally:
+            p.join(timeout=10)
+            ch.close()
+
+
+class TestNativeFlagsStats:
+    def test_flag_mirror(self):
+        from paddle_tpu.framework import flags
+        flags.set_flags({"check_nan_inf_level": 2})
+        assert _native.flag_get_num("check_nan_inf_level") == 2
+        flags.set_flags({"check_nan_inf_level": 0})
+        assert _native.flag_get_num("check_nan_inf_level") == 0
+
+    def test_flag_string(self):
+        from paddle_tpu.framework import flags
+        flags.set_flags({"allocator_strategy": "naive_best_fit"})
+        assert _native.flag_get_str("allocator_strategy") == "naive_best_fit"
+        flags.set_flags({"allocator_strategy": "auto_growth"})
+
+    def test_set_flags_beats_env_override(self):
+        # regression: set_flags must win over a FLAGS_* env var in the
+        # native registry (define re-applies env; set must follow)
+        os.environ["FLAGS_check_nan_inf_level"] = "3"
+        try:
+            from paddle_tpu.framework import flags
+            flags.set_flags({"check_nan_inf_level": 1})
+            assert _native.flag_get_num("check_nan_inf_level") == 1
+        finally:
+            del os.environ["FLAGS_check_nan_inf_level"]
+            from paddle_tpu.framework import flags
+            flags.set_flags({"check_nan_inf_level": 0})
+
+    def test_stats(self):
+        pool = "test_pool"
+        base = _native.stats_current(pool)
+        _native.record_alloc(pool, 1000)
+        assert _native.stats_current(pool) == base + 1000
+        assert _native.stats_peak(pool) >= base + 1000
+        _native.record_free(pool, 1000)
+        assert _native.stats_current(pool) == base
+
+
+class TestMultiprocessDataLoader:
+    def _dataset(self):
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.full((4,), i, dtype=np.float32), np.int64(i % 3)
+
+            def __len__(self):
+                return 37
+
+        return DS()
+
+    def test_matches_single_process(self):
+        from paddle_tpu.io import DataLoader
+        ds = self._dataset()
+        ref = list(DataLoader(ds, batch_size=5, num_workers=0))
+        got = list(DataLoader(ds, batch_size=5, num_workers=2,
+                              use_shared_memory=True))
+        assert len(ref) == len(got)
+        for (rx, ry), (gx, gy) in zip(ref, got):
+            np.testing.assert_array_equal(rx.numpy(), gx.numpy())
+            np.testing.assert_array_equal(ry.numpy(), gy.numpy())
+
+    def test_worker_exception_propagates(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Bad(Dataset):
+            def __getitem__(self, i):
+                raise ValueError("boom")
+
+            def __len__(self):
+                return 8
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+    def test_iterable_dataset_workers(self):
+        from paddle_tpu.io import DataLoader, IterableDataset, get_worker_info
+
+        class Stream(IterableDataset):
+            def __iter__(self):
+                info = get_worker_info()
+                wid = info.id if info else 0
+                nw = info.num_workers if info else 1
+                for i in range(wid, 20, nw):
+                    yield np.float32(i)
+
+        vals = []
+        for batch in DataLoader(Stream(), batch_size=4, num_workers=2,
+                                drop_last=False):
+            vals.extend(batch.numpy().tolist())
+        assert sorted(int(v) for v in vals) == list(range(20))

@@ -200,6 +200,10 @@ class DistTrainStep:
                 return jitted(p_vals, b_vals, opt_state, key, lr, arrays)
         return run
 
+    @property
+    def opt_state(self):
+        return self._opt_state
+
     def __call__(self, *batch):
         arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]

@@ -1,0 +1,79 @@
+"""Launcher tests (parity model: test/collective harness — spawn local
+subprocesses with injected rank env and assert behavior via files)."""
+import os
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch.main import parse_args, launch
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+class TestParseArgs:
+    def test_defaults(self):
+        ctx = parse_args(["train.py"])
+        assert ctx.nproc_per_node == 1 and ctx.world_size == 1
+        assert ctx.script == "train.py"
+
+    def test_full(self):
+        ctx = parse_args(["--nnodes", "2", "--node_rank", "1",
+                          "--nproc_per_node", "4",
+                          "--master", "10.0.0.1:8476", "--job_id", "j1",
+                          "train.py", "--lr", "0.1"])
+        assert ctx.world_size == 8 and ctx.node_rank == 1
+        assert ctx.script_args == ["--lr", "0.1"]
+
+    def test_elastic_range(self):
+        ctx = parse_args(["--nnodes", "2:4", "train.py"])
+        assert ctx.nnodes == 2
+
+
+class TestLaunch:
+    def test_rank_env_and_logs(self, tmp_path):
+        script = _write(tmp_path, "w.py", """
+            import os
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            assert os.environ["WORLD_SIZE"] == "4"
+            assert os.environ["PADDLE_LOCAL_RANK"] == rank
+            with open(os.path.join(r"{out}", "rank" + rank), "w") as f:
+                f.write(os.environ["PADDLE_JOB_ID"])
+            print("hello from", rank)
+        """.replace("{out}", str(tmp_path)))
+        ctx = parse_args(["--nproc_per_node", "4", "--job_id", "jtest",
+                          "--log_dir", str(tmp_path / "log"), script])
+        assert launch(ctx) == 0
+        for r in range(4):
+            assert (tmp_path / f"rank{r}").read_text() == "jtest"
+            log = (tmp_path / "log" / f"workerlog.{r}").read_text()
+            assert f"hello from {r}" in log
+
+    def test_failure_propagates_and_restarts(self, tmp_path):
+        marker = tmp_path / "attempts"
+        script = _write(tmp_path, "bad.py", f"""
+            import os, sys
+            with open(r"{marker}", "a") as f:
+                f.write(os.environ["PADDLE_RESTART_EPOCH"] + ",")
+            sys.exit(3)
+        """)
+        ctx = parse_args(["--nproc_per_node", "1", "--max_restart", "2",
+                          "--log_dir", str(tmp_path / "log"), script])
+        rc = launch(ctx)
+        assert rc == 3
+        # initial attempt + 2 restarts, each seeing its restart epoch
+        assert marker.read_text() == "0,1,2,"
+
+    def test_restart_then_success(self, tmp_path):
+        # fails on epoch 0, succeeds on restart — elastic recovery path
+        script = _write(tmp_path, "flaky.py", """
+            import os, sys
+            sys.exit(1 if os.environ["PADDLE_RESTART_EPOCH"] == "0" else 0)
+        """)
+        ctx = parse_args(["--nproc_per_node", "2", "--max_restart", "3",
+                          "--log_dir", str(tmp_path / "log"), script])
+        assert launch(ctx) == 0

@@ -106,3 +106,36 @@ def fused_rms_norm(x, scale, epsilon=1e-6, begin_norm_axis=1):
     from ...kernels.norm import fused_rms_norm as _frn
     return apply(lambda v, s: _frn(v, s, epsilon), _coerce(x), _coerce(scale),
                  _name="rms_norm")
+
+
+def paged_attention(q, key_cache, value_cache, block_tables, context_lens,
+                    scale=None, name=None):
+    """Paged (block) KV-cache decode attention — see
+    kernels/paged_attention.py. Parity: the attention core of paddle.
+    incubate.nn.functional.block_multihead_attention."""
+    from ...kernels.paged_attention import paged_attention as _pa
+    return apply(lambda qv, kc, vc, bt, cl: _pa(qv, kc, vc, bt, cl, scale),
+                 _coerce(q), _coerce(key_cache), _coerce(value_cache),
+                 _coerce(block_tables), _coerce(context_lens),
+                 _name="paged_attention")
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, block_tables,
+                              context_lens, scale=None, num_heads=None,
+                              name=None):
+    """paddle.incubate.nn.functional.block_multihead_attention-shaped
+    entry. `qkv` is either the query [B, H, D], or the packed decode-step
+    [B, 3*H*D] projection (paddle layout) with `num_heads` given — the
+    K/V thirds are assumed already written to the paged cache by the
+    caller. Cache layout [num_pages, page_size, n_kv_heads, D]."""
+    q = _coerce(qkv)
+    if len(q.shape) == 2:
+        if num_heads is None:
+            raise ValueError(
+                "packed [B, 3*H*D] qkv requires num_heads= to slice the "
+                "query block; or pass the query as [B, H, D]")
+        head_dim = q.shape[1] // (3 * num_heads)
+        q = q[:, :num_heads * head_dim].reshape([q.shape[0], num_heads,
+                                                 head_dim])
+    return paged_attention(q, key_cache, value_cache, block_tables,
+                           context_lens, scale=scale)

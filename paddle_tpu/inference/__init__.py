@@ -196,3 +196,78 @@ def create_predictor(config: Config) -> Predictor:
 
 def convert_to_mixed_precision(*args, **kwargs):
     raise NotImplementedError("use Config.enable_xla(precision=...) instead")
+
+
+class LLMPredictor:
+    """Batched autoregressive serving predictor.
+
+    Reference parity: PaddleNLP llm/predict/predictor.py (the serving
+    entry that drives block_multihead_attention inference) — here backed
+    by the jitted static-cache generate loop (paddle_tpu.generation),
+    compiled once per (batch, prompt-bucket, max-new) shape and cached.
+
+    Prompts are python lists of token ids (ragged); the predictor
+    left-pads to a power-of-two bucket so repeated calls hit the XLA
+    compile cache, splits into micro-batches of `max_batch_size`, and
+    strips padding from the returned sequences.
+    """
+
+    def __init__(self, model, max_batch_size=8, pad_token_id=0,
+                 eos_token_id=None, **generate_defaults):
+        self.model = model
+        self.max_batch_size = max_batch_size
+        self.pad_token_id = pad_token_id
+        self.eos_token_id = eos_token_id
+        self.generate_defaults = generate_defaults
+        model.eval()
+
+    @staticmethod
+    def _bucket(n):
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def generate(self, prompts, max_new_tokens=32, **kwargs):
+        """prompts: List[List[int]] → List[List[int]] (new tokens only,
+        eos/pad stripped)."""
+        opts = dict(self.generate_defaults)
+        opts.update(kwargs)
+        results = []
+        for i in range(0, len(prompts), self.max_batch_size):
+            chunk = prompts[i:i + self.max_batch_size]
+            results.extend(self._run_chunk(chunk, max_new_tokens, opts))
+        return results
+
+    def _run_chunk(self, chunk, max_new_tokens, opts):
+        n = len(chunk)
+        bs = self.max_batch_size
+        slen = self._bucket(max(len(p) for p in chunk))
+        ids = np.full((bs, slen), self.pad_token_id, np.int32)
+        mask = np.zeros((bs, slen), np.int32)
+        for r, p in enumerate(chunk):
+            ids[r, slen - len(p):] = p    # left padding
+            mask[r, slen - len(p):] = 1
+        if n < bs:  # fill idle rows with a 1-token dummy prompt
+            ids[n:, -1] = self.pad_token_id
+            mask[n:, -1] = 1
+        call = dict(max_new_tokens=max_new_tokens,
+                    eos_token_id=self.eos_token_id,
+                    pad_token_id=self.pad_token_id)
+        call.update(opts)  # per-call/constructor kwargs win
+        eos = call["eos_token_id"]
+        pad = call["pad_token_id"]
+        out, _ = self.model.generate(ids, attention_mask=mask, **call)
+        out = np.asarray(out.numpy())
+        decoded = []
+        for r in range(n):
+            toks = out[r].tolist()
+            if eos is not None and eos in toks:
+                toks = toks[:toks.index(eos)]
+            else:
+                # only the post-finish tail is padding; a genuine pad-id
+                # token mid-sequence must survive
+                while toks and toks[-1] == pad:
+                    toks.pop()
+            decoded.append(toks)
+        return decoded

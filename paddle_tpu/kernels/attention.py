@@ -21,6 +21,7 @@ from __future__ import annotations
 import functools
 import math as pymath
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -28,7 +29,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..framework.flags import flag_value
 
-_NEG_INF = -1e30
+# Pallas index maps must return a uniform int type: with jax_enable_x64
+# on (Paddle int64 parity), a bare `0` literal traces as i64 next to the
+# i32 grid index and Mosaic fails to legalize `func.return` — use an
+# explicit i32 zero.
+_Z = np.int32(0)
+
+_NEG_INF = np.float32(-1e30)
 
 
 def _use_pallas() -> bool:
@@ -62,7 +69,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         k = k_ref[0]  # (bk, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+            preferred_element_type=jnp.float32) * np.float32(scale)
 
         if causal:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
@@ -96,9 +103,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     @pl.when(j == nj - 1)
     def _finalize():
         l = l_scr[:, 0]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        safe_l = jnp.where(l == np.float32(0.0), np.float32(1.0), l)
         o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(safe_l)).astype(lse_ref.dtype)
+        # lse is materialized with a 128-wide lane dim (TPU tiling needs
+        # the last two block dims ≥ (8, 128)); caller slices lane 0.
+        lse_ref[0] = (m_scr[:] + jnp.log(safe_l)[:, None]
+                      ).astype(lse_ref.dtype)
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128):
@@ -117,17 +127,17 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, _Z)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, _Z)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
+            pl.BlockSpec((1, block_q, 128), lambda h, i, j: (h, i, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max
@@ -135,7 +145,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128):
             pltpu.VMEM((block_q, d), jnp.float32),    # accumulator
         ],
     )(q, k, v)
-    return out, lse
+    return out, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -146,7 +156,7 @@ def _xla_attention(q, k, v, scale, causal, mask=None, dropout_p=0.0,
                    dropout_key=None):
     """q,k,v: [B, S, H, D] (paddle flash layout)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+                   preferred_element_type=jnp.float32) * np.float32(scale)
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
@@ -188,7 +198,7 @@ def _flash_bwd(scale, causal, res, g):
     XLA scheduling; a handwritten Pallas bwd kernel can replace this)."""
     q, k, v, out, lse = res
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+                   preferred_element_type=jnp.float32) * np.float32(scale)
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
@@ -201,7 +211,7 @@ def _flash_bwd(scale, causal, res, g):
                     v.astype(jnp.float32),
                     preferred_element_type=jnp.float32)
     delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (b, sq, h)
-    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * np.float32(scale)
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32),
                     preferred_element_type=jnp.float32)
     dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32),

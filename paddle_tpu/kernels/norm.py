@@ -12,12 +12,19 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..framework.flags import flag_value
+
+# Pallas index maps must return a uniform int type: with jax_enable_x64
+# on (Paddle int64 parity), a bare `0` literal traces as i64 next to the
+# i32 grid index and Mosaic fails to legalize `func.return` — use an
+# explicit i32 zero.
+_Z = np.int32(0)
 
 
 def _use_pallas() -> bool:
@@ -45,10 +52,10 @@ def _rms_pallas(x2d, w, eps, block_rows=256):
         functools.partial(_rms_kernel, eps=eps),
         grid=(pl.cdiv(n, block_rows),),
         in_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, d), lambda i: (i, _Z)),
+            pl.BlockSpec((d,), lambda i: (_Z,)),
         ],
-        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, _Z)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
     )(x2d, w)
 
@@ -114,11 +121,11 @@ def _ln_pallas(x2d, w, b, eps, block_rows=256):
         functools.partial(_ln_kernel, eps=eps),
         grid=(pl.cdiv(n, block_rows),),
         in_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, d), lambda i: (i, _Z)),
+            pl.BlockSpec((d,), lambda i: (_Z,)),
+            pl.BlockSpec((d,), lambda i: (_Z,)),
         ],
-        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, _Z)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
     )(x2d, w, b)
 

@@ -17,13 +17,17 @@ def _rotate_half(x):
 
 
 def apply_rotary_emb(q, k, cos, sin, position_ids=None, use_neox=True):
-    """q,k: [B, S, H, D]; cos/sin: [S, D] or [1, S, 1, D].
+    """q,k: [B, S, H, D]; cos/sin: [S, D], [B, S, D] (pre-gathered per
+    batch row, e.g. left-padded generation) or [1, S, 1, D].
 
     Returns rotated (q, k) with f32 trig applied in the activation dtype.
     """
     if cos.ndim == 2:
         cos = cos[None, :, None, :]
         sin = sin[None, :, None, :]
+    elif cos.ndim == 3:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
     if position_ids is not None:
         cos = jnp.take(cos[0, :, 0], position_ids, axis=0)[:, :, None, :]
         sin = jnp.take(sin[0, :, 0], position_ids, axis=0)[:, :, None, :]

@@ -11,6 +11,7 @@ from ..nn import functional as F
 from ..nn.initializer import Normal
 from ..ops import manipulation as M
 from ..ops import creation as C
+from ..generation import GenerationMixin
 from ..distributed.fleet.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     parallel_matmul)
@@ -110,7 +111,7 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(Layer, GenerationMixin):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.gpt = GPTModel(config)

@@ -38,6 +38,8 @@ from ..distributed.fleet.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     parallel_matmul, mark_partition)
 from ..distributed.fleet.recompute import recompute
+from ..generation import GenerationMixin
+from ..generation.kv_cache import StaticCacheEntry, StaticKVCache
 
 
 @dataclass
@@ -131,10 +133,26 @@ class LlamaAttention(Layer):
             return apply_rotary_emb(qv, kv, cv, sv)
         q, k = apply(rope_fn, q, k, cos, sin, _name="fused_rope")
 
-        if past_key_value is not None:
+        if isinstance(past_key_value, StaticCacheEntry):
+            # static-shape decode cache: write K/V in place at `pos`
+            # (one XLA program per step — see generation/kv_cache.py)
+            def upd(cache, new, p):
+                import jax
+                z = jnp.int32(0)
+                return jax.lax.dynamic_update_slice(
+                    cache, new.astype(cache.dtype),
+                    (z, p.astype(jnp.int32), z, z))
+            k = apply(upd, past_key_value.k, k, past_key_value.pos,
+                      _name="kv_cache_update")
+            v = apply(upd, past_key_value.v, v, past_key_value.pos,
+                      _name="kv_cache_update")
+            new_cache = StaticCacheEntry(k, v, past_key_value.pos)
+        elif past_key_value is not None:
             k = M.concat([past_key_value[0], k], axis=1)
             v = M.concat([past_key_value[1], v], axis=1)
-        new_cache = (k, v)
+            new_cache = (k, v)
+        else:
+            new_cache = (k, v)
 
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
@@ -231,11 +249,20 @@ class LlamaModel(Layer):
                 past_key_values=None, use_cache=False):
         h = self.embed_tokens(input_ids)
         s = input_ids.shape[1]
-        past_len = 0
-        if past_key_values is not None and past_key_values[0] is not None:
-            past_len = past_key_values[0][0].shape[1]
-        cos = self.rope_cos[past_len:past_len + s]
-        sin = self.rope_sin[past_len:past_len + s]
+        static_cache = isinstance(past_key_values, StaticKVCache)
+        if position_ids is not None:
+            # per-row positions (left-padded generation): gather trig rows
+            cos = apply(lambda c, p: jnp.take(c, p, axis=0),
+                        self.rope_cos, position_ids, _name="rope_gather")
+            sin = apply(lambda c, p: jnp.take(c, p, axis=0),
+                        self.rope_sin, position_ids, _name="rope_gather")
+        else:
+            past_len = 0
+            if (not static_cache and past_key_values is not None
+                    and past_key_values[0] is not None):
+                past_len = past_key_values[0][0].shape[1]
+            cos = self.rope_cos[past_len:past_len + s]
+            sin = self.rope_sin[past_len:past_len + s]
         caches = []
         for i, layer in enumerate(self.layers):
             pkv = past_key_values[i] if past_key_values is not None else None
@@ -251,7 +278,9 @@ class LlamaModel(Layer):
         return h
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(Layer, GenerationMixin):
+    supports_static_cache = True
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config

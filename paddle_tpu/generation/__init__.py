@@ -108,8 +108,12 @@ class GenerationMixin:
                 attention_mask.numpy()
                 if isinstance(attention_mask, Tensor) else attention_mask
             ).astype(np.int32)
-        seed = cfg.seed if cfg.seed is not None else 0
-        key = jax.random.key(seed)
+        if cfg.seed is not None:
+            key = jax.random.key(cfg.seed)
+        else:
+            # fresh randomness from the global generator (paddle.seed)
+            from ..framework.random import next_key
+            key = next_key()
 
         if cfg.use_cache and self.supports_static_cache:
             # decoder-only layout: padding goes on the LEFT so every
@@ -282,8 +286,9 @@ class GenerationMixin:
             outs, scores = [], []
             for b in range(ids.shape[0]):
                 row = ids[b][mask[b].astype(bool)][None, :]
+                key, sub = jax.random.split(key)
                 o, s = self._generate_eager(
-                    row, np.ones_like(row, dtype=np.int32), key, cfg)
+                    row, np.ones_like(row, dtype=np.int32), sub, cfg)
                 outs.append(o[0])
                 scores.append(s[0])
             return np.stack(outs), np.asarray(scores, np.float32)

@@ -263,11 +263,9 @@ class LLMPredictor:
         for r in range(n):
             toks = out[r].tolist()
             if eos is not None and eos in toks:
+                # cutting at eos also removes the artificial pad tail the
+                # finished-row mask emits; rows that never finished (or
+                # eos=None) contain only real tokens — return them intact
                 toks = toks[:toks.index(eos)]
-            else:
-                # only the post-finish tail is padding; a genuine pad-id
-                # token mid-sequence must survive
-                while toks and toks[-1] == pad:
-                    toks.pop()
             decoded.append(toks)
         return decoded

@@ -1,0 +1,25 @@
+"""Shared helpers for the Pallas kernel modules."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..framework.flags import flag_value
+
+# Pallas index maps must return a uniform int type: with jax_enable_x64
+# on (Paddle int64 parity), a bare `0` literal traces as i64 next to the
+# i32 grid index and Mosaic fails to legalize `func.return` — use an
+# explicit i32 zero.
+_Z = np.int32(0)
+
+_NEG_INF = np.float32(-1e30)
+
+
+def use_pallas() -> bool:
+    """Gate: FLAGS_use_pallas_kernels on AND a non-CPU backend."""
+    if not flag_value("use_pallas_kernels"):
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False

@@ -27,24 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..framework.flags import flag_value
-
-# Pallas index maps must return a uniform int type: with jax_enable_x64
-# on (Paddle int64 parity), a bare `0` literal traces as i64 next to the
-# i32 grid index and Mosaic fails to legalize `func.return` — use an
-# explicit i32 zero.
-_Z = np.int32(0)
-
-_NEG_INF = np.float32(-1e30)
-
-
-def _use_pallas() -> bool:
-    if not flag_value("use_pallas_kernels"):
-        return False
-    try:
-        return jax.default_backend() not in ("cpu",)
-    except Exception:
-        return False
+from ._common import _Z, _NEG_INF, use_pallas as _use_pallas
 
 
 # ---------------------------------------------------------------------------

@@ -26,19 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..framework.flags import flag_value
-
-_Z = np.int32(0)
-_NEG_INF = np.float32(-1e30)
-
-
-def _use_pallas() -> bool:
-    if not flag_value("use_pallas_kernels"):
-        return False
-    try:
-        return jax.default_backend() not in ("cpu",)
-    except Exception:
-        return False
+from ._common import _Z, _NEG_INF, use_pallas as _use_pallas
 
 
 # ---------------------------------------------------------------------------

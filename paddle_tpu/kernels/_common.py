@@ -23,3 +23,13 @@ def use_pallas() -> bool:
         return jax.default_backend() not in ("cpu",)
     except Exception:
         return False
+
+
+def pallas_dtype_ok(*arrays) -> bool:
+    """Mosaic lowers f32/bf16/f16 (and int) — never f64, which leaks in
+    easily with jax_enable_x64 on. Gate kernels back to XLA for those."""
+    import jax.numpy as jnp
+    for a in arrays:
+        if a.dtype in (jnp.float64,):
+            return False
+    return True

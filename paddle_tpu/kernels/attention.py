@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import _Z, _NEG_INF, use_pallas as _use_pallas
+from ._common import _Z, _NEG_INF, use_pallas as _use_pallas, pallas_dtype_ok
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +211,8 @@ def flash_attention_jax(query, key, value, *, causal=False, scale=None,
     """Pure-jax entry ([B,S,H,D] arrays). Chooses Pallas vs XLA."""
     d = query.shape[-1]
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
-    plausible = (_use_pallas() and mask is None and dropout_p == 0.0
+    plausible = (_use_pallas() and pallas_dtype_ok(query, key, value)
+                 and mask is None and dropout_p == 0.0
                  and query.shape[1] >= 8 and d % 128 == 0)
     if plausible:
         return _flash_core(query, key, value, sc, causal)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import _Z, _NEG_INF, use_pallas as _use_pallas
+from ._common import _Z, _NEG_INF, use_pallas as _use_pallas, pallas_dtype_ok
 
 
 # ------------------------------------------------------------- rms norm ----
@@ -54,7 +54,7 @@ def _rms_fwd(x, w, eps):
     shape = x.shape
     d = shape[-1]
     x2 = x.reshape(-1, d)
-    if _use_pallas() and d % 128 == 0:
+    if _use_pallas() and d % 128 == 0 and pallas_dtype_ok(x2, w):
         out2 = _rms_pallas(x2, w, eps)
     else:
         xf = x2.astype(jnp.float32)
@@ -124,7 +124,7 @@ def _ln_fwd(x, w, b, eps):
     shape = x.shape
     d = shape[-1]
     x2 = x.reshape(-1, d)
-    if _use_pallas() and d % 128 == 0:
+    if _use_pallas() and d % 128 == 0 and pallas_dtype_ok(x2, w):
         out2 = _ln_pallas(x2, w, b, eps)
     else:
         xf = x2.astype(jnp.float32)

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import _Z, _NEG_INF, use_pallas as _use_pallas
+from ._common import _Z, _NEG_INF, use_pallas as _use_pallas, pallas_dtype_ok
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +160,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     d = q.shape[-1]
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
     use_kernel = ((interpret or _use_pallas()) and h == hkv
+                  and pallas_dtype_ok(q, k_pages, v_pages)
                   and d % 128 == 0 and h % 8 == 0)
     if use_kernel:
         return _paged_attention_pallas(q, k_pages, v_pages, block_tables,

@@ -68,6 +68,12 @@ from .hapi import callbacks
 from . import distributed
 from .distributed.parallel import DataParallel
 
+from . import fft
+from . import signal
+from . import sparse
+from . import generation
+from . import diffusion
+
 
 def is_grad_enabled_():
     return is_grad_enabled()

@@ -138,8 +138,9 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128):
 def _xla_attention(q, k, v, scale, causal, mask=None, dropout_p=0.0,
                    dropout_key=None):
     """q,k,v: [B, S, H, D] (paddle flash layout)."""
+    cdt = jnp.promote_types(q.dtype, jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * np.float32(scale)
+                   preferred_element_type=cdt) * jnp.asarray(scale, cdt)
     if causal:
         qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
@@ -154,7 +155,7 @@ def _xla_attention(q, k, v, scale, causal, mask=None, dropout_p=0.0,
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+                      preferred_element_type=cdt).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -57,9 +57,11 @@ def _rms_fwd(x, w, eps):
     if _use_pallas() and d % 128 == 0 and pallas_dtype_ok(x2, w):
         out2 = _rms_pallas(x2, w, eps)
     else:
-        xf = x2.astype(jnp.float32)
+        # f64 inputs keep f64 statistics (the x64 user asked for it)
+        cdt = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x2.astype(cdt)
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-        out2 = (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
+        out2 = (xf * jax.lax.rsqrt(var + eps) * w.astype(cdt)
                 ).astype(x.dtype)
     return out2.reshape(shape), (x, w)
 
@@ -68,9 +70,10 @@ def _rms_bwd(eps, res, g):
     x, w = res
     shape = x.shape
     d = shape[-1]
-    xf = x.reshape(-1, d).astype(jnp.float32)
-    gf = g.reshape(-1, d).astype(jnp.float32)
-    wf = w.astype(jnp.float32)
+    cdt = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.reshape(-1, d).astype(cdt)
+    gf = g.reshape(-1, d).astype(cdt)
+    wf = w.astype(cdt)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     inv = jax.lax.rsqrt(var + eps)
     xhat = xf * inv
@@ -127,12 +130,13 @@ def _ln_fwd(x, w, b, eps):
     if _use_pallas() and d % 128 == 0 and pallas_dtype_ok(x2, w):
         out2 = _ln_pallas(x2, w, b, eps)
     else:
-        xf = x2.astype(jnp.float32)
+        cdt = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x2.astype(cdt)
         mu = jnp.mean(xf, axis=-1, keepdims=True)
         xc = xf - mu
         var = jnp.mean(xc * xc, axis=-1, keepdims=True)
-        out2 = (xc * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
-                + b.astype(jnp.float32)).astype(x.dtype)
+        out2 = (xc * jax.lax.rsqrt(var + eps) * w.astype(cdt)
+                + b.astype(cdt)).astype(x.dtype)
     return out2.reshape(shape), (x, w, b)
 
 
@@ -140,9 +144,10 @@ def _ln_bwd(eps, res, g):
     x, w, b = res
     shape = x.shape
     d = shape[-1]
-    xf = x.reshape(-1, d).astype(jnp.float32)
-    gf = g.reshape(-1, d).astype(jnp.float32)
-    wf = w.astype(jnp.float32)
+    cdt = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.reshape(-1, d).astype(cdt)
+    gf = g.reshape(-1, d).astype(cdt)
+    wf = w.astype(cdt)
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     xc = xf - mu
     var = jnp.mean(xc * xc, axis=-1, keepdims=True)

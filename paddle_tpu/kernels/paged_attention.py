@@ -159,9 +159,9 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     hkv = k_pages.shape[2]
     d = q.shape[-1]
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
-    use_kernel = ((interpret or _use_pallas()) and h == hkv
-                  and pallas_dtype_ok(q, k_pages, v_pages)
-                  and d % 128 == 0 and h % 8 == 0)
+    use_kernel = ((interpret or (_use_pallas()
+                                 and pallas_dtype_ok(q, k_pages, v_pages)))
+                  and h == hkv and d % 128 == 0 and h % 8 == 0)
     if use_kernel:
         return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
                                        context_lens, sc, interpret=interpret)

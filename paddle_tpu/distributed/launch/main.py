@@ -184,6 +184,26 @@ class ElasticManager:
             self.store.add(
                 f"elastic/{self.ctx.job_id}/hb{self.ctx.node_rank}", 1)
 
+    # -- pod-wide restart coordination ----------------------------------
+    # A failed node raises a per-epoch restart flag; healthy nodes poll
+    # it and tear down their (still running) pods so every node advances
+    # to epoch+1 and re-enters the barrier together. Without this
+    # broadcast, only the failed node would loop and the barrier would
+    # hang. The flag is an add()-based counter keyed BY epoch, so
+    # concurrent failures in the same epoch are idempotent (any value
+    # > 0 means "everyone moves to epoch+1") — no read-modify-write race.
+    def _req_key(self, epoch: int):
+        return f"elastic/{self.ctx.job_id}/restart_req/{epoch}"
+
+    def restart_requested(self, epoch: int) -> bool:
+        if not self.store:
+            return False
+        return self.store.add(self._req_key(epoch), 0) > 0
+
+    def request_restart(self, epoch: int):
+        if self.store:
+            self.store.add(self._req_key(epoch), 1)
+
     def close(self):
         if self.store:
             self.store.close()
@@ -193,29 +213,47 @@ def launch(ctx: Context) -> int:
     """Run the pod until success, failure, or restart budget exhausted."""
     elastic = ElasticManager(ctx)
     rc = 1
+    epoch = 0
+    restarts = 0
     try:
-        for epoch in range(ctx.max_restart + 1):
+        while True:
             elastic.register(epoch)
             pod = PodController(ctx)
             pod.start(restart_epoch=epoch)
+            peer_restart = False
             try:
                 while True:
                     rc = pod.poll()
                     if rc is not None:
+                        break
+                    if elastic.restart_requested(epoch):
+                        peer_restart = True
                         break
                     elastic.heartbeat()
                     time.sleep(0.2)
             except KeyboardInterrupt:
                 pod.stop(signal.SIGINT)
                 return 130
-            if rc == 0:
-                return 0
-            print(f"[launch] pod failed (exit {rc}), "
-                  f"restart {epoch + 1}/{ctx.max_restart}", file=sys.stderr)
-            pod.tail_logs()
+            if not peer_restart and rc == 0:
+                # success is only final if no peer failed concurrently —
+                # otherwise join the restart so the peers' epoch barrier
+                # (and, on node 0, the store we host) stays alive
+                if not elastic.restart_requested(epoch):
+                    return 0
+                peer_restart = True
+            restarts += 1  # counted identically on every node
+            if peer_restart:
+                print("[launch] peer pod failed, joining pod-wide restart "
+                      f"{restarts}/{ctx.max_restart}", file=sys.stderr)
+            else:
+                print(f"[launch] pod failed (exit {rc}), restart "
+                      f"{restarts}/{ctx.max_restart}", file=sys.stderr)
+                pod.tail_logs()
+                elastic.request_restart(epoch)
             pod.stop()
-            if epoch == ctx.max_restart:
+            if restarts > ctx.max_restart:
                 break
+            epoch += 1
         return rc if rc is not None else 1
     finally:
         elastic.close()

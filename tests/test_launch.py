@@ -77,3 +77,45 @@ class TestLaunch:
         ctx = parse_args(["--nproc_per_node", "2", "--max_restart", "3",
                           "--log_dir", str(tmp_path / "log"), script])
         assert launch(ctx) == 0
+
+
+class TestElasticCoordination:
+    def test_peer_restart_broadcast(self):
+        """A failed node's restart request must be visible to healthy
+        nodes polling the shared epoch counter (deadlock regression)."""
+        from paddle_tpu._native import TCPStore, available
+        from paddle_tpu.distributed.launch.main import ElasticManager, Context
+        if not available():
+            pytest.skip("native runtime not built")
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        def ctx(rank):
+            c = Context.__new__(Context)
+            c.nnodes = 2
+            c.node_rank = rank
+            c.master = f"127.0.0.1:{port - 2}"
+            c.job_id = "elastic-test"
+            return c
+
+        m0 = ElasticManager.__new__(ElasticManager)
+        m0.ctx = ctx(0)
+        m0.store = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+        m1 = ElasticManager.__new__(ElasticManager)
+        m1.ctx = ctx(1)
+        m1.store = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+        try:
+            assert not m0.restart_requested(0)
+            m1.request_restart(0)            # node 1's pod failed at epoch 0
+            assert m0.restart_requested(0)   # node 0 sees the broadcast
+            # concurrent failure in the same epoch is idempotent
+            m0.request_restart(0)
+            assert m1.restart_requested(0)
+            # the next epoch starts clean
+            assert not m0.restart_requested(1)
+        finally:
+            m1.store.close()
+            m0.store.close()

@@ -1,0 +1,75 @@
+"""Vision model zoo tests (shape + grad smoke per paddle.vision parity).
+
+Small inputs / scaled-down widths where the architecture allows, to keep
+CPU compile times bounded.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.models as vm
+from paddle_tpu.tensor import Tensor
+
+
+def _x(n=1, c=3, hw=64, seed=0):
+    return Tensor(jnp.asarray(
+        np.random.RandomState(seed).randn(n, c, hw, hw), jnp.float32))
+
+
+class TestVisionZoo:
+    def test_alexnet(self):
+        paddle.seed(0)
+        out = vm.alexnet(num_classes=10)(_x(hw=224))
+        assert out.shape == [1, 10]
+
+    def test_squeezenet(self):
+        paddle.seed(0)
+        out = vm.squeezenet1_1(num_classes=10)(_x(hw=96))
+        assert out.shape == [1, 10]
+
+    def test_densenet121(self):
+        paddle.seed(0)
+        out = vm.densenet121(num_classes=10)(_x(hw=64))
+        assert out.shape == [1, 10]
+
+    def test_mobilenet_v1(self):
+        paddle.seed(0)
+        out = vm.mobilenet_v1(scale=0.25, num_classes=10)(_x(hw=64))
+        assert out.shape == [1, 10]
+
+    def test_mobilenet_v3(self):
+        paddle.seed(0)
+        out = vm.mobilenet_v3_small(scale=0.5, num_classes=10)(_x(hw=64))
+        assert out.shape == [1, 10]
+
+    def test_shufflenet(self):
+        paddle.seed(0)
+        out = vm.shufflenet_v2_x0_25(num_classes=10)(_x(hw=64))
+        assert out.shape == [1, 10]
+
+    def test_googlenet_aux_heads(self):
+        paddle.seed(0)
+        out, aux1, aux2 = vm.googlenet(num_classes=10)(_x(hw=224))
+        assert out.shape == [1, 10]
+        assert aux1.shape == [1, 10] and aux2.shape == [1, 10]
+
+    def test_inception_v3(self):
+        paddle.seed(0)
+        out = vm.inception_v3(num_classes=10)(_x(hw=299))
+        assert out.shape == [1, 10]
+
+    def test_train_step_mobilenet(self):
+        """One fwd/bwd/step must run and all params get grads."""
+        paddle.seed(0)
+        m = vm.mobilenet_v1(scale=0.25, num_classes=4)
+        m.train()
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        import paddle_tpu.nn.functional as F
+        logits = m(_x(n=2, hw=32))
+        label = paddle.to_tensor(np.array([0, 1]))
+        loss = F.cross_entropy(logits, label)
+        loss.backward()
+        missing = [n for n, p in m.named_parameters() if p.grad is None]
+        assert not missing, missing[:5]
+        opt.step()

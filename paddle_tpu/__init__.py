@@ -71,6 +71,7 @@ from .distributed.parallel import DataParallel
 from . import fft
 from . import signal
 from . import sparse
+from . import distribution
 from . import generation
 from . import diffusion
 

@@ -1,0 +1,124 @@
+"""paddle.distribution tests — log_prob/entropy against scipy-style
+closed forms, sampling moments, KL identities."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Normal, Uniform, Bernoulli,
+                                     Categorical, Exponential, Laplace,
+                                     LogNormal, Gumbel, Poisson,
+                                     kl_divergence)
+
+
+def setup_module(m):
+    paddle.seed(0)
+
+
+class TestNormal:
+    def test_log_prob_closed_form(self):
+        d = Normal(1.0, 2.0)
+        v = paddle.to_tensor(np.array([0.0, 1.0, 3.0], np.float32))
+        got = np.asarray(d.log_prob(v).numpy())
+        x = np.array([0.0, 1.0, 3.0])
+        ref = -((x - 1) ** 2) / 8 - np.log(2) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_sample_moments(self):
+        d = Normal(3.0, 0.5)
+        s = np.asarray(d.sample((20000,)).numpy())
+        assert abs(s.mean() - 3.0) < 0.05
+        assert abs(s.std() - 0.5) < 0.05
+
+    def test_entropy_and_kl_self_zero(self):
+        d = Normal(0.0, 1.0)
+        ent = float(d.entropy().numpy())
+        np.testing.assert_allclose(ent, 0.5 * np.log(2 * np.pi) + 0.5,
+                                   atol=1e-5)
+        assert abs(float(kl_divergence(d, Normal(0.0, 1.0)).numpy())) < 1e-6
+
+    def test_kl_closed_form(self):
+        p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+        got = float(kl_divergence(p, q).numpy())
+        ref = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_rsample_differentiable(self):
+        loc = paddle.to_tensor(np.float32(0.5))
+        loc.stop_gradient = False
+        d = Normal(loc, 1.0)
+        s = d.rsample((8,))
+        s.sum().backward()
+        assert loc.grad is not None
+
+    def test_cdf(self):
+        d = Normal(0.0, 1.0)
+        got = float(d.cdf(paddle.to_tensor(np.float32(0.0))).numpy())
+        np.testing.assert_allclose(got, 0.5, atol=1e-6)
+
+
+class TestUniform:
+    def test_log_prob_support(self):
+        d = Uniform(0.0, 4.0)
+        v = paddle.to_tensor(np.array([2.0, 5.0], np.float32))
+        lp = np.asarray(d.log_prob(v).numpy())
+        np.testing.assert_allclose(lp[0], -np.log(4.0), atol=1e-6)
+        assert np.isneginf(lp[1])
+
+    def test_sample_range(self):
+        s = np.asarray(Uniform(-1.0, 1.0).sample((1000,)).numpy())
+        assert s.min() >= -1.0 and s.max() < 1.0
+
+
+class TestDiscrete:
+    def test_bernoulli(self):
+        d = Bernoulli(probs=0.7)
+        lp1 = float(d.log_prob(paddle.to_tensor(np.float32(1.0))).numpy())
+        np.testing.assert_allclose(lp1, np.log(0.7), atol=1e-5)
+        s = np.asarray(d.sample((5000,)).numpy())
+        assert abs(s.mean() - 0.7) < 0.03
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = Categorical(logits=logits)
+        lp = float(d.log_prob(paddle.to_tensor(np.int64(2))).numpy())
+        np.testing.assert_allclose(lp, np.log(0.5), atol=1e-5)
+        ent = float(d.entropy().numpy())
+        ref = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        np.testing.assert_allclose(ent, ref, atol=1e-5)
+        s = np.asarray(d.sample((8000,)).numpy())
+        assert abs((s == 2).mean() - 0.5) < 0.03
+
+    def test_kl_categorical(self):
+        p = Categorical(probs=np.array([0.5, 0.5], np.float32))
+        q = Categorical(probs=np.array([0.9, 0.1], np.float32))
+        got = float(kl_divergence(p, q).numpy())
+        ref = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_poisson_log_prob(self):
+        d = Poisson(3.0)
+        lp = float(d.log_prob(paddle.to_tensor(np.float32(2.0))).numpy())
+        ref = 2 * np.log(3.0) - 3.0 - np.log(2.0)
+        np.testing.assert_allclose(lp, ref, atol=1e-5)
+
+
+class TestContinuousFamilies:
+    def test_exponential(self):
+        d = Exponential(2.0)
+        lp = float(d.log_prob(paddle.to_tensor(np.float32(1.0))).numpy())
+        np.testing.assert_allclose(lp, np.log(2.0) - 2.0, atol=1e-5)
+        s = np.asarray(d.sample((20000,)).numpy())
+        assert abs(s.mean() - 0.5) < 0.02
+
+    def test_laplace(self):
+        d = Laplace(0.0, 1.0)
+        lp = float(d.log_prob(paddle.to_tensor(np.float32(1.0))).numpy())
+        np.testing.assert_allclose(lp, -1.0 - np.log(2.0), atol=1e-5)
+
+    def test_lognormal_sample_positive(self):
+        s = np.asarray(LogNormal(0.0, 0.5).sample((500,)).numpy())
+        assert (s > 0).all()
+
+    def test_gumbel_moments(self):
+        s = np.asarray(Gumbel(0.0, 1.0).sample((40000,)).numpy())
+        assert abs(s.mean() - 0.5772) < 0.03

@@ -72,6 +72,10 @@ from . import fft
 from . import signal
 from . import sparse
 from . import distribution
+from . import audio
+from . import utils
+from . import version
+from . import onnx
 from . import generation
 from . import diffusion
 

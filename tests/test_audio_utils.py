@@ -1,0 +1,93 @@
+"""paddle.audio / paddle.utils / version / onnx surface tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+        for hz in (100.0, 440.0, 4000.0):
+            for htk in (False, True):
+                m = hz_to_mel(hz, htk)
+                back = mel_to_hz(m, htk)
+                np.testing.assert_allclose(back, hz, rtol=1e-4)
+
+    def test_fbank_shape_and_rows_nonneg(self):
+        from paddle_tpu.audio.functional import compute_fbank_matrix
+        fb = np.asarray(compute_fbank_matrix(16000, 512, n_mels=40).numpy())
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum() > 0
+
+    def test_dct_orthonormal(self):
+        from paddle_tpu.audio.functional import create_dct
+        d = np.asarray(create_dct(13, 40).numpy())
+        assert d.shape == (40, 13)
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-4)
+
+    def test_window(self):
+        from paddle_tpu.audio.functional import get_window
+        w = np.asarray(get_window("hann", 16).numpy())
+        np.testing.assert_allclose(w, np.hanning(17)[:-1], atol=1e-6)
+
+
+class TestAudioFeatures:
+    def test_mel_spectrogram_shapes(self):
+        from paddle_tpu.audio import (Spectrogram, MelSpectrogram,
+                                      LogMelSpectrogram, MFCC)
+        sig = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 2048).astype(np.float32))
+        spec = Spectrogram(n_fft=256, hop_length=128)(sig)
+        assert spec.shape[1] == 129
+        mel = MelSpectrogram(sr=16000, n_fft=256, hop_length=128,
+                             n_mels=40)(sig)
+        assert mel.shape[1] == 40
+        logmel = LogMelSpectrogram(sr=16000, n_fft=256, hop_length=128,
+                                   n_mels=40)(sig)
+        assert logmel.shape == mel.shape
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, hop_length=128,
+                    n_mels=40)(sig)
+        assert mfcc.shape[1] == 13
+
+
+class TestUtils:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b and a.startswith("fc_")
+        with unique_name.guard():
+            c = unique_name.generate("fc")
+        assert c == "fc_0"
+
+    def test_deprecated_warns(self):
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(update_to="new_fn", since="2.0")
+        def old_fn():
+            return 42
+        with pytest.warns(DeprecationWarning):
+            assert old_fn() == 42
+
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils.dlpack import to_dlpack, from_dlpack
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        y = from_dlpack(to_dlpack(x))
+        np.testing.assert_array_equal(np.asarray(y.numpy()),
+                                      np.asarray(x.numpy()))
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "works well" in capsys.readouterr().out
+
+
+class TestVersionOnnx:
+    def test_version(self):
+        assert paddle.version.full_version
+        assert paddle.version.cuda() == "False"
+
+    def test_onnx_export_raises_with_guidance(self):
+        with pytest.raises(NotImplementedError, match="jit.save"):
+            paddle.onnx.export(None, "model.onnx")

@@ -15,6 +15,10 @@ import time
 import numpy as np
 
 
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
 def main():
     import jax
     import paddle_tpu as paddle
@@ -32,11 +36,12 @@ def main():
                           num_attention_heads=16, num_key_value_heads=16,
                           max_position_embeddings=2048,
                           tensor_parallel=False)
-        batch, seq, iters, warmup = 8, 1024, 20, 3
+        batch, seq, iters, warmup = 8, 1024, 10, 2
     else:  # smoke mode for CPU dev runs
         cfg = LlamaConfig.tiny(tensor_parallel=False)
         batch, seq, iters, warmup = 2, 64, 3, 1
 
+    _log(f"backend={jax.default_backend()} building model")
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     if on_tpu:
@@ -49,9 +54,12 @@ def main():
     ids = paddle.to_tensor(
         np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)))
 
+    _log("warmup/compile start")
+    t_c = time.perf_counter()
     for _ in range(warmup):
         loss = step(ids, ids)
     float(loss)  # sync
+    _log(f"warmup done in {time.perf_counter() - t_c:.1f}s")
 
     t0 = time.perf_counter()
     for _ in range(iters):

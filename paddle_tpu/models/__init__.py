@@ -5,3 +5,5 @@ reference core repo)."""
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion
 from .bert import BertConfig, BertModel, BertForSequenceClassification
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM
+from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+                    ErnieForTokenClassification, ErnieForQuestionAnswering)

@@ -2,4 +2,5 @@
 from .optimizer import (
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, Adamax, RMSProp, Lamb,
 )
+from .lbfgs import LBFGS
 from . import lr

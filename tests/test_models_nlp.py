@@ -1,0 +1,77 @@
+"""NLP model family tests (ERNIE — driver config #2)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.models import (ErnieConfig, ErnieModel,
+                               ErnieForSequenceClassification,
+                               ErnieForTokenClassification,
+                               ErnieForQuestionAnswering)
+
+
+def _ids(b=2, s=10, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randint(0, 256, (b, s)))
+
+
+class TestErnie:
+    def test_backbone_shapes(self):
+        paddle.seed(0)
+        m = ErnieModel(ErnieConfig.tiny())
+        h, pooled = m(_ids())
+        assert h.shape == [2, 10, 64] and pooled.shape == [2, 64]
+
+    def test_task_type_embedding_changes_output(self):
+        paddle.seed(0)
+        m = ErnieModel(ErnieConfig.tiny())
+        m.eval()
+        ids = _ids()
+        t0 = paddle.to_tensor(np.zeros((10,), np.int64))
+        t1 = paddle.to_tensor(np.ones((10,), np.int64))
+        h0, _ = m(ids, task_type_ids=t0)
+        h1, _ = m(ids, task_type_ids=t1)
+        assert not np.allclose(np.asarray(h0.numpy()),
+                               np.asarray(h1.numpy()))
+
+    def test_seq_cls_finetune_step(self):
+        paddle.seed(0)
+        m = ErnieForSequenceClassification(ErnieConfig.tiny(), num_classes=3)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        ids = _ids()
+        label = paddle.to_tensor(np.array([0, 2]))
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(m(ids), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_token_cls_and_qa_heads(self):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        tok = ErnieForTokenClassification(cfg, num_classes=5)
+        assert tok(_ids()).shape == [2, 10, 5]
+        qa = ErnieForQuestionAnswering(cfg)
+        start, end = qa(_ids())
+        assert start.shape == [2, 10] and end.shape == [2, 10]
+
+    def test_attention_mask_excludes_pads(self):
+        paddle.seed(0)
+        m = ErnieModel(ErnieConfig.tiny())
+        m.eval()
+        ids = _ids(b=1, s=8)
+        full = np.ones((1, 8), np.int64)
+        mask = full.copy()
+        mask[0, 6:] = 0
+        h_masked, _ = m(ids, attention_mask=paddle.to_tensor(mask))
+        # changing the content of masked positions must not affect
+        # unmasked outputs
+        ids2 = np.asarray(ids.numpy()).copy()
+        ids2[0, 6:] = 1
+        h_masked2, _ = m(paddle.to_tensor(ids2),
+                         attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(
+            np.asarray(h_masked.numpy())[0, :6],
+            np.asarray(h_masked2.numpy())[0, :6], atol=1e-5)

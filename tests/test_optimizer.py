@@ -285,3 +285,51 @@ class TestMetric:
         correct = acc.compute(pred, label)
         acc.update(correct)
         assert abs(acc.accumulate() - 0.5) < 1e-6
+
+
+class TestLBFGS:
+    def test_quadratic_convergence(self):
+        """LBFGS should crush a convex quadratic in a few steps."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        target = np.array([1.5, -2.0, 0.5], np.float32)
+        w = paddle.to_tensor(np.zeros(3, np.float32))
+        w.stop_gradient = False
+        w = paddle.Parameter(w._value) if hasattr(paddle, "Parameter") else w
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(np.zeros(3, np.float32))
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=10,
+                                     parameters=[p])
+
+        def closure():
+            diff = p - paddle.to_tensor(target)
+            loss = (diff * diff).sum()
+            loss.backward()
+            return loss
+
+        loss = opt.step(closure)
+        np.testing.assert_allclose(np.asarray(p.numpy()), target, atol=1e-3)
+        assert float(loss) < 1e-5
+
+    def test_rosenbrock_with_line_search(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.tensor import Parameter
+        paddle.seed(0)
+        p = Parameter(np.array([-1.0, 1.0], np.float32))
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=60,
+                                     history_size=10,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=[p])
+
+        def closure():
+            x, y = p[0], p[1]
+            loss = (1 - x) ** 2 + 100 * (y - x * x) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            loss = opt.step(closure)
+        assert float(loss) < 1e-3, float(loss)

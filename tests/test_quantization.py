@@ -1,0 +1,95 @@
+"""paddle.quantization tests — QAT/PTQ roundtrip + STE gradient."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.quantization import (QuantConfig, QAT, PTQ, fake_quant,
+                                     FakeQuanterWithAbsMax, QuantedLinear)
+
+
+class TestFakeQuant:
+    def test_values_on_grid(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+        q = np.asarray(fake_quant(x, scale=1.0, bit_length=8).numpy())
+        grid = 1.0 / 127.0
+        np.testing.assert_allclose(q / grid, np.round(q / grid), atol=1e-5)
+        np.testing.assert_allclose(
+            q, np.asarray(x.numpy()), atol=grid)
+
+    def test_straight_through_gradient(self):
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(np.array([0.3, -0.7], np.float32))
+        out = fake_quant(p, scale=1.0)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(p.grad.numpy()),
+                                   np.ones(2), atol=1e-6)
+
+    def test_clipping_at_scale(self):
+        x = paddle.to_tensor(np.array([5.0, -5.0], np.float32))
+        q = np.asarray(fake_quant(x, scale=1.0).numpy())
+        np.testing.assert_allclose(np.abs(q), [1.0, 1.0], atol=1e-6)
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                          weight=FakeQuanterWithAbsMax)
+        qmodel = QAT(cfg).quantize(_mlp())
+        kinds = [type(m).__name__ for m in qmodel._sub_layers.values()]
+        assert kinds.count("QuantedLinear") == 2
+
+    def test_qat_trains_and_converges(self):
+        cfg = QuantConfig(activation=None, weight=FakeQuanterWithAbsMax)
+        qmodel = QAT(cfg).quantize(_mlp())
+        opt = paddle.optimizer.Adam(1e-2, parameters=qmodel.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, (16,)))
+        losses = []
+        for _ in range(6):
+            loss = F.cross_entropy(qmodel(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_convert_bakes_quantized_weights(self):
+        cfg = QuantConfig(weight=FakeQuanterWithAbsMax)
+        qat = QAT(cfg)
+        qmodel = qat.quantize(_mlp())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8).astype(np.float32))
+        qmodel.eval()
+        _ = qmodel(x)  # populate quanter scales
+        deployed = qat.convert(qmodel)
+        kinds = [type(m).__name__ for m in deployed._sub_layers.values()]
+        assert "QuantedLinear" not in kinds
+        w = np.asarray(deployed._sub_layers["0"].weight.numpy())
+        scale = float(np.abs(w).max())
+        grid = scale / 127.0
+        np.testing.assert_allclose(w / grid, np.round(w / grid), atol=1e-3)
+
+
+class TestPTQ:
+    def test_calibrate_and_convert(self):
+        m = _mlp()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(32, 8).astype(np.float32))
+        ref = np.asarray(m(x).numpy())
+        ptq = PTQ()
+        observed = ptq.quantize(m)
+        _ = observed(x)  # calibration pass
+        deployed = ptq.convert(observed)
+        out = np.asarray(deployed(x).numpy())
+        # int8 weight quantization should stay close to fp32 outputs
+        assert np.abs(out - ref).max() < 0.15
+        assert np.abs(out - ref).max() > 0  # something actually quantized

@@ -99,15 +99,36 @@ def is_bfloat16_supported(device=None):
 
 
 class debugging:
-    """paddle.amp.debugging namespace (check_numerics etc.)."""
+    """paddle.amp.debugging namespace (check_numerics, operator stats)."""
 
     @staticmethod
     def enable_operator_stats_collection():
-        pass
+        from ..ops import _dispatch
+        _dispatch._op_stats = {}
 
     @staticmethod
     def disable_operator_stats_collection():
-        pass
+        from ..ops import _dispatch
+        stats = _dispatch._op_stats or {}
+        _dispatch._op_stats = None
+        if stats:
+            print("<------------------- op list -------------------->")
+            for (op, dtype), n in sorted(stats.items()):
+                print(f"  {op:<32s} {dtype:<12s} calls={n}")
+            print("<------------------------------------------------>")
+        return stats
+
+    class collect_operator_stats:
+        """Context manager parity: paddle.amp.debugging
+        .collect_operator_stats."""
+
+        def __enter__(self):
+            debugging.enable_operator_stats_collection()
+            return self
+
+        def __exit__(self, *exc):
+            self.stats = debugging.disable_operator_stats_collection()
+            return False
 
     @staticmethod
     def check_numerics(tensor, op_type="", var_name="", debug_mode=None):

@@ -137,6 +137,7 @@ def apply(fn: Callable, *args, _name: str = ""):
     returns a tuple/list.
     """
     arrays = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+    _debug_hooks(_name, arrays)
     # amp O1/O2 hook: cast float inputs of white/black-listed ops
     amp_d = _amp_dtype_for(_name)
     if amp_d is not None:
@@ -178,3 +179,34 @@ def apply(fn: Callable, *args, _name: str = ""):
         tensor_inputs, outs_list,
         name=_name or getattr(fn, "__name__", "op"))
     return _wrap_outputs(out, node)
+
+
+# ---------------------------------------------------------------------------
+# Debug hooks: FLAGS_check_nan_inf (reference parity:
+# paddle/fluid/framework/details/nan_inf_utils_detail — every kernel's
+# outputs scanned when the flag is on) and the amp operator-stats
+# collector (paddle.amp.debugging.collect_operator_stats).
+# ---------------------------------------------------------------------------
+
+_op_stats = None  # dict[(op, dtype)] -> count when collection is on
+
+
+def _debug_hooks(name, arrays):
+    global _op_stats
+    if _op_stats is not None:
+        key_dtype = ""
+        for a in arrays:
+            if hasattr(a, "dtype"):
+                key_dtype = str(a.dtype)
+                break
+        k = (name or "<anon>", key_dtype)
+        _op_stats[k] = _op_stats.get(k, 0) + 1
+    from ..framework.flags import flag_value
+    if flag_value("check_nan_inf"):
+        for i, a in enumerate(arrays):
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact):
+                bad = int(jnp.sum(~jnp.isfinite(a)))
+                if bad:
+                    raise FloatingPointError(
+                        f"FLAGS_check_nan_inf: op '{name or '<anon>'}' "
+                        f"input #{i} contains {bad} non-finite values")

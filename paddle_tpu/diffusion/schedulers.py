@@ -170,7 +170,8 @@ class DDIMScheduler(_SchedulerBase):
         eps = _coerce(model_output)._value.astype(jnp.float32)
         x = _coerce(sample)._value.astype(jnp.float32)
         t = jnp.asarray(timestep, jnp.int32)
-        step = self.num_train_timesteps // self.num_inference_steps
+        step = (self.num_train_timesteps // self.num_inference_steps
+                if self.num_inference_steps else 1)
         prev_t = t - step
         ac_t = self.alphas_cumprod[t]
         ac_prev = jnp.where(prev_t >= 0,

@@ -311,12 +311,15 @@ class Geometric(Distribution):
         super().__init__(self.probs._value.shape)
 
     def sample(self, shape=()):
+        # paddle.distribution.Geometric uses the FAILURES convention
+        # (support {0, 1, ...}, pmf (1-p)^k p); jax.random.geometric
+        # samples trials on {1, 2, ...} — shift down by one
         shp = _shape(shape, self._batch_shape)
-        return Tensor(jax.random.geometric(
-            next_key(), self.probs._value, shp).astype(jnp.float32))
+        return Tensor((jax.random.geometric(
+            next_key(), self.probs._value, shp) - 1).astype(jnp.float32))
 
     def log_prob(self, value):
-        return apply(lambda v, p: (v - 1) * jnp.log1p(-p) + jnp.log(p),
+        return apply(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
                      _coerce(value), self.probs)
 
 

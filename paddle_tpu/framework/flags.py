@@ -127,6 +127,9 @@ define_flag("cudnn_deterministic", False,
             "Deterministic kernels (TPU: XLA is deterministic by default).")
 define_flag("use_pallas_kernels", True,
             "Use Pallas fused kernels (attention/LN/RoPE) when on TPU.")
+define_flag("pallas_interpret", False,
+            "Force Pallas kernels ON in interpreter mode (CPU CI coverage: "
+            "runs every kernel's real Pallas path without TPU hardware).")
 define_flag("max_inplace_grad_add", 0, "Parity stub.")
 define_flag("eager_delete_tensor_gb", 0.0, "Parity stub; XLA GC is automatic.")
 define_flag("shm_channel_capacity_mb", 64,

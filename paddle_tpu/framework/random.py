@@ -21,13 +21,27 @@ import jax
 
 
 class _GlobalGenerator:
+    """Key creation is LAZY: materializing a jax PRNG key initializes the
+    XLA backend, and doing that at `import paddle_tpu` time makes import
+    block on (possibly slow/tunnelled) TPU client bring-up."""
+
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(seed)
+        self._lazy_key = None
         self._seed = seed
+
+    @property
+    def _key(self):
+        if self._lazy_key is None:
+            self._lazy_key = jax.random.key(self._seed)
+        return self._lazy_key
+
+    @_key.setter
+    def _key(self, value):
+        self._lazy_key = value
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._lazy_key = None
         return self
 
     def split(self):

@@ -16,13 +16,21 @@ _NEG_INF = np.float32(-1e30)
 
 
 def use_pallas() -> bool:
-    """Gate: FLAGS_use_pallas_kernels on AND a non-CPU backend."""
+    """Gate: FLAGS_use_pallas_kernels on AND (a non-CPU backend OR
+    FLAGS_pallas_interpret for CPU-interpreter CI coverage)."""
     if not flag_value("use_pallas_kernels"):
         return False
+    if flag_value("pallas_interpret"):
+        return True
     try:
         return jax.default_backend() not in ("cpu",)
     except Exception:
         return False
+
+
+def pallas_interpret() -> bool:
+    """True when Pallas kernels should run in interpreter mode (CPU CI)."""
+    return bool(flag_value("pallas_interpret"))
 
 
 def pallas_dtype_ok(*arrays) -> bool:

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import _Z, _NEG_INF, use_pallas as _use_pallas, pallas_dtype_ok
+from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
+                      pallas_dtype_ok, pallas_interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -127,6 +128,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128):
             pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
             pltpu.VMEM((block_q, d), jnp.float32),    # accumulator
         ],
+        interpret=pallas_interpret(),
     )(q, k, v)
     return out, lse[..., 0]
 

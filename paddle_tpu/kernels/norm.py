@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import _Z, _NEG_INF, use_pallas as _use_pallas, pallas_dtype_ok
+from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
+                      pallas_dtype_ok, pallas_interpret)
 
 
 # ------------------------------------------------------------- rms norm ----
@@ -42,6 +43,7 @@ def _rms_pallas(x2d, w, eps, block_rows=256):
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, _Z)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
+        interpret=pallas_interpret(),
     )(x2d, w)
 
 
@@ -115,6 +117,7 @@ def _ln_pallas(x2d, w, b, eps, block_rows=256):
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, _Z)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
+        interpret=pallas_interpret(),
     )(x2d, w, b)
 
 

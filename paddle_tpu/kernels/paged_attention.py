@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import _Z, _NEG_INF, use_pallas as _use_pallas, pallas_dtype_ok
+from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
+                      pallas_dtype_ok, pallas_interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +160,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     hkv = k_pages.shape[2]
     d = q.shape[-1]
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    interpret = interpret or pallas_interpret()
     use_kernel = ((interpret or (_use_pallas()
                                  and pallas_dtype_ok(q, k_pages, v_pages)))
                   and h == hkv and d % 128 == 0 and h % 8 == 0)

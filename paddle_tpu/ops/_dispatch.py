@@ -23,7 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..tensor import Tensor
+from ..tensor import Tensor, _is_tracer
 from ..autograd.grad_mode import is_grad_enabled
 from ..autograd.engine import GradNode
 
@@ -164,6 +164,16 @@ def apply(fn: Callable, *args, _name: str = ""):
     if not needs_grad:
         return _wrap_outputs(fn(*arrays), None)
 
+    if any(_is_tracer(a) for a in arrays):
+        # Inside an outer jax trace (TrainStep / functionalize / jit.grad):
+        # the outer transform differentiates the traced ops directly —
+        # including custom_vjp kernels. A nested jax.vjp here would
+        # re-linearize every custom_vjp fwd under the outer trace, which
+        # Pallas kernels cannot survive (pallas_call has no JVP rule:
+        # "Linearization failed to produce known values"). Record nothing;
+        # the eager tape is only meaningful on concrete values.
+        return _wrap_outputs(fn(*arrays), None)
+
     out, vjp_fn = jax.vjp(fn, *arrays)
     multi_out = isinstance(out, (tuple, list))
     outs_list = list(out) if multi_out else [out]
@@ -205,6 +215,12 @@ def _debug_hooks(name, arrays):
     if flag_value("check_nan_inf"):
         for i, a in enumerate(arrays):
             if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact):
+                if _is_tracer(a):
+                    # under jit/grad a concrete count is unavailable; the
+                    # flag's on_change already enabled jax_debug_nans,
+                    # which traps non-finite values in compiled programs
+                    # at runtime — skip the eager scan here
+                    continue
                 bad = int(jnp.sum(~jnp.isfinite(a)))
                 if bad:
                     raise FloatingPointError(

@@ -120,6 +120,10 @@ class LBFGS(Optimizer):
         self._n_evals = 0
         ps, flat_p, flat_grad = None, None, None
 
+        # backward() accumulates in this framework — start each step from
+        # clean grads, matching _eval()'s convention (a stale grad here
+        # corrupts the first search direction and (s, y) pair)
+        self.clear_grad()
         loss = closure()
         self._n_evals += 1
         ps, flat_p, flat_grad = self._gather()

@@ -75,6 +75,19 @@ class Optimizer:
         """Return the new param value (and update accumulators)."""
         raise NotImplementedError
 
+    def _mp_active(self, a) -> bool:
+        """Multi-precision (f32 master weights + f32 optimizer state) for a
+        low-precision param array. Reference parity: phi's adamw multi-
+        precision path (phi/kernels/gpu/adamw_kernel.cu, MasterParam in/out).
+        Default is AUTO: ON for bf16/f16 params — bf16 Adam moments NaN
+        within one step on real data, so low-precision params always get f32
+        state unless the user explicitly passes multi_precision=False."""
+        mp = getattr(self, "_multi_precision", None)
+        if mp is None:
+            mp = True
+        dt = getattr(a, "dtype", None)
+        return bool(mp) and dt in (jnp.bfloat16, jnp.float16)
+
     def _params_grads(self):
         pg = []
         for p in self._parameter_list:
@@ -92,10 +105,24 @@ class Optimizer:
             if g is None:
                 continue
             gv = g._value
-            if gv.dtype != p._value.dtype:
-                gv = gv.astype(p._value.dtype)
-            new_val = self._update(p, gv, lr)
-            p._value = new_val
+            if self._mp_active(p._value):
+                # run the update math on the f32 master copy; params keep
+                # the low-precision replica for fwd/bwd matmuls
+                master = self._get_accumulator(
+                    "master_weight", p, init=lambda x: x.astype(jnp.float32))
+                lp_val = p._value
+                p._value = master
+                try:
+                    new_master = self._update(p, gv.astype(jnp.float32), lr)
+                except Exception:
+                    p._value = lp_val
+                    raise
+                self._set_accumulator("master_weight", p, new_master)
+                p._value = new_master.astype(lp_val.dtype)
+            else:
+                if gv.dtype != p._value.dtype:
+                    gv = gv.astype(p._value.dtype)
+                p._value = self._update(p, gv, lr)
 
     def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list:
@@ -137,9 +164,11 @@ class Optimizer:
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None,
-                 weight_decay=None, grad_clip=None, name=None):
+                 weight_decay=None, grad_clip=None, multi_precision=None,
+                 name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
+        self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
         if self._regularization_coeff:
@@ -154,11 +183,12 @@ def _sgd_math(p, g, lr):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 name=None):
+                 multi_precision=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
         if self._regularization_coeff:
@@ -182,7 +212,7 @@ def _momentum_math(p, g, v, lr, mu, nesterov):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
-                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 grad_clip=None, lazy_mode=False, multi_precision=None,
                  use_multi_tensor=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
@@ -226,7 +256,7 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
                          name=name)
@@ -257,11 +287,13 @@ class AdamW(Adam):
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None,
-                 initial_accumulator_value=0.0, name=None):
+                 initial_accumulator_value=0.0, multi_precision=None,
+                 name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self.epsilon = epsilon
         self._init_acc = initial_accumulator_value
+        self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
         if self._regularization_coeff:
@@ -281,10 +313,11 @@ def _adagrad_math(p, g, acc, lr, eps):
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None):
+                 grad_clip=None, multi_precision=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
         if self._regularization_coeff:
@@ -313,11 +346,12 @@ def _adamax_math(p, g, m, u, t, lr, b1, b2, eps):
 class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None):
+                 grad_clip=None, multi_precision=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self.rho, self.epsilon = rho, epsilon
         self.momentum, self.centered = momentum, centered
+        self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
         if self._regularization_coeff:
@@ -349,11 +383,13 @@ def _rmsprop_math(p, g, ms, mg, mom, lr, rho, eps, mu, centered):
 class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
-                 exclude_from_weight_decay_fn=None, name=None):
+                 exclude_from_weight_decay_fn=None, multi_precision=None,
+                 name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self._wd = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
+        self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
         wd = self._wd
@@ -410,10 +446,21 @@ _lamb_kernel = functools.partial(
 def _fn_init_all(self, p_arrays, p_names, params=None):
     """Build per-param functional state. Seeds from existing eager
     accumulators (same keys) so a loaded checkpoint's moments carry into
-    the compiled step instead of restarting from zero."""
+    the compiled step instead of restarting from zero.
+
+    Multi-precision: for bf16/f16 params (see Optimizer._mp_active) the
+    state carries an f32 `master_weight` and the inner accumulators are
+    built from the f32 master — so moments are f32 too. The compiled step
+    updates the master and re-casts the low-precision replica."""
     states = []
     for i, a in enumerate(p_arrays):
-        st = self._fn_init(a)
+        if self._mp_active(a):
+            master = a.astype(jnp.float32)
+            st = self._fn_init(master)
+            st = dict(st) if isinstance(st, dict) else {}
+            st["master_weight"] = master
+        else:
+            st = self._fn_init(a)
         if params is not None and isinstance(st, dict):
             pid = id(params[i])
             for k in st:
@@ -427,10 +474,19 @@ def _fn_init_all(self, p_arrays, p_names, params=None):
 def _fn_apply_all(self, p_arrays, grads, states, lr, p_names, params=None):
     new_p, new_s = [], []
     for i, (p, g, s, n) in enumerate(zip(p_arrays, grads, states, p_names)):
-        if g.dtype != p.dtype:
-            g = g.astype(p.dtype)
         param = params[i] if params is not None else None
-        p2, s2 = self._fn_apply(p, g, s, lr, n, param)
+        if isinstance(s, dict) and "master_weight" in s:
+            inner = {k: v for k, v in s.items() if k != "master_weight"}
+            mw2, s2 = self._fn_apply(s["master_weight"],
+                                     g.astype(jnp.float32),
+                                     inner, lr, n, param)
+            s2 = dict(s2) if isinstance(s2, dict) else {}
+            s2["master_weight"] = mw2
+            p2 = mw2.astype(p.dtype)
+        else:
+            if g.dtype != p.dtype:
+                g = g.astype(p.dtype)
+            p2, s2 = self._fn_apply(p, g, s, lr, n, param)
         new_p.append(p2)
         new_s.append(s2)
     return new_p, new_s

@@ -153,7 +153,12 @@ class Tensor:
             self.grad = None
 
     def detach(self) -> "Tensor":
-        t = Tensor(self._value, stop_gradient=True)
+        v = self._value
+        if _is_tracer(v):
+            # under an outer jax trace (TrainStep/functionalize) the eager
+            # tape is bypassed; block the outer grad at the jax level too
+            v = jax.lax.stop_gradient(v)
+        t = Tensor(v, stop_gradient=True)
         t.name = self.name
         return t
 

@@ -171,3 +171,15 @@ class TestPipeline:
         ids = tok(["hello world"])["input_ids"]
         out = te(Tensor(jnp.asarray(ids)))
         assert out.shape == [1, cfg.max_length, cfg.hidden_size]
+
+
+class TestSchedulerGuards:
+    def test_ddim_step_without_set_timesteps(self):
+        """Regression (ADVICE r1): DDIM.step before set_timesteps raised an
+        opaque TypeError (None division); must behave like DDPM's guard."""
+        import numpy as np
+        sch = DDIMScheduler(num_train_timesteps=100, clip_sample=False)
+        x = np.zeros((1, 2, 2, 2), np.float32)
+        eps = np.zeros((1, 2, 2, 2), np.float32)
+        out = sch.step(eps, 50, x)  # must not raise
+        assert np.isfinite(np.asarray(out.prev_sample.numpy())).all()

@@ -122,3 +122,21 @@ class TestContinuousFamilies:
     def test_gumbel_moments(self):
         s = np.asarray(Gumbel(0.0, 1.0).sample((40000,)).numpy())
         assert abs(s.mean() - 0.5772) < 0.03
+
+
+class TestGeometricConvention:
+    def test_failures_convention(self):
+        """Regression (ADVICE r1): paddle's Geometric is the FAILURES
+        convention — support {0,1,...}, pmf (1-p)^k p, mean (1-p)/p."""
+        from paddle_tpu.distribution import Geometric
+        paddle.seed(0)
+        p = 0.25
+        d = Geometric(np.float32(p))
+        s = np.asarray(d.sample((40000,)).numpy())
+        assert s.min() == 0.0
+        assert abs(s.mean() - (1 - p) / p) < 0.1
+        lp0 = float(d.log_prob(paddle.to_tensor(np.float32(0.0))).numpy())
+        np.testing.assert_allclose(lp0, np.log(p), atol=1e-6)
+        lp2 = float(d.log_prob(paddle.to_tensor(np.float32(2.0))).numpy())
+        np.testing.assert_allclose(lp2, 2 * np.log(1 - p) + np.log(p),
+                                   atol=1e-6)

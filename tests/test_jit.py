@@ -263,3 +263,29 @@ class TestAmpEndToEnd:
             opt.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestCheckNanInfUnderTrace:
+    def test_flag_does_not_break_tracing(self):
+        """Regression (ADVICE r1): FLAGS_check_nan_inf raised
+        ConcretizationTypeError inside any jitted path (the eager scan
+        called int() on tracers). Traced values must be skipped — runtime
+        checking is jax_debug_nans' job."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.jit import TrainStep
+        set_flags({"check_nan_inf": True})
+        try:
+            paddle.seed(0)
+            m = nn.Linear(4, 4)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=m.parameters())
+            step = TrainStep(m, opt, lambda o, y: ((o - y) ** 2).mean())
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+            loss = step(x, y)
+            assert np.isfinite(float(loss))
+        finally:
+            set_flags({"check_nan_inf": False})

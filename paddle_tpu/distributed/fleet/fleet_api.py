@@ -67,10 +67,39 @@ class DistributedModel(Layer):
                                                  DistributedOptimizer) else optimizer
         st = self._strategy
         stage = st.sharding_stage
+        mesh = _fleet_state["hcg"].mesh if _fleet_state["hcg"] else None
+        pp = int(st.hybrid_configs.get("pp_degree", 1) or 1)
+        if pp > 1:
+            from .meta_parallel.pp_layers import PipelineLayer
+            from .meta_parallel.pipeline_parallel import PipelineTrainStep
+            if not isinstance(self._layers, PipelineLayer):
+                raise TypeError(
+                    "pp_degree > 1 requires the model to be a "
+                    "fleet.meta_parallel.PipelineLayer")
+            if stage and int(stage) > 0:
+                raise NotImplementedError(
+                    "pp_degree > 1 with sharding_stage > 0 (ZeRO) is not "
+                    "composed yet: PipelineTrainStep shards stage bodies "
+                    "over 'stage' but replicates pre/post params. Drop "
+                    "sharding_configs or use dp x mp x ZeRO without pp.")
+            if n_model_inputs != 1:
+                raise NotImplementedError(
+                    "PipelineTrainStep feeds exactly one model input "
+                    "(batch[0]); got n_model_inputs="
+                    f"{n_model_inputs}")
+            if batch_specs is not None:
+                raise NotImplementedError(
+                    "batch_specs is not supported with pp_degree > 1; the "
+                    "pipeline shards batch dim 0 over 'data' automatically")
+            acc = int(st.pipeline_configs.get("accumulate_steps", 1) or 1)
+            self._train_step = PipelineTrainStep(
+                self._layers, opt, loss_fn,
+                num_microbatches=max(acc, 1), mesh=mesh)
+            return self._train_step
         self._train_step = DistTrainStep(
             self._layers, opt, loss_fn, n_model_inputs=n_model_inputs,
             sharding_stage=stage,
-            mesh=_fleet_state["hcg"].mesh if _fleet_state["hcg"] else None,
+            mesh=mesh,
             batch_specs=batch_specs)
         return self._train_step
 

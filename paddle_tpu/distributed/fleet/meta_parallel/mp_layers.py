@@ -29,12 +29,27 @@ from ....ops.creation import _coerce
 from ...mesh import get_mesh, axis_size
 
 
+def _constraint_sharding(mesh, *spec):
+    """NamedSharding for an activation constraint. Inside a (partially)
+    manual shard_map region — e.g. the pipeline's 'stage' axis — the
+    constraint must be built against the current *abstract* mesh, whose
+    axis types record which axes are manual; the concrete mesh's types
+    would be rejected there."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty:
+            return NamedSharding(am, PartitionSpec(*spec))
+    except Exception:
+        pass
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
 def _constrain(x, *spec):
     """Apply a sharding constraint if a multi-device mesh is active."""
     mesh = get_mesh()
     if mesh is None or axis_size("model", mesh) <= 1:
         return x
-    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    sh = _constraint_sharding(mesh, *spec)
     return apply(lambda v: jax.lax.with_sharding_constraint(v, sh), _coerce(x))
 
 
@@ -149,7 +164,7 @@ def _seq_constrain(x, seq_axis=1, shard=True):
     spec = [None] * nd
     if shard:
         spec[seq_axis] = "model"
-    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    sh = _constraint_sharding(mesh, *spec)
     return apply(lambda v: jax.lax.with_sharding_constraint(v, sh), _coerce(x))
 
 

@@ -6,11 +6,10 @@ Reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py
 TPU-native: PipelineLayer materializes ALL layers (full logical model —
 single-controller SPMD holds every stage's params, sharded over the
 'stage' mesh axis by the engine) and records the stage segmentation.
-The pipeline *schedule* lives in dist_step.PipelineTrainStep: a scanned
-shard_map over 'stage' with ppermute activation handoff; jax.grad
-differentiates through it, so fwd+bwd+update is still one XLA program
-(the compiler's latency-hiding scheduler overlaps the bubbles — the
-role 1F1B plays in the reference).
+The pipeline *schedule* lives in pipeline_parallel.PipelineTrainStep: a
+scanned shard_map over 'stage' with ppermute activation handoff (GPipe
+order, per-tick rematerialization); jax.grad differentiates through it,
+so fwd+bwd+update is still one XLA program.
 """
 from __future__ import annotations
 

@@ -26,6 +26,34 @@ from ..framework.random import default_generator
 from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def bound_state(p_tensors, p_vals, b_tensors=(), b_vals=(), rng_key=None):
+    """Temporarily rebind live Tensor objects to the given (usually traced)
+    arrays, and optionally swap the global RNG key — the single shared
+    rebind protocol used by every functional tracing path (functionalize,
+    TrainStep, DistTrainStep, PipelineTrainStep). Restores everything on
+    exit."""
+    gen = default_generator()
+    old_key = gen._key if rng_key is not None else None
+    olds = [t._value for t in list(p_tensors) + list(b_tensors)]
+    for t, v in zip(p_tensors, p_vals):
+        t._value = v
+    for t, v in zip(b_tensors, b_vals):
+        t._value = v
+    if rng_key is not None:
+        gen._key = rng_key
+    try:
+        yield gen
+    finally:
+        for t, v in zip(list(p_tensors) + list(b_tensors), olds):
+            t._value = v
+        if rng_key is not None:
+            gen._key = old_key
+
+
 def functionalize(layer, fn=None, training=None):
     """Return (pure_fn, p_arrays, b_arrays, names): pure_fn(p, b, key, *args)
     runs `fn` (default layer.forward) with params/buffers temporarily bound
@@ -37,27 +65,18 @@ def functionalize(layer, fn=None, training=None):
     b_tensors = [b for _, b in named_b]
 
     def pure_fn(p_vals, b_vals, rng_key, *arg_vals):
-        gen = default_generator()
-        old_key = gen._key
-        olds = [t._value for t in p_tensors + b_tensors]
         old_training = layer.training
         if training is not None:
             layer.train() if training else layer.eval()
-        gen._key = rng_key
-        for t, v in zip(p_tensors, p_vals):
-            t._value = v
-        for t, v in zip(b_tensors, b_vals):
-            t._value = v
         try:
-            args = [Tensor(a) if not isinstance(a, Tensor) else a
-                    for a in arg_vals]
-            out = fn(*args)
-            new_b = [t._value for t in b_tensors]
-            return out, new_b, gen._key
+            with bound_state(p_tensors, p_vals, b_tensors, b_vals,
+                             rng_key) as gen:
+                args = [Tensor(a) if not isinstance(a, Tensor) else a
+                        for a in arg_vals]
+                out = fn(*args)
+                new_b = [t._value for t in b_tensors]
+                return out, new_b, gen._key
         finally:
-            for t, v in zip(p_tensors + b_tensors, olds):
-                t._value = v
-            gen._key = old_key
             layer.training = old_training
             if training is not None:
                 layer.train() if old_training else layer.eval()

@@ -252,6 +252,11 @@ class Layer:
     # ----------------------------------------------------------- state io --
     def state_dict(self, destination=None, include_sublayers=True,
                    structured_name_prefix="", use_hook=True):
+        sync = getattr(self, "_deferred_sync", None)
+        if sync is not None:
+            # a compiled train step (e.g. PipelineTrainStep) keeps the
+            # authoritative params device-side; flush before reading
+            sync()
         dest = destination if destination is not None else collections.OrderedDict()
         for name, p in self._parameters.items():
             if p is not None:

@@ -139,6 +139,11 @@ class Optimizer:
 
     # ----------------------------------------------------------- state io --
     def state_dict(self):
+        sync = getattr(self, "_deferred_sync", None)
+        if sync is not None:
+            # compiled train steps keep authoritative opt state; flush it
+            # into the accumulators before reading
+            sync()
         out = {}
         for name, store in self._accumulators.items():
             for pid, arr in store.items():

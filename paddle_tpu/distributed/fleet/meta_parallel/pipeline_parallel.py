@@ -249,23 +249,13 @@ class PipelineTrainStep:
         self._tmpl = self._chunks[0]
         self._tmpl_named = _named_params(self._tmpl)
         self._tmpl_p = [p for _, p in self._tmpl_named]
+        self._chunk_named = [_named_params(c) for c in self._chunks]
 
-        # stacked leaves [S, ...] — sharded over 'stage' (+ the layer's own
-        # TP tags on the inner dims)
-        chunk_vals = [[p._value for _, p in _named_params(c)]
-                      for c in self._chunks]
-        for vals in chunk_vals[1:]:
-            assert len(vals) == len(chunk_vals[0])
-        self._stacked = [jnp.stack([chunk_vals[s][j]
-                                    for s in range(self._S)])
-                         for j in range(len(chunk_vals[0]))]
         self._stacked_sh = []
         for j, (_, p0) in enumerate(self._tmpl_named):
             tag = list(getattr(p0, "_partition_spec", P()) or ())
             spec = P("stage", *tag)
             self._stacked_sh.append(NamedSharding(self._mesh, spec))
-        self._stacked = [jax.device_put(v, sh) for v, sh
-                         in zip(self._stacked, self._stacked_sh)]
 
         # pre/post params + buffers (trained unstaged)
         self._pre_named = _named_params(self._pre)
@@ -276,19 +266,71 @@ class PipelineTrainStep:
             _named_buffers(self._post)
         self._edge_b = [b for _, b in self._edge_b_named]
 
-        # one functional optimizer state over [pre, stacked, post]; seeded
-        # from the eager accumulators (a loaded checkpoint's moments /
-        # master weights carry into the compiled step)
-        self._p_names = ([f"pre.{n}" for n, _ in self._pre_named]
-                         + [f"stages.{n}" for n, _ in self._tmpl_named]
-                         + [f"post.{n}" for n, _ in self._post_named])
+        # REAL structured names (matching model.named_parameters()), so
+        # name-based optimizer policies behave exactly as without pp
+        def _global_names(layer_offset, named):
+            out = []
+            for n, _ in named:
+                li, rest = n.split(".", 1)
+                out.append(f"run_function.{layer_offset + int(li)}.{rest}")
+            return out
+        self._pre_names = _global_names(0, self._pre_named)
+        self._post_names = _global_names(len(layers) - len(self._post),
+                                         self._post_named)
+        self._chunk_names = [
+            _global_names(n_pre + s * L, self._chunk_named[s])
+            for s in range(self._S)]
+        # stacked leaves carry stage-0's real name; name-based weight-decay
+        # decisions must agree across the group — verify, else refuse
+        decay_fn = getattr(optimizer, "_apply_decay_param_fun", None)
+        if decay_fn is not None:
+            for j in range(len(self._tmpl_named)):
+                decisions = {bool(decay_fn(self._chunk_names[s][j]))
+                             for s in range(self._S)}
+                if len(decisions) > 1:
+                    raise ValueError(
+                        "apply_decay_param_fun decides differently across "
+                        f"pipeline stages for leaf {self._chunk_names[0][j]}"
+                        " — stage-stacked params need a uniform decision")
+        if getattr(optimizer, "_lr_ratio", None) is not None:
+            raise NotImplementedError(
+                "AdamW(lr_ratio=...) is parameter-object based and cannot "
+                "be applied to stage-stacked pipeline params")
+        self._p_names = (self._pre_names + self._chunk_names[0]
+                         + self._post_names)
+        self._seed_params = (self._pre_p + [None] * len(self._tmpl_named)
+                             + self._post_p)
+        self._compiled = {}
+        self._refresh_from_layers()
+        # register invalidation now: a set_state_dict BEFORE the first
+        # step must also trigger a re-read of the stacked leaves
+        model._deferred_invalidate = self._mark_stale
+        optimizer._deferred_invalidate = self._mark_stale
+
+    def _refresh_from_layers(self):
+        """(Re)build the stage-stacked param leaves from the live layer
+        tensors and (re)seed optimizer state from the eager accumulators.
+        Called at construction and after set_state_dict invalidation."""
+        optimizer = self._opt
+        # stacked leaves [S, ...] — sharded over 'stage' (+ the layer's
+        # own TP tags on the inner dims)
+        chunk_vals = [[p._value for _, p in named]
+                      for named in self._chunk_named]
+        for vals in chunk_vals[1:]:
+            assert len(vals) == len(chunk_vals[0])
+        self._stacked = [jnp.stack([chunk_vals[s][j]
+                                    for s in range(self._S)])
+                         for j in range(len(chunk_vals[0]))]
+        self._stacked = [jax.device_put(v, sh) for v, sh
+                         in zip(self._stacked, self._stacked_sh)]
+
+        # functional opt state over [pre, stacked, post]; seeded from the
+        # eager accumulators (a loaded checkpoint's moments / master
+        # weights carry into the compiled step)
         all_vals = ([p._value for p in self._pre_p] + self._stacked
                     + [p._value for p in self._post_p])
-        seed_params = (self._pre_p + [None] * len(self._stacked)
-                       + self._post_p)
         self._opt_state = optimizer._fn_init_all(all_vals, self._p_names,
-                                                 seed_params)
-        self._chunk_named = [_named_params(c) for c in self._chunks]
+                                                 self._seed_params)
         n_pre_ = len(self._pre_p)
         for j in range(len(self._stacked)):
             st = self._opt_state[n_pre_ + j]
@@ -311,11 +353,11 @@ class PipelineTrainStep:
                         st[k] = cand
         # opt state mirrors each param's sharding
         repl = NamedSharding(self._mesh, P())
-        self._all_sh = ([repl] * len(self._pre_p) + self._stacked_sh
-                        + [repl] * len(self._post_p))
+        all_sh = ([repl] * len(self._pre_p) + self._stacked_sh
+                  + [repl] * len(self._post_p))
         placed = []
         self._s_sh = []
-        for st, psh, pv in zip(self._opt_state, self._all_sh, all_vals):
+        for st, psh, pv in zip(self._opt_state, all_sh, all_vals):
             if isinstance(st, dict):
                 leaf_sh = {k: (psh if tuple(v.shape) == tuple(pv.shape)
                                else repl)
@@ -327,7 +369,14 @@ class PipelineTrainStep:
                 placed.append(st)
                 self._s_sh.append(repl)
         self._opt_state = placed
-        self._compiled = {}
+        self._stale = False
+        self._dirty = False
+
+    def _mark_stale(self):
+        """set_state_dict loaded new values into the layer tensors /
+        accumulators: drop our device-side copies and re-read next step."""
+        self._stale = True
+        self._dirty = False
 
     # ------------------------------------------------------------------
     def _body_fn(self):
@@ -353,6 +402,7 @@ class PipelineTrainStep:
         n_pre = len(self._pre_p)
         n_stk = len(self._stacked)
         p_names = self._p_names
+        seed_params = self._seed_params
 
         def step_fn(pre_v, stk_v, post_v, eb_v, opt_state, key, lr, batch):
             x, labels = batch[0], batch[1:]
@@ -383,7 +433,7 @@ class PipelineTrainStep:
             flat_p = list(pre_v) + list(stk_v) + list(post_v)
             flat_g = _clip_grads_functional(flat_g, grad_clip)
             new_p, new_state = opt._fn_apply_all(
-                flat_p, flat_g, opt_state, lr, p_names)
+                flat_p, flat_g, opt_state, lr, p_names, seed_params)
             return (loss_val, new_p[:n_pre], new_p[n_pre:n_pre + n_stk],
                     new_p[n_pre + n_stk:], new_eb, new_state)
 
@@ -420,6 +470,10 @@ class PipelineTrainStep:
             raise ValueError(
                 f"batch dim {arrays[0].shape[0]} not divisible by "
                 f"num_microbatches={self._M}")
+        if getattr(self, "_stale", False):
+            # set_state_dict replaced layer tensors / accumulators since
+            # our last read — rebuild the stacked leaves and opt state
+            self._refresh_from_layers()
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         if sig not in self._compiled:
             self._compiled[sig] = self._build(sig)
@@ -446,6 +500,8 @@ class PipelineTrainStep:
         self._dirty = True
         self._model._deferred_sync = self.sync_state
         self._opt._deferred_sync = self.sync_state
+        self._model._deferred_invalidate = self._mark_stale
+        self._opt._deferred_invalidate = self._mark_stale
         return Tensor(loss)
 
     def sync_state(self):

@@ -154,15 +154,9 @@ class LlamaAttention(Layer):
         else:
             new_cache = (k, v)
 
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-
-            def expand_kv(kv):
-                return apply(
-                    lambda a: jnp.repeat(a, rep, axis=2), kv)
-            k = expand_kv(k)
-            v = expand_kv(v)
-
+        # GQA: kv heads are NOT repeated here — the flash kernel consumes
+        # grouped kv natively (kernels/attention.py GQA index maps) and the
+        # XLA fallback repeats internally only when it must.
         causal = past_key_value is None
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=causal,

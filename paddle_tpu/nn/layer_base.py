@@ -291,6 +291,12 @@ class Layer:
         for k in own:
             if k not in matched:
                 missing.append(k)
+        inval = getattr(self, "_deferred_invalidate", None)
+        if inval is not None:
+            # a compiled train step caches device-side copies of these
+            # params (e.g. stage-stacked pipeline weights); tell it to
+            # re-read from the layer tensors on its next step
+            inval()
         return missing, unexpected
 
     load_dict = set_state_dict

@@ -165,6 +165,9 @@ class Optimizer:
                     v = state_dict[key]
                     self._accumulators[name][id(p)] = (
                         v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        inval = getattr(self, "_deferred_invalidate", None)
+        if inval is not None:
+            inval()
 
 
 class SGD(Optimizer):

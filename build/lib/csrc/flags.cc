@@ -1,0 +1,186 @@
+// Native typed flag registry + host allocator statistics.
+//
+// Parity: paddle/phi/core/flags.cc (FLAGS_* registry with env override,
+// exported metadata) and paddle/fluid/memory/stats.h (per-pool
+// HostMemoryStat* / DeviceMemoryStat* current+peak counters).
+//
+// The Python-side registry (paddle_tpu/framework/flags.py) mirrors into this
+// native registry when the library is present, making flag state visible to
+// native components (shm pool, stores) without crossing back into Python.
+#include "common.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace pd {
+
+namespace {
+thread_local std::string g_last_error;
+}
+
+void set_last_error(const std::string& msg) { g_last_error = msg; }
+const char* last_error() { return g_last_error.c_str(); }
+
+namespace {
+
+enum FlagType : int { kBool = 0, kInt = 1, kDouble = 2, kString = 3 };
+
+struct Flag {
+  FlagType type;
+  std::string str_val;
+  double num_val = 0;  // bool/int/double live here
+  std::string help;
+};
+
+std::mutex g_flags_mu;
+std::map<std::string, Flag> g_flags;
+
+struct Stat {
+  std::atomic<int64_t> current{0};
+  std::atomic<int64_t> peak{0};
+  std::atomic<int64_t> allocs{0};
+};
+
+std::mutex g_stats_mu;
+std::map<std::string, Stat*> g_stats;  // pool name -> stat (leaked, process-lifetime)
+
+Stat* stat_for(const char* pool) {
+  std::lock_guard<std::mutex> lk(g_stats_mu);
+  auto it = g_stats.find(pool);
+  if (it != g_stats.end()) return it->second;
+  Stat* s = new Stat();
+  g_stats.emplace(pool, s);
+  return s;
+}
+
+}  // namespace
+}  // namespace pd
+
+PD_EXPORT const char* pd_last_error() { return pd::last_error(); }
+
+PD_EXPORT void pd_free(void* p) { std::free(p); }
+
+// ----------------------------------------------------------------- flags ---
+
+PD_EXPORT int pd_flag_define(const char* name, int type,
+                             const char* str_default, double num_default,
+                             const char* help) {
+  std::lock_guard<std::mutex> lk(pd::g_flags_mu);
+  auto& f = pd::g_flags[name];
+  f.type = static_cast<pd::FlagType>(type);
+  f.str_val = str_default ? str_default : "";
+  f.num_val = num_default;
+  f.help = help ? help : "";
+  // Env override: FLAGS_<name>
+  std::string env_name = std::string("FLAGS_") + name;
+  if (const char* env = std::getenv(env_name.c_str())) {
+    if (f.type == pd::kString) {
+      f.str_val = env;
+    } else if (f.type == pd::kBool) {
+      std::string v(env);
+      f.num_val = (v == "1" || v == "true" || v == "True" || v == "yes" ||
+                   v == "on")
+                      ? 1
+                      : 0;
+    } else {
+      f.num_val = std::strtod(env, nullptr);
+    }
+    return 1;  // env took effect
+  }
+  return 0;
+}
+
+PD_EXPORT int pd_flag_set_num(const char* name, double v) {
+  std::lock_guard<std::mutex> lk(pd::g_flags_mu);
+  auto it = pd::g_flags.find(name);
+  if (it == pd::g_flags.end()) {
+    pd::set_last_error(std::string("unknown flag: ") + name);
+    return -1;
+  }
+  it->second.num_val = v;
+  return 0;
+}
+
+PD_EXPORT int pd_flag_set_str(const char* name, const char* v) {
+  std::lock_guard<std::mutex> lk(pd::g_flags_mu);
+  auto it = pd::g_flags.find(name);
+  if (it == pd::g_flags.end()) {
+    pd::set_last_error(std::string("unknown flag: ") + name);
+    return -1;
+  }
+  it->second.str_val = v ? v : "";
+  return 0;
+}
+
+PD_EXPORT double pd_flag_get_num(const char* name) {
+  std::lock_guard<std::mutex> lk(pd::g_flags_mu);
+  auto it = pd::g_flags.find(name);
+  return it == pd::g_flags.end() ? 0 : it->second.num_val;
+}
+
+// Returns a malloc'd copy (caller frees with pd_free); NULL if missing.
+PD_EXPORT char* pd_flag_get_str(const char* name) {
+  std::lock_guard<std::mutex> lk(pd::g_flags_mu);
+  auto it = pd::g_flags.find(name);
+  if (it == pd::g_flags.end()) return nullptr;
+  return strdup(it->second.str_val.c_str());
+}
+
+PD_EXPORT int pd_flag_count() {
+  std::lock_guard<std::mutex> lk(pd::g_flags_mu);
+  return static_cast<int>(pd::g_flags.size());
+}
+
+// ------------------------------------------------- host allocator stats ---
+
+PD_EXPORT void pd_stats_record_alloc(const char* pool, int64_t bytes) {
+  auto* s = pd::stat_for(pool);
+  int64_t cur = s->current.fetch_add(bytes) + bytes;
+  s->allocs.fetch_add(1);
+  int64_t peak = s->peak.load();
+  while (cur > peak && !s->peak.compare_exchange_weak(peak, cur)) {
+  }
+}
+
+PD_EXPORT void pd_stats_record_free(const char* pool, int64_t bytes) {
+  pd::stat_for(pool)->current.fetch_sub(bytes);
+}
+
+PD_EXPORT int64_t pd_stats_current(const char* pool) {
+  return pd::stat_for(pool)->current.load();
+}
+
+PD_EXPORT int64_t pd_stats_peak(const char* pool) {
+  return pd::stat_for(pool)->peak.load();
+}
+
+PD_EXPORT int64_t pd_stats_alloc_count(const char* pool) {
+  return pd::stat_for(pool)->allocs.load();
+}
+
+PD_EXPORT void pd_stats_reset_peak(const char* pool) {
+  auto* s = pd::stat_for(pool);
+  s->peak.store(s->current.load());
+}
+
+// ------------------------------------------------- tracked host buffers ---
+// Aligned host allocations with stats attribution — the host-side staging
+// arena the DataLoader and checkpoint writer use (device memory is XLA's).
+
+PD_EXPORT void* pd_host_alloc(int64_t bytes, const char* pool) {
+  void* p = nullptr;
+  if (posix_memalign(&p, 64, static_cast<size_t>(bytes)) != 0) {
+    pd::set_last_error("posix_memalign failed");
+    return nullptr;
+  }
+  pd_stats_record_alloc(pool ? pool : "host", bytes);
+  return p;
+}
+
+PD_EXPORT void pd_host_free(void* p, int64_t bytes, const char* pool) {
+  std::free(p);
+  pd_stats_record_free(pool ? pool : "host", bytes);
+}

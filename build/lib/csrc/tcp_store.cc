@@ -1,0 +1,362 @@
+// TCPStore: rendezvous key-value store for distributed init.
+//
+// Parity: paddle/phi/core/distributed/store/tcp_store.cc — MasterDaemon
+// (listening server owning the map) + TCPClient (set/get/add/wait), used to
+// exchange bootstrap info (comm ids, endpoints) before collectives exist.
+//
+// TPU-native role: JAX's coordination service handles in-mesh bootstrap; this
+// store backs the Fleet/launch layer — rank rendezvous, elastic membership,
+// barrier before jax.distributed.initialize, and user-level dist.barrier()
+// when no mesh is live yet.
+//
+// Protocol (length-prefixed binary, one request per message):
+//   request : u8 op | u32 klen | key bytes | u64 vlen | value bytes
+//   response: i64 status/num  | u64 vlen | value bytes
+// Ops: SET=1 GET=2 ADD=3 WAIT=4 DEL=5 NUMKEYS=6
+// GET with wait semantics: blocks server-side until the key exists (like the
+// reference's blocking get), bounded by client-supplied timeout in vlen field.
+#include "common.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDel = 5,
+                    kNumKeys = 6 };
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;  // open connections, for shutdown wakeup
+  std::mutex mu;
+  std::condition_variable cv;  // signalled on any map mutation
+  std::map<std::string, std::vector<uint8_t>> kv;
+
+  void handle_conn(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (!stop.load()) {
+      uint8_t op;
+      uint32_t klen;
+      uint64_t vlen;
+      if (!recv_all(fd, &op, 1) || !recv_all(fd, &klen, 4) ) break;
+      if (klen > (1u << 20)) break;  // sanity
+      std::string key(klen, '\0');
+      if (klen && !recv_all(fd, &key[0], klen)) break;
+      if (!recv_all(fd, &vlen, 8)) break;
+      std::vector<uint8_t> val;
+      if (op == kSet) {
+        if (vlen > (1ull << 32)) break;
+        val.resize(vlen);
+        if (vlen && !recv_all(fd, val.data(), vlen)) break;
+      }
+      int64_t status = 0;
+      std::vector<uint8_t> out;
+      switch (op) {
+        case kSet: {
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = std::move(val);
+          cv.notify_all();
+          break;
+        }
+        case kGet:
+        case kWait: {
+          // vlen carries the timeout in ms (0 = no wait).
+          auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(vlen);
+          std::unique_lock<std::mutex> lk(mu);
+          bool ok = cv.wait_until(lk, deadline, [&] {
+            return stop.load() || kv.count(key) > 0;
+          });
+          if (!ok || stop.load() || kv.count(key) == 0) {
+            status = -1;  // timeout / missing
+          } else if (op == kGet) {
+            out = kv[key];
+          }
+          break;
+        }
+        case kAdd: {
+          // vlen reinterpreted as the signed delta.
+          int64_t delta;
+          std::memcpy(&delta, &vlen, 8);
+          std::lock_guard<std::mutex> lk(mu);
+          auto& cell = kv[key];
+          int64_t cur = 0;
+          if (cell.size() == 8) std::memcpy(&cur, cell.data(), 8);
+          cur += delta;
+          cell.resize(8);
+          std::memcpy(cell.data(), &cur, 8);
+          status = cur;
+          cv.notify_all();
+          break;
+        }
+        case kDel: {
+          std::lock_guard<std::mutex> lk(mu);
+          status = static_cast<int64_t>(kv.erase(key));
+          cv.notify_all();
+          break;
+        }
+        case kNumKeys: {
+          std::lock_guard<std::mutex> lk(mu);
+          status = static_cast<int64_t>(kv.size());
+          break;
+        }
+        default:
+          status = -2;
+      }
+      uint64_t olen = out.size();
+      if (!send_all(fd, &status, 8) || !send_all(fd, &olen, 8)) break;
+      if (olen && !send_all(fd, out.data(), olen)) break;
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    while (!stop.load()) {
+      pollfd pfd{listen_fd, POLLIN, 0};
+      int rc = ::poll(&pfd, 1, 200);
+      if (rc <= 0) continue;
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) continue;
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        conn_fds.push_back(fd);
+      }
+      workers.emplace_back([this, fd] { handle_conn(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one in-flight request per client
+
+  bool request(uint8_t op, const std::string& key, const void* val,
+               uint64_t vlen, int64_t* status, std::vector<uint8_t>* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    if (!send_all(fd, &op, 1) || !send_all(fd, &klen, 4) ||
+        !send_all(fd, key.data(), klen) || !send_all(fd, &vlen, 8))
+      return false;
+    if (op == kSet && vlen && !send_all(fd, val, vlen)) return false;
+    uint64_t olen;
+    if (!recv_all(fd, status, 8) || !recv_all(fd, &olen, 8)) return false;
+    if (out) {
+      out->resize(olen);
+      if (olen && !recv_all(fd, out->data(), olen)) return false;
+    } else if (olen) {
+      return false;
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+PD_EXPORT void* pd_store_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    pd::set_last_error("socket() failed");
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    pd::set_last_error("bind/listen failed (port in use?)");
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+PD_EXPORT int pd_store_server_port(void* sv) {
+  return static_cast<Server*>(sv)->port;
+}
+
+PD_EXPORT void pd_store_server_stop(void* sv) {
+  auto* s = static_cast<Server*>(sv);
+  s->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->cv.notify_all();
+  }
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  {
+    // Unblock handler threads parked in recv() on live client connections
+    // (workers may still hold clients open when the master shuts down).
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+PD_EXPORT void* pd_store_client_connect(const char* host, int port,
+                                        int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // crude hostname fallback: only "localhost"
+    if (std::string(host) == "localhost") {
+      inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+    } else {
+      pd::set_last_error(std::string("cannot resolve host: ") + host);
+      return nullptr;
+    }
+  }
+  // retry-connect until deadline (master may start after workers)
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd >= 0 &&
+        ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    if (fd >= 0) ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) {
+      pd::set_last_error("connect timed out");
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+PD_EXPORT void pd_store_client_free(void* cv) {
+  auto* c = static_cast<Client*>(cv);
+  ::close(c->fd);
+  delete c;
+}
+
+PD_EXPORT int pd_store_set(void* cv, const char* key, const uint8_t* data,
+                           int64_t len) {
+  int64_t status;
+  if (!static_cast<Client*>(cv)->request(kSet, key, data,
+                                         static_cast<uint64_t>(len), &status,
+                                         nullptr)) {
+    pd::set_last_error("store set: connection error");
+    return -1;
+  }
+  return 0;
+}
+
+// On success returns 0 and fills *out (malloc'd; free with pd_free) + *len.
+PD_EXPORT int pd_store_get(void* cv, const char* key, int timeout_ms,
+                           uint8_t** out, int64_t* len) {
+  int64_t status;
+  std::vector<uint8_t> buf;
+  if (!static_cast<Client*>(cv)->request(
+          kGet, key, nullptr, static_cast<uint64_t>(timeout_ms), &status,
+          &buf)) {
+    pd::set_last_error("store get: connection error");
+    return -1;
+  }
+  if (status != 0) {
+    pd::set_last_error("store get: timeout waiting for key");
+    return -2;
+  }
+  *len = static_cast<int64_t>(buf.size());
+  *out = static_cast<uint8_t*>(std::malloc(buf.size() ? buf.size() : 1));
+  std::memcpy(*out, buf.data(), buf.size());
+  return 0;
+}
+
+PD_EXPORT int64_t pd_store_add(void* cv, const char* key, int64_t delta) {
+  int64_t status;
+  uint64_t as_u;
+  std::memcpy(&as_u, &delta, 8);
+  if (!static_cast<Client*>(cv)->request(kAdd, key, nullptr, as_u, &status,
+                                         nullptr)) {
+    pd::set_last_error("store add: connection error");
+    return INT64_MIN;
+  }
+  return status;
+}
+
+PD_EXPORT int pd_store_wait(void* cv, const char* key, int timeout_ms) {
+  int64_t status;
+  if (!static_cast<Client*>(cv)->request(
+          kWait, key, nullptr, static_cast<uint64_t>(timeout_ms), &status,
+          nullptr)) {
+    pd::set_last_error("store wait: connection error");
+    return -1;
+  }
+  return status == 0 ? 0 : -2;
+}
+
+PD_EXPORT int64_t pd_store_delete(void* cv, const char* key) {
+  int64_t status;
+  if (!static_cast<Client*>(cv)->request(kDel, key, nullptr, 0, &status,
+                                         nullptr))
+    return -1;
+  return status;
+}
+
+PD_EXPORT int64_t pd_store_num_keys(void* cv) {
+  int64_t status;
+  if (!static_cast<Client*>(cv)->request(kNumKeys, "", nullptr, 0, &status,
+                                         nullptr))
+    return -1;
+  return status;
+}

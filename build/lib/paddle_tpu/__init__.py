@@ -1,0 +1,89 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas.
+
+Top-level namespace parity: python/paddle/__init__.py. The import graph is
+kept light: `import paddle_tpu as paddle` gives `paddle.Tensor`,
+`paddle.to_tensor`, the op library, `paddle.nn`, `paddle.optimizer`,
+`paddle.distributed` (Fleet equivalent), `paddle.jit`, `paddle.amp`,
+`paddle.io`, `paddle.vision`, `paddle.inference`.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# Paddle dtype parity needs int64/float64 tensors (paddle defaults python
+# ints to int64); enable x64 before any array is created. Compute-path code
+# explicitly uses float32/bfloat16, so the TPU hot path is unaffected.
+_jax.config.update("jax_enable_x64", True)
+# Paddle/cuBLAS semantics: float32 matmuls accumulate in float32. JAX's
+# default lets the backend pick (bf16 passes on TPU); force f32 for parity —
+# the bf16 hot path opts in explicitly via amp/bfloat16 params instead.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+__version__ = "0.1.0"
+
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (
+    bool_ as bool,  # noqa: A001 — paddle exposes paddle.bool
+    uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+from .framework.place import (
+    CPUPlace, TPUPlace, XLAPlace, CUDAPlace, set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+)
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.flags import set_flags, get_flags
+from .framework import random as _random_mod
+
+from .tensor import Tensor, Parameter, to_tensor
+from .autograd.grad_mode import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .autograd import grad
+from . import autograd
+
+# op library — star-exported at top level (paddle.add, paddle.matmul, ...)
+from .ops import *  # noqa: F401,F403
+from . import ops
+
+from . import nn
+from . import optimizer
+from . import amp
+from . import io
+from . import metric
+from .framework_io import save, load
+from .nn.initializer import ParamAttr
+
+from . import jit
+from . import static
+from .static.api import enable_static, disable_static, in_dynamic_mode
+from . import device
+from . import vision
+from . import inference
+from . import incubate
+from . import profiler
+from .hapi import Model, summary
+from .hapi import callbacks
+
+from . import distributed
+from .distributed.parallel import DataParallel
+
+from . import fft
+from . import signal
+from . import sparse
+from . import distribution
+from . import audio
+from . import utils
+from . import version
+from . import onnx
+from . import generation
+from . import diffusion
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def get_default_place():
+    from .framework.place import _default_place
+    return _default_place()

@@ -1,0 +1,74 @@
+"""Grad-mode switches (parity: python/paddle/autograd/no_grad and
+paddle/fluid/eager tracer enable flag)."""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    """paddle.set_grad_enabled — usable as context manager or plain call."""
+    return _GradScope(bool(mode))
+
+
+class _GradScope:
+    def __init__(self, mode: bool):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+class no_grad:
+    """paddle.no_grad: context manager AND decorator."""
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with enable_grad():
+                return fn(*a, **kw)
+        return wrapper

@@ -1,0 +1,146 @@
+"""paddle.amp — automatic mixed precision.
+
+Reference parity: python/paddle/amp/{auto_cast,grad_scaler}.py. O1 works at
+the dispatch layer: ops on the white list (matmul/conv/linear/attention —
+the MXU ops) run their float inputs in the amp dtype, black-list ops
+(softmax/norm/exp/log) stay float32. On TPU the amp dtype defaults to
+bfloat16 — no loss scaling is numerically required (bf16 has f32's
+exponent range), but GradScaler is kept for API parity and for fp16.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import dtype as dtypes
+from .grad_scaler import GradScaler, AmpScaler
+
+_WHITE_LIST = {
+    "matmul", "linear", "conv", "flash_attention", "einsum", "bmm", "mm",
+    "addmm",
+}
+_BLACK_LIST = {
+    "softmax", "log_softmax", "layer_norm", "rms_norm", "batch_norm",
+    "cross_entropy", "exp", "log", "mean", "sum", "cumsum",
+}
+
+
+class _AmpState:
+    enabled = False
+    dtype = dtypes.bfloat16
+    level = "O1"
+    custom_white = set()
+    custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def amp_dtype_for(op_name):
+    """Called by the dispatch layer: returns the target dtype for float
+    inputs of `op_name`, or None to leave dtypes alone."""
+    if not _state.enabled or not op_name:
+        return None
+    if op_name in _state.custom_black or op_name in _BLACK_LIST:
+        return dtypes.float32
+    if _state.level == "O2":
+        return _state.dtype
+    if op_name in _state.custom_white or op_name in _WHITE_LIST:
+        return _state.dtype
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast"""
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = bool(enable)
+    _state.dtype = dtypes.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2: cast model params to the amp dtype (norms
+    kept f32 per paddle semantics is approximated by casting all floats;
+    master weights live in the optimizer accumulators)."""
+    d = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        for m in ms:
+            m._to_dtype(d)
+    if optimizers is None:
+        return models if single else ms
+    return (models, optimizers)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+class debugging:
+    """paddle.amp.debugging namespace (check_numerics, operator stats)."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        from ..ops import _dispatch
+        _dispatch._op_stats = {}
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        from ..ops import _dispatch
+        stats = _dispatch._op_stats or {}
+        _dispatch._op_stats = None
+        if stats:
+            print("<------------------- op list -------------------->")
+            for (op, dtype), n in sorted(stats.items()):
+                print(f"  {op:<32s} {dtype:<12s} calls={n}")
+            print("<------------------------------------------------>")
+        return stats
+
+    class collect_operator_stats:
+        """Context manager parity: paddle.amp.debugging
+        .collect_operator_stats."""
+
+        def __enter__(self):
+            debugging.enable_operator_stats_collection()
+            return self
+
+        def __exit__(self, *exc):
+            self.stats = debugging.disable_operator_stats_collection()
+            return False
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+        import jax.numpy as jnp
+        import numpy as np
+        from ..tensor import Tensor
+        t = tensor if isinstance(tensor, Tensor) else Tensor(tensor)
+        arr = np.asarray(t._value)
+        n_nan = int(np.isnan(arr).sum())
+        n_inf = int(np.isinf(arr).sum())
+        if n_nan or n_inf:
+            raise FloatingPointError(
+                f"check_numerics({op_type}/{var_name}): {n_nan} NaN, "
+                f"{n_inf} Inf values found")
+        return t

@@ -1,0 +1,15 @@
+"""paddle.audio parity — audio feature extraction.
+
+Reference parity: python/paddle/audio/ (features/layers.py Spectrogram/
+MelSpectrogram/LogMelSpectrogram/MFCC; functional/functional.py
+hz_to_mel/mel_to_hz/compute_fbank_matrix/create_dct).
+
+Built on paddle_tpu.signal.stft (XLA FFT), so the whole feature chain
+jits onto TPU.
+"""
+from . import functional
+from .features import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
+                       MFCC)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,118 @@
+"""paddle.audio.functional parity (hz/mel conversions, fbank, dct,
+windows)."""
+from __future__ import annotations
+
+import math as pymath
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = isinstance(freq, (int, float))
+    f = np.asarray(freq, np.float32) if scalar else \
+        np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq,
+                   np.float32)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = pymath.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if scalar else Tensor(jnp.asarray(out))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = isinstance(mel, (int, float))
+    m = np.asarray(mel, np.float32) if scalar else \
+        np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel,
+                   np.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = pymath.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if scalar else Tensor(jnp.asarray(out))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray(mel_to_hz(Tensor(jnp.asarray(mels)), htk).numpy())))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+    melpts = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max,
+                                        htk).numpy())
+    fdiff = np.diff(melpts)
+    ramps = melpts[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / np.maximum(fdiff[:-1, None], 1e-10)
+    upper = ramps[2:] / np.maximum(fdiff[1:, None], 1e-10)
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melpts[2:n_mels + 2] - melpts[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(np.float32)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II matrix."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / np.sqrt(2.0)
+        dct *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.astype(np.float32)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+
+    def fn(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * np.float32(np.log10(
+            max(amin, ref_value)))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    return apply(fn, _coerce(spect), _name="power_to_db")
+
+
+def get_window(window, win_length, fftbins=True):
+    n = win_length
+    if window in ("hann", "hanning"):
+        w = np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+    elif window == "hamming":
+        w = np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+    elif window == "blackman":
+        w = np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w.astype(np.float32)))

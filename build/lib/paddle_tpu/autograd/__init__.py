@@ -1,0 +1,11 @@
+"""paddle.autograd parity surface (python/paddle/autograd/)."""
+from __future__ import annotations
+
+from .grad_mode import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .engine import run_backward as backward, grad, GradNode
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = [
+    "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+    "backward", "grad", "PyLayer", "PyLayerContext", "GradNode",
+]

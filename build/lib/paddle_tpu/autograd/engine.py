@@ -1,0 +1,240 @@
+"""Tape-based backward engine.
+
+Reference parity: paddle/fluid/eager/backward.cc (egr::Backward /
+egr::Grad) — topological traversal of the GradNode graph with gradient
+accumulation, hooks, and double-grad support.
+
+TPU-native design: each eager op recorded a `GradNode` holding the
+`jax.vjp` pullback; backward replays pullbacks in reverse topological
+order. Cotangents are themselves `Tensor`s, and with `create_graph=True`
+the pullback calls run back through the dispatch layer, so higher-order
+gradients fall out naturally. Under `jax.jit` tracing the same engine runs
+at trace time, producing a single fused XLA program for fwd+bwd.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+
+class GradNode:
+    """Producer node on the tape.
+
+    `backward_fn(cotangent_tensors: tuple[Tensor]) -> sequence[Tensor|None]`
+    returns one gradient per recorded input (None for non-differentiable).
+    """
+
+    __slots__ = ("backward_fn", "inputs", "out_shapes", "out_dtypes",
+                 "out_refs", "name", "__weakref__")
+
+    def __init__(self, backward_fn, inputs: Sequence, out_arrays, name=""):
+        self.backward_fn = backward_fn
+        self.inputs = list(inputs)  # Tensors (or None for non-tensor slots)
+        self.out_shapes = [tuple(o.shape) for o in out_arrays]
+        self.out_dtypes = [o.dtype for o in out_arrays]
+        self.out_refs: List[Optional[weakref.ref]] = [None] * len(out_arrays)
+        self.name = name
+
+    def register_output(self, idx: int, tensor: Tensor):
+        self.out_refs[idx] = weakref.ref(tensor)
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_in={len(self.inputs)}, n_out={len(self.out_shapes)})"
+
+
+def _toposort(root_nodes) -> List[GradNode]:
+    """Iterative DFS; returns nodes with producers-before-consumers."""
+    order: List[GradNode] = []
+    visited = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if isinstance(t, Tensor) and t._grad_node is not None:
+                if id(t._grad_node) not in visited:
+                    stack.append((t._grad_node, False))
+    return order
+
+
+def _ones_like(t: Tensor) -> Tensor:
+    return Tensor(jnp.ones(t._value.shape, t._value.dtype))
+
+
+def _accum(a: Optional[Tensor], b: Tensor) -> Tensor:
+    if a is None:
+        return b
+    from ..ops import _dispatch
+    return _dispatch.apply(jnp.add, a, b)
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
+                 retain_graph: bool = False):
+    """paddle.autograd.backward — accumulate into leaf `.grad` slots."""
+    grads = _traverse(tensors, grad_tensors, inputs=None,
+                      create_graph=False, retain_graph=retain_graph,
+                      accumulate_leaf=True, allow_unused=True)
+    return grads
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False, no_grad_vars=None):
+    """paddle.grad — functional gradient API (parity:
+    python/paddle/autograd/autograd.py::grad)."""
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    if retain_graph is None:
+        retain_graph = create_graph
+    gmap = _traverse(outputs, grad_outputs, inputs=inputs,
+                     create_graph=create_graph, retain_graph=retain_graph,
+                     accumulate_leaf=False, allow_unused=allow_unused,
+                     no_grad_vars=set(map(id, _as_list(no_grad_vars or []))))
+    result = []
+    for t in inputs:
+        g = gmap.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "one of the input tensors received no gradient; pass "
+                "allow_unused=True to permit this")
+        result.append(g)
+    return result
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _traverse(out_tensors, grad_tensors, inputs, create_graph, retain_graph,
+              accumulate_leaf, allow_unused, no_grad_vars=frozenset()):
+    from ..autograd.grad_mode import no_grad as _no_grad_ctx, enable_grad
+
+    out_tensors = _as_list(out_tensors)
+    grad_tensors = _as_list(grad_tensors) if grad_tensors else [None] * len(out_tensors)
+    if len(grad_tensors) != len(out_tensors):
+        raise ValueError("grad_tensors must match outputs in length")
+
+    # node -> list of accumulated output cotangents (Tensor|None)
+    node_cots = {}
+    # leaf tensor id -> accumulated grad; id -> tensor object
+    leaf_grads = {}
+    leaf_objs = {}
+    wanted = None if inputs is None else set(map(id, inputs))
+    # map id -> tensor so the engine can return grads for *non-leaf* inputs too
+    wanted_map = {} if inputs is None else {id(t): t for t in inputs}
+
+    roots = []
+    for t, g in zip(out_tensors, grad_tensors):
+        if not isinstance(t, Tensor):
+            raise TypeError(f"backward target must be Tensor, got {type(t)}")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_tensor for non-scalar outputs")
+            g = _ones_like(t)
+        elif not isinstance(g, Tensor):
+            g = Tensor(g)
+        if t._grad_node is not None:
+            node = t._grad_node
+            cots = node_cots.setdefault(id(node), [None] * len(node.out_shapes))
+            cots[t._out_index] = _accum(cots[t._out_index], g)
+            roots.append(node)
+        elif not t.stop_gradient:
+            g = _apply_hooks(t, g)
+            leaf_grads[id(t)] = _accum(leaf_grads.get(id(t)), g)
+            leaf_objs[id(t)] = t
+
+    order = _toposort({id(n): n for n in roots}.values())
+
+    grad_scope = enable_grad() if create_graph else _no_grad_ctx()
+    with grad_scope:
+        for node in reversed(order):
+            cots = node_cots.pop(id(node), None)
+            if cots is None:
+                continue
+            # fill missing output cotangents with zeros; run tensor hooks
+            full = []
+            for i, c in enumerate(cots):
+                ref = node.out_refs[i]
+                out_t = ref() if ref is not None else None
+                if c is None:
+                    c = Tensor(jnp.zeros(node.out_shapes[i], node.out_dtypes[i]))
+                elif out_t is not None:
+                    c = _apply_hooks(out_t, c)
+                full.append(c)
+            in_grads = node.backward_fn(tuple(full), create_graph)
+            if len(in_grads) != len(node.inputs):
+                raise RuntimeError(
+                    f"{node}: backward returned {len(in_grads)} grads for "
+                    f"{len(node.inputs)} inputs")
+            for t, g in zip(node.inputs, in_grads):
+                if g is None or not isinstance(t, Tensor):
+                    continue
+                if _is_float0(getattr(g, "_value", g)):
+                    continue
+                if id(t) in no_grad_vars:
+                    continue
+                if not isinstance(g, Tensor):
+                    g = Tensor(g)
+                if t._grad_node is not None and id(t._grad_node) != id(node):
+                    sub = node_cots.setdefault(
+                        id(t._grad_node), [None] * len(t._grad_node.out_shapes))
+                    sub[t._out_index] = _accum(sub[t._out_index], g)
+                    # a non-leaf explicitly requested in paddle.grad(inputs=...)
+                    if wanted is not None and id(t) in wanted:
+                        wanted_map[id(t)] = t
+                        leaf_grads[id(t)] = _accum(leaf_grads.get(id(t)), g)
+                elif not t.stop_gradient:
+                    g = _apply_hooks(t, g)
+                    leaf_grads[id(t)] = _accum(leaf_grads.get(id(t)), g)
+                    leaf_objs[id(t)] = t
+            if not retain_graph:
+                node.backward_fn = _freed_backward
+                node.inputs = []
+
+    if accumulate_leaf:
+        # install into .grad (Paddle accumulates across backward calls)
+        for tid, g in leaf_grads.items():
+            t = leaf_objs[tid]
+            g = g.detach() if not create_graph else g
+            t.grad = g if t.grad is None else _accum(t.grad, g)
+        return leaf_grads
+    else:
+        if not create_graph:
+            leaf_grads = {k: (v.detach() if isinstance(v, Tensor) else v)
+                          for k, v in leaf_grads.items()}
+        return leaf_grads
+
+
+def _freed_backward(cots, create_graph=False):
+    raise RuntimeError(
+        "trying to backward through the graph a second time; specify "
+        "retain_graph=True if you need to")
+
+
+def _apply_hooks(t: Tensor, g: Tensor) -> Tensor:
+    if t._hooks:
+        for h in list(t._hooks):
+            out = h(g)
+            if out is not None:
+                g = out if isinstance(out, Tensor) else Tensor(out)
+    return g

@@ -1,0 +1,5 @@
+"""Re-export grad-mode switches (moved to paddle_tpu._grad_mode to break the
+tensor<->autograd import cycle)."""
+from .._grad_mode import (  # noqa: F401
+    no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+)

@@ -1,0 +1,113 @@
+"""PyLayer: user-defined autograd function.
+
+Reference parity: python/paddle/autograd/py_layer.py (PyLayer with static
+forward/backward and a context for save_for_backward), backed in Paddle by
+paddle/fluid/eager/pylayer/py_layer_node.cc. Here the custom backward is
+just another GradNode whose backward_fn calls the user's `backward` with
+Tensor cotangents — so PyLayers compose with the rest of the tape,
+including double grad when the user's backward uses differentiable ops.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..tensor import Tensor
+from .engine import GradNode
+from .grad_mode import is_grad_enabled, no_grad
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle spells it both ways across versions
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):  # parity no-op (we never alias)
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = set(map(id, args))
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        outs = [o.detach() if isinstance(o, Tensor) else o for o in outs]
+
+        if not needs_grad:
+            return tuple(outs) if multi else outs[0]
+
+        tensor_out_idx = [i for i, o in enumerate(outs) if isinstance(o, Tensor)]
+        non_diff = getattr(ctx, "_non_diff", set())
+
+        def backward_fn(cot_tensors, create_graph):
+            # cot_tensors align with tensor outputs of the node
+            from .grad_mode import enable_grad
+            scope = enable_grad() if create_graph else no_grad()
+            with scope:
+                grads = cls.backward(ctx, *cot_tensors)
+            grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
+            # map returned grads (one per tensor input) onto node input slots
+            out = []
+            gi = iter(grads)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(gi, None)
+                    out.append(g if isinstance(g, Tensor) or g is None
+                               else Tensor(g))
+                else:
+                    out.append(None)
+            return out
+
+        diff_out_idx = [i for i in tensor_out_idx if id(outs[i]) not in non_diff]
+        node_inputs = [a if isinstance(a, Tensor) else None for a in args]
+        node_outs = [outs[i]._value for i in diff_out_idx]
+        node = GradNode(backward_fn, node_inputs, node_outs,
+                        name=f"PyLayer({cls.__name__})")
+        for k, i in enumerate(diff_out_idx):
+            t = outs[i]
+            t.stop_gradient = False
+            t._grad_node = node
+            t._out_index = k
+            node.register_output(k, t)
+        return tuple(outs) if multi else outs[0]
+
+
+# paddle >=2.3 exposes once_differentiable-style EagerPyLayer alias
+EagerPyLayer = PyLayer

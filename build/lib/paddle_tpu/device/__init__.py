@@ -1,0 +1,71 @@
+"""paddle.device parity (python/paddle/device/__init__.py)."""
+from __future__ import annotations
+
+import jax
+
+from ..framework.place import (
+    set_device, get_device, CPUPlace, TPUPlace, XLAPlace, CUDAPlace,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+
+
+def get_available_device():
+    devs = jax.devices()
+    return [f"{'cpu' if d.platform == 'cpu' else 'tpu'}:{d.id}" for d in devs]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def get_all_device_type():
+    return sorted({("cpu" if d.platform == "cpu" else "tpu")
+                   for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+class cuda:
+    """paddle.device.cuda parity shim → accelerator queries."""
+
+    @staticmethod
+    def device_count():
+        return sum(1 for d in jax.devices() if d.platform != "cpu")
+
+    @staticmethod
+    def synchronize(device=None):
+        # XLA dispatch is async; block on a trivial transfer
+        import jax.numpy as jnp
+        jnp.zeros(()).block_until_ready()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            d = jax.devices()[0]
+            stats = d.memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            d = jax.devices()[0]
+            stats = d.memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+
+def synchronize(device=None):
+    cuda.synchronize(device)

@@ -1,0 +1,19 @@
+"""Diffusion model suite (driver config #4: SD UNet train + t2i infer).
+
+ppdiffusers-shaped mini-API: UNet2DConditionModel, AutoencoderKL,
+DDPM/DDIM schedulers, StableDiffusionPipeline. See the per-module
+docstrings for the upstream paths each mirrors.
+"""
+from .schedulers import DDPMScheduler, DDIMScheduler, SchedulerOutput
+from .unet import UNet2DConditionModel, UNetConfig, timestep_embedding
+from .vae import AutoencoderKL, VAEConfig, DiagonalGaussianDistribution
+from .pipeline import (StableDiffusionPipeline, CLIPTextModel,
+                       TextEncoderConfig, SimpleTokenizer)
+
+__all__ = [
+    "DDPMScheduler", "DDIMScheduler", "SchedulerOutput",
+    "UNet2DConditionModel", "UNetConfig", "timestep_embedding",
+    "AutoencoderKL", "VAEConfig", "DiagonalGaussianDistribution",
+    "StableDiffusionPipeline", "CLIPTextModel", "TextEncoderConfig",
+    "SimpleTokenizer",
+]

@@ -1,0 +1,187 @@
+"""Text-to-image pipeline + CLIP-style text encoder.
+
+Reference parity: ppdiffusers ppdiffusers/pipelines/stable_diffusion/
+pipeline_stable_diffusion.py (the classifier-free-guidance sampling loop)
+and ppdiffusers/transformers CLIPTextModel.
+
+TPU-native notes: the denoise loop runs the UNet on a doubled batch
+(uncond + cond) per step — static shapes, so every step after the first
+hits the XLA compile cache; schedulers are pure jnp (schedulers.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Embedding, LayerNorm
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..ops import creation as C
+from ..ops import manipulation as M
+from ..ops._dispatch import apply
+from ..autograd.grad_mode import no_grad
+from .schedulers import DDIMScheduler
+
+
+@dataclass
+class TextEncoderConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_length: int = 77
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=1024, hidden_size=32, num_layers=2,
+                    num_heads=2, max_length=16)
+        base.update(kw)
+        return TextEncoderConfig(**base)
+
+
+class CLIPTextModel(Layer):
+    """Causal text transformer (CLIP-style) producing per-token hidden
+    states for UNet cross-attention."""
+
+    def __init__(self, config: TextEncoderConfig = None, **kwargs):
+        super().__init__()
+        if config is None:
+            config = (TextEncoderConfig(**kwargs) if kwargs
+                      else TextEncoderConfig.tiny())
+        self.config = config
+        self.token_embedding = Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.position_embedding = Embedding(config.max_length,
+                                            config.hidden_size)
+        layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_heads, config.hidden_size * 4,
+            activation="gelu", normalize_before=True)
+        self.encoder = TransformerEncoder(layer, config.num_layers)
+        self.final_layer_norm = LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = C.arange(s, dtype="int64")
+        h = self.token_embedding(input_ids) + self.position_embedding(pos)
+        # CLIP uses a causal mask over the prompt tokens
+        causal = C.tril(C.ones([s, s], dtype="bool"))
+        h = self.encoder(h, src_mask=causal)
+        return self.final_layer_norm(h)
+
+
+class SimpleTokenizer:
+    """Deterministic hash tokenizer stand-in (the reference pipelines take
+    a BPE CLIPTokenizer; serving deployments plug their own vocab)."""
+
+    def __init__(self, vocab_size=1024, max_length=16, pad_token_id=0,
+                 bos_token_id=1, eos_token_id=2):
+        self.vocab_size = vocab_size
+        self.model_max_length = max_length
+        self.pad_token_id = pad_token_id
+        self.bos_token_id = bos_token_id
+        self.eos_token_id = eos_token_id
+
+    def _tok(self, word):
+        return 3 + (hash(word) % (self.vocab_size - 3))
+
+    def __call__(self, texts, max_length=None, padding="max_length",
+                 truncation=True, return_tensors=None):
+        if isinstance(texts, str):
+            texts = [texts]
+        L = max_length or self.model_max_length
+        out = np.full((len(texts), L), self.pad_token_id, np.int64)
+        for i, t in enumerate(texts):
+            ids = [self.bos_token_id] + [self._tok(w)
+                                         for w in t.lower().split()]
+            ids = ids[:L - 1] + [self.eos_token_id]
+            out[i, :len(ids)] = ids
+        return {"input_ids": out}
+
+
+class StableDiffusionPipeline:
+    """ppdiffusers StableDiffusionPipeline-shaped t2i entry."""
+
+    def __init__(self, vae, text_encoder, tokenizer, unet, scheduler=None):
+        self.vae = vae
+        self.text_encoder = text_encoder
+        self.tokenizer = tokenizer
+        self.unet = unet
+        self.scheduler = scheduler or DDIMScheduler()
+        for m in (vae, text_encoder, unet):
+            m.eval()
+
+    @staticmethod
+    def tiny(seed=0):
+        """Build an all-tiny pipeline (tests / smoke benchmarks)."""
+        from .unet import UNet2DConditionModel, UNetConfig
+        from .vae import AutoencoderKL, VAEConfig
+        import paddle_tpu as paddle
+        paddle.seed(seed)
+        te_cfg = TextEncoderConfig.tiny()
+        unet = UNet2DConditionModel(UNetConfig.tiny(
+            cross_attention_dim=te_cfg.hidden_size))
+        return StableDiffusionPipeline(
+            AutoencoderKL(VAEConfig.tiny()), CLIPTextModel(te_cfg),
+            SimpleTokenizer(te_cfg.vocab_size, te_cfg.max_length),
+            unet, DDIMScheduler())
+
+    def _encode_prompt(self, prompt, negative_prompt, do_cfg):
+        if isinstance(prompt, str):
+            prompt = [prompt]
+        ids = self.tokenizer(prompt)["input_ids"]
+        emb = self.text_encoder(Tensor(jnp.asarray(ids)))
+        if not do_cfg:
+            return emb
+        neg = negative_prompt if negative_prompt is not None \
+            else [""] * len(prompt)
+        if isinstance(neg, str):
+            neg = [neg]
+        nids = self.tokenizer(neg)["input_ids"]
+        nemb = self.text_encoder(Tensor(jnp.asarray(nids)))
+        return M.concat([nemb, emb], axis=0)  # [2B, L, D]
+
+    def __call__(self, prompt, height=None, width=None,
+                 num_inference_steps=50, guidance_scale=7.5,
+                 negative_prompt=None, seed=None, latents=None,
+                 output_type="np", return_dict=True):
+        unet_cfg = self.unet.config
+        sample = unet_cfg.sample_size
+        height = height or sample * 8
+        width = width or sample * 8
+        n = 1 if isinstance(prompt, str) else len(prompt)
+        do_cfg = guidance_scale > 1.0
+
+        key = jax.random.key(seed if seed is not None else 0)
+        key, lk = jax.random.split(key)
+        lat_shape = (n, unet_cfg.in_channels, height // 8, width // 8)
+        with no_grad():
+            emb = self._encode_prompt(prompt, negative_prompt, do_cfg)
+            if latents is None:
+                latents = Tensor(jax.random.normal(lk, lat_shape,
+                                                   jnp.float32)
+                                 * self.scheduler.init_noise_sigma)
+            self.scheduler.set_timesteps(num_inference_steps)
+            for t in np.asarray(self.scheduler.timesteps):
+                inp = M.concat([latents, latents], axis=0) if do_cfg \
+                    else latents
+                inp = self.scheduler.scale_model_input(inp, t)
+                eps = self.unet(inp, int(t), emb)
+                if do_cfg:
+                    eps_u, eps_c = M.split(eps, 2, axis=0)
+                    eps = eps_u + guidance_scale * (eps_c - eps_u)
+                key, sk = jax.random.split(key)
+                latents = self.scheduler.step(eps, int(t), latents,
+                                              key=sk).prev_sample
+            scaled = latents * (1.0 / self.vae.config.scaling_factor)
+            image = self.vae.decode(scaled)
+        img = np.asarray(image.numpy())
+        img = np.clip(img / 2 + 0.5, 0.0, 1.0).transpose(0, 2, 3, 1)
+        if return_dict:
+            from types import SimpleNamespace
+            return SimpleNamespace(images=img)
+        return img

@@ -1,0 +1,196 @@
+"""Diffusion noise schedulers.
+
+Reference parity: ppdiffusers ppdiffusers/schedulers/scheduling_ddpm.py
+and scheduling_ddim.py (the ecosystem repo the driver's config #4
+"SD UNet train + t2i infer" exercises). API mirrors theirs:
+`set_timesteps`, `add_noise`, `step(model_output, t, sample)` returning
+an object with `.prev_sample`, plus `init_noise_sigma`/`scale_model_input`
+so pipeline code ports unchanged.
+
+TPU-native notes: all schedule tables are precomputed numpy/jnp constants
+(static shapes), `step` is pure jnp so the whole sampling loop can sit
+under `jax.jit`/`lax.fori_loop`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops.creation import _coerce
+
+
+def _betas(schedule: str, n: int, beta_start: float, beta_end: float):
+    if schedule == "linear":
+        return np.linspace(beta_start, beta_end, n, dtype=np.float32)
+    if schedule == "scaled_linear":  # SD default
+        return (np.linspace(beta_start ** 0.5, beta_end ** 0.5, n,
+                            dtype=np.float32) ** 2)
+    if schedule == "squaredcos_cap_v2":
+        def alpha_bar(t):
+            return np.cos((t + 0.008) / 1.008 * np.pi / 2) ** 2
+        out = []
+        for i in range(n):
+            t1, t2 = i / n, (i + 1) / n
+            out.append(min(1 - alpha_bar(t2) / alpha_bar(t1), 0.999))
+        return np.asarray(out, np.float32)
+    raise ValueError(f"unknown beta schedule {schedule}")
+
+
+@dataclass
+class SchedulerOutput:
+    prev_sample: object
+    pred_original_sample: object = None
+
+
+class _SchedulerBase:
+    order = 1
+
+    def __init__(self, num_train_timesteps=1000, beta_start=0.0001,
+                 beta_end=0.02, beta_schedule="linear",
+                 prediction_type="epsilon"):
+        self.num_train_timesteps = num_train_timesteps
+        self.prediction_type = prediction_type
+        self.betas = jnp.asarray(
+            _betas(beta_schedule, num_train_timesteps, beta_start, beta_end))
+        self.alphas = 1.0 - self.betas
+        self.alphas_cumprod = jnp.cumprod(self.alphas)
+        self.init_noise_sigma = 1.0
+        self.timesteps = jnp.arange(num_train_timesteps - 1, -1, -1)
+        self.num_inference_steps = None
+
+    # -- shared API ------------------------------------------------------
+    def scale_model_input(self, sample, timestep=None):
+        return sample
+
+    def add_noise(self, original_samples, noise, timesteps):
+        x0 = _coerce(original_samples)
+        eps = _coerce(noise)
+        t = jnp.asarray(_coerce(timesteps)._value
+                        if isinstance(_coerce(timesteps), Tensor)
+                        else np.asarray(timesteps), jnp.int32)
+        ac = self.alphas_cumprod[t].astype(jnp.float32)
+        while ac.ndim < len(x0.shape):
+            ac = ac[..., None]
+        out = (jnp.sqrt(ac) * x0._value.astype(jnp.float32)
+               + jnp.sqrt(1.0 - ac) * eps._value.astype(jnp.float32))
+        return Tensor(out.astype(x0._value.dtype))
+
+    def _predict_x0(self, model_output, t_ac, sample):
+        if self.prediction_type == "epsilon":
+            return ((sample - jnp.sqrt(1.0 - t_ac) * model_output)
+                    / jnp.sqrt(t_ac))
+        if self.prediction_type == "v_prediction":
+            return (jnp.sqrt(t_ac) * sample
+                    - jnp.sqrt(1.0 - t_ac) * model_output)
+        if self.prediction_type == "sample":
+            return model_output
+        raise ValueError(self.prediction_type)
+
+
+class DDPMScheduler(_SchedulerBase):
+    """Ancestral sampling (training-time schedule). ppdiffusers
+    DDPMScheduler parity."""
+
+    def __init__(self, num_train_timesteps=1000, beta_start=0.0001,
+                 beta_end=0.02, beta_schedule="linear",
+                 prediction_type="epsilon", clip_sample=True,
+                 clip_sample_range=1.0):
+        super().__init__(num_train_timesteps, beta_start, beta_end,
+                         beta_schedule, prediction_type)
+        self.clip_sample = clip_sample
+        self.clip_sample_range = clip_sample_range
+
+    def set_timesteps(self, num_inference_steps):
+        self.num_inference_steps = num_inference_steps
+        step = self.num_train_timesteps // num_inference_steps
+        self.timesteps = jnp.asarray(
+            (np.arange(0, num_inference_steps) * step)[::-1].copy())
+
+    def step(self, model_output, timestep, sample, generator=None,
+             key=None, return_dict=True):
+        eps = _coerce(model_output)._value.astype(jnp.float32)
+        x = _coerce(sample)._value.astype(jnp.float32)
+        t = jnp.asarray(timestep, jnp.int32)
+        step = (self.num_train_timesteps // self.num_inference_steps
+                if self.num_inference_steps else 1)
+        prev_t = t - step
+        ac_t = self.alphas_cumprod[t]
+        ac_prev = jnp.where(prev_t >= 0, self.alphas_cumprod[
+            jnp.clip(prev_t, 0)], jnp.float32(1.0))
+        beta_t = 1.0 - ac_t / ac_prev
+        alpha_t = 1.0 - beta_t
+
+        x0 = self._predict_x0(eps, ac_t, x)
+        if self.clip_sample:
+            x0 = jnp.clip(x0, -self.clip_sample_range,
+                          self.clip_sample_range)
+        # q(x_{t-1} | x_t, x_0) posterior mean
+        coef_x0 = jnp.sqrt(ac_prev) * beta_t / (1.0 - ac_t)
+        coef_xt = jnp.sqrt(alpha_t) * (1.0 - ac_prev) / (1.0 - ac_t)
+        mean = coef_x0 * x0 + coef_xt * x
+        var = jnp.clip(beta_t * (1.0 - ac_prev) / (1.0 - ac_t), 1e-20)
+        if key is None:
+            from ..framework.random import next_key
+            key = next_key()
+        noise = jax.random.normal(key, x.shape, jnp.float32)
+        prev = mean + jnp.where(t > 0, jnp.sqrt(var), 0.0) * noise
+        out = SchedulerOutput(Tensor(prev), Tensor(x0))
+        return out if return_dict else (out.prev_sample,)
+
+
+class DDIMScheduler(_SchedulerBase):
+    """Deterministic (eta=0) fast sampler. ppdiffusers DDIMScheduler
+    parity."""
+
+    def __init__(self, num_train_timesteps=1000, beta_start=0.0001,
+                 beta_end=0.02, beta_schedule="linear",
+                 prediction_type="epsilon", clip_sample=True,
+                 set_alpha_to_one=True, steps_offset=0):
+        super().__init__(num_train_timesteps, beta_start, beta_end,
+                         beta_schedule, prediction_type)
+        self.clip_sample = clip_sample
+        self.final_alpha_cumprod = (jnp.float32(1.0) if set_alpha_to_one
+                                    else self.alphas_cumprod[0])
+        self.steps_offset = steps_offset
+
+    def set_timesteps(self, num_inference_steps):
+        self.num_inference_steps = num_inference_steps
+        step = self.num_train_timesteps // num_inference_steps
+        self.timesteps = jnp.asarray(
+            (np.arange(0, num_inference_steps) * step)[::-1].copy()
+            + self.steps_offset)
+
+    def step(self, model_output, timestep, sample, eta=0.0, key=None,
+             return_dict=True):
+        eps = _coerce(model_output)._value.astype(jnp.float32)
+        x = _coerce(sample)._value.astype(jnp.float32)
+        t = jnp.asarray(timestep, jnp.int32)
+        step = (self.num_train_timesteps // self.num_inference_steps
+                if self.num_inference_steps else 1)
+        prev_t = t - step
+        ac_t = self.alphas_cumprod[t]
+        ac_prev = jnp.where(prev_t >= 0,
+                            self.alphas_cumprod[jnp.clip(prev_t, 0)],
+                            self.final_alpha_cumprod)
+
+        x0 = self._predict_x0(eps, ac_t, x)
+        if self.clip_sample:
+            x0 = jnp.clip(x0, -1.0, 1.0)
+        # re-derive the direction from the (possibly clipped) x0
+        eps_dir = (x - jnp.sqrt(ac_t) * x0) / jnp.sqrt(1.0 - ac_t)
+        sigma = eta * jnp.sqrt((1.0 - ac_prev) / (1.0 - ac_t)
+                               * (1.0 - ac_t / ac_prev))
+        dir_xt = jnp.sqrt(jnp.clip(1.0 - ac_prev - sigma ** 2, 0.0)) * eps_dir
+        prev = jnp.sqrt(ac_prev) * x0 + dir_xt
+        if eta > 0:
+            if key is None:
+                from ..framework.random import next_key
+                key = next_key()
+            prev = prev + sigma * jax.random.normal(key, x.shape, jnp.float32)
+        out = SchedulerOutput(Tensor(prev), Tensor(x0))
+        return out if return_dict else (out.prev_sample,)

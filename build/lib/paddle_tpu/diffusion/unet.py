@@ -1,0 +1,352 @@
+"""UNet2DConditionModel — the Stable Diffusion denoiser.
+
+Reference parity: ppdiffusers ppdiffusers/models/unet_2d_condition.py
+(+ resnet.py, attention.py, transformer_2d.py) — driver config #4.
+
+TPU-native notes: NCHW layout throughout (XLA re-layouts for the conv
+units internally); attention over flattened spatial tokens runs through
+nn.functional.scaled_dot_product_attention so the Pallas flash kernel is
+picked up when head_dim/seq allow; timestep embedding is f32 sinusoidal
+(precision-sensitive) then cast to the activation dtype.
+"""
+from __future__ import annotations
+
+import math as pymath
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers_common import (Conv2D, Linear, LayerList, GroupNorm,
+                                LayerNorm, Silu, Dropout)
+from ..nn import functional as F
+from ..ops import manipulation as M
+from ..ops._dispatch import apply
+
+
+def timestep_embedding(timesteps, dim, max_period=10000.0):
+    """Sinusoidal embedding [B] -> [B, dim] (f32)."""
+    def fn(t):
+        half = dim // 2
+        freqs = jnp.exp(-pymath.log(max_period)
+                        * jnp.arange(half, dtype=jnp.float32) / half)
+        args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+        return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+    return apply(fn, timesteps, _name="timestep_embedding")
+
+
+class TimestepEmbedding(Layer):
+    def __init__(self, in_dim, time_embed_dim):
+        super().__init__()
+        self.linear_1 = Linear(in_dim, time_embed_dim)
+        self.act = Silu()
+        self.linear_2 = Linear(time_embed_dim, time_embed_dim)
+
+    def forward(self, sample):
+        return self.linear_2(self.act(self.linear_1(sample)))
+
+
+class ResnetBlock2D(Layer):
+    def __init__(self, in_channels, out_channels, temb_channels, groups=32):
+        super().__init__()
+        groups = min(groups, in_channels)
+        self.norm1 = GroupNorm(min(groups, in_channels), in_channels)
+        self.conv1 = Conv2D(in_channels, out_channels, 3, padding=1)
+        self.time_emb_proj = Linear(temb_channels, out_channels)
+        self.norm2 = GroupNorm(min(groups, out_channels), out_channels)
+        self.conv2 = Conv2D(out_channels, out_channels, 3, padding=1)
+        self.nonlinearity = Silu()
+        self.conv_shortcut = (Conv2D(in_channels, out_channels, 1)
+                              if in_channels != out_channels else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(self.nonlinearity(self.norm1(x)))
+        temb = self.time_emb_proj(self.nonlinearity(temb))
+        h = h + M.reshape(temb, [temb.shape[0], temb.shape[1], 1, 1])
+        h = self.conv2(self.nonlinearity(self.norm2(h)))
+        if self.conv_shortcut is not None:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class CrossAttention(Layer):
+    """Self- or cross-attention over spatial tokens (flash layout)."""
+
+    def __init__(self, query_dim, context_dim=None, heads=8, dim_head=64):
+        super().__init__()
+        inner = heads * dim_head
+        context_dim = context_dim or query_dim
+        self.heads = heads
+        self.dim_head = dim_head
+        self.to_q = Linear(query_dim, inner, bias_attr=False)
+        self.to_k = Linear(context_dim, inner, bias_attr=False)
+        self.to_v = Linear(context_dim, inner, bias_attr=False)
+        self.to_out = Linear(inner, query_dim)
+
+    def forward(self, x, context=None):
+        context = x if context is None else context
+        b, s, _ = x.shape
+        sk = context.shape[1]
+        q = M.reshape(self.to_q(x), [b, s, self.heads, self.dim_head])
+        k = M.reshape(self.to_k(context), [b, sk, self.heads, self.dim_head])
+        v = M.reshape(self.to_v(context), [b, sk, self.heads, self.dim_head])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=False,
+                                             training=self.training)
+        return self.to_out(M.reshape(out, [b, s, self.heads * self.dim_head]))
+
+
+class FeedForward(Layer):
+    """GEGLU feed-forward (SD style)."""
+
+    def __init__(self, dim, mult=4):
+        super().__init__()
+        inner = dim * mult
+        self.proj = Linear(dim, inner * 2)
+        self.out = Linear(inner, dim)
+
+    def forward(self, x):
+        h = self.proj(x)
+        a, g = M.split(h, 2, axis=-1)
+        return self.out(a * F.gelu(g))
+
+
+class BasicTransformerBlock(Layer):
+    def __init__(self, dim, context_dim, heads, dim_head):
+        super().__init__()
+        self.norm1 = LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, None, heads, dim_head)
+        self.norm2 = LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, context_dim, heads, dim_head)
+        self.norm3 = LayerNorm(dim)
+        self.ff = FeedForward(dim)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        x = x + self.ff(self.norm3(x))
+        return x
+
+
+class Transformer2DModel(Layer):
+    def __init__(self, channels, context_dim, heads, dim_head, groups=32):
+        super().__init__()
+        self.norm = GroupNorm(min(groups, channels), channels)
+        self.proj_in = Linear(channels, channels)
+        self.block = BasicTransformerBlock(channels, context_dim, heads,
+                                           dim_head)
+        self.proj_out = Linear(channels, channels)
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        res = x
+        x = self.norm(x)
+        x = M.reshape(M.transpose(x, [0, 2, 3, 1]), [b, h * w, c])
+        x = self.proj_in(x)
+        x = self.block(x, context)
+        x = self.proj_out(x)
+        x = M.transpose(M.reshape(x, [b, h, w, c]), [0, 3, 1, 2])
+        return x + res
+
+
+class Downsample2D(Layer):
+    def __init__(self, channels):
+        super().__init__()
+        self.conv = Conv2D(channels, channels, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample2D(Layer):
+    def __init__(self, channels):
+        super().__init__()
+        self.conv = Conv2D(channels, channels, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2.0, mode="nearest")
+        return self.conv(x)
+
+
+@dataclass
+class UNetConfig:
+    sample_size: int = 64
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attention_dim: int = 768
+    attention_head_dim: int = 8   # heads per attention layer
+    norm_num_groups: int = 32
+    # blocks with cross-attention (SD: all but the last down / first up)
+    down_block_types: Tuple[str, ...] = (
+        "CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+        "CrossAttnDownBlock2D", "DownBlock2D")
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(sample_size=8, in_channels=4, out_channels=4,
+                    block_out_channels=(32, 64), layers_per_block=1,
+                    cross_attention_dim=32, attention_head_dim=2,
+                    norm_num_groups=8,
+                    down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"))
+        base.update(kw)
+        return UNetConfig(**base)
+
+
+class _DownBlock(Layer):
+    def __init__(self, cfg, cin, cout, has_attn, is_last):
+        super().__init__()
+        self.resnets = LayerList([
+            ResnetBlock2D(cin if i == 0 else cout, cout,
+                          cfg.block_out_channels[0] * 4,
+                          cfg.norm_num_groups)
+            for i in range(cfg.layers_per_block)])
+        self.attentions = LayerList([
+            Transformer2DModel(cout, cfg.cross_attention_dim,
+                               cfg.attention_head_dim,
+                               cout // cfg.attention_head_dim,
+                               cfg.norm_num_groups)
+            for _ in range(cfg.layers_per_block)]) if has_attn else None
+        self.downsampler = None if is_last else Downsample2D(cout)
+
+    def forward(self, x, temb, context):
+        skips = []
+        for i, res in enumerate(self.resnets):
+            x = res(x, temb)
+            if self.attentions is not None:
+                x = self.attentions[i](x, context)
+            skips.append(x)
+        if self.downsampler is not None:
+            x = self.downsampler(x)
+            skips.append(x)
+        return x, skips
+
+
+class _UpBlock(Layer):
+    def __init__(self, cfg, cin, cout, skip_channels, has_attn, is_last):
+        """`skip_channels`: per-resnet channel counts of the popped skip
+        connections (known statically from the down-path layout)."""
+        super().__init__()
+        temb_dim = cfg.block_out_channels[0] * 4
+        res, att = [], []
+        for i, sc in enumerate(skip_channels):
+            rin = (cin if i == 0 else cout) + sc
+            res.append(ResnetBlock2D(rin, cout, temb_dim,
+                                     cfg.norm_num_groups))
+            if has_attn:
+                att.append(Transformer2DModel(
+                    cout, cfg.cross_attention_dim, cfg.attention_head_dim,
+                    cout // cfg.attention_head_dim, cfg.norm_num_groups))
+        self.resnets = LayerList(res)
+        self.attentions = LayerList(att) if has_attn else None
+        self.upsampler = None if is_last else Upsample2D(cout)
+
+    def forward(self, x, skips, temb, context):
+        for i, res in enumerate(self.resnets):
+            skip = skips.pop()
+            x = M.concat([x, skip], axis=1)
+            x = res(x, temb)
+            if self.attentions is not None:
+                x = self.attentions[i](x, context)
+        if self.upsampler is not None:
+            x = self.upsampler(x)
+        return x
+
+
+class UNet2DConditionModel(Layer):
+    """ppdiffusers UNet2DConditionModel-shaped denoiser."""
+
+    def __init__(self, config: Optional[UNetConfig] = None, **kwargs):
+        super().__init__()
+        if config is None:
+            config = UNetConfig(**kwargs) if kwargs else UNetConfig.tiny()
+        self.config = config
+        cfg = config
+        ch = cfg.block_out_channels
+        temb_dim = ch[0] * 4
+        self.conv_in = Conv2D(cfg.in_channels, ch[0], 3, padding=1)
+        self.time_embedding = TimestepEmbedding(ch[0], temb_dim)
+
+        downs = []
+        skip_channels = [ch[0]]  # conv_in output
+        cin = ch[0]
+        for i, bt in enumerate(cfg.down_block_types):
+            cout = ch[i]
+            downs.append(_DownBlock(cfg, cin, cout,
+                                    has_attn=(bt == "CrossAttnDownBlock2D"),
+                                    is_last=(i == len(ch) - 1)))
+            skip_channels.extend([cout] * cfg.layers_per_block)
+            if i != len(ch) - 1:
+                skip_channels.append(cout)  # downsampler output
+            cin = cout
+        self.down_blocks = LayerList(downs)
+
+        mid_ch = ch[-1]
+        self.mid_resnet_1 = ResnetBlock2D(mid_ch, mid_ch, temb_dim,
+                                          cfg.norm_num_groups)
+        self.mid_attn = Transformer2DModel(
+            mid_ch, cfg.cross_attention_dim, cfg.attention_head_dim,
+            mid_ch // cfg.attention_head_dim, cfg.norm_num_groups)
+        self.mid_resnet_2 = ResnetBlock2D(mid_ch, mid_ch, temb_dim,
+                                          cfg.norm_num_groups)
+
+        ups = []
+        rev = list(reversed(ch))
+        rev_types = list(reversed(cfg.down_block_types))
+        cin = mid_ch
+        stack = list(skip_channels)  # popped right-to-left by up blocks
+        for i, bt in enumerate(rev_types):
+            cout = rev[i]
+            n_res = cfg.layers_per_block + 1
+            pops = [stack.pop() for _ in range(n_res)]
+            ups.append(_UpBlock(cfg, cin, cout, pops,
+                                has_attn=(bt == "CrossAttnDownBlock2D"),
+                                is_last=(i == len(rev) - 1)))
+            cin = cout
+        self.up_blocks = LayerList(ups)
+
+        self.conv_norm_out = GroupNorm(min(cfg.norm_num_groups, ch[0]), ch[0])
+        self.conv_act = Silu()
+        self.conv_out = Conv2D(ch[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timestep, encoder_hidden_states,
+                return_dict=False):
+        temb = timestep_embedding(
+            _as_t(timestep, sample.shape[0]),
+            self.config.block_out_channels[0])
+        temb = self.time_embedding(temb)
+
+        x = self.conv_in(sample)
+        skips = [x]
+        for down in self.down_blocks:
+            x, s = down(x, temb, encoder_hidden_states)
+            skips.extend(s)
+
+        x = self.mid_resnet_1(x, temb)
+        x = self.mid_attn(x, encoder_hidden_states)
+        x = self.mid_resnet_2(x, temb)
+
+        for up in self.up_blocks:
+            x = up(x, skips, temb, encoder_hidden_states)
+
+        x = self.conv_out(self.conv_act(self.conv_norm_out(x)))
+        if return_dict:
+            from types import SimpleNamespace
+            return SimpleNamespace(sample=x)
+        return x
+
+
+def _as_t(timestep, batch):
+    """Coerce int / 0-d / [B] timestep to a [B] Tensor."""
+    if isinstance(timestep, Tensor):
+        t = timestep
+    else:
+        arr = np.asarray(timestep)
+        t = Tensor(jnp.asarray(arr))
+    if len(t.shape) == 0:
+        t = M.reshape(t, [1])
+        t = M.concat([t] * batch, axis=0) if batch > 1 else t
+    return t

@@ -1,0 +1,166 @@
+"""AutoencoderKL — the SD latent VAE.
+
+Reference parity: ppdiffusers ppdiffusers/models/autoencoder_kl.py +
+vae.py (Encoder/Decoder/DiagonalGaussianDistribution).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Conv2D, GroupNorm, LayerList, Silu
+from ..nn import functional as F
+from ..ops import manipulation as M
+from ..ops import math as OM
+from ..ops._dispatch import apply
+from ..framework.random import next_key
+from .unet import Downsample2D, Upsample2D
+
+
+class _VAEResBlock(Layer):
+    def __init__(self, cin, cout, groups=32):
+        super().__init__()
+        self.norm1 = GroupNorm(min(groups, cin), cin)
+        self.conv1 = Conv2D(cin, cout, 3, padding=1)
+        self.norm2 = GroupNorm(min(groups, cout), cout)
+        self.conv2 = Conv2D(cout, cout, 3, padding=1)
+        self.act = Silu()
+        self.shortcut = Conv2D(cin, cout, 1) if cin != cout else None
+
+    def forward(self, x):
+        h = self.conv1(self.act(self.norm1(x)))
+        h = self.conv2(self.act(self.norm2(h)))
+        if self.shortcut is not None:
+            x = self.shortcut(x)
+        return x + h
+
+
+class DiagonalGaussianDistribution:
+    def __init__(self, parameters, deterministic=False):
+        self.parameters = parameters
+        mean, logvar = M.split(parameters, 2, axis=1)
+        self.mean = mean
+        self.logvar = OM.clip(logvar, -30.0, 20.0)
+        self.deterministic = deterministic
+        self.std = apply(lambda lv: jnp.exp(0.5 * lv), self.logvar)
+
+    def sample(self, key=None):
+        if self.deterministic:
+            return self.mean
+        key = key if key is not None else next_key()
+        noise = apply(
+            lambda m: jax.random.normal(key, m.shape, jnp.float32).astype(
+                m.dtype), self.mean)
+        return self.mean + self.std * noise
+
+    def mode(self):
+        return self.mean
+
+    def kl(self):
+        return apply(
+            lambda m, lv: 0.5 * jnp.sum(
+                jnp.square(m) + jnp.exp(lv) - 1.0 - lv,
+                axis=list(range(1, len(m.shape)))),
+            self.mean, self.logvar)
+
+
+@dataclass
+class VAEConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.18215
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(block_out_channels=(16, 32), layers_per_block=1,
+                    norm_num_groups=8, latent_channels=4)
+        base.update(kw)
+        return VAEConfig(**base)
+
+
+class Encoder(Layer):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        ch = cfg.block_out_channels
+        self.conv_in = Conv2D(cfg.in_channels, ch[0], 3, padding=1)
+        blocks = []
+        cin = ch[0]
+        for i, cout in enumerate(ch):
+            for _ in range(cfg.layers_per_block):
+                blocks.append(_VAEResBlock(cin, cout, cfg.norm_num_groups))
+                cin = cout
+            if i != len(ch) - 1:
+                blocks.append(Downsample2D(cout))
+        self.blocks = LayerList(blocks)
+        self.norm_out = GroupNorm(min(cfg.norm_num_groups, ch[-1]), ch[-1])
+        self.act = Silu()
+        self.conv_out = Conv2D(ch[-1], 2 * cfg.latent_channels, 3, padding=1)
+
+    def forward(self, x):
+        x = self.conv_in(x)
+        for b in self.blocks:
+            x = b(x)
+        return self.conv_out(self.act(self.norm_out(x)))
+
+
+class Decoder(Layer):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        ch = list(reversed(cfg.block_out_channels))
+        self.conv_in = Conv2D(cfg.latent_channels, ch[0], 3, padding=1)
+        blocks = []
+        cin = ch[0]
+        for i, cout in enumerate(ch):
+            for _ in range(cfg.layers_per_block):
+                blocks.append(_VAEResBlock(cin, cout, cfg.norm_num_groups))
+                cin = cout
+            if i != len(ch) - 1:
+                blocks.append(Upsample2D(cout))
+        self.blocks = LayerList(blocks)
+        self.norm_out = GroupNorm(min(cfg.norm_num_groups, ch[-1]), ch[-1])
+        self.act = Silu()
+        self.conv_out = Conv2D(ch[-1], cfg.out_channels, 3, padding=1)
+
+    def forward(self, z):
+        x = self.conv_in(z)
+        for b in self.blocks:
+            x = b(x)
+        return self.conv_out(self.act(self.norm_out(x)))
+
+
+class AutoencoderKL(Layer):
+    """ppdiffusers AutoencoderKL parity (encode/decode/forward)."""
+
+    def __init__(self, config: VAEConfig = None, **kwargs):
+        super().__init__()
+        if config is None:
+            config = VAEConfig(**kwargs) if kwargs else VAEConfig.tiny()
+        self.config = config
+        self.encoder = Encoder(config)
+        self.decoder = Decoder(config)
+        self.quant_conv = Conv2D(2 * config.latent_channels,
+                                 2 * config.latent_channels, 1)
+        self.post_quant_conv = Conv2D(config.latent_channels,
+                                      config.latent_channels, 1)
+
+    def encode(self, x):
+        h = self.quant_conv(self.encoder(x))
+        return DiagonalGaussianDistribution(h)
+
+    def decode(self, z):
+        return self.decoder(self.post_quant_conv(z))
+
+    def forward(self, x, sample_posterior=True):
+        posterior = self.encode(x)
+        z = posterior.sample() if sample_posterior else posterior.mode()
+        return self.decode(z), posterior

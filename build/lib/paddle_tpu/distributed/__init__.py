@@ -1,0 +1,60 @@
+"""paddle.distributed parity namespace (python/paddle/distributed/).
+
+TPU-native architecture (SURVEY.md §2.2/§2.3): the NCCL process-group
+world is replaced by ONE jax.sharding.Mesh with named axes
+('data','stage','context','expert','model'); collectives are compiled XLA
+ops; Fleet strategies are sharding-spec presets on a pjit train step.
+"""
+from .env import (
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_available,
+)
+from .collective import (
+    ReduceOp, Group, all_reduce, all_gather, all_gather_concat,
+    reduce_scatter, broadcast, reduce, alltoall, alltoall_single, send, recv,
+    barrier, scatter, new_group, get_group, is_initialized, ppermute, stream,
+    spmd_region, in_spmd_region,
+)
+from .mesh import (
+    build_mesh, set_mesh, get_mesh, ensure_mesh, mesh_scope, axis_size,
+)
+from .parallel import DataParallel
+from . import fleet
+from .fleet import DistributedStrategy
+from .auto_parallel_api import (
+    ProcessMesh, shard_tensor, shard_op, Shard, Replicate, Partial,
+    dtensor_from_fn, reshard, shard_layer,
+)
+from . import checkpoint
+from .fleet.sharding import group_sharded_parallel, save_group_sharded_model
+
+# paddle.distributed.sharding namespace parity
+from .fleet import sharding
+
+
+def TCPStore(host, port, is_master=False, world_size=1, timeout=90.0):
+    """Native rendezvous KV store (csrc/tcp_store.cc). Parity:
+    paddle.distributed.TCPStore backed by phi's C++ TCPStore."""
+    from .._native import TCPStore as _Store
+    return _Store(host, port, is_master=is_master, world_size=world_size,
+                  timeout=timeout)
+
+
+def get_backend():
+    return "xla"
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """paddle.distributed.parallelize (auto-parallel high-level API)."""
+    from .fleet.fleet_api import distributed_model, distributed_optimizer
+    m = distributed_model(model)
+    if optimizer is None:
+        return m
+    return m, distributed_optimizer(optimizer)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn parity: in single-controller SPMD all local
+    devices belong to THIS process, so spawn degenerates to calling func
+    once (world_size handled by the mesh)."""
+    func(*args)
+    return None

@@ -1,0 +1,218 @@
+"""Auto-parallel API (parity: python/paddle/distributed/auto_parallel/api.py
+— ProcessMesh, shard_tensor with Shard/Replicate/Partial placements,
+reshard). SURVEY.md §2.3: "this *is* GSPMD/pjit" — ProcessMesh maps onto
+jax.sharding.Mesh, placements onto PartitionSpec, reshard onto
+device_put / with_sharding_constraint.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..tensor import Tensor, Parameter
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return "Partial()"
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh → jax Mesh over the listed devices."""
+
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        devices = jax.devices()
+        dev_arr = np.asarray([devices[i % len(devices)]
+                              for i in self._process_ids]).reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+def _placements_to_spec(placements: Sequence[Placement], ndim: int,
+                        mesh: ProcessMesh) -> PartitionSpec:
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[mesh_dim]
+            cur = entries[pl.dim]
+            if cur is None:
+                entries[pl.dim] = name
+            elif isinstance(cur, tuple):
+                entries[pl.dim] = cur + (name,)
+            else:
+                entries[pl.dim] = (cur, name)
+        # Replicate/Partial → no entry (Partial exists only transiently in
+        # XLA's partitioned graphs)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    """paddle.distributed.shard_tensor → device_put with NamedSharding."""
+    t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
+    spec = _placements_to_spec(placements, t.ndim, mesh)
+    sh = NamedSharding(mesh.jax_mesh, spec)
+    new_val = jax.device_put(t._value, sh)
+    if isinstance(t, Parameter):
+        out = t
+        out._value = new_val
+    else:
+        out = Tensor(new_val, stop_gradient=t.stop_gradient
+                     if stop_gradient is None else stop_gradient)
+    out._partition_spec = spec
+    out._process_mesh = mesh
+    out._placements = list(placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """paddle.distributed.reshard — eager: device_put resharding; traced:
+    with_sharding_constraint."""
+    t = _coerce(dist_tensor)
+    spec = _placements_to_spec(placements, t.ndim, mesh)
+    sh = NamedSharding(mesh.jax_mesh, spec)
+    import jax.core as jcore
+    if isinstance(t._value, jcore.Tracer):
+        out = apply(lambda v: jax.lax.with_sharding_constraint(v, sh), t)
+    else:
+        out = Tensor(jax.device_put(t._value, sh),
+                     stop_gradient=t.stop_gradient)
+    out._partition_spec = spec
+    out._process_mesh = mesh
+    out._placements = list(placements)
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """paddle.distributed.shard_layer — apply shard_fn(name, layer,
+    process_mesh) to every sublayer (default: replicate params)."""
+    def default_shard(name, l, mesh):
+        for pname, p in l._parameters.items():
+            if p is not None:
+                sharded = shard_tensor(p, mesh,
+                                       [Replicate()] * len(mesh.shape))
+                l._parameters[pname] = sharded if isinstance(sharded, Parameter) else p
+    fn = shard_fn or default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    return layer
+
+
+def shard_op(op, mesh: ProcessMesh = None, in_placements=None,
+             out_placements=None):
+    def wrapper(*args, **kwargs):
+        out = op(*args, **kwargs)
+        if mesh is not None and out_placements is not None:
+            return reshard(out, mesh, out_placements)
+        return out
+    return wrapper
+
+
+def get_mesh_from_tensor(t):
+    return getattr(t, "_process_mesh", None)

@@ -1,0 +1,101 @@
+"""Distributed checkpoint (parity: python/paddle/distributed/checkpoint/
+save_state_dict.py / load_state_dict.py — per-rank shard files + metadata
+with reshard-on-load).
+
+TPU-native: orbax-checkpoint, which is sharding-aware and reshards on
+load natively (tensorstore-backed, async-capable) — exactly the
+reference's metadata+reslice design, productionized.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+
+from ..tensor import Tensor, Parameter
+
+
+def _to_arrays(state_dict):
+    out = {}
+    for k, v in state_dict.items():
+        if isinstance(v, Tensor):
+            out[k] = v._value
+        elif isinstance(v, dict):
+            out[k] = _to_arrays(v)
+        else:
+            out[k] = v
+    return out
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, async_save: bool = False):
+    """paddle.distributed.save_state_dict → orbax StandardSave."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    arrays = _to_arrays(state_dict)
+    ckptr.save(path, arrays, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, offload: bool = False):
+    """paddle.distributed.load_state_dict — loads INTO the given state dict
+    (tensors keep their current sharding; orbax reshards on read)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    template = _to_arrays(state_dict)
+    restored = ckptr.restore(path, template)
+
+    def write_back(dst, src):
+        for k, v in dst.items():
+            if isinstance(v, Tensor):
+                v._value = src[k]
+            elif isinstance(v, dict):
+                write_back(v, src[k])
+    write_back(state_dict, restored)
+    return state_dict
+
+
+class AsyncCheckpointer:
+    """Async save for the training loop (orbax async API): the device→host
+    copy happens immediately, serialization in background — the elastic
+    restart story's write half (SURVEY.md §5.3/§5.4)."""
+
+    def __init__(self, directory):
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir, options=ocp.CheckpointManagerOptions(
+                max_to_keep=3, enable_async_checkpointing=True))
+
+    def save(self, step: int, state_dict: Dict):
+        import orbax.checkpoint as ocp
+        self._mgr.save(step, args=ocp.args.StandardSave(_to_arrays(state_dict)))
+
+    def restore_latest(self, state_dict: Dict) -> Optional[int]:
+        import orbax.checkpoint as ocp
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_to_arrays(state_dict)))
+
+        def write_back(dst, src):
+            for k, v in dst.items():
+                if isinstance(v, Tensor):
+                    v._value = src[k]
+                elif isinstance(v, dict):
+                    write_back(v, src[k])
+        write_back(state_dict, restored)
+        return step
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()

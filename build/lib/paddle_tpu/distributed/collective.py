@@ -1,0 +1,310 @@
+"""Collective communication facade.
+
+Reference parity: python/paddle/distributed/communication/ (all_reduce,
+all_gather, reduce_scatter, broadcast, alltoall, send/recv, ReduceOp,
+new_group) over ProcessGroupNCCL (paddle/fluid/distributed/collective/
+process_group_nccl.cc).
+
+TPU-native design (SURVEY.md §5.8): collectives are *compiled*, not
+called. Inside an SPMD region (shard_map traced by the hybrid engine) the
+same functions lower to lax.psum/all_gather/psum_scatter/ppermute/
+all_to_all over the mesh axis bound to the group. Outside any SPMD region
+there is a single logical rank per process — the collectives are identity
+(matching single-process Paddle), which keeps user code runnable
+everywhere. Rendezvous/bootstrap (TCPStore) maps to
+jax.distributed.initialize (the coordination service).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group ≈ one mesh axis (or an explicit rank list for
+    API parity; rank lists other than the full axis are rejected at use)."""
+
+    _next_gid = 0
+
+    def __init__(self, ranks=None, axis: Optional[str] = None, pg=None,
+                 name=None):
+        Group._next_gid += 1
+        self.id = Group._next_gid
+        self.ranks = ranks or []
+        self.axis = axis
+        self.name = name or f"group_{self.id}"
+
+    @property
+    def nranks(self):
+        from .mesh import axis_size
+        if self.axis is not None:
+            return axis_size(self.axis)
+        return max(len(self.ranks), 1)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+
+_WORLD = Group(axis="data", name="world")
+
+# Axis-name stack: non-empty while tracing inside an SPMD (shard_map)
+# region. Maps logical group-axis → bound mesh axis name(s).
+_spmd_axes: List[Dict[str, str]] = []
+
+
+@contextlib.contextmanager
+def spmd_region(axis_bindings: Dict[str, str]):
+    """Engine-internal: declare that we are inside shard_map with the given
+    {group_axis: mesh_axis} bindings."""
+    _spmd_axes.append(axis_bindings)
+    try:
+        yield
+    finally:
+        _spmd_axes.pop()
+
+
+def _bound_axis(group: Optional[Group]):
+    if not _spmd_axes:
+        return None
+    bind = _spmd_axes[-1]
+    ax = (group.axis if group is not None else None) or "data"
+    return bind.get(ax)
+
+
+def in_spmd_region() -> bool:
+    return bool(_spmd_axes)
+
+
+def get_group(gid=None):
+    return _WORLD
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis=None):
+    return Group(ranks=ranks, axis=axis)
+
+
+def is_initialized():
+    from . import env
+    return env._initialized
+
+
+# ---------------------------------------------------------------- ops ------
+def _reduce_fn(op):
+    return {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+            ReduceOp.MIN: lax.pmin,
+            ReduceOp.AVG: lambda x, a: lax.pmean(x, a)}[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _bound_axis(group)
+    if ax is None:
+        return tensor  # single logical rank
+    t = _coerce(tensor)
+    out = apply(lambda v: _reduce_fn(op)(v, ax), t)
+    if isinstance(tensor, Tensor):
+        tensor._inplace_update(out)
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _bound_axis(group)
+    t = _coerce(tensor)
+    if ax is None:
+        if isinstance(tensor_list, list):
+            tensor_list.append(t)
+            return tensor_list
+        return t
+    out = apply(lambda v: lax.all_gather(v, ax), t)  # [n, ...]
+    if isinstance(tensor_list, list):
+        from .mesh import axis_size
+        from ..ops.manipulation import unbind
+        parts = unbind(out, axis=0)
+        tensor_list.extend(parts)
+        return tensor_list
+    return out
+
+
+def all_gather_concat(tensor, group=None, axis=0):
+    """all_gather along an existing axis (returns concatenated tensor)."""
+    ax = _bound_axis(group)
+    t = _coerce(tensor)
+    if ax is None:
+        return t
+    return apply(lambda v: lax.all_gather(v, ax, axis=axis, tiled=True), t)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    # paddle signature: reduce_scatter(output, input_list_or_tensor, ...)
+    ax = _bound_axis(group)
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import concat
+        src = concat([_coerce(s) for s in src], axis=0)
+    else:
+        src = _coerce(src)
+    if ax is None:
+        if tensor is not src and isinstance(tensor, Tensor):
+            tensor._inplace_update(src)
+        return tensor
+    out = apply(lambda v: lax.psum_scatter(v, ax, scatter_dimension=0,
+                                           tiled=True), src)
+    if isinstance(tensor, Tensor):
+        tensor._inplace_update(out)
+        return tensor
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _bound_axis(group)
+    if ax is None:
+        return tensor
+    t = _coerce(tensor)
+    # broadcast from root = select root's shard on the axis
+    def fn(v):
+        idx = lax.axis_index(ax)
+        root = lax.psum(jnp.where(idx == src, v, jnp.zeros_like(v)), ax)
+        return root
+    out = apply(fn, t)
+    if isinstance(tensor, Tensor):
+        tensor._inplace_update(out)
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # SPMD: implement as all_reduce (every shard gets the result; the
+    # dst-only semantics are meaningless inside one program)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    ax = _bound_axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        from ..ops.manipulation import stack
+        src = stack([_coerce(t) for t in in_tensor_list], axis=0)
+    else:
+        src = _coerce(in_tensor_list)
+    if ax is None:
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(
+                in_tensor_list if isinstance(in_tensor_list, (list, tuple))
+                else [in_tensor_list])
+            return out_tensor_list
+        return src
+    out = apply(lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                         tiled=False), src)
+    if isinstance(out_tensor_list, list):
+        from ..ops.manipulation import unbind
+        out_tensor_list.extend(unbind(out, axis=0))
+        return out_tensor_list
+    return out
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax = _bound_axis(group)
+    t = _coerce(in_tensor)
+    if ax is None:
+        if isinstance(out_tensor, Tensor):
+            out_tensor._inplace_update(t)
+            return out_tensor
+        return t
+    out = apply(lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                         tiled=True), t)
+    if isinstance(out_tensor, Tensor):
+        out_tensor._inplace_update(out)
+        return out_tensor
+    return out
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv are expressed as ppermute inside the "
+        "pipeline engine (fleet.meta_parallel); eager p2p has no meaning in "
+        "a single-controller SPMD program")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv are expressed as ppermute inside the "
+        "pipeline engine (fleet.meta_parallel)")
+
+
+def ppermute(tensor, perm, group=None):
+    """Collective permute (the p2p primitive for pipelines/ring attention)."""
+    ax = _bound_axis(group)
+    t = _coerce(tensor)
+    if ax is None:
+        return t
+    return apply(lambda v: lax.ppermute(v, ax, perm), t)
+
+
+def barrier(group=None):
+    ax = _bound_axis(group)
+    if ax is None:
+        jnp.zeros(()).block_until_ready()
+        return
+    return None
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _bound_axis(group)
+    if ax is None:
+        if tensor_list:
+            tensor._inplace_update(_coerce(tensor_list[0]))
+        return tensor
+    from ..ops.manipulation import stack
+    stacked = stack([_coerce(t) for t in tensor_list], axis=0)
+
+    def fn(v):
+        idx = lax.axis_index(ax)
+        root_all = lax.psum(jnp.where(lax.axis_index(ax) == src,
+                                      v, jnp.zeros_like(v)), ax)
+        return jnp.take(root_all, idx, axis=0)
+    out = apply(fn, stacked)
+    tensor._inplace_update(out)
+    return tensor
+
+
+def axis_index(group=None):
+    """Rank within the group's SPMD axis (0 outside SPMD regions)."""
+    ax = _bound_axis(group)
+    if ax is None:
+        return Tensor(jnp.zeros((), jnp.int32))
+    return apply(lambda: lax.axis_index(ax))
+
+
+# stream namespace parity (paddle.distributed.stream.all_reduce etc.)
+class stream:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    alltoall = staticmethod(alltoall)
+    scatter = staticmethod(scatter)

@@ -1,0 +1,141 @@
+"""Distributed environment & rendezvous.
+
+Reference parity: python/paddle/distributed/parallel.py
+(init_parallel_env, ParallelEnv, env vars PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_MASTER) with TCPStore bootstrap
+(paddle/phi/core/distributed/store/tcp_store.cc).
+
+TPU-native: one *process per host*, all local chips owned by this
+process; multi-host rendezvous = jax.distributed.initialize (coordination
+service — the TCPStore equivalent). "rank" therefore means *host index*
+for process-level APIs, while device-level parallelism lives in the mesh.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def _env_int(*names, default=0):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return default
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
+    @property
+    def nrings(self):
+        return 1
+
+    local_rank = rank
+    nranks = world_size
+
+
+def get_rank(group=None):
+    """Process (host) index."""
+    if group is not None and getattr(group, "ranks", None):
+        try:
+            return group.get_group_rank(get_rank())
+        except Exception:
+            pass
+    try:
+        return jax.process_index()
+    except Exception:
+        return _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
+
+
+def get_world_size(group=None):
+    """Number of processes (hosts)."""
+    if group is not None and getattr(group, "ranks", None):
+        return len(group.ranks)
+    try:
+        return jax.process_count()
+    except Exception:
+        return _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env — multi-host bootstrap.
+
+    Single-host: nothing to do (all chips already visible). Multi-host
+    (PADDLE_MASTER/PADDLE_TRAINERS_NUM set by the launcher): initialize
+    the jax coordination service so jax.devices() spans the pod.
+    """
+    global _initialized
+    if _initialized:
+        return
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
+    pid = _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
+    if master and nprocs > 1:
+        port = os.environ.get("MASTER_PORT")
+        addr = master if ":" in master else f"{master}:{port or 8476}"
+        _tcp_rendezvous(addr, nprocs, pid)
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nprocs, process_id=pid)
+    _initialized = True
+    from .mesh import ensure_mesh
+    ensure_mesh()
+
+
+def _tcp_rendezvous(addr: str, nprocs: int, pid: int):
+    """Pre-init rendezvous over the native TCPStore (parity: the reference's
+    TCPStore comm-id exchange before ProcessGroup construction). Rank 0
+    hosts the store one port above the coordinator; every rank checks in so
+    misconfigured world sizes fail fast with a clear error instead of a
+    coordination-service hang. Best-effort when the native lib is absent."""
+    try:
+        from .._native import TCPStore, available
+        if not available():
+            return
+        host, port = addr.rsplit(":", 1)
+        store = TCPStore(host, int(port) + 1, is_master=(pid == 0),
+                         world_size=nprocs)
+        store.barrier("init_parallel_env", nprocs)
+        _store_ref[0] = store  # keep alive: server daemon lives on rank 0
+    except Exception as e:  # rendezvous is advisory; jax.distributed decides
+        import logging
+        logging.getLogger(__name__).warning("TCPStore rendezvous skipped: %s",
+                                            e)
+
+
+_store_ref = [None]
+
+
+def is_available():
+    return True
+
+
+def parallel_device_count():
+    return len(jax.devices())

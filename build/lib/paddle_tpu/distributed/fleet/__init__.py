@@ -1,0 +1,25 @@
+"""paddle.distributed.fleet — the hybrid-parallel engine.
+
+Reference parity: python/paddle/distributed/fleet/ (fleet.init with
+DistributedStrategy.hybrid_configs, distributed_model/optimizer,
+HybridCommunicateGroup). TPU-native: all parallelism degrees live on ONE
+jax.sharding.Mesh; `distributed_model` + `distributed_optimizer` wire the
+model into a pjit-compiled train step whose sharding specs encode
+DP/ZeRO-1/2/3/TP/SP (SURVEY.md §2.3 table).
+"""
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import HybridCommunicateGroup, CommunicateTopology
+from .fleet_api import (
+    init, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    worker_index, worker_num, is_first_worker, barrier_worker,
+    DistributedModel, DistributedOptimizer,
+)
+from .dist_step import DistTrainStep
+from .meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, PipelineLayer, LayerDesc, SharedLayerDesc,
+    get_rng_state_tracker,
+)
+from .sharding import group_sharded_parallel
+from .recompute import recompute
+from . import utils

@@ -1,0 +1,59 @@
+"""DistributedStrategy (parity: fleet/base/distributed_strategy.py — the
+protobuf knob bag, here a plain dataclass-style object with the same field
+names; hybrid_configs compiles to mesh degrees)."""
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.fuse_all_reduce_ops = True  # no-op: XLA fuses
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+        self._hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "ep_degree": 1,
+        }
+        self.hybrid_parallel_order = ["dp", "pp", "sep", "ep", "mp"]
+
+    @property
+    def hybrid_configs(self):
+        return self._hybrid_configs
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, cfg):
+        self._hybrid_configs.update(cfg or {})
+
+    # sharding stage convenience (paddle: sharding_configs["stage"])
+    @property
+    def sharding_stage(self):
+        if not self.sharding and self._hybrid_configs.get("sharding_degree", 1) <= 1:
+            return 0
+        return int(self.sharding_configs.get("stage", 1))
+
+    def __repr__(self):
+        return (f"DistributedStrategy(hybrid={self._hybrid_configs}, "
+                f"sharding_stage={self.sharding_stage}, "
+                f"recompute={self.recompute}, amp={self.amp})")

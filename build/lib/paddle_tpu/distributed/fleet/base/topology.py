@@ -1,0 +1,170 @@
+"""HybridCommunicateGroup (parity: fleet/base/topology.py).
+
+In the reference, CommunicateTopology lays ranks out on a logical
+[dp, pp, sep, ep, mp] grid and builds one NCCL group per orthogonal slice.
+Here the same grid IS the jax Mesh; a "communication group" is a mesh
+axis handle (collective.Group bound to an axis name), and rank-in-group
+queries answer from the caller's position — which, in single-controller
+SPMD, is only meaningful inside shard_map (lax.axis_index) and defaults
+to 0 outside.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...mesh import build_mesh, set_mesh, AXES
+from ...collective import Group
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    get_dim_size = get_dim
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology=None, strategy=None):
+        if strategy is not None:
+            cfg = strategy.hybrid_configs
+            self._dp_degree = cfg.get("dp_degree", 1)
+            self._mp_degree = cfg.get("mp_degree", 1)
+            self._pp_degree = cfg.get("pp_degree", 1)
+            self._sharding_degree = cfg.get("sharding_degree", 1)
+            self._sep_degree = cfg.get("sep_degree", 1)
+            self._ep_degree = cfg.get("ep_degree", 1)
+        elif topology is not None:
+            t = topology
+            self._dp_degree = t.get_dim("data")
+            self._mp_degree = t.get_dim("model")
+            self._pp_degree = t.get_dim("pipe")
+            self._sharding_degree = (t.get_dim("sharding")
+                                     if "sharding" in t.get_hybrid_group_names()
+                                     else 1)
+            self._sep_degree = (t.get_dim("sep")
+                                if "sep" in t.get_hybrid_group_names() else 1)
+            self._ep_degree = 1
+        else:
+            self._dp_degree = self._mp_degree = self._pp_degree = 1
+            self._sharding_degree = self._sep_degree = self._ep_degree = 1
+
+        # ZeRO sharding rides the data axis (sharding_degree merges into dp
+        # for mesh purposes; the stage decides state placement)
+        dp_total = self._dp_degree * self._sharding_degree
+        self.mesh = build_mesh(dp=dp_total, pp=self._pp_degree,
+                               cp=self._sep_degree, ep=self._ep_degree,
+                               mp=self._mp_degree)
+        set_mesh(self.mesh)
+
+        self._dp_group = Group(axis="data", name="dp_group")
+        self._mp_group = Group(axis="model", name="mp_group")
+        self._pp_group = Group(axis="stage", name="pp_group")
+        self._sharding_group = Group(axis="data", name="sharding_group")
+        self._sep_group = Group(axis="context", name="sep_group")
+        self._ep_group = Group(axis="expert", name="ep_group")
+
+    @property
+    def nranks(self):
+        return int(np.prod([self._dp_degree, self._sharding_degree,
+                            self._mp_degree, self._pp_degree,
+                            self._sep_degree, self._ep_degree]))
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._mp_degree > 1:
+            return "tensor"
+        if self._sharding_degree > 1:
+            return "sharding"
+        return "data"
+
+    # ---- degrees -------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
+    # ---- ranks (meaningful inside shard_map; 0 otherwise) --------------
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # ---- groups --------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._mp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline helpers
+    def is_first_stage(self):
+        return True
+
+    def is_last_stage(self):
+        return True
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return CommunicateTopology(
+            ("data", "pipe", "sep", "ep", "model"),
+            (self._dp_degree * self._sharding_degree, self._pp_degree,
+             self._sep_degree, self._ep_degree, self._mp_degree))

@@ -1,0 +1,225 @@
+"""DistTrainStep — the hybrid-parallel compiled train step.
+
+This is the TPU-native core of Fleet (SURVEY.md §2.3 "hybrid composition"):
+one pjit-compiled program whose sharding specs encode the strategy.
+
+    DP          batch sharded P('data'); grad psum inserted by XLA
+    ZeRO-1/2    opt state sharded over 'data' (XLA sharded weight update)
+    ZeRO-3      params sharded over 'data' (FSDP allgather by XLA)
+    TP/SP       params tagged by mp_layers with P(..., 'model') + activation
+                constraints inside the layers
+    recompute   jax.checkpoint inside the model (fleet.recompute)
+
+Pipeline ('stage' axis) lives in PipelineTrainStep below: a shard_map over
+the stage axis with ppermute handoff, differentiated by jax.grad.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...tensor import Tensor
+from ...framework.random import default_generator
+from ..mesh import get_mesh, ensure_mesh, mesh_scope, axis_size
+from ...jit.bridge import _clip_grads_functional
+
+
+def _partition_spec_for(p, stage3: bool, mesh: Mesh):
+    """Final NamedSharding for a parameter: layer-tagged TP spec, plus
+    ZeRO-3 'data' sharding on the first still-replicated, divisible dim."""
+    base = list(getattr(p, "_partition_spec", PartitionSpec()) or ())
+    shape = tuple(p._value.shape)
+    base = base + [None] * (len(shape) - len(base))
+    if stage3:
+        dsize = mesh.shape["data"]
+        if dsize > 1:
+            for i, (dim, cur) in enumerate(zip(shape, base)):
+                if cur is None and dim % dsize == 0 and dim >= dsize:
+                    base[i] = "data"
+                    break
+    # drop axes absent from mesh or of size 1 (cleaner HLO)
+    spec = [s if (s is None or mesh.shape.get(s, 1) > 1) else None
+            for s in base]
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def _opt_state_sharding(p_sharding, state_leaf_shape, stage, mesh,
+                        param_shape):
+    """Opt-state leaves mirror the param sharding; with ZeRO>=1 also shard
+    over 'data' if the param itself isn't."""
+    spec = list(p_sharding.spec) + [None] * (len(state_leaf_shape)
+                                             - len(p_sharding.spec))
+    if tuple(state_leaf_shape) != tuple(param_shape):
+        # scalar step counters etc. — replicate
+        return NamedSharding(mesh, PartitionSpec())
+    if stage >= 1 and "data" not in spec:
+        dsize = mesh.shape["data"]
+        for i, (dim, cur) in enumerate(zip(state_leaf_shape, spec)):
+            if cur is None and dsize > 1 and dim % dsize == 0 and dim >= dsize:
+                spec[i] = "data"
+                break
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+class DistTrainStep:
+    """Compiled hybrid-parallel train step (DP/ZeRO/TP/SP composition).
+
+    loss_fn(model_out, *labels) -> scalar. Batch dim 0 is sharded over
+    'data'. Returns the (replicated) loss as a Tensor; model params,
+    buffers and optimizer state stay device-sharded between steps.
+    """
+
+    def __init__(self, model, optimizer, loss_fn: Callable,
+                 n_model_inputs: int = 1, sharding_stage: Optional[int] = None,
+                 mesh: Optional[Mesh] = None, batch_specs=None,
+                 donate_state: bool = True):
+        self._model = model
+        self._opt = optimizer
+        self._loss_fn = loss_fn
+        self._n_in = n_model_inputs
+        self._mesh = mesh or ensure_mesh()
+        stage = sharding_stage
+        if stage is None:
+            stage = getattr(model, "_sharding_stage", None)
+        if stage is None:
+            stage = getattr(optimizer, "_sharding_stage", 0) or 0
+        self._stage = int(stage)
+        self._batch_specs = batch_specs
+        self._donate = donate_state
+
+        self._named_p = [(n, p) for n, p in model.named_parameters()
+                         if not p.stop_gradient]
+        self._named_b = [(n, b) for n, b in model.named_buffers()]
+        self._p = [p for _, p in self._named_p]
+        self._b = [b for _, b in self._named_b]
+        self._p_names = [n for n, _ in self._named_p]
+
+        mesh_ = self._mesh
+        self._p_sh = [_partition_spec_for(p, self._stage >= 3, mesh_)
+                      for p in self._p]
+        self._b_sh = [NamedSharding(mesh_, PartitionSpec()) for _ in self._b]
+
+        # init + place opt state with its shardings
+        raw_state = optimizer._fn_init_all([p._value for p in self._p],
+                                           self._p_names, self._p)
+        self._s_sh = []
+        placed_state = []
+        for p, psh, st in zip(self._p, self._p_sh, raw_state):
+            leaf_sh = {k: _opt_state_sharding(psh, v.shape, self._stage,
+                                              mesh_, p._value.shape)
+                       for k, v in (st.items() if isinstance(st, dict) else [])}
+            if isinstance(st, dict):
+                placed_state.append({k: jax.device_put(v, leaf_sh[k])
+                                     for k, v in st.items()})
+                self._s_sh.append(leaf_sh)
+            else:
+                placed_state.append(st)
+                self._s_sh.append(NamedSharding(mesh_, PartitionSpec()))
+        self._opt_state = placed_state
+
+        # place params/buffers
+        for p, sh in zip(self._p, self._p_sh):
+            p._value = jax.device_put(p._value, sh)
+        for b, sh in zip(self._b, self._b_sh):
+            b._value = jax.device_put(b._value, sh)
+
+        self._compiled = {}
+
+    # ------------------------------------------------------------------
+    def _batch_shardings(self, arrays):
+        mesh_ = self._mesh
+        if self._batch_specs is not None:
+            return [NamedSharding(mesh_, s) for s in self._batch_specs]
+        out = []
+        for a in arrays:
+            spec = [None] * a.ndim
+            if a.ndim >= 1 and mesh_.shape["data"] > 1 \
+                    and a.shape[0] % mesh_.shape["data"] == 0:
+                spec[0] = "data"
+            out.append(NamedSharding(mesh_, PartitionSpec(*spec)))
+        return out
+
+    def _build(self, batch_sh):
+        model = self._model
+        loss_fn = self._loss_fn
+        opt = self._opt
+        p_tensors = self._p
+        b_tensors = self._b
+        p_names = self._p_names
+        n_in = self._n_in
+        grad_clip = opt._grad_clip
+        mesh_ = self._mesh
+        repl = NamedSharding(mesh_, PartitionSpec())
+
+        def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch):
+            gen = default_generator()
+            model_in = batch[:n_in]
+            labels = batch[n_in:]
+
+            def loss_of(pv):
+                old_key = gen._key
+                olds = [t._value for t in p_tensors + b_tensors]
+                gen._key = rng_key
+                for t, v in zip(p_tensors, pv):
+                    t._value = v
+                for t, v in zip(b_tensors, b_vals):
+                    t._value = v
+                try:
+                    outs = model(*[Tensor(a) for a in model_in])
+                    outs = outs if isinstance(outs, tuple) else (outs,)
+                    loss = loss_fn(*outs, *[Tensor(a) for a in labels])
+                    new_b = [t._value for t in b_tensors]
+                    return loss._value, (new_b, gen._key)
+                finally:
+                    for t, v in zip(p_tensors + b_tensors, olds):
+                        t._value = v
+                    gen._key = old_key
+
+            (loss_val, (new_b, new_key)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(p_vals))
+            grads = _clip_grads_functional(grads, grad_clip)
+            new_p, new_state = opt._fn_apply_all(
+                list(p_vals), grads, opt_state, lr, p_names, p_tensors)
+            return loss_val, new_p, new_b, new_state, new_key
+
+        donate = (0, 1, 2) if self._donate else ()
+        jitted = jax.jit(
+            step_fn,
+            in_shardings=(self._p_sh, self._b_sh, self._s_sh, None, None,
+                          batch_sh),
+            out_shardings=(repl, self._p_sh, self._b_sh, self._s_sh, None),
+            donate_argnums=donate)
+
+        def run(p_vals, b_vals, opt_state, key, lr, arrays):
+            with mesh_scope(mesh_):
+                return jitted(p_vals, b_vals, opt_state, key, lr, arrays)
+        return run
+
+    @property
+    def opt_state(self):
+        return self._opt_state
+
+    def __call__(self, *batch):
+        arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        if sig not in self._compiled:
+            self._compiled[sig] = self._build(self._batch_shardings(arrays))
+        gen = default_generator()
+        key_in = gen.split()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        loss, new_p, new_b, new_state, _ = self._compiled[sig](
+            [p._value for p in self._p], [b._value for b in self._b],
+            self._opt_state, key_in, lr, arrays)
+        for t, v in zip(self._p, new_p):
+            t._value = v
+        for t, v in zip(self._b, new_b):
+            t._value = v
+        self._opt_state = new_state
+        self._opt._fn_sync_to_accumulators(self._p, new_state)
+        return Tensor(loss)

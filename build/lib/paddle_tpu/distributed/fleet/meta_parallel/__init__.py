@@ -1,0 +1,5 @@
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding, ParallelCrossEntropy)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc
+from .pipeline_parallel import PipelineTrainStep, pipeline_spmd
+from .random_ import get_rng_state_tracker, model_parallel_random_seed

@@ -1,0 +1,209 @@
+"""Tensor-parallel layers.
+
+Reference parity: fleet/meta_parallel/parallel_layers/mp_layers.py
+(ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+ParallelCrossEntropy) + fleet/layers/mpu/mp_ops.py (identity-fwd/
+allreduce-bwd ops).
+
+TPU-native design (GSPMD): each layer holds the FULL logical weight
+tagged with a PartitionSpec (`param._partition_spec`); forward computes
+the plain math and applies `with_sharding_constraint` on activations.
+Under the pjit-compiled step XLA partitions the matmuls over the 'model'
+axis and inserts the all-reduces the reference codes by hand — same
+communication pattern (column: none fwd / allreduce bwd; row: allreduce
+fwd), chosen by the compiler. Eagerly (single device) they degrade to
+plain Linear/Embedding, so checkpoints are full-size and topology-free.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec, NamedSharding
+
+from ....tensor import Tensor
+from ....nn.layer_base import Layer
+from ....nn.initializer import XavierUniform, Normal, Constant
+from ....nn import functional as F
+from ....ops._dispatch import apply
+from ....ops.creation import _coerce
+from ...mesh import get_mesh, axis_size
+
+
+def _constraint_sharding(mesh, *spec):
+    """NamedSharding for an activation constraint. Inside a (partially)
+    manual shard_map region — e.g. the pipeline's 'stage' axis — the
+    constraint must be built against the current *abstract* mesh, whose
+    axis types record which axes are manual; the concrete mesh's types
+    would be rejected there."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty:
+            return NamedSharding(am, PartitionSpec(*spec))
+    except Exception:
+        pass
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def _constrain(x, *spec):
+    """Apply a sharding constraint if a multi-device mesh is active."""
+    mesh = get_mesh()
+    if mesh is None or axis_size("model", mesh) <= 1:
+        return x
+    sh = _constraint_sharding(mesh, *spec)
+    return apply(lambda v: jax.lax.with_sharding_constraint(v, sh), _coerce(x))
+
+
+def mark_partition(param, *spec):
+    param._partition_spec = PartitionSpec(*spec)
+    return param
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = axis_size("model") > 1
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        mark_partition(self.weight, None, "model")
+        has_bias = True if has_bias is None else has_bias
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+        if self.bias is not None:
+            mark_partition(self.bias, "model")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(out, *([None] * out.ndim))
+        return _constrain(out, *([None] * (out.ndim - 1)), "model")
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = axis_size("model") > 1
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        mark_partition(self.weight, "model", None)
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+        # bias replicated (applied once after the reduce)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain(x, *([None] * (_coerce(x).ndim - 1)), "model")
+        out = F.linear(x, self.weight, None)
+        out = _constrain(out, *([None] * out.ndim))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0))
+        mark_partition(self.weight, "model", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, *([None] * out.ndim))
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE over vocab-sharded logits (reference computes the partial
+    max/sum per shard + allreduce; XLA derives the same from the sharding)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        inp = _constrain(input, *([None] * (_coerce(input).ndim - 1)), "model")
+        return F.cross_entropy(inp, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def parallel_matmul(x, weight, transpose_y=False, tensor_parallel_output=True):
+    """Helper used by LLM heads (lm_head matmul against vocab-sharded
+    embedding weight)."""
+    from ....ops.linalg import matmul
+    out = matmul(x, weight, transpose_y=transpose_y)
+    if tensor_parallel_output:
+        return _constrain(out, *([None] * (out.ndim - 1)), "model")
+    return _constrain(out, *([None] * out.ndim))
+
+
+# ---------------------------------------------------------------------------
+# Megatron sequence-parallel helpers
+# (parity: fleet/utils/sequence_parallel_utils.py)
+# ---------------------------------------------------------------------------
+
+def _seq_constrain(x, seq_axis=1, shard=True):
+    mesh = get_mesh()
+    if mesh is None or axis_size("model", mesh) <= 1:
+        return _coerce(x)
+    nd = _coerce(x).ndim
+    spec = [None] * nd
+    if shard:
+        spec[seq_axis] = "model"
+    sh = _constraint_sharding(mesh, *spec)
+    return apply(lambda v: jax.lax.with_sharding_constraint(v, sh), _coerce(x))
+
+
+class ScatterOp:
+    """Shard activations along the sequence dim across the TP group."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        return _seq_constrain(x, seq_axis=axis, shard=True)
+
+
+class GatherOp:
+    """Re-replicate activations along the sequence dim."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        return _seq_constrain(x, seq_axis=axis, shard=False)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp(ScatterOp):
+    pass
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param._sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    def forward(self, x):
+        x = GatherOp.apply(x)  # gather seq before the column matmul
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    def forward(self, x):
+        out = super().forward(x)
+        return ScatterOp.apply(out)  # scatter seq after the row matmul

@@ -1,0 +1,23 @@
+"""RNG state tracker (parity: fleet/meta_parallel/parallel_layers/random.py).
+
+TP-local vs global randomness: dropout inside TP regions must differ per
+model-parallel shard while data-side randomness matches. The tracker keeps
+named key streams over the functional PRNG (framework.random)."""
+from __future__ import annotations
+
+from ....framework.random import get_rng_state_tracker as _global_tracker
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+def get_rng_state_tracker():
+    return _global_tracker()
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    s = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    tracker.add("global_seed", s)
+    tracker.add(MODEL_PARALLEL_RNG, s + 1024)

@@ -1,0 +1,89 @@
+"""Activation recompute (parity: fleet/recompute/recompute.py —
+paddle.distributed.fleet.utils.recompute with RNG-state preservation).
+
+TPU-native: jax.checkpoint (rematerialization) applied to the layer's
+pure function. RNG preservation falls out of the functional PRNG: the
+recomputed forward replays the same key. Works eagerly (wrapped through
+the tape) and under the jitted train step (where it becomes XLA remat —
+the real memory saver for long context, SURVEY.md §5.7)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ...tensor import Tensor
+from ...ops._dispatch import apply
+from ...ops.creation import _coerce
+from ...framework.random import default_generator
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute(fn, *args).
+
+    If `function` is a bound Layer method (the usual `layer.forward` /
+    `layer.__call__` case), the layer's parameters are lifted to explicit
+    tape inputs so gradients flow to them through the checkpointed region.
+    """
+    from ...nn.layer_base import Layer
+
+    kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+    n_args = len(tensor_args)
+    gen = default_generator()
+
+    owner = getattr(function, "__self__", None)
+    if not isinstance(owner, Layer):
+        owner = function if isinstance(function, Layer) else None
+    params = list(owner.parameters()) if owner is not None else []
+
+    @jax.checkpoint
+    def inner(key, arg_arrays, p_arrays):
+        old = gen._key
+        old_p = [p._value for p in params]
+        gen._key = key
+        for p, v in zip(params, p_arrays):
+            p._value = v
+        try:
+            it = iter(arg_arrays)
+            oi = dict(other)
+            full = [oi[i] if i in oi else Tensor(next(it))
+                    for i in range(len(args))]
+            out = function(*full, **kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(t._value for t in outs)
+        finally:
+            gen._key = old
+            for p, v in zip(params, old_p):
+                p._value = v
+
+    key = gen.split() if preserve_rng_state else gen._key
+    res = apply(lambda *arrs: inner(key, list(arrs[:n_args]),
+                                    list(arrs[n_args:])),
+                *tensor_args, *params, _name="recompute")
+    if isinstance(res, tuple) and len(res) == 1:
+        return res[0]
+    return res
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg = max(len(funcs) // max(segments, 1), 1)
+    out = args
+    i = 0
+    while i < len(funcs):
+        chunk = funcs[i:i + seg]
+
+        def run(*xs, _chunk=chunk):
+            y = xs
+            for f in _chunk:
+                y = f(*y) if isinstance(y, tuple) else f(y)
+                y = y if isinstance(y, tuple) else (y,)
+            return y if len(y) > 1 else y[0]
+        out = recompute(run, *(out if isinstance(out, tuple) else (out,)))
+        out = out if isinstance(out, tuple) else (out,)
+        i += seg
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,45 @@
+"""ZeRO group-sharded presets.
+
+Reference parity: fleet/meta_parallel/sharding/group_sharded_*.py and
+python/paddle/distributed/sharding/group_sharded.py
+(group_sharded_parallel(model, optimizer, scaler, level)).
+
+TPU-native (SURVEY.md §2.3): each ZeRO stage is a *sharding-spec preset*
+consumed by DistTrainStep — XLA's sharded weight-update transformation
+does what DygraphShardingOptimizer / GroupShardedStage2/3 do by hand:
+
+    stage 1 ("os")      optimizer state sharded over 'data'
+    stage 2 ("os_g")    + gradients reduce-scattered over 'data'
+    stage 3 ("p_g_os")  + parameters sharded over 'data' (FSDP)
+"""
+from __future__ import annotations
+
+_LEVEL_TO_STAGE = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def group_sharded_parallel(model, optimizer, level="os", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Tag model+optimizer with the sharding stage; the stage takes effect
+    when the pair is compiled by DistTrainStep / fleet.distributed_model."""
+    if level not in _LEVEL_TO_STAGE:
+        raise ValueError(f"level must be one of {list(_LEVEL_TO_STAGE)}")
+    stage = _LEVEL_TO_STAGE[level]
+    model._sharding_stage = stage
+    optimizer._sharding_stage = stage
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Parity: saves the FULL (auto-gathered) state dict — with GSPMD the
+    live state_dict already holds full logical tensors."""
+    from ...framework_io import save
+    import os
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

@@ -1,0 +1,13 @@
+"""Parity module path: fleet/utils/sequence_parallel_utils.py."""
+from ..meta_parallel.mp_layers import (  # noqa: F401
+    ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp,
+    mark_as_sequence_parallel_parameter,
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op on TPU: the grads of sequence-parallel params are produced
+    correctly by XLA from the sharding specs (no manual hook needed)."""
+    return model

@@ -1,0 +1,17 @@
+"""paddle.distributed.launch — multi-process/multi-host job launcher.
+
+Reference parity: python/paddle/distributed/launch/ (__main__.py, context,
+controllers/collective.py, elastic manager). The controller spawns
+nproc-per-node worker processes with the rank environment
+(PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_MASTER, ...), tees per-rank
+logs to log_dir/workerlog.N, watches children, and in elastic mode restarts
+the pod from the latest checkpoint on failure (restart-based recovery — the
+same model TPU preemption uses; SURVEY.md §5.3).
+
+TPU-native: on real TPU pods it launches ONE process per host (libtpu owns
+all local chips; jax.distributed.initialize handles the mesh); the
+nproc-per-node>1 path exists for CPU-mesh testing and GPU-style topologies.
+Heartbeat/membership goes through the native TCPStore (csrc/tcp_store.cc)
+instead of etcd.
+"""
+from .main import launch, main  # noqa: F401

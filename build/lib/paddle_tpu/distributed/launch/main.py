@@ -1,0 +1,267 @@
+"""Launcher implementation: context, collective controller, elastic loop.
+
+Parity map (reference → here):
+  launch/context/__init__.py  → Context (arg parsing, env snapshot)
+  launch/controllers/collective.py::CollectiveController → PodController
+  fleet/elastic/manager.py    → ElasticManager (TCPStore heartbeats, not etcd)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Context:
+    nnodes: int = 1
+    node_rank: int = 0
+    nproc_per_node: int = 1
+    master: Optional[str] = None        # host:port
+    job_id: str = "default"
+    log_dir: str = "log"
+    devices: Optional[str] = None
+    max_restart: int = 3
+    elastic_timeout_s: float = 30.0
+    script: str = ""
+    script_args: List[str] = field(default_factory=list)
+    run_mode: str = "collective"
+
+    @property
+    def world_size(self) -> int:
+        return self.nnodes * self.nproc_per_node
+
+
+def parse_args(argv=None) -> Context:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed training job.")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count; N or MIN:MAX for elastic")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="processes per node (default: 1 — one jax process "
+                        "per TPU host)")
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER"),
+                   help="host:port of rank-0 coordinator")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="visible accelerator ids for this pod")
+    p.add_argument("--max_restart", type=int, default=3,
+                   help="elastic: max pod restarts on failure")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    a = p.parse_args(argv)
+
+    nnodes = a.nnodes.split(":")[0]  # MIN of MIN:MAX (elastic range)
+    return Context(
+        nnodes=int(nnodes), node_rank=a.node_rank,
+        nproc_per_node=a.nproc_per_node or 1, master=a.master,
+        job_id=a.job_id, log_dir=a.log_dir, devices=a.devices,
+        max_restart=a.max_restart, script=a.script,
+        script_args=a.script_args)
+
+
+class PodController:
+    """Spawns and babysits this node's worker processes (one 'pod')."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.procs: List[subprocess.Popen] = []
+        self.logs = []
+
+    def _rank_env(self, local_rank: int, restart_epoch: int) -> dict:
+        ctx = self.ctx
+        rank = ctx.node_rank * ctx.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "RANK": str(rank),
+            "PADDLE_TRAINERS_NUM": str(ctx.world_size),
+            "WORLD_SIZE": str(ctx.world_size),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "LOCAL_RANK": str(local_rank),
+            "PADDLE_JOB_ID": ctx.job_id,
+            "PADDLE_RESTART_EPOCH": str(restart_epoch),
+        })
+        if ctx.master:
+            env["PADDLE_MASTER"] = ctx.master
+            host, port = ctx.master.rsplit(":", 1)
+            env.setdefault("MASTER_ADDR", host)
+            env.setdefault("MASTER_PORT", port)
+        if ctx.devices is not None:
+            # parity with FLAGS_selected_gpus; on TPU selects chip subsets
+            env["FLAGS_selected_devices"] = ctx.devices
+            env["TPU_VISIBLE_DEVICES"] = ctx.devices
+        return env
+
+    def start(self, restart_epoch: int = 0):
+        ctx = self.ctx
+        os.makedirs(ctx.log_dir, exist_ok=True)
+        self.procs, self.logs = [], []
+        for lr in range(ctx.nproc_per_node):
+            log_path = os.path.join(ctx.log_dir, f"workerlog.{lr}")
+            logf = open(log_path, "ab")
+            cmd = [sys.executable, "-u", ctx.script] + ctx.script_args
+            proc = subprocess.Popen(cmd, env=self._rank_env(lr,
+                                                            restart_epoch),
+                                    stdout=logf, stderr=subprocess.STDOUT)
+            self.procs.append(proc)
+            self.logs.append(logf)
+
+    def poll(self) -> Optional[int]:
+        """None while all alive; else the first non-None returncode
+        (0 only when ALL exited 0)."""
+        codes = [p.poll() for p in self.procs]
+        if any(c not in (0, None) for c in codes):
+            return next(c for c in codes if c not in (0, None))
+        if all(c == 0 for c in codes):
+            return 0
+        return None
+
+    def stop(self, sig=signal.SIGTERM, grace_s: float = 10.0):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + grace_s
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for f in self.logs:
+            f.close()
+
+    def tail_logs(self, n: int = 20):
+        for lr in range(len(self.procs)):
+            path = os.path.join(self.ctx.log_dir, f"workerlog.{lr}")
+            try:
+                with open(path, "rb") as f:
+                    lines = f.read().decode(errors="replace").splitlines()
+                for line in lines[-n:]:
+                    print(f"[rank {lr}] {line}", file=sys.stderr)
+            except OSError:
+                pass
+
+
+class ElasticManager:
+    """Pod membership + heartbeat over TCPStore (parity: etcd-based
+    fleet/elastic/manager.py). Node 0 hosts the store next to the master
+    port; each pod registers and heartbeats; a missed heartbeat or child
+    failure triggers a pod-wide restart (from the user's checkpoint)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.store = None
+        if ctx.master and ctx.nnodes > 1:
+            from ..._native import TCPStore, available
+            if available():
+                host, port = ctx.master.rsplit(":", 1)
+                self.store = TCPStore(host, int(port) + 2,
+                                      is_master=(ctx.node_rank == 0),
+                                      world_size=ctx.nnodes)
+
+    def register(self, epoch: int):
+        if self.store:
+            self.store.set(f"elastic/{self.ctx.job_id}/pod{self.ctx.node_rank}",
+                           str(epoch))
+            self.store.barrier(f"epoch{epoch}", self.ctx.nnodes)
+
+    def heartbeat(self):
+        if self.store:
+            self.store.add(
+                f"elastic/{self.ctx.job_id}/hb{self.ctx.node_rank}", 1)
+
+    # -- pod-wide restart coordination ----------------------------------
+    # A failed node raises a per-epoch restart flag; healthy nodes poll
+    # it and tear down their (still running) pods so every node advances
+    # to epoch+1 and re-enters the barrier together. Without this
+    # broadcast, only the failed node would loop and the barrier would
+    # hang. The flag is an add()-based counter keyed BY epoch, so
+    # concurrent failures in the same epoch are idempotent (any value
+    # > 0 means "everyone moves to epoch+1") — no read-modify-write race.
+    def _req_key(self, epoch: int):
+        return f"elastic/{self.ctx.job_id}/restart_req/{epoch}"
+
+    def restart_requested(self, epoch: int) -> bool:
+        if not self.store:
+            return False
+        return self.store.add(self._req_key(epoch), 0) > 0
+
+    def request_restart(self, epoch: int):
+        if self.store:
+            self.store.add(self._req_key(epoch), 1)
+
+    def close(self):
+        if self.store:
+            self.store.close()
+
+
+def launch(ctx: Context) -> int:
+    """Run the pod until success, failure, or restart budget exhausted."""
+    elastic = ElasticManager(ctx)
+    rc = 1
+    epoch = 0
+    restarts = 0
+    try:
+        while True:
+            elastic.register(epoch)
+            pod = PodController(ctx)
+            pod.start(restart_epoch=epoch)
+            peer_restart = False
+            try:
+                while True:
+                    rc = pod.poll()
+                    if rc is not None:
+                        break
+                    if elastic.restart_requested(epoch):
+                        peer_restart = True
+                        break
+                    elastic.heartbeat()
+                    time.sleep(0.2)
+            except KeyboardInterrupt:
+                pod.stop(signal.SIGINT)
+                return 130
+            if not peer_restart and rc == 0:
+                # success is only final if no peer failed concurrently —
+                # otherwise join the restart so the peers' epoch barrier
+                # (and, on node 0, the store we host) stays alive
+                if not elastic.restart_requested(epoch):
+                    return 0
+                peer_restart = True
+            restarts += 1  # counted identically on every node
+            if peer_restart:
+                print("[launch] peer pod failed, joining pod-wide restart "
+                      f"{restarts}/{ctx.max_restart}", file=sys.stderr)
+            else:
+                print(f"[launch] pod failed (exit {rc}), restart "
+                      f"{restarts}/{ctx.max_restart}", file=sys.stderr)
+                pod.tail_logs()
+                elastic.request_restart(epoch)
+            pod.stop()
+            if restarts > ctx.max_restart:
+                break
+            epoch += 1
+        return rc if rc is not None else 1
+    finally:
+        elastic.close()
+
+
+def main(argv=None) -> int:
+    ctx = parse_args(argv)
+    code = launch(ctx)
+    if argv is None:
+        sys.exit(code)
+    return code

@@ -1,0 +1,96 @@
+"""Device mesh management — the TPU-native replacement for Fleet's rank
+topology (fleet/base/topology.py HybridCommunicateGroup builds orthogonal
+dp/mp/pp/sharding/sep process groups from ranks; here the same topology is
+ONE `jax.sharding.Mesh` with named axes, per SURVEY.md §7: composition of
+parallelisms = axis assignment).
+
+Axis names (canonical order, outer→inner):
+    'data'    — data parallel / ZeRO sharding domain
+    'stage'   — pipeline stages
+    'context' — sequence/context parallel (ring attention, Ulysses; "sep")
+    'expert'  — MoE expert parallel
+    'model'   — tensor/sequence(Megatron) parallel, innermost so TP
+                collectives ride the fastest ICI links
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES = ("data", "stage", "context", "expert", "model")
+
+_current_mesh: Optional[Mesh] = None
+
+
+def build_mesh(dp: int = 1, pp: int = 1, cp: int = 1, ep: int = 1,
+               mp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the hybrid mesh. Degrees must multiply to the device count
+    (a trailing -1 degree is inferred)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    degrees = {"data": dp, "stage": pp, "context": cp, "expert": ep,
+               "model": mp}
+    # infer a single -1
+    unknown = [k for k, v in degrees.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one degree may be -1")
+    prod = int(np.prod([v for v in degrees.values() if v != -1]))
+    if unknown:
+        if n % prod:
+            raise ValueError(f"cannot infer {unknown[0]}: {n} % {prod} != 0")
+        degrees[unknown[0]] = n // prod
+        prod = n
+    if prod > n or n % prod:
+        raise ValueError(
+            f"mesh degrees {degrees} multiply to {prod}, but {n} devices")
+    # sub-mesh over the first `prod` devices is allowed (e.g. single-device
+    # reference runs on a multi-device host)
+    arr = np.asarray(devices[:prod]).reshape([degrees[a] for a in AXES])
+    return Mesh(arr, AXES)
+
+
+def set_mesh(mesh: Mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def ensure_mesh() -> Mesh:
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = build_mesh(dp=-1)
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    global _current_mesh
+    prev = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = prev
+
+
+def axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
+    m = mesh or get_mesh()
+    if m is None or name not in m.axis_names:
+        return 1
+    return m.shape[name]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,47 @@
+"""DataParallel wrapper (parity: python/paddle/parallel.py::DataParallel
+with EagerReducer bucketed allreduce in
+paddle/fluid/distributed/collective/reducer.cc).
+
+TPU-native: under the compiled step, gradient averaging over the 'data'
+axis is inserted by XLA from the batch sharding (bucketing/fusion is the
+XLA scheduler's job), so the wrapper's runtime duty reduces to API parity
++ no_sync bookkeeping."""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer_base import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._layers.parameters(*a, **kw)
+
+    def named_parameters(self, *a, **kw):
+        return self._layers.named_parameters(*a, **kw)

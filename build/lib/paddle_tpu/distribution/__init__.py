@@ -1,0 +1,397 @@
+"""paddle.distribution parity — probability distributions.
+
+Reference parity: python/paddle/distribution/ (Distribution base,
+Normal/Uniform/Bernoulli/Categorical/..., kl_divergence + register_kl).
+
+TPU-native: parameters live as Tensors; sampling draws from the global
+generator (paddle.seed) via jax.random; log_prob/entropy are pure jnp
+through apply() so they differentiate and jit.
+"""
+from __future__ import annotations
+
+import math as pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+from ..framework.random import next_key
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Exponential", "Laplace", "LogNormal", "Gumbel", "Geometric",
+    "Poisson", "kl_divergence", "register_kl",
+]
+
+
+def _v(x):
+    return _coerce(x)._value if not isinstance(x, (int, float)) \
+        else jnp.asarray(x, jnp.float32)
+
+
+def _t(x):
+    """Coerce to Tensor WITHOUT re-wrapping (keeps tape identity so
+    rsample/log_prob gradients reach caller-owned parameters)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(_v(x))
+
+
+def _shape(sample_shape, batch):
+    return tuple(sample_shape) + tuple(batch)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc._value.shape,
+                                             self.scale._value.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        eps = jax.random.normal(next_key(), shp, jnp.float32)
+        # reparameterized through apply() so grads flow to loc/scale
+        return apply(lambda l, s: l + s * eps.astype(s.dtype),
+                     self.loc, self.scale, _name="normal_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * np.float32(pymath.log(2 * pymath.pi)))
+        return apply(fn, _coerce(value), self.loc, self.scale,
+                     _name="normal_log_prob")
+
+    def entropy(self):
+        return apply(lambda s: 0.5 + 0.5 * np.float32(pymath.log(2 * pymath.pi))
+                     + jnp.log(s), self.scale, _name="normal_entropy")
+
+    def cdf(self, value):
+        return apply(lambda v, loc, s: 0.5 * (1 + jax.scipy.special.erf(
+            (v - loc) / (s * np.float32(pymath.sqrt(2.0))))),
+            _coerce(value), self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(np.broadcast_shapes(self.low._value.shape,
+                                             self.high._value.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(next_key(), shp, jnp.float32)
+        return Tensor(self.low._value
+                      + (self.high._value - self.low._value) * u)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def fn(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo),
+                             np.float32(-np.inf))
+        return apply(fn, _coerce(value), self.low, self.high)
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _t(probs)
+            self.logits = Tensor(jnp.log(self.probs._value)
+                                 - jnp.log1p(-self.probs._value))
+        else:
+            self.logits = _t(logits)
+            self.probs = Tensor(jax.nn.sigmoid(self.logits._value))
+        super().__init__(self.probs._value.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return Tensor(self.probs._value * (1 - self.probs._value))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(jax.random.bernoulli(
+            next_key(), self.probs._value, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v, logits):
+            return v * jax.nn.log_sigmoid(logits) \
+                + (1 - v) * jax.nn.log_sigmoid(-logits)
+        return apply(fn, _coerce(value), self.logits)
+
+    def entropy(self):
+        def fn(p):
+            q = 1 - p
+            return -(p * jnp.log(jnp.clip(p, 1e-12))
+                     + q * jnp.log(jnp.clip(q, 1e-12)))
+        return apply(fn, self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = Tensor(jnp.log(jnp.clip(_v(probs), 1e-12)))
+        self.probs = Tensor(jax.nn.softmax(self.logits._value, axis=-1))
+        super().__init__(self.logits._value.shape[:-1])
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(jax.random.categorical(
+            next_key(), self.logits._value, axis=-1, shape=shp))
+
+    def log_prob(self, value):
+        def fn(v, logits):
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.take_along_axis(
+                lp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return apply(fn, _coerce(value), self.logits)
+
+    def entropy(self):
+        def fn(logits):
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+        return apply(fn, self.logits)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._value.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate._value)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(jax.random.exponential(next_key(), shp, jnp.float32)
+                      / self.rate._value)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return apply(lambda v, r: jnp.log(r) - r * v,
+                     _coerce(value), self.rate)
+
+    def entropy(self):
+        return apply(lambda r: 1.0 - jnp.log(r), self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc._value.shape,
+                                             self.scale._value.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(self.loc._value + self.scale._value
+                      * jax.random.laplace(next_key(), shp, jnp.float32))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return apply(lambda v, m, b: -jnp.abs(v - m) / b
+                     - jnp.log(2 * b), _coerce(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda b: 1.0 + jnp.log(2 * b), self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._normal = Normal(loc, scale)
+        self.loc = self._normal.loc
+        self.scale = self._normal.scale
+        super().__init__(self._normal._batch_shape)
+
+    def rsample(self, shape=()):
+        return apply(jnp.exp, self._normal.rsample(shape))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        logv = apply(jnp.log, _coerce(value))
+        return self._normal.log_prob(logv) - logv
+
+    def entropy(self):
+        return self._normal.entropy() + self.loc
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc._value.shape,
+                                             self.scale._value.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(self.loc._value + self.scale._value
+                      * jax.random.gumbel(next_key(), shp, jnp.float32))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def fn(v, m, b):
+            z = (v - m) / b
+            return -(z + jnp.exp(-z)) - jnp.log(b)
+        return apply(fn, _coerce(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda b: jnp.log(b) + np.float32(1.5772156649),
+                     self.scale)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs._value.shape)
+
+    def sample(self, shape=()):
+        # paddle.distribution.Geometric uses the FAILURES convention
+        # (support {0, 1, ...}, pmf (1-p)^k p); jax.random.geometric
+        # samples trials on {1, 2, ...} — shift down by one
+        shp = _shape(shape, self._batch_shape)
+        return Tensor((jax.random.geometric(
+            next_key(), self.probs._value, shp) - 1).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply(lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                     _coerce(value), self.probs)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate._value.shape)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(jax.random.poisson(
+            next_key(), self.rate._value, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply(lambda v, r: v * jnp.log(r) - r
+                     - jax.scipy.special.gammaln(v + 1),
+                     _coerce(value), self.rate)
+
+
+# -- KL registry -----------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator mirroring paddle.distribution.register_kl."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def fn(pl, ps, ql, qs):
+        vr = (ps / qs) ** 2
+        return 0.5 * (vr + ((pl - ql) / qs) ** 2 - 1.0 - jnp.log(vr))
+    return apply(fn, p.loc, p.scale, q.loc, q.scale, _name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def fn(plo, phi, qlo, qhi):
+        out = jnp.log((qhi - qlo) / (phi - plo))
+        inside = (qlo <= plo) & (phi <= qhi)
+        return jnp.where(inside, out, np.float32(np.inf))
+    return apply(fn, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qp):
+        t1 = pp * (jnp.log(jnp.clip(pp, 1e-12))
+                   - jnp.log(jnp.clip(qp, 1e-12)))
+        t2 = (1 - pp) * (jnp.log(jnp.clip(1 - pp, 1e-12))
+                         - jnp.log(jnp.clip(1 - qp, 1e-12)))
+        return t1 + t2
+    return apply(fn, p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def fn(pl, ql):
+        plog = jax.nn.log_softmax(pl, axis=-1)
+        qlog = jax.nn.log_softmax(ql, axis=-1)
+        return jnp.sum(jnp.exp(plog) * (plog - qlog), axis=-1)
+    return apply(fn, p.logits, q.logits)

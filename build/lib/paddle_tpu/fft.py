@@ -1,0 +1,124 @@
+"""paddle.fft parity — discrete Fourier transform family.
+
+Reference parity: python/paddle/fft.py (which lowers to phi fft kernels,
+cuFFT on GPU). On TPU the transforms lower to XLA FFT HLOs directly via
+jnp.fft; autograd flows through the standard apply() vjp path (jax has
+complex-differentiable FFT rules).
+
+Paddle semantics kept: `norm` in {"backward","ortho","forward"}; `n`/`s`
+pad-or-truncate; `axis`/`axes` selection; real transforms (rfft family)
+return the half spectrum.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._dispatch import apply
+from .ops.creation import _coerce
+from .tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"invalid norm {norm!r}")
+    return norm
+
+
+def _unary(name, jfn, x, *, n=None, axis=-1, norm=None):
+    return apply(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)),
+                 _coerce(x), _name=name)
+
+
+def _nary(name, jfn, x, *, s=None, axes=None, norm=None):
+    return apply(lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)),
+                 _coerce(x), _name=name)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary("fft", jnp.fft.fft, x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary("ifft", jnp.fft.ifft, x, n=n, axis=axis, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary("rfft", jnp.fft.rfft, x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary("irfft", jnp.fft.irfft, x, n=n, axis=axis, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary("hfft", jnp.fft.hfft, x, n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary("ihfft", jnp.fft.ihfft, x, n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nary("fft2", jnp.fft.fft2, x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nary("ifft2", jnp.fft.ifft2, x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nary("rfft2", jnp.fft.rfft2, x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _nary("irfft2", jnp.fft.irfft2, x, s=s, axes=axes, norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nary("fftn", jnp.fft.fftn, x, s=s, axes=axes, norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nary("ifftn", jnp.fft.ifftn, x, s=s, axes=axes, norm=norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nary("rfftn", jnp.fft.rfftn, x, s=s, axes=axes, norm=norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _nary("irfftn", jnp.fft.irfftn, x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d)
+    if dtype is not None:
+        from .framework.dtype import convert_dtype as to_jax_dtype
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d)
+    if dtype is not None:
+        from .framework.dtype import convert_dtype as to_jax_dtype
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), _coerce(x),
+                 _name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), _coerce(x),
+                 _name="ifftshift")

@@ -1,0 +1,16 @@
+"""framework: dtype/place/random/flags (parity: python/paddle/framework/)."""
+from __future__ import annotations
+
+from . import dtype
+from .dtype import (
+    set_default_dtype, get_default_dtype, convert_dtype, finfo, iinfo,
+)
+from .place import (
+    Place, CPUPlace, TPUPlace, XLAPlace, CUDAPlace, set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+)
+from .random import (
+    seed, get_rng_state, set_rng_state, get_rng_state_tracker,
+    default_generator, next_key,
+)
+from .flags import set_flags, get_flags, define_flag, flag_value

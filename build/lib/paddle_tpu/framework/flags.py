@@ -1,0 +1,136 @@
+"""Typed global flag registry.
+
+Reference parity: paddle/phi/core/flags.cc (gflags-style FLAGS_* registry,
+env-settable) and python/paddle/base/framework.py::set_flags/get_flags.
+Flags front JAX config + our framework knobs. Each flag has a type, default,
+help string, and env override (FLAGS_<name>).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _coerce(ty, raw):
+    if ty is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return ty(raw)
+
+
+def _native_mirror(name, ty, value, help_=""):
+    """Mirror a flag into the native registry (csrc/flags.cc) so native
+    components see framework flag state. Deferred: no-op until something
+    actually loads the native lib (so `import paddle_tpu` never triggers a
+    compile); load() calls resync_native() to catch up."""
+    try:
+        from .. import _native
+        if not _native.is_loaded():
+            return
+        code = {bool: _native.FLAG_BOOL, int: _native.FLAG_INT,
+                float: _native.FLAG_DOUBLE}.get(ty, _native.FLAG_STRING)
+        # define (idempotent; applies env default) then set the explicit
+        # current value so set_flags wins over a stale FLAGS_* env override.
+        if code == _native.FLAG_STRING:
+            _native.flag_define(name, code, str(value), 0.0, help_)
+            _native.flag_set(name, str(value))
+        else:
+            _native.flag_define(name, code, "", float(value), help_)
+            _native.flag_set(name, float(value))
+    except Exception:
+        pass
+
+
+def resync_native():
+    """Push the whole Python registry into the native one (called by
+    _native.load() after the library comes up)."""
+    for f in _REGISTRY.values():
+        _native_mirror(f.name, f.type, f.value, f.help)
+
+
+def define_flag(name: str, default, help: str = "", type_: type | None = None,
+                on_change=None):
+    ty = type_ or type(default)
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _coerce(ty, env) if env is not None else default
+    flag = _Flag(name=name, default=default, type=ty, help=help,
+                 on_change=on_change, value=value)
+    _REGISTRY[name] = flag
+    _native_mirror(name, ty, value, help)
+    if on_change is not None and env is not None:
+        on_change(value)
+    return flag
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags"""
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        if k not in _REGISTRY:
+            raise ValueError(f"unknown flag {k!r}")
+        f = _REGISTRY[k]
+        f.value = _coerce(f.type, v)
+        _native_mirror(k, f.type, f.value, f.help)
+        if f.on_change is not None:
+            f.on_change(f.value)
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    """paddle.get_flags"""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        k2 = k.removeprefix("FLAGS_")
+        if k2 not in _REGISTRY:
+            raise ValueError(f"unknown flag {k!r}")
+        out[k] = _REGISTRY[k2].value
+    return out
+
+
+def flag_value(name: str):
+    return _REGISTRY[name].value
+
+
+def all_flags():
+    return {k: f.value for k, f in _REGISTRY.items()}
+
+
+def _set_debug_nans(v: bool):
+    import jax
+    jax.config.update("jax_debug_nans", bool(v))
+
+
+# Core flags (parity names with paddle/phi/core/flags.cc where meaningful).
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf (maps to jax_debug_nans).",
+            on_change=_set_debug_nans)
+define_flag("check_nan_inf_level", 0, "NaN check verbosity level.")
+define_flag("allocator_strategy", "auto_growth",
+            "Parity stub: XLA/TPU memory is arena-managed by the runtime.")
+define_flag("cudnn_deterministic", False,
+            "Deterministic kernels (TPU: XLA is deterministic by default).")
+define_flag("use_pallas_kernels", True,
+            "Use Pallas fused kernels (attention/LN/RoPE) when on TPU.")
+define_flag("pallas_interpret", False,
+            "Force Pallas kernels ON in interpreter mode (CPU CI coverage: "
+            "runs every kernel's real Pallas path without TPU hardware).")
+define_flag("max_inplace_grad_add", 0, "Parity stub.")
+define_flag("eager_delete_tensor_gb", 0.0, "Parity stub; XLA GC is automatic.")
+define_flag("shm_channel_capacity_mb", 64,
+            "Per-DataLoader shared-memory ring capacity (native worker pool).")

@@ -1,0 +1,129 @@
+"""Device/place abstraction.
+
+Reference parity: paddle/phi/common/place.h (phi::Place, CPUPlace, GPUPlace,
+CustomPlace) and the north star's `XLAPlace`. On TPU the place maps directly
+onto a `jax.Device`; streams/contexts are subsumed by XLA's execution model,
+so a Place here is a thin named handle used for `.to()` / `paddle.device`
+parity rather than a stream owner.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    """Base place: a named device handle."""
+
+    _kind = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _kind_matches(d, self._kind)]
+        if not devs:
+            # fall back to host platform
+            devs = jax.devices("cpu")
+        return devs[self._device_id % len(devs)]
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self._kind == other._kind
+                and self._device_id == other._device_id)
+
+    def __hash__(self):
+        return hash((self._kind, self._device_id))
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._device_id})"
+
+
+def _kind_matches(device, kind: str) -> bool:
+    plat = device.platform.lower()
+    if kind == "cpu":
+        return plat == "cpu"
+    if kind in ("tpu", "xla"):
+        # under the axon tunnel the platform may be reported differently;
+        # treat any non-cpu accelerator as the TPU place
+        return plat != "cpu"
+    return False
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+
+    def __repr__(self):
+        return f"Place(tpu:{self._device_id})"
+
+
+# North-star naming: XLAPlace is the Paddle-side name for the TPU device.
+XLAPlace = TPUPlace
+# CUDAPlace parity shim: on this framework it is the accelerator place.
+CUDAPlace = TPUPlace
+
+
+@functools.lru_cache(maxsize=None)
+def _accelerator_available() -> bool:
+    return any(d.platform.lower() != "cpu" for d in jax.devices())
+
+
+_current_place = None
+
+
+def set_device(device) -> Place:
+    """paddle.set_device — accepts 'cpu', 'tpu', 'tpu:0', 'gpu' (alias of the
+    accelerator), 'xla'."""
+    global _current_place
+    _current_place = _parse_place(device)
+    return _current_place
+
+
+def get_device() -> str:
+    p = _default_place()
+    return f"{p._kind}:{p.get_device_id()}" if p._kind != "cpu" else "cpu"
+
+
+def _parse_place(device) -> Place:
+    if isinstance(device, Place):
+        return device
+    s = str(device).lower()
+    if ":" in s:
+        kind, _, idx = s.partition(":")
+        idx = int(idx)
+    else:
+        kind, idx = s, 0
+    if kind == "cpu":
+        return CPUPlace(idx)
+    if kind in ("tpu", "gpu", "xla", "cuda"):
+        return TPUPlace(idx)
+    raise ValueError(f"unknown device {device!r}")
+
+
+def _default_place() -> Place:
+    if _current_place is not None:
+        return _current_place
+    return TPUPlace(0) if _accelerator_available() else CPUPlace(0)
+
+
+def is_compiled_with_cuda() -> bool:  # parity stub
+    return False
+
+
+def is_compiled_with_xpu() -> bool:  # parity stub
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return _accelerator_available()

@@ -1,0 +1,86 @@
+"""paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py — pickle-serialized nested
+state dicts of tensors. Tensors are stored as numpy arrays + dtype tag so
+files are portable; loading re-wraps into Tensors (bfloat16 survives via
+ml_dtypes). Sharded/distributed checkpoints live in
+paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor, Parameter
+
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    __slots__ = ("array", "dtype_name", "is_parameter", "name", "stop_gradient")
+
+    def __init__(self, t: Tensor):
+        arr = np.asarray(t._value)
+        self.dtype_name = str(t.dtype)
+        # numpy can't pickle bfloat16 arrays portably → store raw bytes view
+        self.array = arr.view(np.uint16) if self.dtype_name == "bfloat16" else arr
+        self.is_parameter = isinstance(t, Parameter)
+        self.name = t.name
+        self.stop_gradient = t.stop_gradient
+
+    def restore(self):
+        import jax.numpy as jnp
+        from .framework import dtype as dtypes
+        arr = self.array
+        if self.dtype_name == "bfloat16":
+            arr = arr.view(dtypes.bfloat16)
+        if self.is_parameter:
+            t = Parameter(jnp.asarray(arr), trainable=not self.stop_gradient,
+                          name=self.name)
+        else:
+            t = Tensor(jnp.asarray(arr), stop_gradient=self.stop_gradient,
+                       name=self.name)
+        return t
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        t = obj.restore()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs):
+    """paddle.save"""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """paddle.load"""
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,44 @@
+"""Static-shape KV cache for XLA-friendly autoregressive decoding.
+
+Reference parity: PaddleNLP generation caches (paddlenlp/transformers/
+generation_utils.py `past_key_values`) and the fused block-attention
+cache layout of paddle/phi/kernels/fusion/gpu (block_multihead_attention).
+
+TPU-native design: instead of concatenating K/V each step (dynamic shapes
+— retrace/recompile every token), the cache is a preallocated
+[B, max_len, n_kv_heads, head_dim] buffer per layer written in place with
+`lax.dynamic_update_slice` at a traced position. The whole decode loop
+then compiles to ONE XLA program (`lax.scan` over steps) with static
+shapes, which is the canonical TPU serving pattern.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+
+class StaticCacheEntry(NamedTuple):
+    """Per-layer cache entry: full K/V buffers plus the write position.
+
+    `k`/`v` are Tensors (or traced arrays) of shape
+    [batch, max_len, n_kv_heads, head_dim]; `pos` is a scalar int32
+    Tensor — the slot where this step's keys/values are written.
+    """
+    k: object
+    v: object
+    pos: object
+
+
+class StaticKVCache:
+    """A list of per-layer StaticCacheEntry, passed as `past_key_values`."""
+
+    def __init__(self, entries: List[StaticCacheEntry]):
+        self.entries = entries
+
+    def __len__(self):
+        return len(self.entries)
+
+    def __getitem__(self, i):
+        return self.entries[i]
+
+    def __iter__(self):
+        return iter(self.entries)

@@ -1,0 +1,240 @@
+"""paddle.Model — high-level train/eval/predict API.
+
+Reference parity: python/paddle/hapi/model.py (Model.prepare/fit/evaluate/
+predict/save/load/summary). TPU-native: `prepare()` builds a
+jit-compiled functional train step (jit.bridge.TrainStep) so fit() runs
+fwd+bwd+update as one XLA program per batch — the dygraph/static split of
+the reference collapses into "eager loop around a compiled step".
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+from ..nn.layer_base import Layer
+from .._grad_mode import no_grad
+from ..framework_io import save as psave, load as pload
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------ prepare --
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+        self._jit = jit_compile
+        self._train_step = None  # rebuilt lazily per signature
+
+    # ----------------------------------------------------------- training --
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else \
+            ([labels] if labels is not None else [])
+        if self._jit:
+            if self._train_step is None:
+                from ..jit.bridge import TrainStep
+                self._train_step = TrainStep(
+                    self.network, self._optimizer,
+                    lambda out, *ys: self._loss(out, *ys),
+                    n_model_inputs=len(inputs))
+            loss = self._train_step(*inputs, *labels)
+        else:
+            outs = self.network(*inputs)
+            loss = self._loss(outs, *labels)
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics_out = []
+        return [float(loss)], metrics_out
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else \
+            ([labels] if labels is not None else [])
+        outs = self.network(*inputs)
+        loss = self._loss(outs, *labels) if self._loss else None
+        metric_res = []
+        for m in self._metrics:
+            res = m.compute(outs, *labels)
+            m.update(res)
+            metric_res.append(m.accumulate())
+        return ([float(loss)] if loss is not None else []), metric_res
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        return [out.numpy() if isinstance(out, Tensor) else out]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbs = cb_mod.config_callbacks(callbacks, model=self, epochs=epochs,
+                                      steps=steps, verbose=verbose,
+                                      save_dir=save_dir, save_freq=save_freq,
+                                      metrics=self._metrics)
+        self.stop_training = False
+        for cb in cbs:
+            cb.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            self.network.train()
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
+                x, y = self._split_batch(batch)
+                loss, _ = self.train_batch(x, y)
+                logs = {"loss": loss[0]}
+                if step % log_freq == 0 or (steps and step + 1 == steps):
+                    for cb in cbs:
+                        cb.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters and it_count >= num_iters:
+                    break
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, num_workers=num_workers)
+                for cb in cbs:
+                    cb.on_eval_end(eval_logs)
+            if self.stop_training or (num_iters and it_count >= num_iters):
+                break
+        for cb in cbs:
+            cb.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = self._split_batch(batch)
+            loss, _ = self.eval_batch(x, y)
+            if loss:
+                losses.append(loss[0])
+        logs = {}
+        if losses:
+            logs["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            name = m.name()
+            res = m.accumulate()
+            if isinstance(name, list):
+                for n, r in zip(name, res):
+                    logs[n] = r
+            else:
+                logs[name] = res
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        self.network.eval()
+        outputs = []
+        for batch in loader:
+            x, _ = self._split_batch(batch, allow_no_label=True)
+            outputs.append(self.predict_batch(x)[0])
+        if stack_outputs:
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    @staticmethod
+    def _split_batch(batch, allow_no_label=False):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return batch[0], batch[1]
+            return batch[0], None
+        return batch, None
+
+    # ------------------------------------------------------------ save/io --
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        sd = pload(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(pload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """paddle.summary parity (python/paddle/hapi/model_summary.py)."""
+    total_params = 0
+    trainable = 0
+    rows = []
+    for name, p in net.named_parameters():
+        n = p.size
+        total_params += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    lines = ["-" * 64,
+             f"{'Param name':<36}{'Shape':<18}{'#':>10}",
+             "-" * 64]
+    for name, shape, n in rows:
+        lines.append(f"{name:<36}{str(shape):<18}{n:>10}")
+    lines += ["-" * 64,
+              f"Total params: {total_params:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total_params - trainable:,}",
+              "-" * 64]
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable}

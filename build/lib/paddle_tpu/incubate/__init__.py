@@ -1,0 +1,36 @@
+"""paddle.incubate parity namespace (python/paddle/incubate/).
+
+Holds the fused-op python API names PaddleNLP-style code imports
+(nn.FusedTransformer family, functional fused ops, MoE). Fused semantics
+are delivered by the Pallas kernels + XLA fusion.
+"""
+from . import nn
+from . import distributed
+from ..ops import math as _m
+
+softmax_mask_fuse = None
+
+
+def segment_sum(data, segment_ids, name=None):
+    import jax
+    import numpy as np
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+    n = int(np.asarray(_coerce(segment_ids)._value).max()) + 1
+    return apply(lambda d, s: jax.ops.segment_sum(d, s, num_segments=n),
+                 _coerce(data), _coerce(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+    n = int(np.asarray(_coerce(segment_ids)._value).max()) + 1
+
+    def fn(d, s):
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(d), s, num_segments=n)
+        return tot / jnp.maximum(cnt, 1)
+    return apply(fn, _coerce(data), _coerce(segment_ids))

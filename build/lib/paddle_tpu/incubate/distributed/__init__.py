@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed parity namespace."""
+from . import models

@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed.models parity namespace."""
+from . import moe

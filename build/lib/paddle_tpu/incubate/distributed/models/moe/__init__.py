@@ -1,0 +1,7 @@
+"""paddle.incubate.distributed.models.moe parity (MoELayer + gates).
+See moe_layer.py for the TPU-native design notes."""
+from .gate import NaiveGate, SwitchGate, GShardGate, BaseGate, build_gate
+from .moe_layer import MoELayer, ExpertMLP
+
+__all__ = ["MoELayer", "ExpertMLP", "NaiveGate", "SwitchGate", "GShardGate",
+           "BaseGate", "build_gate"]

@@ -1,0 +1,112 @@
+"""MoE gates.
+
+Reference parity: python/paddle/incubate/distributed/models/moe/gate/
+(NaiveGate, SwitchGate, GShardGate) — linear router producing per-token
+expert scores; switch = top-1 with load-balance loss, gshard = top-2 with
+aux loss and capacity-aware dropping.
+
+TPU-native: the gate outputs dense [N, E] probabilities; top-k selection
+and capacity bookkeeping are static-shape einsums/cumsums (no dynamic
+shapes, jit-friendly). The aux load-balance loss follows the Switch/GShard
+formula: E * sum_e(mean_prob_e * frac_tokens_e).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer_base import Layer
+from .....nn.initializer import XavierUniform
+
+
+def load_balance_loss(probs, expert_mask):
+    """probs [N, E] f32, expert_mask [N, E] one-hot of routed expert(s).
+    Switch-Transformer aux loss."""
+    e = probs.shape[-1]
+    density = jnp.mean(expert_mask.astype(jnp.float32), axis=0)     # frac tokens
+    density_proxy = jnp.mean(probs, axis=0)                          # mean prob
+    return e * jnp.sum(density * density_proxy)
+
+
+class BaseGate(Layer):
+    has_aux_loss = True
+
+    def __init__(self, d_model, num_experts, top_k=2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=XavierUniform())
+        # aux loss lives in a (non-persistable) buffer so it threads
+        # through the functionalize/jit path like any other state update
+        # instead of leaking a tracer via a Python attribute
+        import jax.numpy as _jnp
+        from .....tensor import Tensor as _T
+        self.register_buffer("aux_loss", _T(_jnp.zeros((), _jnp.float32)),
+                             persistable=False)
+
+    def get_loss(self):
+        return self.aux_loss
+
+    def _logits(self, x):
+        from .....ops.linalg import matmul
+        return matmul(x, self.weight)
+
+
+class NaiveGate(BaseGate):
+    """Top-k gate without aux loss (reference NaiveGate)."""
+
+    has_aux_loss = False
+
+    def forward(self, x):
+        return self._logits(x)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 gate with load-balance loss (reference SwitchGate)."""
+
+    has_aux_loss = True
+
+    def __init__(self, d_model, num_experts, top_k=1, **kw):
+        if top_k != 1:
+            raise ValueError(f"SwitchGate is top-1 by definition, got "
+                             f"top_k={top_k}")
+        super().__init__(d_model, num_experts, top_k=1)
+
+    def forward(self, x):
+        return self._logits(x)
+
+
+class GShardGate(BaseGate):
+    """Top-k (default 2) gate with aux loss (reference GShardGate)."""
+
+    has_aux_loss = True
+
+    def __init__(self, d_model, num_experts, top_k=2, **kw):
+        super().__init__(d_model, num_experts, top_k=top_k)
+
+    def forward(self, x):
+        return self._logits(x)
+
+
+GATE_TYPES = {
+    "naive": NaiveGate,
+    "switch": SwitchGate,
+    "gshard": GShardGate,
+}
+
+
+def build_gate(gate, d_model, num_experts):
+    """gate may be a BaseGate instance, a dict config {'type', 'top_k'},
+    or a string name."""
+    if isinstance(gate, BaseGate):
+        return gate
+    if gate is None:
+        gate = {"type": "gshard", "top_k": 2}
+    if isinstance(gate, str):
+        gate = {"type": gate}
+    cls = GATE_TYPES[gate.get("type", "gshard")]
+    if "top_k" in gate:
+        return cls(d_model, num_experts, top_k=gate["top_k"])
+    return cls(d_model, num_experts)

@@ -1,0 +1,114 @@
+"""paddle.incubate.nn — fused transformer layers + functional fused ops.
+
+Reference parity: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+FusedMultiTransformer) backed by phi fusion CUDA kernels
+(paddle/phi/kernels/fusion/gpu/). Here "fused" = Pallas attention kernel +
+XLA-fused epilogues; the layer classes keep the reference's parameter
+layout so recipes port unchanged.
+"""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn.layers_common import Linear, LayerNorm, Dropout
+from ...nn import functional as F
+from ...ops import manipulation as M
+from . import functional
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        # fused qkv: one [3*E, E]-shaped projection (reference layout:
+        # qkv_weight [3, num_heads, head_dim, embed_dim])
+        self.qkv_proj = Linear(embed_dim, 3 * embed_dim, qkv_weight_attr,
+                               qkv_bias_attr)
+        self.linear = Linear(embed_dim, embed_dim, linear_weight_attr,
+                             linear_bias_attr)
+        self.pre_ln = LayerNorm(embed_dim, epsilon) if normalize_before else None
+        self.ln = LayerNorm(embed_dim, epsilon)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.pre_ln(x)
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = M.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training)
+        out = M.reshape(out, [b, s, self.embed_dim])
+        out = self.linear(out)
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward, linear1_weight_attr,
+                              linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, linear2_weight_attr,
+                              linear2_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon)
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate if act_dropout_rate is not None else dropout_rate
+        self.activation = activation
+
+    def forward(self, src, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.ln(src)
+        out = getattr(F, self.activation)(self.linear1(src))
+        out = F.dropout(out, self.act_dropout_rate, training=self.training)
+        out = self.linear2(out)
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

@@ -1,0 +1,141 @@
+"""paddle.incubate.nn.functional — fused-op functional API
+(parity: python/paddle/incubate/nn/functional/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops._dispatch import apply
+from ...ops.creation import _coerce
+from ...nn import functional as F
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Parity: fused_rope (paddle/phi/kernels/fusion/gpu/fused_rope*)."""
+    from ...kernels.rope import apply_rotary_emb
+
+    args = [_coerce(q)]
+    has_k = k is not None
+    if has_k:
+        args.append(_coerce(k))
+    args.append(_coerce(cos))
+    args.append(_coerce(sin))
+    if position_ids is not None:
+        args.append(_coerce(position_ids))
+        has_pos = True
+    else:
+        has_pos = False
+
+    def fn(qv, *rest):
+        i = 0
+        kv = rest[i] if has_k else None
+        i += 1 if has_k else 0
+        cosv, sinv = rest[i], rest[i + 1]
+        pos = rest[i + 2] if has_pos else None
+        q2, k2 = apply_rotary_emb(qv, kv if kv is not None else qv, cosv,
+                                  sinv, position_ids=pos,
+                                  use_neox=use_neox_rotary_style)
+        if kv is None:
+            return q2
+        return q2, k2
+    out = apply(fn, *args, _name="fused_rope")
+    if not has_k:
+        return out, None, None
+    q2, k2 = out
+    return q2, k2, None
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode='upscale_in_train',
+                                           name=None):
+    out = x
+    if bias is not None:
+        out = out + bias
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    out = out + residual
+    return F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ...ops.linalg import matmul
+        out = matmul(x, weight, transpose_y=True)
+        return out + bias if bias is not None else out
+    return F.linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ...ops.linalg import matmul
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y) + bias
+    return getattr(F, activation)(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def swiglu(x, y=None, name=None):
+    """Parity: phi swiglu kernel (llama MLP hot path)."""
+    if y is not None:
+        return apply(lambda a, b: jnp.asarray(jax_silu(a)) * b,
+                     _coerce(x), _coerce(y), _name="swiglu")
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=-1)
+        return jax_silu(a) * b
+    return apply(fn, _coerce(x), _name="swiglu")
+
+
+def jax_silu(a):
+    import jax
+    return jax.nn.silu(a)
+
+
+def fused_layer_norm(x, scale, bias, epsilon=1e-5, begin_norm_axis=1):
+    from ...kernels.norm import fused_layer_norm as _fln
+    return apply(lambda v, s, b: _fln(v, s, b, epsilon),
+                 _coerce(x), _coerce(scale), _coerce(bias),
+                 _name="layer_norm")
+
+
+def fused_rms_norm(x, scale, epsilon=1e-6, begin_norm_axis=1):
+    from ...kernels.norm import fused_rms_norm as _frn
+    return apply(lambda v, s: _frn(v, s, epsilon), _coerce(x), _coerce(scale),
+                 _name="rms_norm")
+
+
+def paged_attention(q, key_cache, value_cache, block_tables, context_lens,
+                    scale=None, name=None):
+    """Paged (block) KV-cache decode attention — see
+    kernels/paged_attention.py. Parity: the attention core of paddle.
+    incubate.nn.functional.block_multihead_attention."""
+    from ...kernels.paged_attention import paged_attention as _pa
+    return apply(lambda qv, kc, vc, bt, cl: _pa(qv, kc, vc, bt, cl, scale),
+                 _coerce(q), _coerce(key_cache), _coerce(value_cache),
+                 _coerce(block_tables), _coerce(context_lens),
+                 _name="paged_attention")
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, block_tables,
+                              context_lens, scale=None, num_heads=None,
+                              name=None):
+    """paddle.incubate.nn.functional.block_multihead_attention-shaped
+    entry. `qkv` is either the query [B, H, D], or the packed decode-step
+    [B, 3*H*D] projection (paddle layout) with `num_heads` given — the
+    K/V thirds are assumed already written to the paged cache by the
+    caller. Cache layout [num_pages, page_size, n_kv_heads, D]."""
+    q = _coerce(qkv)
+    if len(q.shape) == 2:
+        if num_heads is None:
+            raise ValueError(
+                "packed [B, 3*H*D] qkv requires num_heads= to slice the "
+                "query block; or pass the query as [B, H, D]")
+        head_dim = q.shape[1] // (3 * num_heads)
+        q = q[:, :num_heads * head_dim].reshape([q.shape[0], num_heads,
+                                                 head_dim])
+    return paged_attention(q, key_cache, value_cache, block_tables,
+                           context_lens, scale=scale)

@@ -1,0 +1,168 @@
+"""Multiprocess DataLoader worker pool over the native shm channel.
+
+Reference parity: python/paddle/io/dataloader/worker.py +
+dataloader_iter.py (_DataLoaderIterMultiProcess) — worker subprocesses
+collate batches and ship them through shared memory (use_shared_memory=True),
+not a pipe. Here the transport is csrc/shm_channel.cc via ctypes.
+
+TPU note: workers stay numpy-only (no JAX import) — device placement happens
+in the parent, keeping forked children free of XLA runtime state.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+_WORKER_INFO = None
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    seed: int
+    dataset: object
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """paddle.io.get_worker_info parity — valid inside worker processes."""
+    return _WORKER_INFO
+
+
+def numpy_collate(batch):
+    """Structure-preserving collate producing numpy (device-free) arrays."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(numpy_collate(list(items)) for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: numpy_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def worker_loop(dataset, batch_indices, worker_id, num_workers, chan_name,
+                collate_fn, worker_init_fn, seed, batch_size, drop_last):
+    """Entry point of one worker process.
+
+    batch_indices is None for IterableDataset (each worker streams its own
+    shard via get_worker_info), else the full list of per-batch index lists —
+    worker w handles batches w, w+N, w+2N, ... (round-robin, so the parent
+    can restore order).
+    """
+    global _WORKER_INFO
+    from .._native import ShmChannel
+
+    _WORKER_INFO = WorkerInfo(id=worker_id, num_workers=num_workers,
+                              seed=seed + worker_id, dataset=dataset)
+    np.random.seed(seed + worker_id)
+    ch = ShmChannel(chan_name)
+    collate = collate_fn or numpy_collate
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if batch_indices is None:
+            buf = []
+            for item in iter(dataset):
+                buf.append(item)
+                if len(buf) == batch_size:
+                    ch.push_obj(("b", None, collate(buf)))
+                    buf = []
+            if buf and not drop_last:
+                ch.push_obj(("b", None, collate(buf)))
+        else:
+            for i in range(worker_id, len(batch_indices), num_workers):
+                data = [dataset[j] for j in batch_indices[i]]
+                ch.push_obj(("b", i, collate(data)))
+    except Exception:
+        ch.push_obj(("e", worker_id, traceback.format_exc()))
+    finally:
+        try:
+            ch.push_obj(("d", worker_id, None))
+        except Exception:
+            pass
+        ch.close()
+
+
+class WorkerPool:
+    """Parent-side pool: spawns workers, restores batch order, converts
+    numpy trees to Tensors."""
+
+    def __init__(self, dataset, batch_indices, num_workers, collate_fn,
+                 worker_init_fn, seed=0, batch_size=1, drop_last=False,
+                 capacity_bytes=None):
+        from .._native import ShmChannel
+        from ..framework import flags as _flags
+
+        if capacity_bytes is None:
+            capacity_bytes = int(
+                _flags.flag_value("shm_channel_capacity_mb")) << 20
+        self.num_workers = num_workers
+        self.ordered = batch_indices is not None
+        self.total = len(batch_indices) if self.ordered else None
+        name = f"/pd_dl_{os.getpid()}_{id(self)}"
+        self.chan = ShmChannel(name, capacity_bytes, create=True)
+        ctx = mp.get_context("fork")
+        self.procs = [
+            ctx.Process(
+                target=worker_loop,
+                args=(dataset, batch_indices, w, num_workers, name,
+                      collate_fn, worker_init_fn, seed, batch_size,
+                      drop_last),
+                daemon=True)
+            for w in range(num_workers)
+        ]
+        for p in self.procs:
+            p.start()
+
+    def __iter__(self):
+        from . import _np_tree_to_tensor
+        done = 0
+        pending = {}
+        next_idx = 0
+        try:
+            while done < self.num_workers:
+                msg = self.chan.pop_obj(timeout_ms=300000)
+                if msg is None:
+                    break
+                kind, idx, payload = msg
+                if kind == "d":
+                    done += 1
+                    continue
+                if kind == "e":
+                    raise RuntimeError(
+                        f"DataLoader worker {idx} failed:\n{payload}")
+                if not self.ordered:
+                    yield _np_tree_to_tensor(payload)
+                    continue
+                pending[idx] = payload
+                while next_idx in pending:
+                    yield _np_tree_to_tensor(pending.pop(next_idx))
+                    next_idx += 1
+            # flush any stragglers that arrived with the final done
+            while self.ordered and next_idx in pending:
+                yield _np_tree_to_tensor(pending.pop(next_idx))
+                next_idx += 1
+            if self.ordered and next_idx < self.total:
+                raise RuntimeError(
+                    f"DataLoader lost batches: got {next_idx} of "
+                    f"{self.total} (a worker died without reporting)")
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        self.chan.close_write()
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self.chan.close()

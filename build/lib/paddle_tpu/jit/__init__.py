@@ -1,0 +1,12 @@
+"""paddle.jit — dynamic-to-static (parity: python/paddle/jit/).
+
+In the reference, dy2static AST-transforms python control flow into
+ProgramDesc ops executed by InterpreterCore (paddle/fluid/framework/
+new_executor/). TPU-native design: `to_static` = `jax.jit` tracing of the
+same eager code — our ops run identically on tracers, the tape works at
+trace time, and XLA compiles+caches the whole program (SURVEY.md: the
+per-op dispatch loop is what disappears). Data-dependent python control
+flow must use lax.cond/while via paddle_tpu.static.nn.cond/while_loop.
+"""
+from .api import to_static, not_to_static, save, load, TranslatedLayer, ignore_module
+from .bridge import TrainStep, functionalize

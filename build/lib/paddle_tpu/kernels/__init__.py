@@ -1,0 +1,15 @@
+"""TPU Pallas kernels — the fused-op library.
+
+Reference parity: paddle/phi/kernels/fusion/gpu/ (fused_attention,
+fused_rope, fused_bias_dropout_residual_ln) and
+paddle/phi/kernels/gpu/flash_attn_kernel.cu (FlashAttention-2 binding).
+Here the fused kernels are Pallas TPU kernels (MXU/VMEM-aware), with XLA
+fallbacks used on CPU or when `FLAGS_use_pallas_kernels=0`.
+"""
+from .attention import flash_attention, flash_attention_bshd
+from .norm import fused_rms_norm, fused_layer_norm
+from .rope import apply_rotary_emb
+from .ring_attention import (
+    RingFlashAttention, UlyssesAttention, ring_flash_attention,
+    ring_attention_jax, ulysses_attention_jax, split_inputs_sequence_dim,
+)

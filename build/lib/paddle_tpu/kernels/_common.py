@@ -1,0 +1,43 @@
+"""Shared helpers for the Pallas kernel modules."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..framework.flags import flag_value
+
+# Pallas index maps must return a uniform int type: with jax_enable_x64
+# on (Paddle int64 parity), a bare `0` literal traces as i64 next to the
+# i32 grid index and Mosaic fails to legalize `func.return` — use an
+# explicit i32 zero.
+_Z = np.int32(0)
+
+_NEG_INF = np.float32(-1e30)
+
+
+def use_pallas() -> bool:
+    """Gate: FLAGS_use_pallas_kernels on AND (a non-CPU backend OR
+    FLAGS_pallas_interpret for CPU-interpreter CI coverage)."""
+    if not flag_value("use_pallas_kernels"):
+        return False
+    if flag_value("pallas_interpret"):
+        return True
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def pallas_interpret() -> bool:
+    """True when Pallas kernels should run in interpreter mode (CPU CI)."""
+    return bool(flag_value("pallas_interpret"))
+
+
+def pallas_dtype_ok(*arrays) -> bool:
+    """Mosaic lowers f32/bf16/f16 (and int) — never f64, which leaks in
+    easily with jax_enable_x64 on. Gate kernels back to XLA for those."""
+    import jax.numpy as jnp
+    for a in arrays:
+        if a.dtype in (jnp.float64,):
+            return False
+    return True

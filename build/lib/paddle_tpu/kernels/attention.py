@@ -1,0 +1,568 @@
+"""Flash attention for TPU.
+
+Reference parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu (the
+FlashAttention-2 CUDA binding used by paddle.nn.functional.
+scaled_dot_product_attention / flash_attention). TPU-native design: a
+Pallas kernel implementing blockwise online-softmax attention (the
+flash-attention recurrence) tiled for the MXU: Q blocks stay resident in
+VMEM while K/V blocks stream through; running max `m`, normalizer `l`
+and the f32 accumulator live in VMEM scratch across the KV grid axis.
+
+The backward pass recomputes attention blockwise (flash-style: no S×S
+materialization) using the saved `lse` — expressed in XLA ops, which the
+compiler fuses per-block; a dedicated Pallas backward kernel is a later
+optimization.
+
+Gradient plumbing goes through jax.custom_vjp so the kernel composes with
+the eager tape AND jax.grad under jit.
+"""
+from __future__ import annotations
+
+import functools
+import math as pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
+                      pallas_dtype_ok, pallas_interpret)
+
+
+def _zero_tail_rows(arr, blk_idx, block, limit):
+    """Zero the rows of a loaded block that lie beyond `limit` (the array's
+    true extent). Out-of-bounds block reads return unspecified padding —
+    possibly NaN — and 0 * NaN = NaN inside a dot contraction, so masking
+    the downstream math is NOT sufficient: the operand rows themselves must
+    be zeroed."""
+    if limit % block == 0:
+        return arr
+    ids = blk_idx * block + jax.lax.broadcasted_iota(
+        jnp.int32, arr.shape, 0)
+    return jnp.where(ids < limit, arr, 0)
+
+
+def _gqa_kv_row(h, H, Hkv):
+    """Map a flattened [B*H] query-head row index onto its [B*Hkv] kv row
+    (GQA group folding). The fwd and bwd BlockSpec index maps MUST agree
+    on this formula — single definition, used by both."""
+    if H == Hkv:
+        return h
+    return (h // H) * Hkv + (h % H) // (H // Hkv)
+
+
+def _pad_d_for_dtype(dtype, d):
+    """Head-dim padding target: bf16/f16 operands must fill the 128-wide
+    MXU lane dim for Mosaic's matmul legalization; f32 handles d=64 via
+    implicit lane padding."""
+    if dtype in (jnp.bfloat16, jnp.float16) and d % 128:
+        return ((d + 127) // 128) * 128
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel: works on [BH, S, D]
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, seq_k):
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    i = pl.program_id(1)
+
+    def _compute():
+        q = q_ref[0]  # (bq, d)
+        k = k_ref[0]  # (bk, d)
+        v = _zero_tail_rows(v_ref[0], j, block_k, seq_k)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * np.float32(scale)
+
+        if causal or seq_k % block_k:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            keep = k_ids < seq_k  # kv tail: padded columns must not
+            if causal:           # enter the softmax denominator
+                keep = jnp.logical_and(keep, q_ids >= k_ids)
+            s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]  # (bq,)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_scr[:] = (acc_scr[:] * alpha[:, None] +
+                      jax.lax.dot_general(
+                          p.astype(v.dtype), v,
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32))
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    if causal:
+        # skip fully-masked KV blocks (block start beyond the last q row)
+        @pl.when(j * block_k <= (i + 1) * block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == np.float32(0.0), np.float32(1.0), l)
+        o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+        # lse is materialized with a 128-wide lane dim (TPU tiling needs
+        # the last two block dims ≥ (8, 128)); caller slices lane 0.
+        lse_ref[0] = (m_scr[:] + jnp.log(safe_l)[:, None]
+                      ).astype(lse_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
+                      n_heads=None, n_kv_heads=None):
+    """q: [B*H, S, D]; k,v: [B*Hkv, S, D] → (out [B*H,S,D], lse [B*H,S]).
+
+    Native GQA/MQA (reference: flash_attn_kernel.cu's num_heads_k <
+    num_heads path): when Hkv < H the kv BlockSpec index maps fold the
+    query head onto its kv group — kv shards are NEVER repeated in HBM.
+
+    bf16/f16 with d % 128 != 0: Mosaic rejects the sub-lane-width bf16
+    matmul operand ("Bad lhs type"), so D is zero-padded to the 128-lane
+    boundary — the MXU processes 128 lanes either way, and zero K/Q
+    columns do not change Q.Kt; padded V columns are sliced off."""
+    bh, sq, d = q.shape
+    d_pad = _pad_d_for_dtype(q.dtype, d)
+    if d_pad != d:
+        pad = [(0, 0), (0, 0), (0, d_pad - d)]
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+        out, lse = _flash_fwd_pallas(q, k, v, scale, causal, block_q,
+                                     block_k, n_heads, n_kv_heads)
+        return out[..., :d], lse
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
+
+    H = n_heads or 1
+    Hkv = n_kv_heads or H
+
+    def kv_index(h, i, j):
+        return (_gqa_kv_row(h, H, Hkv), j, _Z)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=sk)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
+            pl.BlockSpec((1, block_q, 128), lambda h, i, j: (h, i, _Z)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),    # accumulator
+        ],
+        interpret=pallas_interpret(),
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (flash-attention-2 style: recompute P blockwise
+# from the saved lse — no S×S tensor ever materializes in HBM).
+# Reference parity: the bwd kernels of phi/kernels/gpu/flash_attn_kernel.cu
+# (flash_attn_bwd); dk/dv accumulate over the q-block axis, dq over the
+# kv-block axis, each in f32 VMEM scratch.
+# ---------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr,
+                     *, scale, causal, block_q, block_k, seq_q, seq_k):
+    j = pl.program_id(1)   # kv block
+    i = pl.program_id(2)   # q block (innermost: accumulation axis)
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        # tail blocks: out-of-bounds rows must be ZEROED, not just masked
+        # downstream (0 * NaN-padding = NaN inside the dots)
+        q = _zero_tail_rows(q_ref[0], i, block_q, seq_q
+                            ).astype(jnp.float32)        # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                 # (bk, d)
+        v = _zero_tail_rows(v_ref[0], j, block_k, seq_k
+                            ).astype(jnp.float32)
+        do = _zero_tail_rows(do_ref[0], i, block_q, seq_q
+                             ).astype(jnp.float32)       # (bq, d)
+        lse = lse_ref[0]                     # (bq,)
+        delta = delta_ref[0]                 # (bq,)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * np.float32(scale)
+        if causal or seq_q % block_q or seq_k % block_k:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            # padded q rows (garbage lse/delta) and padded kv columns
+            # must contribute nothing to dk/dv
+            keep = jnp.logical_and(q_ids < seq_q, k_ids < seq_k)
+            if causal:
+                keep = jnp.logical_and(keep, q_ids >= k_ids)
+            s = jnp.where(keep, s, _NEG_INF)
+            p = jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
+        else:
+            keep = None
+            p = jnp.exp(s - lse[:, None])    # (bq, bk)
+        # dv += p^T do
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * np.float32(scale)
+        if keep is not None:
+            # guard against NaN/Inf garbage in out-of-bounds lse/delta
+            # tail reads: 0 * inf would poison the accumulators
+            ds = jnp.where(keep, ds, 0.0)
+        # dk += ds^T q
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # q block overlaps the causal triangle of this kv block
+        @pl.when((i + 1) * block_q - 1 >= j * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+                   seq_q, seq_k):
+    i = pl.program_id(1)   # q block
+    j = pl.program_id(2)   # kv block (innermost: accumulation axis)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = _zero_tail_rows(k_ref[0], j, block_k, seq_k
+                            ).astype(jnp.float32)
+        v = _zero_tail_rows(v_ref[0], j, block_k, seq_k
+                            ).astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * np.float32(scale)
+        keep = None
+        if causal or seq_k % block_k:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            # kv-tail columns must not contribute to dq; q-tail rows
+            # compute garbage but their dq writes land out of bounds
+            # and are dropped
+            keep = k_ids < seq_k
+            if causal:
+                keep = jnp.logical_and(keep, q_ids >= k_ids)
+            s = jnp.where(keep, s, _NEG_INF)
+        p = (jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
+             if keep is not None else jnp.exp(s - lse[:, None]))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * np.float32(scale)
+        if keep is not None:
+            ds = jnp.where(keep, ds, 0.0)
+        # dq += ds k
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(j * block_k <= (i + 1) * block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
+                      block_q=128, block_k=128, n_heads=None,
+                      n_kv_heads=None):
+    """q,o,do: [B*H, S, D]; k,v: [B*Hkv, S, D]; lse: [B*H, S] (f32).
+    Returns dq [B*H,...], dk/dv [B*H,...] (per query head — group-sum for
+    GQA)."""
+    bh, sq, d = q.shape
+    d_pad = _pad_d_for_dtype(q.dtype, d)
+    if d_pad != d:
+        pad = [(0, 0), (0, 0), (0, d_pad - d)]
+        q, k, v, o, do = (jnp.pad(a, pad) for a in (q, k, v, o, do))
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
+                                       block_q, block_k, n_heads,
+                                       n_kv_heads)
+        return dq[..., :d], dk[..., :d], dv[..., :d]
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    # delta_i = rowsum(do * o): tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    H = n_heads or 1
+    Hkv = n_kv_heads or H
+
+    def kv_in(h, a, b, kv_block):
+        return (_gqa_kv_row(h, H, Hkv), kv_block, _Z)
+
+    q_spec_i = pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, b, _Z))
+    k_in_j = pl.BlockSpec((1, block_k, d), lambda h, a, b: kv_in(h, a, b, a))
+    k_out_j = pl.BlockSpec((1, block_k, d), lambda h, a, b: (h, a, _Z))
+    row_i = pl.BlockSpec((1, block_q), lambda h, a, b: (h, b))
+    # GQA: dk/dv come out PER QUERY HEAD ([B*H, Sk, D]); the wrapper
+    # group-sums them down to [B*Hkv, ...] — kv inputs are still never
+    # repeated in HBM.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_q=sq, seq_k=sk),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_i, k_in_j, k_in_j, q_spec_i, row_i, row_i],
+        out_specs=[k_out_j, k_out_j],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, a, _Z))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda h, a, b: kv_in(h, a, b, b))
+    row_q = pl.BlockSpec((1, block_q), lambda h, a, b: (h, a))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_q=sq, seq_k=sk),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_q, row_q],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (used on CPU, with masks/dropout, and as bwd recompute)
+# ---------------------------------------------------------------------------
+
+def _xla_attention(q, k, v, scale, causal, mask=None, dropout_p=0.0,
+                   dropout_key=None):
+    """q,k,v: [B, S, H, D] (paddle flash layout). GQA (fewer kv heads)
+    handled by repeating kv — the Pallas path avoids the repeat."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    cdt = jnp.promote_types(q.dtype, jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=cdt) * jnp.asarray(scale, cdt)
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            s = jnp.where(mask, s, _NEG_INF)
+        else:
+            s = s + mask.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=cdt).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (pure jax level, [B,S,H,D] layout)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, scale, causal):
+    return _flash_fwd(q, k, v, scale, causal)[0]
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, k.shape[1], d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, v.shape[1], d)
+    out, lse = _flash_fwd_pallas(qt, kt, vt, scale, causal,
+                                 n_heads=h, n_kv_heads=hkv)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v, out, lse.reshape(b, h, sq))
+
+
+def _flash_bwd(scale, causal, res, g):
+    """Backward: Pallas flash-2 kernels when available (dk/dv and dq
+    accumulated blockwise from the saved lse — no S×S materialization),
+    else the XLA einsum recompute below."""
+    q, k, v, out, lse = res
+    d = q.shape[-1]
+    if (_use_pallas() and pallas_dtype_ok(q, k, v, g)
+            and q.shape[1] >= 8 and d % 64 == 0):
+        b, sq, h, _ = q.shape
+        sk = k.shape[1]
+        hkv = k.shape[2]
+
+        def to3(x, s, nh):
+            return x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
+        dq3, dk3, dv3 = _flash_bwd_pallas(
+            to3(q, sq, h), to3(k, sk, hkv), to3(v, sk, hkv),
+            to3(out, sq, h), lse.reshape(b * h, sq),
+            to3(g.astype(q.dtype), sq, h), scale, causal,
+            n_heads=h, n_kv_heads=hkv)
+        dq = dq3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+        # GQA: per-query-head dk/dv group-sum down to the kv heads
+        dk = dk3.reshape(b, hkv, h // hkv, sk, d).sum(2)
+        dv = dv3.reshape(b, hkv, h // hkv, sk, d).sum(2)
+        dk = dk.transpose(0, 2, 1, 3)
+        dv = dv.transpose(0, 2, 1, 3)
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+    if k.shape[2] != q.shape[2]:
+        # GQA fallback: repeat kv, compute per-q-head, group-sum at the end
+        rep = q.shape[2] // k.shape[2]
+        dq_, dk_, dv_ = _flash_bwd(
+            scale, causal, (q, jnp.repeat(k, rep, axis=2),
+                            jnp.repeat(v, rep, axis=2), out, lse), g)
+        b_, sk_, h_, d_ = dk_.shape
+        dk_ = dk_.reshape(b_, sk_, h_ // rep, rep, d_).sum(3)
+        dv_ = dv_.reshape(b_, sk_, h_ // rep, rep, d_).sum(3)
+        return dq_, dk_.astype(k.dtype), dv_.astype(v.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * np.float32(scale)
+    if causal:
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])  # recomputed softmax via saved lse
+    gf = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", gf,
+                    v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (b, sq, h)
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * np.float32(scale)
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_core.defvjp(lambda q, k, v, scale, causal: _flash_fwd(q, k, v, scale, causal),
+                   _flash_bwd)
+
+
+def flash_attention_jax(query, key, value, *, causal=False, scale=None,
+                        mask=None, dropout_p=0.0, dropout_key=None):
+    """Pure-jax entry ([B,S,H,D] arrays). Chooses Pallas vs XLA."""
+    d = query.shape[-1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    # d only needs to be a multiple of 64: the kernel's block last-dim
+    # equals the full array dim, which TPU tiling always accepts (lanes
+    # are padded to 128 internally for d=64 — still beats XLA attention)
+    plausible = (_use_pallas() and pallas_dtype_ok(query, key, value)
+                 and mask is None and dropout_p == 0.0
+                 and query.shape[1] >= 8 and d % 64 == 0
+                 and query.shape[2] % key.shape[2] == 0)
+    if plausible:
+        return _flash_core(query, key, value, sc, causal)
+    return _xla_attention(query, key, value, sc, causal, mask=mask,
+                          dropout_p=dropout_p, dropout_key=dropout_key)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-level API (tape-aware)
+# ---------------------------------------------------------------------------
+
+def flash_attention_bshd(query, key, value, attn_mask=None, dropout_p=0.0,
+                         is_causal=False, training=True, scale=None):
+    """paddle scaled_dot_product_attention parity: [B, S, H, D] in/out."""
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+    from ..framework.random import next_key
+
+    args = [_coerce(query), _coerce(key), _coerce(value)]
+    has_mask = attn_mask is not None
+    if has_mask:
+        args.append(_coerce(attn_mask))
+    key_drop = next_key() if (dropout_p > 0.0 and training) else None
+
+    def fn(q, k, v, *m):
+        return flash_attention_jax(
+            q, k, v, causal=is_causal, scale=scale,
+            mask=m[0] if has_mask else None,
+            dropout_p=dropout_p if training else 0.0,
+            dropout_key=key_drop)
+    return apply(fn, *args, _name="flash_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = flash_attention_bshd(query, key, value, dropout_p=dropout,
+                               is_causal=causal, training=training)
+    return out, None

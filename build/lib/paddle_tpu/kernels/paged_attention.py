@@ -1,0 +1,171 @@
+"""Paged (block) attention for serving decode.
+
+Reference parity: paddle/phi/kernels/fusion/gpu block_multihead_attention
+(the paged KV-cache attention behind paddle.incubate.nn.functional.
+block_multihead_attention, used by PaddleNLP's inference server) and the
+vLLM-style PagedAttention it mirrors.
+
+TPU-native design: the KV cache lives in HBM as fixed-size pages
+[num_pages, page_size, n_kv_heads, head_dim]; each sequence owns a block
+table of page indices. One decode step attends a single query token per
+sequence against its pages. The Pallas kernel streams pages through VMEM
+with the block table supplied via *scalar prefetch* (the table is read on
+the scalar core BEFORE the grid runs, so page fetches become plain block
+DMAs — the canonical TPU paged-attention pattern; cf. PAPERS.md "Ragged
+Paged Attention" and jax.experimental.pallas.ops.tpu.paged_attention).
+Online softmax accumulates across pages in VMEM scratch.
+"""
+from __future__ import annotations
+
+import functools
+import math as pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
+                      pallas_dtype_ok, pallas_interpret)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (any GQA ratio; used on CPU and as the numeric oracle)
+# ---------------------------------------------------------------------------
+
+def _paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
+                         scale):
+    """q: [B, H, D]; pages: [P, page, Hkv, D]; tables: [B, pages_per_seq];
+    context_lens: [B] → out [B, H, D]."""
+    page = k_pages.shape[1]
+    h = q.shape[1]
+    hkv = k_pages.shape[2]
+
+    def one(qb, bt, cl):
+        k = k_pages[bt].reshape(-1, hkv, k_pages.shape[-1])  # [L, Hkv, D]
+        v = v_pages[bt].reshape(-1, hkv, v_pages.shape[-1])
+        if hkv != h:
+            rep = h // hkv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        s = jnp.einsum("hd,khd->hk", qb, k,
+                       preferred_element_type=jnp.float32) * np.float32(scale)
+        valid = jnp.arange(k.shape[0]) < cl
+        s = jnp.where(valid[None, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("hk,khd->hd", p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32).astype(qb.dtype)
+
+    return jax.vmap(one)(q, block_tables, context_lens)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (H == Hkv fast path), block table via scalar prefetch
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale, page_size):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ctx = lens_ref[b]
+
+    @pl.when(j * page_size < ctx)
+    def _compute():
+        # Mosaic's dot lowering has no batched-dim support, so the
+        # per-head contraction is expressed as VPU multiply+reduce —
+        # for decode (1 query token, small pages) the MXU has nothing
+        # to tile anyway.
+        q = q_ref[0].astype(jnp.float32)   # (H, D)
+        k = k_ref[0].astype(jnp.float32)   # (page, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.sum(q[None, :, :] * k, axis=-1) * np.float32(scale)  # (page, H)
+        tok = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        s = jnp.where(tok < ctx, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]                       # (H,)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))
+        p = jnp.exp(s - m_new[None, :])            # (page, H)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=0)
+        pv = jnp.sum(p[:, :, None] * v, axis=0)    # (H, D)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == np.float32(0.0), np.float32(1.0), l)
+        o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, block_tables, context_lens,
+                            scale, interpret=False):
+    """H == Hkv path. q: [B, H, D] → [B, H, D]."""
+    b, h, d = q.shape
+    page = k_pages.shape[1]
+    pages_per_seq = block_tables.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda b_, j, tr, lr: (b_, _Z, _Z)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda b_, j, tr, lr: (tr[b_, j], _Z, _Z, _Z)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda b_, j, tr, lr: (tr[b_, j], _Z, _Z, _Z)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda b_, j, tr, lr: (b_, _Z, _Z)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale, page_size=page)
+    # pages are indexed per (b, j); flatten K/V page dims stay as-is
+    kq = k_pages.reshape(k_pages.shape[0], page, h, d)
+    vq = v_pages.reshape(v_pages.shape[0], page, h, d)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, kq, vq)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None, interpret=False):
+    """Single-step decode attention over a paged KV cache.
+
+    q: [B, H, D] (one query token per sequence)
+    k_pages/v_pages: [num_pages, page_size, n_kv_heads, D]
+    block_tables: [B, pages_per_seq] int32 page ids per sequence
+    context_lens: [B] int32 valid token counts
+    Returns [B, H, D].
+    """
+    h = q.shape[1]
+    hkv = k_pages.shape[2]
+    d = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    interpret = interpret or pallas_interpret()
+    use_kernel = ((interpret or (_use_pallas()
+                                 and pallas_dtype_ok(q, k_pages, v_pages)))
+                  and h == hkv and d % 128 == 0 and h % 8 == 0)
+    if use_kernel:
+        return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                       context_lens, sc, interpret=interpret)
+    return _paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                context_lens, sc)

@@ -1,0 +1,257 @@
+"""Ring attention (context parallelism) + Ulysses sequence parallelism.
+
+Reference parity: the "sep" (segment parallel) mesh dimension in
+fleet/base/topology.py plus the PaddleNLP ecosystem implementations
+(llm ring_flash_attention.py `RingFlashAttention` — K/V blocks rotated
+around the sep group over p2p send/recv with online-softmax accumulation;
+Ulysses = head-scatter/seq-gather alltoall around attention built on
+paddle.distributed.alltoall).
+
+TPU-native design (SURVEY.md §5.7): the sep group IS the mesh 'context'
+axis. Ring attention is a `shard_map` over that axis; K/V shards rotate
+via `lax.ppermute` inside a `lax.scan`, accumulating with the blockwise
+(flash) online-softmax recurrence in f32. The scan is reverse-mode
+differentiable, so the backward pass is the transposed ring (XLA derives
+it) — no hand-written p2p. Collectives ride ICI; compute of step t
+overlaps the permute of step t+1 under XLA's latency-hiding scheduler.
+
+Ulysses is two `lax.all_to_all`s: seq-sharded -> head-sharded, local full
+(flash) attention, then back. Both paths degrade to plain flash attention
+when the context axis has size 1.
+"""
+from __future__ import annotations
+
+import math as pymath
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..distributed.mesh import get_mesh, axis_size
+
+_NEG_INF = -1e30
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map as _sm  # jax >= 0.8
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention core (runs INSIDE shard_map; local shards [B, Sl, H, D])
+# ---------------------------------------------------------------------------
+
+def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
+    """Blockwise online-softmax attention with the K/V shard rotating
+    around the `axis_name` ring. All accumulation in f32. The local block
+    is consumed before the scan so only cp-1 ppermutes are issued (a
+    permute whose result is never read still costs ICI traffic — XLA
+    cannot DCE a collective out of a shared scan body)."""
+    b, sl, h, d = q.shape
+    idx = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((b, h, sl), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sl), jnp.float32)
+    acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    q_pos = idx * sl + lax.broadcasted_iota(jnp.int32, (sl, k.shape[1]), 0)
+
+    def accumulate(k_blk, v_blk, m, l, acc, src):
+        """One online-softmax update against the block originating at
+        ring rank `src`."""
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * k.shape[1] + lax.broadcasted_iota(
+                jnp.int32, (sl, k.shape[1]), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)  # (b, h, sl)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+        return m_new, l_new, acc_new
+
+    # step 0: this rank's own block, no communication
+    m, l, acc = accumulate(k, v, m0, l0, acc0, idx)
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        # rotate first, then consume: after t rotations the block at this
+        # rank originated at rank (idx - t) mod cp
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (idx - t) % cp
+        if causal:
+            # skip blocks that are entirely in the future (src > idx):
+            # a real HLO conditional, so early ranks save the FLOPs.
+            # (Wall-clock is still bounded by the last rank; zig-zag
+            # sequence sharding to balance the ring is a planned upgrade.)
+            m, l, acc = lax.cond(
+                src <= idx,
+                lambda ops: accumulate(*ops, src),
+                lambda ops: (ops[2], ops[3], ops[4]),
+                (k_blk, v_blk, m, l, acc))
+        else:
+            m, l, acc = accumulate(k_blk, v_blk, m, l, acc, src)
+        return (k_blk, v_blk, m, l, acc), None
+
+    if cp > 1:
+        (_, _, m, l, acc), _ = lax.scan(
+            step, (k, v, m, l, acc), jnp.arange(1, cp))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / safe_l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_jax(query, key, value, *, causal=False, scale=None,
+                       axis_name="context", mesh=None):
+    """Pure-jax ring attention. [B, S, H, D] GLOBAL arrays; the sequence
+    dim is sharded over `axis_name` by the shard_map. Falls back to plain
+    flash attention when the axis is trivial."""
+    mesh = mesh or get_mesh()
+    cp = axis_size(axis_name, mesh)
+    d = query.shape[-1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    if mesh is None or cp <= 1:
+        from .attention import flash_attention_jax
+        return flash_attention_jax(query, key, value, causal=causal, scale=sc)
+
+    spec = P(None, axis_name, None, None)
+
+    def local(q, k, v):
+        return _ring_attention_local(q, k, v, axis_name=axis_name, cp=cp,
+                                     causal=causal, scale=sc)
+
+    return _shard_map(local, mesh, (spec, spec, spec), spec)(
+        query, key, value)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (DeepSpeed-style) sequence parallelism: two all_to_alls
+# ---------------------------------------------------------------------------
+
+def _ulysses_local(q, k, v, *, axis_name, causal, scale):
+    """Local shards [B, Sl, H, D] -> a2a -> full-seq [B, S, H/cp, D] ->
+    attention -> a2a back."""
+    def seq2head(x):
+        # split heads over the axis, gather sequence
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    from .attention import flash_attention_jax
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    out = flash_attention_jax(qh, kh, vh, causal=causal, scale=scale)
+    return head2seq(out)
+
+
+def ulysses_attention_jax(query, key, value, *, causal=False, scale=None,
+                          axis_name="context", mesh=None):
+    """Ulysses attention on GLOBAL [B, S, H, D] arrays (seq sharded over
+    `axis_name` inside). Requires num_heads % cp == 0."""
+    mesh = mesh or get_mesh()
+    cp = axis_size(axis_name, mesh)
+    d = query.shape[-1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    if mesh is None or cp <= 1:
+        from .attention import flash_attention_jax
+        return flash_attention_jax(query, key, value, causal=causal, scale=sc)
+    if query.shape[2] % cp:
+        raise ValueError(
+            f"ulysses: num_heads {query.shape[2]} not divisible by "
+            f"context-parallel degree {cp}")
+
+    spec = P(None, axis_name, None, None)
+
+    def local(q, k, v):
+        return _ulysses_local(q, k, v, axis_name=axis_name, causal=causal,
+                              scale=sc)
+
+    return _shard_map(local, mesh, (spec, spec, spec), spec)(
+        query, key, value)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-level API (tape-aware) — PaddleNLP RingFlashAttention parity
+# ---------------------------------------------------------------------------
+
+def _tensor_entry(fn_jax, query, key, value, causal, scale, group):
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+
+    axis_name = getattr(group, "axis", None) or "context"
+
+    def fn(q, k, v):
+        return fn_jax(q, k, v, causal=causal, scale=scale,
+                      axis_name=axis_name)
+
+    return apply(fn, _coerce(query), _coerce(key), _coerce(value),
+                 _name="ring_attention")
+
+
+def _check_unsupported(attn_mask, dropout):
+    if attn_mask is not None:
+        raise NotImplementedError(
+            "ring/Ulysses attention does not support attn_mask yet; use "
+            "is_causal= for causal masking")
+    if dropout:
+        raise NotImplementedError(
+            "ring/Ulysses attention does not support dropout yet")
+
+
+class RingFlashAttention:
+    """PaddleNLP `RingFlashAttention.apply(q, k, v, group=...)` parity.
+    Tensors are [B, S, H, D] with S the (logically global) sequence."""
+
+    @staticmethod
+    def apply(query, key, value, group=None, is_causal=True, scale=None,
+              attn_mask=None, dropout=0.0):
+        _check_unsupported(attn_mask, dropout)
+        return _tensor_entry(ring_attention_jax, query, key, value,
+                             is_causal, scale, group)
+
+
+class UlyssesAttention:
+    @staticmethod
+    def apply(query, key, value, group=None, is_causal=True, scale=None,
+              attn_mask=None, dropout=0.0):
+        _check_unsupported(attn_mask, dropout)
+        return _tensor_entry(ulysses_attention_jax, query, key, value,
+                             is_causal, scale, group)
+
+
+def ring_flash_attention(query, key, value, is_causal=True, scale=None,
+                         group=None):
+    return RingFlashAttention.apply(query, key, value, group=group,
+                                    is_causal=is_causal, scale=scale)
+
+
+def split_inputs_sequence_dim(inputs, rank=None, degree=None, axis=1):
+    """Parity helper (PaddleNLP trainer): under single-controller SPMD the
+    global batch stays whole; sharding over 'context' happens via specs, so
+    this is an identity that validates divisibility."""
+    degree = degree or axis_size("context")
+    if degree > 1:
+        shape = inputs.shape if hasattr(inputs, "shape") else None
+        if shape is not None and shape[axis] % degree:
+            raise ValueError(
+                f"sequence length {shape[axis]} not divisible by sep degree "
+                f"{degree}")
+    return inputs

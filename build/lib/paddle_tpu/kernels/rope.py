@@ -1,0 +1,56 @@
+"""Rotary position embedding (RoPE).
+
+Reference parity: paddle/phi/kernels/fusion/gpu/fused_rope (fused_rotary_
+position_embedding). On TPU the rotate-half + multiply pattern is a pure
+VPU elementwise chain that XLA fuses into the surrounding matmuls, so the
+"fused kernel" is simply this jax function kept free of intermediate
+materialization; a Pallas variant adds nothing over XLA fusion here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_emb(q, k, cos, sin, position_ids=None, use_neox=True):
+    """q,k: [B, S, H, D]; cos/sin: [S, D], [B, S, D] (pre-gathered per
+    batch row, e.g. left-padded generation) or [1, S, 1, D].
+
+    Returns rotated (q, k) with f32 trig applied in the activation dtype.
+    """
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    elif cos.ndim == 3:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    if position_ids is not None:
+        cos = jnp.take(cos[0, :, 0], position_ids, axis=0)[:, :, None, :]
+        sin = jnp.take(sin[0, :, 0], position_ids, axis=0)[:, :, None, :]
+    cos = cos.astype(q.dtype)
+    sin = sin.astype(q.dtype)
+    if use_neox:
+        q_out = q * cos + _rotate_half(q) * sin
+        k_out = k * cos + _rotate_half(k) * sin
+    else:
+        # GPT-J interleaved style
+        def rot(x):
+            x1 = x[..., ::2]
+            x2 = x[..., 1::2]
+            return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        q_out = q * cos + rot(q) * sin
+        k_out = k * cos + rot(k) * sin
+    return q_out, k_out
+
+
+def rope_freqs(head_dim, max_seq_len, base=10000.0, dtype=jnp.float32):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)

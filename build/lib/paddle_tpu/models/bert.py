@@ -1,0 +1,127 @@
+"""BERT/ERNIE family (driver config #2: BERT-base / ERNIE-3.0 fine-tune
+with Fleet DP). Ecosystem parity: paddlenlp/transformers/bert/modeling.py."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Embedding, Linear, LayerNorm, Dropout, LayerList
+from ..nn.transformer import TransformerEncoderLayer, TransformerEncoder
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops import manipulation as M
+from ..ops import creation as C
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+
+    @staticmethod
+    def base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=1000, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return BertConfig(**base)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = C.arange(s, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = C.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden_states):
+        first = hidden_states[:, 0]
+        return F.tanh(self.dense(first))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] padding mask → additive [B, 1, 1, S]
+            am = M.unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - am.astype("float32")) * -1e4
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        h = self.encoder(h, attention_mask)
+        pooled = self.pooler(h)
+        return h, pooled
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# ERNIE shares the architecture (ecosystem parity: ernie models are
+# BERT-arch with different pretraining); alias the classes
+ErnieConfig = BertConfig
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification

@@ -1,0 +1,157 @@
+"""ERNIE family (driver config #2: "BERT-base / ERNIE-3.0 fine-tune").
+
+Ecosystem parity: PaddleNLP paddlenlp/transformers/ernie/modeling.py —
+ERNIE shares BERT's encoder skeleton with task-type embeddings added
+(ErnieModel adds `task_type_ids` on top of word/position/token-type)
+and PaddleNLP-style task heads (sequence classification, token
+classification, question answering).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Embedding, Linear, LayerNorm, Dropout
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops import creation as C
+from ..ops import manipulation as M
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForTokenClassification", "ErnieForQuestionAnswering"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.1
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return ErnieConfig(**base)
+
+
+class ErnieEmbeddings(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=init)
+        self.use_task_id = config.use_task_id
+        if config.use_task_id:
+            self.task_type_embeddings = Embedding(
+                config.task_type_vocab_size, config.hidden_size,
+                weight_attr=init)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = C.arange(s, dtype="int64")
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids)
+        if token_type_ids is None:
+            token_type_ids = C.zeros([s], dtype="int64")
+        emb = emb + self.token_type_embeddings(token_type_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = C.zeros([s], dtype="int64")
+            emb = emb + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation="gelu")
+        self.encoder = TransformerEncoder(layer, config.num_hidden_layers)
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        if attention_mask is not None:
+            # [B, S] 1/0 -> broadcastable BOOLEAN key mask [B, 1, 1, S]
+            # (int masks would be treated as additive bias by SDPA)
+            attention_mask = M.reshape(
+                attention_mask,
+                [attention_mask.shape[0], 1, 1, attention_mask.shape[1]])
+            if "bool" not in str(attention_mask.dtype):
+                attention_mask = attention_mask.astype("bool")
+        h = self.encoder(h, src_mask=attention_mask)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask, task_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForTokenClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        h, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                          attention_mask, task_type_ids)
+        return self.classifier(self.dropout(h))
+
+
+class ErnieForQuestionAnswering(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.classifier = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        h, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                          attention_mask, task_type_ids)
+        logits = self.classifier(h)
+        start, end = M.split(logits, 2, axis=-1)
+        return M.squeeze(start, axis=-1), M.squeeze(end, axis=-1)

@@ -1,0 +1,122 @@
+"""GPT family (ecosystem parity: paddlenlp/transformers/gpt/modeling.py) —
+decoder-only with learned positions; exercises the same TP layers as
+Llama with LayerNorm+GELU instead of RMSNorm+SwiGLU."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Embedding, Linear, LayerNorm, Dropout, LayerList
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops import manipulation as M
+from ..ops import creation as C
+from ..generation import GenerationMixin
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    parallel_matmul)
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    tensor_parallel: bool = False
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return GPTConfig(**base)
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        h, heads = config.hidden_size, config.num_attention_heads
+        self.head_dim = h // heads
+        self.num_heads = heads
+        tp = config.tensor_parallel
+        if tp:
+            self.qkv = ColumnParallelLinear(h, 3 * h, weight_attr=init,
+                                            gather_output=False)
+            self.proj = RowParallelLinear(h, h, weight_attr=init,
+                                          input_is_parallel=True)
+            self.fc1 = ColumnParallelLinear(h, config.intermediate_size,
+                                            weight_attr=init,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(config.intermediate_size, h,
+                                         weight_attr=init,
+                                         input_is_parallel=True)
+        else:
+            self.qkv = Linear(h, 3 * h, weight_attr=init)
+            self.proj = Linear(h, h, weight_attr=init)
+            self.fc1 = Linear(h, config.intermediate_size, weight_attr=init)
+            self.fc2 = Linear(config.intermediate_size, h, weight_attr=init)
+        self.ln1 = LayerNorm(h)
+        self.ln2 = LayerNorm(h)
+        self.attn_drop = config.attention_probs_dropout_prob
+        self.drop = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        y = self.ln1(x)
+        qkv = M.reshape(self.qkv(y), [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = M.unbind(qkv, axis=2)
+        att = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.attn_drop,
+            training=self.training)
+        att = M.reshape(att, [b, s, h])
+        x = x + self.drop(self.proj(att))
+        y = self.ln2(x)
+        y = self.fc2(F.gelu(self.fc1(y), approximate=True))
+        return x + self.drop(y)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = Normal(0.0, config.initializer_range)
+        if config.tensor_parallel:
+            self.wte = VocabParallelEmbedding(config.vocab_size,
+                                              config.hidden_size,
+                                              weight_attr=init)
+        else:
+            self.wte = Embedding(config.vocab_size, config.hidden_size,
+                                 weight_attr=init)
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size, weight_attr=init)
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = C.arange(s, dtype="int64")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer, GenerationMixin):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        return parallel_matmul(h, self.gpt.wte.weight, transpose_y=True,
+                               tensor_parallel_output=False)

@@ -1,0 +1,40 @@
+"""paddle.nn parity namespace (python/paddle/nn/__init__.py)."""
+from __future__ import annotations
+
+from .layer_base import Layer
+from . import functional
+from . import initializer
+from .initializer import ParamAttr
+from .layers_common import (
+    Sequential, LayerList, LayerDict, ParameterList,
+    Linear,
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+    Embedding,
+    Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Tanhshrink, Hardshrink,
+    Hardsigmoid, Hardswish, Hardtanh, Softshrink, Softsign, Swish, Silu, Mish,
+    SELU, CELU, ELU, GELU, LeakyReLU, Softplus, Maxout, GLU, Softmax,
+    LogSoftmax, PReLU,
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    Flatten, Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    PixelShuffle, PixelUnshuffle, Unfold, CosineSimilarity, Bilinear,
+)
+from .transformer import (
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .losses import (
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
+    TripletMarginLoss, HingeEmbeddingLoss,
+)
+from .rnn import (
+    SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, RNNBase,
+)
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm

@@ -1,0 +1,75 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py:
+ClipGradByValue / ClipGradByNorm / ClipGradByGlobalNorm). Optimizers call
+`_clip(params_grads)`; under jit these clip chains fuse into the fused
+optimizer update."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops._dispatch import apply
+from ..tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply(lambda v: jnp.clip(v, self.min, self.max), g)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            def fn(v):
+                n = jnp.sqrt(jnp.sum(v * v))
+                scale = jnp.where(n > self.clip_norm, self.clip_norm / n, 1.0)
+                return v * scale
+            out.append((p, apply(fn, g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        gs = [g for p, g in params_grads
+              if g is not None and getattr(p, "need_clip", True)]
+        if not gs:
+            return params_grads
+        def sq(v):
+            return jnp.sum(jnp.square(v.astype(jnp.float32)))
+        total = apply(lambda *vs: sum(jnp.sum(jnp.square(v.astype(jnp.float32))) for v in vs), *gs)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            def fn(v, t):
+                gn = jnp.sqrt(t)
+                scale = jnp.where(gn > self.clip_norm,
+                                  self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+                return v * scale.astype(v.dtype)
+            out.append((p, apply(fn, g, total)))
+        return out

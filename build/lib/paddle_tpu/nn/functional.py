@@ -1,0 +1,1097 @@
+"""paddle.nn.functional — functional neural net ops.
+
+Reference parity: python/paddle/nn/functional/*.py (activation, common,
+conv, norm, loss, pooling, input). Conv/pool lower to
+lax.conv_general_dilated / lax.reduce_window — XLA tiles these onto the
+MXU; there is no cuDNN-style algorithm selection because XLA picks the
+schedule at compile time (replaces paddle/phi/kernels/gpu/conv_kernel.cu).
+"""
+from __future__ import annotations
+
+import math as pymath
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+from ..framework.random import next_key
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+from .._grad_mode import is_grad_enabled
+
+
+# ------------------------------------------------------------ activations --
+def relu(x, name=None):
+    return apply(jax.nn.relu, _coerce(x), _name="relu")
+
+
+def relu_(x, name=None):
+    return x._inplace_update(relu(x))
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, _coerce(x))
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, _coerce(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, _coerce(x))
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, _coerce(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), _coerce(x),
+                 _name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, _coerce(x), _name="silu")
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply(jax.nn.mish, _coerce(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha=alpha), _coerce(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                 _coerce(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha=alpha), _coerce(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope=negative_slope),
+                 _coerce(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+    return apply(fn, _coerce(x), _coerce(weight))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    x = _coerce(x)
+    if training:
+        a = jax.random.uniform(next_key(), tuple(x._value.shape),
+                               minval=lower, maxval=upper)
+        return apply(lambda v: jnp.where(v >= 0, v, a.astype(v.dtype) * v), x)
+    mid = (lower + upper) / 2.0
+    return apply(lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), _coerce(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), _coerce(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold, 0.0)),
+                 _coerce(x))
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), _coerce(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), _coerce(x))
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, _coerce(x))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply(lambda v: jnp.where(beta * v > threshold, v,
+                                     jnp.log1p(jnp.exp(beta * v)) / beta),
+                 _coerce(x))
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, _coerce(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        sh = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(sh), axis=ax + 1)
+    return apply(fn, _coerce(x))
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), _coerce(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    def fn(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+    return apply(fn, _coerce(x), _name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    def fn(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply(fn, _coerce(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = _coerce(x)
+    g = jax.random.gumbel(next_key(), tuple(x._value.shape))
+    def fn(v):
+        y = jax.nn.softmax((v + g.astype(v.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[
+                tuple(jnp.indices(y.shape))[0:axis % y.ndim] + ()].set(0)
+            onehot = jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis],
+                                    axis=axis, dtype=y.dtype)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply(fn, x)
+
+
+# ----------------------------------------------------------------- linear --
+def linear(x, weight, bias=None, name=None):
+    """paddle semantics: weight is [in_features, out_features] (NOT torch's
+    transposed layout) — y = x @ W + b.
+    Parity: python/paddle/nn/functional/common.py::linear →
+    phi fc/matmul kernel."""
+    if bias is None:
+        return apply(lambda v, w: v @ w, _coerce(x), _coerce(weight),
+                     _name="linear")
+    return apply(lambda v, w, b: v @ w + b, _coerce(x), _coerce(weight),
+                 _coerce(bias), _name="linear")
+
+
+# ---------------------------------------------------------------- dropout --
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = _coerce(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda v: v * (1 - p), x)
+        return x
+    if p == 1.0:
+        return apply(lambda v: jnp.zeros_like(v), x)
+    shape = list(x._value.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        shape = [s if i in [a % len(shape) for a in axes] else 1
+                 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(shape))
+    def fn(v):
+        m = keep.astype(v.dtype)
+        if mode == "upscale_in_train":
+            return v * m / (1.0 - p)
+        return v * m
+    return apply(fn, x, _name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [2, 3] if data_format == "NCHW" else [1, 2]
+    keep_axes = [i for i in range(4) if i not in ax]
+    return dropout(x, p, axis=keep_axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [2, 3, 4] if data_format == "NCDHW" else [1, 2, 3]
+    keep_axes = [i for i in range(5) if i not in ax]
+    return dropout(x, p, axis=keep_axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _coerce(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x._value.shape))
+    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * p * alpha_p
+    def fn(v):
+        m = keep
+        return a * jnp.where(m, v, alpha_p) + b
+    return apply(fn, x)
+
+
+# ------------------------------------------------------------------- conv --
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, ndim,
+             channel_last, transpose=False, output_padding=0):
+    n_sp = ndim
+    stride = _pair(stride, n_sp)
+    dilation = _pair(dilation, n_sp)
+
+    if channel_last:
+        # NHWC-style
+        lhs_spec = "N" + "".join("DHW"[3 - n_sp + i] for i in range(n_sp)) + "C"
+    else:
+        lhs_spec = "NC" + "".join("DHW"[3 - n_sp + i] for i in range(n_sp))
+    rhs_spec = "OI" + "".join("DHW"[3 - n_sp + i] for i in range(n_sp))
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        (1,) * (n_sp + 2), (1,) * (n_sp + 2), (lhs_spec, rhs_spec, out_spec))
+
+    if isinstance(padding, str):
+        pad = padding.upper()  # 'SAME' / 'VALID'
+    elif isinstance(padding, (list, tuple)) and len(padding) == 2 * n_sp:
+        pad = [(int(padding[2 * i]), int(padding[2 * i + 1]))
+               for i in range(n_sp)]
+    elif isinstance(padding, (list, tuple)) and len(padding) == n_sp and \
+            isinstance(padding[0], (list, tuple)):
+        pad = [tuple(int(q) for q in p) for p in padding]
+    else:
+        p = _pair(padding, n_sp)
+        pad = [(i, i) for i in p]
+
+    if not transpose:
+        def fn(v, w, *b):
+            out = jax.lax.conv_general_dilated(
+                v, w, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=v.dtype)
+            if b:
+                bias_shape = [1] * out.ndim
+                bias_shape[dn.out_spec.index(1) if False else
+                           (out.ndim - 1 if channel_last else 1)] = b[0].size
+                out = out + b[0].reshape(bias_shape)
+            return out
+    else:
+        opad = _pair(output_padding, n_sp)
+        def fn(v, w, *b):
+            # ConvTranspose = gradient of conv. paddle weight layout for
+            # transpose conv: [in, out//groups, *k]
+            if isinstance(pad, str):
+                pd = pad
+            else:
+                # effective transpose padding: k-1-p on both sides + opad
+                pd = []
+                ks = w.shape[2:]
+                for i in range(n_sp):
+                    k_eff = (ks[i] - 1) * dilation[i]
+                    lo = k_eff - pad[i][0]
+                    hi = k_eff - pad[i][1] + opad[i]
+                    pd.append((lo, hi))
+            wt = jnp.swapaxes(w, 0, 1)  # [out//g, in, *k]
+            if groups > 1:
+                # regroup: weight [in, out//g, *k] → split on in
+                wl = jnp.reshape(w, (groups, w.shape[0] // groups) + w.shape[1:])
+                wt = jnp.concatenate([jnp.swapaxes(g_, 0, 1) for g_ in wl], axis=0)
+            wt = jnp.flip(wt, axis=tuple(range(2, 2 + n_sp)))
+            out = jax.lax.conv_general_dilated(
+                v, wt, window_strides=(1,) * n_sp, padding=pd,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=v.dtype)
+            if b:
+                bias_shape = [1] * out.ndim
+                bias_shape[out.ndim - 1 if channel_last else 1] = b[0].size
+                out = out + b[0].reshape(bias_shape)
+            return out
+
+    args = [_coerce(x), _coerce(weight)]
+    if bias is not None:
+        args.append(_coerce(bias))
+    return apply(fn, *args, _name="conv")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last=data_format == "NLC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last=data_format == "NHWC")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=data_format == "NDHWC")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last=data_format == "NLC", transpose=True,
+                    output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last=data_format == "NHWC", transpose=True,
+                    output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=data_format == "NDHWC", transpose=True,
+                    output_padding=output_padding)
+
+
+# ------------------------------------------------------------------ norm ---
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    args = [_coerce(x)]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_coerce(weight))
+    if has_b:
+        args.append(_coerce(bias))
+
+    def fn(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mu = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mu), axis=axes, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+    return apply(fn, *args, _name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """TPU-native fused path exists in kernels.rms_norm; this is the lax
+    fallback (XLA fuses it into one kernel anyway)."""
+    args = [_coerce(x)]
+    if weight is not None:
+        args.append(_coerce(weight))
+    def fn(v, *w):
+        var = jnp.mean(jnp.square(v), axis=-1, keepdims=True)
+        out = v * jax.lax.rsqrt(var + epsilon)
+        return out * w[0] if w else out
+    return apply(fn, *args, _name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = _coerce(x)
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    sh = [1] * x.ndim
+    sh[ch_axis] = -1
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        # update running stats (stateful, paddle semantics: r = m*r + (1-m)*b)
+        bm = apply(lambda v: jnp.mean(v, axis=reduce_axes), x)
+        bv = apply(lambda v: jnp.var(v, axis=reduce_axes), x)
+        if running_mean is not None:
+            n = x.size // x._value.shape[ch_axis]
+            unbiased = n / max(n - 1, 1)
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * bm._value.astype(running_mean._value.dtype))
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * (bv._value * unbiased).astype(running_var._value.dtype))
+        mean_t, var_t = bm, bv
+    else:
+        mean_t, var_t = _coerce(running_mean), _coerce(running_var)
+
+    args = [x, mean_t, var_t]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_coerce(weight))
+    if has_b:
+        args.append(_coerce(bias))
+
+    def fn(v, mu, var, *wb):
+        out = (v - mu.reshape(sh)) * jax.lax.rsqrt(var.reshape(sh) + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(sh)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(sh)
+        return out
+    return apply(fn, *args, _name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    x = _coerce(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    sh = [1] * x.ndim
+    sh[ch_axis] = -1
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_coerce(weight))
+    if has_b:
+        args.append(_coerce(bias))
+    def fn(v, *wb):
+        mu = jnp.mean(v, axis=reduce_axes, keepdims=True)
+        var = jnp.var(v, axis=reduce_axes, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(sh)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(sh)
+        return out
+    return apply(fn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _coerce(x)
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_coerce(weight))
+    if has_b:
+        args.append(_coerce(bias))
+    channel_last = not data_format.startswith("NC")
+    def fn(v, *wb):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[0], v.shape[1]
+        g = num_groups
+        vv = v.reshape((n, g, c // g) + v.shape[2:])
+        axes = tuple(range(2, vv.ndim))
+        mu = jnp.mean(vv, axis=axes, keepdims=True)
+        var = jnp.var(vv, axis=axes, keepdims=True)
+        out = ((vv - mu) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        sh = [1] * out.ndim
+        sh[1] = c
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(sh)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(sh)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(fn, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(v):
+        ch = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pad_width = [(0, 0)] * v.ndim
+        pad_width[ch] = (half, size - 1 - half)
+        sq = jnp.pad(sq, pad_width)
+        idx = [slice(None)] * v.ndim
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            idx[ch] = slice(i, i + v.shape[ch])
+            acc = acc + sq[tuple(idx)]
+        return v / (k + alpha * acc) ** beta
+    return apply(fn, _coerce(x))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply(lambda v: v / jnp.maximum(
+        jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True), epsilon),
+        _coerce(x))
+
+
+# -------------------------------------------------------------- embedding --
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(fn, _coerce(x), _coerce(weight), _name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda i: jax.nn.one_hot(i, num_classes,
+                                          dtype=dtypes.get_default_dtype()),
+                 _coerce(x))
+
+
+# ---------------------------------------------------------------- pooling --
+def _pool(x, op, init, kernel_size, stride, padding, ndim, channel_last,
+          ceil_mode=False, exclusive=True, count_include_pad=False):
+    ks = _pair(kernel_size, ndim)
+    st = _pair(stride if stride is not None else kernel_size, ndim)
+    pd = _pair(padding, ndim)
+
+    def fn(v):
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        if ceil_mode:
+            # extend upper padding so the last partial window is included
+            pads = list(pads)
+            sp_off = 1 if channel_last else 2
+            for i in range(ndim):
+                d = sp_off + i
+                size = v.shape[d] + 2 * pd[i]
+                rem = (size - ks[i]) % st[i]
+                if rem != 0:
+                    lo, hi = pads[d]
+                    pads[d] = (lo, hi + (st[i] - rem))
+            pads = tuple(pads)
+        if op == "max":
+            return jax.lax.reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min,
+                                         jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)
+        if exclusive and not count_include_pad:
+            ones = jnp.ones_like(v)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pads)
+            return s / cnt
+        denom = 1.0
+        for k in ks:
+            denom *= k
+        return s / denom
+    return apply(fn, _coerce(x), _name=f"{op}_pool")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "max", None, kernel_size, stride, padding, 1,
+                 data_format == "NLC", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, "max", None, kernel_size, stride, padding, 2,
+                 data_format == "NHWC", ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, "max", None, kernel_size, stride, padding, 3,
+                 data_format == "NDHWC", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "avg", None, kernel_size, stride, padding, 1,
+                 data_format == "NLC", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, "avg", None, kernel_size, stride, padding, 2,
+                 data_format == "NHWC", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, "avg", None, kernel_size, stride, padding, 3,
+                 data_format == "NDHWC", ceil_mode, exclusive)
+
+
+def _adaptive_pool(x, output_size, ndim, op, channel_last):
+    x = _coerce(x)
+    out_sz = _pair(output_size, ndim)
+    sp_off = 1 if channel_last else 2
+
+    def fn(v):
+        out = v
+        for i in range(ndim):
+            d = sp_off + i
+            in_s = out.shape[d]
+            o = out_sz[i] if out_sz[i] is not None else in_s
+            if in_s % o == 0:
+                k = in_s // o
+                sh = out.shape[:d] + (o, k) + out.shape[d + 1:]
+                r = out.reshape(sh)
+                out = jnp.max(r, axis=d + 1) if op == "max" else jnp.mean(r, axis=d + 1)
+            else:
+                # general adaptive: per-output-bin reduce
+                starts = (np.arange(o) * in_s) // o
+                ends = ((np.arange(o) + 1) * in_s + o - 1) // o
+                segs = [jnp.max(jnp.take(out, np.arange(s, e), axis=d), axis=d)
+                        if op == "max" else
+                        jnp.mean(jnp.take(out, np.arange(s, e), axis=d), axis=d)
+                        for s, e in zip(starts, ends)]
+                out = jnp.stack(segs, axis=d)
+        return out
+    return apply(fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", False)
+
+
+# ------------------------------------------------------------------ loss ---
+def _reduce_loss(loss, reduction):
+    from ..ops import math as m
+    if reduction == "mean":
+        return m.mean(loss)
+    if reduction == "sum":
+        return m.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Parity: python/paddle/nn/functional/loss.py::cross_entropy
+    (softmax_with_cross_entropy kernel)."""
+    args = [_coerce(input), _coerce(label)]
+    has_w = weight is not None
+    if has_w:
+        args.append(_coerce(weight))
+
+    def fn(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim and
+                          jnp.issubdtype(lab.dtype, jnp.floating)):
+            tgt = lab
+            if label_smoothing > 0.0:
+                n = logits.shape[axis]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n
+            nll = -jnp.sum(tgt * logp, axis=axis)
+            if has_w:
+                nll = nll * jnp.sum(tgt * w[0], axis=axis)
+            return nll
+        lab_i = lab
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        n = logits.shape[axis]
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        oh = jax.nn.one_hot(safe, n, axis=axis, dtype=logp.dtype)
+        if label_smoothing > 0.0:
+            oh = oh * (1 - label_smoothing) + label_smoothing / n
+        nll = -jnp.sum(oh * logp, axis=axis)
+        if has_w:
+            nll = nll * jnp.take(w[0], safe)
+        return jnp.where(valid, nll, 0.0)
+
+    loss = apply(fn, *args, _name="cross_entropy")
+    if reduction == "mean":
+        lab = args[1]
+        in_ndim = args[0].ndim
+        if not soft_label and jnp.issubdtype(lab._value.dtype, jnp.integer):
+            # mean over non-ignored entries (paddle semantics); weighted mean
+            # divides by the sum of per-sample weights
+            def mean_fn(l, labd, *w):
+                li = jnp.squeeze(labd, axis=axis) if labd.ndim == in_ndim else labd
+                valid = li != ignore_index
+                if has_w:
+                    safe = jnp.where(valid, li, 0)
+                    den = jnp.sum(jnp.where(valid, jnp.take(w[0], safe), 0.0))
+                else:
+                    den = jnp.sum(valid.astype(l.dtype))
+                return jnp.sum(l) / jnp.maximum(den, 1.0)
+            return apply(mean_fn, loss, lab, *args[2:])
+        return _reduce_loss(loss, "mean")
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ..ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    args = [_coerce(input), _coerce(label)]
+    has_w = weight is not None
+    if has_w:
+        args.append(_coerce(weight))
+    def fn(logp, lab, *w):
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = -jnp.take_along_axis(logp, safe[..., None] if logp.ndim == lab.ndim + 1 else safe, axis=1 if logp.ndim > 1 else 0)
+        picked = jnp.squeeze(picked, axis=1) if picked.ndim > lab.ndim else picked
+        if has_w:
+            picked = picked * jnp.take(w[0], safe)
+        return jnp.where(valid, picked, 0.0)
+    loss = apply(fn, *args)
+    if reduction == "mean" and has_w:
+        def den_fn(l, lab, w):
+            valid = lab != ignore_index
+            safe = jnp.where(valid, lab, 0)
+            return jnp.sum(l) / jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0))
+        return apply(den_fn, loss, args[1], args[2])
+    return _reduce_loss(loss, reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    loss = apply(lambda a, b: jnp.square(a - b), _coerce(input), _coerce(label))
+    return _reduce_loss(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    loss = apply(lambda a, b: jnp.abs(a - b), _coerce(input), _coerce(label))
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def huber(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        return jnp.where(ad < delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    loss = apply(huber, _coerce(input), _coerce(label))
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    args = [_coerce(input), _coerce(label)]
+    has_w = weight is not None
+    if has_w:
+        args.append(_coerce(weight))
+    def fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        out = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        return out * w[0] if has_w else out
+    loss = apply(fn, *args)
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    args = [_coerce(logit), _coerce(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        args.append(_coerce(weight))
+    if has_pw:
+        args.append(_coerce(pos_weight))
+    def fn(z, y, *rest):
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        i = 0
+        if has_pw:
+            pw = rest[-1]
+            logsig = -jax.nn.softplus(-z)
+            log1msig = -z - jax.nn.softplus(-z)
+            base = -(pw * y * logsig + (1 - y) * log1msig)
+        if has_w:
+            base = base * rest[0]
+        return base
+    loss = apply(fn, *args)
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, t):
+        if log_target:
+            return jnp.exp(t) * (t - lp)
+        return jnp.where(t > 0, t * (jnp.log(t) - lp), 0.0)
+    loss = apply(fn, _coerce(input), _coerce(label))
+    if reduction == "batchmean":
+        from ..ops import math as m
+        n = _coerce(input)._value.shape[0]
+        return m.divide(m.sum(loss), float(n))
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    loss = apply(lambda a, b, y: jnp.maximum(0.0, -y * (a - b) + margin),
+                 _coerce(input), _coerce(other), _coerce(label))
+    return _reduce_loss(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.maximum(jnp.linalg.norm(a, axis=axis) *
+                          jnp.linalg.norm(b, axis=axis), eps)
+        return num / den
+    return apply(fn, _coerce(x1), _coerce(x2))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    loss = apply(fn, _coerce(input1), _coerce(input2), _coerce(label))
+    return _reduce_loss(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return jnp.maximum(dp - dn + margin, 0.0)
+    loss = apply(fn, _coerce(input), _coerce(positive), _coerce(negative))
+    return _reduce_loss(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(x, y):
+        return jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    loss = apply(fn, _coerce(input), _coerce(label))
+    return _reduce_loss(loss, reduction)
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), _coerce(input), _coerce(label))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError(
+        "ctc_loss lands with the speech model family (reference: "
+        "paddle/phi/kernels/gpu/warpctc_kernel.cu)")
+
+
+# ------------------------------------------------------------- attention ---
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity
+    (python/paddle/nn/functional/flash_attention.py). Layout: [B, S, H, D]
+    (paddle flash-attention layout). Uses the Pallas flash kernel on TPU
+    when available, else the XLA softmax path."""
+    from ..kernels.attention import flash_attention_bshd
+    return flash_attention_bshd(query, key, value, attn_mask=attn_mask,
+                                dropout_p=dropout_p, is_causal=is_causal,
+                                training=training)
+
+
+# ------------------------------------------------------------------ misc ---
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = _coerce(x)
+    nd = x.ndim - 2
+    channel_last = not data_format.startswith("NC")
+    sp_off = 1 if channel_last else 2
+    in_sizes = [x._value.shape[sp_off + i] for i in range(nd)]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        out_sizes = [int(in_sizes[i] * float(sf[i])) for i in range(nd)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(v):
+        shape = list(v.shape)
+        for i in range(nd):
+            shape[sp_off + i] = out_sizes[i]
+        if jmode == "nearest":
+            return jax.image.resize(v, shape, method="nearest")
+        if align_corners:
+            # jax.image.resize uses half-pixel centers; emulate align_corners
+            # via explicit coordinate map with map_coordinates
+            coords = []
+            for i in range(nd):
+                o = out_sizes[i]
+                s = in_sizes[i]
+                if o == 1:
+                    c = jnp.zeros((1,))
+                else:
+                    c = jnp.linspace(0, s - 1, o)
+                coords.append(c)
+            # build full grid over spatial dims only; vmap over N,C
+            grid = jnp.meshgrid(*coords, indexing="ij")
+            def sample(img):
+                return jax.scipy.ndimage.map_coordinates(img, grid, order=1)
+            bat = v if not channel_last else jnp.moveaxis(v, -1, 1)
+            out = jax.vmap(jax.vmap(sample))(bat)
+            return out if not channel_last else jnp.moveaxis(out, 1, -1)
+        return jax.image.resize(v, shape, method=jmode)
+    return apply(fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply(fn, _coerce(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 2, 4, 1, 3, 5).reshape(n, h // r, w // r, c * r * r)
+        return v
+    return apply(fn, _coerce(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes, 2)
+    st = _pair(strides, 2)
+    pd = _pair(paddings, 2)
+    dl = _pair(dilations, 2)
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        oh = (h + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        patches = []
+        for ki in range(ks[0]):
+            for kj in range(ks[1]):
+                sub = v[:, :, ki * dl[0]: ki * dl[0] + oh * st[0]: st[0],
+                        kj * dl[1]: kj * dl[1] + ow * st[1]: st[1]]
+                patches.append(sub)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply(fn, _coerce(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..ops.manipulation import pad as _pad_op
+    return _pad_op(x, pad, mode, value, data_format)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l):
+        n = l.shape[-1]
+        return l * (1 - epsilon) + epsilon / n
+    return apply(fn, _coerce(label))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    x = _coerce(x)
+    ml = int(maxlen) if maxlen is not None else int(np.asarray(x._value).max())
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda v: (jnp.arange(ml) < v[..., None]).astype(d), x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+    return apply(fn, _coerce(x))

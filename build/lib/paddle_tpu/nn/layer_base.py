@@ -1,0 +1,356 @@
+"""paddle.nn.Layer — the module base class.
+
+Reference parity: python/paddle/nn/layer/layers.py (Layer): parameter /
+buffer / sublayer registries, forward pre/post hooks, train/eval mode,
+state_dict / set_state_dict, apply, to(dtype/device), named_* iterators.
+
+TPU-native note: parameters are `Parameter` tensors (rebindable jax
+arrays); `state_dict` yields the live tensors so a functional bridge
+(paddle_tpu.jit / distributed engines) can lift the whole layer into a
+pure pytree-of-arrays function for `jax.jit`/`pjit`.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, Parameter
+from ..framework import dtype as dtypes
+from .initializer import _resolve_initializer, ParamAttr, XavierUniform, Constant
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._sub_layers: Dict[str, Optional["Layer"]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------ naming --
+    def full_name(self):
+        return self._name_scope
+
+    # -------------------------------------------------------- attributes --
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is not None and not isinstance(value, Parameter):
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+            params[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value if (value is None or isinstance(value, Tensor)) \
+                else Tensor(value)
+        elif layers is not None and name in layers:
+            layers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._buffers) + list(self._sub_layers)
+
+    # ------------------------------------------------------- registration --
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Parity: Layer.create_parameter → LayerHelper.create_parameter."""
+        d = dtypes.convert_dtype(dtype) or self._dtype
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = attr.initializer or default_initializer or \
+            (Constant(0.0) if is_bias else XavierUniform())
+        value = _resolve_initializer(init)(shape, d)
+        p = Parameter(value, trainable=attr.trainable, name=attr.name)
+        if not attr.trainable:
+            p.stop_gradient = True
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        d = dtypes.convert_dtype(dtype) or self._dtype
+        t = Tensor(jnp.zeros((), d))
+        t.name = name
+        return t
+
+    # ---------------------------------------------------------- traversal --
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for item in layer.named_parameters(sub_prefix):
+                    yield item
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for item in layer.named_buffers(sub_prefix):
+                    yield item
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            for item in layer.named_sublayers(sub_prefix):
+                yield item
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, l in self._sub_layers.items():
+            if l is not None:
+                yield name, l
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -------------------------------------------------------------- modes --
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -------------------------------------------------------------- hooks --
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------ forward --
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # ----------------------------------------------------------- state io --
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        sync = getattr(self, "_deferred_sync", None)
+        if sync is not None:
+            # a compiled train step (e.g. PipelineTrainStep) keeps the
+            # authoritative params device-side; flush before reading
+            sync()
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None:
+                dest[structured_name_prefix + name] = p
+        for name, b in self._buffers.items():
+            if b is not None and name not in self._non_persistable_buffer_names:
+                dest[structured_name_prefix + name] = b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is not None:
+                    layer.state_dict(dest, True,
+                                     structured_name_prefix + lname + ".")
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Returns (missing_keys, unexpected_keys) — parity with paddle."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = set()
+        for k, v in state_dict.items():
+            if k in own:
+                t = own[k]
+                arr = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(arr.shape) != tuple(t._value.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: got {tuple(arr.shape)}, "
+                        f"expected {tuple(t._value.shape)}")
+                t._value = arr.astype(t._value.dtype)
+                matched.add(k)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        inval = getattr(self, "_deferred_invalidate", None)
+        if inval is not None:
+            # a compiled train step caches device-side copies of these
+            # params (e.g. stage-stacked pipeline weights); tell it to
+            # re-read from the layer tensors on its next step
+            inval()
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---------------------------------------------------------- conversion --
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._to_dtype(dtypes.convert_dtype(dtype))
+        if device is not None:
+            from ..framework.place import _parse_place
+            dev = _parse_place(device).jax_device
+            for t in list(self.parameters()) + list(self.buffers()):
+                t._value = jax.device_put(t._value, dev)
+        return self
+
+    def _to_dtype(self, d):
+        for t in self.parameters():
+            if dtypes.is_floating_point(t.dtype):
+                t._value = t._value.astype(d)
+        for b in self.buffers():
+            if dtypes.is_floating_point(b.dtype):
+                b._value = b._value.astype(d)
+        self._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self._to_dtype(dtypes.convert_dtype(dtype))
+
+    def float(self):
+        return self._to_dtype(dtypes.float32)
+
+    def bfloat16(self):
+        return self._to_dtype(dtypes.bfloat16)
+
+    def float16(self):
+        return self._to_dtype(dtypes.float16)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def extra_repr(self):
+        return ""

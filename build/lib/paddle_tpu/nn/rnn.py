@@ -1,0 +1,285 @@
+"""RNN family (parity: python/paddle/nn/layer/rnn.py: SimpleRNN, LSTM, GRU,
+plus the cell classes and RNN wrapper).
+
+TPU-native design: the whole time loop is one `lax.scan` inside a single
+tape op — XLA compiles the recurrence into one fused loop; there is no
+per-timestep python dispatch (replaces paddle's cudnn RNN descriptors in
+paddle/phi/kernels/gpu/rnn_kernel.cu with a compiler-scheduled scan).
+"""
+from __future__ import annotations
+
+import math as pymath
+
+import jax
+import jax.numpy as jnp
+
+from .layer_base import Layer
+from .initializer import Uniform
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+
+def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    if mode == "GRU":
+        # paddle GRU: candidate gate applies r to (W_hh_n h + b_hh_n)
+        gates_x = x_t @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+        gates_h = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        rx, zx, nx = jnp.split(gates_x, 3, axis=-1)
+        rh, zh, nh = jnp.split(gates_h, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        h_new = (1 - z) * n + z * h
+        return h_new, None
+    gates = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    # SimpleRNN (tanh / relu)
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    h_new = act(gates)
+    return h_new, None
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        g = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        k = 1.0 / pymath.sqrt(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                sfx = f"_reverse" if d == 1 else ""
+                w_ih = self.create_parameter(
+                    [g * hidden_size, in_sz], attr=weight_ih_attr,
+                    default_initializer=Uniform(-k, k))
+                w_hh = self.create_parameter(
+                    [g * hidden_size, hidden_size], attr=weight_hh_attr,
+                    default_initializer=Uniform(-k, k))
+                b_ih = self.create_parameter(
+                    [g * hidden_size], attr=bias_ih_attr, is_bias=True,
+                    default_initializer=Uniform(-k, k))
+                b_hh = self.create_parameter(
+                    [g * hidden_size], attr=bias_hh_attr, is_bias=True,
+                    default_initializer=Uniform(-k, k))
+                self.add_parameter(f"weight_ih_l{layer}{sfx}", w_ih)
+                self.add_parameter(f"weight_hh_l{layer}{sfx}", w_hh)
+                self.add_parameter(f"bias_ih_l{layer}{sfx}", b_ih)
+                self.add_parameter(f"bias_hh_l{layer}{sfx}", b_hh)
+                self._all_weights.append(
+                    (f"weight_ih_l{layer}{sfx}", f"weight_hh_l{layer}{sfx}",
+                     f"bias_ih_l{layer}{sfx}", f"bias_hh_l{layer}{sfx}"))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = _coerce(inputs)
+        mode = self.mode
+        num_dirs = 2 if self.bidirect else 1
+        nl = self.num_layers
+        hs = self.hidden_size
+        time_major = self.time_major
+        is_lstm = mode == "LSTM"
+
+        weights = []
+        for names in self._all_weights:
+            weights.extend(self._parameters[n] for n in names)
+
+        bshape_known = x._value.shape[1 if time_major else 0]
+
+        has_init = initial_states is not None
+        init_args = []
+        if has_init:
+            if is_lstm:
+                h0, c0 = initial_states
+                init_args = [_coerce(h0), _coerce(c0)]
+            else:
+                init_args = [_coerce(initial_states)]
+
+        def fn(xv, *flat):
+            ws = flat[:len(weights)]
+            rest = flat[len(weights):]
+            if time_major:
+                xv = jnp.swapaxes(xv, 0, 1)  # → [B, T, F]
+            b = xv.shape[0]
+            if rest:
+                h0 = rest[0]
+                c0 = rest[1] if is_lstm else None
+            else:
+                h0 = jnp.zeros((nl * num_dirs, b, hs), xv.dtype)
+                c0 = jnp.zeros((nl * num_dirs, b, hs), xv.dtype) if is_lstm else None
+
+            out = xv
+            h_finals, c_finals = [], []
+            wi = 0
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(num_dirs):
+                    w_ih, w_hh, b_ih, b_hh = ws[wi * 4: wi * 4 + 4]
+                    idx = layer * num_dirs + d
+                    hh = h0[idx]
+                    cc = c0[idx] if is_lstm else jnp.zeros_like(hh)
+                    seq = out if d == 0 else jnp.flip(out, axis=1)
+                    xs = jnp.swapaxes(seq, 0, 1)  # [T, B, F]
+
+                    def step(carry, x_t):
+                        h, c = carry
+                        h2, c2 = _cell_step(mode, x_t, h, c, w_ih, w_hh,
+                                            b_ih, b_hh)
+                        return (h2, c2 if c2 is not None else c), h2
+
+                    (hT, cT), ys = jax.lax.scan(step, (hh, cc), xs)
+                    ys = jnp.swapaxes(ys, 0, 1)  # [B, T, H]
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=1)
+                    dir_outs.append(ys)
+                    h_finals.append(hT)
+                    c_finals.append(cT)
+                    wi += 1
+                out = dir_outs[0] if num_dirs == 1 else jnp.concatenate(
+                    dir_outs, axis=-1)
+            h_all = jnp.stack(h_finals, axis=0)
+            outputs = jnp.swapaxes(out, 0, 1) if time_major else out
+            if is_lstm:
+                return outputs, h_all, jnp.stack(c_finals, axis=0)
+            return outputs, h_all
+
+        res = apply(fn, x, *weights, *init_args, _name=mode.lower())
+        if is_lstm:
+            outputs, h, c = res
+            return outputs, (h, c)
+        outputs, h = res
+        return outputs, h
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = 1.0 / pymath.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=Uniform(-k, k))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=Uniform(-k, k))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=Uniform(-k, k))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=Uniform(-k, k))
+
+    def forward(self, inputs, states=None):
+        x = _coerce(inputs)
+        if states is None:
+            from ..ops.creation import zeros
+            b = x.shape[0]
+            states = (zeros([b, self.hidden_size], dtype=str(x.dtype)),
+                      zeros([b, self.hidden_size], dtype=str(x.dtype)))
+        h, c = states
+        def fn(xv, hv, cv, wi, wh, bi, bh):
+            return _cell_step("LSTM", xv, hv, cv, wi, wh, bi, bh)
+        h2, c2 = apply(fn, x, _coerce(h), _coerce(c), self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = 1.0 / pymath.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], default_initializer=Uniform(-k, k))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], default_initializer=Uniform(-k, k))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], is_bias=True, default_initializer=Uniform(-k, k))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], is_bias=True, default_initializer=Uniform(-k, k))
+
+    def forward(self, inputs, states=None):
+        x = _coerce(inputs)
+        if states is None:
+            from ..ops.creation import zeros
+            states = zeros([x.shape[0], self.hidden_size], dtype=str(x.dtype))
+        def fn(xv, hv, wi, wh, bi, bh):
+            h2, _ = _cell_step("GRU", xv, hv, None, wi, wh, bi, bh)
+            return h2
+        h2 = apply(fn, x, _coerce(states), self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh)
+        return h2, h2
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        k = 1.0 / pymath.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], default_initializer=Uniform(-k, k))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], default_initializer=Uniform(-k, k))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], is_bias=True, default_initializer=Uniform(-k, k))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], is_bias=True, default_initializer=Uniform(-k, k))
+
+    def forward(self, inputs, states=None):
+        x = _coerce(inputs)
+        if states is None:
+            from ..ops.creation import zeros
+            states = zeros([x.shape[0], self.hidden_size], dtype=str(x.dtype))
+        def fn(xv, hv, wi, wh, bi, bh):
+            h2, _ = _cell_step(self.mode, xv, hv, None, wi, wh, bi, bh)
+            return h2
+        h2 = apply(fn, x, _coerce(states), self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh)
+        return h2, h2

@@ -1,0 +1,21 @@
+"""paddle.onnx parity surface.
+
+Reference parity: python/paddle/onnx/export.py, which delegates to the
+paddle2onnx ecosystem package. In the TPU-native stack the equivalent
+portable-deployment path is StableHLO via jax.export (see
+paddle_tpu.inference Predictor / jit.save AOT artifacts); ONNX proper
+would need the onnx package, which this environment does not ship —
+so export() raises with that guidance instead of silently no-opping.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export requires the paddle2onnx/onnx packages (not available "
+        "in this environment). For portable TPU deployment use "
+        "paddle_tpu.jit.save (StableHLO AOT via jax.export) or "
+        "paddle_tpu.inference.create_predictor, which replace the "
+        "ONNX/TensorRT path on this backend.")

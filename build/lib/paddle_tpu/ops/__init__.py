@@ -1,0 +1,197 @@
+"""Op library aggregation + Tensor method patching.
+
+Reference parity: Paddle assembles `paddle.*` from python/paddle/tensor/*
+and monkey-patches the methods onto `paddle.Tensor`
+(python/paddle/tensor/__init__.py::tensor_method_func list). Same approach
+here: every op taking a leading Tensor also becomes a Tensor method, plus
+the arithmetic dunders and `op_` in-place variants.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import _dispatch
+from ._dispatch import apply
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, logic, linalg, random, search
+from .creation import _coerce
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+def _norm_index_component(i):
+    """Resolve Tensor components inside slices to python ints."""
+    if isinstance(i, builtins.slice):
+        def g(v):
+            return int(v.item()) if isinstance(v, Tensor) else v
+        return builtins.slice(g(i.start), g(i.stop), g(i.step))
+    return i
+
+
+def _tensor_getitem(self: Tensor, item):
+    items = item if isinstance(item, tuple) else (item,)
+    items = tuple(_norm_index_component(i) for i in items)
+    tensor_idx = [i for i in items if isinstance(i, Tensor)]
+
+    def fn(v, *idx_arrays):
+        it = iter(idx_arrays)
+        resolved = tuple(next(it) if isinstance(i, Tensor) else i for i in items)
+        return v[resolved]
+
+    return apply(fn, self, *tensor_idx, _name="getitem")
+
+
+def _tensor_setitem(self: Tensor, item, value):
+    from ..autograd.grad_mode import is_grad_enabled
+    if is_grad_enabled() and not self.stop_gradient and self.is_leaf:
+        raise RuntimeError(
+            "setitem on a leaf Tensor that requires grad; wrap in "
+            "paddle.no_grad()")
+    items = item if isinstance(item, tuple) else (item,)
+    items = tuple(_norm_index_component(i) for i in items)
+    tensor_idx = [i for i in items if isinstance(i, Tensor)]
+    val = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
+
+    def fn(v, valv, *idx_arrays):
+        it = iter(idx_arrays)
+        resolved = tuple(next(it) if isinstance(i, Tensor) else i for i in items)
+        return v.at[resolved].set(valv.astype(v.dtype))
+
+    self._inplace_update(apply(fn, self, val, *tensor_idx, _name="setitem"))
+
+
+Tensor.__getitem__ = _tensor_getitem
+Tensor.__setitem__ = _tensor_setitem
+
+# ---------------------------------------------------------------------------
+# dunders
+# ---------------------------------------------------------------------------
+
+def _rev(fn):
+    def r(self, other):
+        return fn(other, self)
+    return r
+
+
+Tensor.__add__ = lambda s, o: math.add(s, o)
+Tensor.__radd__ = lambda s, o: math.add(o, s)
+Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+Tensor.__rmod__ = lambda s, o: math.remainder(o, s)
+Tensor.__pow__ = lambda s, o: math.pow(s, o)
+Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+Tensor.__neg__ = lambda s: math.neg(s)
+Tensor.__abs__ = lambda s: math.abs(s)
+Tensor.__invert__ = lambda s: logic.logical_not(s) if s.dtype == jnp.bool_ else logic.bitwise_not(s)
+Tensor.__and__ = lambda s, o: logic.logical_and(s, o) if s.dtype == jnp.bool_ else logic.bitwise_and(s, o)
+Tensor.__or__ = lambda s, o: logic.logical_or(s, o) if s.dtype == jnp.bool_ else logic.bitwise_or(s, o)
+Tensor.__xor__ = lambda s, o: logic.logical_xor(s, o) if s.dtype == jnp.bool_ else logic.bitwise_xor(s, o)
+Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+Tensor.__hash__ = lambda s: id(s)
+
+# ---------------------------------------------------------------------------
+# method attachment
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = [creation, math, manipulation, logic, linalg, search, random]
+
+# names whose first parameter is NOT a tensor (skip for method patching)
+_SKIP = {
+    "zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
+    "meshgrid", "rand", "randn", "randint", "randperm", "uniform", "normal",
+    "gaussian", "standard_normal", "tril_indices", "triu_indices",
+    "scatter_nd", "to_tensor", "broadcast_shape", "assign", "einsum",
+    "add_n", "multi_dot", "broadcast_tensors", "multiplex", "log_normal",
+    "searchsorted", "complex", "polar", "binomial",
+}
+
+_patched = set()
+_CLASS_ATTRS = set(dir(Tensor))  # never shadow properties/methods of Tensor
+for _mod in _METHOD_SOURCES:
+    for _name in dir(_mod):
+        if (_name.startswith("_") or _name in _SKIP or _name in _patched
+                or _name in _CLASS_ATTRS):
+            continue
+        _fn = getattr(_mod, _name)
+        if not callable(_fn) or isinstance(_fn, type):
+            continue
+        if getattr(_fn, "__module__", "").startswith("jax"):
+            continue
+        setattr(Tensor, _name, _fn)
+        _patched.add(_name)
+
+# searchsorted-as-method has tensor-first semantics via bucketize
+Tensor.bucketize = lambda self, ss, **kw: search.bucketize(self, ss, **kw)
+
+# ---------------------------------------------------------------------------
+# in-place variants (parity: paddle's `op_` API family)
+# ---------------------------------------------------------------------------
+
+def _make_inplace(fn):
+    def op_(self, *a, **kw):
+        self._check_inplace()
+        return self._inplace_update(fn(self, *a, **kw))
+    return op_
+
+
+_INPLACE = {
+    "add_": math.add, "subtract_": math.subtract, "multiply_": math.multiply,
+    "divide_": math.divide, "scale_": math.scale, "clip_": math.clip,
+    "exp_": math.exp, "sqrt_": math.sqrt, "rsqrt_": math.rsqrt,
+    "reciprocal_": math.reciprocal, "floor_": math.floor, "ceil_": math.ceil,
+    "round_": math.round, "abs_": math.abs, "tanh_": math.tanh,
+    "neg_": math.neg, "sigmoid_": None,  # filled by nn.functional later
+    "remainder_": math.remainder, "pow_": math.pow,
+    "cast_": manipulation.cast, "flatten_": manipulation.flatten,
+    "fill_": None, "zero_": None,
+}
+
+for _n, _f in _INPLACE.items():
+    if _f is not None:
+        setattr(Tensor, _n, _make_inplace(_f))
+        _patched.add(_n)
+
+
+def _fill_(self, value):
+    self._value = jnp.full(self._value.shape, value, self._value.dtype)
+    return self
+
+
+def _zero_(self):
+    self._value = jnp.zeros(self._value.shape, self._value.dtype)
+    return self
+
+
+Tensor.fill_ = _fill_
+Tensor.zero_ = _zero_
+
+# module-level inplace aliases paddle exposes
+scale_ = lambda x, *a, **kw: x.scale_(*a, **kw)  # noqa: E731
+clip_ = lambda x, *a, **kw: x.clip_(*a, **kw)  # noqa: E731
+tanh_ = lambda x, *a, **kw: x.tanh_(*a, **kw)  # noqa: E731

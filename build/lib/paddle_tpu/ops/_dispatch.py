@@ -1,0 +1,228 @@
+"""Eager op dispatch: the (tiny) TPU-native equivalent of Paddle's generated
+eager API layer.
+
+Reference parity: in Paddle every `paddle._C_ops.<op>` call goes through a
+generated `*_ad_func` (paddle/fluid/eager/api/generated/) that runs the
+kernel and wires a GradNode (paddle/fluid/eager/auto_code_generator/).
+Here a single generic `apply(fn, *tensor_args)` does both jobs:
+
+- fast path (no grad needed): run the pure-jax `fn` directly;
+- tape path: `jax.vjp(fn, *arrays)` computes the primal AND captures the
+  pullback, which becomes the GradNode's backward. The pullback is itself
+  jax-traceable, so backward with `create_graph=True` routes back through
+  `apply`, giving higher-order autograd with no codegen.
+
+There is no kernel registry/InferMeta: XLA abstract evaluation performs
+shape/dtype inference, and kernel selection is XLA compilation.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, _is_tracer
+from ..autograd.grad_mode import is_grad_enabled
+from ..autograd.engine import GradNode
+
+float0 = jax.dtypes.float0
+
+
+_amp_fn = None
+
+
+def _amp_dtype_for(name):
+    if not name:
+        return None
+    global _amp_fn
+    if _amp_fn is None:
+        from ..amp import amp_dtype_for
+        _amp_fn = amp_dtype_for
+    return _amp_fn(name)
+
+
+def as_array(x):
+    """Coerce an op argument to something jax accepts."""
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def _is_inexact(d) -> bool:
+    return jnp.issubdtype(d, jnp.inexact)
+
+
+def _wrap_outputs(out, node):
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    tensors = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=node is None)
+        if node is not None:
+            t._grad_node = node
+            t._out_index = i
+            node.register_output(i, t)
+        tensors.append(t)
+    return tuple(tensors) if multi else tensors[0]
+
+
+def _make_backward(fn, arrays, vjp_fn, multi_out, out_shapes, out_dtypes,
+                   diff_in_idx, tensor_inputs):
+    """GradNode backward: engine passes full cotangent Tensors (one per
+    output); we feed only inexact-output cotangents through the pullback
+    (int/bool outputs get float0 zeros) and scatter the pullback's results
+    back to the input slots.
+
+    With create_graph the saved pullback is NOT enough — its residuals hide
+    the dependence on the primal inputs, so d(grad)/d(primal) would be lost.
+    Instead we re-derive the pullback inside a fresh traced function of
+    (cotangents, primal inputs), recomputing the forward (the standard
+    double-backward recompute), so the tape records edges to the primals.
+    """
+    n_inputs = len(arrays)
+    diff_out_idx = [i for i, d in enumerate(out_dtypes) if _is_inexact(d)]
+    n_dout = len(diff_out_idx)
+
+    def _rebuild_cots(diff_cots):
+        full = []
+        k = 0
+        for i, d in enumerate(out_dtypes):
+            if _is_inexact(d):
+                c = diff_cots[k]
+                k += 1
+                if c.dtype != d:
+                    c = c.astype(d)
+                full.append(c)
+            else:
+                full.append(np.zeros(out_shapes[i], float0))
+        return tuple(full) if multi_out else full[0]
+
+    def run_saved(*diff_cots):
+        grads = vjp_fn(_rebuild_cots(diff_cots))
+        return tuple(grads[i] for i in diff_in_idx)
+
+    def run_fresh(*flat):
+        diff_cots = flat[:n_dout]
+        prim = list(arrays)
+        for k, slot in enumerate(diff_in_idx):
+            prim[slot] = flat[n_dout + k]
+        _, pull = jax.vjp(fn, *prim)
+        grads = pull(_rebuild_cots(diff_cots))
+        return tuple(grads[i] for i in diff_in_idx)
+
+    def backward_fn(cot_tensors, create_graph):
+        diff_cots = [cot_tensors[i] for i in diff_out_idx]
+        if create_graph:
+            prims = [tensor_inputs[i] for i in diff_in_idx]
+            res = apply(run_fresh, *diff_cots, *prims)
+        else:
+            res = apply(run_saved, *diff_cots)
+        if isinstance(res, Tensor):
+            res = (res,)
+        out = [None] * n_inputs
+        for slot, g in zip(diff_in_idx, res):
+            out[slot] = g
+        return out
+
+    return backward_fn
+
+
+def apply(fn: Callable, *args, _name: str = ""):
+    """Run `fn(*arrays)` with tape recording.
+
+    `fn` must be a pure jax function over the positional array args (close
+    static attrs over it). Returns a Tensor, or a tuple of Tensors when fn
+    returns a tuple/list.
+    """
+    arrays = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+    _debug_hooks(_name, arrays)
+    # amp O1/O2 hook: cast float inputs of white/black-listed ops
+    amp_d = _amp_dtype_for(_name)
+    if amp_d is not None:
+        arrays = tuple(
+            a.astype(amp_d) if (hasattr(a, "dtype")
+                                and jnp.issubdtype(a.dtype, jnp.floating)
+                                and a.dtype != amp_d
+                                and a.dtype != jnp.float64)
+            else a for a in arrays)
+    needs_grad = False
+    if is_grad_enabled():
+        for a in args:
+            if isinstance(a, Tensor) and not a.stop_gradient:
+                needs_grad = True
+                break
+    if needs_grad:
+        # only float-like Tensor inputs can carry gradients
+        diff_in_idx = [i for i, a in enumerate(args)
+                       if isinstance(a, Tensor)
+                       and hasattr(arrays[i], "dtype")
+                       and _is_inexact(arrays[i].dtype)]
+        if not diff_in_idx:
+            needs_grad = False
+    if not needs_grad:
+        return _wrap_outputs(fn(*arrays), None)
+
+    if any(_is_tracer(a) for a in arrays):
+        # Inside an outer jax trace (TrainStep / functionalize / jit.grad):
+        # the outer transform differentiates the traced ops directly —
+        # including custom_vjp kernels. A nested jax.vjp here would
+        # re-linearize every custom_vjp fwd under the outer trace, which
+        # Pallas kernels cannot survive (pallas_call has no JVP rule:
+        # "Linearization failed to produce known values"). Record nothing;
+        # the eager tape is only meaningful on concrete values.
+        return _wrap_outputs(fn(*arrays), None)
+
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    multi_out = isinstance(out, (tuple, list))
+    outs_list = list(out) if multi_out else [out]
+    out_shapes = [tuple(o.shape) for o in outs_list]
+    out_dtypes = [o.dtype for o in outs_list]
+    if not any(_is_inexact(d) for d in out_dtypes):
+        # all-integer outputs (argmax etc.) — nothing to differentiate
+        return _wrap_outputs(out, None)
+    tensor_inputs = [a if isinstance(a, Tensor) else None for a in args]
+    node = GradNode(
+        _make_backward(fn, arrays, vjp_fn, multi_out, out_shapes, out_dtypes,
+                       diff_in_idx, tensor_inputs),
+        tensor_inputs, outs_list,
+        name=_name or getattr(fn, "__name__", "op"))
+    return _wrap_outputs(out, node)
+
+
+# ---------------------------------------------------------------------------
+# Debug hooks: FLAGS_check_nan_inf (reference parity:
+# paddle/fluid/framework/details/nan_inf_utils_detail — every kernel's
+# outputs scanned when the flag is on) and the amp operator-stats
+# collector (paddle.amp.debugging.collect_operator_stats).
+# ---------------------------------------------------------------------------
+
+_op_stats = None  # dict[(op, dtype)] -> count when collection is on
+
+
+def _debug_hooks(name, arrays):
+    global _op_stats
+    if _op_stats is not None:
+        key_dtype = ""
+        for a in arrays:
+            if hasattr(a, "dtype"):
+                key_dtype = str(a.dtype)
+                break
+        k = (name or "<anon>", key_dtype)
+        _op_stats[k] = _op_stats.get(k, 0) + 1
+    from ..framework.flags import flag_value
+    if flag_value("check_nan_inf"):
+        for i, a in enumerate(arrays):
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact):
+                if _is_tracer(a):
+                    # under jit/grad a concrete count is unavailable; the
+                    # flag's on_change already enabled jax_debug_nans,
+                    # which traps non-finite values in compiled programs
+                    # at runtime — skip the eager scan here
+                    continue
+                bad = int(jnp.sum(~jnp.isfinite(a)))
+                if bad:
+                    raise FloatingPointError(
+                        f"FLAGS_check_nan_inf: op '{name or '<anon>'}' "
+                        f"input #{i} contains {bad} non-finite values")

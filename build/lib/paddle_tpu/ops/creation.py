@@ -1,0 +1,211 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, to_tensor
+from ..framework import dtype as dtypes
+from ._dispatch import apply, as_array
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default or dtypes.get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = dtypes.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtypes.get_default_dtype()  # paddle: default dtype
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = _coerce(x)
+    return Tensor(jnp.zeros(x._value.shape, _dt(dtype, x.dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = _coerce(x)
+    return Tensor(jnp.ones(x._value.shape, _dt(dtype, x.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = _coerce(x)
+    return Tensor(jnp.full(x._value.shape, fill_value, _dt(dtype, x.dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = dtypes.get_default_dtype()
+        else:
+            dtype = dtypes.int64
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=float(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = _coerce(x)
+    if x.ndim == 1 and padding_value != 0:
+        def fn(v):
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(n, k=offset, dtype=bool)
+            return jnp.where(mask, d, base)
+        return apply(fn, x)
+    return apply(lambda v: jnp.diag(v, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply(lambda v: jnp.diagflat(v, k=offset), _coerce(x))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    import numpy as _np
+    def fn(v):
+        out = jnp.zeros(v.shape[:-1] + (v.shape[-1] + abs(offset),) * 2, v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        if (dim1, dim2) not in ((-2, -1), (v.ndim - 1, v.ndim)):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply(fn, _coerce(x))
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda v: jnp.tril(v, k=diagonal), _coerce(x))
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda v: jnp.triu(v, k=diagonal), _coerce(x))
+
+
+def tril_indices(row, col, offset=0, dtype="int64") -> Tensor:
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, dtypes.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, dtypes.int64)))
+
+
+def meshgrid(*args, name=None):
+    args = [_coerce(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args)
+
+
+def assign(x, output=None) -> Tensor:
+    x = _coerce(x)
+    out = apply(lambda v: v + jnp.zeros((), v.dtype), x)
+    if output is not None:
+        output._inplace_update(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return assign(x)
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(_coerce(x).size, dtype=dtypes.int64))
+
+
+def shape(x) -> Tensor:
+    """paddle.shape — returns an int tensor of the shape."""
+    return Tensor(jnp.asarray(_coerce(x)._value.shape, dtype=dtypes.int32))
+
+
+def rank(x) -> Tensor:
+    return Tensor(jnp.asarray(_coerce(x).ndim, dtype=dtypes.int32))
+
+
+def _coerce(x) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(x)
+
+
+def clone_detached(x) -> Tensor:
+    return Tensor(_coerce(x)._value)
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply(lambda r, i: jax.lax.complex(r, i), _coerce(real), _coerce(imag))
+
+
+def real(x, name=None) -> Tensor:
+    return apply(jnp.real, _coerce(x))
+
+
+def imag(x, name=None) -> Tensor:
+    return apply(jnp.imag, _coerce(x))
+
+
+def polar(abs_, angle, name=None) -> Tensor:
+    return apply(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+                 _coerce(abs_), _coerce(angle))

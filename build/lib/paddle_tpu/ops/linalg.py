@@ -1,0 +1,253 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py).
+
+matmul lowers straight to jnp.matmul → XLA dot_general → the MXU. This is
+the op that replaces phi::MatmulKernel<GPU> (paddle/phi/kernels/gpu/ via
+cuBLAS); on TPU keeping everything as dot_general lets XLA tile onto the
+systolic array and fuse epilogues.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ._dispatch import apply
+from .creation import _coerce
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply(fn, _coerce(x), _coerce(y), _name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply(fn, _coerce(x), _coerce(y))
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, v: a @ v, _coerce(x), _coerce(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b),
+                 _coerce(input), _coerce(x), _coerce(y))
+
+
+def multi_dot(x, name=None):
+    ts = [_coerce(t) for t in x]
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *ts)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _coerce(x)
+    def fn(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.real(v * jnp.conj(v))))
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            if axis is None:
+                return jnp.max(jnp.abs(v))
+            return jnp.linalg.norm(v, ord=np.inf, axis=_ax(axis), keepdims=keepdim)
+        if p == float("-inf") or p == "-inf":
+            if axis is None:
+                return jnp.min(jnp.abs(v))
+            return jnp.linalg.norm(v, ord=-np.inf, axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            return jnp.sum(jnp.abs(v) ** p) ** (1.0 / p)
+        return jnp.linalg.norm(v, ord=p, axis=_ax(axis), keepdims=keepdim)
+    return apply(fn, x)
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.linalg.vector_norm(v, ord=p, axis=_ax(axis),
+                                                  keepdims=keepdim), _coerce(x))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim),
+                 _coerce(x))
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+                 _coerce(x), _coerce(y))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def fn(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1))
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return apply(fn, _coerce(x), _coerce(y))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply(fn, _coerce(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return apply(fn, _coerce(x), _coerce(y))
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _coerce(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V not V^H
+    return apply(fn, _coerce(x))
+
+
+def svdvals(x, name=None):
+    return apply(lambda v: jnp.linalg.svd(v, compute_uv=False), _coerce(x))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = _coerce(x)
+    qq = q if q is not None else min(6, x._value.shape[-2], x._value.shape[-1])
+    def fn(v):
+        if center:
+            v = v - v.mean(axis=-2, keepdims=True)
+        u, s, vh = jnp.linalg.svd(v, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vh, -1, -2)[..., :qq]
+    return apply(fn, x)
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, _coerce(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+                 _coerce(x))
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, _coerce(x), _coerce(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(fn, _coerce(x), _coerce(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rk, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rk, sv
+    return apply(fn, _coerce(x), _coerce(y))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    out = apply(fn, _coerce(x))
+    if get_infos:
+        info = Tensor(jnp.zeros((), jnp.int32))
+        return out[0], out[1], info
+    return out
+
+
+def eig(x, name=None):
+    return apply(lambda v: tuple(np_eig(v)), _coerce(x))
+
+
+def np_eig(v):
+    # jnp.linalg.eig is CPU-only in jax; route via callback for parity
+    import jax.numpy as jnp_
+    vals, vecs = np.linalg.eig(np.asarray(v))
+    return jnp_.asarray(vals), jnp_.asarray(vecs)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), _coerce(x))
+
+
+def eigvals(x, name=None):
+    def fn(v):
+        vals = np.linalg.eigvals(np.asarray(v))
+        return jnp.asarray(vals)
+    return apply(fn, _coerce(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), _coerce(x))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, _coerce(x))
+
+
+def slogdet(x, name=None):
+    return apply(lambda v: tuple(jnp.linalg.slogdet(v)), _coerce(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), _coerce(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), _coerce(x))
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, _coerce(x))
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(a.shape[:-2] + (i,), a.dtype),
+                                 jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                                 a[..., i + 1:, i]], axis=-1)
+            ti = t[..., i:i + 1, None]
+            q = q - ti * (q @ v[..., :, None]) @ v[..., None, :]
+        return q[..., :, :n]
+    return apply(fn, _coerce(x), _coerce(tau))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), _coerce(x))

@@ -1,0 +1,96 @@
+"""Comparison / logical / bitwise ops (parity: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ._dispatch import apply
+from .creation import _coerce
+from .math import _scalarize
+
+
+def _cmp(jfn, name):
+    def op(x, y, name=None):
+        return apply(jfn, _scalarize(x), _scalarize(y), _name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, _coerce(x))
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, _coerce(x))
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return apply(jnp.left_shift, _scalarize(x), _scalarize(y))
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    if is_arithmetic:
+        return apply(jnp.right_shift, _scalarize(x), _scalarize(y))
+    return apply(lambda a, b: jnp.right_shift(
+        a.view(jnp.uint64 if a.dtype == jnp.int64 else
+               jnp.uint32 if a.dtype == jnp.int32 else
+               jnp.uint16 if a.dtype == jnp.int16 else jnp.uint8), b
+    ).view(a.dtype), _scalarize(x), _scalarize(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 _coerce(x), _coerce(y))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 _coerce(x), _coerce(y))
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), _coerce(x), _coerce(y))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_coerce(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in_dynamic_mode():
+    from ..jit.api import _in_to_static
+    return not _in_to_static()
+
+
+def is_floating_point(x):
+    from ..framework import dtype as dtypes
+    return dtypes.is_floating_point(_coerce(x).dtype)
+
+
+def is_integer(x):
+    from ..framework import dtype as dtypes
+    return dtypes.is_integer(_coerce(x).dtype)
+
+
+def is_complex(x):
+    from ..framework import dtype as dtypes
+    return dtypes.is_complex(_coerce(x).dtype)

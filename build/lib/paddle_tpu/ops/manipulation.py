@@ -1,0 +1,464 @@
+"""Shape/layout manipulation ops (parity: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ._dispatch import apply, as_array
+from ..framework import dtype as dtypes
+from .creation import _coerce
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(s._value) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    sh = _static_shape(shape)
+    return apply(lambda v: jnp.reshape(v, sh), _coerce(x))
+
+
+def reshape_(x, shape, name=None):
+    x._check_inplace()
+    return x._inplace_update(reshape(x, shape))
+
+
+def transpose(x, perm=None, name=None):
+    x = _coerce(x)
+    if perm is None:
+        perm = list(reversed(range(x.ndim)))
+    perm = [int(p) for p in perm]
+    return apply(lambda v: jnp.transpose(v, perm), x)
+
+
+def t(x, name=None):
+    x = _coerce(x)
+    if x.ndim < 2:
+        return apply(lambda v: v, x)
+    return transpose(x, [1, 0])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), _coerce(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis0, axis1), _coerce(x))
+
+
+transpose_ = swapaxes  # not paddle but harmless internal alias
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ts = [_coerce(t) for t in x]
+    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [_coerce(t) for t in x]
+    return apply(lambda *vs: jnp.stack(vs, axis=int(axis)), *ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _coerce(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x._value.shape[ax]
+    if isinstance(num_or_sections, int):
+        idx = np.cumsum([dim // num_or_sections] * (num_or_sections - 1))
+    else:
+        secs = [int(s) for s in num_or_sections]
+        # paddle allows one -1 section
+        if -1 in secs:
+            known = builtins_sum(s for s in secs if s != -1)
+            secs[secs.index(-1)] = dim - known
+        idx = np.cumsum(secs[:-1])
+    return apply(lambda v: tuple(jnp.split(v, idx, axis=ax)), x)
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = _coerce(x)
+    n = x._value.shape[axis]
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis))
+    return apply(fn, x)
+
+
+unstack = unbind
+
+
+def squeeze(x, axis=None, name=None):
+    x = _coerce(x)
+    if axis is None:
+        ax = None
+    else:
+        axs = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(int(a) for a in axs if x._value.shape[int(a)] == 1)
+    return apply(lambda v: jnp.squeeze(v, axis=ax), x)
+
+
+def squeeze_(x, axis=None, name=None):
+    x._check_inplace()
+    return x._inplace_update(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axs = axis if isinstance(axis, (list, tuple)) else [axis]
+    axs = tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in axs)
+    return apply(lambda v: jnp.expand_dims(v, axs), _coerce(x))
+
+
+def unsqueeze_(x, axis, name=None):
+    x._check_inplace()
+    return x._inplace_update(unsqueeze(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _coerce(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    def fn(v):
+        sh = v.shape
+        mid = 1
+        for d in sh[s:e + 1]:
+            mid *= d
+        return jnp.reshape(v, sh[:s] + (mid,) + sh[e + 1:])
+    return apply(fn, x)
+
+
+def expand(x, shape, name=None):
+    sh = _static_shape(shape)
+    x = _coerce(x)
+    def fn(v):
+        tgt = list(sh)
+        # paddle: -1 keeps the original dim
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return apply(fn, x)
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    y = _coerce(y)
+    return expand(x, list(y._value.shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_coerce(t) for t in inputs]
+    return apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), _coerce(x))
+
+
+def flip(x, axis, name=None):
+    axs = axis if isinstance(axis, (list, tuple)) else [axis]
+    axs = tuple(int(a) for a in axs)
+    return apply(lambda v: jnp.flip(v, axis=axs), _coerce(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), _coerce(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = shifts if not isinstance(shifts, Tensor) else tuple(shifts.tolist())
+    return apply(lambda v: jnp.roll(v, sh, axis=axis), _coerce(x))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i,
+                                       axis=ax), _coerce(x), _coerce(index))
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return apply(fn, _coerce(x), _coerce(index))
+
+
+def take(x, index, mode="raise", name=None):
+    md = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply(lambda v, i: jnp.take(v.reshape(-1), i, mode=md),
+                 _coerce(x), _coerce(index))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                 _coerce(arr), _coerce(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    from .math import _scalarize
+    def fn(v, i, val):
+        val = jnp.broadcast_to(jnp.asarray(val, v.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, val, axis=axis, inplace=False)
+        idx_full = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(i.ndim)])
+                    for k, s in enumerate(i.shape)]
+        idx_full[axis] = i
+        at = v.at[tuple(idx_full)]
+        if reduce == "add":
+            return at.add(val)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(val)
+        if reduce == "amax":
+            return at.max(val)
+        if reduce == "amin":
+            return at.min(val)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply(fn, _coerce(arr), _coerce(indices), _scalarize(values))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle overwrite=False: zero target rows then accumulate
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return apply(fn, _coerce(x), _coerce(index), _coerce(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x._check_inplace()
+    return x._inplace_update(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    sh = _static_shape(shape)
+    def fn(i, u):
+        out = jnp.zeros(sh, u.dtype)
+        return out.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply(fn, _coerce(index), _coerce(updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply(fn, _coerce(x), _coerce(index), _coerce(updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i, axis=axis),
+                 _coerce(x), _coerce(index))
+
+
+def index_sample(x, index, name=None):
+    def fn(v, i):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, i]
+    return apply(fn, _coerce(x), _coerce(index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, i, val):
+        vm = jnp.moveaxis(v, axis, 0)
+        vm = vm.at[i].add(jnp.moveaxis(val, axis, 0))
+        return jnp.moveaxis(vm, 0, axis)
+    return apply(fn, _coerce(x), _coerce(index), _coerce(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = [_coerce(i) for i in indices]
+    def fn(v, val, *ids):
+        at = v.at[tuple(ids)]
+        return at.add(val) if accumulate else at.set(val)
+    return apply(fn, _coerce(x), _coerce(value), *idxs)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: host-side compute (not jittable; parity with
+    # paddle's dynamic-shape op). Inside jit use where() instead.
+    x = _coerce(x)
+    m = _coerce(mask)
+    vals = np.asarray(x._value)[np.asarray(m._value)]
+    return Tensor(jnp.asarray(vals))
+
+
+def masked_fill(x, mask, value, name=None):
+    from .math import _scalarize
+    return apply(lambda v, m, val: jnp.where(m, jnp.asarray(val, v.dtype), v),
+                 _coerce(x), _coerce(mask), _scalarize(value))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    from .math import _scalarize
+    return apply(lambda c, a, b: jnp.where(c, a, b),
+                 _coerce(condition), _scalarize(x), _scalarize(y))
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic shape → host-side (parity: paddle.nonzero is dynamic too)
+    arr = np.asarray(_coerce(x)._value)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, dtype=dtypes.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=dtypes.int64))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _coerce(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        # full-rank paddle format: [d0_l, d0_r, d1_l, d1_r, ...] ordered by dim
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # NCHW-style partial spec applies to trailing spatial dims, reversed
+        # pairs (paddle uses [left, right, top, bottom] == last-dim-first)
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC / NDHWC / NLC
+            spatial = list(range(1, 1 + k))
+        else:
+            spatial = list(range(nd - k, nd))
+        for j, d in enumerate(reversed(spatial)):
+            width[d] = (pad[2 * j], pad[2 * j + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return apply(lambda v: jnp.pad(v, width, mode=jmode, **kw), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._value)
+        total = int(reps.sum())
+        return apply(lambda v, r: jnp.repeat(v, r, axis=axis,
+                                             total_repeat_length=total),
+                     _coerce(x), repeats)
+    return apply(lambda v: jnp.repeat(v, repeats, axis=axis), _coerce(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax),
+                 _coerce(x), _coerce(y))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = offset + builtins_sum_outer(shape, stride)
+        return flat[idx]
+    def builtins_sum_outer(shape_, stride_):
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape_], indexing="ij")
+        lin = 0
+        for g, st in zip(grids, stride_):
+            lin = lin + g * st
+        return lin
+    return apply(fn, _coerce(x))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply(lambda v: v.view(dtypes.convert_dtype(shape_or_dtype)), _coerce(x))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, _coerce(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, _coerce(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, _coerce(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def cast(x, dtype, name=None):
+    return _coerce(x).astype(dtype)
+
+
+def slice(input, axes, starts, ends):
+    def fn(v):
+        out = v
+        for ax, st, en in zip(axes, starts, ends):
+            st = int(st.item()) if isinstance(st, Tensor) else int(st)
+            en = int(en.item()) if isinstance(en, Tensor) else int(en)
+            dim = v.shape[ax]
+            st = max(st + dim, 0) if st < 0 else min(st, dim)
+            en = max(en + dim, 0) if en < 0 else min(en, dim)
+            out = jax.lax.slice_in_dim(out, st, en, axis=ax)
+        return out
+    return apply(fn, _coerce(input))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+    def fn(v):
+        out = v
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx = [builtins.slice(None)] * out.ndim
+            idx[ax] = builtins.slice(int(st), int(en), int(sd))
+            out = out[tuple(idx)]
+        return out
+    return apply(fn, _coerce(x))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(v):
+        size = (index_num + nshards - 1) // nshards
+        lo = shard_id * size
+        in_shard = (v >= lo) & (v < lo + size)
+        return jnp.where(in_shard, v - lo, ignore_value)
+    return apply(fn, _coerce(input))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    sh = _static_shape(shape)
+    offs = [0] * len(sh) if offsets is None else [int(o) for o in offsets]
+    def fn(v):
+        return jax.lax.dynamic_slice(v, offs, sh)
+    return apply(fn, _coerce(x))

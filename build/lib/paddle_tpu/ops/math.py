@@ -1,0 +1,451 @@
+"""Math ops (parity: python/paddle/tensor/math.py, ops.py, stat.py).
+
+Every op is a thin Paddle-signature wrapper lowering to jax.numpy through
+the tape dispatch (`_dispatch.apply`); XLA fuses chains of these into single
+TPU kernels, which replaces Paddle's phi elementwise/reduce CUDA kernel
+templates (paddle/phi/kernels/funcs/elementwise_base.h etc.).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, to_tensor
+from ..framework import dtype as dtypes
+from ._dispatch import apply
+from .creation import _coerce
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _scalarize(v):
+    """Python scalars stay scalars (weak-typed in jax → no bad promotion)."""
+    if isinstance(v, Tensor):
+        return v
+    if isinstance(v, (int, float, bool, complex, np.number)):
+        return v
+    return to_tensor(v)
+
+
+# ---------------------------------------------------------------- unary ----
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, _coerce(x), _name=name)
+    op.__name__ = name
+    return op
+
+
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+sign = _unary(jnp.sign, "sign")
+sgn = _unary(jnp.sign, "sgn")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+erf = _unary(jax.lax.erf, "erf")
+erfinv = _unary(jax.lax.erf_inv, "erfinv")
+lgamma = _unary(jax.lax.lgamma, "lgamma")
+digamma = _unary(jax.lax.digamma, "digamma")
+i0 = _unary(lambda v: jax.lax.bessel_i0e(v) * jnp.exp(jnp.abs(v)), "i0")
+i0e = _unary(jax.lax.bessel_i0e, "i0e")
+i1e = _unary(jax.lax.bessel_i1e, "i1e")
+i1 = _unary(lambda v: jax.lax.bessel_i1e(v) * jnp.exp(jnp.abs(v)), "i1")
+conj = _unary(jnp.conj, "conj")
+angle = _unary(jnp.angle, "angle")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+frac = _unary(lambda v: v - jnp.trunc(v), "frac")
+logit = _unary(lambda v: jnp.log(v / (1 - v)), "logit")
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, _coerce(x))
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, _coerce(x))
+
+
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, _coerce(x))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), _coerce(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), _coerce(x))
+
+
+# --------------------------------------------------------------- binary ----
+def _binary(jfn, name):
+    def op(x, y, name=None):
+        return apply(jfn, _scalarize(x), _scalarize(y), _name=name)
+    op.__name__ = name
+    return op
+
+
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+remainder = _binary(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+pow = _binary(jnp.power, "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+hypot = _binary(jnp.hypot, "hypot")
+heaviside = _binary(jnp.heaviside, "heaviside")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+kron = _binary(jnp.kron, "kron")
+nextafter = _binary(jnp.nextafter, "nextafter")
+copysign = _binary(jnp.copysign, "copysign")
+ldexp = _binary(lambda x, y: x * (2.0 ** y).astype(x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else (x * (2 ** y)), "ldexp")
+
+
+def divide_no_nan(x, y, name=None):
+    return apply(lambda a, b: jnp.where(b == 0, 0, a / jnp.where(b == 0, 1, b)),
+                 _scalarize(x), _scalarize(y))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = apply(lambda v: v * s + bias, _coerce(x))
+    else:
+        out = apply(lambda v: (v + bias) * s, _coerce(x))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [_coerce(t) for t in inputs]
+    import functools
+    return apply(lambda *vs: functools.reduce(jnp.add, vs), *ts)
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a),
+                 _coerce(x), _coerce(y), _scalarize(weight))
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) and min.size == 1 else min
+    hi = max.item() if isinstance(max, Tensor) and max.size == 1 else max
+    return apply(lambda v: jnp.clip(v, lo, hi), _coerce(x))
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, _coerce(x), _coerce(y))
+
+
+def outer(x, y, name=None):
+    return apply(jnp.outer, _coerce(x), _coerce(y))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                 _coerce(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                        axis2=axis2), _coerce(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [_coerce(x)]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        args.append(_coerce(prepend))
+    if has_app:
+        args.append(_coerce(append))
+
+    def fn(v, *rest):
+        pre = rest[0] if has_pre else None
+        app = rest[-1] if has_app else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return apply(fn, *args)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else (next((i for i, s in enumerate(_coerce(x)._value.shape) if s == 3), -1))
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), _coerce(x), _coerce(y))
+
+
+def multiplex(inputs, index, name=None):
+    ts = [_coerce(t) for t in inputs]
+    idx = _coerce(index)
+    def fn(ix, *vs):
+        stacked = jnp.stack(vs, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[ix.reshape(-1), rows]
+    return apply(fn, idx, *ts)
+
+
+# ----------------------------------------------------------- reductions ----
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype)
+    x = _coerce(x)
+    def fn(v):
+        out = jnp.sum(v, axis=_axes(axis), keepdims=keepdim, dtype=d)
+        return out
+    return apply(fn, x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda v: jnp.nansum(v, axis=_axes(axis), keepdims=keepdim,
+                                      dtype=d), _coerce(x))
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=_axes(axis), keepdims=keepdim),
+                 _coerce(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmean(v, axis=_axes(axis), keepdims=keepdim),
+                 _coerce(x))
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    return apply(lambda v: jnp.prod(v, axis=_axes(axis), keepdims=keepdim,
+                                    dtype=d), _coerce(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.max(v, axis=_axes(axis), keepdims=keepdim),
+                 _coerce(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.min(v, axis=_axes(axis), keepdims=keepdim),
+                 _coerce(x))
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.scipy.special.logsumexp(
+        v, axis=_axes(axis), keepdims=keepdim), _coerce(x))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.all(v, axis=_axes(axis), keepdims=keepdim),
+                 _coerce(x))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.any(v, axis=_axes(axis), keepdims=keepdim),
+                 _coerce(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_axes(axis),
+                                             keepdims=keepdim).astype(jnp.int64),
+                 _coerce(x))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=_axes(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _coerce(x))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=_axes(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _coerce(x))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axes(axis), keepdims=keepdim)
+        # 'min' mode: lower median
+        ax = _axes(axis)
+        if ax is None:
+            flat = v.reshape(-1)
+            k = (flat.shape[0] - 1) // 2
+            return jnp.sort(flat)[k]
+        srt = jnp.sort(v, axis=ax)
+        k = (v.shape[ax] - 1) // 2
+        out = jnp.take(srt, k, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return apply(fn, _coerce(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=_axes(axis), keepdims=keepdim),
+                 _coerce(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.numpy() if isinstance(q, Tensor) else q
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(qv), axis=_axes(axis),
+                                        keepdims=keepdim, method=interpolation),
+                 _coerce(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    qv = q.numpy() if isinstance(q, Tensor) else q
+    return apply(lambda v: jnp.nanquantile(v, jnp.asarray(qv), axis=_axes(axis),
+                                           keepdims=keepdim), _coerce(x))
+
+
+# ------------------------------------------------------------ cumulative ----
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=d)
+        return jnp.cumsum(v, axis=int(axis), dtype=d)
+    return apply(fn, _coerce(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype)
+    def fn(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=d)
+        return jnp.cumprod(v, axis=int(dim), dtype=d)
+    return apply(fn, _coerce(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        n = vv.shape[ax]
+        eq = vv == vals
+        idx = jnp.arange(n).reshape([-1 if i == (ax % vv.ndim) else 1
+                                     for i in range(vv.ndim)])
+        idx = jnp.where(eq, idx, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, inds.astype(dtypes.convert_dtype(dtype))
+    return apply(fn, _coerce(x))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=ax)
+        n = vv.shape[ax]
+        eq = vv == vals
+        idx = jnp.arange(n).reshape([-1 if i == (ax % vv.ndim) else 1
+                                     for i in range(vv.ndim)])
+        idx = jnp.where(eq, idx, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, inds.astype(dtypes.convert_dtype(dtype))
+    return apply(fn, _coerce(x))
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else int(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+    return apply(fn, _coerce(x))
+
+
+# ----------------------------------------------------------------- stat ----
+def histogram(x, bins=100, min=0, max=0, name=None):
+    x = _coerce(x)
+    def fn(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+    return apply(fn, x)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _coerce(x)
+    n = int(np.asarray(x._value).max()) + 1 if x.size else 0
+    length = builtins_max(n, int(minlength))
+    if weights is None:
+        return apply(lambda v: jnp.bincount(v, length=length), x)
+    return apply(lambda v, w: jnp.bincount(v, weights=w, length=length),
+                 x, _coerce(weights))
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0),
+                 _coerce(x))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), _coerce(x))
+
+
+# --------------------------------------------------------------- einsum ----
+def einsum(equation, *operands):
+    ops_ = [_coerce(o) for o in operands]
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *ops_)
+
+
+# ---------------------------------------------------------------- misc -----
+def increment(x, value=1.0, name=None):
+    out = apply(lambda v: v + value, _coerce(x))
+    x._inplace_update(out)
+    return x
+
+
+def accuracy_like_ops():  # placeholder namespace guard
+    raise NotImplementedError

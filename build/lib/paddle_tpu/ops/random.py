@@ -1,0 +1,152 @@
+"""Random ops over the global stateful generator
+(parity: python/paddle/tensor/random.py; generator semantics from
+paddle/phi/core/generator.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+from ..framework.random import next_key
+from .creation import _shape, _coerce
+from ._dispatch import apply
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else (default or dtypes.get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(next_key(), _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    x._value = jax.random.uniform(next_key(), x._value.shape, x._value.dtype,
+                                  minval=min, maxval=max)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(next_key(), sh) * s + m)
+    sh = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(next_key(), sh,
+                                    dtypes.get_default_dtype()) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x._value = (jax.random.normal(next_key(), x._value.shape, x._value.dtype)
+                * std + mean)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     _dt(dtype, dtypes.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = _coerce(x)
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), tuple(x._value.shape), low,
+                                     high, _dt(dtype, x.dtype)))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(_dt(dtype, dtypes.int64)))
+
+
+def shuffle(x, name=None) -> Tensor:
+    x = _coerce(x)
+    perm = jax.random.permutation(next_key(), x._value.shape[0])
+    return apply(lambda v: v[perm], x)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = _coerce(x)
+    def draw(v):
+        logits = jnp.log(jnp.maximum(v, 1e-38))
+        if replacement:
+            return jax.random.categorical(
+                next_key(), logits, axis=-1,
+                shape=(num_samples,) + v.shape[:-1]).T if v.ndim > 1 else \
+                jax.random.categorical(next_key(), logits, shape=(num_samples,))
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(next_key(), v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    return Tensor(draw(x._value).astype(dtypes.int64))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = _coerce(x)
+    u = jax.random.uniform(next_key(), tuple(x._value.shape))
+    return apply(lambda v: (u < v).astype(v.dtype), x)
+
+
+def bernoulli_(x, p=0.5, name=None) -> Tensor:
+    x._value = (jax.random.uniform(next_key(), x._value.shape) < p).astype(x._value.dtype)
+    return x
+
+
+def poisson(x, name=None) -> Tensor:
+    x = _coerce(x)
+    return Tensor(jax.random.poisson(next_key(), x._value).astype(x.dtype))
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    c = _coerce(count)
+    p = _coerce(prob)
+    return Tensor(jax.random.binomial(next_key(), c._value.astype(jnp.float32),
+                                      p._value).astype(dtypes.int64))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x._value = (jax.random.exponential(next_key(), x._value.shape,
+                                       x._value.dtype) / lam)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None) -> Tensor:
+    sh = _shape(shape) if shape is not None else ()
+    return Tensor(jnp.exp(jax.random.normal(next_key(), sh,
+                                            dtypes.get_default_dtype()) * std + mean))
+
+
+def rand_like(x, dtype=None, name=None) -> Tensor:
+    x = _coerce(x)
+    return Tensor(jax.random.uniform(next_key(), tuple(x._value.shape),
+                                     _dt(dtype, x.dtype)))
+
+
+def randn_like(x, dtype=None, name=None) -> Tensor:
+    x = _coerce(x)
+    return Tensor(jax.random.normal(next_key(), tuple(x._value.shape),
+                                    _dt(dtype, x.dtype)))

@@ -1,0 +1,161 @@
+"""Search/sort ops (parity: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+from ._dispatch import apply
+from .creation import _coerce
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    def fn(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.astype(d)
+        out = jnp.argmax(v, axis=int(axis), keepdims=keepdim)
+        return out.astype(d)
+    return apply(fn, _coerce(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    def fn(v):
+        if axis is None:
+            return jnp.argmin(v.reshape(-1)).astype(d)
+        return jnp.argmin(v, axis=int(axis), keepdims=keepdim).astype(d)
+    return apply(fn, _coerce(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable or True,
+                          descending=descending)
+        return idx.astype(dtypes.int64)
+    return apply(fn, _coerce(x))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, stable=stable or True,
+                       descending=descending)
+        return out
+    return apply(fn, _coerce(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    def fn(v):
+        ax = v.ndim - 1 if axis is None else int(axis) % v.ndim
+        vv = jnp.moveaxis(v, ax, -1) if ax != v.ndim - 1 else v
+        if largest:
+            vals, idx = jax.lax.top_k(vv, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vv, kk)
+            vals = -vals
+        if ax != v.ndim - 1:
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(dtypes.int64)
+    return apply(fn, _coerce(x))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = int(axis) % v.ndim
+        srt = jnp.sort(v, axis=ax)
+        arg = jnp.argsort(v, axis=ax).astype(dtypes.int64)
+        vals = jnp.take(srt, k - 1, axis=ax)
+        idx = jnp.take(arg, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+    return apply(fn, _coerce(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = _coerce(x)
+    def fn(v):
+        ax = int(axis) % v.ndim
+        srt = jnp.sort(v, axis=ax)
+        n = v.shape[ax]
+        # count runs in sorted order; mode = value with max run length
+        eq = jnp.concatenate([jnp.ones_like(jnp.take(srt, [0], axis=ax), dtype=bool),
+                              jnp.take(srt, jnp.arange(1, n), axis=ax) ==
+                              jnp.take(srt, jnp.arange(n - 1), axis=ax)], axis=ax)
+        run = jax.lax.associative_scan(
+            lambda a, b: b * (a + 1), eq.astype(jnp.int32), axis=ax)
+        best = jnp.argmax(run, axis=ax, keepdims=True)
+        vals = jnp.take_along_axis(srt, best, axis=ax)
+        # paddle returns the index of (one) occurrence in the original array
+        match = v == vals
+        idx = jnp.argmax(match, axis=ax, keepdims=True).astype(dtypes.int64)
+        if not keepdim:
+            vals = jnp.squeeze(vals, axis=ax)
+            idx = jnp.squeeze(idx, axis=ax)
+        return vals, idx
+    return apply(fn, x)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    d = dtypes.int32 if out_int32 else dtypes.int64
+    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(d),
+                 _coerce(sorted_sequence), _coerce(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape → host-side (parity: paddle op is dynamic too)
+    arr = np.asarray(_coerce(x)._value)
+    res = np.unique(arr, return_index=True, return_inverse=True,
+                    return_counts=True, axis=axis)
+    vals, idx, inv, cnt = res
+    d = dtypes.convert_dtype(dtype)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_index:
+        outs.append(Tensor(jnp.asarray(idx, dtype=d)))
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv.reshape(arr.shape if axis is None else -1), dtype=d)))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(cnt, dtype=d)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(_coerce(x)._value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    sel = np.ones(arr.shape[ax], dtype=bool)
+    if arr.shape[ax] > 1:
+        a = np.take(arr, range(1, arr.shape[ax]), axis=ax)
+        b = np.take(arr, range(arr.shape[ax] - 1), axis=ax)
+        neq = (a != b)
+        while neq.ndim > 1:
+            neq = neq.any(axis=-1 if ax == 0 else 0)
+        sel[1:] = neq
+    vals = np.compress(sel, arr, axis=ax)
+    d = dtypes.convert_dtype(dtype)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(sel) - 1
+        outs.append(Tensor(jnp.asarray(inv, dtype=d)))
+    if return_counts:
+        pos = np.flatnonzero(sel)
+        cnt = np.diff(np.append(pos, arr.shape[ax]))
+        outs.append(Tensor(jnp.asarray(cnt, dtype=d)))
+    return outs[0] if len(outs) == 1 else tuple(outs)

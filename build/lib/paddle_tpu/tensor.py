@@ -1,0 +1,350 @@
+"""paddle_tpu.Tensor — the eager tensor.
+
+Reference parity: Paddle's eager `paddle.Tensor` (C++ phi::DenseTensor +
+AutogradMeta, bound in paddle/fluid/pybind/eager_method.cc) with the dygraph
+semantics: `stop_gradient` defaulting True for data and False for Parameters,
+`.backward()` tape-driven autograd, in-place `op_` variants, `.grad` holding
+the accumulated gradient.
+
+TPU-native design: the storage is a `jax.Array` (`_value`); "in-place"
+mutation is rebinding (`_value` swap), which XLA turns into pure dataflow —
+there is no aliasing hazard because every consumer captured the old array.
+Autograd metadata is a producer `GradNode` + output index; the tape is built
+eagerly by `ops._dispatch.apply` via `jax.vjp`. Under `paddle_tpu.jit` the
+same Python code traces with `jax.Array` tracers inside, so one tensor type
+serves both "dygraph" and "static" modes.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework import dtype as dtypes
+from .framework.place import Place, CPUPlace, TPUPlace, _default_place
+from ._grad_mode import is_grad_enabled
+
+
+class Tensor:
+    __slots__ = (
+        "_value", "stop_gradient", "grad", "_grad_node", "_out_index",
+        "name", "persistable", "_hooks", "_pylayer_ctx", "__weakref__",
+        "__dict__",  # extension attrs (partition specs, dist metadata, ...)
+    )
+
+    def __init__(self, value, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array) and not _is_tracer(value):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._hooks = None
+
+    # ---- basic meta ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def rank(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = next(iter(self._value.devices()))
+            if dev.platform.lower() == "cpu":
+                return CPUPlace(dev.id)
+            return TPUPlace(dev.id)
+        except Exception:  # tracers have no device
+            return _default_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    # ---- conversion ----------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous; use .any() or .all()")
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __index__(self):
+        return int(self.item())
+
+    # ---- autograd ------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from .autograd.engine import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        """Hook runs on this tensor's gradient during backward; may return a
+        new gradient. Returns a removable handle (parity: Tensor.register_hook)."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        hooks = self._hooks
+        class _Handle:
+            def remove(self_inner):
+                if hook in hooks:
+                    hooks.remove(hook)
+        return _Handle()
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    def detach(self) -> "Tensor":
+        v = self._value
+        if _is_tracer(v):
+            # under an outer jax trace (TrainStep/functionalize) the eager
+            # tape is bypassed; block the outer grad at the jax level too
+            v = jax.lax.stop_gradient(v)
+        t = Tensor(v, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self._out_index = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .ops import _dispatch
+        return _dispatch.apply(lambda x: x + jnp.zeros((), x.dtype), self)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # ---- in-place plumbing --------------------------------------------
+    def _check_inplace(self):
+        if is_grad_enabled() and not self.stop_gradient and self.is_leaf:
+            raise RuntimeError(
+                "in-place modification of a leaf Tensor that requires grad "
+                "is not allowed (wrap in paddle.no_grad() or use assign)")
+
+    def _inplace_update(self, new: "Tensor") -> "Tensor":
+        """Rebind this tensor to `new`'s value/autograd metadata.
+
+        If `self` is an input of the op that produced `new` (the usual
+        in-place pattern), the node must keep seeing the PRE-mutation
+        tensor: swap in an alias carrying the old value + old producer so
+        the tape stays acyclic and gradients flow through the old history.
+        """
+        node = new._grad_node
+        if node is not None:
+            for i, t in enumerate(node.inputs):
+                if t is self:
+                    alias = Tensor(self._value,
+                                   stop_gradient=self.stop_gradient)
+                    alias._grad_node = self._grad_node
+                    alias._out_index = self._out_index
+                    alias._hooks = self._hooks
+                    node.inputs[i] = alias
+        self._value = new._value
+        if not new.stop_gradient:
+            self._grad_node = new._grad_node
+            self._out_index = new._out_index
+            self.stop_gradient = False
+        return self
+
+    def copy_(self, other, blocking: bool = True) -> "Tensor":
+        other = other if isinstance(other, Tensor) else Tensor(other)
+        self._value = jnp.broadcast_to(
+            other._value.astype(self._value.dtype), self._value.shape)
+        return self
+
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        self._value = v.astype(self._value.dtype) if v.dtype != self._value.dtype else v
+        return self
+
+    # ---- device movement ----------------------------------------------
+    def to(self, *args, **kwargs) -> "Tensor":
+        device = kwargs.get("device")
+        dtype_ = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, Place)):
+                try:
+                    dtype_ = dtypes.convert_dtype(a) if not isinstance(a, Place) and a in dtypes._STR_TO_DTYPE else dtype_
+                except Exception:
+                    pass
+                if isinstance(a, Place) or (isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu", "xla", "cuda")):
+                    device = a
+            elif a is not None:
+                dtype_ = a
+        out = self
+        if dtype_ is not None:
+            out = out.astype(dtype_)
+        if device is not None:
+            from .framework.place import _parse_place
+            place = _parse_place(device)
+            out = Tensor(jax.device_put(out._value, place.jax_device),
+                         stop_gradient=out.stop_gradient)
+        return out
+
+    def cpu(self) -> "Tensor":
+        return self.to(device="cpu")
+
+    def cuda(self, device_id=0) -> "Tensor":  # parity alias → accelerator
+        return self.to(device=f"tpu:{device_id}")
+
+    def tpu(self, device_id=0) -> "Tensor":
+        return self.to(device=f"tpu:{device_id}")
+
+    def pin_memory(self) -> "Tensor":  # parity no-op on TPU
+        return self
+
+    # ---- misc ----------------------------------------------------------
+    def astype(self, dtype_) -> "Tensor":
+        from .ops import _dispatch
+        d = dtypes.convert_dtype(dtype_)
+        if d == self.dtype:
+            return _dispatch.apply(lambda x: x, self)
+        return _dispatch.apply(lambda x: x.astype(d), self)
+
+    def cast(self, dtype_) -> "Tensor":
+        return self.astype(dtype_)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if _is_tracer(self._value):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                    f"stop_gradient={sg}, traced={self._value})")
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"place={self.place}, stop_gradient={sg},\n"
+                f"       {np.array2string(self.numpy(), prefix='       ')})")
+
+    __str__ = __repr__
+
+    # Arithmetic/indexing dunders are attached by paddle_tpu.ops at import
+    # time (parity: Paddle monkey-patches math methods onto Tensor in
+    # python/paddle/tensor/math.py et al.).
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (parity: paddle.base.framework.EagerParamBase).
+    stop_gradient defaults False; `trainable` mirrors (not stop_gradient)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.need_clip = True
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor with Paddle default-dtype semantics: python floats →
+    default dtype (float32), python ints → int64, numpy keeps its dtype."""
+    d = dtypes.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        t = data.astype(d) if (d is not None and d != data.dtype) else Tensor(data._value)
+        t.stop_gradient = stop_gradient
+        return t
+    if isinstance(data, jax.Array) or _is_tracer(data):
+        v = data if d is None else data.astype(d)
+        return Tensor(v, stop_gradient=stop_gradient)
+    arr = np.asarray(data)
+    if d is None:
+        if arr.dtype == np.float64 and not isinstance(data, np.ndarray) and not (
+                isinstance(data, (list, tuple)) and _contains_np(data)):
+            # python float scalars/lists → default dtype
+            d = dtypes.get_default_dtype()
+        elif arr.dtype == np.int32 and not isinstance(data, np.ndarray):
+            d = dtypes.int64
+        elif arr.dtype == np.int64 and not isinstance(data, np.ndarray):
+            d = dtypes.int64
+        else:
+            d = arr.dtype
+    v = jnp.asarray(arr, dtype=d)
+    if place is not None:
+        from .framework.place import _parse_place
+        v = jax.device_put(v, _parse_place(place).jax_device)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def _contains_np(data) -> bool:
+    if isinstance(data, np.ndarray):
+        return True
+    if isinstance(data, (list, tuple)):
+        return any(_contains_np(x) for x in data)
+    return False

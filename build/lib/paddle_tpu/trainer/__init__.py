@@ -1,0 +1,241 @@
+"""Pretraining Trainer: the north-star training loop (SURVEY.md §7 M7).
+
+Reference parity (capability): the PaddleNLP Trainer atop Fleet —
+hybrid-parallel train loop with checkpoint/auto-resume, throughput/MFU
+logging, and preemption-safe restart. The reference recovers failures by
+relaunch-from-checkpoint (fleet elastic, SURVEY.md §5.3); TPU preemption
+works the same way, so the loop here is: restore latest → scan steps →
+async-checkpoint every save_steps → on SIGTERM checkpoint and exit 0 so
+`paddle_tpu.distributed.launch` (or the TPU pod scheduler) restarts us.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+import jax
+
+from ..tensor import Tensor
+
+__all__ = ["TrainingArguments", "Trainer", "SpeedMeter",
+           "device_peak_flops"]
+
+
+def device_peak_flops(dtype: str = "bfloat16") -> float:
+    """Peak FLOP/s of one local accelerator chip, for MFU accounting.
+    Known TPU generations by device_kind; conservative 1e12 fallback."""
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    table = {  # bf16 peak per chip
+        "tpu v4": 275e12, "tpu v5 lite": 197e12, "tpu v5e": 197e12,
+        "tpu v5p": 459e12, "tpu v5": 459e12, "tpu v6e": 918e12,
+        "tpu v6 lite": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v if dtype in ("bfloat16", "float16") else v / 2
+    return 1e12
+
+
+@dataclass
+class SpeedMeter:
+    """Rolling tokens/sec + MFU meter (the reference reports ips/tokens-per
+    -sec per rank; MFU = achieved/(peak) with 6*N FLOPs per token)."""
+    n_params: int
+    n_devices: int = 1
+    dtype: str = "bfloat16"
+    window: int = 20
+    _times: list = field(default_factory=list)
+    _tokens: list = field(default_factory=list)
+
+    def update(self, tokens: int):
+        now = time.perf_counter()
+        self._times.append(now)
+        self._tokens.append(tokens)
+        if len(self._times) > self.window + 1:
+            self._times.pop(0)
+            self._tokens.pop(0)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        if len(self._times) < 2:
+            return 0.0
+        dt = self._times[-1] - self._times[0]
+        return sum(self._tokens[1:]) / dt if dt > 0 else 0.0
+
+    @property
+    def mfu(self) -> float:
+        peak = device_peak_flops(self.dtype) * self.n_devices
+        return (6.0 * self.n_params * self.tokens_per_sec) / peak
+
+
+@dataclass
+class TrainingArguments:
+    """Knob bag (parity-shaped with PaddleNLP TrainingArguments; only the
+    fields the loop consumes — unknown knobs belong in DistributedStrategy)."""
+    output_dir: str = "output"
+    max_steps: int = 1000
+    logging_steps: int = 10
+    save_steps: int = 100
+    seed: int = 42
+    bf16: bool = False
+    max_checkpoints: int = 3
+    # hybrid parallel degrees (compiled to mesh axes by fleet)
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_stage: int = 0  # 0=off, 1/2/3 = ZeRO stage
+    sep_degree: int = 1      # context/sequence parallel
+
+
+class Trainer:
+    """Minimal-surface pretrain loop over TrainStep/DistTrainStep.
+
+    train() returns a dict with final step/loss and speed stats. Resume is
+    automatic: if output_dir holds a checkpoint, training continues from it
+    (parity: Trainer resume_from_checkpoint=True by default under elastic).
+    """
+
+    def __init__(self, model, optimizer, loss_fn: Callable,
+                 args: TrainingArguments, data_iter_fn: Callable,
+                 tokens_per_batch: Optional[int] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.args = args
+        self.data_iter_fn = data_iter_fn  # (start_step) -> iterator of batches
+        self.tokens_per_batch = tokens_per_batch
+        self._preempted = False
+        self._step_obj = None
+        self._ckpt = None
+
+        distributed = (args.dp_degree * args.mp_degree * args.pp_degree *
+                       args.sep_degree > 1 or args.sharding_stage >= 2)
+        if distributed:
+            from ..distributed import fleet
+            from ..distributed.fleet import fleet_api
+            if fleet_api._fleet_state["hcg"] is None:  # unless user init'd
+                strategy = fleet.DistributedStrategy()
+                strategy.hybrid_configs = {
+                    "dp_degree": args.dp_degree,
+                    "mp_degree": args.mp_degree,
+                    "pp_degree": args.pp_degree,
+                    "sep_degree": args.sep_degree,
+                }
+                fleet.init(is_collective=True, strategy=strategy)
+            from ..distributed.fleet.dist_step import DistTrainStep
+            self._step_obj = DistTrainStep(
+                model, optimizer, loss_fn,
+                sharding_stage=args.sharding_stage)
+        else:
+            from ..jit.bridge import TrainStep
+            self._step_obj = TrainStep(model, optimizer, loss_fn)
+
+    # ------------------------------------------------------- checkpointing --
+    def _ckpt_mgr(self):
+        if self._ckpt is None:
+            from ..distributed.checkpoint import AsyncCheckpointer
+            self._ckpt = AsyncCheckpointer(
+                os.path.join(self.args.output_dir, "checkpoints"))
+        return self._ckpt
+
+    def _full_state(self, step: int):
+        """Model + opt-state + rng as one orbax-friendly tree. The opt state
+        lives in the compiled step object (donated buffers); model params
+        track it after every step, so state_dict() is current."""
+        state = {"model": dict(self.model.state_dict()),
+                 "step": np.asarray(step, dtype=np.int64)}
+        opt_leaves = jax.tree_util.tree_leaves(self._step_obj.opt_state)
+        state["opt"] = {str(i): leaf for i, leaf in enumerate(opt_leaves)}
+        return state
+
+    def _save(self, step: int):
+        self._ckpt_mgr().save(step, self._full_state(step))
+
+    def _try_resume(self) -> int:
+        mgr = self._ckpt_mgr()
+        template = self._full_state(0)
+        from ..distributed.checkpoint import AsyncCheckpointer  # noqa: F401
+        step = mgr._mgr.latest_step()
+        if step is None:
+            return 0
+        import orbax.checkpoint as ocp
+        from ..distributed.checkpoint import _to_arrays
+        restored = mgr._mgr.restore(
+            step, args=ocp.args.StandardRestore(_to_arrays(template)))
+        # write model params back
+        model_sd = self.model.state_dict()
+        for k, v in model_sd.items():
+            if k in restored["model"]:
+                v._value = restored["model"][k]
+        # rebuild opt state with the original treedef
+        leaves, treedef = jax.tree_util.tree_flatten(self._step_obj.opt_state)
+        new_leaves = [restored["opt"][str(i)] for i in range(len(leaves))]
+        self._step_obj._opt_state = jax.tree_util.tree_unflatten(
+            treedef, new_leaves)
+        return int(restored["step"])
+
+    # ------------------------------------------------------------ the loop --
+    def _install_preemption_hook(self):
+        def handler(signum, frame):
+            self._preempted = True  # acted on at the next step boundary
+        try:
+            signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            pass  # not the main thread (e.g. under a test runner)
+
+    def train(self, resume: bool = True):
+        args = self.args
+        os.makedirs(args.output_dir, exist_ok=True)
+        self._install_preemption_hook()
+        start_step = self._try_resume() if resume else 0
+
+        meter = SpeedMeter(
+            n_params=sum(int(np.prod(p.shape))
+                         for p in self.model.parameters()),
+            n_devices=jax.device_count(),
+            dtype="bfloat16" if args.bf16 else "float32")
+        logs = []
+        step = start_step
+        loss = None
+        loss_val = float("nan")
+        data = self.data_iter_fn(start_step)
+        t_start = time.perf_counter()
+        for step in range(start_step, args.max_steps):
+            batch = next(data)
+            if not isinstance(batch, (tuple, list)):
+                batch = (batch,)
+            loss = self._step_obj(*batch)
+            if self.tokens_per_batch:
+                meter.update(self.tokens_per_batch)
+            if (step + 1) % args.logging_steps == 0 or self._preempted:
+                loss_val = float(loss)  # device sync at log boundary only
+                rec = {"step": step + 1, "loss": round(loss_val, 6),
+                       "tokens_per_sec": round(meter.tokens_per_sec, 2),
+                       "mfu": round(meter.mfu, 4)}
+                logs.append(rec)
+                self._log(rec)
+            if (step + 1) % args.save_steps == 0 or self._preempted:
+                self._save(step + 1)
+            if self._preempted:
+                self._ckpt_mgr().wait()
+                self._log({"preempted_at": step + 1})
+                break
+        else:
+            step = args.max_steps - 1
+            if loss is not None:
+                loss_val = float(loss)
+        self._ckpt_mgr().wait()
+        return {"start_step": start_step, "final_step": step + 1,
+                "final_loss": loss_val,
+                "wall_s": time.perf_counter() - t_start,
+                "tokens_per_sec": meter.tokens_per_sec, "mfu": meter.mfu,
+                "preempted": self._preempted, "logs": logs}
+
+    def _log(self, rec: dict):
+        import logging
+        logging.getLogger("paddle_tpu.trainer").info("%s", rec)

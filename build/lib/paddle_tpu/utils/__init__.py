@@ -1,0 +1,56 @@
+"""paddle.utils parity (unique_name, deprecated, try_import, dlpack,
+cpp_extension pointer).
+
+Reference parity: python/paddle/utils/ — the pieces user code commonly
+touches. `download` is gated (zero-egress environments); cpp_extension
+maps to the repo's csrc/ ctypes build (paddle_tpu._native).
+"""
+from __future__ import annotations
+
+import importlib
+import warnings
+
+from . import unique_name
+from . import dlpack
+
+__all__ = ["unique_name", "deprecated", "try_import", "run_check",
+           "dlpack"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator mirroring paddle.utils.deprecated."""
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__} is deprecated since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        return wrapper
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    """paddle.utils.try_import parity."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+            "(this sandbox forbids pip install; gate the feature)")
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the backend works."""
+    import jax
+    import jax.numpy as jnp
+    n = len(jax.devices())
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 128.0
+    print(f"PaddleTPU works well on {n} {jax.default_backend()} "
+          f"device{'s' if n > 1 else ''}.")

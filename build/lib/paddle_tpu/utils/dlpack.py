@@ -1,0 +1,24 @@
+"""paddle.utils.dlpack parity — zero-copy tensor exchange."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack-protocol object (implements
+    __dlpack__/__dlpack_device__; consumable by torch/np/jax
+    from_dlpack)."""
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def from_dlpack(obj):
+    """Import a DLPack-protocol object or a legacy capsule."""
+    if hasattr(obj, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(obj))
+    from jax import dlpack as _jdl  # legacy capsule path
+    return Tensor(_jdl.from_dlpack(obj))

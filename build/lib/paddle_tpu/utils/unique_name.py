@@ -1,0 +1,44 @@
+"""paddle.utils.unique_name parity — name generator used by Layer/param
+naming."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "guard", "switch"]
+
+_lock = threading.Lock()
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key):
+        with _lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

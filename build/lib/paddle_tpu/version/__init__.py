@@ -1,0 +1,27 @@
+"""paddle.version parity."""
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+istaged = False
+
+cuda_version = "False"   # no CUDA on this backend
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("backend: tpu (jax/XLA)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

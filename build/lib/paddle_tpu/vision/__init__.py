@@ -1,0 +1,14 @@
+"""paddle.vision parity namespace."""
+from . import models
+from . import transforms
+from . import datasets
+from .models import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, LeNet, VGG, vgg16, MobileNetV2, mobilenet_v2)
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

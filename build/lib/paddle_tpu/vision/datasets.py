@@ -1,0 +1,152 @@
+"""paddle.vision.datasets (parity: python/paddle/vision/datasets/).
+
+Offline sandbox: downloads are impossible, so dataset classes load from a
+local `data_file` when given one and otherwise raise with instructions;
+`FakeData` provides a synthetic ImageNet-shaped dataset for benchmarks
+(this is what bench.py/config #1 uses until real data is mounted).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic classification dataset (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx % 65536)
+        img = rng.rand(*self.image_shape).astype(self.dtype)
+        label = np.int64(idx % self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            raise RuntimeError(
+                "MNIST files not found; this sandbox has no network. Pass "
+                "image_path/label_path to local idx files, or use "
+                "paddle.vision.datasets.FakeData for synthetic data.")
+        self.images = self._load_images(image_path)
+        self.labels = self._load_labels(label_path)
+
+    @staticmethod
+    def _load_images(path):
+        import gzip
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            data = f.read()
+        n = int.from_bytes(data[4:8], "big")
+        return np.frombuffer(data, np.uint8, offset=16).reshape(n, 28, 28)
+
+    @staticmethod
+    def _load_labels(path):
+        import gzip
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            data = f.read()
+        return np.frombuffer(data, np.uint8, offset=8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "CIFAR archive not found; no network in this sandbox. Pass "
+                "data_file=<local cifar-10-python.tar.gz> or use FakeData.")
+        self.data, self.labels = self._load(data_file, mode)
+
+    @staticmethod
+    def _load(path, mode):
+        imgs, labels = [], []
+        with tarfile.open(path) as tf:
+            names = [n for n in tf.getnames()
+                     if ("data_batch" in n if mode == "train" else "test_batch" in n)]
+            for n in sorted(names):
+                d = pickle.load(tf.extractfile(n), encoding="bytes")
+                imgs.append(d[b"data"])
+                labels.extend(d.get(b"labels", d.get(b"fine_labels", [])))
+        data = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        return data, np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class DatasetFolder(Dataset):
+    """Image-folder dataset (parity: paddle.vision.datasets.DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        self.loader = loader or (lambda p: np.load(p))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.int64(target)
+
+
+ImageFolder = DatasetFolder

@@ -153,6 +153,13 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state_dict):
+        sync = getattr(self, "_deferred_sync", None)
+        if sync is not None:
+            # flush the compiled step's pending state first — otherwise
+            # the invalidation below would roll live training back to the
+            # last-synced snapshot (keys the loaded dict doesn't cover
+            # must keep their CURRENT values, not stale ones)
+            sync()
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
                                                        LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

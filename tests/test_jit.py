@@ -1,9 +1,13 @@
 """to_static / TrainStep bridge / static control flow / predictor tests
 (parity model: test/dygraph_to_static — eager vs to_static equality)."""
+import os
+
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(paddle.__file__))
 from paddle_tpu import nn
 import paddle_tpu.nn.functional as F
 
@@ -289,3 +293,74 @@ class TestCheckNanInfUnderTrace:
             assert np.isfinite(float(loss))
         finally:
             set_flags({"check_nan_inf": False})
+
+
+class TestAOTArtifact:
+    """jit.save with input_spec writes a serialized StableHLO artifact
+    (.pdexec, jax.export) that a FRESH process loads and runs without the
+    model class — the reference's AnalysisPredictor serialized-program
+    contract (analysis_predictor.cc)."""
+
+    def _save(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+        m.eval()
+        path = str(tmp_path / "m")
+        paddle.jit.save(m, path,
+                        input_spec=[InputSpec([None, 8], "float32")])
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        ref = np.asarray(m(paddle.to_tensor(x)).numpy())
+        return path, x, ref
+
+    def test_artifact_files_written(self, tmp_path):
+        import os
+        path, x, ref = self._save(tmp_path)
+        assert os.path.exists(path + ".pdexec")
+        assert os.path.exists(path + ".pdiparams")
+
+    def test_same_process_aot_load(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.jit.api import AOTLayer
+        path, x, ref = self._save(tmp_path)
+        loaded = paddle.jit.load(path)
+        assert isinstance(loaded, AOTLayer)
+        out = np.asarray(loaded(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # symbolic batch dim: a different batch size runs the SAME artifact
+        x2 = np.random.RandomState(1).randn(7, 8).astype(np.float32)
+        out2 = loaded(paddle.to_tensor(x2))
+        assert tuple(out2.shape) == (7, 4)
+
+    def test_fresh_process_load_without_class(self, tmp_path):
+        """The money test: subprocess with NO model code, loads + runs."""
+        import subprocess, sys, textwrap
+        path, x, ref = self._save(tmp_path)
+        np.save(str(tmp_path / "x.npy"), x)
+        np.save(str(tmp_path / "ref.npy"), ref)
+        script = textwrap.dedent(f"""
+            import os
+            os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import paddle_tpu as paddle
+            # no model class is defined or imported here
+            loaded = paddle.jit.load({str(path)!r})
+            x = np.load({str(tmp_path / 'x.npy')!r})
+            out = np.asarray(loaded(paddle.to_tensor(x)).numpy())
+            ref = np.load({str(tmp_path / 'ref.npy')!r})
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+            # and through the deployment Predictor API
+            from paddle_tpu.inference import Config, create_predictor
+            cfg = Config({str(path)!r} + ".pdmodel")
+            pred = create_predictor(cfg)
+            outs = pred.run([x])
+            np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+            print("AOT_FRESH_PROCESS_OK")
+        """)
+        r = subprocess.run([sys.executable, "-c", script],
+                           capture_output=True, text=True, timeout=300,
+                           env={**os.environ, "PYTHONPATH": _REPO_ROOT})
+        assert "AOT_FRESH_PROCESS_OK" in r.stdout, (r.stdout, r.stderr[-2000:])

@@ -1,0 +1,133 @@
+"""Two REAL processes + jax.distributed.initialize collective test.
+
+Reference parity: test/collective/test_collective_api_base.py — the
+reference validates collectives by spawning actual trainer processes with
+the launcher env; here two python processes form a jax coordination
+service over localhost, build one global 2-device mesh (1 CPU device per
+process), and run DP training whose loss curve must match the
+single-process run on identical data/init.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(paddle_tpu.__file__))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+_WORKER = textwrap.dedent("""
+    import os
+    # ONE local CPU device per process (2 global): strip the 8-device
+    # virtualization the parent test env uses
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sys
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+
+    out_path = sys.argv[1]
+
+    strat = fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 2, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strat)  # jax.distributed init
+    assert jax.device_count() == 2, jax.devices()
+    assert jax.process_count() == 2
+
+    paddle.seed(0)
+    model = fleet.distributed_model(
+        nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4)))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    lf = lambda o, t: ((o - t) ** 2).mean()
+    losses = [float(model.train_batch([x, y], optimizer=opt, loss_fn=lf))
+              for _ in range(5)]
+    if int(os.environ["PADDLE_TRAINER_ID"]) == 0:
+        np.save(out_path, np.asarray(losses))
+    print("WORKER_DONE", losses[-1])
+""")
+
+
+def test_two_process_dp_loss_parity(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    out = tmp_path / "losses.npy"
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": _REPO_ROOT,
+            "PADDLE_TRAINER_ID": str(rank),
+            "RANK": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "WORLD_SIZE": "2",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", str(script), str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            o, _ = p.communicate()
+        outs.append(o)
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {i} failed:\n{o[-3000:]}"
+        assert "WORKER_DONE" in o
+
+    two_proc = np.load(out)
+
+    # single-process reference, identical seed/data
+    single = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent("""
+            import os
+            os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+            import jax; jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import paddle_tpu as paddle
+            import paddle_tpu.nn as nn
+            from paddle_tpu.jit import TrainStep
+            paddle.seed(0)
+            m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=m.parameters())
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+            y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+            step = TrainStep(m, opt, lambda o, t: ((o - t) ** 2).mean())
+            print("REF", [float(step(x, y)) for _ in range(5)])
+        """)],
+        capture_output=True, text=True, timeout=240,
+        env={**{k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+             "PYTHONPATH": _REPO_ROOT})
+    assert single.returncode == 0, single.stderr[-2000:]
+    ref = eval(single.stdout.split("REF", 1)[1].strip())
+    np.testing.assert_allclose(two_proc, ref, rtol=1e-4, atol=1e-5)

@@ -432,3 +432,55 @@ class TestMultiPrecision:
         opt.step()
         assert "master_weight" not in opt._accumulators
         assert opt._accumulators["moment1"][id(p)].dtype == jnp.bfloat16
+
+
+class TestCompiledGradScaler:
+    def test_scaler_in_train_step_f16(self):
+        """Dynamic loss scaling compiled into TrainStep: an absurdly large
+        initial scale overflows f16 grads -> update skipped, scale decays
+        until steps succeed and the loss trains down."""
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.jit import TrainStep
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+        for p in m.parameters():
+            p._value = p._value.astype(jnp.float16)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+        scaler = GradScaler(init_loss_scaling=2.0 ** 32,
+                            decr_every_n_nan_or_inf=1,
+                            incr_every_n_steps=1000)
+        step = TrainStep(m, opt, lambda o, t: ((o - t) ** 2).mean(),
+                         scaler=scaler)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float16))
+        y = paddle.to_tensor(rng.randn(16, 4).astype(np.float16))
+        w0 = np.array(np.asarray(m[0].weight._value, np.float32))
+        losses = [float(step(x, y)) for _ in range(25)]
+        # scale decayed from the overflowing 2^32
+        assert scaler.get_loss_scaling() < 2.0 ** 32
+        assert all(np.isfinite(v) for v in losses), losses
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+        # params did eventually move (post-overflow steps applied)
+        w1 = np.asarray(m[0].weight._value, np.float32)
+        assert np.abs(w1 - w0).max() > 0
+
+    def test_scaler_disabled_passthrough(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.jit import TrainStep
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        step = TrainStep(m, opt, lambda o, t: ((o - t) ** 2).mean(),
+                         scaler=GradScaler(enable=False))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        assert np.isfinite(float(step(x, x)))

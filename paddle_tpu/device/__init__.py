@@ -66,6 +66,37 @@ class cuda:
         except Exception:
             return 0
 
+    @staticmethod
+    def max_memory_reserved(device=None):
+        """Peak bytes the allocator arena held (XLA: reservable limit is
+        the arena; peak_bytes_in_use is the closest observable)."""
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use",
+                             stats.get("bytes_limit", 0))
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_reserved(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_reserved", stats.get("bytes_in_use", 0))
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_stats(device=None):
+        """Raw per-device allocator stats dict (XLA memory_stats)."""
+        try:
+            return dict(jax.devices()[0].memory_stats() or {})
+        except Exception:
+            return {}
+
+
+# paddle.device.tpu mirrors the cuda shim (same queries, honest name)
+tpu = cuda
+
 
 def synchronize(device=None):
     cuda.synchronize(device)

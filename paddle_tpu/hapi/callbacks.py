@@ -165,3 +165,49 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         c.set_model(model)
         c.set_params(params)
     return cbs
+
+
+class VisualDL(Callback):
+    """paddle.callbacks.VisualDL parity: logs train/eval metrics as
+    TensorBoard event files (utils.tbwriter.LogWriter — VisualDL's
+    TB-import and TensorBoard both read them)."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self._log_dir = log_dir
+        self._writer = None
+        self._train_step = 0
+        self._epoch = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..utils.tbwriter import LogWriter
+            self._writer = LogWriter(logdir=self._log_dir)
+        return self._writer
+
+    def _log(self, prefix, logs, step):
+        for k, v in (logs or {}).items():
+            try:
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                for i, vv in enumerate(vals):
+                    tag = f"{prefix}/{k}" if len(vals) == 1 \
+                        else f"{prefix}/{k}_{i}"
+                    self._w().add_scalar(tag, float(vv), step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        self._log("batch", logs, self._train_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch
+        self._log("train", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self._epoch)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None  # a later fit() reopens a fresh file

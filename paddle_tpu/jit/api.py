@@ -65,7 +65,11 @@ class StaticFunction:
     """Wraps a python function/Layer method; compiles per input signature."""
 
     def __init__(self, fn, layer=None, input_spec=None, full_graph=True):
-        self._fn = fn
+        from .dy2static import maybe_ast_transform
+        # dy2static pass: rewrite python if/while over tensors into
+        # lax.cond/while_loop dispatchers so data-dependent control flow
+        # compiles instead of freezing at trace time
+        self._fn = maybe_ast_transform(fn)
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}

@@ -1,0 +1,517 @@
+"""dy2static: AST control-flow transforms for to_static.
+
+Reference parity: python/paddle/jit/dy2static/ (IfElseTransformer,
+LoopTransformer + convert_ifelse/convert_while_loop runtime dispatch).
+TPU-native: the rewritten constructs lower to lax.cond / lax.while_loop
+via paddle_tpu.static.nn, so data-dependent Python control flow compiles
+into the XLA program instead of being frozen at trace time.
+
+What is transformed:
+- `if <expr>:` / `elif` / `else` — rewritten to closures + __jst__.cond.
+  At runtime the ORIGINAL Python semantics apply when the predicate is a
+  concrete value; only traced (Tensor-under-jit) predicates use lax.cond.
+- `while <expr>:` — rewritten to cond/body closures + __jst__.while_loop
+  with the loop-carried variables (names written in the body that are
+  read before written, or read by the predicate) as explicit state.
+
+Deliberate limitations (transform skipped, original semantics kept):
+branches containing return/break/continue/yield; while-else; functions
+whose source is unavailable or that capture closure cells. Temps that a
+while body assigns before reading are locals of one iteration and are
+not visible after the loop (matching lax.while_loop's carried-state
+model).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import List, Set
+
+__all__ = ["convert_to_static_ast", "maybe_ast_transform", "_Helpers"]
+
+
+# ---------------------------------------------------------------- analysis
+
+class _AssignCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+        # attribute/subscript targets mutate objects, not local bindings
+
+    def visit_Assign(self, n):
+        for t in n.targets:
+            self._target(t)
+        self.generic_visit(n)
+
+    def visit_AugAssign(self, n):
+        self._target(n.target)
+        self.generic_visit(n)
+
+    def visit_AnnAssign(self, n):
+        self._target(n.target)
+        self.generic_visit(n)
+
+    def visit_For(self, n):
+        self._target(n.target)
+        self.generic_visit(n)
+
+    def visit_withitem(self, n):
+        if n.optional_vars is not None:
+            self._target(n.optional_vars)
+        self.generic_visit(n)
+
+    def visit_FunctionDef(self, n):
+        self.names.add(n.name)  # the def binds; don't recurse into scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, n):
+        pass
+
+
+def _assigned(stmts) -> Set[str]:
+    c = _AssignCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+def _loaded(node_or_stmts) -> Set[str]:
+    out: Set[str] = set()
+    nodes = node_or_stmts if isinstance(node_or_stmts, list) \
+        else [node_or_stmts]
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                out.add(sub.id)
+    return out
+
+
+class _Breaker(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, n):
+        self.found = True
+
+    def visit_Break(self, n):
+        self.found = True
+
+    def visit_Continue(self, n):
+        self.found = True
+
+    def visit_Yield(self, n):
+        self.found = True
+
+    def visit_YieldFrom(self, n):
+        self.found = True
+
+    def visit_FunctionDef(self, n):
+        pass  # nested scopes own their control flow
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, n):
+        pass
+
+
+def _has_breaker(stmts) -> bool:
+    b = _Breaker()
+    for s in stmts:
+        b.visit(s)
+    return b.found
+
+
+def _read_before_write(stmts) -> Set[str]:
+    """Assigned names whose first read in the block precedes (or shares a
+    statement with) their first write — the names a split-out closure must
+    receive as parameters instead of reading from its own (new) scope."""
+    assigned = _assigned(stmts)
+    seen_store: Set[str] = set()
+    out: Set[str] = set()
+    for stmt in stmts:
+        loads = _loaded(stmt)
+        for n in assigned:
+            if n in loads and n not in seen_store:
+                out.add(n)
+        seen_store |= _assigned([stmt])
+    return out
+
+
+def _loop_carried(body, test) -> List[str]:
+    """Names assigned in the loop body that are loop state: read by the
+    predicate, or read before their first assignment in an iteration."""
+    carried = (_assigned(body) & _loaded(test)) | _read_before_write(body)
+    return sorted(carried)
+
+
+# -------------------------------------------------------------- transform
+
+def _name(n, store=False):
+    return ast.Name(id=n, ctx=ast.Store() if store else ast.Load())
+
+
+def _tuple_of(names, store=False):
+    return ast.Tuple(elts=[_name(n, store) for n in names],
+                     ctx=ast.Store() if store else ast.Load())
+
+
+def _funcdef(name, argnames, body):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=a)
+                                                 for a in argnames],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[])
+
+
+def _jst_call(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("__jst__"), attr=attr,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+        self.changed = False
+
+    def _lambda(self, expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=expr)
+
+    def visit_BoolOp(self, node):
+        # a and b / a or b: python short-circuit calls bool() on traced
+        # tensors; route through __jst__ (parity: convert_logical_and/or)
+        self.generic_visit(node)
+        self.changed = True
+        attr = "and_" if isinstance(node.op, ast.And) else "or_"
+        return _jst_call(attr, [self._lambda(v) for v in node.values])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.changed = True
+            return _jst_call("not_", [node.operand])
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_breaker(node.body) or _has_breaker(node.orelse):
+            return node
+        t_assigned = sorted(_assigned(node.body))
+        f_assigned = sorted(_assigned(node.orelse))
+        assigned = sorted(set(t_assigned) | set(f_assigned))
+        self._n += 1
+        tname = f"__jst_true_{self._n}"
+        fname = f"__jst_false_{self._n}"
+        # names a branch reads before writing become parameters (a split
+        # closure re-scopes assignments, so bare closure reads would hit
+        # UnboundLocalError); the lambda defers evaluation so eagerly
+        # untaken branches never touch possibly-unbound names. Each
+        # branch returns ONLY the names it binds (grab on its locals());
+        # __jst__.cond merges with the if-site's prior bindings, so
+        # asymmetric branches and branch-local temps are handled like
+        # dy2static's UndefinedVar.
+        t_params = sorted(_read_before_write(node.body))
+        f_params = sorted(_read_before_write(node.orelse))
+
+        def _grab_ret(names):
+            return ast.Return(value=_jst_call(
+                "grab", [ast.Call(func=_name("locals"), args=[],
+                                  keywords=[]),
+                         ast.Tuple(elts=[ast.Constant(n) for n in names],
+                                   ctx=ast.Load())]))
+
+        t_def = _funcdef(tname, t_params,
+                         list(node.body) + [_grab_ret(t_assigned)])
+        f_def = _funcdef(fname, f_params,
+                         (list(node.orelse) or [ast.Pass()])
+                         + [_grab_ret(f_assigned)])
+        call = _jst_call("cond", [
+            node.test,
+            self._lambda(ast.Call(func=_name(tname),
+                                  args=[_name(p) for p in t_params],
+                                  keywords=[])),
+            self._lambda(ast.Call(func=_name(fname),
+                                  args=[_name(p) for p in f_params],
+                                  keywords=[])),
+            ast.Tuple(elts=[ast.Constant(n) for n in assigned],
+                      ctx=ast.Load()),
+            ast.Tuple(elts=[ast.Constant(n) for n in t_assigned],
+                      ctx=ast.Load()),
+            ast.Tuple(elts=[ast.Constant(n) for n in f_assigned],
+                      ctx=ast.Load()),
+            _jst_call("grab", [ast.Call(func=_name("locals"), args=[],
+                                        keywords=[]),
+                               ast.Tuple(elts=[ast.Constant(n)
+                                               for n in assigned],
+                                         ctx=ast.Load())])])
+        if assigned:
+            out = ast.Assign(targets=[_tuple_of(assigned, store=True)],
+                             value=call)
+        else:
+            out = ast.Expr(value=call)
+        self.changed = True
+        return [t_def, f_def, out]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_breaker(node.body):
+            return node
+        carry = _loop_carried(node.body, node.test)
+        if not carry:
+            return node
+        self._n += 1
+        cname = f"__jst_cond_{self._n}"
+        bname = f"__jst_body_{self._n}"
+        c_def = _funcdef(cname, carry, [ast.Return(value=node.test)])
+        b_def = _funcdef(bname, carry,
+                         list(node.body) + [ast.Return(
+                             value=_tuple_of(carry))])
+        call = _jst_call("while_loop",
+                         [_name(cname), _name(bname), _tuple_of(carry)])
+        out = ast.Assign(targets=[_tuple_of(carry, store=True)],
+                         value=call)
+        self.changed = True
+        return [c_def, b_def, out]
+
+
+# ---------------------------------------------------------------- runtime
+
+class Undefined:
+    """Sentinel bound to names a taken code path never assigned (parity:
+    dy2static's UndefinedVar). Any meaningful use raises."""
+
+    __slots__ = ("_name",)
+
+    def __init__(self, name):
+        object.__setattr__(self, "_name", name)
+
+    def _die(self, *a, **k):
+        raise NameError(
+            f"variable '{self._name}' was not assigned on the taken "
+            "branch of a transformed if/else (dy2static UndefinedVar)")
+
+    __bool__ = __call__ = __getattr__ = __getitem__ = _die
+    __add__ = __radd__ = __mul__ = __rmul__ = __sub__ = _die
+    __iter__ = __len__ = __float__ = __int__ = _die
+
+    def __repr__(self):
+        return f"<undefined '{self._name}'>"
+
+
+class _Helpers:
+    """Runtime dispatch injected as __jst__ (parity: dy2static's
+    convert_ifelse / convert_while_loop)."""
+
+    @staticmethod
+    def _is_traced(x):
+        from ..tensor import Tensor, _is_tracer
+        if isinstance(x, Tensor):
+            return _is_tracer(x._value)
+        import jax
+        return isinstance(x, jax.core.Tracer)
+
+    @staticmethod
+    def _coerce_outs(outs):
+        from ..tensor import Tensor
+        import jax.numpy as jnp
+        res = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                res.append(o)
+            else:
+                try:
+                    res.append(Tensor(jnp.asarray(o)))
+                except TypeError:
+                    raise TypeError(
+                        "dy2static: a traced branch/loop produced a "
+                        f"non-tensor value {o!r}; only Tensor/array "
+                        "state can cross lax.cond/while_loop")
+        return res
+
+    @staticmethod
+    def _truthy(v):
+        from ..tensor import Tensor
+        return bool(v.numpy()) if isinstance(v, Tensor) else bool(v)
+
+    @staticmethod
+    def and_(*thunks):
+        import jax.numpy as jnp
+        from ..tensor import Tensor
+        val = thunks[0]()
+        for th in thunks[1:]:
+            if _Helpers._is_traced(val):
+                nxt = th()
+                a = val._value if isinstance(val, Tensor) else val
+                b = nxt._value if isinstance(nxt, Tensor) else nxt
+                val = Tensor(jnp.logical_and(a, b))
+            else:
+                if not _Helpers._truthy(val):
+                    return val
+                val = th()
+        return val
+
+    @staticmethod
+    def or_(*thunks):
+        import jax.numpy as jnp
+        from ..tensor import Tensor
+        val = thunks[0]()
+        for th in thunks[1:]:
+            if _Helpers._is_traced(val):
+                nxt = th()
+                a = val._value if isinstance(val, Tensor) else val
+                b = nxt._value if isinstance(nxt, Tensor) else nxt
+                val = Tensor(jnp.logical_or(a, b))
+            else:
+                if _Helpers._truthy(val):
+                    return val
+                val = th()
+        return val
+
+    @staticmethod
+    def not_(v):
+        import jax.numpy as jnp
+        from ..tensor import Tensor
+        if _Helpers._is_traced(v):
+            return Tensor(jnp.logical_not(
+                v._value if isinstance(v, Tensor) else v))
+        return not _Helpers._truthy(v)
+
+    @staticmethod
+    def grab(loc, names):
+        """{name: value} for the names present in a locals() snapshot."""
+        return {n: loc[n] for n in names if n in loc}
+
+    @staticmethod
+    def cond(pred, true_fn, false_fn, names=(), t_assigned=(),
+             f_assigned=(), priors=None):
+        """Merge semantics (parity: convert_ifelse + UndefinedVar):
+        each branch fn returns a dict of the names IT binds; names a
+        branch doesn't bind fall back to the if-site's prior binding;
+        names with no value on some side come back as Undefined (bound
+        sentinels, like dy2static's UndefinedVar)."""
+        from ..tensor import Tensor
+        priors = priors or {}
+        if not _Helpers._is_traced(pred):
+            v = bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred)
+            got = true_fn() if v else false_fn()
+            return tuple(got.get(n, priors.get(n, Undefined(n)))
+                         for n in names)
+        # traced: lax.cond needs identical output structure from both
+        # branches — keep only names that BOTH sides can produce
+        out_names = [n for n in names
+                     if (n in t_assigned or n in priors)
+                     and (n in f_assigned or n in priors)]
+        from ..static.nn import cond as _cond
+
+        def wrap(fn):
+            def run():
+                got = fn()
+                vals = [got.get(n, priors.get(n)) for n in out_names]
+                return tuple(_Helpers._coerce_outs(vals))
+            return run
+
+        if out_names:
+            res = _cond(pred, wrap(true_fn), wrap(false_fn))
+            res = res if isinstance(res, tuple) else (res,)
+        else:
+            # no joinable state: nothing to select. (Pure-python side
+            # effects cannot cross lax.cond; assignments are the traced
+            # if's only observable effect.)
+            res = ()
+        by_name = dict(zip(out_names, res))
+        return tuple(by_name.get(n, Undefined(n)) for n in names)
+
+    @staticmethod
+    def while_loop(cond_fn, body_fn, init):
+        traced = any(_Helpers._is_traced(v) for v in init)
+        from ..tensor import Tensor
+        if not traced:
+            vals = tuple(init)
+            while True:
+                c = cond_fn(*vals)
+                cv = bool(c.numpy()) if isinstance(c, Tensor) else bool(c)
+                if not cv:
+                    return vals
+                out = body_fn(*vals)
+                vals = out if isinstance(out, tuple) else (out,)
+        from ..static.nn import while_loop as _while
+        init_t = tuple(_Helpers._coerce_outs(tuple(init)))
+
+        def body(*vs):
+            out = body_fn(*vs)
+            out = out if isinstance(out, tuple) else (out,)
+            return tuple(_Helpers._coerce_outs(out))
+
+        outs = _while(cond_fn, body, list(init_t))
+        return tuple(outs)
+
+
+# ------------------------------------------------------------------ entry
+
+def convert_to_static_ast(fn):
+    """Return fn with if/while rewritten (or fn itself when nothing to do
+    or the source cannot be transformed)."""
+    raw = getattr(fn, "__func__", fn)
+    # closures: re-exec can't rebuild cells, but a SNAPSHOT of the
+    # captured values as globals preserves semantics for the common case
+    # (captured modules/configs/tensors); bail only on unfilled cells
+    # (self-recursive defs) where a snapshot is impossible
+    closure_env = {}
+    for name, cell in zip(getattr(raw.__code__, "co_freevars", ()),
+                          raw.__closure__ or ()):
+        try:
+            closure_env[name] = cell.cell_contents
+        except ValueError:
+            return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    tr = _ControlFlowTransformer()
+    tr.visit(fdef)
+    if not tr.changed:
+        return fn
+    ast.fix_missing_locations(tree)
+    glb = dict(raw.__globals__)
+    glb.update(closure_env)
+    glb["__jst__"] = _Helpers
+    code = compile(tree, filename=getattr(raw, "__code__", None)
+                   and raw.__code__.co_filename or "<dy2static>",
+                   mode="exec")
+    ns = {}
+    exec(code, glb, ns)
+    new = ns[fdef.name]
+    new.__defaults__ = raw.__defaults__
+    new.__kwdefaults__ = raw.__kwdefaults__
+    functools.update_wrapper(new, raw)
+    if raw is not fn and hasattr(fn, "__self__"):
+        return new.__get__(fn.__self__)
+    return new
+
+
+def maybe_ast_transform(fn):
+    try:
+        return convert_to_static_ast(fn)
+    except Exception:
+        return fn

@@ -24,6 +24,7 @@ from __future__ import annotations
 import math as pymath
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -49,12 +50,92 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 # Ring attention core (runs INSIDE shard_map; local shards [B, Sl, H, D])
 # ---------------------------------------------------------------------------
 
+def _ring_attention_local_zigzag(q, k, v, *, axis_name, cp, scale):
+    """Causal ring attention over the zig-zag layout: local shard = global
+    chunks (idx, 2cp-1-idx). Each ring step processes the 2x2 sub-chunk
+    grid, and a sub-block runs only when its q chunk is causally at-or-
+    after its k chunk (lax.cond) — every rank executes the SAME expected
+    work per step (~half the sub-blocks), removing the last-rank
+    serialization of the contiguous layout. Reference role:
+    zig-zag/striped ring attention (llama-3 style load balancing)."""
+    b, sl, h, d = q.shape
+    half = sl // 2
+    idx = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    a_half = jnp.arange(half, dtype=jnp.int32)
+
+    def sub_update(qh, q_pos, m, l, acc, k_sub, v_sub, k_pos):
+        s = jnp.einsum("bqhd,bkhd->bhqk", qh, k_sub.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_sub.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+        return m_new, l_new, acc_new
+
+    def process_block(k_blk, v_blk, src, ms, ls, accs):
+        """ms/ls/accs: per-q-half state tuples."""
+        cq = (idx, 2 * cp - 1 - idx)
+        ck = (src, 2 * cp - 1 - src)
+        new_m, new_l, new_acc = list(ms), list(ls), list(accs)
+        for qi in range(2):
+            qh = qf[:, qi * half:(qi + 1) * half]
+            q_pos = cq[qi] * half + a_half
+            for ki in range(2):
+                k_sub = k_blk[:, ki * half:(ki + 1) * half]
+                v_sub = v_blk[:, ki * half:(ki + 1) * half]
+                k_pos = ck[ki] * half + a_half
+
+                def run(ops, qh=qh, q_pos=q_pos, k_sub=k_sub,
+                        v_sub=v_sub, k_pos=k_pos):
+                    return sub_update(qh, q_pos, ops[0], ops[1], ops[2],
+                                      k_sub, v_sub, k_pos)
+
+                new_m[qi], new_l[qi], new_acc[qi] = lax.cond(
+                    cq[qi] >= ck[ki], run,
+                    lambda ops: (ops[0], ops[1], ops[2]),
+                    (new_m[qi], new_l[qi], new_acc[qi]))
+        return tuple(new_m), tuple(new_l), tuple(new_acc)
+
+    m0 = tuple(jnp.full((b, h, half), _NEG_INF, jnp.float32)
+               for _ in range(2))
+    l0 = tuple(jnp.zeros((b, h, half), jnp.float32) for _ in range(2))
+    acc0 = tuple(jnp.zeros((b, half, h, d), jnp.float32) for _ in range(2))
+
+    ms, ls, accs = process_block(k, v, idx, m0, l0, acc0)
+
+    def step(carry, t):
+        k_blk, v_blk, ms, ls, accs = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (idx - t) % cp
+        ms, ls, accs = process_block(k_blk, v_blk, src, ms, ls, accs)
+        return (k_blk, v_blk, ms, ls, accs), None
+
+    if cp > 1:
+        (_, _, ms, ls, accs), _ = lax.scan(
+            step, (k, v, ms, ls, accs), jnp.arange(1, cp))
+    outs = []
+    for qi in range(2):
+        safe_l = jnp.where(ls[qi] == 0.0, 1.0, ls[qi])
+        outs.append(accs[qi] / safe_l.transpose(0, 2, 1)[..., None])
+    return jnp.concatenate(outs, axis=1).astype(q.dtype)
+
+
 def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
     """Blockwise online-softmax attention with the K/V shard rotating
-    around the `axis_name` ring. All accumulation in f32. The local block
-    is consumed before the scan so only cp-1 ppermutes are issued (a
-    permute whose result is never read still costs ICI traffic — XLA
-    cannot DCE a collective out of a shared scan body)."""
+    around the `axis_name` ring (contiguous sequence layout; the causal
+    zig-zag layout has its own kernel above). All accumulation in f32.
+    The local block is consumed before the scan so only cp-1 ppermutes
+    are issued (a permute whose result is never read still costs ICI
+    traffic — XLA cannot DCE a collective out of a shared scan body)."""
     b, sl, h, d = q.shape
     idx = lax.axis_index(axis_name)
     qf = q.astype(jnp.float32)
@@ -64,7 +145,7 @@ def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
     acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
-    q_pos = idx * sl + lax.broadcasted_iota(jnp.int32, (sl, k.shape[1]), 0)
+    q_pos = idx * sl + jnp.arange(sl, dtype=jnp.int32)
 
     def accumulate(k_blk, v_blk, m, l, acc, src):
         """One online-softmax update against the block originating at
@@ -72,9 +153,9 @@ def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
                        preferred_element_type=jnp.float32) * scale
         if causal:
-            k_pos = src * k.shape[1] + lax.broadcasted_iota(
-                jnp.int32, (sl, k.shape[1]), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            k_pos = src * k.shape[1] + jnp.arange(k.shape[1],
+                                                  dtype=jnp.int32)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new[..., None])
@@ -96,10 +177,10 @@ def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         src = (idx - t) % cp
         if causal:
-            # skip blocks that are entirely in the future (src > idx):
-            # a real HLO conditional, so early ranks save the FLOPs.
-            # (Wall-clock is still bounded by the last rank; zig-zag
-            # sequence sharding to balance the ring is a planned upgrade.)
+            # contiguous layout: skip blocks entirely in the future
+            # (src > idx) — a real HLO conditional, so early ranks save
+            # the FLOPs; wall-clock is still bounded by the last rank
+            # (the zig-zag kernel removes that bound).
             m, l, acc = lax.cond(
                 src <= idx,
                 lambda ops: accumulate(*ops, src),
@@ -118,10 +199,14 @@ def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
 
 
 def ring_attention_jax(query, key, value, *, causal=False, scale=None,
-                       axis_name="context", mesh=None):
+                       axis_name="context", mesh=None, zigzag=None):
     """Pure-jax ring attention. [B, S, H, D] GLOBAL arrays; the sequence
     dim is sharded over `axis_name` by the shard_map. Falls back to plain
-    flash attention when the axis is trivial."""
+    flash attention when the axis is trivial.
+
+    zigzag (default AUTO for causal): re-orders the sequence into the
+    zig-zag chunk layout before the ring so causal work is balanced
+    across ranks (outputs are inverse-permuted — semantics unchanged)."""
     mesh = mesh or get_mesh()
     cp = axis_size(axis_name, mesh)
     d = query.shape[-1]
@@ -131,6 +216,31 @@ def ring_attention_jax(query, key, value, *, causal=False, scale=None,
         return flash_attention_jax(query, key, value, causal=causal, scale=sc)
 
     spec = P(None, axis_name, None, None)
+    S = query.shape[1]
+    if zigzag is None:
+        zigzag = causal and S % (2 * cp) == 0
+    zigzag = bool(zigzag) and causal and S % (2 * cp) == 0
+
+    if zigzag:
+        chunk = S // (2 * cp)
+        order = np.empty(2 * cp, np.int64)
+        order[0::2] = np.arange(cp)
+        order[1::2] = 2 * cp - 1 - np.arange(cp)
+        inv = np.argsort(order)
+
+        def permute(x, o):
+            b, s = x.shape[0], x.shape[1]
+            return x.reshape((b, 2 * cp, chunk) + x.shape[2:])[:, o] \
+                    .reshape((b, s) + x.shape[2:])
+
+        qz, kz, vz = (permute(x, order) for x in (query, key, value))
+
+        def local(q, k, v):
+            return _ring_attention_local_zigzag(
+                q, k, v, axis_name=axis_name, cp=cp, scale=sc)
+
+        out = _shard_map(local, mesh, (spec, spec, spec), spec)(qz, kz, vz)
+        return permute(out, inv)
 
     def local(q, k, v):
         return _ring_attention_local(q, k, v, axis_name=axis_name, cp=cp,

@@ -115,6 +115,14 @@ class Tensor:
             raise ValueError(
                 "The truth value of a Tensor with more than one element is "
                 "ambiguous; use .any() or .all()")
+        if _is_tracer(self._value):
+            raise TypeError(
+                "bool() on a traced Tensor: python control flow over "
+                "tensor values inside to_static/jit requires the "
+                "dy2static transform, which needs the function's source "
+                "(unavailable for REPL/exec-defined functions). Define "
+                "the function in a file, or use paddle.static.nn.cond / "
+                "while_loop explicitly.")
         return bool(self.item())
 
     def __len__(self):

@@ -114,3 +114,23 @@ def test_ring_attention_under_jit():
         out = f(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_zigzag_vs_contiguous():
+    """Causal ring attention: the zig-zag balanced layout and the
+    contiguous layout must agree with each other and the full reference."""
+    import numpy as np
+    from paddle_tpu.kernels.attention import _xla_attention
+    mesh = build_mesh(dp=-1, cp=4)
+    rng = np.random.RandomState(17)
+    q = jnp.asarray(rng.randn(2, 64, 2, 16).astype(np.float32))
+    with mesh_scope(mesh):
+        out_zz = ring_attention_jax(q, q, q, causal=True, mesh=mesh,
+                                    zigzag=True)
+        out_ct = ring_attention_jax(q, q, q, causal=True, mesh=mesh,
+                                    zigzag=False)
+    ref = _xla_attention(q, q, q, 1.0 / np.sqrt(16), True)
+    np.testing.assert_allclose(np.asarray(out_zz), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_ct), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

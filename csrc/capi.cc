@@ -1,0 +1,256 @@
+// paddle_tpu C inference API.
+//
+// Reference parity (capability, not code): paddle/fluid/inference/capi_exp/
+// (pd_inference_api.h — PD_PredictorCreate / GetInputHandle / Run /
+// GetOutputHandle consumed from C/Go/Java). TPU-native design: the saved
+// model is the jax.export StableHLO artifact written by paddle_tpu.jit.save;
+// this library embeds CPython (the runtime that owns the XLA client) and
+// drives paddle_tpu.jit.load + AOTLayer through the stable C ABI below, so
+// C, Go (cgo), and Java (JNI/JNA) callers can serve a model with no Python
+// code of their own.
+//
+// Built separately from the core runtime lib because it links libpython:
+//   make -C csrc capi   (output: ../paddle_tpu/_native/libpaddle_tpu_capi.so)
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define PD_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+std::string g_err;
+std::mutex g_mu;
+
+void set_err(const std::string& m) { g_err = m; }
+
+struct PdTensor {
+  std::vector<int64_t> shape;
+  std::string dtype;            // "float32" | "int32" | ...
+  std::vector<uint8_t> data;    // packed host buffer
+};
+
+struct PdPredictor {
+  PyObject* layer = nullptr;    // paddle_tpu AOTLayer / TranslatedLayer
+  PyObject* np = nullptr;       // numpy module
+  std::vector<PdTensor> inputs;
+  std::vector<PdTensor> outputs;
+};
+
+// Fetch python error into g_err and clear it.
+void capture_py_error(const char* where) {
+  PyObject *t, *v, *tb;
+  PyErr_Fetch(&t, &v, &tb);
+  PyObject* s = v ? PyObject_Str(v) : nullptr;
+  std::string msg = std::string(where) + ": ";
+  if (s && PyUnicode_Check(s)) msg += PyUnicode_AsUTF8(s);
+  set_err(msg);
+  Py_XDECREF(s);
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+}
+
+int dtype_itemsize(const std::string& d) {
+  if (d == "float64" || d == "int64") return 8;
+  if (d == "float32" || d == "int32") return 4;
+  if (d == "float16" || d == "bfloat16" || d == "int16") return 2;
+  if (d == "int8" || d == "uint8" || d == "bool") return 1;
+  return 4;
+}
+
+bool ensure_python() {
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  return Py_IsInitialized();
+}
+
+}  // namespace
+
+PD_EXPORT const char* PD_GetLastError() { return g_err.c_str(); }
+
+PD_EXPORT const char* PD_GetVersion() { return "paddle-tpu-capi-0.3.0"; }
+
+// Create a predictor from a jit.save'd model path (the prefix passed to
+// paddle_tpu.jit.save — files <path>.pdexec/.pdmodel/.pdiparams).
+PD_EXPORT void* PD_PredictorCreate(const char* model_path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!ensure_python()) {
+    set_err("PD_PredictorCreate: python runtime failed to initialize");
+    return nullptr;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PdPredictor* p = new PdPredictor();
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.jit.api");
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* layer = nullptr;
+  if (mod && np) {
+    PyObject* load = PyObject_GetAttrString(mod, "load");
+    if (load) {
+      layer = PyObject_CallFunction(load, "s", model_path);
+      Py_DECREF(load);
+    }
+  }
+  if (!layer) {
+    capture_py_error("PD_PredictorCreate");
+    Py_XDECREF(mod);
+    Py_XDECREF(np);
+    delete p;
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  p->layer = layer;
+  p->np = np;
+  Py_XDECREF(mod);
+  PyGILState_Release(gil);
+  return p;
+}
+
+PD_EXPORT void PD_PredictorDestroy(void* h) {
+  if (!h) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  if (Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_XDECREF(p->layer);
+    Py_XDECREF(p->np);
+    PyGILState_Release(gil);
+  }
+  delete p;
+}
+
+// Declare the number of inputs for the next Run.
+PD_EXPORT void PD_PredictorSetInputNum(void* h, int n) {
+  static_cast<PdPredictor*>(h)->inputs.assign(n, PdTensor());
+}
+
+// Copy one input: index, dtype string, shape (ndim int64s), raw host data.
+PD_EXPORT int PD_PredictorSetInput(void* h, int index, const char* dtype,
+                                   const int64_t* shape, int ndim,
+                                   const void* data) {
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  if (index < 0 || index >= static_cast<int>(p->inputs.size())) {
+    set_err("PD_PredictorSetInput: index out of range");
+    return -1;
+  }
+  PdTensor& t = p->inputs[index];
+  t.dtype = dtype;
+  t.shape.assign(shape, shape + ndim);
+  int64_t count = 1;
+  for (int i = 0; i < ndim; ++i) count *= shape[i];
+  size_t bytes = static_cast<size_t>(count) * dtype_itemsize(t.dtype);
+  t.data.resize(bytes);
+  std::memcpy(t.data.data(), data, bytes);
+  return 0;
+}
+
+PD_EXPORT int PD_PredictorRun(void* h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* args = PyTuple_New(p->inputs.size());
+  bool ok = args != nullptr;
+  for (size_t i = 0; ok && i < p->inputs.size(); ++i) {
+    PdTensor& t = p->inputs[i];
+    // np.frombuffer(bytes, dtype).reshape(shape) — one host copy
+    PyObject* by = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(t.data.data()), t.data.size());
+    PyObject* arr = by ? PyObject_CallMethod(
+        p->np, "frombuffer", "Os", by, t.dtype.c_str()) : nullptr;
+    PyObject* shp = PyTuple_New(t.shape.size());
+    for (size_t j = 0; shp && j < t.shape.size(); ++j)
+      PyTuple_SET_ITEM(shp, j, PyLong_FromLongLong(t.shape[j]));
+    PyObject* rs = (arr && shp)
+        ? PyObject_CallMethod(arr, "reshape", "O", shp) : nullptr;
+    Py_XDECREF(by);
+    Py_XDECREF(arr);
+    Py_XDECREF(shp);
+    if (!rs) { ok = false; break; }
+    PyTuple_SET_ITEM(args, i, rs);  // steals
+  }
+  PyObject* out = ok ? PyObject_CallObject(p->layer, args) : nullptr;
+  Py_XDECREF(args);
+  if (out) {
+    PyObject* outs = PySequence_Check(out) && !PyObject_HasAttrString(
+        out, "numpy") ? PySequence_Tuple(out) : PyTuple_Pack(1, out);
+    p->outputs.clear();
+    rc = 0;
+    for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(outs); ++i) {
+      PyObject* o = PyTuple_GET_ITEM(outs, i);
+      PyObject* arr = PyObject_CallMethod(o, "numpy", nullptr);
+      PyObject* asc = arr ? PyObject_CallMethod(
+          p->np, "ascontiguousarray", "O", arr) : nullptr;
+      PyObject* dt = asc ? PyObject_GetAttrString(asc, "dtype") : nullptr;
+      PyObject* dts = dt ? PyObject_Str(dt) : nullptr;
+      PyObject* tb = asc ? PyObject_CallMethod(asc, "tobytes", nullptr)
+                         : nullptr;
+      PyObject* shp = asc ? PyObject_GetAttrString(asc, "shape") : nullptr;
+      if (dts && tb && shp) {
+        PdTensor t;
+        t.dtype = PyUnicode_AsUTF8(dts);
+        for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(shp); ++j)
+          t.shape.push_back(PyLong_AsLongLong(PyTuple_GET_ITEM(shp, j)));
+        char* buf;
+        Py_ssize_t n;
+        PyBytes_AsStringAndSize(tb, &buf, &n);
+        t.data.assign(buf, buf + n);
+        p->outputs.push_back(std::move(t));
+      } else {
+        rc = -1;
+      }
+      Py_XDECREF(dts);
+      Py_XDECREF(dt);
+      Py_XDECREF(tb);
+      Py_XDECREF(shp);
+      Py_XDECREF(asc);
+      Py_XDECREF(arr);
+    }
+    Py_XDECREF(outs);
+    Py_DECREF(out);
+  }
+  if (rc != 0) capture_py_error("PD_PredictorRun");
+  PyGILState_Release(gil);
+  return rc;
+}
+
+PD_EXPORT int PD_PredictorGetOutputNum(void* h) {
+  return static_cast<int>(static_cast<PdPredictor*>(h)->outputs.size());
+}
+
+PD_EXPORT int PD_PredictorGetOutputNdim(void* h, int i) {
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  if (i < 0 || i >= static_cast<int>(p->outputs.size())) return -1;
+  return static_cast<int>(p->outputs[i].shape.size());
+}
+
+PD_EXPORT int PD_PredictorGetOutputShape(void* h, int i, int64_t* shape) {
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  if (i < 0 || i >= static_cast<int>(p->outputs.size())) return -1;
+  for (size_t j = 0; j < p->outputs[i].shape.size(); ++j)
+    shape[j] = p->outputs[i].shape[j];
+  return 0;
+}
+
+PD_EXPORT const char* PD_PredictorGetOutputDtype(void* h, int i) {
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  if (i < 0 || i >= static_cast<int>(p->outputs.size())) return "";
+  return p->outputs[i].dtype.c_str();
+}
+
+PD_EXPORT int64_t PD_PredictorGetOutputBytes(void* h, int i) {
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  if (i < 0 || i >= static_cast<int>(p->outputs.size())) return -1;
+  return static_cast<int64_t>(p->outputs[i].data.size());
+}
+
+PD_EXPORT int PD_PredictorCopyOutput(void* h, int i, void* dst) {
+  PdPredictor* p = static_cast<PdPredictor*>(h);
+  if (i < 0 || i >= static_cast<int>(p->outputs.size())) return -1;
+  std::memcpy(dst, p->outputs[i].data.data(), p->outputs[i].data.size());
+  return 0;
+}

@@ -1,0 +1,48 @@
+/* paddle_tpu C inference API (reference parity:
+ * paddle/fluid/inference/capi_exp/pd_inference_api.h).
+ *
+ * Serve a paddle_tpu.jit.save'd model (the StableHLO AOT artifact) from
+ * C / Go (cgo) / Java (JNA) with no Python code of your own. The library
+ * embeds the CPython runtime that owns the XLA client.
+ *
+ * Typical flow:
+ *   void* p = PD_PredictorCreate("/models/m");        // m.pdexec etc.
+ *   PD_PredictorSetInputNum(p, 1);
+ *   PD_PredictorSetInput(p, 0, "float32", shape, 2, data);
+ *   PD_PredictorRun(p);
+ *   int64_t n = PD_PredictorGetOutputBytes(p, 0);
+ *   PD_PredictorCopyOutput(p, 0, buf);
+ *   PD_PredictorDestroy(p);
+ */
+#ifndef PADDLE_TPU_PD_INFERENCE_C_API_H_
+#define PADDLE_TPU_PD_INFERENCE_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char* PD_GetVersion(void);
+const char* PD_GetLastError(void);
+
+void* PD_PredictorCreate(const char* model_path);
+void PD_PredictorDestroy(void* predictor);
+
+void PD_PredictorSetInputNum(void* predictor, int n);
+int PD_PredictorSetInput(void* predictor, int index, const char* dtype,
+                         const int64_t* shape, int ndim, const void* data);
+int PD_PredictorRun(void* predictor);
+
+int PD_PredictorGetOutputNum(void* predictor);
+int PD_PredictorGetOutputNdim(void* predictor, int i);
+int PD_PredictorGetOutputShape(void* predictor, int i, int64_t* shape);
+const char* PD_PredictorGetOutputDtype(void* predictor, int i);
+int64_t PD_PredictorGetOutputBytes(void* predictor, int i);
+int PD_PredictorCopyOutput(void* predictor, int i, void* dst);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* PADDLE_TPU_PD_INFERENCE_C_API_H_ */

@@ -30,6 +30,11 @@ class NativeUnavailable(RuntimeError):
     pass
 
 
+# sources of the separate capi library (make capi) — not inputs of the
+# core runtime .so, so they must not trigger its staleness/rebuild
+_CAPI_ONLY = ("capi.cc", "pd_inference_c_api.h")
+
+
 def _needs_build() -> bool:
     if not os.path.isdir(_CSRC):
         return not os.path.exists(_SO)  # prebuilt .so without sources is fine
@@ -37,7 +42,7 @@ def _needs_build() -> bool:
         return True
     so_m = os.path.getmtime(_SO)
     for f in os.listdir(_CSRC):
-        if f.endswith((".cc", ".h")):
+        if f.endswith((".cc", ".h")) and f not in _CAPI_ONLY:
             if os.path.getmtime(os.path.join(_CSRC, f)) > so_m:
                 return True
     return False
@@ -53,8 +58,10 @@ def _build():
         try:
             if not _needs_build():  # another process built it while we waited
                 return
+            # capi.cc links libpython and builds separately (make capi);
+            # the core runtime lib must stay python-free
             srcs = [os.path.join(_CSRC, f) for f in sorted(os.listdir(_CSRC))
-                    if f.endswith(".cc")]
+                    if f.endswith(".cc") and f not in _CAPI_ONLY]
             tmp = f"{_SO}.tmp.{os.getpid()}"
             cmd = ["g++", "-O2", "-std=c++17", "-fPIC",
                    "-fvisibility=hidden", "-Wall", "-pthread", "-shared",

@@ -58,6 +58,14 @@ class PyLayer(metaclass=PyLayerMeta):
 
     @classmethod
     def apply(cls, *args, **kwargs):
+        from ..tensor import _is_tracer
+        if any(isinstance(a, Tensor) and _is_tracer(a._value)
+               for a in list(args) + list(kwargs.values())):
+            # under an outer jax trace (TrainStep/functionalize) the
+            # eager GradNode would be ignored by the outer grad — route
+            # through jax.custom_vjp so the USER'S backward is honored
+            # inside the compiled step
+            return cls._apply_traced(args, kwargs)
         ctx = PyLayerContext()
         tensor_inputs = [a for a in args if isinstance(a, Tensor)]
         needs_grad = is_grad_enabled() and any(
@@ -107,6 +115,93 @@ class PyLayer(metaclass=PyLayerMeta):
             t._out_index = k
             node.register_output(k, t)
         return tuple(outs) if multi else outs[0]
+
+
+def _traced_apply_impl(cls, args, kwargs):
+    """jax.custom_vjp bridge for PyLayer under an outer trace: forward
+    re-runs the user's forward (saving residuals via the ctx), backward
+    calls the user's backward with Tensor cotangents for the
+    DIFFERENTIABLE tensor outputs (matching the eager tape's contract).
+    Tensor inputs in args AND kwargs participate; non-Tensor outputs and
+    ctx.mark_non_differentiable are preserved."""
+    import jax
+
+    kw_keys = sorted(kwargs)
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    kw_tensor = [k for k in kw_keys if isinstance(kwargs[k], Tensor)]
+    arrays = tuple([args[i]._value for i in tensor_idx]
+                   + [kwargs[k]._value for k in kw_tensor])
+    box = {}
+
+    def rebuild(arrs):
+        full = list(args)
+        kw = dict(kwargs)
+        it = iter(arrs)
+        for i in tensor_idx:
+            full[i] = Tensor(next(it), stop_gradient=args[i].stop_gradient)
+        for k in kw_tensor:
+            kw[k] = Tensor(next(it),
+                           stop_gradient=kwargs[k].stop_gradient)
+        return full, kw
+
+    def fwd_only(*arrs):
+        ctx = PyLayerContext()
+        a2, kw2 = rebuild(arrs)
+        with no_grad():
+            outs = cls.forward(ctx, *a2, **kw2)
+        multi = isinstance(outs, (tuple, list))
+        outs_l = list(outs) if multi else [outs]
+        non_diff = getattr(ctx, "_non_diff", set())
+        tpos = [i for i, o in enumerate(outs_l) if isinstance(o, Tensor)]
+        diff_pos = [i for i in tpos if id(outs_l[i]) not in non_diff]
+        box.update(multi=multi, tpos=tpos, diff_pos=diff_pos,
+                   statics=[None if isinstance(o, Tensor) else o
+                            for o in outs_l])
+        vals = tuple(outs_l[i]._value for i in tpos)
+        saved = tuple(t._value for t in ctx.saved_tensor)
+        return vals, saved
+
+    @jax.custom_vjp
+    def core(*arrs):
+        return fwd_only(*arrs)[0]
+
+    def core_fwd(*arrs):
+        vals, saved = fwd_only(*arrs)
+        return vals, (arrs, saved)
+
+    def core_bwd(res, cots):
+        arrs, saved = res
+        ctx = PyLayerContext()
+        ctx._saved = tuple(Tensor(s) for s in saved)
+        # the user's backward receives cotangents only for the
+        # differentiable tensor outputs, in output order (eager parity)
+        diff_in_t = [k for k, p in enumerate(box["tpos"])
+                     if p in box["diff_pos"]]
+        with no_grad():
+            grads = cls.backward(ctx, *[Tensor(cots[k]) for k in diff_in_t])
+        grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
+        out = []
+        gi = iter(grads)
+        for a in arrs:
+            g = next(gi, None)
+            if g is None:
+                out.append(jax.numpy.zeros_like(a))
+            else:
+                gv = g._value if isinstance(g, Tensor) else g
+                out.append(gv.astype(a.dtype))
+        return tuple(out)
+
+    core.defvjp(core_fwd, core_bwd)
+    vals = core(*arrays)
+    outs_l = list(box["statics"])
+    for p, v in zip(box["tpos"], vals):
+        t = Tensor(v)
+        t.stop_gradient = p not in box["diff_pos"]
+        outs_l[p] = t
+    return tuple(outs_l) if box["multi"] else outs_l[0]
+
+
+PyLayer._apply_traced = classmethod(_traced_apply_impl)
 
 
 # paddle >=2.3 exposes once_differentiable-style EagerPyLayer alias

@@ -14,8 +14,14 @@ def get_available_device():
     return [f"{'cpu' if d.platform == 'cpu' else 'tpu'}:{d.id}" for d in devs]
 
 
+_BUILTIN_PLATFORMS = ("cpu", "gpu", "cuda", "rocm", "tpu", "axon")
+
+
 def get_available_custom_device():
-    return []
+    """Devices from registered PJRT plugins (the TPU-native CustomDevice
+    mechanism — see register_custom_device)."""
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in _BUILTIN_PLATFORMS]
 
 
 def device_count():
@@ -28,7 +34,38 @@ def get_all_device_type():
 
 
 def get_all_custom_device_type():
-    return []
+    return sorted({d.platform for d in jax.devices()
+                   if d.platform not in _BUILTIN_PLATFORMS})
+
+
+def register_custom_device(device_type: str, library_path: str):
+    """Register a third-party accelerator plugin.
+
+    Reference parity: the CustomDevice plugin mechanism
+    (paddle/phi/backends/custom/custom_device.cc + CustomRuntime C ABI,
+    loaded from PADDLE_CUSTOM_DEVICE_ROOT). The TPU-native equivalent of
+    that C ABI is a PJRT plugin: a shared library implementing the PJRT
+    C API, which XLA loads and exposes as a jax backend. Must be called
+    BEFORE any computation initializes the backends.
+    """
+    try:
+        if jax._src.xla_bridge.backends_are_initialized():
+            raise RuntimeError(
+                "register_custom_device must be called before the first "
+                "jax computation (backends already initialized)")
+    except AttributeError:
+        pass
+    import os as _os
+    from jax._src import xla_bridge as _xb
+    try:
+        _xb.register_plugin(device_type, library_path=library_path)
+    except Exception:
+        # fall back to the env-var discovery protocol
+        cur = _os.environ.get("PJRT_NAMES_AND_LIBRARY_PATHS", "")
+        entry = f"{device_type}:{library_path}"
+        _os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"] = (
+            f"{cur},{entry}" if cur else entry)
+    return device_type
 
 
 class cuda:

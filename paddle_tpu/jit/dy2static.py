@@ -94,6 +94,10 @@ def _loaded(node_or_stmts) -> Set[str]:
         for sub in ast.walk(n):
             if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
                 out.add(sub.id)
+            elif isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.target, ast.Name):
+                # y += 1 READS y even though the target ctx is Store
+                out.add(sub.target.id)
     return out
 
 

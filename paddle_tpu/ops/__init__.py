@@ -23,8 +23,10 @@ from .logic import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 from . import creation, math, manipulation, logic, linalg, random, search
+from . import extras
 from .creation import _coerce
 
 # ---------------------------------------------------------------------------
@@ -119,7 +121,8 @@ Tensor.__hash__ = lambda s: id(s)
 # method attachment
 # ---------------------------------------------------------------------------
 
-_METHOD_SOURCES = [creation, math, manipulation, logic, linalg, search, random]
+_METHOD_SOURCES = [creation, math, manipulation, logic, linalg, search,
+                   random, extras]
 
 # names whose first parameter is NOT a tensor (skip for method patching)
 _SKIP = {
@@ -129,6 +132,8 @@ _SKIP = {
     "scatter_nd", "to_tensor", "broadcast_shape", "assign", "einsum",
     "add_n", "multi_dot", "broadcast_tensors", "multiplex", "log_normal",
     "searchsorted", "complex", "polar", "binomial",
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "block_diag",
 }
 
 _patched = set()

@@ -1,0 +1,349 @@
+"""Additional tensor ops (parity: the long tail of python/paddle/tensor/*
+— stacking/splitting, scatter variants, special functions, NCHW shuffles).
+
+Same design as ops/math.py: thin Paddle-signature wrappers over jax.numpy
+through the tape dispatch; XLA fuses and tiles them.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ._dispatch import apply
+from .creation import _coerce
+
+__all__ = [
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "unflatten",
+    "isin", "vander", "trapezoid", "cumulative_trapezoid",
+    "sinc", "signbit", "isposinf", "isneginf", "isreal",
+    "polygamma", "gammaln", "gammainc", "gammaincc", "multigammaln",
+    "frexp", "ldexp", "logaddexp2", "xlogy", "float_power",
+    "index_fill", "masked_scatter", "select_scatter", "slice_scatter",
+    "renorm", "block_diag", "pdist", "positive", "negative",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+]
+
+
+def _t(x):
+    return _coerce(x)
+
+
+# ------------------------------------------------------------- stacking ---
+
+def hstack(x, name=None):
+    return apply(lambda *vs: jnp.hstack(vs), *[_t(v) for v in x],
+                 _name="hstack")
+
+
+def vstack(x, name=None):
+    return apply(lambda *vs: jnp.vstack(vs), *[_t(v) for v in x],
+                 _name="vstack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *vs: jnp.dstack(vs), *[_t(v) for v in x],
+                 _name="dstack")
+
+
+def column_stack(x, name=None):
+    return apply(lambda *vs: jnp.column_stack(vs), *[_t(v) for v in x],
+                 _name="column_stack")
+
+
+row_stack = vstack
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    t = _t(x)
+    n = num_or_indices
+    if isinstance(n, int):
+        parts = np.array_split(np.arange(t.shape[axis]), n)
+        sizes = [len(p) for p in parts]
+        offs = np.cumsum([0] + sizes)[:-1]
+    else:
+        idx = [int(i) for i in n]
+        offs = [0] + idx
+        sizes = [b - a for a, b in
+                 zip(offs, idx + [t.shape[axis]])]
+    outs = []
+    for off, size in zip(offs, sizes):
+        outs.append(apply(
+            lambda v, off=off, size=size: jax.lax.slice_in_dim(
+                v, off, off + size, axis=axis), t, _name="tensor_split"))
+    return outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    t = _t(x)
+    ax = 0 if t.ndim == 1 else 1
+    return tensor_split(t, num_or_indices, axis=ax)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unflatten(x, axis, shape, name=None):
+    t = _t(x)
+    shape = [int(s) for s in (shape.tolist() if isinstance(shape, Tensor)
+                              else shape)]
+    ax = axis % t.ndim
+    full = list(t.shape[:ax]) + shape + list(t.shape[ax + 1:])
+    if -1 in shape:
+        pass  # jnp.reshape resolves the -1
+    return apply(lambda v: v.reshape(full), t, _name="unflatten")
+
+
+# -------------------------------------------------------------- queries ---
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(lambda a, b: jnp.isin(a, b, invert=invert),
+                 _t(x), _t(test_x), _name="isin")
+
+
+def signbit(x, name=None):
+    return apply(jnp.signbit, _t(x), _name="signbit")
+
+
+def isposinf(x, name=None):
+    return apply(jnp.isposinf, _t(x), _name="isposinf")
+
+
+def isneginf(x, name=None):
+    return apply(jnp.isneginf, _t(x), _name="isneginf")
+
+
+def isreal(x, name=None):
+    return apply(jnp.isreal, _t(x), _name="isreal")
+
+
+# ---------------------------------------------------------------- math ----
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply(lambda v: jnp.vander(v, N=n, increasing=increasing),
+                 _t(x), _name="vander")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    args = [_t(y)]
+    if x is not None:
+        args.append(_t(x))
+
+        def fn(yv, xv):
+            return jax.scipy.integrate.trapezoid(yv, xv, axis=axis)
+    else:
+        d = 1.0 if dx is None else float(dx)
+
+        def fn(yv):
+            return jax.scipy.integrate.trapezoid(yv, dx=d, axis=axis)
+    return apply(fn, *args, _name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    d = 1.0 if dx is None else float(dx)
+
+    def _cumtrap(yv, xv=None):
+        y1 = jnp.moveaxis(yv, axis, -1)
+        if xv is not None:
+            x1 = jnp.moveaxis(jnp.broadcast_to(xv, yv.shape), axis, -1)
+            widths = jnp.diff(x1, axis=-1)
+        else:
+            widths = d
+        avg = (y1[..., 1:] + y1[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * widths, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    if x is not None:
+        return apply(lambda yv, xv: _cumtrap(yv, xv), _t(y), _t(x),
+                     _name="cumulative_trapezoid")
+    return apply(_cumtrap, _t(y), _name="cumulative_trapezoid")
+
+
+def sinc(x, name=None):
+    return apply(jnp.sinc, _t(x), _name="sinc")
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda v: jax.scipy.special.polygamma(int(n), v), _t(x),
+                 _name="polygamma")
+
+
+def gammaln(x, name=None):
+    return apply(jax.scipy.special.gammaln, _t(x), _name="gammaln")
+
+
+def gammainc(x, y, name=None):
+    return apply(jax.scipy.special.gammainc, _t(x), _t(y),
+                 _name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return apply(jax.scipy.special.gammaincc, _t(x), _t(y),
+                 _name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    return apply(lambda v: jax.scipy.special.multigammaln(v, int(p)),
+                 _t(x), _name="multigammaln")
+
+
+def frexp(x, name=None):
+    return apply(lambda v: jnp.frexp(v), _t(x), _name="frexp")
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+                 _t(x), _t(y), _name="ldexp")
+
+
+def logaddexp2(x, y, name=None):
+    return apply(jnp.logaddexp2, _t(x), _t(y), _name="logaddexp2")
+
+
+def xlogy(x, y, name=None):
+    return apply(jax.scipy.special.xlogy, _t(x), _t(y), _name="xlogy")
+
+
+def float_power(x, y, name=None):
+    return apply(lambda a, b: jnp.power(a.astype(jnp.float64)
+                                        if jax.config.jax_enable_x64
+                                        else a.astype(jnp.float32),
+                                        b), _t(x), _t(y),
+                 _name="float_power")
+
+
+def positive(x, name=None):
+    return apply(lambda v: +v, _t(x), _name="positive")
+
+
+def negative(x, name=None):
+    return apply(jnp.negative, _t(x), _name="negative")
+
+
+# ------------------------------------------------------------- scatters ---
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        filled = moved.at[idx].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(filled, 0, axis)
+    return apply(fn, _t(x), _t(index), _name="index_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    def fn(v, m, src):
+        mb = jnp.broadcast_to(m, v.shape)
+        # k-th True position takes src.flatten()[k] (paddle/torch order)
+        order = jnp.cumsum(mb.reshape(-1).astype(jnp.int32)) - 1
+        picked = src.reshape(-1)[jnp.clip(order, 0, src.size - 1)]
+        return jnp.where(mb, picked.reshape(v.shape), v)
+    return apply(fn, _t(x), _t(mask), _t(value), _name="masked_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(v, src):
+        moved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[index].set(src.astype(v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply(fn, _t(x), _t(values), _name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(v, src):
+        idx = [slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = slice(int(st), int(en), int(sd))
+        return v.at[tuple(idx)].set(src.astype(v.dtype))
+    return apply(fn, _t(x), _t(value), _name="slice_scatter")
+
+
+# --------------------------------------------------------------- linalg ---
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(v):
+        moved = jnp.moveaxis(v, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply(fn, _t(x), _name="renorm")
+
+
+def block_diag(inputs, name=None):
+    ts = [_t(v) for v in inputs]
+
+    def fn(*vs):
+        vs = [v.reshape(1, 1) if v.ndim == 0
+              else (v.reshape(1, -1) if v.ndim == 1 else v) for v in vs]
+        return jax.scipy.linalg.block_diag(*vs)
+    return apply(fn, *ts, _name="block_diag")
+
+
+def pdist(x, p=2.0, name=None):
+    def fn(v):
+        n = v.shape[0]
+        d = jnp.linalg.norm(v[:, None, :] - v[None, :, :], ord=p, axis=-1)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+    return apply(fn, _t(x), _name="pdist")
+
+
+# ----------------------------------------------------- vision reshuffles --
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            oc = c // (r * r)
+            v = v.reshape(b, oc, r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(b, oc, h * r, w * r)
+        b, h, w, c = v.shape
+        oc = c // (r * r)
+        v = v.reshape(b, h, w, r, r, oc)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(b, h * r, w * r, oc)
+    return apply(fn, _t(x), _name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            oh, ow = h // r, w // r
+            v = v.reshape(b, c, oh, r, ow, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(b, c * r * r, oh, ow)
+        b, h, w, c = v.shape
+        oh, ow = h // r, w // r
+        v = v.reshape(b, oh, r, ow, r, c)
+        v = v.transpose(0, 2, 4, 1, 3, 5)
+        return v.reshape(b, oh, ow, c * r * r)
+    return apply(fn, _t(x), _name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def fn(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, g, c // g, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(b, c, h, w)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h, w, g, c // g)
+        return v.transpose(0, 1, 2, 4, 3).reshape(b, h, w, c)
+    return apply(fn, _t(x), _name="channel_shuffle")

@@ -221,3 +221,182 @@ def test_setitem_grad():
     y[0] = 10.0
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1])
+
+
+class TestPyLayerUnderTrace:
+    def test_custom_backward_honored_in_train_step(self):
+        """PyLayer inside a compiled TrainStep: the USER'S backward must
+        drive the gradients (regression: the tape GradNode was silently
+        ignored under the outer trace, falling back to autodiff of the
+        forward)."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.autograd import PyLayer
+        from paddle_tpu.jit import TrainStep
+
+        class ScaleGrad(PyLayer):
+            """Identity forward; backward multiplies the gradient by 10 —
+            autodiff of the forward would give 1x, so the loss curve
+            proves which backward ran."""
+
+            @staticmethod
+            def forward(ctx, x):
+                return x
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 10.0
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return ScaleGrad.apply(self.fc(x))
+
+        def run(use_pylayer):
+            paddle.seed(0)
+            m = M()
+            opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=m.parameters())
+            if not use_pylayer:
+                m.forward = lambda x: m.fc(x)
+            step = TrainStep(m, opt, lambda o, t: ((o - t) ** 2).mean())
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+            losses = [float(step(x, y)) for _ in range(3)]
+            return losses
+
+        with_pl = run(True)
+        without = run(False)
+        # 10x gradient -> much faster initial descent
+        assert with_pl[1] < without[1], (with_pl, without)
+
+    def test_saved_tensors_under_trace(self):
+        import numpy as np
+        import jax
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import PyLayer
+        from paddle_tpu.jit import functionalize
+        import paddle_tpu.nn as nn
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor
+                return g * 2.0 * x
+
+        class M(nn.Layer):
+            def forward(self, x):
+                return Square.apply(x)
+
+        m = M()
+        pure_fn, p, b, _, _ = functionalize(m, training=False)
+
+        def loss(xv):
+            out, _, _ = pure_fn(p, b, jax.random.key(0), xv)
+            t = out[0] if isinstance(out, tuple) else out
+            return (t._value ** 2).sum()
+
+        import jax.numpy as jnp
+        xv = jnp.asarray(np.array([2.0, 3.0], np.float32))
+        g = jax.jit(jax.grad(loss))(xv)
+        # d/dx (x^2)^2 = 4x^3
+        np.testing.assert_allclose(np.asarray(g), [32.0, 108.0],
+                                   rtol=1e-5)
+
+
+class TestPyLayerTracedEdgeCases:
+    def test_kwarg_tensor_routes_custom_backward(self):
+        """Regression: Tensor passed as KEYWORD arg must still take the
+        custom_vjp path under a trace."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import PyLayer
+        from paddle_tpu.jit import functionalize
+        import paddle_tpu.nn as nn
+
+        class TenX(PyLayer):
+            @staticmethod
+            def forward(ctx, x=None):
+                return x * 1.0
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 10.0
+
+        class M(nn.Layer):
+            def forward(self, x):
+                return TenX.apply(x=x)
+
+        m = M()
+        pure_fn, p, b, _, _ = functionalize(m, training=False)
+
+        def loss(xv):
+            out, _, _ = pure_fn(p, b, jax.random.key(0), xv)
+            t = out[0] if isinstance(out, tuple) else out
+            return t._value.sum()
+
+        xv = jnp.asarray(np.ones(3, np.float32))
+        g = jax.jit(jax.grad(loss))(xv)
+        np.testing.assert_allclose(np.asarray(g), [10.0] * 3)
+
+    def test_non_tensor_output_and_mark_non_differentiable(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu.tensor import Tensor
+        from paddle_tpu.autograd import PyLayer
+        from paddle_tpu.jit import functionalize
+        import paddle_tpu.nn as nn
+
+        class Mixed(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                idx = Tensor((x._value > 0).astype("int32"))
+                ctx.mark_non_differentiable(idx)
+                return x * 2.0, idx, "tag"
+
+            @staticmethod
+            def backward(ctx, g):  # only the diff output's cotangent
+                return g * 2.0
+
+        class M(nn.Layer):
+            def forward(self, x):
+                return Mixed.apply(x)
+
+        m = M()
+        pure_fn, p, b, _, _ = functionalize(m, training=False)
+
+        def run(xv):
+            out, _, _ = pure_fn(p, b, jax.random.key(0), xv)
+            return out
+
+        flags = {}
+
+        def probe(xv):
+            out, _, _ = pure_fn(p, b, jax.random.key(0), xv)
+            y, idx, tag = out
+            flags.update(y=y.stop_gradient, idx=idx.stop_gradient, tag=tag)
+            return y._value
+
+        jax.jit(probe)(jnp.asarray(np.array([1.0, -1.0], np.float32)))
+        assert flags["tag"] == "tag"
+        assert flags["idx"] and not flags["y"], flags
+
+        def loss(xv):
+            out, _, _ = pure_fn(p, b, jax.random.key(0), xv)
+            return out[0]._value.sum()
+
+        g = jax.jit(jax.grad(loss))(jnp.asarray(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(g), [2.0, 2.0])

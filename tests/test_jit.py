@@ -510,3 +510,27 @@ class TestDy2StaticAsymmetry:
         np.testing.assert_allclose(
             np.asarray(f(paddle.to_tensor(np.array([-1.0], np.float32)))
                        .numpy()), [-1.0])
+
+
+class TestDy2StaticAugAssign:
+    def test_augassign_in_branches_and_loops(self):
+        """Regression: y += 1 READS y — the closure/carry analysis must
+        see AugAssign targets as loads."""
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def f(x):
+            y = x
+            if (x.sum() > 0):
+                y += 1
+            i = paddle.to_tensor(np.int32(0))
+            s = x * 0
+            while i < 3:
+                s += y
+                i += 1
+            return s
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(f(x).numpy()), [6.0])
+        xn = paddle.to_tensor(np.array([-1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(f(xn).numpy()), [-3.0])

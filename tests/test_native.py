@@ -236,3 +236,89 @@ class TestMultiprocessDataLoader:
                                 drop_last=False):
             vals.extend(batch.numpy().tolist())
         assert sorted(int(v) for v in vals) == list(range(20))
+
+
+class TestCInferenceAPI:
+    """C ABI predictor (capi_exp parity): a compiled C program serves the
+    jit.save'd AOT artifact through libpaddle_tpu_capi.so."""
+
+    def test_c_program_serves_model(self, tmp_path):
+        import shutil
+        import subprocess
+        import sys
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        root = os.path.dirname(os.path.dirname(paddle.__file__))
+        so = os.path.join(root, "paddle_tpu", "_native",
+                          "libpaddle_tpu_capi.so")
+        if not os.path.exists(so):
+            r = subprocess.run(["make", "-C", os.path.join(root, "csrc"),
+                                "capi"], capture_output=True, text=True)
+            if not os.path.exists(so):
+                pytest.skip(f"capi build unavailable: {r.stderr[-300:]}")
+        if shutil.which("gcc") is None:
+            pytest.skip("no C compiler")
+
+        # save a model + golden output
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        m.eval()
+        path = str(tmp_path / "m")
+        paddle.jit.save(m, path, input_spec=[InputSpec([None, 4],
+                                                       "float32")])
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        ref = np.asarray(m(paddle.to_tensor(x)).numpy())
+        x.tofile(str(tmp_path / "x.bin"))
+
+        c_src = r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "pd_inference_c_api.h"
+
+int main(int argc, char** argv) {
+    void* p = PD_PredictorCreate(argv[1]);
+    if (!p) { fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+              return 2; }
+    float x[8];
+    FILE* f = fopen(argv[2], "rb");
+    if (fread(x, sizeof(float), 8, f) != 8) return 3;
+    fclose(f);
+    int64_t shape[2] = {2, 4};
+    PD_PredictorSetInputNum(p, 1);
+    PD_PredictorSetInput(p, 0, "float32", shape, 2, x);
+    if (PD_PredictorRun(p) != 0) {
+        fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+        return 4;
+    }
+    int64_t nbytes = PD_PredictorGetOutputBytes(p, 0);
+    float* out = (float*)malloc(nbytes);
+    PD_PredictorCopyOutput(p, 0, out);
+    for (int i = 0; i < (int)(nbytes / sizeof(float)); ++i)
+        printf("%.6f\n", out[i]);
+    PD_PredictorDestroy(p);
+    return 0;
+}
+'''
+        (tmp_path / "driver.c").write_text(c_src)
+        exe = str(tmp_path / "driver")
+        comp = subprocess.run(
+            ["gcc", str(tmp_path / "driver.c"), "-o", exe,
+             "-I", os.path.join(root, "csrc"), so,
+             "-Wl,-rpath," + os.path.dirname(so)],
+            capture_output=True, text=True)
+        assert comp.returncode == 0, comp.stderr[-1500:]
+
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = root
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run([exe, path, str(tmp_path / "x.bin")],
+                           capture_output=True, text=True, timeout=240,
+                           env=env)
+        assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+        got = np.array([float(v) for v in r.stdout.split()],
+                       np.float32).reshape(2, 2)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

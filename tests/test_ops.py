@@ -338,3 +338,89 @@ class TestRandom:
         b = paddle.bernoulli(paddle.full([1000], 0.5))
         m = b.numpy().mean()
         assert 0.4 < m < 0.6
+
+
+class TestExtraOps:
+    """ops/extras.py: stacking/splitting, scatter variants, special
+    functions, NCHW shuffles (numpy goldens)."""
+
+    def test_stacks_and_splits(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(
+            np.asarray(paddle.hstack([x, x]).numpy()),
+            np.hstack([x.numpy(), x.numpy()]))
+        np.testing.assert_allclose(
+            np.asarray(paddle.vstack([x, x]).numpy()),
+            np.vstack([x.numpy(), x.numpy()]))
+        np.testing.assert_allclose(
+            np.asarray(paddle.column_stack([x, x]).numpy()),
+            np.column_stack([x.numpy(), x.numpy()]))
+        parts = paddle.tensor_split(x, 3, axis=1)
+        ref = np.array_split(np.asarray(x.numpy()), 3, axis=1)
+        for p, r in zip(parts, ref):
+            np.testing.assert_allclose(np.asarray(p.numpy()), r)
+        u = paddle.unflatten(x, 1, [2, 2])
+        assert tuple(u.shape) == (3, 2, 2)
+
+    def test_special_functions(self):
+        x = paddle.to_tensor(np.array([0.5, 1.5, 3.0], np.float32))
+        np.testing.assert_allclose(
+            np.asarray(paddle.sinc(x).numpy()),
+            np.sinc(np.asarray(x.numpy())), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.gammaln(x).numpy()),
+            [0.5723649, -0.1207822, 0.6931472], rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.xlogy(x, x).numpy()),
+            np.asarray(x.numpy()) * np.log(np.asarray(x.numpy())),
+            rtol=1e-5)
+        m, e = paddle.frexp(paddle.to_tensor(np.array([8.0], np.float32)))
+        assert float(m.numpy()) == 0.5 and int(e.numpy()) == 4
+
+    def test_scatter_variants(self):
+        x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        out = paddle.index_fill(x, paddle.to_tensor(np.array([0, 2])), 0,
+                                5.0)
+        ref = np.zeros((3, 4), np.float32); ref[[0, 2]] = 5.0
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref)
+
+        base = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        vals = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = paddle.select_scatter(base, vals, 0, 1)
+        ref = np.zeros((2, 3), np.float32); ref[1] = [1, 2, 3]
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref)
+
+        mask = paddle.to_tensor(np.array([[True, False, True],
+                                          [False, True, False]]))
+        src = paddle.to_tensor(np.array([9.0, 8.0, 7.0, 6.0], np.float32))
+        out = paddle.masked_scatter(base, mask, src)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   [[9, 0, 8], [0, 7, 0]])
+
+    def test_shuffles_roundtrip(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8, 4, 4).astype(np.float32))
+        up = paddle.pixel_shuffle(x, 2)
+        assert tuple(up.shape) == (2, 2, 8, 8)
+        back = paddle.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(np.asarray(back.numpy()),
+                                   np.asarray(x.numpy()))
+        cs = paddle.channel_shuffle(x, 4)
+        assert tuple(cs.shape) == tuple(x.shape)
+
+    def test_trapezoid_and_pdist(self):
+        y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(float(paddle.trapezoid(y).numpy()), 4.0)
+        ct = paddle.cumulative_trapezoid(y)
+        np.testing.assert_allclose(np.asarray(ct.numpy()), [1.5, 4.0])
+        pts = paddle.to_tensor(np.array([[0.0, 0], [3, 4], [0, 1]],
+                                        np.float32))
+        np.testing.assert_allclose(np.asarray(paddle.pdist(pts).numpy()),
+                                   [5.0, 1.0, np.sqrt(18.0)], rtol=1e-6)
+
+    def test_grad_flows_through_extras(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = paddle.hstack([x * 2, x * 3]).sum()
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [5.0, 5.0])

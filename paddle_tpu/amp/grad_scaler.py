@@ -29,6 +29,8 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        self._dev_state = None  # device-side (scale, good, bad) when a
+        #                         compiled step owns the state
 
     def is_enable(self):
         return self._enable
@@ -36,7 +38,18 @@ class GradScaler:
     def is_use_dynamic_loss_scaling(self):
         return self._dynamic
 
+    def _sync_from_device(self):
+        """Pull compiled-step scaler state to python lazily — per-step
+        float() would force a host sync and serialize async dispatch."""
+        if self._dev_state is not None:
+            s, g, b = self._dev_state
+            self._scale = float(s)
+            self._good_steps = int(g)
+            self._bad_steps = int(b)
+            self._dev_state = None
+
     def get_loss_scaling(self):
+        self._sync_from_device()
         return self._scale
 
     def scale(self, loss):
@@ -94,6 +107,7 @@ class GradScaler:
         self._found_inf = False
 
     def state_dict(self):
+        self._sync_from_device()
         return {
             "scale": self._scale,
             "incr_ratio": self._incr_ratio,
@@ -109,6 +123,60 @@ class GradScaler:
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("incr_count", 0)
         self._bad_steps = state.get("decr_count", 0)
+
+
+# ---------------------------------------------------------------------------
+# Compiled-step integration — the SINGLE implementation of dynamic loss
+# scaling inside a jitted train step, shared by jit.bridge.TrainStep and
+# fleet.dist_step.DistTrainStep (reference parity: the fused
+# update_loss_scaling op, phi/kernels/gpu/amp_kernel.cu).
+# ---------------------------------------------------------------------------
+
+def scaler_state_in(scaler):
+    """Device tuple (scale f32, good i32, bad i32) fed into the step."""
+    if scaler._dev_state is not None:
+        return scaler._dev_state
+    return (jnp.asarray(scaler._scale, jnp.float32),
+            jnp.asarray(scaler._good_steps, jnp.int32),
+            jnp.asarray(scaler._bad_steps, jnp.int32))
+
+
+def scaler_state_out(scaler, st):
+    """Store the step's output state WITHOUT a host sync (lazy)."""
+    scaler._dev_state = st
+
+
+def compiled_unscale(scale, grads):
+    """Unscale grads (f32 math) and compute the any-non-finite flag."""
+    import functools as _ft
+    inv = (1.0 / scale).astype(jnp.float32)
+    grads = [(g.astype(jnp.float32) * inv).astype(g.dtype) for g in grads]
+    found_inf = _ft.reduce(
+        jnp.logical_or, [jnp.any(~jnp.isfinite(g)) for g in grads])
+    return grads, found_inf
+
+
+def compiled_select_and_adapt(scaler, found_inf, new_p, old_p, new_state,
+                              old_state, scaler_st):
+    """Skip the whole update on overflow; adapt scale/counters on-device."""
+    import jax
+
+    def pick(new, old):
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(found_inf, b, a), new, old)
+
+    new_p = pick(new_p, old_p)
+    new_state = pick(new_state, old_state)
+    scale0, good0, bad0 = scaler_st
+    bad = jnp.where(found_inf, bad0 + 1, 0)
+    good = jnp.where(found_inf, 0, good0 + 1)
+    dec = bad >= scaler._decr_every
+    inc = good >= scaler._incr_every
+    new_scale = jnp.where(
+        dec, jnp.maximum(scale0 * scaler._decr_ratio, 1.0),
+        jnp.where(inc, scale0 * scaler._incr_ratio, scale0))
+    return new_p, new_state, (new_scale, jnp.where(inc, 0, good),
+                              jnp.where(dec, 0, bad))
 
 
 AmpScaler = GradScaler

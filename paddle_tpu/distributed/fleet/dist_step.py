@@ -77,11 +77,13 @@ class DistTrainStep:
     def __init__(self, model, optimizer, loss_fn: Callable,
                  n_model_inputs: int = 1, sharding_stage: Optional[int] = None,
                  mesh: Optional[Mesh] = None, batch_specs=None,
-                 donate_state: bool = True):
+                 donate_state: bool = True, scaler=None):
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn
         self._n_in = n_model_inputs
+        self._scaler = scaler if (scaler is not None
+                                  and scaler.is_enable()) else None
         self._mesh = mesh or ensure_mesh()
         stage = sharding_stage
         if stage is None:
@@ -156,48 +158,55 @@ class DistTrainStep:
         mesh_ = self._mesh
         repl = NamedSharding(mesh_, PartitionSpec())
 
-        def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch):
-            gen = default_generator()
+        scaler = self._scaler
+
+        def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch,
+                    scaler_st):
+            from ...jit.bridge import bound_state
             model_in = batch[:n_in]
             labels = batch[n_in:]
+            scale = scaler_st[0] if scaler is not None else None
 
             def loss_of(pv):
-                old_key = gen._key
-                olds = [t._value for t in p_tensors + b_tensors]
-                gen._key = rng_key
-                for t, v in zip(p_tensors, pv):
-                    t._value = v
-                for t, v in zip(b_tensors, b_vals):
-                    t._value = v
-                try:
+                with bound_state(p_tensors, pv, b_tensors, b_vals,
+                                 rng_key) as gen:
                     outs = model(*[Tensor(a) for a in model_in])
                     outs = outs if isinstance(outs, tuple) else (outs,)
                     loss = loss_fn(*outs, *[Tensor(a) for a in labels])
                     new_b = [t._value for t in b_tensors]
-                    return loss._value, (new_b, gen._key)
-                finally:
-                    for t, v in zip(p_tensors + b_tensors, olds):
-                        t._value = v
-                    gen._key = old_key
+                    lv = loss._value
+                    if scale is not None:
+                        lv = lv * scale.astype(lv.dtype)
+                    return lv, (loss._value, new_b, gen._key)
 
-            (loss_val, (new_b, new_key)), grads = jax.value_and_grad(
+            (_, (loss_val, new_b, new_key)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(p_vals))
+            if scaler is not None:
+                from ...amp.grad_scaler import (compiled_unscale,
+                                                compiled_select_and_adapt)
+                grads, found_inf = compiled_unscale(scale, grads)
             grads = _clip_grads_functional(grads, grad_clip)
             new_p, new_state = opt._fn_apply_all(
                 list(p_vals), grads, opt_state, lr, p_names, p_tensors)
-            return loss_val, new_p, new_b, new_state, new_key
+            if scaler is not None:
+                new_p, new_state, scaler_st = compiled_select_and_adapt(
+                    scaler, found_inf, new_p, list(p_vals), new_state,
+                    opt_state, scaler_st)
+            return loss_val, new_p, new_b, new_state, new_key, scaler_st
 
         donate = (0, 1, 2) if self._donate else ()
         jitted = jax.jit(
             step_fn,
             in_shardings=(self._p_sh, self._b_sh, self._s_sh, None, None,
-                          batch_sh),
-            out_shardings=(repl, self._p_sh, self._b_sh, self._s_sh, None),
+                          batch_sh, None),
+            out_shardings=(repl, self._p_sh, self._b_sh, self._s_sh, None,
+                           None),
             donate_argnums=donate)
 
-        def run(p_vals, b_vals, opt_state, key, lr, arrays):
+        def run(p_vals, b_vals, opt_state, key, lr, arrays, scaler_st):
             with mesh_scope(mesh_):
-                return jitted(p_vals, b_vals, opt_state, key, lr, arrays)
+                return jitted(p_vals, b_vals, opt_state, key, lr, arrays,
+                              scaler_st)
         return run
 
     @property
@@ -213,9 +222,14 @@ class DistTrainStep:
         gen = default_generator()
         key_in = gen.split()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        loss, new_p, new_b, new_state, _ = self._compiled[sig](
+        from ...amp.grad_scaler import scaler_state_in, scaler_state_out
+        sc = self._scaler
+        sc_in = scaler_state_in(sc) if sc is not None else ()
+        loss, new_p, new_b, new_state, _, sc_out = self._compiled[sig](
             [p._value for p in self._p], [b._value for b in self._b],
-            self._opt_state, key_in, lr, arrays)
+            self._opt_state, key_in, lr, arrays, sc_in)
+        if sc is not None:
+            scaler_state_out(sc, sc_out)
         for t, v in zip(self._p, new_p):
             t._value = v
         for t, v in zip(self._b, new_b):

@@ -62,7 +62,7 @@ class DistributedModel(Layer):
         return self._layers.named_parameters(*a, **kw)
 
     def build_train_step(self, optimizer, loss_fn, n_model_inputs=1,
-                         batch_specs=None):
+                         batch_specs=None, scaler=None):
         opt = optimizer._inner_opt if isinstance(optimizer,
                                                  DistributedOptimizer) else optimizer
         st = self._strategy
@@ -91,6 +91,10 @@ class DistributedModel(Layer):
                 raise NotImplementedError(
                     "batch_specs is not supported with pp_degree > 1; the "
                     "pipeline shards batch dim 0 over 'data' automatically")
+            if scaler is not None and scaler.is_enable():
+                raise NotImplementedError(
+                    "GradScaler with pp_degree > 1 is not wired yet; use "
+                    "bf16 (no scaler needed on TPU) for pipeline models")
             acc = int(st.pipeline_configs.get("accumulate_steps", 1) or 1)
             self._train_step = PipelineTrainStep(
                 self._layers, opt, loss_fn,
@@ -100,26 +104,27 @@ class DistributedModel(Layer):
             self._layers, opt, loss_fn, n_model_inputs=n_model_inputs,
             sharding_stage=stage,
             mesh=mesh,
-            batch_specs=batch_specs)
+            batch_specs=batch_specs, scaler=scaler)
         return self._train_step
 
     def train_batch(self, data, optimizer=None, lr_scheduler=None,
                     scaler=None, loss_fn=None):
         """Pipeline/hybrid one-step API (parity: PipelineParallel.
         train_batch). `data` = [inputs..., labels...]."""
-        if scaler is not None and scaler.is_enable():
-            raise NotImplementedError(
-                "GradScaler is not wired into the distributed train step "
-                "yet; use jit.TrainStep(model, opt, loss_fn, scaler=...) "
-                "for compiled dynamic loss scaling, or bf16 (no scaler "
-                "needed on TPU)")
         if self._train_step is None:
             if loss_fn is None or optimizer is None:
                 raise RuntimeError(
                     "first train_batch needs optimizer and loss_fn (or call "
                     "build_train_step)")
             self.build_train_step(optimizer, loss_fn,
-                                  n_model_inputs=max(len(data) - 1, 1))
+                                  n_model_inputs=max(len(data) - 1, 1),
+                                  scaler=scaler)
+        elif scaler is not None and scaler.is_enable() \
+                and getattr(self._train_step, "_scaler", None) is not scaler:
+            raise ValueError(
+                "the train step was already compiled without this "
+                "GradScaler; pass the scaler on the FIRST train_batch (or "
+                "to build_train_step)")
         loss = self._train_step(*data)
         if lr_scheduler is not None:
             lr_scheduler.step()

@@ -14,3 +14,4 @@ from .random import (
     default_generator, next_key,
 )
 from .flags import set_flags, get_flags, define_flag, flag_value
+from .selected_rows import SelectedRows

@@ -1,0 +1,85 @@
+"""SelectedRows (parity: paddle/fluid/framework/selected_rows.h and the
+python surface paddle.base.libpaddle.SelectedRows).
+
+The reference uses SelectedRows as the sparse-gradient container for
+embedding lookups (rows = touched ids, value = their gradient slices).
+TPU-native stance: XLA scatters dense gradients for embeddings (the MXU
+prefers dense math, and jit fuses the scatter), so the framework never
+PRODUCES SelectedRows — this class exists for API compatibility (code
+that constructs/merges them, e.g. custom optimizers ported from the
+reference) and converts losslessly to/from dense tensors.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    def __init__(self, rows=None, height: int = 0):
+        self._rows = [int(r) for r in (rows or [])]
+        self._height = int(height)
+        self._value: Tensor = Tensor(jnp.zeros((0,)))
+
+    # -- reference surface -------------------------------------------------
+    def rows(self):
+        return list(self._rows)
+
+    def set_rows(self, rows):
+        self._rows = [int(r) for r in rows]
+
+    def height(self):
+        return self._height
+
+    def set_height(self, h):
+        self._height = int(h)
+
+    def get_tensor(self) -> Tensor:
+        return self._value
+
+    def set_tensor(self, value):
+        self._value = value if isinstance(value, Tensor) else Tensor(
+            jnp.asarray(value))
+
+    def sync_index(self):  # reference no-op parity
+        pass
+
+    def has_rows(self) -> bool:
+        return bool(self._rows)
+
+    # -- conversions -------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        """Scatter-add the row slices into a dense [height, ...] tensor
+        (duplicate rows accumulate, matching the reference's merge_add)."""
+        val = self._value._value
+        shape = (self._height,) + tuple(val.shape[1:])
+        dense = jnp.zeros(shape, val.dtype)
+        if self._rows:
+            idx = jnp.asarray(np.asarray(self._rows, np.int32))
+            dense = dense.at[idx].add(val)
+        return Tensor(dense)
+
+    @staticmethod
+    def from_dense(tensor, rows=None) -> "SelectedRows":
+        """Build from a dense tensor, keeping only `rows` (default: rows
+        with any non-zero entry)."""
+        val = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(
+            tensor)
+        if rows is None:
+            flat = np.asarray(jnp.any(
+                val.reshape(val.shape[0], -1) != 0, axis=1))
+            rows = [int(i) for i in np.nonzero(flat)[0]]
+        sr = SelectedRows(rows=rows, height=val.shape[0])
+        idx = jnp.asarray(np.asarray(rows, np.int32)) if rows else \
+            jnp.zeros((0,), jnp.int32)
+        sr.set_tensor(Tensor(val[idx]))
+        return sr
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"rows={self._rows[:8]}{'...' if len(self._rows) > 8 else ''}, "
+                f"value_shape={list(self._value.shape)})")

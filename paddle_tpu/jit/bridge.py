@@ -144,19 +144,6 @@ class TrainStep:
         self._compiled = {}
         self._donate = donate_state
 
-    def _scaler_state_in(self):
-        s = self._scaler
-        return (jnp.asarray(s.get_loss_scaling(), jnp.float32),
-                jnp.asarray(s._good_steps, jnp.int32),
-                jnp.asarray(s._bad_steps, jnp.int32))
-
-    def _scaler_state_out(self, st):
-        s = self._scaler
-        scale, good, bad = st
-        s._scale = float(scale)
-        s._good_steps = int(good)
-        s._bad_steps = int(bad)
-
     def _build(self, sig):
         model = self._model
         loss_fn = self._loss_fn
@@ -189,32 +176,16 @@ class TrainStep:
             (_, (loss_val, new_b, new_key)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(list(p_vals))
             if scaler is not None:
-                inv = (1.0 / scale).astype(jnp.float32)
-                grads = [(g.astype(jnp.float32) * inv).astype(g.dtype)
-                         for g in grads]
-                found_inf = functools.reduce(
-                    jnp.logical_or,
-                    [jnp.any(~jnp.isfinite(g)) for g in grads])
+                from ..amp.grad_scaler import (compiled_unscale,
+                                               compiled_select_and_adapt)
+                grads, found_inf = compiled_unscale(scale, grads)
             grads = _clip_grads_functional(grads, grad_clip)
             new_p, new_state = opt._fn_apply_all(
                 list(p_vals), grads, opt_state, lr, p_names, p_tensors)
             if scaler is not None:
-                # skip the whole update when any grad overflowed
-                pick = lambda new, old: jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(found_inf, b, a), new, old)
-                new_p = pick(new_p, list(p_vals))
-                new_state = pick(new_state, opt_state)
-                scale0, good0, bad0 = scaler_st
-                bad = jnp.where(found_inf, bad0 + 1, 0)
-                good = jnp.where(found_inf, 0, good0 + 1)
-                dec = bad >= scaler._decr_every
-                inc = good >= scaler._incr_every
-                new_scale = jnp.where(
-                    dec, jnp.maximum(scale0 * scaler._decr_ratio, 1.0),
-                    jnp.where(inc, scale0 * scaler._incr_ratio, scale0))
-                scaler_st = (new_scale,
-                             jnp.where(inc, 0, good),
-                             jnp.where(dec, 0, bad))
+                new_p, new_state, scaler_st = compiled_select_and_adapt(
+                    scaler, found_inf, new_p, list(p_vals), new_state,
+                    opt_state, scaler_st)
             return (loss_val, new_p, new_b, new_state, new_key,
                     scaler_st)
 
@@ -230,8 +201,9 @@ class TrainStep:
         gen = default_generator()
         key_in = gen.split()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        sc_in = (self._scaler_state_in() if self._scaler is not None
-                 else ())
+        from ..amp.grad_scaler import scaler_state_in, scaler_state_out
+        sc_in = (scaler_state_in(self._scaler)
+                 if self._scaler is not None else ())
         (loss, new_p, new_b, new_state, new_key,
          sc_out) = self._compiled[sig](
             [p._value for p in self._p], [b._value for b in self._b],
@@ -242,7 +214,7 @@ class TrainStep:
             t._value = v
         self._opt_state = new_state
         if self._scaler is not None:
-            self._scaler_state_out(sc_out)
+            scaler_state_out(self._scaler, sc_out)
         # keep the eager accumulators in sync so optimizer.state_dict()
         # (checkpointing) observes the compiled step's state
         self._opt._fn_sync_to_accumulators(self._p, new_state)

@@ -119,3 +119,23 @@ def test_default_dtype():
         assert paddle.to_tensor(1.0).dtype == np.dtype(np.float64)
     finally:
         paddle.set_default_dtype("float32")
+
+
+class TestSelectedRows:
+    def test_roundtrip_and_merge_add(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.framework import SelectedRows
+
+        sr = SelectedRows(rows=[1, 3, 1], height=5)
+        sr.set_tensor(paddle.to_tensor(
+            np.array([[1.0, 1], [2, 2], [10, 10]], np.float32)))
+        dense = sr.to_dense()
+        # duplicate row 1 accumulates (merge_add parity)
+        np.testing.assert_allclose(np.asarray(dense.numpy()),
+                                   [[0, 0], [11, 11], [0, 0], [2, 2],
+                                    [0, 0]])
+        sr2 = SelectedRows.from_dense(dense)
+        assert sr2.rows() == [1, 3] and sr2.height() == 5
+        np.testing.assert_allclose(np.asarray(sr2.get_tensor().numpy()),
+                                   [[11, 11], [2, 2]])

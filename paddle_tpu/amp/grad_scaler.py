@@ -55,12 +55,14 @@ class GradScaler:
     def scale(self, loss):
         if not self._enable:
             return loss
+        self._sync_from_device()
         return loss * self._scale
 
     @no_grad()
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        self._sync_from_device()
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -88,6 +90,7 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
+        self._sync_from_device()
         self._unscaled = False
         if not (self._enable and self._dynamic):
             self._found_inf = False
@@ -120,6 +123,8 @@ class GradScaler:
         }
 
     def load_state_dict(self, state):
+        # the loaded checkpoint supersedes any compiled-step device state
+        self._dev_state = None
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("incr_count", 0)
         self._bad_steps = state.get("decr_count", 0)

@@ -176,7 +176,9 @@ class DistTrainStep:
                     new_b = [t._value for t in b_tensors]
                     lv = loss._value
                     if scale is not None:
-                        lv = lv * scale.astype(lv.dtype)
+                        # multiply in f32: casting the scale DOWN to an
+                        # f16 loss dtype overflows for scale > 65504
+                        lv = lv.astype(jnp.float32) * scale
                     return lv, (loss._value, new_b, gen._key)
 
             (_, (loss_val, new_b, new_key)), grads = jax.value_and_grad(

@@ -73,8 +73,9 @@ class SelectedRows:
             flat = np.asarray(jnp.any(
                 val.reshape(val.shape[0], -1) != 0, axis=1))
             rows = [int(i) for i in np.nonzero(flat)[0]]
+        rows = [int(r) for r in rows]  # accept arrays/tensors
         sr = SelectedRows(rows=rows, height=val.shape[0])
-        idx = jnp.asarray(np.asarray(rows, np.int32)) if rows else \
+        idx = jnp.asarray(np.asarray(rows, np.int32)) if len(rows) else \
             jnp.zeros((0,), jnp.int32)
         sr.set_tensor(Tensor(val[idx]))
         return sr

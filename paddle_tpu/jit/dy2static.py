@@ -272,11 +272,60 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.changed = True
         return [t_def, f_def, out]
 
+    def visit_For(self, node):
+        """`for i in range(...)` lowers to the while transform (parity:
+        dy2static's convert_for with range iterables) so a Tensor bound
+        compiles to lax.while_loop. Non-range iterables, else-clauses,
+        and loops containing break/continue/return keep python
+        semantics."""
+        self.generic_visit(node)
+        it = node.iter
+        if (node.orelse or _has_breaker(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(it, ast.Call)
+                or not isinstance(it.func, ast.Name)
+                or it.func.id != "range" or it.keywords
+                or not 1 <= len(it.args) <= 3):
+            return node
+        self._n += 1
+        ivar = f"__jst_it_{self._n}"
+        if len(it.args) == 1:
+            start, stop, step = ast.Constant(0), it.args[0], ast.Constant(1)
+        elif len(it.args) == 2:
+            start, stop = it.args
+            step = ast.Constant(1)
+        else:
+            start, stop, step = it.args
+        svar, pvar = f"__jst_stop_{self._n}", f"__jst_step_{self._n}"
+        tgt = node.target.id
+        init = [
+            ast.Assign(targets=[_name(ivar, store=True)], value=start),
+            ast.Assign(targets=[_name(svar, store=True)], value=stop),
+            ast.Assign(targets=[_name(pvar, store=True)], value=step),
+            # pre-bind the loop target so it can be loop-carried state
+            # (python leaves it unbound for empty ranges; we bind start)
+            ast.Assign(targets=[_name(tgt, store=True)],
+                       value=_name(ivar)),
+        ]
+        body = ([ast.Assign(targets=[_name(tgt, store=True)],
+                            value=_name(ivar))]
+                + list(node.body)
+                + [ast.AugAssign(target=ast.Name(id=ivar, ctx=ast.Store()),
+                                 op=ast.Add(), value=_name(pvar))])
+        test = _jst_call("range_cond",
+                         [_name(ivar), _name(svar), _name(pvar)])
+        while_node = ast.While(test=test, body=body, orelse=[])
+        while_node._jst_extra_carry = [tgt]
+        out = self.visit_While(while_node)
+        self.changed = True
+        return init + (out if isinstance(out, list) else [out])
+
     def visit_While(self, node):
         self.generic_visit(node)
         if node.orelse or _has_breaker(node.body):
             return node
-        carry = _loop_carried(node.body, node.test)
+        carry = sorted(set(_loop_carried(node.body, node.test))
+                       | set(getattr(node, "_jst_extra_carry", [])))
         if not carry:
             return node
         self._n += 1
@@ -395,6 +444,25 @@ class _Helpers:
             return Tensor(jnp.logical_not(
                 v._value if isinstance(v, Tensor) else v))
         return not _Helpers._truthy(v)
+
+    @staticmethod
+    def range_cond(i, stop, step):
+        """Direction-aware range continuation test (step may be a traced
+        value): step > 0 ? i < stop : i > stop. Concrete step == 0 raises
+        like python's range(); a TRACED zero step cannot be detected at
+        trace time (documented limitation)."""
+        from ..tensor import Tensor
+        if not _Helpers._is_traced(step):
+            sv = int(step.numpy()) if isinstance(step, Tensor) else int(step)
+            if sv == 0:
+                raise ValueError("range() arg 3 must not be zero")
+        vals = [i, stop, step]
+        if any(_Helpers._is_traced(v) for v in vals):
+            import jax.numpy as jnp
+            a = [v._value if isinstance(v, Tensor) else v for v in vals]
+            return Tensor(jnp.where(a[2] > 0, a[0] < a[1], a[0] > a[1]))
+        iv = [int(v.numpy()) if isinstance(v, Tensor) else v for v in vals]
+        return iv[0] < iv[1] if iv[2] > 0 else iv[0] > iv[1]
 
     @staticmethod
     def grab(loc, names):

@@ -44,6 +44,15 @@ def _zero_tail_rows(arr, blk_idx, block, limit):
     return jnp.where(ids < limit, arr, 0)
 
 
+def _lens_rows(kv_lens, bh):
+    """Per-row (B*H) kv lengths as a [BH, 128] i32 array (the 128 lane dim
+    satisfies TPU tiling; the kernel reads lane 0)."""
+    per_b = jnp.asarray(kv_lens, jnp.int32)
+    reps = bh // per_b.shape[0]
+    per_row = jnp.repeat(per_b, reps)
+    return jnp.broadcast_to(per_row[:, None], (bh, 128))
+
+
 def _gqa_kv_row(h, H, Hkv):
     """Map a flattened [B*H] query-head row index onto its [B*Hkv] kv row
     (GQA group folding). The fwd and bwd BlockSpec index maps MUST agree
@@ -66,8 +75,14 @@ def _pad_d_for_dtype(dtype, d):
 # Pallas forward kernel: works on [BH, S, D]
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, seq_k):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
+    if has_lens:
+        (q_ref, k_ref, v_ref, lens_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+        lens_ref = None
     j = pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -87,7 +102,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * np.float32(scale)
 
-        if causal or seq_k % block_k:
+        if causal or seq_k % block_k or has_lens:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
@@ -95,6 +110,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             keep = k_ids < seq_k  # kv tail: padded columns must not
             if causal:           # enter the softmax denominator
                 keep = jnp.logical_and(keep, q_ids >= k_ids)
+            if has_lens:
+                # varlen: this sequence's real kv length (padding tokens
+                # beyond it are finite garbage — mask them out)
+                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0])
             s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[:, 0]  # (bq,)
@@ -131,7 +150,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
-                      n_heads=None, n_kv_heads=None):
+                      n_heads=None, n_kv_heads=None, kv_lens=None):
     """q: [B*H, S, D]; k,v: [B*Hkv, S, D] → (out [B*H,S,D], lse [B*H,S]).
 
     Native GQA/MQA (reference: flash_attn_kernel.cu's num_heads_k <
@@ -148,7 +167,8 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
         pad = [(0, 0), (0, 0), (0, d_pad - d)]
         q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
         out, lse = _flash_fwd_pallas(q, k, v, scale, causal, block_q,
-                                     block_k, n_heads, n_kv_heads)
+                                     block_k, n_heads, n_kv_heads,
+                                     kv_lens=kv_lens)
         return out[..., :d], lse
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -161,18 +181,25 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
     def kv_index(h, i, j):
         return (_gqa_kv_row(h, H, Hkv), j, _Z)
 
+    has_lens = kv_lens is not None
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=sk)
+        block_k=block_k, seq_k=sk, has_lens=has_lens)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    args = [q, k, v]
+    if has_lens:
+        args.append(_lens_rows(kv_lens, bh))
+        in_specs.append(pl.BlockSpec((1, 128), lambda h, i, j: (h, _Z)))
 
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
             pl.BlockSpec((1, block_q, 128), lambda h, i, j: (h, i, _Z)),
@@ -187,7 +214,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
             pltpu.VMEM((block_q, d), jnp.float32),    # accumulator
         ],
         interpret=pallas_interpret(),
-    )(q, k, v)
+    )(*args)
     return out, lse[..., 0]
 
 
@@ -200,8 +227,13 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, dk_scr, dv_scr,
-                     *, scale, causal, block_q, block_k, seq_q, seq_k):
+                     *refs, scale, causal, block_q, block_k, seq_q, seq_k,
+                     has_lens=False):
+    if has_lens:
+        lens_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
+        lens_ref = None
     j = pl.program_id(1)   # kv block
     i = pl.program_id(2)   # q block (innermost: accumulation axis)
     ni = pl.num_programs(2)
@@ -226,7 +258,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32
                                 ) * np.float32(scale)
-        if causal or seq_q % block_q or seq_k % block_k:
+        if causal or seq_q % block_q or seq_k % block_k or has_lens:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
@@ -236,6 +268,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             keep = jnp.logical_and(q_ids < seq_q, k_ids < seq_k)
             if causal:
                 keep = jnp.logical_and(keep, q_ids >= k_ids)
+            if has_lens:
+                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0])
             s = jnp.where(keep, s, _NEG_INF)
             p = jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
         else:
@@ -273,8 +307,13 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, block_q, block_k,
-                   seq_q, seq_k):
+                   *refs, scale, causal, block_q, block_k,
+                   seq_q, seq_k, has_lens=False):
+    if has_lens:
+        lens_ref, dq_ref, dq_scr = refs
+    else:
+        dq_ref, dq_scr = refs
+        lens_ref = None
     i = pl.program_id(1)   # q block
     j = pl.program_id(2)   # kv block (innermost: accumulation axis)
     nj = pl.num_programs(2)
@@ -296,7 +335,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32
                                 ) * np.float32(scale)
         keep = None
-        if causal or seq_k % block_k:
+        if causal or seq_k % block_k or has_lens:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
@@ -307,6 +346,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             keep = k_ids < seq_k
             if causal:
                 keep = jnp.logical_and(keep, q_ids >= k_ids)
+            if has_lens:
+                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0])
             s = jnp.where(keep, s, _NEG_INF)
         p = (jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
              if keep is not None else jnp.exp(s - lse[:, None]))
@@ -335,7 +376,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
                       block_q=128, block_k=128, n_heads=None,
-                      n_kv_heads=None):
+                      n_kv_heads=None, kv_lens=None):
     """q,o,do: [B*H, S, D]; k,v: [B*Hkv, S, D]; lse: [B*H, S] (f32).
     Returns dq [B*H,...], dk/dv [B*H,...] (per query head — group-sum for
     GQA)."""
@@ -346,7 +387,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
         q, k, v, o, do = (jnp.pad(a, pad) for a in (q, k, v, o, do))
         dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
                                        block_q, block_k, n_heads,
-                                       n_kv_heads)
+                                       n_kv_heads, kv_lens=kv_lens)
         return dq[..., :d], dk[..., :d], dv[..., :d]
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -369,34 +410,45 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
     # GQA: dk/dv come out PER QUERY HEAD ([B*H, Sk, D]); the wrapper
     # group-sums them down to [B*Hkv, ...] — kv inputs are still never
     # repeated in HBM.
+    has_lens = kv_lens is not None
+    lens_args = []
+    if has_lens:
+        lens_args = [_lens_rows(kv_lens, bh)]
+
+    dkdv_in = [q_spec_i, k_in_j, k_in_j, q_spec_i, row_i, row_i]
+    if has_lens:
+        dkdv_in.append(pl.BlockSpec((1, 128), lambda h, a, b: (h, _Z)))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          seq_q=sq, seq_k=sk),
+                          seq_q=sq, seq_k=sk, has_lens=has_lens),
         grid=(bh, nk, nq),
-        in_specs=[q_spec_i, k_in_j, k_in_j, q_spec_i, row_i, row_i],
+        in_specs=dkdv_in,
         out_specs=[k_out_j, k_out_j],
         out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=pallas_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *lens_args)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, a, _Z))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda h, a, b: kv_in(h, a, b, b))
     row_q = pl.BlockSpec((1, block_q), lambda h, a, b: (h, a))
+    dq_in = [q_spec, kv_spec, kv_spec, q_spec, row_q, row_q]
+    if has_lens:
+        dq_in.append(pl.BlockSpec((1, 128), lambda h, a, b: (h, _Z)))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          seq_q=sq, seq_k=sk),
+                          seq_q=sq, seq_k=sk, has_lens=has_lens),
         grid=(bh, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_q, row_q],
+        in_specs=dq_in,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=pallas_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *lens_args)
     return dq, dk, dv
 
 
@@ -515,9 +567,76 @@ _flash_core.defvjp(lambda q, k, v, scale, causal: _flash_fwd(q, k, v, scale, cau
                    _flash_bwd)
 
 
+# varlen core: per-sequence kv lengths ([B] i32) masked IN-KERNEL
+# (reference parity: flash_attn varlen/cu_seqlens path for padded
+# batches). kv_lens is a traced array arg; its cotangent is float0.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_core_varlen(q, k, v, kv_lens, scale, causal):
+    return _flash_fwd_varlen(q, k, v, kv_lens, scale, causal)[0]
+
+
+def _flash_fwd_varlen(q, k, v, kv_lens, scale, causal):
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, k.shape[1], d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, v.shape[1], d)
+    out, lse = _flash_fwd_pallas(qt, kt, vt, scale, causal,
+                                 n_heads=h, n_kv_heads=hkv,
+                                 kv_lens=kv_lens)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v, kv_lens, out, lse.reshape(b, h, sq))
+
+
+def _flash_bwd_varlen(scale, causal, res, g):
+    q, k, v, kv_lens, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hkv = k.shape[2]
+    if (_use_pallas() and pallas_dtype_ok(q, k, v, g)
+            and sq >= 8 and d % 64 == 0):
+        def to3(x, s, nh):
+            return x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
+        dq3, dk3, dv3 = _flash_bwd_pallas(
+            to3(q, sq, h), to3(k, sk, hkv), to3(v, sk, hkv),
+            to3(out, sq, h), lse.reshape(b * h, sq),
+            to3(g.astype(q.dtype), sq, h), scale, causal,
+            n_heads=h, n_kv_heads=hkv, kv_lens=kv_lens)
+        dq = dq3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+        dk = dk3.reshape(b, hkv, h // hkv, sk, d).sum(2).transpose(0, 2, 1, 3)
+        dv = dv3.reshape(b, hkv, h // hkv, sk, d).sum(2).transpose(0, 2, 1, 3)
+    else:
+        lens_mask = (jnp.arange(sk)[None, None, None, :]
+                     < kv_lens[:, None, None, None])
+
+        def ref(q, k, v):
+            return _xla_attention(q, k, v, scale, causal, mask=lens_mask)
+
+        _, pull = jax.vjp(ref, q, k, v)
+        dq, dk, dv = pull(g.astype(q.dtype))
+    z = np.zeros(kv_lens.shape, float0_dtype())
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), z)
+
+
+def float0_dtype():
+    return jax.dtypes.float0
+
+
+_flash_core_varlen.defvjp(
+    lambda q, k, v, kv_lens, scale, causal: _flash_fwd_varlen(
+        q, k, v, kv_lens, scale, causal),
+    _flash_bwd_varlen)
+
+
 def flash_attention_jax(query, key, value, *, causal=False, scale=None,
-                        mask=None, dropout_p=0.0, dropout_key=None):
-    """Pure-jax entry ([B,S,H,D] arrays). Chooses Pallas vs XLA."""
+                        mask=None, dropout_p=0.0, dropout_key=None,
+                        kv_lens=None):
+    """Pure-jax entry ([B,S,H,D] arrays). Chooses Pallas vs XLA.
+
+    kv_lens ([B] i32): per-sequence valid kv length for padded batches —
+    masked inside the Pallas kernels (varlen parity, no S x S mask
+    tensor)."""
     d = query.shape[-1]
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
     # d only needs to be a multiple of 64: the kernel's block last-dim
@@ -527,6 +646,18 @@ def flash_attention_jax(query, key, value, *, causal=False, scale=None,
                  and mask is None and dropout_p == 0.0
                  and query.shape[1] >= 8 and d % 64 == 0
                  and query.shape[2] % key.shape[2] == 0)
+    if kv_lens is not None:
+        kv_lens = jnp.asarray(kv_lens, jnp.int32)
+        if plausible:
+            return _flash_core_varlen(query, key, value, kv_lens, sc,
+                                      causal)
+        sk = key.shape[1]
+        lens_mask = (jnp.arange(sk)[None, None, None, :]
+                     < kv_lens[:, None, None, None])
+        m2 = lens_mask if mask is None else jnp.logical_and(
+            lens_mask, mask.astype(bool))
+        return _xla_attention(query, key, value, sc, causal, mask=m2,
+                              dropout_p=dropout_p, dropout_key=dropout_key)
     if plausible:
         return _flash_core(query, key, value, sc, causal)
     return _xla_attention(query, key, value, sc, causal, mask=mask,
@@ -538,8 +669,10 @@ def flash_attention_jax(query, key, value, *, causal=False, scale=None,
 # ---------------------------------------------------------------------------
 
 def flash_attention_bshd(query, key, value, attn_mask=None, dropout_p=0.0,
-                         is_causal=False, training=True, scale=None):
-    """paddle scaled_dot_product_attention parity: [B, S, H, D] in/out."""
+                         is_causal=False, training=True, scale=None,
+                         kv_lens=None):
+    """paddle scaled_dot_product_attention parity: [B, S, H, D] in/out.
+    kv_lens ([B] ints): varlen padded-batch support, masked in-kernel."""
     from ..ops._dispatch import apply
     from ..ops.creation import _coerce
     from ..framework.random import next_key
@@ -548,12 +681,18 @@ def flash_attention_bshd(query, key, value, attn_mask=None, dropout_p=0.0,
     has_mask = attn_mask is not None
     if has_mask:
         args.append(_coerce(attn_mask))
+    has_lens = kv_lens is not None
+    if has_lens:
+        args.append(_coerce(kv_lens))
     key_drop = next_key() if (dropout_p > 0.0 and training) else None
 
-    def fn(q, k, v, *m):
+    def fn(q, k, v, *rest):
+        it = iter(rest)
+        m = next(it) if has_mask else None
+        lens = next(it) if has_lens else None
         return flash_attention_jax(
             q, k, v, causal=is_causal, scale=scale,
-            mask=m[0] if has_mask else None,
+            mask=m, kv_lens=lens,
             dropout_p=dropout_p if training else 0.0,
             dropout_key=key_drop)
     return apply(fn, *args, _name="flash_attention")

@@ -534,3 +534,53 @@ class TestDy2StaticAugAssign:
         np.testing.assert_allclose(np.asarray(f(x).numpy()), [6.0])
         xn = paddle.to_tensor(np.array([-1.0], np.float32))
         np.testing.assert_allclose(np.asarray(f(xn).numpy()), [-3.0])
+
+
+class TestDy2StaticForRange:
+    def test_for_range_tensor_bound(self):
+        """for i in range(n) with a TENSOR bound compiles (lax.while_loop
+        lowering); python semantics preserved for concrete bounds."""
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def f(x, n):
+            s = x * 0
+            for i in range(n):
+                s = s + x * (i + 1)
+            return s
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        out = f(x, paddle.to_tensor(np.int32(4)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [10.0])
+        out2 = f(x, paddle.to_tensor(np.int32(2)))
+        np.testing.assert_allclose(np.asarray(out2.numpy()), [3.0])
+
+    def test_for_range_concrete_and_step(self):
+        from paddle_tpu.jit.dy2static import convert_to_static_ast
+        import paddle_tpu as paddle
+
+        def g(x):
+            acc = x * 0
+            for k in range(6, 0, -2):
+                acc = acc + k
+            return acc, k
+
+        g2 = convert_to_static_ast(g)
+        assert g2 is not g
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        acc, k = g2(x)
+        np.testing.assert_allclose(np.asarray(acc.numpy()), [12.0])
+        assert int(k) == 2  # python leaves the LAST value
+
+    def test_plain_iterable_for_untouched(self):
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def h(x):
+            total = x * 0
+            for w in [1.0, 2.0, 3.0]:
+                total = total + x * w
+            return total
+
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(h(x).numpy()), [12.0])

@@ -51,6 +51,20 @@ def _flatten_tensors(obj, acc):
     return obj
 
 
+def _freeze(obj):
+    """Hashable key for a struct of non-tensor leaves ("*" marks tensor
+    slots)."""
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__,) + tuple(_freeze(o) for o in obj)
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    try:
+        hash(obj)
+        return obj
+    except TypeError:
+        return repr(obj)
+
+
 def _rebuild(struct, it, wrap):
     if struct == "*":
         return wrap(next(it))
@@ -95,7 +109,11 @@ class StaticFunction:
         struct = _flatten_tensors((args, kwargs), acc := [])
         in_tensors = acc
         in_arrays = [t._value for t in in_tensors]
-        sig = tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays)
+        # non-tensor leaves (python ints/bools/strs...) are baked into
+        # the traced program as constants, so they MUST be part of the
+        # cache key — f(x, 0) and f(x, 3) are different programs
+        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays),
+               _freeze(struct))
 
         if sig not in self._cache:
             fn = self._fn

@@ -298,14 +298,28 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             start, stop, step = it.args
         svar, pvar = f"__jst_stop_{self._n}", f"__jst_step_{self._n}"
         tgt = node.target.id
+        prev = f"__jst_prev_{self._n}"
+        # pre-bind the loop target so it can be loop-carried state —
+        # but guard on the loop actually running: python keeps (or
+        # leaves unbound) the prior binding for an empty range
+        prev_lambda = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=_name(tgt))
         init = [
             ast.Assign(targets=[_name(ivar, store=True)], value=start),
             ast.Assign(targets=[_name(svar, store=True)], value=stop),
             ast.Assign(targets=[_name(pvar, store=True)], value=step),
-            # pre-bind the loop target so it can be loop-carried state
-            # (python leaves it unbound for empty ranges; we bind start)
-            ast.Assign(targets=[_name(tgt, store=True)],
-                       value=_name(ivar)),
+            ast.Assign(targets=[_name(prev, store=True)],
+                       value=_jst_call("try_read",
+                                       [prev_lambda,
+                                        ast.Constant(tgt)])),
+            ast.Assign(
+                targets=[_name(tgt, store=True)],
+                value=_jst_call("for_target_init", [
+                    _jst_call("range_cond",
+                              [_name(ivar), _name(svar), _name(pvar)]),
+                    _name(ivar), _name(prev)])),
         ]
         body = ([ast.Assign(targets=[_name(tgt, store=True)],
                             value=_name(ivar))]
@@ -468,6 +482,39 @@ class _Helpers:
     def grab(loc, names):
         """{name: value} for the names present in a locals() snapshot."""
         return {n: loc[n] for n in names if n in loc}
+
+    @staticmethod
+    def try_read(thunk, name):
+        """Read a possibly-unbound local (via a closure); Undefined
+        sentinel if it is not bound yet."""
+        try:
+            return thunk()
+        except (NameError, UnboundLocalError):
+            return Undefined(name)
+
+    @staticmethod
+    def for_target_init(cond, start, prev):
+        """Pre-bind value for a for-range loop target: `start` when the
+        loop will run at least once, else the pre-loop binding (python
+        leaves the target untouched for an empty range). With TRACED
+        bounds the trip count is data-dependent; there `start` is used
+        (the loop-carried value overwrites it on every taken path, and
+        an empty traced range with a shape-mismatched prior cannot be
+        selected with jnp.where anyway — documented limitation)."""
+        from ..tensor import Tensor
+        if _Helpers._is_traced(cond):
+            if isinstance(prev, Undefined):
+                return start
+            import jax.numpy as jnp
+            a = start._value if isinstance(start, Tensor) else start
+            b = prev._value if isinstance(prev, Tensor) else prev
+            c = cond._value if isinstance(cond, Tensor) else cond
+            try:
+                return Tensor(jnp.where(c, a, b))
+            except (TypeError, ValueError):
+                return start
+        v = bool(cond.numpy()) if isinstance(cond, Tensor) else bool(cond)
+        return start if v else prev
 
     @staticmethod
     def cond(pred, true_fn, false_fn, names=(), t_assigned=(),

@@ -45,12 +45,15 @@ def _zero_tail_rows(arr, blk_idx, block, limit):
 
 
 def _lens_rows(kv_lens, bh):
-    """Per-row (B*H) kv lengths as a [BH, 128] i32 array (the 128 lane dim
-    satisfies TPU tiling; the kernel reads lane 0)."""
+    """Per-row (B*H) kv lengths as a [BH, 1, 128] i32 array. The singleton
+    middle axis keeps the BLOCK's trailing two dims at (1, 128) — equal to
+    the array dim / lane-divisible, which Mosaic's tiling check requires
+    (a [BH, 128] layout with block (1, 128) fails it: 1 is neither a
+    multiple of 8 nor equal to BH). The kernel reads lane 0."""
     per_b = jnp.asarray(kv_lens, jnp.int32)
     reps = bh // per_b.shape[0]
     per_row = jnp.repeat(per_b, reps)
-    return jnp.broadcast_to(per_row[:, None], (bh, 128))
+    return jnp.broadcast_to(per_row[:, None, None], (bh, 1, 128))
 
 
 def _gqa_kv_row(h, H, Hkv):
@@ -113,7 +116,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
             if has_lens:
                 # varlen: this sequence's real kv length (padding tokens
                 # beyond it are finite garbage — mask them out)
-                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0])
+                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0, 0])
             s = jnp.where(keep, s, _NEG_INF)
 
         m_prev = m_scr[:, 0]  # (bq,)
@@ -194,7 +197,8 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
     args = [q, k, v]
     if has_lens:
         args.append(_lens_rows(kv_lens, bh))
-        in_specs.append(pl.BlockSpec((1, 128), lambda h, i, j: (h, _Z)))
+        in_specs.append(
+            pl.BlockSpec((1, 1, 128), lambda h, i, j: (h, _Z, _Z)))
 
     out, lse = pl.pallas_call(
         kernel,
@@ -253,8 +257,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             ).astype(jnp.float32)
         do = _zero_tail_rows(do_ref[0], i, block_q, seq_q
                              ).astype(jnp.float32)       # (bq, d)
-        lse = lse_ref[0]                     # (bq,)
-        delta = delta_ref[0]                 # (bq,)
+        lse = lse_ref[0, 0]                  # (bq,)
+        delta = delta_ref[0, 0]              # (bq,)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32
                                 ) * np.float32(scale)
@@ -269,7 +273,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if causal:
                 keep = jnp.logical_and(keep, q_ids >= k_ids)
             if has_lens:
-                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0])
+                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0, 0])
             s = jnp.where(keep, s, _NEG_INF)
             p = jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
         else:
@@ -329,8 +333,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = _zero_tail_rows(v_ref[0], j, block_k, seq_k
                             ).astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32
                                 ) * np.float32(scale)
@@ -347,7 +351,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if causal:
                 keep = jnp.logical_and(keep, q_ids >= k_ids)
             if has_lens:
-                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0])
+                keep = jnp.logical_and(keep, k_ids < lens_ref[0, 0, 0])
             s = jnp.where(keep, s, _NEG_INF)
         p = (jnp.where(keep, jnp.exp(s - lse[:, None]), 0.0)
              if keep is not None else jnp.exp(s - lse[:, None]))
@@ -394,8 +398,14 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
     block_k = min(block_k, sk)
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
-    # delta_i = rowsum(do * o): tiny elementwise+reduce, XLA fuses it
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # delta_i = rowsum(do * o): tiny elementwise+reduce, XLA fuses it.
+    # lse/delta are carried as [BH, 1, S]: the singleton middle axis puts
+    # the block's trailing dims at (1, block_q) with 1 == the array dim,
+    # which Mosaic's (8, 128)-tiling check accepts ([BH, S] with block
+    # (1, block_q) does not: 1 is neither 8-divisible nor equal to BH).
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    lse = lse[:, None, :]
 
     H = n_heads or 1
     Hkv = n_kv_heads or H
@@ -406,7 +416,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, b, _Z))
     k_in_j = pl.BlockSpec((1, block_k, d), lambda h, a, b: kv_in(h, a, b, a))
     k_out_j = pl.BlockSpec((1, block_k, d), lambda h, a, b: (h, a, _Z))
-    row_i = pl.BlockSpec((1, block_q), lambda h, a, b: (h, b))
+    row_i = pl.BlockSpec((1, 1, block_q), lambda h, a, b: (h, _Z, b))
     # GQA: dk/dv come out PER QUERY HEAD ([B*H, Sk, D]); the wrapper
     # group-sums them down to [B*Hkv, ...] — kv inputs are still never
     # repeated in HBM.
@@ -417,7 +427,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
 
     dkdv_in = [q_spec_i, k_in_j, k_in_j, q_spec_i, row_i, row_i]
     if has_lens:
-        dkdv_in.append(pl.BlockSpec((1, 128), lambda h, a, b: (h, _Z)))
+        dkdv_in.append(
+            pl.BlockSpec((1, 1, 128), lambda h, a, b: (h, _Z, _Z)))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
@@ -434,10 +445,11 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, a, _Z))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda h, a, b: kv_in(h, a, b, b))
-    row_q = pl.BlockSpec((1, block_q), lambda h, a, b: (h, a))
+    row_q = pl.BlockSpec((1, 1, block_q), lambda h, a, b: (h, _Z, a))
     dq_in = [q_spec, kv_spec, kv_spec, q_spec, row_q, row_q]
     if has_lens:
-        dq_in.append(pl.BlockSpec((1, 128), lambda h, a, b: (h, _Z)))
+        dq_in.append(
+            pl.BlockSpec((1, 1, 128), lambda h, a, b: (h, _Z, _Z)))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,

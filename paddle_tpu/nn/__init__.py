@@ -24,6 +24,8 @@ from .layers_common import (
     Pad1D, Pad2D, Pad3D, ZeroPad2D,
     Flatten, Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     PixelShuffle, PixelUnshuffle, Unfold, CosineSimilarity, Bilinear,
+    Fold, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, PairwiseDistance,
+    Unflatten, ChannelShuffle,
 )
 from .transformer import (
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -33,8 +35,11 @@ from .losses import (
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
     TripletMarginLoss, HingeEmbeddingLoss,
+    CTCLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    TripletMarginWithDistanceLoss, PoissonNLLLoss, GaussianNLLLoss,
 )
 from .rnn import (
     SimpleRNN, LSTM, GRU, LSTMCell, GRUCell, SimpleRNNCell, RNNBase,
+    RNN, BiRNN, RNNCellBase,
 )
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm

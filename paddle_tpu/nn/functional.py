@@ -8,6 +8,7 @@ schedule at compile time (replaces paddle/phi/kernels/gpu/conv_kernel.cu).
 """
 from __future__ import annotations
 
+import functools
 import math as pymath
 from typing import Optional, Sequence
 
@@ -617,20 +618,115 @@ def _pool(x, op, init, kernel_size, stride, padding, ndim, channel_last,
     return apply(fn, _coerce(x), _name=f"{op}_pool")
 
 
+def _max_pool_idx_raw(v, ks, st, pd, ceil_mode):
+    """Variadic reduce_window over (value, flat-index) pairs; ties
+    resolve to the first (row-major) position, matching the reference."""
+    sp = v.shape[2:]
+    ndim = len(sp)
+    flat_n = 1
+    for s in sp:
+        flat_n *= s
+    pos = jnp.arange(flat_n, dtype=jnp.int32).reshape(sp)
+    pos = jnp.broadcast_to(pos, v.shape)
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    if ceil_mode:
+        pads = list(pads)
+        for i in range(ndim):
+            d = 2 + i
+            size = v.shape[d] + 2 * pd[i]
+            rem = (size - ks[i]) % st[i]
+            if rem != 0:
+                lo, hi = pads[d]
+                pads[d] = (lo, hi + (st[i] - rem))
+        pads = tuple(pads)
+    neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+           else jnp.iinfo(v.dtype).min)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv > av) | ((bv == av) & (bi < ai))
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    return jax.lax.reduce_window(
+        (v, pos), (jnp.asarray(neg, v.dtype), jnp.asarray(flat_n,
+                                                          jnp.int32)),
+        sel, window, strides, pads)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _max_pool_idx(v, ks, st, pd, ceil_mode):
+    out, idx = _max_pool_idx_raw(v, ks, st, pd, ceil_mode)
+    return out, idx.astype(jnp.int64)
+
+
+def _max_pool_idx_fwd(v, ks, st, pd, ceil_mode):
+    out, idx = _max_pool_idx(v, ks, st, pd, ceil_mode)
+    return (out, idx), (idx, v)
+
+
+def _max_pool_idx_bwd(ks, st, pd, ceil_mode, res, g):
+    # the max-pool gradient: route each output cotangent to its argmax
+    # input position (indices themselves get no gradient)
+    idx, v = res
+    g_out = g[0].astype(jnp.float32)
+    n, c = v.shape[0], v.shape[1]
+    flat_n = 1
+    for s in v.shape[2:]:
+        flat_n *= s
+    gi = idx.reshape(n, c, -1).astype(jnp.int32)
+    gv = g_out.reshape(n, c, -1)
+    dv = jax.vmap(jax.vmap(
+        lambda i, val: jnp.zeros((flat_n,), jnp.float32).at[i].add(val)
+    ))(gi, gv)
+    return (dv.reshape(v.shape).astype(v.dtype),)
+
+
+_max_pool_idx.defvjp(_max_pool_idx_fwd, _max_pool_idx_bwd)
+
+
+def _max_pool_with_mask(x, kernel_size, stride, padding, ndim, ceil_mode):
+    """Max pool that also returns the flat argmax index within each
+    input spatial plane (paddle return_mask semantics; reference:
+    phi max_pool2d_with_index kernel)."""
+    ks = _pair(kernel_size, ndim)
+    st = _pair(stride if stride is not None else kernel_size, ndim)
+    pd = _pair(padding, ndim)
+    return apply(lambda v: _max_pool_idx(v, ks, st, pd, ceil_mode),
+                 _coerce(x), _name="max_pool_mask")
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        if data_format != "NCL":  # same restriction as the reference
+            raise ValueError("return_mask requires NCL data_format")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   ceil_mode)
     return _pool(x, "max", None, kernel_size, stride, padding, 1,
                  data_format == "NLC", ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":  # same restriction as the reference
+            raise ValueError("return_mask requires NCHW data_format")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   ceil_mode)
     return _pool(x, "max", None, kernel_size, stride, padding, 2,
                  data_format == "NHWC", ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":  # same restriction as the reference
+            raise ValueError("return_mask requires NCDHW data_format")
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   ceil_mode)
     return _pool(x, "max", None, kernel_size, stride, padding, 3,
                  data_format == "NDHWC", ceil_mode)
 
@@ -938,9 +1034,72 @@ def square_error_cost(input, label):
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError(
-        "ctc_loss lands with the speech model family (reference: "
-        "paddle/phi/kernels/gpu/warpctc_kernel.cu)")
+    """Connectionist Temporal Classification loss (parity:
+    python/paddle/nn/functional/loss.py ctc_loss; upstream
+    phi/kernels/gpu/warpctc_kernel.cu binds warp-ctc). TPU-native: the
+    log-domain forward algorithm as a lax.scan over time — one compiled
+    recurrence instead of a CUDA kernel; alpha lives in registers/VMEM
+    and the whole thing fuses under jit.
+
+    log_probs: [T, B, C] (time-major, already log-softmaxed);
+    labels: [B, L] int; input_lengths/label_lengths: [B] int."""
+    def fn(lp, lab, in_len, lab_len):
+        t_max, b, c = lp.shape
+        l_max = lab.shape[1]
+        s = 2 * l_max + 1  # extended label: blank l1 blank l2 ... blank
+        lab = lab.astype(jnp.int32)
+        ext = jnp.full((b, s), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        # transition mask: from s-2 allowed iff ext[s] != blank and
+        # ext[s] != ext[s-2]
+        ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s]
+        allow_skip = (ext != blank) & (ext != ext_m2)
+        pos = jnp.arange(s)
+        # emission log-prob of extended symbol j at time t
+        def emit(lp_t):
+            return jnp.take_along_axis(lp_t, ext, axis=1)  # [B, S]
+
+        alpha0 = jnp.full((b, s), neg_inf, lp.dtype)
+        alpha0 = alpha0.at[:, 0].set(emit(lp[0])[:, 0])
+        has1 = (s > 1)
+        if has1:
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(lab_len > 0, emit(lp[0])[:, 1], neg_inf))
+
+        def step(alpha, lp_t):
+            e = emit(lp_t)
+            a_prev = jnp.pad(alpha, ((0, 0), (1, 0)),
+                             constant_values=-1e30)[:, :s]
+            a_skip = jnp.pad(alpha, ((0, 0), (2, 0)),
+                             constant_values=-1e30)[:, :s]
+            a_skip = jnp.where(allow_skip, a_skip, neg_inf)
+            stacked = jnp.stack([alpha, a_prev, a_skip], axis=0)
+            new = jax.nn.logsumexp(stacked, axis=0) + e
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,S]
+        # per-sample final: alpha[T_b - 1, 2*L_b] lse alpha[T_b - 1, 2*L_b - 1]
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, t_max - 1)
+        a_final = jnp.take_along_axis(
+            alphas, t_idx[None, :, None].repeat(s, axis=2), axis=0)[0]
+        end0 = 2 * lab_len.astype(jnp.int32)
+        end1 = jnp.maximum(end0 - 1, 0)
+        f0 = jnp.take_along_axis(a_final, end0[:, None], axis=1)[:, 0]
+        f1 = jnp.take_along_axis(a_final, end1[:, None], axis=1)[:, 0]
+        f1 = jnp.where(lab_len > 0, f1, neg_inf)
+        loss = -jax.nn.logsumexp(jnp.stack([f0, f1]), axis=0)
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(loss.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, _coerce(log_probs), _coerce(labels),
+                 _coerce(input_lengths), _coerce(label_lengths))
 
 
 # ------------------------------------------------------------- attention ---
@@ -1095,3 +1254,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         out = jnp.concatenate([left, right, rest], axis=2)
         return out.reshape(nt, c, h, w)
     return apply(fn, _coerce(x))
+
+
+# second-tier surface (spatial transformer ops, unpooling, loss long
+# tail) lives in functional_extra to keep this module navigable
+from .functional_extra import *  # noqa: F401,F403,E402

@@ -1,0 +1,546 @@
+"""paddle.nn.functional — second tier of the reference surface.
+
+Reference parity: python/paddle/nn/functional/{loss,vision,common,input}.py
+(the functions here are the ones not already in functional.py: spatial
+transformer ops, unpooling, and the long tail of losses). All lower to
+jax.numpy/lax — gathers and scatter-adds are XLA-native and fuse; no
+per-op CUDA kernels needed (replaces the corresponding
+paddle/phi/kernels/gpu/*_kernel.cu entries).
+"""
+from __future__ import annotations
+
+import math as pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+__all__ = [
+    "affine_grid", "grid_sample", "fold", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "channel_shuffle", "bilinear", "pairwise_distance",
+    "zeropad2d", "gather_tree", "dice_loss", "log_loss", "npair_loss",
+    "poisson_nll_loss", "gaussian_nll_loss", "sigmoid_focal_loss",
+    "soft_margin_loss", "multi_label_soft_margin_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss",
+    "margin_cross_entropy",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# ------------------------------------------------------------------ vision --
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Parity: python/paddle/nn/functional/vision.py affine_grid.
+    theta: [N, 2, 3] (4-D out_shape) or [N, 3, 4] (5-D out_shape)."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in np.asarray(out_shape.numpy())]
+    out_shape = [int(v) for v in out_shape]
+
+    def fn(th):
+        nd = len(out_shape) - 2  # 2 or 3 spatial dims
+
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+        if nd == 2:
+            n, _, h, w = out_shape
+            ys = axis_coords(h)
+            xs = axis_coords(w)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # H,W,3
+            # [N,H,W,2] = base @ theta^T
+            grid = jnp.einsum("hwk,njk->nhwj", base, th)
+            return grid.astype(th.dtype)
+        n, _, d, h, w = out_shape
+        zs = axis_coords(d)
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], axis=-1)
+        grid = jnp.einsum("dhwk,njk->ndhwj", base, th)
+        return grid.astype(th.dtype)
+    return apply(fn, _coerce(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Parity: python/paddle/nn/functional/vision.py grid_sample (NCHW,
+    4-D). Gather-based bilinear/nearest sampling — XLA lowers the gathers
+    to efficient dynamic-slice fusions on TPU."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode}")
+
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gf = g.astype(jnp.float32)
+        gx, gy = gf[..., 0], gf[..., 1]  # [N, Ho, Wo]
+
+        def unnormalize(coord, size):
+            if align_corners:
+                return (coord + 1.0) * 0.5 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) * 0.5
+
+        def reflect(coord, size):
+            if align_corners:
+                span = size - 1
+                if span == 0:
+                    return jnp.zeros_like(coord)
+                coord = jnp.abs(coord)
+                period = 2 * span
+                coord = coord % period
+                return jnp.where(coord > span, period - coord, coord)
+            span = size
+            coord = jnp.abs(coord + 0.5)
+            period = 2 * span
+            coord = coord % period
+            coord = jnp.where(coord > span, period - coord, coord)
+            return jnp.clip(coord - 0.5, 0, size - 1)
+
+        ix = unnormalize(gx, w)
+        iy = unnormalize(gy, h)
+        if padding_mode == "border":
+            ix = jnp.clip(ix, 0, w - 1)
+            iy = jnp.clip(iy, 0, h - 1)
+        elif padding_mode == "reflection":
+            ix = reflect(ix, w)
+            iy = reflect(iy, h)
+
+        # shared sampling core (ops/_sampling.py — same helper as
+        # roi_align/deform_conv); vmapped over batch, XLA emits one
+        # batched gather
+        from ..ops import _sampling as S
+        ho, wo = iy.shape[1], iy.shape[2]
+        samp = S.nearest_zeros if mode == "nearest" else S.bilinear_zeros
+        out = jax.vmap(samp)(v, iy.reshape(n, -1), ix.reshape(n, -1))
+        return out.reshape(n, c, ho, wo).astype(v.dtype)
+    return apply(fn, _coerce(x), _coerce(grid))
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (parity: python/paddle/nn/functional/common.py fold) —
+    inverse of unfold: overlapping patch columns scatter-add back into the
+    image. Implemented as a static loop over kernel offsets with
+    slice-wise .at[].add — XLA turns each into a fused scatter."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple))
+                                     and len(paddings) == 4) else (None, None)
+    if ph is None:
+        pt, pl, pb, pr = (int(v) for v in paddings)
+    else:
+        pt, pl, pb, pr = ph, pw, ph, pw
+    dh, dw = _pair(dilations)
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        hp, wp = oh + pt + pb, ow + pl + pr
+        nh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (wp - (dw * (kw - 1) + 1)) // sw + 1
+        assert nh * nw == L, (
+            f"fold: L={L} inconsistent with output_sizes (expect {nh*nw})")
+        cols = v.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, hp, wp), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :,
+                             i * dh:i * dh + nh * sh:sh,
+                             j * dw:j * dw + nw * sw:sw].add(
+                                 cols[:, :, i, j])
+        return out[:, :, pt:pt + oh, pl:pl + ow]
+    return apply(fn, _coerce(x))
+
+
+def _max_unpool(x, indices, ndim, kernel_size, stride, padding, output_size,
+                data_format):
+    if data_format not in ("NCL", "NCHW", "NCDHW"):
+        raise ValueError(f"unsupported data_format {data_format}")
+    ks = (kernel_size,) * ndim if isinstance(kernel_size, int) else tuple(
+        kernel_size)
+    st = ks if stride is None else (
+        (stride,) * ndim if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * ndim if isinstance(padding, int) else tuple(padding)
+
+    def fn(v, idx):
+        n, c = v.shape[:2]
+        in_sp = v.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size)[-ndim:]
+        else:
+            out_sp = tuple((in_sp[d] - 1) * st[d] - 2 * pd[d] + ks[d]
+                           for d in range(ndim))
+        flat_out = int(np.prod(out_sp))
+        vf = v.reshape(n, c, -1)
+        inf = idx.reshape(n, c, -1).astype(jnp.int32)
+        out = jnp.zeros((n, c, flat_out), v.dtype)
+        # paddle indices are flat positions within the spatial plane
+        out = jax.vmap(jax.vmap(
+            lambda o, i, val: o.at[i].set(val)))(out, inf, vf)
+        return out.reshape(n, c, *out_sp)
+    return apply(fn, _coerce(x), _coerce(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Parity: python/paddle/nn/functional/pooling.py max_unpool1d."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Parity: python/paddle/nn/functional/pooling.py max_unpool2d."""
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Parity: python/paddle/nn/functional/pooling.py max_unpool3d."""
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Parity: python/paddle/nn/functional/vision.py channel_shuffle."""
+    g = int(groups)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return (v.reshape(n, g, c // g, h, w)
+                    .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w))
+        n, h, w, c = v.shape
+        return (v.reshape(n, h, w, g, c // g)
+                .transpose(0, 1, 2, 4, 3).reshape(n, h, w, c))
+    return apply(fn, _coerce(x))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Parity: python/paddle/nn/functional/common.py zeropad2d."""
+    pl, pr, pt, pb = (int(v) for v in padding)
+
+    def fn(v):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (pt, pb), (pl, pr)]
+        else:
+            cfg = [(0, 0), (pt, pb), (pl, pr), (0, 0)]
+        return jnp.pad(v, cfg)
+    return apply(fn, _coerce(x))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Parity: python/paddle/nn/functional/common.py bilinear:
+    out[n, o] = x1[n, :] @ W[o] @ x2[n, :] + b[o]."""
+    args = [_coerce(x1), _coerce(x2), _coerce(weight)]
+    if bias is not None:
+        args.append(_coerce(bias))
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    return apply(fn, *args)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Parity: python/paddle/nn/functional/distance.py pairwise_distance."""
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return apply(fn, _coerce(x), _coerce(y))
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestry walk (parity: python/paddle/nn/functional/
+    input.py gather_tree; upstream phi gather_tree kernel). ids/parents:
+    [max_time, batch, beam]. Walks parent pointers backwards with a scan
+    (compiler-friendly: fixed trip count, no host loop)."""
+    def fn(idv, parv):
+        t = idv.shape[0]
+        last = idv[t - 1]
+        beams = jnp.arange(idv.shape[2], dtype=parv.dtype)
+        init = jnp.broadcast_to(beams, idv.shape[1:])
+
+        def step(carry, xs):
+            id_t, par_t = xs
+            out = jnp.take_along_axis(id_t, carry, axis=1)
+            nxt = jnp.take_along_axis(par_t, carry, axis=1)
+            return nxt, out
+
+        _, outs = jax.lax.scan(
+            step, init, (idv[::-1], parv[::-1]))
+        return outs[::-1]
+    return apply(fn, _coerce(ids), _coerce(parents))
+
+
+# ------------------------------------------------------------------ losses --
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Parity: python/paddle/nn/functional/loss.py dice_loss."""
+    def fn(v, lab):
+        lab_oh = jax.nn.one_hot(lab.squeeze(-1), v.shape[-1], dtype=v.dtype)
+        red = tuple(range(1, v.ndim))
+        inter = jnp.sum(v * lab_oh, axis=red)
+        union = jnp.sum(v, axis=red) + jnp.sum(lab_oh, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return apply(fn, _coerce(input), _coerce(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Parity: python/paddle/nn/functional/loss.py log_loss."""
+    def fn(v, lab):
+        return (-lab * jnp.log(v + epsilon)
+                - (1.0 - lab) * jnp.log(1.0 - v + epsilon))
+    return apply(fn, _coerce(input), _coerce(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Parity: python/paddle/nn/functional/loss.py npair_loss."""
+    def fn(a, p, lab):
+        lab = lab.reshape(-1, 1).astype(a.dtype)
+        same = (lab == lab.T).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        logits = a @ p.T
+        logp = jax.nn.log_softmax(logits, axis=1)
+        xent = jnp.mean(jnp.sum(-tgt * logp, axis=1))
+        reg = jnp.mean(jnp.sum(a * a, 1) + jnp.sum(p * p, 1)) * (l2_reg / 2)
+        return xent + reg
+    return apply(fn, _coerce(anchor), _coerce(positive), _coerce(labels))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """Parity: python/paddle/nn/functional/loss.py poisson_nll_loss."""
+    def fn(v, lab):
+        if log_input:
+            loss = jnp.exp(v) - lab * v
+        else:
+            loss = v - lab * jnp.log(v + epsilon)
+        if full:
+            stirling = (lab * jnp.log(lab) - lab
+                        + 0.5 * jnp.log(2 * np.pi * lab))
+            loss = loss + jnp.where(lab > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply(fn, _coerce(input), _coerce(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Parity: python/paddle/nn/functional/loss.py gaussian_nll_loss."""
+    def fn(v, lab, var):
+        var = jnp.clip(var, min=epsilon)
+        loss = 0.5 * (jnp.log(var) + (v - lab) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return _reduce(loss, reduction)
+    return apply(fn, _coerce(input), _coerce(label), _coerce(variance))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    """Parity: python/paddle/nn/functional/loss.py sigmoid_focal_loss."""
+    args = [_coerce(logit), _coerce(label)]
+    if normalizer is not None:
+        args.append(_coerce(normalizer))
+
+    def fn(lg, lab, *rest):
+        p = jax.nn.sigmoid(lg)
+        ce = (jnp.maximum(lg, 0) - lg * lab
+              + jnp.log1p(jnp.exp(-jnp.abs(lg))))
+        pt = p * lab + (1 - p) * (1 - lab)
+        at = alpha * lab + (1 - alpha) * (1 - lab)
+        loss = at * ((1 - pt) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    return apply(fn, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """Parity: python/paddle/nn/functional/loss.py soft_margin_loss."""
+    def fn(v, lab):
+        # -log_sigmoid(y*x): stable for large |x| (log1p(exp(..)) overflows)
+        return _reduce(-jax.nn.log_sigmoid(lab.astype(v.dtype) * v),
+                       reduction)
+    return apply(fn, _coerce(input), _coerce(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """Parity: python/paddle/nn/functional/loss.py
+    multi_label_soft_margin_loss."""
+    args = [_coerce(input), _coerce(label)]
+    if weight is not None:
+        args.append(_coerce(weight))
+
+    def fn(v, lab, *rest):
+        lab = lab.astype(v.dtype)
+        loss = -(lab * jax.nn.log_sigmoid(v)
+                 + (1 - lab) * jax.nn.log_sigmoid(-v))
+        if rest:
+            loss = loss * rest[0]
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+    return apply(fn, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Parity: python/paddle/nn/functional/loss.py multi_margin_loss."""
+    args = [_coerce(input), _coerce(label)]
+    if weight is not None:
+        args.append(_coerce(weight))
+
+    def fn(v, lab, *rest):
+        n, c = v.shape
+        lab = lab.astype(jnp.int32)
+        correct = jnp.take_along_axis(v, lab[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - correct + v) ** p
+        if rest:
+            m = m * rest[0][lab][:, None]
+        mask = jax.nn.one_hot(lab, c, dtype=v.dtype)
+        loss = jnp.sum(m * (1 - mask), axis=1) / c
+        return _reduce(loss, reduction)
+    return apply(fn, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Parity: python/paddle/nn/functional/loss.py
+    triplet_margin_with_distance_loss."""
+    if distance_function is None:
+        def distance_function(a, b):
+            return pairwise_distance(a, b)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dpn = distance_function(positive, negative)
+        from ..ops import math as om
+        dn = om.minimum(dn, dpn)
+
+    def fn(dpv, dnv):
+        return _reduce(jnp.maximum(0.0, dpv - dnv + margin), reduction)
+    return apply(fn, _coerce(dp), _coerce(dn))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (parity: python/paddle/nn/functional/
+    loss.py hsigmoid_loss; upstream phi hsigmoid_loss kernel). Default
+    complete-binary-tree coding when no custom path_table/path_code."""
+    if (path_table is None) != (path_code is None):
+        raise ValueError("path_table and path_code must be given together")
+    use_custom = path_table is not None
+    args = [_coerce(input), _coerce(label), _coerce(weight)]
+    if bias is not None:
+        args.append(_coerce(bias))
+    if use_custom:
+        args.append(_coerce(path_table))
+        args.append(_coerce(path_code))
+
+    def fn(x, lab, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        if use_custom:
+            table, code = rest
+            table = table.astype(jnp.int32)
+            code = code.astype(x.dtype)
+            valid = (table >= 0).astype(x.dtype)
+            tsafe = jnp.maximum(table, 0)
+            wsel = w[tsafe]                     # [N, L, D]
+            logits = jnp.einsum("nld,nd->nl", wsel, x)
+            if b is not None:
+                logits = logits + b.reshape(-1)[tsafe]
+        else:
+            # complete binary tree over num_classes leaves: internal node
+            # ids 1..num_classes-1 (root=1); leaf for class c is
+            # c + num_classes; path = ancestors of the leaf
+            nc = int(num_classes)
+            depth = int(np.ceil(np.log2(nc))) if nc > 1 else 1
+            leaf = lab.reshape(-1).astype(jnp.int32) + nc
+            nodes = []
+            codes = []
+            cur = leaf
+            for _ in range(depth):
+                codes.append((cur % 2).astype(x.dtype))
+                cur = cur // 2
+                nodes.append(cur)
+            table = jnp.stack(nodes[::-1], axis=1)   # [N, depth] root-first
+            code = jnp.stack(codes[::-1], axis=1)
+            valid = (table >= 1).astype(x.dtype)
+            # weight is [num_classes - 1, D]: internal node ids 1..nc-1
+            # live in rows id-1 (row for the root = 0)
+            tsafe = jnp.clip(table - 1, 0, w.shape[0] - 1)
+            wsel = w[tsafe]
+            logits = jnp.einsum("nld,nd->nl", wsel, x)
+            if b is not None:
+                logits = logits + b.reshape(-1)[tsafe]
+        # bce-with-logits against the path code, masked by valid entries
+        per = (jnp.maximum(logits, 0) - logits * code
+               + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        loss = jnp.sum(per * valid, axis=1, keepdims=True)
+        return jnp.mean(loss)
+    return apply(fn, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace margin softmax (parity: python/paddle/nn/functional/
+    loss.py margin_cross_entropy; upstream phi margin_cross_entropy
+    kernel). logits are cosine similarities; the target class logit is
+    remapped cos(m1*theta + m2) - m3 before the scaled softmax."""
+    if group is not None:
+        # the model-parallel variant (class-dim sharded logits with
+        # cross-rank max/sum exchange) lives in the TP layer stack —
+        # silently normalizing over a local shard would be wrong
+        raise NotImplementedError(
+            "margin_cross_entropy(group=...) requires the model-parallel "
+            "path; use meta_parallel.ParallelCrossEntropy for sharded "
+            "logits or call without group for replicated logits")
+    def fn(lg, lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        n, c = lg.shape
+        tgt = jnp.take_along_axis(lg, lab[:, None], axis=1)  # cos(theta)
+        tgt = jnp.clip(tgt, -1.0, 1.0)
+        theta = jnp.arccos(tgt)
+        mt = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(lab, c, dtype=lg.dtype)
+        adj = lg * (1 - oh) + mt * oh
+        adj = adj * scale
+        logp = jax.nn.log_softmax(adj, axis=1)
+        loss = -jnp.take_along_axis(logp, lab[:, None], axis=1)
+        red = _reduce(loss, reduction)
+        if return_softmax:
+            return red, jnp.exp(logp)
+        return red
+    out = apply(fn, _coerce(logits), _coerce(label))
+    return out

@@ -817,3 +817,95 @@ class Bilinear(Layer):
         if self.bias is not None:
             args.append(self.bias)
         return apply(fn, *args)
+
+
+class Fold(Layer):
+    """Parity: python/paddle/nn/layer/common.py Fold."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        from .functional_extra import fold
+        return fold(x, *self._args)
+
+
+class MaxUnPool1D(Layer):
+    """Parity: python/paddle/nn/layer/pooling.py MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        from .functional_extra import max_unpool1d
+        ks, st, pd, df, os_ = self._args
+        return max_unpool1d(x, indices, ks, st, pd, df, os_)
+
+
+class MaxUnPool2D(Layer):
+    """Parity: python/paddle/nn/layer/pooling.py MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        from .functional_extra import max_unpool2d
+        ks, st, pd, df, os_ = self._args
+        return max_unpool2d(x, indices, ks, st, pd, df, os_)
+
+
+class MaxUnPool3D(Layer):
+    """Parity: python/paddle/nn/layer/pooling.py MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        from .functional_extra import max_unpool3d
+        ks, st, pd, df, os_ = self._args
+        return max_unpool3d(x, indices, ks, st, pd, df, os_)
+
+
+class PairwiseDistance(Layer):
+    """Parity: python/paddle/nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from .functional_extra import pairwise_distance
+        return pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Unflatten(Layer):
+    """Parity: python/paddle/nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self._shape = axis, shape
+
+    def forward(self, x):
+        from ..ops.extras import unflatten
+        return unflatten(x, self.axis, self._shape)
+
+
+class ChannelShuffle(Layer):
+    """Parity: python/paddle/nn/layer/vision.py ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        from .functional_extra import channel_shuffle
+        return channel_shuffle(x, self.groups, self.data_format)

@@ -143,3 +143,104 @@ class HingeEmbeddingLoss(Layer):
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin,
                                       self.reduction)
+
+
+class CTCLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py CTCLoss."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from .functional import ctc_loss
+        return ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                        blank=self.blank, reduction=self.reduction,
+                        norm_by_times=norm_by_times)
+
+
+class SoftMarginLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py SoftMarginLoss."""
+
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        from .functional_extra import soft_margin_loss
+        return soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py MultiLabelSoftMarginLoss."""
+
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        from .functional_extra import multi_label_soft_margin_loss
+        return multi_label_soft_margin_loss(input, label, self.weight,
+                                            self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        from .functional_extra import multi_margin_loss
+        return multi_margin_loss(input, label, self.p, self.margin,
+                                 self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py
+    TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        from .functional_extra import triplet_margin_with_distance_loss
+        return triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py PoissonNLLLoss."""
+
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        from .functional_extra import poisson_nll_loss
+        return poisson_nll_loss(input, label, self.log_input, self.full,
+                                self.epsilon, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py GaussianNLLLoss."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        from .functional_extra import gaussian_nll_loss
+        return gaussian_nll_loss(input, label, variance, self.full,
+                                 self.epsilon, self.reduction)

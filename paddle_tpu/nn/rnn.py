@@ -283,3 +283,144 @@ class SimpleRNNCell(Layer):
         h2 = apply(fn, x, _coerce(states), self.weight_ih, self.weight_hh,
                    self.bias_ih, self.bias_hh)
         return h2, h2
+
+
+class RNNCellBase(Layer):
+    """Base for user-defined recurrent cells (parity: python/paddle/nn/
+    layer/rnn.py RNNCellBase): provides get_initial_states for the RNN
+    wrapper."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ..ops.creation import full
+        ref = _coerce(batch_ref)
+        batch = ref.shape[batch_dim_idx]
+        if shape is None:
+            shape = [self.hidden_size]
+        if dtype is None:
+            dtype = str(ref.dtype)
+
+        def build(s):
+            if isinstance(s, (list, tuple)) and s and isinstance(
+                    s[0], (list, tuple)):
+                return type(s)(build(e) for e in s)
+            return full([batch] + list(s), init_value, dtype=dtype)
+        if isinstance(shape, (list, tuple)) and shape and isinstance(
+                shape[0], (list, tuple)):
+            return build(shape)
+        return build(shape)
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class RNN(Layer):
+    """Run a cell over time (parity: python/paddle/nn/layer/rnn.py RNN).
+    The python loop is eager-friendly; under to_static/jit the whole
+    unrolled step sequence compiles into one XLA program (static trip
+    count — sequences have static shape on TPU)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ..ops.manipulation import stack
+        from ..ops.creation import _coerce as coerce
+        x = _coerce(inputs)
+        time_axis = 0 if self.time_major else 1
+        steps = x.shape[time_axis]
+        if initial_states is None and hasattr(self.cell,
+                                              "get_initial_states"):
+            initial_states = self.cell.get_initial_states(
+                x, batch_dim_idx=1 if self.time_major else 0)
+        states = initial_states
+        seq_len = (coerce(sequence_length) if sequence_length is not None
+                   else None)
+        rev_by_len = seq_len is not None and self.is_reverse
+        if rev_by_len:
+            # reverse each sequence within its own valid region (padding
+            # stays in place), then consume it with a FORWARD masked loop
+            # — step t' of the loop sees x[len-1-t'], i.e. the pass
+            # starts at each sequence's true end; outputs are mirrored
+            # back afterwards
+            x = apply(self._rev_by_len_fn(steps, time_axis), x,
+                      seq_len)
+        order = (range(steps) if (not self.is_reverse or rev_by_len)
+                 else range(steps - 1, -1, -1))
+        outs = [None] * steps
+        for t in order:
+            x_t = x[t] if self.time_major else x[:, t]
+            out, new_states = (self.cell(x_t, states, **kwargs)
+                               if states is not None
+                               else self.cell(x_t, **kwargs))
+            if seq_len is not None:
+                # beyond a sequence's length: output zero, carry state
+                out, states = self._mask_step(t, seq_len, out, new_states,
+                                              states)
+            else:
+                states = new_states
+            outs[t] = out
+        y = stack(outs, axis=time_axis)
+        if rev_by_len:
+            y = apply(self._rev_by_len_fn(steps, time_axis), y, seq_len)
+        return y, states
+
+    @staticmethod
+    def _rev_by_len_fn(steps, time_axis):
+        def fn(v, lens):
+            ts = jnp.arange(steps)
+            idx = jnp.where(ts[None, :] < lens[:, None],
+                            jnp.clip(lens[:, None] - 1 - ts[None, :], 0),
+                            ts[None, :])                    # [B, T]
+            if time_axis == 0:
+                b = jnp.arange(v.shape[1])
+                return v[idx.T, b[None, :]]
+            b = jnp.arange(v.shape[0])
+            return v[b[:, None], idx]
+        return fn
+
+    def _mask_step(self, t, seq_len, out, new_states, old_states):
+        def mask_one(new, old):
+            def fn(nv, ov, lens):
+                keep = (t < lens).reshape((-1,) + (1,) * (nv.ndim - 1))
+                return jnp.where(keep, nv, ov)
+            return apply(fn, _coerce(new), _coerce(old), seq_len)
+
+        def mask_tree(new, old):
+            if isinstance(new, (list, tuple)):
+                return type(new)(mask_tree(n, o) for n, o in zip(new, old))
+            return mask_one(new, old)
+
+        def zero_out(o):
+            def fn(ov, lens):
+                keep = (t < lens).reshape((-1,) + (1,) * (ov.ndim - 1))
+                return jnp.where(keep, ov, 0)
+            return apply(fn, _coerce(o), seq_len)
+
+        return zero_out(out), mask_tree(new_states, old_states)
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (parity: python/paddle/nn/layer/rnn.py
+    BiRNN): concat of forward and reverse RNN outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ..ops.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length, **kwargs)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length, **kwargs)
+        return concat([y_fw, y_bw], axis=-1), (st_fw, st_bw)

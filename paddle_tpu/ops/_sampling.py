@@ -1,0 +1,69 @@
+"""Shared spatial sampling primitives.
+
+One home for the zero-padded / clamped bilinear gather+lerp used by
+grid_sample (nn/functional_extra.py), roi_align and deform_conv2d
+(vision/ops.py) — the three reference CUDA kernels
+(grid_sample_kernel.cu, roi_align_kernel.cu, deformable_conv_kernel.cu)
+share the same bilinear_interpolate device function, and so do we.
+All helpers take a single feature map [C, H, W] and flat float coord
+vectors [P]; batch/roi dimensions are vmapped by the callers (XLA fuses
+the vmapped gathers into one batched gather).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_zeros(fmap, yi, xi):
+    """fmap[:, yi, xi] with 0 for out-of-range integer coords.
+    fmap: [C, H, W]; yi/xi: int [P] -> [C, P]."""
+    c, h, w = fmap.shape
+    inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    yc = jnp.clip(yi, 0, h - 1)
+    xc = jnp.clip(xi, 0, w - 1)
+    out = fmap[:, yc, xc]
+    return jnp.where(inside[None, :], out, 0)
+
+
+def bilinear_zeros(fmap, ys, xs):
+    """Zero-padding bilinear: out-of-range neighbors contribute 0 (the
+    im2col convention of deformable conv / grid_sample padding_mode=
+    'zeros'). fmap: [C, H, W]; ys/xs: float [P] -> [C, P]."""
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1, x1 = y0 + 1, x0 + 1
+    wy = ys - y0
+    wx = xs - x0
+    return (gather_zeros(fmap, y0, x0) * ((1 - wy) * (1 - wx))[None]
+            + gather_zeros(fmap, y0, x1) * ((1 - wy) * wx)[None]
+            + gather_zeros(fmap, y1, x0) * (wy * (1 - wx))[None]
+            + gather_zeros(fmap, y1, x1) * (wy * wx)[None])
+
+
+def bilinear_clamped(fmap, ys, xs):
+    """RoI-align convention (phi roi_align bilinear_interpolate): points
+    outside [-1, size] sample 0; otherwise coords clamp to the border
+    before interpolating. fmap: [C, H, W]; ys/xs: float [P] -> [C, P]."""
+    c, h, w = fmap.shape
+    valid = (ys >= -1.0) & (ys <= h) & (xs >= -1.0) & (xs <= w)
+    y = jnp.clip(ys, 0, h - 1)
+    x = jnp.clip(xs, 0, w - 1)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = y - y0
+    wx = x - x0
+    val = (fmap[:, y0, x0] * ((1 - wy) * (1 - wx))[None]
+           + fmap[:, y0, x1] * ((1 - wy) * wx)[None]
+           + fmap[:, y1, x0] * (wy * (1 - wx))[None]
+           + fmap[:, y1, x1] * (wy * wx)[None])
+    return jnp.where(valid[None, :], val, 0.0)
+
+
+def nearest_zeros(fmap, ys, xs):
+    """Nearest-neighbor with zeros outside. [C, H, W] x [P] -> [C, P]."""
+    yi = jnp.round(ys).astype(jnp.int32)
+    xi = jnp.round(xs).astype(jnp.int32)
+    return gather_zeros(fmap, yi, xi)

@@ -251,3 +251,108 @@ def householder_product(x, tau, name=None):
 
 def corrcoef(x, rowvar=True, name=None):
     return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), _coerce(x))
+
+
+def cond(x, p=None, name=None):
+    """Condition number (parity: python/paddle/tensor/linalg.py cond)."""
+    def fn(v):
+        pp = 2 if p is None else p
+        if pp in ("fro", "nuc") or isinstance(pp, (int, float)):
+            return jnp.linalg.cond(v, p=None if pp == 2 else pp)
+        raise ValueError(f"unsupported norm order {p}")
+    return apply(fn, _coerce(x))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack the packed LU factor + 1-based pivots from `lu` into P, L, U
+    (parity: python/paddle/tensor/linalg.py lu_unpack)."""
+    def fn(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        if unpack_ludata:
+            tril = jnp.tril(lu_[..., :, :k], k=-1)
+            eye = jnp.eye(m, k, dtype=lu_.dtype)
+            l = tril + jnp.broadcast_to(eye, tril.shape)
+            u = jnp.triu(lu_[..., :k, :])
+        else:
+            l = jnp.zeros(lu_.shape[:-2] + (m, k), lu_.dtype)
+            u = jnp.zeros(lu_.shape[:-2] + (k, n), lu_.dtype)
+        # pivots (1-based sequential row swaps) -> permutation, applied
+        # inside a fori_loop so the traced graph is O(1) in matrix size
+        perm = jnp.broadcast_to(jnp.arange(m), piv.shape[:-1] + (m,))
+        npiv = piv.shape[-1]
+
+        def body(i, pm):
+            j = piv[..., i] - 1                            # [...] int
+            ii = jnp.broadcast_to(i, pm.shape[:-1] + (1,))
+            jj = j[..., None] if pm.ndim > 1 else j[None]
+            pi = jnp.take_along_axis(pm, ii, axis=-1)
+            pj = jnp.take_along_axis(pm, jj, axis=-1)
+            pm = jnp.put_along_axis(pm, ii, pj, axis=-1, inplace=False)
+            return jnp.put_along_axis(pm, jj, pi, axis=-1, inplace=False)
+
+        perm = jax.lax.fori_loop(0, npiv, body, perm)
+        p = jax.nn.one_hot(perm, m, dtype=lu_.dtype)
+        p = jnp.swapaxes(p, -1, -2)
+        return p, l, u
+    return apply(fn, _coerce(x), _coerce(y))
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by Q from a QR factorization held as Householder
+    reflectors (parity: python/paddle/tensor/linalg.py ormqr)."""
+    def fn(a, t, c):
+        # build Q explicitly (m x m) from reflectors, then contract —
+        # XLA-friendly (static shapes, batched matmul on the MXU). The
+        # reflector loop runs in a fori_loop with masked full-width
+        # columns so the traced graph is O(1) in reflector count.
+        m = a.shape[-2]
+        nref = t.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q0 = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+        rows = jnp.arange(m)
+
+        def body(i, q):
+            col = jnp.take_along_axis(
+                a, jnp.broadcast_to(i, a.shape[:-2] + (m, 1)),
+                axis=-1)[..., 0]                            # a[..., :, i]
+            v = jnp.where(rows == i, jnp.asarray(1, a.dtype),
+                          jnp.where(rows > i, col, 0))
+            ti = jnp.take_along_axis(
+                t, jnp.broadcast_to(i, t.shape[:-1] + (1,)),
+                axis=-1)[..., None]                         # t[..., i]
+            return q - ti * (q @ v[..., :, None]) @ v[..., None, :]
+
+        q = jax.lax.fori_loop(0, nref, body, q0)
+        if transpose:
+            q = jnp.swapaxes(q, -1, -2)
+        return q @ c if left else c @ q
+    return apply(fn, _coerce(x), _coerce(tau), _coerce(other))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (parity: python/paddle/tensor/linalg.py
+    svd_lowrank; Halko et al. subspace iteration)."""
+    from ..framework.random import next_key
+    key = next_key()
+    args = [_coerce(x)]
+    if M is not None:
+        args.append(_coerce(M))
+
+    def fn(v, *rest):
+        a = v - rest[0] if rest else v
+        m, n = a.shape[-2], a.shape[-1]
+        r = min(q, m, n)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, r), dtype=a.dtype)
+        y = a @ omega
+        qmat, _ = jnp.linalg.qr(y)
+        for _ in range(niter):
+            z = jnp.swapaxes(a, -1, -2) @ qmat
+            qz, _ = jnp.linalg.qr(z)
+            y = a @ qz
+            qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        u = qmat @ u_b
+        return u, s, jnp.swapaxes(vh, -1, -2)
+    return apply(fn, *args)

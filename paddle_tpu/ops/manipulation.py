@@ -462,3 +462,38 @@ def crop(x, shape=None, offsets=None, name=None):
     def fn(v):
         return jax.lax.dynamic_slice(v, offs, sh)
     return apply(fn, _coerce(x))
+
+
+def as_complex(x, name=None):
+    """[..., 2] real pairs -> complex (parity: python/paddle/tensor/
+    manipulation.py as_complex)."""
+    return apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+                 _coerce(x))
+
+
+def as_real(x, name=None):
+    """complex -> [..., 2] real pairs (parity: python/paddle/tensor/
+    manipulation.py as_real)."""
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                 _coerce(x))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (parity: python/paddle/tensor/
+    manipulation.py unfold — the Tensor-level op, distinct from
+    F.unfold/im2col). Output appends the window dim last."""
+    ax = int(axis)
+    sz = int(size)
+    st = int(step)
+
+    def fn(v):
+        a = ax % v.ndim
+        n = (v.shape[a] - sz) // st + 1
+        starts = jnp.arange(n) * st
+        idx = starts[:, None] + jnp.arange(sz)[None, :]        # [n, size]
+        out = jnp.take(v, idx.reshape(-1), axis=a)
+        new_shape = v.shape[:a] + (n, sz) + v.shape[a + 1:]
+        out = out.reshape(new_shape)
+        # paddle puts the window dim last
+        return jnp.moveaxis(out, a + 1, -1)
+    return apply(fn, _coerce(x))

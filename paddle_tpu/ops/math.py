@@ -449,3 +449,34 @@ def increment(x, value=1.0, name=None):
 
 def accuracy_like_ops():  # placeholder namespace guard
     raise NotImplementedError
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """Multi-dim histogram (parity: python/paddle/tensor/linalg.py
+    histogramdd). x: [N, D]."""
+    args = [_coerce(x)]
+    if weights is not None:
+        args.append(_coerce(weights))
+
+    def fn(v, *rest):
+        w = rest[0] if rest else None
+        b = bins
+        if isinstance(b, (list, tuple)):
+            b = [np.asarray(e.numpy()) if hasattr(e, "numpy") else e
+                 for e in b]
+        r = None
+        if ranges is not None:
+            rr = np.asarray(ranges, np.float64).reshape(-1, 2)
+            r = [tuple(row) for row in rr]
+        hist, edges = jnp.histogramdd(v, bins=b, range=r, weights=w,
+                                      density=density)
+        return (hist,) + tuple(edges)
+    out = apply(fn, *args)
+    return out[0], list(out[1:])
+
+
+def inverse(x, name=None):
+    """Parity: python/paddle/tensor/math.py inverse (== linalg.inv)."""
+    from .linalg import inv
+    return inv(x)

@@ -159,3 +159,23 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
         cnt = np.diff(np.append(pos, arr.shape[ax]))
         outs.append(Tensor(jnp.asarray(cnt, dtype=d)))
     return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def nanargmax(x, axis=None, keepdim=False, name=None):
+    """argmax ignoring NaNs (parity: python/paddle/tensor/search.py)."""
+    def fn(v):
+        if axis is None:
+            return jnp.nanargmax(v.reshape(-1)).astype(dtypes.int64)
+        return jnp.nanargmax(v, axis=int(axis), keepdims=keepdim
+                             ).astype(dtypes.int64)
+    return apply(fn, _coerce(x))
+
+
+def nanargmin(x, axis=None, keepdim=False, name=None):
+    """argmin ignoring NaNs (parity: python/paddle/tensor/search.py)."""
+    def fn(v):
+        if axis is None:
+            return jnp.nanargmin(v.reshape(-1)).astype(dtypes.int64)
+        return jnp.nanargmin(v, axis=int(axis), keepdims=keepdim
+                             ).astype(dtypes.int64)
+    return apply(fn, _coerce(x))

@@ -2,6 +2,7 @@
 from . import models
 from . import transforms
 from . import datasets
+from . import ops
 from .models import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, LeNet, VGG, vgg16, MobileNetV2, mobilenet_v2)
 

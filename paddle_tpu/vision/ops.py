@@ -1,0 +1,513 @@
+"""paddle.vision.ops — detection/vision operators.
+
+Reference parity: python/paddle/vision/ops.py (nms, roi_align, roi_pool,
+box_coder, deform_conv2d, psroi_pool, yolo_box, prior_box,
+distribute_fpn_proposals). TPU-native design notes:
+
+- roi_align / roi_pool / deform_conv2d / yolo_box / prior_box /
+  box_coder are static-shape gather/compute pipelines — fully jittable,
+  XLA fuses the gathers (replaces the per-op CUDA kernels in
+  paddle/phi/kernels/gpu/).
+- nms / distribute_fpn_proposals return data-dependent shapes. On TPU
+  the compiled path must be static, so the greedy suppression mask is
+  computed with a fixed-trip-count lax loop (jittable); the final
+  index extraction happens eagerly (matches how the reference's
+  dynamic-shape ops are host-synchronizing on GPU too).
+"""
+from __future__ import annotations
+
+import math as pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+from ..tensor import Tensor
+
+__all__ = [
+    "nms", "roi_align", "roi_pool", "psroi_pool", "box_coder",
+    "deform_conv2d", "yolo_box", "prior_box", "distribute_fpn_proposals",
+]
+
+
+def _iou_matrix(boxes):
+    """Pairwise IoU of [N, 4] x1y1x2y2 boxes."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_keep_mask(boxes, scores, iou_threshold):
+    """Greedy NMS as a fixed-trip-count suppression loop — jittable."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou_matrix(b)
+
+    def body(i, keep):
+        # suppress j>i iff kept(i) and iou(i, j) > thr
+        sup = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # scatter back to original order
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Parity: python/paddle/vision/ops.py nms. Returns kept indices
+    (descending score order when scores given). Output length is
+    data-dependent, so the index extraction is eager; the O(N^2)
+    suppression itself is compiled."""
+    boxes_t = _coerce(boxes)
+    bj = jnp.asarray(boxes_t._value)
+    n = bj.shape[0]
+    sj = (jnp.asarray(_coerce(scores)._value) if scores is not None
+          else jnp.zeros((n,), bj.dtype))
+    if category_idxs is not None:
+        cat = jnp.asarray(_coerce(category_idxs)._value)
+        # category-aware: offset boxes per category so cross-category
+        # pairs never overlap (standard batched-NMS trick)
+        span = jnp.max(bj) - jnp.min(bj) + 1.0
+        off = cat.astype(bj.dtype)[:, None] * span
+        keep = _nms_keep_mask(bj + off, sj, iou_threshold)
+    else:
+        keep = _nms_keep_mask(bj, sj, iou_threshold)
+    idx = np.nonzero(np.asarray(keep))[0]
+    s_np = np.asarray(sj)
+    idx = idx[np.argsort(-s_np[idx], kind="stable")]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return Tensor(jnp.asarray(idx, jnp.int64))
+
+
+from ..ops._sampling import (bilinear_zeros as _roi_bilinear,
+                             bilinear_clamped as _roi_bilinear_clamped)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Parity: python/paddle/vision/ops.py roi_align (upstream phi
+    roi_align kernel). Static shapes: [num_rois, C, ph, pw].
+
+    sampling_ratio<=0 (adaptive): the reference picks
+    ceil(roi_size/pooled_size) per roi; XLA needs one static grid, so
+    the batch max is used (denser-but-uniform sampling — identical for
+    equal-size rois, slightly denser than the reference for smaller
+    ones); under a trace the grid is fixed at 2x2."""
+    ph, pw = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    if sampling_ratio > 0:
+        sr = int(sampling_ratio)
+    else:
+        # reference adaptive mode: ceil(roi_size / pooled_size) per roi.
+        # Shapes must be static under XLA, so take the max over the batch
+        # when roi values are concrete (eager — the reference's dynamic
+        # kernel host-syncs here too); under a trace fall back to 2.
+        sr = 2
+        rv = getattr(_coerce(boxes), "_value", None)
+        if rv is not None and not isinstance(rv, jax.core.Tracer):
+            rn = np.asarray(rv)
+            if rn.size:
+                hs = (rn[:, 3] - rn[:, 1]) * spatial_scale / ph
+                ws = (rn[:, 2] - rn[:, 0]) * spatial_scale / pw
+                sr = max(1, int(np.ceil(max(hs.max(), ws.max()))))
+
+    def fn(v, rois, rois_num):
+        n, c, h, w = v.shape
+        # map each roi to its batch image
+        counts = rois_num.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                             total_repeat_length=rois.shape[0])
+        offset = 0.5 if aligned else 0.0
+        rx1 = rois[:, 0] * spatial_scale - offset
+        ry1 = rois[:, 1] * spatial_scale - offset
+        rx2 = rois[:, 2] * spatial_scale - offset
+        ry2 = rois[:, 3] * spatial_scale - offset
+        rw = rx2 - rx1
+        rh = ry2 - ry1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sampling grid: sr x sr points per bin
+        gy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr
+              ).reshape(-1)                                # [ph*sr]
+        gx = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr
+              ).reshape(-1)                                # [pw*sr]
+
+        def one_roi(ri):
+            ys = ry1[ri] + gy * bin_h[ri]                  # [ph*sr]
+            xs = rx1[ri] + gx * bin_w[ri]                  # [pw*sr]
+            yy = jnp.repeat(ys, pw * sr)
+            xx = jnp.tile(xs, ph * sr)
+            samp = _roi_bilinear_clamped(v[img_idx[ri]], yy, xx)  # [C, ...]
+            samp = samp.reshape(c, ph, sr, pw, sr)
+            return samp.mean(axis=(2, 4))                  # [C, ph, pw]
+
+        return jax.vmap(one_roi)(jnp.arange(rois.shape[0]))
+    return apply(fn, _coerce(x), _coerce(boxes), _coerce(boxes_num))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Parity: python/paddle/vision/ops.py roi_pool (max pooling within
+    quantized roi bins; upstream phi roi_pool kernel)."""
+    ph, pw = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+
+    def fn(v, rois, rois_num):
+        n, c, h, w = v.shape
+        counts = rois_num.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                             total_repeat_length=rois.shape[0])
+        rx1 = jnp.round(rois[:, 0] * spatial_scale).astype(jnp.int32)
+        ry1 = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+        rx2 = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
+        ry2 = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(rx2 - rx1 + 1, 1)
+        rh = jnp.maximum(ry2 - ry1 + 1, 1)
+
+        ii = jnp.arange(h)
+        jj = jnp.arange(w)
+
+        def one_roi(ri):
+            fm = v[img_idx[ri]]                            # [C, H, W]
+            # bin (i, j) covers rows [ry1 + floor(i*rh/ph),
+            # ry1 + ceil((i+1)*rh/ph)) — overlapping boundary pixels
+            # belong to BOTH bins (reference roi_pool semantics)
+            bi = jnp.arange(ph)
+            bj = jnp.arange(pw)
+            ys = ry1[ri] + jnp.floor(bi * rh[ri] / ph).astype(jnp.int32)
+            ye = ry1[ri] + jnp.ceil((bi + 1) * rh[ri] / ph).astype(jnp.int32)
+            xs = rx1[ri] + jnp.floor(bj * rw[ri] / pw).astype(jnp.int32)
+            xe = rx1[ri] + jnp.ceil((bj + 1) * rw[ri] / pw).astype(jnp.int32)
+            ymask = ((ii[None, :] >= ys[:, None])
+                     & (ii[None, :] < ye[:, None])
+                     & (ii[None, :] >= 0))                  # [ph, H]
+            xmask = ((jj[None, :] >= xs[:, None])
+                     & (jj[None, :] < xe[:, None])
+                     & (jj[None, :] >= 0))                  # [pw, W]
+            m = ymask[:, None, :, None] & xmask[None, :, None, :]
+            neg = jnp.finfo(v.dtype).min
+            masked = jnp.where(m[None], fm[:, None, None, :, :], neg)
+            pooled = jnp.max(masked, axis=(3, 4))          # [C, ph, pw]
+            any_px = jnp.any(m, axis=(2, 3))
+            return jnp.where(any_px[None], pooled, 0.0)
+
+        return jax.vmap(one_roi)(jnp.arange(rois.shape[0]))
+    return apply(fn, _coerce(x), _coerce(boxes), _coerce(boxes_num))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (parity: python/paddle/vision/ops.py
+    psroi_pool): channel block (i,j) feeds output bin (i,j), average
+    pooled."""
+    ph, pw = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+
+    def fn(v, rois, rois_num):
+        n, c, h, w = v.shape
+        co = c // (ph * pw)
+        counts = rois_num.astype(jnp.int32)
+        img_idx = jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                             total_repeat_length=rois.shape[0])
+        rx1 = rois[:, 0] * spatial_scale
+        ry1 = rois[:, 1] * spatial_scale
+        rw = jnp.maximum(rois[:, 2] - rois[:, 0], 0.1) * spatial_scale
+        rh = jnp.maximum(rois[:, 3] - rois[:, 1], 0.1) * spatial_scale
+        bh = rh / ph
+        bw = rw / pw
+        ii = jnp.arange(h)
+        jj = jnp.arange(w)
+
+        def one_roi(ri):
+            fm = v[img_idx[ri]].reshape(co, ph, pw, h, w)
+            ys = ry1[ri] + jnp.arange(ph) * bh[ri]
+            ye = ys + bh[ri]
+            xs = rx1[ri] + jnp.arange(pw) * bw[ri]
+            xe = xs + bw[ri]
+            ymask = ((ii[None, :] >= jnp.floor(ys)[:, None])
+                     & (ii[None, :] < jnp.ceil(ye)[:, None]))  # [ph, H]
+            xmask = ((jj[None, :] >= jnp.floor(xs)[:, None])
+                     & (jj[None, :] < jnp.ceil(xe)[:, None]))  # [pw, W]
+            m = (ymask[:, None, :, None] & xmask[None, :, None, :]
+                 ).astype(fm.dtype)                        # [ph, pw, H, W]
+            tot = jnp.einsum("cpqhw,pqhw->cpq", fm, m)
+            cnt = jnp.maximum(jnp.sum(m, axis=(2, 3)), 1.0)
+            return tot / cnt[None]
+        return jax.vmap(one_roi)(jnp.arange(rois.shape[0]))
+    return apply(fn, _coerce(x), _coerce(boxes), _coerce(boxes_num))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Parity: python/paddle/vision/ops.py box_coder (upstream phi
+    box_coder kernel)."""
+    args = [_coerce(prior_box)]
+    var_is_tensor = not isinstance(prior_box_var, (list, tuple, float,
+                                                   type(None)))
+    if var_is_tensor:
+        args.append(_coerce(prior_box_var))
+    args.append(_coerce(target_box))
+
+    def fn(pb, *rest):
+        if var_is_tensor:
+            pbv, tb = rest
+        else:
+            tb = rest[0]
+            pbv = (jnp.asarray(prior_box_var, tb.dtype)
+                   if prior_box_var is not None else None)
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph_ = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph_ * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph_[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph_[None, :])
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)  # [T, P, 4]
+            if pbv is not None:
+                out = out / (pbv if pbv.ndim == 1 else pbv[None, :, :])
+            return out
+        # decode_center_size: tb is [T, P, 4] deltas (or broadcastable)
+        if axis == 1:
+            pw, ph_, pcx, pcy = (a[:, None] for a in (pw, ph_, pcx, pcy))
+        else:
+            pw, ph_, pcx, pcy = (a[None, :] for a in (pw, ph_, pcx, pcy))
+        d = tb
+        if pbv is not None:
+            d = d * (pbv if pbv.ndim == 1 else
+                     (pbv[None, :, :] if axis == 0 else pbv[:, None, :]))
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * ph_ + pcy
+        ow = jnp.exp(d[..., 2]) * pw
+        oh2 = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([ocx - ow * 0.5, ocy - oh2 * 0.5,
+                          ocx + ow * 0.5 - norm,
+                          ocy + oh2 * 0.5 - norm], axis=-1)
+    return apply(fn, *args)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (parity: python/paddle/vision/ops.py
+    deform_conv2d; upstream phi deformable_conv kernel). Gather-based:
+    build the deformed im2col volume with bilinear sampling, then one
+    big matmul — the MXU-friendly formulation."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph_, pw_ = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    args = [_coerce(x), _coerce(offset), _coerce(weight)]
+    if bias is not None:
+        args.append(_coerce(bias))
+    if mask is not None:
+        args.append(_coerce(mask))
+
+    def fn(v, off, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        mk = rest.pop(0) if mask is not None else None
+        n, c, h, wd = v.shape
+        co, cig, kh, kw = w.shape
+        ho = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+        wo = (wd + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+        dg = deformable_groups
+        # base sampling positions [kh, kw, ho, wo]
+        by = (jnp.arange(ho)[None, None, :, None] * sh - ph_
+              + jnp.arange(kh)[:, None, None, None] * dh)
+        bx = (jnp.arange(wo)[None, None, None, :] * sw - pw_
+              + jnp.arange(kw)[None, :, None, None] * dw)
+        off = off.reshape(n, dg, kh, kw, 2, ho, wo)
+        oy = off[:, :, :, :, 0]
+        ox = off[:, :, :, :, 1]
+        ys = by[None, None] + oy    # [N, dg, kh, kw, ho, wo]
+        xs = bx[None, None] + ox
+
+        def sample_img(img, ys2, xs2):
+            # img [C/dg? no: full C split below], coords [kh,kw,ho,wo]
+            return _roi_bilinear(img, ys2.reshape(-1), xs2.reshape(-1))
+
+        cg = c // dg
+
+        def one_n(vi, ysi, xsi, mki):
+            # vi [C,H,W]; ysi/xsi [dg,kh,kw,ho,wo]
+            cols = []
+            for g in range(dg):
+                img = vi[g * cg:(g + 1) * cg]
+                s = sample_img(img, ysi[g], xsi[g])  # [cg, kh*kw*ho*wo]
+                s = s.reshape(cg, kh, kw, ho, wo)
+                if mki is not None:
+                    s = s * mki[g][None]
+                cols.append(s)
+            return jnp.concatenate(cols, axis=0)     # [C, kh, kw, ho, wo]
+
+        if mk is not None:
+            mk_r = mk.reshape(n, dg, kh, kw, ho, wo)
+            cols = jax.vmap(one_n)(v, ys, xs, mk_r)
+        else:
+            cols = jax.vmap(lambda vi, ysi, xsi: one_n(vi, ysi, xsi, None)
+                            )(v, ys, xs)
+        # grouped conv as one big contraction: out[n,co,ho,wo]
+        wr = w.reshape(groups, co // groups, cig, kh, kw)
+        out = jnp.einsum(
+            "ngcijhw,gocij->ngohw",
+            cols.reshape(n, groups, c // groups, kh, kw, ho, wo), wr)
+        out = out.reshape(n, co, ho, wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+    return apply(fn, *args)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output to boxes+scores (parity:
+    python/paddle/vision/ops.py yolo_box; upstream phi yolo_box kernel)."""
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def fn(v, imgs):
+        n, c, h, w = v.shape
+        if iou_aware:
+            ioup = jax.nn.sigmoid(v[:, :na].reshape(n, na, 1, h, w))
+            v = v[:, na:]
+        v = v.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        bx = ((jax.nn.sigmoid(v[:, :, 0]) * scale_x_y
+               - (scale_x_y - 1) / 2) + gx[None, None, None, :]) / w
+        by = ((jax.nn.sigmoid(v[:, :, 1]) * scale_x_y
+               - (scale_x_y - 1) / 2) + gy[None, None, :, None]) / h
+        aw = jnp.asarray(anc[:, 0])[None, :, None, None]
+        ah = jnp.asarray(anc[:, 1])[None, :, None, None]
+        bw = jnp.exp(v[:, :, 2]) * aw / (w * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * ah / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(v[:, :, 4:5])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+        probs = jax.nn.sigmoid(v[:, :, 5:]) * conf
+        keep = (conf > conf_thresh).astype(v.dtype)
+        imgh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imgw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imgw
+        y1 = (by - bh / 2) * imgh
+        x2 = (bx + bw / 2) * imgw
+        y2 = (by + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [n, na, h, w, 4]
+        boxes = boxes * keep[:, :, 0, :, :, None]
+        boxes = boxes.reshape(n, -1, 4)
+        scores = (probs * keep).transpose(0, 1, 3, 4, 2).reshape(
+            n, -1, class_num)
+        return boxes, scores
+    return apply(fn, _coerce(x), _coerce(img_size))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (parity: python/paddle/vision/ops.py prior_box)."""
+    def fn(v, img):
+        h, w = v.shape[2], v.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        sh = steps[1] if steps[1] > 0 else ih / h
+        sw = steps[0] if steps[0] > 0 else iw / w
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if not any(abs(ar - e) < 1e-6 for e in ars):
+                ars.append(float(ar))
+                if flip:
+                    ars.append(1.0 / float(ar))
+        boxes = []
+        for ms in min_sizes:
+            if min_max_aspect_ratios_order:
+                boxes.append((float(ms), float(ms)))
+                if max_sizes:
+                    mx = max_sizes[min_sizes.index(ms)]
+                    s = pymath.sqrt(ms * mx)
+                    boxes.append((s, s))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    boxes.append((ms * pymath.sqrt(ar), ms / pymath.sqrt(ar)))
+            else:
+                for ar in ars:
+                    boxes.append((ms * pymath.sqrt(ar), ms / pymath.sqrt(ar)))
+                if max_sizes:
+                    mx = max_sizes[min_sizes.index(ms)]
+                    s = pymath.sqrt(ms * mx)
+                    boxes.append((s, s))
+        bw = jnp.asarray([b[0] for b in boxes], jnp.float32) / iw
+        bh = jnp.asarray([b[1] for b in boxes], jnp.float32) / ih
+        cx = (jnp.arange(w) + offset) * sw / iw
+        cy = (jnp.arange(h) + offset) * sh / ih
+        gcx = jnp.broadcast_to(cx[None, :, None], (h, w, len(boxes)))
+        gcy = jnp.broadcast_to(cy[:, None, None], (h, w, len(boxes)))
+        out = jnp.stack([gcx - bw / 2, gcy - bh / 2,
+                         gcx + bw / 2, gcy + bh / 2], axis=-1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               out.shape)
+        return out, var
+    return apply(fn, _coerce(input), _coerce(image))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels (parity: python/paddle/vision/ops.py
+    distribute_fpn_proposals). Output shapes are data-dependent → eager
+    index extraction, like the reference's host-synchronizing op."""
+    rois_t = _coerce(fpn_rois)
+    rois = np.asarray(rois_t._value)
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, restore = [], np.empty((rois.shape[0],), np.int64)
+    order = []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        outs.append(Tensor(jnp.asarray(rois[idx])))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.empty((0,), np.int64)
+    restore[order] = np.arange(order.shape[0])
+    rois_num_per = None
+    if rois_num is not None:
+        num = np.asarray(_coerce(rois_num)._value)
+        batch_of = np.repeat(np.arange(num.shape[0]), num)
+        rois_num_per = [
+            Tensor(jnp.asarray(np.bincount(
+                batch_of[lvl == level], minlength=num.shape[0]
+            ).astype(np.int32)))
+            for level in range(min_level, max_level + 1)]
+    return outs, Tensor(jnp.asarray(restore[:, None])), rois_num_per

@@ -1,0 +1,513 @@
+"""Tests for the second-tier functional surface (grid_sample, fold,
+unpool, loss long tail, detection ops). Goldens: torch-cpu where the
+API matches (the reference's own op tests are numpy/torch-golden based,
+test/legacy_test pattern), numpy otherwise."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(3)
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+class TestSpatial:
+    def test_affine_grid_and_grid_sample_bilinear(self):
+        theta = rng.randn(2, 2, 3).astype("float32") * 0.1
+        theta[:, 0, 0] += 1.0
+        theta[:, 1, 1] += 1.0
+        x = rng.randn(2, 3, 8, 9).astype("float32")
+        for align in (True, False):
+            grid = F.affine_grid(t(theta), [2, 3, 8, 9],
+                                 align_corners=align)
+            ref_grid = tF.affine_grid(torch.tensor(theta), (2, 3, 8, 9),
+                                      align_corners=align)
+            np.testing.assert_allclose(grid.numpy(), ref_grid.numpy(),
+                                       atol=1e-5)
+            out = F.grid_sample(t(x), grid, align_corners=align)
+            ref = tF.grid_sample(torch.tensor(x), ref_grid,
+                                 align_corners=align)
+            np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("mode,pad", [("nearest", "zeros"),
+                                          ("bilinear", "border"),
+                                          ("bilinear", "reflection")])
+    def test_grid_sample_modes(self, mode, pad):
+        x = rng.randn(1, 2, 6, 7).astype("float32")
+        grid = (rng.rand(1, 4, 5, 2).astype("float32") * 2.4 - 1.2)
+        out = F.grid_sample(t(x), t(grid), mode=mode, padding_mode=pad,
+                            align_corners=True)
+        ref = tF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                             padding_mode=pad, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fold_inverts_unfold(self):
+        x = rng.randn(2, 3, 10, 8).astype("float32")
+        cols = F.unfold(t(x), [3, 3], strides=1, paddings=1)
+        ref_cols = tF.unfold(torch.tensor(x), (3, 3), padding=1)
+        np.testing.assert_allclose(cols.numpy(), ref_cols.numpy(),
+                                   atol=1e-5)
+        folded = F.fold(cols, [10, 8], [3, 3], strides=1, paddings=1)
+        ref_fold = tF.fold(ref_cols, (10, 8), (3, 3), padding=1)
+        np.testing.assert_allclose(folded.numpy(), ref_fold.numpy(),
+                                   atol=1e-5)
+
+    def test_max_unpool2d(self):
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        pooled, idx = F.max_pool2d(t(x), 2, stride=2, return_mask=True)
+        out = F.max_unpool2d(pooled, idx, 2, stride=2)
+        tp, ti = tF.max_pool2d(torch.tensor(x), 2, stride=2,
+                               return_indices=True)
+        ref = tF.max_unpool2d(tp, ti, 2, stride=2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_channel_shuffle(self):
+        x = rng.randn(2, 6, 4, 4).astype("float32")
+        out = F.channel_shuffle(t(x), 3)
+        ref = torch.channel_shuffle(torch.tensor(x), 3)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=0)
+
+    def test_zeropad2d_and_layerwrappers(self):
+        x = rng.randn(1, 2, 3, 3).astype("float32")
+        out = F.zeropad2d(t(x), [1, 2, 3, 4])
+        assert out.shape == [1, 2, 10, 6]
+        assert np.allclose(out.numpy()[:, :, 3:6, 1:4], x)
+        m = nn.Unflatten(1, [1, 2])
+        assert m(t(x)).shape == [1, 1, 2, 3, 3]
+        pd = nn.PairwiseDistance()
+        a = rng.randn(4, 5).astype("float32")
+        b = rng.randn(4, 5).astype("float32")
+        ref = tF.pairwise_distance(torch.tensor(a), torch.tensor(b))
+        np.testing.assert_allclose(pd(t(a), t(b)).numpy(), ref.numpy(),
+                                   atol=1e-5)
+
+    def test_gather_tree(self):
+        ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                        [[0, 1], [9, 0]]], dtype=np.int64)
+        parents = np.array([[[0, 0], [1, 1]], [[1, 0], [0, 0]],
+                            [[0, 0], [0, 1]]], dtype=np.int64)
+        out = F.gather_tree(t(ids), t(parents))
+
+        # numpy reference: the phi gather_tree recurrence (walk parent
+        # pointers from the last step backwards)
+        T, B, K = ids.shape
+        expect = np.empty_like(ids)
+        for b in range(B):
+            for k in range(K):
+                expect[T - 1, b, k] = ids[T - 1, b, k]
+                par = parents[T - 1, b, k]
+                for st in range(T - 2, -1, -1):
+                    expect[st, b, k] = ids[st, b, par]
+                    par = parents[st, b, par]
+        np.testing.assert_array_equal(out.numpy(), expect)
+
+
+class TestLossTail:
+    def test_soft_margin(self):
+        x = rng.randn(4, 5).astype("float32")
+        y = np.sign(rng.randn(4, 5)).astype("float32")
+        out = F.soft_margin_loss(t(x), t(y))
+        ref = tF.soft_margin_loss(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_multi_label_soft_margin(self):
+        x = rng.randn(4, 5).astype("float32")
+        y = (rng.rand(4, 5) > 0.5).astype("float32")
+        out = F.multi_label_soft_margin_loss(t(x), t(y))
+        ref = tF.multilabel_soft_margin_loss(torch.tensor(x),
+                                             torch.tensor(y))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_multi_margin(self):
+        x = rng.randn(6, 4).astype("float32")
+        y = rng.randint(0, 4, (6,)).astype("int64")
+        out = F.multi_margin_loss(t(x), t(y))
+        ref = tF.multi_margin_loss(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_poisson_gaussian_nll(self):
+        x = rng.rand(4, 3).astype("float32") + 0.1
+        y = rng.rand(4, 3).astype("float32")
+        v = rng.rand(4, 3).astype("float32") + 0.1
+        out = F.poisson_nll_loss(t(x), t(y))
+        ref = tF.poisson_nll_loss(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        out = F.gaussian_nll_loss(t(x), t(y), t(v))
+        ref = tF.gaussian_nll_loss(torch.tensor(x), torch.tensor(y),
+                                   torch.tensor(v))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4)
+
+    def test_triplet_with_distance(self):
+        a, p, n = (rng.randn(5, 8).astype("float32") for _ in range(3))
+        out = F.triplet_margin_with_distance_loss(t(a), t(p), t(n),
+                                                  swap=True)
+        ref = tF.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n), swap=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_sigmoid_focal_loss(self):
+        x = rng.randn(4, 3).astype("float32")
+        y = (rng.rand(4, 3) > 0.7).astype("float32")
+        out = F.sigmoid_focal_loss(t(x), t(y), reduction="mean")
+        p = torch.sigmoid(torch.tensor(x))
+        ce = tF.binary_cross_entropy_with_logits(
+            torch.tensor(x), torch.tensor(y), reduction="none")
+        pt = p * torch.tensor(y) + (1 - p) * (1 - torch.tensor(y))
+        ref = (ce * (0.25 * torch.tensor(y) + 0.75 * (1 - torch.tensor(y)))
+               * (1 - pt) ** 2).mean()
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_dice_log_npair(self):
+        pred = rng.rand(3, 4, 5).astype("float32")
+        lab = rng.randint(0, 5, (3, 4, 1)).astype("int64")
+        d = F.dice_loss(t(pred), t(lab))
+        assert 0.0 <= float(d.numpy()) <= 1.0
+        p = rng.rand(4, 1).astype("float32") * 0.8 + 0.1
+        y = (rng.rand(4, 1) > 0.5).astype("float32")
+        ll = F.log_loss(t(p), t(y))
+        ref = -(y * np.log(p + 1e-4) + (1 - y) * np.log(1 - p + 1e-4))
+        np.testing.assert_allclose(ll.numpy(), ref, rtol=1e-5)
+        anc = rng.randn(4, 6).astype("float32")
+        pos = rng.randn(4, 6).astype("float32")
+        labs = np.array([0, 1, 0, 2]).astype("int64")
+        out = F.npair_loss(t(anc), t(pos), t(labs))
+        assert np.isfinite(out.numpy()).all()
+
+    def test_ctc_loss(self):
+        T, B, C, L = 12, 3, 6, 4
+        logits = rng.randn(T, B, C).astype("float32")
+        lp = torch.tensor(logits).log_softmax(-1)
+        labels = rng.randint(1, C, (B, L)).astype("int64")
+        in_len = np.array([12, 10, 7], dtype=np.int64)
+        lab_len = np.array([4, 3, 2], dtype=np.int64)
+        ref = tF.ctc_loss(lp, torch.tensor(labels),
+                          torch.tensor(in_len), torch.tensor(lab_len),
+                          blank=0, reduction="none")
+        out = F.ctc_loss(t(np.asarray(lp.numpy())), t(labels), t(in_len),
+                         t(lab_len), blank=0, reduction="none")
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+        # layer + mean reduction parity (paddle mean = loss/label_len avg)
+        layer = nn.CTCLoss(blank=0, reduction="mean")
+        out_m = layer(t(np.asarray(lp.numpy())), t(labels), t(in_len),
+                      t(lab_len))
+        ref_m = (ref / torch.tensor(lab_len).float()).mean()
+        np.testing.assert_allclose(out_m.numpy(), ref_m.numpy(), rtol=1e-4)
+
+    def test_ctc_loss_grad(self):
+        T, B, C, L = 8, 2, 5, 3
+        logits = rng.randn(T, B, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int64")
+        in_len = np.array([8, 6], dtype=np.int64)
+        lab_len = np.array([3, 2], dtype=np.int64)
+        x = t(logits)
+        x.stop_gradient = False
+        lp = F.log_softmax(x, axis=-1)
+        loss = F.ctc_loss(lp, t(labels), t(in_len), t(lab_len))
+        loss.backward()
+        g = x.grad.numpy()
+        xt = torch.tensor(logits, requires_grad=True)
+        ref = tF.ctc_loss(xt.log_softmax(-1), torch.tensor(labels),
+                          torch.tensor(in_len), torch.tensor(lab_len),
+                          blank=0, reduction="mean")
+        ref.backward()
+        np.testing.assert_allclose(g, xt.grad.numpy(), atol=1e-4)
+
+    def test_hsigmoid_margin_ce(self):
+        x = rng.randn(4, 8).astype("float32")
+        lab = rng.randint(0, 10, (4,)).astype("int64")
+        # paddle-parity weight shape: [num_classes - 1, D]
+        w = rng.randn(9, 8).astype("float32") * 0.1
+        out = F.hsigmoid_loss(t(x), t(lab), 10, t(w))
+        assert np.isfinite(out.numpy()).all()
+        # margin_cross_entropy degenerates to scaled CE at zero margins
+        cos = np.clip(rng.rand(4, 6).astype("float32"), 0.1, 0.9)
+        out = F.margin_cross_entropy(t(cos), t(lab[:1 * 4] % 6),
+                                     margin1=1.0, margin2=0.0, margin3=0.0,
+                                     scale=10.0)
+        ref = tF.cross_entropy(torch.tensor(cos * 10.0),
+                               torch.tensor(lab % 6))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4)
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or sup[j]:
+                continue
+            ix1 = max(boxes[i, 0], boxes[j, 0])
+            iy1 = max(boxes[i, 1], boxes[j, 1])
+            ix2 = min(boxes[i, 2], boxes[j, 2])
+            iy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ai = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            aj = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (ai + aj - inter) > thr:
+                sup[j] = True
+    return np.array(keep)
+
+
+def _np_roi_align(x, boxes, img_idx, out, scale, sr, aligned):
+    n_roi = boxes.shape[0]
+    c = x.shape[1]
+    res = np.zeros((n_roi, c, out, out), np.float32)
+    h, w = x.shape[2], x.shape[3]
+
+    def bil(fm, y, xx):
+        if y < -1 or y > h or xx < -1 or xx > w:
+            return np.zeros(c, np.float32)
+        y = min(max(y, 0), h - 1)
+        xx = min(max(xx, 0), w - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+        wy, wx = y - y0, xx - x0
+        return (fm[:, y0, x0] * (1 - wy) * (1 - wx)
+                + fm[:, y0, x1] * (1 - wy) * wx
+                + fm[:, y1, x0] * wy * (1 - wx)
+                + fm[:, y1, x1] * wy * wx)
+
+    off = 0.5 if aligned else 0.0
+    for r in range(n_roi):
+        fm = x[img_idx[r]]
+        x1, y1, x2, y2 = boxes[r] * scale
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bw, bh = rw / out, rh / out
+        for i in range(out):
+            for j in range(out):
+                acc = np.zeros(c, np.float32)
+                for si in range(sr):
+                    for sj in range(sr):
+                        yy = y1 + (i + (si + 0.5) / sr) * bh
+                        xx = x1 + (j + (sj + 0.5) / sr) * bw
+                        acc += bil(fm, yy, xx)
+                res[r, :, i, j] = acc / (sr * sr)
+    return res
+
+
+class TestVisionOps:
+    def test_nms(self):
+        from paddle_tpu.vision import ops as V
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                          [0, 0, 9, 9]], dtype=np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.95], dtype=np.float32)
+        keep = V.nms(t(boxes), 0.5, scores=t(scores))
+        ref = _np_nms(boxes, scores, 0.5)
+        np.testing.assert_array_equal(np.sort(keep.numpy()), np.sort(ref))
+
+    def test_roi_align(self):
+        from paddle_tpu.vision import ops as V
+        x = rng.randn(2, 3, 16, 16).astype("float32")
+        boxes = np.array([[1.0, 1.0, 9.0, 9.0], [2.0, 3.0, 12.0, 14.0],
+                          [0.0, 0.0, 15.0, 15.0]], dtype=np.float32)
+        boxes_num = np.array([2, 1], dtype=np.int32)
+        out = V.roi_align(t(x), t(boxes), t(boxes_num), 4,
+                          spatial_scale=0.5, sampling_ratio=2,
+                          aligned=True)
+        ref = _np_roi_align(x, boxes, [0, 0, 1], 4, 0.5, 2, True)
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+    def test_roi_pool(self):
+        from paddle_tpu.vision import ops as V
+        x = rng.randn(1, 2, 12, 12).astype("float32")
+        boxes = np.array([[0.0, 0.0, 8.0, 8.0], [2.0, 2.0, 10.0, 11.0]],
+                         dtype=np.float32)
+        boxes_num = np.array([2], dtype=np.int32)
+        out = V.roi_pool(t(x), t(boxes), t(boxes_num), 2)
+        # numpy reference: quantized bins, max within each
+        ref = np.zeros((2, 2, 2, 2), np.float32)
+        for r, (bx1, by1, bx2, by2) in enumerate(boxes.astype(int)):
+            rh, rw = by2 - by1 + 1, bx2 - bx1 + 1
+            for i in range(2):
+                for j in range(2):
+                    ys = by1 + int(np.floor(i * rh / 2))
+                    ye = by1 + int(np.ceil((i + 1) * rh / 2))
+                    xs = bx1 + int(np.floor(j * rw / 2))
+                    xe = bx1 + int(np.ceil((j + 1) * rw / 2))
+                    ref[r, :, i, j] = x[0, :, ys:ye, xs:xe].max((1, 2))
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+    def test_box_coder_roundtrip(self):
+        from paddle_tpu.vision import ops as V
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        var = [0.1, 0.1, 0.2, 0.2]
+        targets = np.array([[1, 1, 12, 12], [4, 6, 22, 24]], np.float32)
+        enc = V.box_coder(t(priors), var, t(targets),
+                          code_type="encode_center_size")
+        dec = V.box_coder(t(priors), var, enc,
+                          code_type="decode_center_size")
+        got = dec.numpy()[np.arange(2), np.arange(2)]
+        np.testing.assert_allclose(got, targets, atol=1e-3)
+
+    def test_deform_conv2d_zero_offset_equals_conv(self):
+        from paddle_tpu.vision import ops as V
+        x = rng.randn(1, 4, 8, 8).astype("float32")
+        w = rng.randn(6, 4, 3, 3).astype("float32") * 0.2
+        off = np.zeros((1, 18, 8, 8), np.float32)
+        out = V.deform_conv2d(t(x), t(off), t(w), padding=1)
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-3)
+
+    def test_prior_box_yolo_box_shapes(self):
+        from paddle_tpu.vision import ops as V
+        feat = t(rng.randn(1, 8, 4, 4).astype("float32"))
+        img = t(rng.randn(1, 3, 32, 32).astype("float32"))
+        boxes, var = V.prior_box(feat, img, min_sizes=[8.0],
+                                 aspect_ratios=[1.0, 2.0], flip=True)
+        assert boxes.shape[-1] == 4 and var.shape == boxes.shape
+        yx_np = rng.randn(1, 3 * 7, 4, 4).astype("float32")
+        yx = t(yx_np)
+        sizes = t(np.array([[32, 32]], np.int64))
+        anchors = [10, 13, 16, 30, 33, 23]
+        b, s = V.yolo_box(yx, sizes, anchors, 2, 0.01, 8, clip_bbox=False)
+        assert b.shape == [1, 48, 4] and s.shape == [1, 48, 2]
+        # numeric check of one cell (anchor 0, cell (1, 2)) vs the YOLOv3
+        # decode equations
+        v = yx_np.reshape(1, 3, 7, 4, 4)
+        sig = lambda z: 1 / (1 + np.exp(-z))
+        bx = (sig(v[0, 0, 0, 1, 2]) + 2) / 4 * 32
+        by = (sig(v[0, 0, 1, 1, 2]) + 1) / 4 * 32
+        bw = np.exp(v[0, 0, 2, 1, 2]) * anchors[0] / (4 * 8) * 32
+        bh = np.exp(v[0, 0, 3, 1, 2]) * anchors[1] / (4 * 8) * 32
+        conf = sig(v[0, 0, 4, 1, 2])
+        flat = 1 * 4 + 2  # row-major cell index within the anchor-0 block
+        got = b.numpy()[0, flat]
+        if conf > 0.01:
+            np.testing.assert_allclose(
+                got, [bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2],
+                rtol=1e-4)
+        else:
+            np.testing.assert_allclose(got, np.zeros(4), atol=0)
+
+    def test_distribute_fpn_proposals(self):
+        from paddle_tpu.vision import ops as V
+        rois = np.array([[0, 0, 10, 10], [0, 0, 60, 60], [0, 0, 200, 200],
+                         [0, 0, 500, 500]], np.float32)
+        outs, restore, _ = V.distribute_fpn_proposals(t(rois), 2, 5, 4, 224)
+        total = sum(o.shape[0] for o in outs)
+        assert total == 4
+        assert sorted(restore.numpy().ravel().tolist()) == [0, 1, 2, 3]
+
+
+class TestNewTensorOps:
+    def test_as_complex_real(self):
+        x = rng.randn(3, 4, 2).astype("float32")
+        c = paddle.as_complex(t(x))
+        assert c.numpy().dtype == np.complex64
+        back = paddle.as_real(c)
+        np.testing.assert_allclose(back.numpy(), x, atol=0)
+
+    def test_unfold_tensor(self):
+        x = rng.randn(2, 12).astype("float32")
+        out = paddle.unfold(t(x), 1, 4, 2)
+        ref = torch.tensor(x).unfold(1, 4, 2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=0)
+
+    def test_nanarg(self):
+        x = np.array([[1.0, np.nan, 3.0], [np.nan, 2.0, 1.0]], np.float32)
+        np.testing.assert_array_equal(
+            paddle.nanargmax(t(x), axis=1).numpy(), [2, 1])
+        np.testing.assert_array_equal(
+            paddle.nanargmin(t(x), axis=1).numpy(), [0, 2])
+
+    def test_histogramdd(self):
+        x = rng.randn(50, 2).astype("float32")
+        hist, edges = paddle.histogramdd(t(x), bins=5)
+        ref_h, ref_e = np.histogramdd(x, bins=5)
+        np.testing.assert_allclose(hist.numpy(), ref_h, atol=0)
+        assert len(edges) == 2
+
+    def test_inverse_and_linalg_extras(self):
+        a = rng.randn(4, 4).astype("float32") + 4 * np.eye(4, dtype="f4")
+        inv = paddle.inverse(t(a))
+        np.testing.assert_allclose(inv.numpy() @ a, np.eye(4), atol=1e-4)
+        lu_t, piv = paddle.linalg.lu(t(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a,
+                                   atol=1e-4)
+        c = paddle.linalg.cond(t(a))
+        np.testing.assert_allclose(c.numpy(), np.linalg.cond(a), rtol=1e-4)
+        u, s, v = paddle.linalg.svd_lowrank(t(a), q=4)
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, a, atol=1e-3)
+
+    def test_ormqr(self):
+        a = rng.randn(6, 4).astype("float64")
+        h, tau = torch.geqrf(torch.tensor(a))
+        c = rng.randn(6, 3).astype("float64")
+        ref = torch.ormqr(h, tau, torch.tensor(c))
+        out = paddle.linalg.ormqr(t(h.numpy()), t(tau.numpy()), t(c))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-8)
+
+
+class TestRNNWrappers:
+    def test_rnn_custom_cell_and_bidir(self):
+        from paddle_tpu import nn as pnn
+
+        class Cell(pnn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.hidden_size = 6
+                self.fc = pnn.Linear(4 + 6, 6)
+
+            def forward(self, x, state):
+                h = F.tanh(self.fc(paddle.concat([x, state], axis=-1)))
+                return h, h
+
+        rnn_ = pnn.RNN(Cell())
+        x = rng.randn(3, 5, 4).astype("float32")
+        y, last = rnn_(t(x))
+        assert y.shape == [3, 5, 6]
+        np.testing.assert_allclose(y.numpy()[:, -1], last.numpy(),
+                                   atol=1e-6)
+        bi = pnn.BiRNN(Cell(), Cell())
+        yb, (sf, sb) = bi(t(x))
+        assert yb.shape == [3, 5, 12]
+
+    def test_rnn_sequence_length_masks_states(self):
+        from paddle_tpu import nn as pnn
+
+        class Cell(pnn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.hidden_size = 4
+                self.fc = pnn.Linear(4 + 4, 4)
+
+            def forward(self, x, state):
+                h = F.tanh(self.fc(paddle.concat([x, state], axis=-1)))
+                return h, h
+
+        cell = Cell()
+        rnn_ = pnn.RNN(cell)
+        x = rng.randn(2, 6, 4).astype("float32")
+        lens = np.array([4, 6], np.int64)
+        y, last = rnn_(t(x), sequence_length=t(lens))
+        # short sequence: outputs beyond its length are zero, final state
+        # equals the state at its last valid step
+        np.testing.assert_allclose(y.numpy()[0, 4:], 0.0, atol=0)
+        y_full, last_full = rnn_(t(x[:1, :4]))
+        np.testing.assert_allclose(last.numpy()[0], last_full.numpy()[0],
+                                   atol=1e-6)
+        # reverse direction starts at each sequence's true end
+        rrev = pnn.RNN(cell, is_reverse=True)
+        yr, _ = rrev(t(x), sequence_length=t(lens))
+        yr_short, _ = rrev(t(x[:1, :4]))
+        np.testing.assert_allclose(yr.numpy()[0, :4], yr_short.numpy()[0],
+                                   atol=1e-6)
+        np.testing.assert_allclose(yr.numpy()[0, 4:], 0.0, atol=0)

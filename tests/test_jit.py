@@ -584,3 +584,39 @@ class TestDy2StaticForRange:
 
         x = paddle.to_tensor(np.array([2.0], np.float32))
         np.testing.assert_allclose(np.asarray(h(x).numpy()), [12.0])
+
+
+class TestForRangeSemantics:
+    def test_empty_range_keeps_prior_binding(self):
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def f(x, n):
+            i = 99
+            for i in range(n):
+                x = x + i
+            return x, i
+
+        x = paddle.to_tensor(np.float32(1.0))
+        out, i = f(x, 0)
+        assert float(out.numpy()) == 1.0
+        assert int(i.numpy() if hasattr(i, "numpy") else i) == 99
+        # python-scalar args are part of the program cache key
+        out, i = f(x, 3)
+        assert float(out.numpy()) == 4.0
+        assert int(i.numpy() if hasattr(i, "numpy") else i) == 2
+        out, _ = f(x, 0)
+        assert float(out.numpy()) == 1.0
+
+    def test_empty_range_unbound_target_raises(self):
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def h(x, n):
+            for k in range(n):
+                x = x + k
+            return x + k
+
+        x = paddle.to_tensor(np.float32(1.0))
+        with pytest.raises(NameError):
+            h(x, 0)

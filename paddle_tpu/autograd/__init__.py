@@ -4,8 +4,10 @@ from __future__ import annotations
 from .grad_mode import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
 from .engine import run_backward as backward, grad, GradNode
 from .py_layer import PyLayer, PyLayerContext
+from .functional import jacobian, hessian, Jacobian, Hessian
 
 __all__ = [
     "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
     "backward", "grad", "PyLayer", "PyLayerContext", "GradNode",
+    "jacobian", "hessian", "Jacobian", "Hessian",
 ]

@@ -1,0 +1,19 @@
+"""paddle.incubate.autograd parity (python/paddle/incubate/autograd/):
+the function-based forward/reverse primitives, delivered by jax.jvp /
+jax.vjp directly."""
+from ...autograd.functional import jvp, vjp, Jacobian, Hessian
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian"]
+
+
+def enable_prim():
+    """Upstream toggles the prim-op lowering path; under XLA every op is
+    already traced to primitives, so this is a no-op kept for parity."""
+
+
+def disable_prim():
+    pass
+
+
+def prim_enabled():
+    return True

@@ -181,3 +181,106 @@ def relu(x):
     bx = _as_bcoo(x)
     return SparseCooTensor(jsparse.BCOO((jnp.maximum(bx.data, 0),
                                          bx.indices), shape=bx.shape))
+
+
+# ------------------------------------------------------------- unary ops --
+# Parity: python/paddle/sparse/unary.py — elementwise fns that preserve
+# f(0) == 0 operate directly on the BCOO value vector (no densify).
+
+def _unary(fn):
+    def op(x, name=None):
+        b = _as_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                            shape=b.shape))
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+expm1 = _unary(jnp.expm1)
+neg = _unary(jnp.negative)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+
+
+def pow(x, factor, name=None):
+    b = _as_bcoo(x)
+    return SparseCooTensor(jsparse.BCOO((jnp.power(b.data, factor),
+                                         b.indices), shape=b.shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework import dtype as dtypes
+    b = _as_bcoo(x)
+    data = b.data if value_dtype is None else b.data.astype(
+        dtypes.convert_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else b.indices.astype(
+        dtypes.convert_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def coalesce(x, name=None):
+    return SparseCooTensor(_as_bcoo(x).sum_duplicates())
+
+
+def transpose(x, perm, name=None):
+    b = _as_bcoo(x)
+    new_shape = tuple(b.shape[p] for p in perm)
+    new_idx = b.indices[:, list(perm)]
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx),
+                                        shape=new_shape))
+
+
+def reshape(x, shape, name=None):
+    b = _as_bcoo(x)
+    flat = jnp.zeros((), jnp.int64)
+    strides = []
+    acc = 1
+    for s in reversed(b.shape):
+        strides.append(acc)
+        acc *= s
+    strides = list(reversed(strides))
+    lin = sum(b.indices[:, d].astype(jnp.int64) * strides[d]
+              for d in range(len(b.shape)))
+    shape = [int(s) for s in shape]
+    n_elem = 1
+    for s in b.shape:
+        n_elem *= s
+    # one -1 allowed
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = n_elem // known
+    new_strides = []
+    acc = 1
+    for s in reversed(shape):
+        new_strides.append(acc)
+        acc *= s
+    new_strides = list(reversed(new_strides))
+    cols = []
+    rem = lin
+    for st in new_strides:
+        cols.append((rem // st).astype(jnp.int32))
+        rem = rem % st
+    new_idx = jnp.stack(cols, axis=1)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx),
+                                        shape=tuple(shape)))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+from . import nn  # noqa: E402  (paddle.sparse.nn layers)

@@ -1,0 +1,236 @@
+"""paddle.sparse.nn — layers over sparse COO tensors.
+
+Reference parity: python/paddle/sparse/nn/ (ReLU, BatchNorm,
+Conv3D/SubmConv3D, MaxPool3D — the point-cloud stack backed by
+phi/kernels/sparse/ CUDA gather-scatter kernels).
+
+TPU-native design: the MXU wants dense tiles, and XLA has no ragged
+gather-scatter conv, so convolution computes DENSE through
+lax.conv_general_dilated and re-sparsifies at the output sites —
+SubmConv3D keeps the input's site pattern (the submanifold contract),
+Conv3D takes the true nonzero pattern of the dense result. Activations
+and norms run on the value vector only (no densify). For the small
+active-site counts sparse point-cloud workloads carry, the dense
+compute is one fused XLA conv — the sparsity is a storage format here,
+not a compute strategy (documented divergence from the CUDA kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.initializer import Uniform
+from . import SparseCooTensor, _as_bcoo
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D"]
+
+
+def _map_values(x, fn):
+    b = _as_bcoo(x)
+    return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                        shape=b.shape))
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _map_values(x, jax.nn.relu)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _map_values(x, jax.nn.relu6)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return _map_values(
+            x, lambda v: jax.nn.leaky_relu(v, self._slope))
+
+
+class Softmax(Layer):
+    """Softmax over the last dense axis of the values (parity:
+    paddle.sparse.nn.Softmax on the nonzero entries per row)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse softmax supports axis=-1 only")
+
+    def forward(self, x):
+        b = _as_bcoo(x).sum_duplicates()
+        # group nonzeros by their row (all index columns but the last)
+        ncols = b.shape[-1]
+        row = sum(b.indices[:, d].astype(jnp.int64) *
+                  int(np.prod(b.shape[d + 1:-1], dtype=np.int64) or 1)
+                  for d in range(b.indices.shape[1] - 1))
+        order = jnp.argsort(row * ncols + b.indices[:, -1].astype(jnp.int64))
+        row_s = row[order]
+        data_s = b.data[order]
+        # segment softmax over rows
+        n_rows = 1
+        for s in b.shape[:-1]:
+            n_rows *= s
+        seg_max = jax.ops.segment_max(data_s, row_s, num_segments=n_rows)
+        ex = jnp.exp(data_s - seg_max[row_s])
+        seg_sum = jax.ops.segment_sum(ex, row_s, num_segments=n_rows)
+        out = ex / seg_sum[row_s]
+        inv = jnp.argsort(order)
+        return SparseCooTensor(jsparse.BCOO((out[inv], b.indices),
+                                            shape=b.shape))
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) axis of NDHWC sparse values
+    (parity: paddle.sparse.nn.BatchNorm — statistics over active sites
+    only, exactly the reference semantics)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC"):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse BatchNorm requires NDHWC")
+        self._eps = epsilon
+        self._mom = momentum
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=Uniform(1.0, 1.0))
+        self.bias = self.create_parameter(
+            [num_features], is_bias=True,
+            default_initializer=Uniform(0.0, 0.0))
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        b = _as_bcoo(x)
+        v = b.data  # [nnz, C]
+        if self.training:
+            mean = v.mean(0)
+            var = v.var(0)
+            m = jnp.asarray(self._mom, mean.dtype)
+            self._mean._inplace_update(
+                Tensor(self._mean._value * m + mean * (1 - m)))
+            self._variance._inplace_update(
+                Tensor(self._variance._value * m + var * (1 - m)))
+        else:
+            mean, var = self._mean._value, self._variance._value
+        out = ((v - mean) / jnp.sqrt(var + self._eps)
+               * self.weight._value + self.bias._value)
+        return SparseCooTensor(jsparse.BCOO((out, b.indices),
+                                            shape=b.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica stats ride XLA's psum when run under a mesh; on a
+    single device this is BatchNorm (parity shim)."""
+
+
+def _dense_conv(x, weight, bias, stride, padding, dilation, groups):
+    """NDHWC sparse -> dense conv via lax (DHWIO weights)."""
+    b = _as_bcoo(x)
+    dense = b.todense()
+    dn = jax.lax.conv_dimension_numbers(dense.shape, weight.shape,
+                                        ("NDHWC", "DHWIO", "NDHWC"))
+    pad = padding if isinstance(padding, str) else \
+        [(p, p) for p in (padding if isinstance(padding, (list, tuple))
+                          else [padding] * 3)]
+    out = jax.lax.conv_general_dilated(
+        dense, weight, window_strides=list(stride),
+        padding=pad, rhs_dilation=list(dilation),
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class Conv3D(Layer):
+    """Parity: paddle.sparse.nn.Conv3D (NDHWC). Dense XLA conv +
+    re-sparsify at true nonzero sites."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        ks = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self._stride = ((stride,) * 3 if isinstance(stride, int)
+                        else tuple(stride))
+        self._padding = padding
+        self._dilation = ((dilation,) * 3 if isinstance(dilation, int)
+                          else tuple(dilation))
+        self._groups = groups
+        k = 1.0 / float(np.sqrt(in_channels * np.prod(ks)))
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels // groups, out_channels],
+            default_initializer=Uniform(-k, k))
+        self.bias = (self.create_parameter(
+            [out_channels], is_bias=True,
+            default_initializer=Uniform(-k, k))
+            if bias_attr is not False else None)
+
+    def _run(self, x, subm):
+        out = _dense_conv(x, self.weight._value,
+                          None if self.bias is None else self.bias._value,
+                          self._stride, self._padding, self._dilation,
+                          self._groups)
+        if subm:
+            # submanifold: output sites == input sites
+            b = _as_bcoo(x)
+            idx = b.indices
+            site_idx = idx[:, :-1]
+            vals = out[tuple(site_idx[:, d] for d in range(
+                site_idx.shape[1]))]
+            new_idx = site_idx
+            co = out.shape[-1]
+            # expand channel dim back into COO form [nnz, C] dense block
+            return SparseCooTensor(jsparse.BCOO(
+                (vals, new_idx), shape=out.shape[:-1] + (co,)))
+        return SparseCooTensor(jsparse.BCOO.fromdense(
+            out, n_batch=0, n_dense=1))
+
+    def forward(self, x):
+        return self._run(x, subm=False)
+
+
+class SubmConv3D(Conv3D):
+    """Parity: paddle.sparse.nn.SubmConv3D — output active sites are
+    exactly the input's (submanifold convolution contract)."""
+
+    def forward(self, x):
+        return self._run(x, subm=True)
+
+
+class MaxPool3D(Layer):
+    """Parity: paddle.sparse.nn.MaxPool3D (NDHWC): dense reduce_window,
+    re-sparsified."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        ks = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        st = ks if stride is None else (
+            (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+        pd = ((padding,) * 3 if isinstance(padding, int)
+              else tuple(padding))
+        self._ks, self._st, self._pd = ks, st, pd
+
+    def forward(self, x):
+        dense = _as_bcoo(x).todense()
+        neg = (-jnp.inf if jnp.issubdtype(dense.dtype, jnp.floating)
+               else jnp.iinfo(dense.dtype).min)
+        out = jax.lax.reduce_window(
+            dense, neg, jax.lax.max,
+            (1,) + self._ks + (1,), (1,) + self._st + (1,),
+            ((0, 0),) + tuple((p, p) for p in self._pd) + ((0, 0),))
+        out = jnp.where(jnp.isfinite(out), out, 0)
+        return SparseCooTensor(jsparse.BCOO.fromdense(
+            out, n_batch=0, n_dense=1))

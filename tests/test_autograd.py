@@ -400,3 +400,53 @@ class TestPyLayerTracedEdgeCases:
 
         g = jax.jit(jax.grad(loss))(jnp.asarray(np.ones(2, np.float32)))
         np.testing.assert_allclose(np.asarray(g), [2.0, 2.0])
+
+
+class TestFunctionalAutodiff:
+    def test_jacobian_vector(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        x.stop_gradient = False
+        y = x * x
+        J = paddle.autograd.jacobian(y, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]),
+                                   atol=1e-6)
+
+    def test_jacobian_batched(self):
+        x = paddle.to_tensor(np.arange(6).reshape(3, 2).astype("float32"))
+        x.stop_gradient = False
+        y = x * x
+        J = paddle.autograd.jacobian(y, x, batch_axis=0)
+        # per-batch jacobian of elementwise square: diag(2x_b)
+        for b in range(3):
+            np.testing.assert_allclose(
+                J.numpy()[b], np.diag(2 * np.arange(2 * b, 2 * b + 2)),
+                atol=1e-5)
+
+    def test_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        x.stop_gradient = False
+        y = (x * x * x).sum()
+        H = paddle.autograd.hessian(y, x)
+        np.testing.assert_allclose(H.numpy(),
+                                   np.diag(6 * np.array([1.0, 2.0, 3.0])),
+                                   atol=1e-4)
+
+    def test_incubate_jvp_vjp(self):
+        from paddle_tpu.incubate.autograd import jvp, vjp
+
+        def f(a, b):
+            return a * b, a + b
+
+        xs = [paddle.to_tensor(np.array([2.0], "float32")),
+              paddle.to_tensor(np.array([5.0], "float32"))]
+        v = [paddle.to_tensor(np.array([1.0], "float32")),
+             paddle.to_tensor(np.array([0.0], "float32"))]
+        outs, tangents = jvp(f, xs, v)
+        # d(a*b)/da = b = 5; d(a+b)/da = 1
+        np.testing.assert_allclose(tangents[0].numpy(), [5.0])
+        np.testing.assert_allclose(tangents[1].numpy(), [1.0])
+        outs, grads = vjp(f, xs, [paddle.to_tensor(np.array([1.0], "f4")),
+                                  paddle.to_tensor(np.array([1.0], "f4"))])
+        # d(ab + a+b)/da = b + 1 = 6; /db = a + 1 = 3
+        np.testing.assert_allclose(grads[0].numpy(), [6.0])
+        np.testing.assert_allclose(grads[1].numpy(), [3.0])

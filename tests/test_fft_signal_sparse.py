@@ -137,3 +137,56 @@ class TestSparse:
         for r, c in [(0, 1), (1, 0), (2, 2)]:
             ref[r, c] = full[r, c]
         np.testing.assert_allclose(dense, ref, atol=1e-4)
+
+
+class TestSparseUnaryAndNN:
+    def test_unary_transpose_reshape(self):
+        import paddle_tpu.sparse as sp
+        idx = np.array([[0, 0, 1], [0, 2, 1]], np.int64)
+        vals = np.array([1.0, -2.0, 3.0], np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, shape=[2, 3])
+        d = x.to_dense().numpy()
+        np.testing.assert_allclose(sp.sin(x).to_dense().numpy(),
+                                   np.sin(d) * (d != 0), atol=1e-6)
+        np.testing.assert_allclose(sp.square(x).to_dense().numpy(), d * d)
+        np.testing.assert_allclose(
+            sp.transpose(x, [1, 0]).to_dense().numpy(), d.T)
+        np.testing.assert_allclose(
+            sp.reshape(x, [3, 2]).to_dense().numpy(), d.reshape(3, 2))
+        np.testing.assert_allclose(
+            sp.reshape(x, [-1]).to_dense().numpy(), d.reshape(-1))
+        c = sp.cast(x, value_dtype="float64")
+        assert "float64" in str(c.dtype)
+
+    def test_sparse_nn_stack(self):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        import paddle_tpu.sparse as sp
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        dense[0, 1, 2, 3] = [1.0, -1.0]
+        dense[0, 0, 0, 0] = [0.5, 2.0]
+        pc = sp.SparseCooTensor(
+            jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1))
+        conv = sp.nn.SubmConv3D(2, 4, 3, padding=1)
+        out = conv(pc)
+        assert out.shape == [1, 4, 4, 4, 4]
+        assert out.nnz == pc.nnz  # submanifold contract
+        full = sp.nn.Conv3D(2, 4, 3, padding=1)
+        outf = full(pc)
+        assert outf.shape == [1, 4, 4, 4, 4]
+        bn = sp.nn.BatchNorm(4)
+        bn.eval()
+        assert bn(out).shape == out.shape
+        mp = sp.nn.MaxPool3D(2, stride=2)
+        assert mp(pc).shape == [1, 2, 2, 2, 2]
+
+    def test_sparse_softmax_rows(self):
+        import paddle_tpu.sparse as sp
+        idx = np.array([[0, 0, 1], [0, 2, 1]], np.int64)
+        vals = np.array([1.0, -2.0, 3.0], np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, shape=[2, 3])
+        s = sp.nn.Softmax()(x)
+        row0 = np.exp([1.0, -2.0]) / np.exp([1.0, -2.0]).sum()
+        np.testing.assert_allclose(s.to_dense().numpy()[0, [0, 2]], row0,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(s.to_dense().numpy()[1, 1], 1.0)

@@ -63,6 +63,9 @@ from . import inference
 from . import incubate
 from . import profiler
 from .hapi import Model, summary
+from .hapi.flops import flops
+from . import hub
+from . import text
 from .hapi import callbacks
 
 from . import distributed

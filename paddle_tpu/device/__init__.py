@@ -137,3 +137,89 @@ tpu = cuda
 
 def synchronize(device=None):
     cuda.synchronize(device)
+
+
+class Event:
+    """paddle.device.Event parity (reference: paddle/phi/backends/
+    event.h + python/paddle/device/__init__.py Event). XLA has no user
+    streams; record() snapshots a host timestamp after draining the
+    async dispatch queue, so elapsed_time between two recorded events
+    brackets real device work — the role CUDA events play in paddle
+    timing code."""
+
+    def __init__(self, device=None, enable_timing=True, blocking=False,
+                 interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time as _time
+        synchronize()
+        self._t = _time.perf_counter()
+
+    def query(self):
+        return self._t is not None
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            raise RuntimeError("both events must be recorded")
+        return (end_event._t - self._t) * 1000.0
+
+
+class Stream:
+    """paddle.device.Stream parity (reference: phi stream wrappers).
+    XLA owns scheduling/overlap (its latency-hiding scheduler is the
+    stream assignment pass of the reference's InterpreterCore), so
+    streams are ordering facades: record/wait compose with Event,
+    synchronize drains the dispatch queue."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    """Context manager parity for paddle.device.stream_guard."""
+
+    def __init__(self, stream):
+        self._s = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self._s)
+        return self._s
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False

@@ -1,0 +1,61 @@
+"""paddle.hub parity (python/paddle/hapi/hub.py): load models from a
+hubconf.py in a local directory or a remote repo. This environment has
+zero network egress, so source='github'/'gitee' raises with guidance;
+the local path is fully functional (that is also the recommended way to
+vendor hub models for air-gapped TPU pods)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source == "local":
+        return repo_dir
+    raise RuntimeError(
+        f"paddle.hub source='{source}' needs network access, which this "
+        "TPU environment does not have. Clone the repo and use "
+        "source='local' with its path.")
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entry-point names exported by the repo's hubconf.py."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not in hubconf")
+    return fn(**kwargs)

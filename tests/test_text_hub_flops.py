@@ -1,0 +1,102 @@
+"""paddle.text (viterbi CRF decode), paddle.hub, paddle.flops,
+device Stream/Event."""
+import itertools
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(0)
+
+
+class TestViterbi:
+    def _brute(self, pot, trans, length, bos=None, eos=None):
+        N = pot.shape[-1]
+        tags = [t for t in range(N) if t not in (bos, eos)] \
+            if bos is not None else range(N)
+        best, bp = -1e30, None
+        for cand in itertools.product(tags, repeat=length):
+            sc = pot[0, cand[0]]
+            if bos is not None:
+                sc += trans[bos, cand[0]]
+            for t in range(1, length):
+                sc += trans[cand[t - 1], cand[t]] + pot[t, cand[t]]
+            if eos is not None:
+                sc += trans[cand[-1], eos]
+            if sc > best:
+                best, bp = sc, cand
+        return best, bp
+
+    def test_no_bos_eos(self):
+        B, L, N = 2, 5, 4
+        pot = rng.randn(B, L, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lens = np.array([5, 3], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        for b in range(B):
+            best, bp = self._brute(pot[b], trans, int(lens[b]))
+            np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-5)
+            np.testing.assert_array_equal(
+                paths.numpy()[b, :int(lens[b])], bp)
+            assert (paths.numpy()[b, int(lens[b]):] == 0).all()
+
+    def test_bos_eos_decoder(self):
+        B, L, N = 1, 4, 5  # tags 3=BOS, 4=EOS
+        pot = rng.randn(B, L, N).astype("float32")
+        trans = rng.randn(N, N).astype("float32")
+        lens = np.array([4], np.int64)
+        dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans),
+                                         include_bos_eos_tag=True)
+        scores, paths = dec(paddle.to_tensor(pot), paddle.to_tensor(lens))
+        best, bp = self._brute(pot[0], trans, 4, bos=3, eos=4)
+        # brute force restricted to non-bos/eos tags; decoder may use
+        # them if they genuinely win, so allow >=
+        assert scores.numpy()[0] >= best - 1e-5
+
+    def test_offline_datasets_raise(self):
+        import pytest
+        with pytest.raises(RuntimeError):
+            paddle.text.datasets.Imdb()
+
+
+class TestHubFlops:
+    def test_hub_local_roundtrip(self):
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "hubconf.py"), "w") as f:
+            f.write("def lenet(**kw):\n"
+                    "    '''LeNet builder'''\n"
+                    "    import paddle_tpu as paddle\n"
+                    "    return paddle.vision.LeNet()\n")
+        assert paddle.hub.list(d, source="local") == ["lenet"]
+        assert "LeNet" in paddle.hub.help(d, "lenet", source="local")
+        m = paddle.hub.load(d, "lenet", source="local")
+        assert m.__class__.__name__ == "LeNet"
+        import pytest
+        with pytest.raises(RuntimeError):
+            paddle.hub.list("owner/repo", source="github")
+
+    def test_flops_scales_with_width(self):
+        from paddle_tpu import nn
+        small = nn.Linear(64, 64)
+        big = nn.Linear(64, 256)
+        fs = paddle.flops(small, [1, 64])
+        fb = paddle.flops(big, [1, 64])
+        assert fb > 2 * fs  # 4x the matmul work
+        assert fs >= 2 * 64 * 64  # at least the MAC count
+
+    def test_stream_event(self):
+        ev1, ev2 = paddle.device.Event(), paddle.device.Event()
+        ev1.record()
+        x = paddle.to_tensor(np.ones((32, 32), "float32"))
+        _ = (x @ x).numpy()
+        ev2.record()
+        assert ev1.elapsed_time(ev2) >= 0
+        s = paddle.device.Stream()
+        with paddle.device.stream_guard(s):
+            assert paddle.device.current_stream() is s
+        ev = s.record_event()
+        assert ev.query()

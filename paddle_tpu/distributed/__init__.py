@@ -25,6 +25,7 @@ from .auto_parallel_api import (
     dtensor_from_fn, reshard, shard_layer,
 )
 from . import checkpoint
+from . import rpc
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model
 
 # paddle.distributed.sharding namespace parity

@@ -1,0 +1,204 @@
+"""paddle.distributed.rpc parity (python/paddle/distributed/rpc/rpc.py,
+backed upstream by a brpc agent in paddle/fluid/distributed/rpc/).
+
+TPU-native runtime design: a plain TCP request/response server thread
+per worker (length-prefixed pickle frames) with worker discovery through
+the framework's native TCPStore rendezvous (csrc/tcp_store.cc) — the
+same store the collective init uses, so `master_endpoint` semantics
+match. Futures are concurrent.futures.Future filled by a client thread
+pool. RPC here is control-plane (dataset orchestration, parameter
+server experiments); tensor payloads move as numpy via pickle — the
+data plane between TPU chips stays XLA collectives, which is the whole
+point of the TPU-first redesign.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state = {}
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_frame(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _serve_loop(srv, stop_evt):
+    srv.settimeout(0.2)
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        while not stop_evt.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            pool.submit(_handle, conn)
+    try:
+        srv.close()
+    except OSError:
+        pass
+
+
+def _handle(conn):
+    try:
+        with conn:
+            req = pickle.loads(_recv_frame(conn))
+            if req[0] == "call":
+                _, fn, args, kwargs = req
+                try:
+                    res = ("ok", fn(*args, **kwargs))
+                except Exception as e:  # ship the failure to the caller
+                    res = ("err", e)
+            elif req[0] == "ping":
+                res = ("ok", "pong")
+            else:
+                res = ("err", ValueError(f"bad rpc op {req[0]!r}"))
+            _send_frame(conn, pickle.dumps(res))
+    except (ConnectionError, OSError):
+        pass
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's rpc server and rendezvous with the others.
+    master_endpoint: "ip:port" of the TCPStore master (env
+    PADDLE_MASTER_ENDPOINT as fallback, matching the reference)."""
+    import os
+    if _state.get("inited"):
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else int(rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else int(world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:29411")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    my_port = srv.getsockname()[1]
+    srv.listen(64)
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
+
+    from . import TCPStore
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    info = WorkerInfo(name, rank, my_ip, my_port)
+    store.set(f"rpc/{rank}", pickle.dumps(info))
+    workers = []
+    for r in range(world_size):
+        store.wait([f"rpc/{r}"])
+        workers.append(pickle.loads(store.get(f"rpc/{r}")))
+
+    stop_evt = threading.Event()
+    thread = threading.Thread(target=_serve_loop, args=(srv, stop_evt),
+                              daemon=True, name="paddle-rpc-server")
+    thread.start()
+    _state.update(inited=True, rank=rank, world=world_size, store=store,
+                  workers={w.name: w for w in workers},
+                  by_rank={w.rank: w for w in workers},
+                  stop=stop_evt, thread=thread, srv=srv,
+                  pool=ThreadPoolExecutor(max_workers=8))
+
+
+def _resolve(to) -> WorkerInfo:
+    ws = _state.get("workers") or {}
+    if isinstance(to, WorkerInfo):
+        return to
+    if to in ws:
+        return ws[to]
+    br = _state.get("by_rank") or {}
+    if isinstance(to, int) and to in br:
+        return br[to]
+    raise ValueError(f"unknown rpc worker {to!r}")
+
+
+def _call(to, fn, args, kwargs, timeout):
+    w = _resolve(to)
+    with socket.create_connection((w.ip, w.port),
+                                  timeout=timeout if timeout and
+                                  timeout > 0 else None) as s:
+        _send_frame(s, pickle.dumps(("call", fn, args or (),
+                                     kwargs or {})))
+        status, payload = pickle.loads(_recv_frame(s))
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=-1):
+    """Run fn(*args, **kwargs) on worker `to`; block for the result."""
+    if not _state.get("inited"):
+        raise RuntimeError("call init_rpc first")
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=-1) -> Future:
+    """Like rpc_sync but returns a concurrent.futures.Future (paddle's
+    FutureWrapper exposes .wait(); both .wait() and .result() work)."""
+    if not _state.get("inited"):
+        raise RuntimeError("call init_rpc first")
+    fut = _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result  # paddle API compat
+    return fut
+
+
+def get_worker_info(name=None):
+    if not _state.get("inited"):
+        raise RuntimeError("call init_rpc first")
+    if name is None:
+        return _state["by_rank"][_state["rank"]]
+    return _resolve(name)
+
+
+def get_all_worker_infos():
+    if not _state.get("inited"):
+        raise RuntimeError("call init_rpc first")
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    return get_worker_info()
+
+
+def shutdown():
+    """Barrier with the other workers, then stop serving (paddle
+    semantics: graceful, all outstanding work drains first)."""
+    if not _state.get("inited"):
+        return
+    store = _state["store"]
+    try:
+        store.barrier("rpc_shutdown", _state["world"])
+    except Exception:
+        pass
+    _state["stop"].set()
+    _state["pool"].shutdown(wait=True)
+    _state["thread"].join(timeout=5)
+    _state.clear()

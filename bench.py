@@ -19,8 +19,40 @@ def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _backend_watchdog(timeout_s=240):
+    """The sandbox's TPU tunnel sometimes wedges at the claim step and
+    jax.devices() then blocks forever (known environmental failure; see
+    round-1/2 bench notes). Probe backend init on a side thread so the
+    bench fails FAST with an attributable message instead of timing out
+    silently."""
+    import threading
+    import jax
+
+    box = {}
+
+    def probe():
+        try:
+            box["devices"] = jax.devices()
+        except Exception as e:  # surfaced below
+            box["error"] = e
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        _log(f"FATAL: jax backend init did not return within {timeout_s}s "
+             "— the TPU tunnel/claim is wedged (environmental; retry "
+             "after the relay lease expires). No benchmark was run.")
+        sys.exit(3)
+    if "error" in box:
+        _log(f"FATAL: jax backend init failed: {box['error']!r}")
+        sys.exit(3)
+    return box["devices"]
+
+
 def main():
     import jax
+    _backend_watchdog()
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu import nn
@@ -113,5 +145,43 @@ def main():
     }))
 
 
+def _orchestrate():
+    """Run the measurement in a CHILD process so two sandbox failure
+    modes stay recoverable (the parent never claims the TPU):
+
+    1. wedged tunnel/claim -> the child's watchdog exits 3; nothing to
+       retry, propagate the diagnostic.
+    2. Pallas remote-compile stall -> child killed at the deadline and
+       retried once with FLAGS_use_pallas_kernels=0 so a crashed kernel
+       build still yields a real (annotated) XLA-path measurement.
+    """
+    import subprocess
+
+    deadline = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "300"))
+    attempts = [dict(os.environ),
+                {**os.environ, "FLAGS_use_pallas_kernels": "0"}]
+    for i, env in enumerate(attempts):
+        try:
+            res = subprocess.run(
+                [sys.executable, __file__, "--worker"], env=env,
+                capture_output=True, text=True, timeout=deadline)
+        except subprocess.TimeoutExpired:
+            _log(f"attempt {i}: child exceeded {deadline}s "
+                 f"({'pallas on' if i == 0 else 'pallas off'}), killed")
+            continue
+        sys.stderr.write(res.stderr)
+        if res.returncode == 0 and res.stdout.strip():
+            sys.stdout.write(res.stdout)
+            return 0
+        if res.returncode == 3:
+            return 3  # wedged tunnel: retrying cannot help
+        _log(f"attempt {i}: child rc={res.returncode}")
+    _log("FATAL: all bench attempts failed")
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        main()
+    else:
+        sys.exit(_orchestrate())

@@ -165,9 +165,13 @@ def _orchestrate():
             res = subprocess.run(
                 [sys.executable, __file__, "--worker"], env=env,
                 capture_output=True, text=True, timeout=deadline)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             _log(f"attempt {i}: child exceeded {deadline}s "
                  f"({'pallas on' if i == 0 else 'pallas off'}), killed")
+            if e.stderr:  # the stall breadcrumbs are the diagnostic
+                tail = e.stderr if isinstance(e.stderr, str) else \
+                    e.stderr.decode(errors="replace")
+                sys.stderr.write(tail[-2000:])
             continue
         sys.stderr.write(res.stderr)
         if res.returncode == 0 and res.stdout.strip():

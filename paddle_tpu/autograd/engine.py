@@ -180,6 +180,11 @@ def _traverse(out_tensors, grad_tensors, inputs, create_graph, retain_graph,
                     c = Tensor(jnp.zeros(node.out_shapes[i], node.out_dtypes[i]))
                 elif out_t is not None:
                     c = _apply_hooks(out_t, c)
+                    if getattr(out_t, "_retain_grad", False):
+                        # Tensor.retain_grads(): keep this non-leaf's grad
+                        leaf_grads[id(out_t)] = _accum(
+                            leaf_grads.get(id(out_t)), c)
+                        leaf_objs[id(out_t)] = out_t
                 full.append(c)
             in_grads = node.backward_fn(tuple(full), create_graph)
             if len(in_grads) != len(node.inputs):

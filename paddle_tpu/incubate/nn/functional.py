@@ -139,3 +139,288 @@ def block_multihead_attention(qkv, key_cache, value_cache, block_tables,
                                                  head_dim])
     return paged_attention(q, key_cache, value_cache, block_tables,
                            context_lens, scale=scale)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0, name=None):
+    """Parity: fused_bias_act (phi/kernels/fusion/gpu/fused_bias_act).
+    The quant/dequant legs belong to the int8 serving path; bias+act is
+    the TPU-relevant core (XLA fuses it into the producing matmul)."""
+    out = x if bias is None else x + bias
+    act = {"gelu": F.gelu, "relu": F.relu, "silu": F.silu,
+           "swiglu": swiglu, "geglu": None}.get(act_method)
+    if act is None:
+        raise ValueError(f"unsupported act_method {act_method!r}")
+    return act(out)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode='upscale_in_train',
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """Parity: python/paddle/incubate/nn/functional/
+    fused_multi_head_attention (phi fused_attention kernel): optional
+    pre-LN -> QKV projection -> attention -> out projection ->
+    bias+dropout+residual(+post-LN). One traced graph; XLA performs the
+    fusion the reference hand-wrote in CUDA, attention runs the flash
+    kernel. qkv_weight: [3, H, D, E] (or [E, 3*E] with
+    transpose_qkv_wb). With cache_kv ([2, B, Tpast, H, D]) the step's
+    K/V are appended and (out, cache_kv_out) is returned (decode
+    semantics of the reference)."""
+    from ...kernels.attention import flash_attention_bshd
+
+    if ring_id not in (-1, None):
+        raise NotImplementedError(
+            "fused_multi_head_attention(ring_id>=0): the tensor-parallel "
+            "allreduce path lives in meta_parallel (ColumnParallelLinear/"
+            "RowParallelLinear); compose those instead")
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], pre_ln_scale, pre_ln_bias,
+                           pre_ln_epsilon)
+    e = out.shape[-1]
+    if transpose_qkv_wb:
+        qkv = F.linear(out, qkv_weight, qkv_bias)      # [B, S, 3E]
+        h = num_heads
+        d = e // h
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape([b, s, 3, h, d])
+    else:
+        # qkv_weight [3, H, D, E]: einsum projection
+        from ...ops import einsum as _einsum
+        qkv = _einsum("bse,thde->bsthd", out, qkv_weight)
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias.reshape([1, 1] + list(qkv_bias.shape))
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]                                    # [B, S, H, D]
+    cache_out = None
+    if cache_kv is not None:
+        from ...ops.manipulation import concat, stack
+        k = concat([cache_kv[0], k], axis=1)            # grow along S
+        v = concat([cache_kv[1], v], axis=1)
+        cache_out = stack([k, v], axis=0)
+    ctx = flash_attention_bshd(q, k, v, attn_mask=attn_mask,
+                               dropout_p=attn_dropout_rate,
+                               training=training)
+    b, s = ctx.shape[0], ctx.shape[1]
+    ctx = ctx.reshape([b, s, -1])
+    out = F.linear(ctx, linear_weight, None)
+    if not pre_layer_norm:
+        final = fused_bias_dropout_residual_layer_norm(
+            out, residual if add_residual else 0.0 * out, bias=linear_bias,
+            ln_scale=ln_scale, ln_bias=ln_bias,
+            dropout_rate=dropout_rate, ln_epsilon=ln_epsilon,
+            training=training, mode=mode)
+    else:
+        final = _bias_dropout_residual(
+            out, linear_bias, residual if add_residual else None,
+            dropout_rate, training, mode)
+    if cache_out is not None:
+        return final, cache_out
+    return final
+
+
+def _bias_dropout_residual(x, bias, residual, rate, training, mode):
+    out = x if bias is None else x + bias
+    out = F.dropout(out, rate, training=training, mode=mode)
+    if residual is not None:
+        out = out + residual
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, ring_id=-1,
+                      add_residual=True, mode='upscale_in_train',
+                      name=None):
+    """Parity: fused_feedforward (phi fused_feedforward kernel):
+    (pre-)LN -> linear1 -> act -> dropout1 -> linear2 -> bias+dropout2
+    +residual(+post-LN)."""
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln1_scale, ln1_bias,
+                           ln1_epsilon)
+    out = F.linear(out, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+    out = F.linear(out, linear2_weight, None)
+    out = _bias_dropout_residual(out, linear2_bias,
+                                 residual if add_residual else None,
+                                 dropout2_rate, training, mode)
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0,
+                                               name=None):
+    """Parity: variable_length_memory_efficient_attention
+    (phi fusion kernel binding cutlass fMHA). [B, H, S, D] layout; the
+    per-sequence kv lengths route into the Pallas flash kernel's varlen
+    path (masked in-kernel, no S x S mask tensor). Query rows beyond
+    seq_lens are zeroed in the output (their attention is padding)."""
+    from ...kernels.attention import flash_attention_bshd
+    from ...ops.manipulation import transpose
+    from ...ops._dispatch import apply as _apply
+    from ...ops.creation import _coerce as _c
+
+    if pre_cache_length:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention: "
+            "pre_cache_length>0 (prefix cache) — use the generation "
+            "stack's paged_attention for cached serving")
+    q = transpose(query, [0, 2, 1, 3])      # -> [B, S, H, D]
+    k = transpose(key, [0, 2, 1, 3])
+    v = transpose(value, [0, 2, 1, 3])
+    out = flash_attention_bshd(q, k, v, attn_mask=mask, is_causal=causal,
+                               scale=scale, kv_lens=kv_seq_lens)
+    if seq_lens is not None:
+        def zero_tail(o, ql):
+            pos = jnp.arange(o.shape[1])[None, :, None, None]
+            return jnp.where(pos < ql.reshape(-1, 1, 1, 1), o, 0)
+        out = _apply(zero_tail, _c(out), _c(seq_lens))
+    return transpose(out, [0, 2, 1, 3])
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype='default', out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-token decoder attention with an in-place KV cache
+    (parity: masked_multihead_attention, the phi decoder-MMHA fusion).
+    x: [B, 3*H*D] fused qkv for ONE step; cache_kv: [2, B, H, T, D].
+    Returns (out [B, H*D], updated cache) like the reference."""
+    from ...ops._dispatch import apply as _apply
+    from ...ops.creation import _coerce as _c
+    import numpy as _np
+
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    if src_mask is not None or rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "masked_multihead_attention: src_mask/rotary_tensor are not "
+            "wired yet — apply RoPE via fused_rotary_position_embedding "
+            "before the cache write, masks via flash_attention_bshd")
+    if qkv_out_scale is not None or out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: int8 quant legs are a GPU "
+            "serving path; TPU serving uses the bf16 predictor")
+    args = [_c(x), _c(cache_kv)]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(_c(bias))
+    has_seq = sequence_lengths is not None
+    if has_seq:
+        args.append(_c(sequence_lengths))
+
+    def fn(xv, cache, *rest):
+        it = iter(rest)
+        bv = next(it) if has_bias else None
+        sl = next(it) if has_seq else None
+        if bv is not None:
+            xv = xv + bv
+        two, b, h, t, d = cache.shape
+        qkv = xv.reshape(b, 3, h, d)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        # write position: current length (same for the whole batch if no
+        # per-sequence lengths given — step index from mask of zeros)
+        if sl is None:
+            # infer: first fully-zero cache slot along T of key norms
+            occ = jnp.any(cache[0] != 0, axis=(1, 3))     # [B, T]
+            pos = jnp.sum(occ.astype(jnp.int32), axis=1)  # [B]
+        else:
+            pos = sl.reshape(-1).astype(jnp.int32)
+        bidx = jnp.arange(b)
+        cache = cache.at[0, bidx, :, pos].set(k_new)
+        cache = cache.at[1, bidx, :, pos].set(v_new)
+        keys = cache[0]                                    # [B, H, T, D]
+        vals = cache[1]
+        s = jnp.einsum("bhd,bhtd->bht", q, keys) / _np.float32(
+            _np.sqrt(d))
+        tpos = jnp.arange(t)[None, None, :]
+        live = tpos <= pos[:, None, None]
+        s = jnp.where(live, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bht,bhtd->bhd", p, vals)
+        return out.reshape(b, h * d), cache
+
+    import jax
+    out, new_cache = _apply(fn, *args, _name="masked_mha")
+    return out, new_cache
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn2_bias=None, quant_method="None", moe_topk=2,
+              norm_topk_prob=True, name=None):
+    """Parity: fused_moe (phi fusion). x: [B, S, E]; ffn1_weight:
+    [n_experts, E, 2*I or I]; ffn2_weight: [n_experts, I, E]. Dense
+    einsum dispatch: every token computes against its top-k experts via
+    one batched matmul per expert stack — the MXU-friendly formulation
+    (ragged all_to_all dispatch lives in incubate MoELayer for the
+    expert-parallel case)."""
+    from ...ops import einsum as _einsum
+    from ...ops._dispatch import apply as _apply
+    from ...ops.creation import _coerce as _c
+    import jax
+
+    args = [_c(x), _c(gate_weight), _c(ffn1_weight), _c(ffn2_weight)]
+    if ffn1_bias is not None:
+        args.append(_c(ffn1_bias))
+    if ffn2_bias is not None:
+        args.append(_c(ffn2_bias))
+    n_b1 = ffn1_bias is not None
+    n_b2 = ffn2_bias is not None
+
+    def fn(xv, gw, w1, w2, *rest):
+        it = iter(rest)
+        b1 = next(it) if n_b1 else None
+        b2 = next(it) if n_b2 else None
+        bsz, s, e = xv.shape
+        tokens = xv.reshape(-1, e)
+        logits = tokens @ gw                     # [T, n_exp]
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        n_exp = w1.shape[0]
+        inter = w1.shape[-1]
+        # dense dispatch: per-expert mask-weighted compute
+        weight_te = jnp.zeros((tokens.shape[0], n_exp), xv.dtype)
+        weight_te = weight_te.at[
+            jnp.arange(tokens.shape[0])[:, None], topi].set(topv)
+        h = jnp.einsum("td,edi->tei", tokens, w1)
+        if b1 is not None:
+            h = h + b1[None]
+        if inter == 2 * w2.shape[1]:
+            half = w2.shape[1]
+            h = jax.nn.silu(h[..., :half]) * h[..., half:]
+        else:
+            h = jax.nn.gelu(h)
+        out = jnp.einsum("tei,eio->teo", h, w2)
+        if b2 is not None:
+            out = out + b2[None]
+        out = jnp.einsum("teo,te->to", out, weight_te)
+        return out.reshape(bsz, s, e)
+    return _apply(fn, *args, _name="fused_moe")

@@ -200,3 +200,55 @@ Tensor.zero_ = _zero_
 scale_ = lambda x, *a, **kw: x.scale_(*a, **kw)  # noqa: E731
 clip_ = lambda x, *a, **kw: x.clip_(*a, **kw)  # noqa: E731
 tanh_ = lambda x, *a, **kw: x.tanh_(*a, **kw)  # noqa: E731
+
+
+# second batch of in-place variants (the long tail paddle exposes)
+_INPLACE2 = {
+    "sin_": math.sin, "cos_": math.cos, "erfinv_": math.erfinv,
+    "lerp_": math.lerp, "mod_": math.mod, "trunc_": math.trunc,
+    "renorm_": extras.renorm, "t_": manipulation.t,
+    "index_fill_": extras.index_fill,
+    "masked_fill_": manipulation.masked_fill,
+    "put_along_axis_": manipulation.put_along_axis,
+    "index_put_": manipulation.index_put,
+    "fill_diagonal_": manipulation.fill_diagonal,
+    "fill_diagonal_tensor_": manipulation.fill_diagonal_tensor,
+}
+for _n, _f in _INPLACE2.items():
+    setattr(Tensor, _n, _make_inplace(_f))
+    _patched.add(_n)
+
+Tensor.fill_diagonal_tensor = manipulation.fill_diagonal_tensor
+
+
+def _sigmoid_(self):
+    self._check_inplace()
+    import jax.nn as _jnn
+    return self._inplace_update(apply(_jnn.sigmoid, self))
+
+
+def _relu_(self):
+    self._check_inplace()
+    import jax.nn as _jnn
+    return self._inplace_update(apply(_jnn.relu, self))
+
+
+Tensor.sigmoid_ = _sigmoid_
+Tensor.relu_ = _relu_
+
+# small introspection methods (parity: pybind eager_method.cc)
+Tensor.element_size = lambda self: self._value.dtype.itemsize
+Tensor.nbytes = property(lambda self: self._value.nbytes)
+Tensor.ndimension = lambda self: self._value.ndim
+Tensor.dim = lambda self: self._value.ndim
+
+
+def _retain_grads(self):
+    """Non-leaf tensors keep .grad after backward (parity:
+    Tensor.retain_grads). The tape stores grads for any tensor with
+    _retain flag set."""
+    self._retain_grad = True
+    return self
+
+
+Tensor.retain_grads = _retain_grads

@@ -497,3 +497,55 @@ def unfold(x, axis, size, step, name=None):
         # paddle puts the window dim last
         return jnp.moveaxis(out, a + 1, -1)
     return apply(fn, _coerce(x))
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Out-of-place core of Tensor.fill_diagonal_ (parity:
+    python/paddle/tensor/manipulation.py fill_diagonal_)."""
+    def fn(v):
+        if v.ndim == 2:
+            h, w = v.shape
+            ii = jnp.arange(h)[:, None]
+            jj = jnp.arange(w)[None, :]
+            if wrap and h > w:
+                # numpy wrap rule: fill every (w+1)-th FLAT element, so
+                # the diagonal restarts one row below after running off
+                # the bottom
+                flat = ii * w + jj
+                mask = (flat - offset) % (w + 1) == 0
+                return jnp.where(mask, jnp.asarray(value, v.dtype), v)
+            mask = (jj - ii) == offset
+            return jnp.where(mask, jnp.asarray(value, v.dtype), v)
+        # n-dim: reference requires equal dims and no offset/wrap
+        if len(set(v.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal with ndim > 2 requires all dimensions "
+                f"equal, got shape {v.shape}")
+        if offset != 0 or wrap:
+            raise ValueError(
+                "fill_diagonal offset/wrap are 2-D only")
+        idx = jnp.arange(v.shape[0])
+        return v.at[tuple(idx for _ in range(v.ndim))].set(
+            jnp.asarray(value, v.dtype))
+    return apply(fn, _coerce(x))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor y onto the (dim1, dim2) diagonal of x (parity:
+    python/paddle/tensor/manipulation.py fill_diagonal_tensor)."""
+    d1, d2 = int(dim1), int(dim2)
+
+    def fn(v, yv):
+        nd = v.ndim
+        a, b = d1 % nd, d2 % nd
+        perm = [d for d in range(nd) if d not in (a, b)] + [a, b]
+        inv = [perm.index(d) for d in range(nd)]
+        vt = v.transpose(perm)                   # [..., H, W]
+        h, w = vt.shape[-2], vt.shape[-1]
+        n = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+        ii = jnp.arange(n) + (0 if offset >= 0 else -offset)
+        jj = jnp.arange(n) + (offset if offset >= 0 else 0)
+        # y already carries the diagonal as its last axis
+        vt = vt.at[..., ii, jj].set(yv)
+        return vt.transpose(inv)
+    return apply(fn, _coerce(x), _coerce(y))

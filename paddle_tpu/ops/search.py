@@ -179,3 +179,39 @@ def nanargmin(x, axis=None, keepdim=False, name=None):
         return jnp.nanargmin(v, axis=int(axis), keepdims=keepdim
                              ).astype(dtypes.int64)
     return apply(fn, _coerce(x))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (parity: python/paddle/tensor/search.py
+    top_p_sampling; upstream phi top_p_sampling CUDA kernel). x: [B, V]
+    probabilities; ps: [B] cumulative-probability cutoffs. Returns
+    (sampled probs [B, 1], token ids [B, 1])."""
+    from ..framework.random import next_key
+    # paddle sentinel: seed=-1 (the default) means non-deterministic
+    if seed is None or int(seed) < 0:
+        key = next_key()
+    else:
+        key = jax.random.PRNGKey(int(seed))
+    args = [_coerce(x), _coerce(ps)]
+    if threshold is not None:
+        args.append(_coerce(threshold))
+
+    def fn(v, p, *rest):
+        order = jnp.argsort(-v, axis=-1)
+        sorted_p = jnp.take_along_axis(v, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens while cumulative mass (exclusive) < p
+        keep = (cum - sorted_p) < p[:, None]
+        keep = keep.at[:, 0].set(True)  # always keep the top token
+        if rest:  # probability floor (paddle threshold semantics)
+            keep = jnp.logical_and(keep,
+                                   sorted_p >= rest[0].reshape(-1, 1))
+            keep = keep.at[:, 0].set(True)
+        masked = jnp.where(keep, sorted_p, 0.0)
+        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        pick = jax.random.categorical(key, jnp.log(masked + 1e-30),
+                                      axis=-1)                 # [B]
+        ids = jnp.take_along_axis(order, pick[:, None], axis=-1)
+        probs = jnp.take_along_axis(v, ids, axis=-1)
+        return probs, ids.astype(jnp.int64)
+    return apply(fn, *args)

@@ -1,0 +1,215 @@
+"""incubate fused-op functional surface + new Tensor methods
+(parity model: python/paddle/incubate/nn/functional tests — manual
+compositions as goldens)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(0)
+B, S, E, H = 2, 8, 16, 4
+D = E // H
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+class TestFusedAttention:
+    def test_fused_mha_matches_manual(self):
+        x = rng.randn(B, S, E).astype("float32")
+        qkv_w = rng.randn(3, H, D, E).astype("float32") * 0.1
+        lin_w = rng.randn(E, E).astype("float32") * 0.1
+        ones = np.ones(E, "float32")
+        zeros = np.zeros(E, "float32")
+        out = IF.fused_multi_head_attention(
+            t(x), t(qkv_w), t(lin_w), pre_layer_norm=True,
+            pre_ln_scale=t(ones), pre_ln_bias=t(zeros),
+            dropout_rate=0.0, attn_dropout_rate=0.0)
+        # manual composition
+        ln = F.layer_norm(t(x), E, t(ones), t(zeros), 1e-5)
+        qkv = np.einsum("bse,thde->bsthd", ln.numpy(), qkv_w)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ctx = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, S, E)
+        ref = ctx @ lin_w + x
+        np.testing.assert_allclose(out.numpy(), ref, atol=2e-4)
+
+    def test_fused_ffn(self):
+        x = rng.randn(B, S, E).astype("float32")
+        w1 = rng.randn(E, 32).astype("float32") * 0.1
+        w2 = rng.randn(32, E).astype("float32") * 0.1
+        out = IF.fused_feedforward(
+            t(x), t(w1), t(w2), pre_layer_norm=True,
+            ln1_scale=t(np.ones(E, "f4")), ln1_bias=t(np.zeros(E, "f4")),
+            dropout1_rate=0.0, dropout2_rate=0.0, activation="gelu")
+        ln = F.layer_norm(t(x), E, t(np.ones(E, "f4")),
+                          t(np.zeros(E, "f4")), 1e-5).numpy()
+        ref = F.gelu(t(ln @ w1)).numpy() @ w2 + x
+        np.testing.assert_allclose(out.numpy(), ref, atol=2e-4)
+
+    def test_varlen_attention_masks_tail(self):
+        q = rng.randn(B, H, S, D).astype("float32")
+        lens = np.array([S, S // 2], "int32")
+        out = IF.variable_length_memory_efficient_attention(
+            t(q), t(q), t(q), t(lens), t(lens))
+        mask = np.zeros((B, 1, 1, S), bool)
+        mask[0, ..., :S] = True
+        mask[1, ..., :S // 2] = True
+        qb = np.transpose(q, (0, 2, 1, 3))
+        s = np.einsum("bqhd,bkhd->bhqk", qb, qb) / np.sqrt(D)
+        s = np.where(mask, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", p, qb)
+        # query rows beyond seq_lens are zeroed by the op
+        ref[1, S // 2:] = 0.0
+        np.testing.assert_allclose(out.numpy(),
+                                   np.transpose(ref, (0, 2, 1, 3)),
+                                   atol=2e-4)
+
+    def test_masked_mmha_two_steps(self):
+        T = 6
+        cache = t(np.zeros((2, B, H, T, D), "float32"))
+        x1 = t(rng.randn(B, 3 * H * D).astype("float32"))
+        x2 = t(rng.randn(B, 3 * H * D).astype("float32"))
+        o1, cache = IF.masked_multihead_attention(x1, cache_kv=cache)
+        o2, cache = IF.masked_multihead_attention(x2, cache_kv=cache)
+        q2 = x2.numpy().reshape(B, 3, H, D)[:, 0]
+        k = np.stack([x1.numpy().reshape(B, 3, H, D)[:, 1],
+                      x2.numpy().reshape(B, 3, H, D)[:, 1]], axis=2)
+        v = np.stack([x1.numpy().reshape(B, 3, H, D)[:, 2],
+                      x2.numpy().reshape(B, 3, H, D)[:, 2]], axis=2)
+        s = np.einsum("bhd,bhtd->bht", q2, k) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bht,bhtd->bhd", p, v).reshape(B, H * D)
+        np.testing.assert_allclose(o2.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_moe_and_bias_act(self):
+        x = rng.randn(B, S, E).astype("float32")
+        nexp, inter = 4, 12
+        gw = rng.randn(E, nexp).astype("float32")
+        w1 = rng.randn(nexp, E, 2 * inter).astype("float32") * 0.1
+        w2 = rng.randn(nexp, inter, E).astype("float32") * 0.1
+        out = IF.fused_moe(t(x), t(gw), t(w1), t(w2), moe_topk=2)
+        assert out.shape == [B, S, E]
+        assert np.isfinite(out.numpy()).all()
+        # top-1 routing equals picking the argmax expert per token
+        out1 = IF.fused_moe(t(x), t(gw), t(w1), t(w2), moe_topk=1)
+        tok = x.reshape(-1, E)
+        ei = np.argmax(tok @ gw, axis=-1)
+        h = np.einsum("td,tdi->ti", tok, w1[ei])
+        sil = h[:, :inter] / (1 + np.exp(-h[:, :inter]))
+        hh = sil * h[:, inter:]
+        ref = np.einsum("ti,tio->to", hh, w2[ei]).reshape(B, S, E)
+        np.testing.assert_allclose(out1.numpy(), ref, atol=2e-4)
+        ba = IF.fused_bias_act(t(x), t(np.ones(E, "f4")),
+                               act_method="relu")
+        np.testing.assert_allclose(ba.numpy(), np.maximum(x + 1, 0),
+                                   atol=0)
+
+
+class TestTensorMethodTail:
+    def test_fill_diagonal_variants(self):
+        x = t(np.ones((4, 4), "float32"))
+        x.fill_diagonal_(5.0)
+        assert np.allclose(np.diag(x.numpy()), 5)
+        y = t(np.zeros((6, 3), "float32"))
+        y.fill_diagonal_(1.0, wrap=True)
+        ref = np.zeros((6, 3))
+        np.fill_diagonal(ref, 1.0, wrap=True)
+        np.testing.assert_array_equal(y.numpy(), ref)
+        z = t(np.zeros((3, 4), "float32"))
+        z.fill_diagonal_tensor_(t(np.array([1., 2, 3], "float32")))
+        assert np.allclose(np.diag(z.numpy()[:, :3]), [1, 2, 3])
+
+    def test_top_p_sampling(self):
+        x = t(np.array([[0.5, 0.3, 0.1, 0.1]], "float32"))
+        probs, ids = paddle.top_p_sampling(
+            x, t(np.array([0.7], "float32")))
+        assert ids.numpy()[0, 0] in (0, 1)
+        assert probs.shape == [1, 1]
+
+    def test_inplace_tail_and_introspection(self):
+        x = t(np.array([1.0, 2.0], "float32"))
+        x.sin_()
+        np.testing.assert_allclose(x.numpy(), np.sin([1.0, 2.0]),
+                                   rtol=1e-6)
+        x2 = t(np.array([-1.0, 2.0], "float32"))
+        x2.relu_()
+        np.testing.assert_allclose(x2.numpy(), [0.0, 2.0])
+        assert x.element_size() == 4
+        assert x.dim() == 1 and x.ndimension() == 1
+        assert x.nbytes == 8
+        m = t(np.ones((2, 3), "float32"))
+        m.t_()
+        assert m.shape == [3, 2]
+
+
+class TestReviewRegressions:
+    def test_retain_grads(self):
+        x = t(np.array([2.0, 3.0], "float32"))
+        x.stop_gradient = False
+        y = x * x
+        y.retain_grads()
+        loss = (y * 2).sum()
+        loss.backward()
+        assert y.grad is not None
+        np.testing.assert_allclose(y.grad.numpy(), [2.0, 2.0])
+
+    def test_fused_mha_cache_decode(self):
+        x0 = rng.randn(B, 4, E).astype("float32")
+        x1 = rng.randn(B, 1, E).astype("float32")
+        qkv_w = rng.randn(3, H, D, E).astype("float32") * 0.1
+        lin_w = rng.randn(E, E).astype("float32") * 0.1
+        empty = t(np.zeros((2, B, 0, H, D), "float32"))
+        out0, cache = IF.fused_multi_head_attention(
+            t(x0), t(qkv_w), t(lin_w), pre_layer_norm=True,
+            pre_ln_scale=t(np.ones(E, "f4")),
+            pre_ln_bias=t(np.zeros(E, "f4")), cache_kv=empty,
+            dropout_rate=0.0, attn_dropout_rate=0.0)
+        assert cache.shape == [2, B, 4, H, D]
+        out1, cache = IF.fused_multi_head_attention(
+            t(x1), t(qkv_w), t(lin_w), pre_layer_norm=True,
+            pre_ln_scale=t(np.ones(E, "f4")),
+            pre_ln_bias=t(np.zeros(E, "f4")), cache_kv=cache,
+            dropout_rate=0.0, attn_dropout_rate=0.0)
+        assert cache.shape == [2, B, 5, H, D]
+        assert out1.shape == [B, 1, E]
+
+    def test_unsupported_args_raise(self):
+        import pytest
+        cache = t(np.zeros((2, B, H, 4, D), "float32"))
+        x = t(rng.randn(B, 3 * H * D).astype("float32"))
+        with pytest.raises(NotImplementedError):
+            IF.masked_multihead_attention(
+                x, cache_kv=cache, rotary_tensor=x, rotary_emb_dims=1)
+        with pytest.raises(NotImplementedError):
+            IF.variable_length_memory_efficient_attention(
+                t(rng.randn(B, H, 4, D).astype("f4")),
+                t(rng.randn(B, H, 4, D).astype("f4")),
+                t(rng.randn(B, H, 4, D).astype("f4")),
+                t(np.array([4, 4], "i4")), t(np.array([4, 4], "i4")),
+                pre_cache_length=2)
+        with pytest.raises(ValueError):
+            paddle.to_tensor(np.zeros((2, 3, 4), "f4")).fill_diagonal_(1.0)
+
+    def test_top_p_seed_semantics(self):
+        x = t(np.tile(np.array([[0.4, 0.3, 0.2, 0.1]], "float32"),
+                      (64, 1)))
+        ps = t(np.full((64,), 0.95, "float32"))
+        _, ids1 = paddle.top_p_sampling(x, ps, seed=-1)
+        _, ids2 = paddle.top_p_sampling(x, ps, seed=-1)
+        # seed=-1 is the "random" sentinel: two calls differ somewhere
+        assert not np.array_equal(ids1.numpy(), ids2.numpy())
+        _, f1 = paddle.top_p_sampling(x, ps, seed=7)
+        _, f2 = paddle.top_p_sampling(x, ps, seed=7)
+        np.testing.assert_array_equal(f1.numpy(), f2.numpy())
+        # threshold floors out low-probability tokens
+        _, ids = paddle.top_p_sampling(
+            x, ps, threshold=t(np.full((64, 1), 0.25, "float32")))
+        assert set(np.unique(ids.numpy())) <= {0, 1}

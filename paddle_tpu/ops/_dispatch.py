@@ -129,6 +129,20 @@ def _make_backward(fn, arrays, vjp_fn, multi_out, out_shapes, out_dtypes,
     return backward_fn
 
 
+#: Active static.Program capturing the op stream (set by
+#: static.program_guard). Each recorded entry is (fn, input refs, output
+#: tensors); Executor.run replays them with substituted feed values —
+#: the facade's stand-in for the reference's ProgramDesc op list.
+_static_recorder = None
+
+
+def _record_static(fn, args, result):
+    if _static_recorder is not None:
+        outs = list(result) if isinstance(result, tuple) else [result]
+        _static_recorder._build_ops.append((fn, list(args), outs))
+    return result
+
+
 def apply(fn: Callable, *args, _name: str = ""):
     """Run `fn(*arrays)` with tape recording.
 
@@ -162,7 +176,7 @@ def apply(fn: Callable, *args, _name: str = ""):
         if not diff_in_idx:
             needs_grad = False
     if not needs_grad:
-        return _wrap_outputs(fn(*arrays), None)
+        return _record_static(fn, args, _wrap_outputs(fn(*arrays), None))
 
     if any(_is_tracer(a) for a in arrays):
         # Inside an outer jax trace (TrainStep / functionalize / jit.grad):
@@ -172,7 +186,7 @@ def apply(fn: Callable, *args, _name: str = ""):
         # Pallas kernels cannot survive (pallas_call has no JVP rule:
         # "Linearization failed to produce known values"). Record nothing;
         # the eager tape is only meaningful on concrete values.
-        return _wrap_outputs(fn(*arrays), None)
+        return _wrap_outputs(fn(*arrays), None)  # tracer: no static record
 
     out, vjp_fn = jax.vjp(fn, *arrays)
     multi_out = isinstance(out, (tuple, list))
@@ -181,14 +195,14 @@ def apply(fn: Callable, *args, _name: str = ""):
     out_dtypes = [o.dtype for o in outs_list]
     if not any(_is_inexact(d) for d in out_dtypes):
         # all-integer outputs (argmax etc.) — nothing to differentiate
-        return _wrap_outputs(out, None)
+        return _record_static(fn, args, _wrap_outputs(out, None))
     tensor_inputs = [a if isinstance(a, Tensor) else None for a in args]
     node = GradNode(
         _make_backward(fn, arrays, vjp_fn, multi_out, out_shapes, out_dtypes,
                        diff_in_idx, tensor_inputs),
         tensor_inputs, outs_list,
         name=_name or getattr(fn, "__name__", "op"))
-    return _wrap_outputs(out, node)
+    return _record_static(fn, args, _wrap_outputs(out, node))
 
 
 # ---------------------------------------------------------------------------

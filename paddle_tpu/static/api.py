@@ -91,14 +91,23 @@ def default_startup_program():
 
 
 class program_guard:
+    """Enters a Program: ops built inside are captured into the
+    program's replay list (the facade's ProgramDesc), so Executor.run
+    can re-execute them against real feed values."""
+
     def __init__(self, main_program, startup_program=None):
         self.main = main_program
 
     def __enter__(self):
+        from ..ops import _dispatch
         _program_stack.append(self.main)
+        self._prev_rec = _dispatch._static_recorder
+        _dispatch._static_recorder = self.main
         return self.main
 
     def __exit__(self, *exc):
+        from ..ops import _dispatch
+        _dispatch._static_recorder = self._prev_rec
         _program_stack.pop()
         return False
 
@@ -135,28 +144,28 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
+        """Bind feeds into the program's placeholders and REPLAY the
+        captured op list (recorded order == topological order), so fetch
+        targets reflect the fed values — the InterpreterCore role of the
+        reference, executed by XLA op-by-op with fusion inside each op's
+        traced fn."""
         feed = feed or {}
         fetch_list = fetch_list or []
         program = program or default_main_program()
-        # bind feeds into placeholders, then the recorded graph tensors are
-        # already the eager results of the build pass IF no feeds changed.
-        # With feeds we must re-evaluate: the simple, correct approach is
-        # that the build pass ran eagerly on placeholder zeros, so we re-run
-        # by substituting feed values and replaying dependent computation.
-        # For the facade we support the dominant pattern: fetch targets are
-        # pure functions of placeholders captured via jit tracing.
         for name, val in feed.items():
             if name in program._placeholders:
                 t = program._placeholders[name]
                 arr = val._value if isinstance(val, Tensor) else jnp.asarray(val)
                 t._value = arr.astype(t._value.dtype) if arr.dtype != t._value.dtype else arr
+        for fn, args, outs_t in program._build_ops:
+            arrs = [a._value if isinstance(a, Tensor) else a for a in args]
+            res = fn(*arrs)
+            res_l = list(res) if isinstance(res, (tuple, list)) else [res]
+            for t, o in zip(outs_t, res_l):
+                t._value = o
         outs = []
         for f in fetch_list:
             if isinstance(f, Tensor):
-                # re-run the tape that produced f is implicit: eager ops
-                # already consumed the updated placeholder values only if
-                # the user builds inside run; for prebuilt graphs users
-                # should use paddle_tpu.jit.to_static (documented).
                 outs.append(np.asarray(f._value) if return_numpy else f)
             else:
                 outs.append(f)

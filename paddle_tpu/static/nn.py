@@ -101,3 +101,145 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
         from ..nn import functional as F
         out = getattr(F, activation)(out)
     return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32",
+              name=None):
+    """Parity: paddle.static.nn.embedding."""
+    from ..nn.layers_common import Embedding
+    emb = Embedding(size[0], size[1], padding_idx=padding_idx,
+                    weight_attr=param_attr)
+    return emb(_coerce(input))
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """Parity: paddle.static.nn.batch_norm."""
+    from ..nn.layers_common import BatchNorm2D, BatchNorm1D, BatchNorm3D
+    x = _coerce(input)
+    ch_axis = 1 if data_layout == "NCHW" else -1
+    num = x.shape[ch_axis]
+    cls = {3: BatchNorm1D, 4: BatchNorm2D, 5: BatchNorm3D}.get(x.ndim,
+                                                               BatchNorm1D)
+    bn = cls(num, momentum=momentum, epsilon=epsilon,
+             weight_attr=param_attr, bias_attr=bias_attr,
+             data_format=data_layout if x.ndim == 4 else "NCL")
+    if is_test or use_global_stats:
+        bn.eval()
+    out = bn(x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """Parity: paddle.static.nn.conv2d."""
+    from ..nn.layers_common import Conv2D
+    x = _coerce(input)
+    cin = x.shape[1 if data_format == "NCHW" else -1]
+    conv = Conv2D(cin, num_filters, filter_size, stride=stride,
+                  padding=padding, dilation=dilation, groups=groups,
+                  weight_attr=param_attr, bias_attr=bias_attr,
+                  data_format=data_format)
+    out = conv(x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """Parity: paddle.static.nn.conv2d_transpose."""
+    from ..nn.layers_common import Conv2DTranspose
+    x = _coerce(input)
+    cin = x.shape[1 if data_format == "NCHW" else -1]
+    conv = Conv2DTranspose(cin, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation,
+                           groups=groups, weight_attr=param_attr,
+                           bias_attr=bias_attr, data_format=data_format)
+    out = conv(x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    """Parity: paddle.static.nn.dropout (old fluid semantics)."""
+    from ..nn import functional as F
+    mode = ("downscale_in_infer"
+            if dropout_implementation == "downgrade_in_infer"
+            else "upscale_in_train")
+    return F.dropout(_coerce(x), dropout_prob, training=not is_test,
+                     mode=mode)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Parity: paddle.static.nn.layer_norm (normalizes over
+    [begin_norm_axis:])."""
+    import numpy as _np
+    from ..nn.layers_common import LayerNorm
+    x = _coerce(input)
+    shape = x.shape[begin_norm_axis:]
+    ln = LayerNorm(shape, epsilon=epsilon,
+                   weight_attr=param_attr if scale else False,
+                   bias_attr=bias_attr if shift else False)
+    out = ln(x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """Parity: paddle.static.nn.prelu."""
+    from ..nn.layers_common import PReLU
+    xc = _coerce(x)
+    num = {"all": 1, "channel": xc.shape[1], "element": None}.get(mode, 1)
+    if num is None:
+        import numpy as _np
+        num = int(_np.prod(xc.shape[1:]))
+    layer = PReLU(num_parameters=num, weight_attr=param_attr,
+                  data_format=data_format)
+    return layer(xc)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """Parity: paddle.static.nn.group_norm."""
+    from ..nn.layers_common import GroupNorm
+    x = _coerce(input)
+    gn = GroupNorm(groups, x.shape[1], epsilon=epsilon,
+                   weight_attr=param_attr, bias_attr=bias_attr)
+    out = gn(x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Parity: paddle.static.nn.spectral_norm — the normalized weight."""
+    from ..nn.layers_common import SpectralNorm
+    w = _coerce(weight)
+    sn = SpectralNorm(w.shape, dim=dim, power_iters=power_iters, eps=eps)
+    return sn(w)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    raise NotImplementedError(
+        "LoD sequence ops have no TPU-native equivalent (LoD tensors are "
+        "a legacy CPU format); use dense padded batches + sequence_mask")

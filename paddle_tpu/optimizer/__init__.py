@@ -1,6 +1,7 @@
 """paddle.optimizer parity namespace (python/paddle/optimizer/__init__.py)."""
 from .optimizer import (
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, Adamax, RMSProp, Lamb,
+    Adadelta, Rprop, ASGD, NAdam, RAdam,
 )
 from .lbfgs import LBFGS
 from . import lr

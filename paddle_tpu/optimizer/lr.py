@@ -307,3 +307,23 @@ class ReduceOnPlateau(LRScheduler):
             self.last_lr = max(self.last_lr * self.factor, self.min_lr)
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
+
+
+class LinearLR(LRScheduler):
+    """Parity: paddle.optimizer.lr.LinearLR — linearly interpolate the
+    lr multiplier from start_factor to end_factor over total_steps."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        f = (self.start_factor
+             + (self.end_factor - self.start_factor) * t / self.total_steps)
+        return self.base_lr * f

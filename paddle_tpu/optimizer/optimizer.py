@@ -652,3 +652,260 @@ def _lamb_fn_apply(self, p, g, s, lr, name, param=None):
 
 Lamb._fn_init = _lamb_fn_init
 Lamb._fn_apply = _lamb_fn_apply
+
+
+# ---------------------------------------------------------------------------
+# second-wave optimizers (parity: python/paddle/optimizer/{adadelta,rprop,
+# asgd,nadam,radam}.py; upstream phi kernels of the same names). Same
+# contract as the rest of the file: a jitted math kernel for the eager
+# path + a _fn_init/_fn_apply pair so TrainStep/DistTrainStep can run the
+# update inside the one compiled program.
+# ---------------------------------------------------------------------------
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.epsilon, self.rho = epsilon, rho
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        avg_sq = self._get_accumulator("avg_squared_grad", p)
+        avg_up = self._get_accumulator("avg_squared_update", p)
+        new_p, new_sq, new_up = _adadelta_kernel(
+            p._value, g, avg_sq, avg_up, lr, self.rho, self.epsilon)
+        self._set_accumulator("avg_squared_grad", p, new_sq)
+        self._set_accumulator("avg_squared_update", p, new_up)
+        return new_p
+
+
+def _adadelta_math(p, g, sq, up, lr, rho, eps):
+    sq2 = rho * sq + (1 - rho) * g * g
+    delta = jnp.sqrt(up + eps) / jnp.sqrt(sq2 + eps) * g
+    up2 = rho * up + (1 - rho) * delta * delta
+    return p - lr * delta, sq2, up2
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self.lr_range = learning_rate_range
+        self.etas = etas
+
+    def _update(self, p, g, lr):
+        prev_g = self._get_accumulator("prev_grad", p)
+        step = self._get_accumulator(
+            "learning_rate", p,
+            init=lambda a: jnp.full_like(a, lr))
+        new_p, new_g, new_step = _rprop_kernel(
+            p._value, g, prev_g, step, self.etas[0], self.etas[1],
+            self.lr_range[0], self.lr_range[1])
+        self._set_accumulator("prev_grad", p, new_g)
+        self._set_accumulator("learning_rate", p, new_step)
+        return new_p
+
+
+def _rprop_math(p, g, pg, step, eta_neg, eta_pos, lr_min, lr_max):
+    sign = jnp.sign(g * pg)
+    factor = jnp.where(sign > 0, eta_pos, jnp.where(sign < 0, eta_neg, 1.0))
+    step2 = jnp.clip(step * factor, lr_min, lr_max)
+    # on sign change the step is retracted: gradient treated as 0
+    g_eff = jnp.where(sign < 0, 0.0, g)
+    return p - step2 * jnp.sign(g_eff), g_eff, step2
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (parity: paddle.optimizer.ASGD): plain SGD steps
+    plus a running average of the iterates over the trailing window."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.batch_num = batch_num
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        d = self._get_accumulator("d", p)
+        ys = self._get_accumulator("ys", p)
+        n = self._get_accumulator("n", p,
+                                  init=lambda a: jnp.zeros((), jnp.int32))
+        new_p, d2, ys2, n2 = _asgd_kernel(p._value, g, d, ys, n, lr,
+                                          self.batch_num)
+        self._set_accumulator("d", p, d2)
+        self._set_accumulator("ys", p, ys2)
+        self._set_accumulator("n", p, n2)
+        return new_p
+
+
+def _asgd_math(p, g, d, ys, n, lr, batch_num):
+    # reference ASGD: d_t = d_{t-1} - y_old + g; y stores the last
+    # batch_num grads as a running sum approximation (single-slot here:
+    # the upstream kernel keeps batch_num slots; the sum is what enters
+    # the update, so one running slot with decay matches for
+    # batch_num=1 and approximates larger windows)
+    y_old = ys
+    d2 = d - y_old + g
+    n2 = jnp.minimum(n + 1, batch_num).astype(n.dtype)
+    return (p - lr / jnp.maximum(n2.astype(p.dtype), 1.0) * d2, d2, g, n2)
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.momentum_decay = momentum_decay
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        mu_prod = self._get_accumulator(
+            "mu_product", p, init=lambda a: jnp.ones((), a.dtype))
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new = _nadam_kernel(p._value, g, m, v, mu_prod, t, lr, self.beta1,
+                            self.beta2, self.epsilon, self.momentum_decay)
+        new_p, m2, v2, mp2, t2 = new
+        self._set_accumulator("moment1", p, m2)
+        self._set_accumulator("moment2", p, v2)
+        self._set_accumulator("mu_product", p, mp2)
+        self._set_accumulator("step", p, t2)
+        return new_p
+
+
+def _nadam_math(p, g, m, v, mu_prod, t, lr, b1, b2, eps, psi):
+    t2 = t + 1
+    tf = t2.astype(p.dtype)
+    mu_t = b1 * (1 - 0.5 * 0.96 ** (tf * psi))
+    mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((tf + 1) * psi))
+    mp2 = mu_prod * mu_t
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    m_hat = mu_t1 * m2 / (1 - mp2 * mu_t1) + (1 - mu_t) * g / (1 - mp2)
+    v_hat = v2 / (1 - b2 ** tf)
+    return p - lr * m_hat / (jnp.sqrt(v_hat) + eps), m2, v2, mp2, t2
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _update(self, p, g, lr):
+        if self._regularization_coeff:
+            g = g + self._regularization_coeff * p._value
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        t = self._get_accumulator("step", p,
+                                  init=lambda x: jnp.zeros((), jnp.int32))
+        new_p, m2, v2, t2 = _radam_kernel(p._value, g, m, v, t, lr,
+                                          self.beta1, self.beta2,
+                                          self.epsilon)
+        self._set_accumulator("moment1", p, m2)
+        self._set_accumulator("moment2", p, v2)
+        self._set_accumulator("step", p, t2)
+        return new_p
+
+
+def _radam_math(p, g, m, v, t, lr, b1, b2, eps):
+    # reference convention (paddle radam kernel == torch.optim.RAdam):
+    # rectify when rho_t > 5; eps is added to the RAW sqrt(v), the
+    # bias correction rides the adaptive-lr numerator
+    t2 = t + 1
+    tf = t2.astype(p.dtype)
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    bc1 = 1 - b1 ** tf
+    bc2 = 1 - b2 ** tf
+    m_hat = m2 / bc1
+    rho_inf = 2.0 / (1 - b2) - 1
+    rho_t = rho_inf - 2 * tf * b2 ** tf / bc2
+    r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+    r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+    r = jnp.sqrt(jnp.maximum(r_num / jnp.maximum(r_den, 1e-30), 0.0))
+    adaptive = jnp.sqrt(bc2) / (jnp.sqrt(v2) + eps)
+    upd = jnp.where(rho_t > 5.0, r * adaptive * m_hat, m_hat)
+    return p - lr * upd, m2, v2, t2
+
+
+_adadelta_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3))(_adadelta_math)
+_rprop_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3))(_rprop_math)
+_asgd_kernel = functools.partial(
+    jax.jit, static_argnums=(6,), donate_argnums=(0, 2, 3))(_asgd_math)
+_nadam_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3, 4))(_nadam_math)
+_radam_kernel = functools.partial(
+    jax.jit, donate_argnums=(0, 2, 3))(_radam_math)
+
+
+def _adadelta_fn_init(self, a):
+    return {"avg_squared_grad": jnp.zeros_like(a),
+            "avg_squared_update": jnp.zeros_like(a)}
+
+
+def _adadelta_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, sq2, up2 = _adadelta_math(p, g, s["avg_squared_grad"],
+                                  s["avg_squared_update"], lr, self.rho,
+                                  self.epsilon)
+    return p2, {"avg_squared_grad": sq2, "avg_squared_update": up2}
+
+
+Adadelta._fn_init = _adadelta_fn_init
+Adadelta._fn_apply = _adadelta_fn_apply
+
+
+def _nadam_fn_init(self, a):
+    return {"moment1": jnp.zeros_like(a), "moment2": jnp.zeros_like(a),
+            "mu_product": jnp.ones((), a.dtype),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _nadam_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, m2, v2, mp2, t2 = _nadam_math(
+        p, g, s["moment1"], s["moment2"], s["mu_product"], s["step"], lr,
+        self.beta1, self.beta2, self.epsilon, self.momentum_decay)
+    return p2, {"moment1": m2, "moment2": v2, "mu_product": mp2,
+                "step": t2}
+
+
+NAdam._fn_init = _nadam_fn_init
+NAdam._fn_apply = _nadam_fn_apply
+
+
+def _radam_fn_init(self, a):
+    return {"moment1": jnp.zeros_like(a), "moment2": jnp.zeros_like(a),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def _radam_fn_apply(self, p, g, s, lr, name, param=None):
+    if self._regularization_coeff:
+        g = g + self._regularization_coeff * p
+    p2, m2, v2, t2 = _radam_math(p, g, s["moment1"], s["moment2"],
+                                 s["step"], lr, self.beta1, self.beta2,
+                                 self.epsilon)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+RAdam._fn_init = _radam_fn_init
+RAdam._fn_apply = _radam_fn_apply

@@ -125,3 +125,36 @@ class RecordEvent:
 
 def load_profiler_result(filename):
     raise NotImplementedError("open the trace directory in TensorBoard")
+
+
+class SortedKeys(enum.Enum):
+    """Parity: paddle.profiler.SortedKeys — summary sort orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """Parity: paddle.profiler.SummaryView."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """Parity: paddle.profiler.export_protobuf. The jax profiler's
+    native artifact IS a protobuf (XPlane .pb inside the trace dir), so
+    this returns the same on-trace-ready handler as
+    export_chrome_tracing pointed at dir_name."""
+    return export_chrome_tracing(dir_name, worker_name)

@@ -484,3 +484,106 @@ class TestCompiledGradScaler:
                          scaler=GradScaler(enable=False))
         x = paddle.to_tensor(np.ones((2, 4), np.float32))
         assert np.isfinite(float(step(x, x)))
+
+
+class TestSecondWaveOptimizers:
+    """Adadelta/Rprop/NAdam/RAdam vs torch-cpu goldens (the reference's
+    kernels share these conventions); ASGD loss-decrease check
+    (windowed-grad semantics have no torch twin)."""
+
+    def _train_pair(self, opt_name, torch_cls, p_kwargs=None,
+                    t_kwargs=None, steps=8):
+        import torch
+        rng_ = np.random.RandomState(0)
+        x_np = rng_.randn(16, 4).astype("float32")
+        y_np = rng_.randn(16, 1).astype("float32")
+        w0 = rng_.randn(4, 1).astype("float32") * 0.5
+        lin = nn.Linear(4, 1)
+        lin.weight.set_value(paddle.to_tensor(w0))
+        lin.bias.set_value(paddle.to_tensor(np.zeros(1, "float32")))
+        opt = getattr(paddle.optimizer, opt_name)(
+            learning_rate=0.05, parameters=lin.parameters(),
+            **(p_kwargs or {}))
+        for _ in range(steps):
+            loss = ((lin(paddle.to_tensor(x_np))
+                     - paddle.to_tensor(y_np)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        tl = torch.nn.Linear(4, 1)
+        with torch.no_grad():
+            tl.weight.copy_(torch.tensor(w0.T))
+            tl.bias.zero_()
+        topt = torch_cls(tl.parameters(), lr=0.05, **(t_kwargs or {}))
+        for _ in range(steps):
+            tloss = ((tl(torch.tensor(x_np))
+                      - torch.tensor(y_np)) ** 2).mean()
+            topt.zero_grad()
+            tloss.backward()
+            topt.step()
+        np.testing.assert_allclose(lin.weight.numpy().ravel(),
+                                   tl.weight.detach().numpy().ravel(),
+                                   atol=3e-4, err_msg=opt_name)
+
+    def test_adadelta(self):
+        import torch
+        self._train_pair("Adadelta", torch.optim.Adadelta,
+                         {"rho": 0.9, "epsilon": 1e-6},
+                         {"rho": 0.9, "eps": 1e-6})
+
+    def test_radam(self):
+        import torch
+        self._train_pair("RAdam", torch.optim.RAdam,
+                         {"beta1": 0.9, "beta2": 0.999},
+                         {"betas": (0.9, 0.999)})
+
+    def test_nadam(self):
+        import torch
+        self._train_pair("NAdam", torch.optim.NAdam,
+                         {"beta1": 0.9, "beta2": 0.999},
+                         {"betas": (0.9, 0.999)})
+
+    def test_rprop(self):
+        import torch
+        self._train_pair("Rprop", torch.optim.Rprop)
+
+    def test_asgd_decreases_loss(self):
+        rng_ = np.random.RandomState(0)
+        x_np = rng_.randn(16, 4).astype("float32")
+        y_np = rng_.randn(16, 1).astype("float32")
+        lin = nn.Linear(4, 1)
+        opt = paddle.optimizer.ASGD(0.05, parameters=lin.parameters())
+        losses = []
+        for _ in range(8):
+            loss = ((lin(paddle.to_tensor(x_np))
+                     - paddle.to_tensor(y_np)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_adadelta_in_compiled_trainstep(self):
+        from paddle_tpu.jit.bridge import TrainStep
+        rng_ = np.random.RandomState(0)
+        model = nn.Linear(6, 2)
+        opt = paddle.optimizer.Adadelta(0.1,
+                                        parameters=model.parameters())
+        step = TrainStep(model, opt,
+                         lambda out, y: ((out - y) ** 2).mean())
+        x = paddle.to_tensor(rng_.randn(8, 6).astype("float32"))
+        y = paddle.to_tensor(rng_.randn(8, 2).astype("float32"))
+        l0 = float(step(x, y))
+        for _ in range(5):
+            l1 = float(step(x, y))
+        assert l1 < l0
+
+    def test_linear_lr(self):
+        sch = paddle.optimizer.lr.LinearLR(0.1, total_steps=4,
+                                           start_factor=0.5)
+        vals = []
+        for _ in range(6):
+            vals.append(sch())
+            sch.step()
+        assert abs(vals[0] - 0.05) < 1e-9
+        assert abs(vals[4] - 0.1) < 1e-9 and abs(vals[5] - 0.1) < 1e-9

@@ -98,7 +98,9 @@ class DistributedModel(Layer):
             acc = int(st.pipeline_configs.get("accumulate_steps", 1) or 1)
             self._train_step = PipelineTrainStep(
                 self._layers, opt, loss_fn,
-                num_microbatches=max(acc, 1), mesh=mesh)
+                num_microbatches=max(acc, 1), mesh=mesh,
+                num_virtual_stages=getattr(self._layers,
+                                           "_num_virtual_stages", 1))
             return self._train_step
         self._train_step = DistTrainStep(
             self._layers, opt, loss_fn, n_model_inputs=n_model_inputs,

@@ -1,9 +1,11 @@
-"""Pipeline parallelism: the compiled GPipe schedule over the 'stage' axis.
+"""Pipeline parallelism: compiled GPipe and interleaved virtual-stage
+schedules over the 'stage' axis.
 
 Reference parity: fleet/meta_parallel/pipeline_parallel.py (PipelineParallel
-with 1F1B/GPipe interleaving) + pp_utils/p2p_communication.py (send/recv of
-stage boundary activations). TPU-native design is radically different from
-the reference's rank-local 1F1B interpreter:
+with 1F1B/GPipe, PipelineParallelWithInterleave for virtual stages) +
+pp_utils/p2p_communication.py (send/recv of stage boundary activations).
+TPU-native design is radically different from the reference's rank-local
+1F1B interpreter:
 
 - Single-controller SPMD: the *stacked* per-stage parameters live as one
   array per leaf with a leading [num_stages] dim, sharded over the mesh's
@@ -115,8 +117,34 @@ def _run_layers(layers, p_tensors, p_vals, b_tensors, b_vals, x_val,
 
 
 # ---------------------------------------------------------------------------
-# the scanned-shard_map GPipe schedule
+# the scanned-shard_map schedules (GPipe and interleaved)
 # ---------------------------------------------------------------------------
+
+def _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh, axis):
+    """Shared harness for both schedules: manual over the 'stage' axis,
+    auto over everything else; params sharded on their leading chunk dim,
+    activations/key replicated in-spec (the stage body's own TP tags
+    compose via GSPMD).
+
+    check_vma=True is required: this jax version's partial-manual
+    shard_map mis-builds internal specs with check_vma=False.
+    """
+    run = jax.shard_map(
+        staged, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                  P(), P()),
+        out_specs=P(axis),
+        axis_names={axis}, check_vma=True)
+    outs = run(stacked_params, x_micro,
+               rng_key if rng_key is not None else jax.random.key(0))
+    return outs[-1]
+
+
+def _varying(axis, val):
+    """Mark a scan carry stage-varying up front (scan requires carry
+    types invariant across iterations)."""
+    return jax.lax.pcast(val, (axis,), to="varying")
+
 
 def pipeline_spmd(body_fn: Callable, stacked_params, x_micro, *,
                   num_stages: int, mesh: Mesh, rng_key=None,
@@ -147,13 +175,9 @@ def pipeline_spmd(body_fn: Callable, stacked_params, x_micro, *,
         # p_local leaves: [1, ...] (this stage's slice); xm replicated
         sid = jax.lax.axis_index(axis)
         p_mine = jax.tree_util.tree_map(lambda a: a[0], p_local)
-        # mark the carries stage-varying up front (scan requires carry
-        # types to be invariant across iterations)
-        state0 = jax.lax.pcast(jnp.zeros(xm.shape[1:], xm.dtype), (axis,),
-                               to="varying")
-        outbuf0 = jax.lax.pcast(
-            jnp.zeros((M,) + tuple(xm.shape[1:]), xm.dtype), (axis,),
-            to="varying")
+        state0 = _varying(axis, jnp.zeros(xm.shape[1:], xm.dtype))
+        outbuf0 = _varying(
+            axis, jnp.zeros((M,) + tuple(xm.shape[1:]), xm.dtype))
 
         def tick(carry, t):
             state, outbuf = carry
@@ -177,18 +201,95 @@ def pipeline_spmd(body_fn: Callable, stacked_params, x_micro, *,
                                       jnp.arange(M + S - 1))
         return outbuf[None]  # [1, M, Bm, ...] -> concat over 'stage'
 
-    # check_vma=True is required: this jax version's partial-manual
-    # shard_map mis-builds internal specs with check_vma=False
-    run = jax.shard_map(
-        staged, mesh=mesh,
-        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
-                  P(), P()),
-        out_specs=P(axis),
-        axis_names={axis}, check_vma=True)
-    outs = run(stacked_params, x_micro,
-               rng_key if rng_key is not None
-               else jax.random.key(0))
-    return outs[-1]
+    return _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh,
+                           axis)
+
+
+def pipeline_spmd_interleaved(body_fn: Callable, stacked_params, x_micro,
+                              *, num_stages: int, num_virtual: int,
+                              mesh: Mesh, rng_key=None,
+                              use_remat: bool = True, axis: str = "stage"):
+    """Interleaved virtual-stage schedule (reference parity:
+    fleet/meta_parallel/pipeline_parallel.py
+    PipelineParallelWithInterleave). Each device owns V chunks — chunk c
+    lives on device c mod S — so an activation crosses every device V
+    times and the pipeline fill/drain bubble shrinks from (S-1)/M
+    microbatch-slots to (S-1) CHUNK-slots out of M*V.
+
+    Single-controller formulation: activations circulate the same
+    ppermute ring as the GPipe schedule, but each carries (microbatch,
+    chunk) int tags. Per tick a device selects its local param slice
+    chunk//S with a dynamic index, device 0 injects new microbatches in
+    waves of S (the injection slots provably coincide with recycled
+    dead slots, so the schedule is tight), and device S-1 writes
+    completed microbatches (chunk == S*V-1). Backward is jax.grad
+    through the scan — XLA reverses the schedule, tags are int
+    (non-differentiable) carry.
+
+    stacked_params leaves: [S*V, ...] in RING-LOCAL order — position
+    p = (c mod S) * V + c // S — so sharding dim 0 over 'stage' lands
+    chunk c on device c mod S with local index c // S.
+    x_micro: [M, Bm, ...]. Returns [M, Bm, ...] final-chunk outputs.
+    """
+    S, V = int(num_stages), int(num_virtual)
+    M = int(x_micro.shape[0])
+    C = S * V
+    W = S * V  # wave period: device 0 is busy C ticks per S microbatches
+    T = ((M - 1) // S) * W + ((M - 1) % S) + C
+    body = jax.checkpoint(body_fn) if use_remat else body_fn
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def staged(p_local, xm, key):
+        sid = jax.lax.axis_index(axis)
+        # p_local leaves: [V, ...] — this device's chunk stack
+        state0 = _varying(axis, jnp.zeros(xm.shape[1:], xm.dtype))
+        tag0 = _varying(axis, jnp.full((2,), -1, jnp.int32))
+        outbuf0 = _varying(
+            axis, jnp.zeros((M,) + tuple(xm.shape[1:]), xm.dtype))
+
+        def tick(carry, t):
+            act, tags, outbuf = carry
+            m_tag, c_tag = tags[0], tags[1]
+            w = t // W
+            r = t - w * W
+            m_new = w * S + r
+            inject = jnp.logical_and(
+                sid == 0, jnp.logical_and(r < S, m_new < M))
+            m_in = jnp.where(inject, m_new, m_tag)
+            c_in = jnp.where(inject, 0, c_tag)
+            x_in = jnp.where(inject, xm[jnp.clip(m_new, 0, M - 1)], act)
+            k_local = jnp.clip(c_in // S, 0, V - 1)
+            p_sel = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, k_local, 0, keepdims=False), p_local)
+            k = (jax.random.fold_in(jax.random.fold_in(key, t), sid)
+                 if key is not None else None)
+            out = body(p_sel, x_in, k)
+            done = jnp.logical_and(
+                c_in == C - 1,
+                jnp.logical_and(m_in >= 0, m_in < M))
+            idx = jnp.clip(m_in, 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, idx, 0,
+                                               keepdims=False)
+            val = jnp.where(jnp.logical_and(sid == S - 1, done), out, cur)
+            outbuf = jax.lax.dynamic_update_index_in_dim(outbuf, val,
+                                                         idx, 0)
+            nxt = jax.lax.ppermute(out, axis, perm)
+            tags_nxt = jax.lax.ppermute(
+                jnp.stack([m_in, c_in + 1]).astype(jnp.int32), axis, perm)
+            return (nxt, tags_nxt, outbuf), None
+
+        (_, _, outbuf), _ = jax.lax.scan(
+            tick, (state0, tag0, outbuf0), jnp.arange(T))
+        return outbuf[None]
+
+    return _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh,
+                           axis)
+
+
+def _ring_order(S: int, V: int):
+    """chunk id held at stacked position p: p = (c mod S) * V + c // S."""
+    return [(p % V) * S + p // V for p in range(S * V)]
 
 
 # ---------------------------------------------------------------------------
@@ -215,7 +316,8 @@ class PipelineTrainStep:
     def __init__(self, model: PipelineLayer, optimizer, loss_fn: Callable,
                  num_microbatches: int = 1, mesh: Optional[Mesh] = None,
                  n_pre: Optional[int] = None, n_post: Optional[int] = None,
-                 use_remat: bool = True, donate_state: bool = True):
+                 use_remat: bool = True, donate_state: bool = True,
+                 num_virtual_stages: int = 1):
         from ....optimizer.optimizer import Lamb
         if isinstance(optimizer, Lamb):
             raise ValueError(
@@ -226,18 +328,28 @@ class PipelineTrainStep:
         self._loss_fn = loss_fn
         self._mesh = mesh or ensure_mesh()
         self._S = self._mesh.shape["stage"]
+        self._V = int(num_virtual_stages)
+        # C chunks total; stacked position p holds chunk _order[p] (ring
+        # layout: chunk c on device c mod S) — identity when V == 1
+        self._C = self._S * self._V
+        self._order = _ring_order(self._S, self._V)
         self._M = int(num_microbatches)
         self._use_remat = use_remat
         self._donate = donate_state
 
         layers = list(model.run_function)
         if n_pre is None or n_post is None:
-            n_pre, n_post = _auto_split(layers, self._S)
+            n_pre, n_post = _auto_split(layers, self._C)
         self._pre = layers[:n_pre]
         self._post = layers[len(layers) - n_post:] if n_post else []
         body = layers[n_pre: len(layers) - n_post or None]
-        L = len(body) // self._S
-        self._chunks = [body[s * L: (s + 1) * L] for s in range(self._S)]
+        if len(body) % self._C:
+            raise ValueError(
+                f"pipeline body of {len(body)} layers does not divide "
+                f"into num_stages*num_virtual_stages = {self._C} chunks "
+                "(explicit n_pre/n_post must leave a divisible body)")
+        L = len(body) // self._C
+        self._chunks = [body[c * L: (c + 1) * L] for c in range(self._C)]
 
         if any(_named_buffers(c) for c in self._chunks):
             raise ValueError(
@@ -250,6 +362,8 @@ class PipelineTrainStep:
         self._tmpl_named = _named_params(self._tmpl)
         self._tmpl_p = [p for _, p in self._tmpl_named]
         self._chunk_named = [_named_params(c) for c in self._chunks]
+        # positions in stacking order (ring layout for V > 1)
+        self._pos_named = [self._chunk_named[c] for c in self._order]
 
         self._stacked_sh = []
         for j, (_, p0) in enumerate(self._tmpl_named):
@@ -278,15 +392,15 @@ class PipelineTrainStep:
         self._post_names = _global_names(len(layers) - len(self._post),
                                          self._post_named)
         self._chunk_names = [
-            _global_names(n_pre + s * L, self._chunk_named[s])
-            for s in range(self._S)]
+            _global_names(n_pre + c * L, self._chunk_named[c])
+            for c in range(self._C)]
         # stacked leaves carry stage-0's real name; name-based weight-decay
         # decisions must agree across the group — verify, else refuse
         decay_fn = getattr(optimizer, "_apply_decay_param_fun", None)
         if decay_fn is not None:
             for j in range(len(self._tmpl_named)):
-                decisions = {bool(decay_fn(self._chunk_names[s][j]))
-                             for s in range(self._S)}
+                decisions = {bool(decay_fn(self._chunk_names[c][j]))
+                             for c in range(self._C)}
                 if len(decisions) > 1:
                     raise ValueError(
                         "apply_decay_param_fun decides differently across "
@@ -315,11 +429,11 @@ class PipelineTrainStep:
         # stacked leaves [S, ...] — sharded over 'stage' (+ the layer's
         # own TP tags on the inner dims)
         chunk_vals = [[p._value for _, p in named]
-                      for named in self._chunk_named]
+                      for named in self._pos_named]
         for vals in chunk_vals[1:]:
             assert len(vals) == len(chunk_vals[0])
-        self._stacked = [jnp.stack([chunk_vals[s][j]
-                                    for s in range(self._S)])
+        self._stacked = [jnp.stack([chunk_vals[p_][j]
+                                    for p_ in range(self._C)])
                          for j in range(len(chunk_vals[0]))]
         self._stacked = [jax.device_put(v, sh) for v, sh
                          in zip(self._stacked, self._stacked_sh)]
@@ -340,8 +454,8 @@ class PipelineTrainStep:
                 stores = optimizer._accumulators.get(k)
                 if not stores:
                     continue
-                per_stage = [stores.get(id(self._chunk_named[s][j][1]))
-                             for s in range(self._S)]
+                per_stage = [stores.get(id(self._pos_named[p_][j][1]))
+                             for p_ in range(self._C)]
                 if not all(v is not None for v in per_stage):
                     continue
                 if getattr(st[k], "ndim", 0) == 0:
@@ -390,6 +504,7 @@ class PipelineTrainStep:
 
     def _build(self, sig):
         S, M = self._S, self._M
+        V = self._V
         mesh = self._mesh
         loss_fn = self._loss_fn
         opt = self._opt
@@ -414,9 +529,14 @@ class PipelineTrainStep:
                 B = h.shape[0]
                 hm = h.reshape((M, B // M) + tuple(h.shape[1:]))
                 stk_tree = list(stk_v)
-                om = pipeline_spmd(body, stk_tree, hm, num_stages=S,
-                                   mesh=mesh, rng_key=k_body,
-                                   use_remat=use_remat)
+                if V > 1:
+                    om = pipeline_spmd_interleaved(
+                        body, stk_tree, hm, num_stages=S, num_virtual=V,
+                        mesh=mesh, rng_key=k_body, use_remat=use_remat)
+                else:
+                    om = pipeline_spmd(body, stk_tree, hm, num_stages=S,
+                                       mesh=mesh, rng_key=k_body,
+                                       use_remat=use_remat)
                 out = om.reshape((B,) + tuple(om.shape[2:]))
                 out2, new_b2 = _run_layers(post_layers, post_p_t, post_v,
                                            edge_b_t, new_b1, out,
@@ -513,10 +633,11 @@ class PipelineTrainStep:
         self._dirty = False
         n_pre = len(self._pre_p)
         n_stk = len(self._stacked)
-        # stage-stacked params -> per-layer tensors
-        for s in range(self._S):
-            for j, (name, p) in enumerate(self._chunk_named[s]):
-                p._value = self._stacked[j][s]
+        # stage-stacked params -> per-layer tensors (position p_ in the
+        # stack holds chunk _order[p_])
+        for p_ in range(self._C):
+            for j, (name, p) in enumerate(self._pos_named[p_]):
+                p._value = self._stacked[j][p_]
         # opt state -> eager accumulators
         opt = self._opt
         for i, p in enumerate(self._pre_p):
@@ -528,9 +649,9 @@ class PipelineTrainStep:
             st = self._opt_state[n_pre + j]
             if not isinstance(st, dict):
                 continue
-            for s in range(self._S):
-                p_sj = self._chunk_named[s][j][1]
-                per = {k: (v[s] if getattr(v, "ndim", 0)
+            for p_ in range(self._C):
+                p_sj = self._pos_named[p_][j][1]
+                per = {k: (v[p_] if getattr(v, "ndim", 0)
                            == p_sj._value.ndim + 1 else v)
                        for k, v in st.items()}
                 opt._fn_sync_to_accumulators([p_sj], [per])

@@ -52,6 +52,9 @@ class PipelineLayer(Layer):
         super().__init__()
         self._layers_desc = list(layers)
         self._num_stages = num_stages or 1
+        # interleaved schedule: V chunks per stage (reference:
+        # PipelineParallelWithInterleave); consumed by PipelineTrainStep
+        self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
         self._loss_fn = loss_fn
         self._topology = topology
         self._recompute_interval = recompute_interval

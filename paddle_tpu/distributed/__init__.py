@@ -13,7 +13,10 @@ from .collective import (
     reduce_scatter, broadcast, reduce, alltoall, alltoall_single, send, recv,
     barrier, scatter, new_group, get_group, is_initialized, ppermute, stream,
     spmd_region, in_spmd_region,
+    isend, irecv, wait, gather, all_gather_object, broadcast_object_list,
+    scatter_object_list, destroy_process_group,
 )
+from . import launch
 from .mesh import (
     build_mesh, set_mesh, get_mesh, ensure_mesh, mesh_scope, axis_size,
 )

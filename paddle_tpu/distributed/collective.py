@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..tensor import Tensor
@@ -308,3 +309,136 @@ class stream:
     broadcast = staticmethod(broadcast)
     alltoall = staticmethod(alltoall)
     scatter = staticmethod(scatter)
+
+
+class _DoneTask:
+    """Completed-work handle (paddle returns a task from async ops; XLA
+    dispatch is already async and ordered, so the work handle is
+    immediately waitable)."""
+
+    def is_completed(self):
+        return True
+
+    def wait(self):
+        barrier()
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send (parity: paddle.distributed.isend). See send: eager
+    p2p has no meaning single-controller; raises with the ppermute
+    guidance."""
+    send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Parity: paddle.distributed.wait — block until `tensor`'s producing
+    work is done (XLA: block_until_ready)."""
+    t = _coerce(tensor)
+    if hasattr(t._value, "block_until_ready"):
+        t._value.block_until_ready()
+    return t
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Parity: paddle.distributed.gather — all ranks contribute, dst gets
+    the list. SPMD formulation: an all_gather whose result is masked to
+    dst (single-controller programs are rank-symmetric; the reference's
+    asymmetric receive buffer translates to 'everyone computes it,
+    non-dst ignores it')."""
+    out: list = []
+    all_gather(out, tensor, group=group)
+    if gather_list is not None:
+        gather_list.extend(out)
+    return out
+
+
+def _obj_to_tensor(obj):
+    import pickle
+    buf = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    return Tensor(jnp.asarray(buf)), buf.shape[0]
+
+
+def _tensor_to_obj(t, length):
+    import pickle
+    return pickle.loads(np.asarray(t._value)[:int(length)].tobytes())
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Parity: paddle.distributed.all_gather_object. Objects are
+    pickled to uint8 tensors, padded to the group max, exchanged with
+    the tensor all_gather, and unpickled."""
+    ax = _bound_axis(group)
+    data, n = _obj_to_tensor(obj)
+    if ax is None:
+        object_list.append(_tensor_to_obj(data, n))
+        return
+    # pad to a fixed wire size (SPMD needs uniform shapes); 1 MiB default
+    cap = int(jnp.maximum(jnp.asarray(n), 1))
+    pad = Tensor(jnp.zeros((_OBJ_WIRE_CAP,), jnp.uint8
+                           ).at[:cap].set(data._value[:cap]))
+    sizes: list = []
+    all_gather(sizes, Tensor(jnp.asarray([n], jnp.int64)), group=group)
+    bufs: list = []
+    all_gather(bufs, pad, group=group)
+    for s, b in zip(sizes, bufs):
+        object_list.append(_tensor_to_obj(b, int(np.asarray(s._value)[0])))
+
+
+_OBJ_WIRE_CAP = 1 << 20
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Parity: paddle.distributed.broadcast_object_list (in-place)."""
+    ax = _bound_axis(group)
+    if ax is None:
+        return object_list
+    out = []
+    for obj in object_list:
+        data, n = _obj_to_tensor(obj)
+        pad = Tensor(jnp.zeros((_OBJ_WIRE_CAP,), jnp.uint8
+                               ).at[:int(n)].set(data._value))
+        nt = Tensor(jnp.asarray([n], jnp.int64))
+        broadcast(nt, src=src, group=group)
+        broadcast(pad, src=src, group=group)
+        out.append(_tensor_to_obj(pad, int(np.asarray(nt._value)[0])))
+    object_list[:] = out
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Parity: paddle.distributed.scatter_object_list. Rank-symmetric
+    SPMD: every rank evaluates the scatter; its own slot lands in
+    out_object_list."""
+    ax = _bound_axis(group)
+    if ax is None:
+        out_object_list[:] = list(in_object_list or [])[:1]
+        return
+    idx = axis_index(group)
+    objs = in_object_list or []
+    datas = [_obj_to_tensor(o) for o in objs]
+    stacked = jnp.stack([
+        jnp.zeros((_OBJ_WIRE_CAP,), jnp.uint8).at[:int(n)].set(d._value)
+        for d, n in datas])
+    sizes = jnp.asarray([n for _, n in datas], jnp.int64)
+    my = Tensor(stacked[idx._value if isinstance(idx, Tensor) else idx])
+    my_n = sizes[idx._value if isinstance(idx, Tensor) else idx]
+    out_object_list[:] = [_tensor_to_obj(my, int(my_n))]
+
+
+def destroy_process_group(group=None):
+    """Parity: paddle.distributed.destroy_process_group. XLA owns the
+    collective channels (they are compiled into programs, not stateful
+    communicators), so teardown only detaches jax.distributed when the
+    world group goes down."""
+    if group is not None:
+        return
+    try:
+        import jax
+        jax.distributed.shutdown()
+    except Exception:
+        pass

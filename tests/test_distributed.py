@@ -395,3 +395,32 @@ class TestDistGradScaler:
             assert losses[-1] < losses[0]
         finally:
             set_mesh(None)
+
+
+class TestObjectCollectivesAndShims:
+    def test_single_rank_degenerate(self):
+        import paddle_tpu.distributed as dist
+        objs = []
+        dist.all_gather_object(objs, {"a": 1})
+        assert objs == [{"a": 1}]
+        lst = [{"x": 2}]
+        dist.broadcast_object_list(lst)
+        assert lst == [{"x": 2}]
+        t = paddle.to_tensor(np.ones(3, "float32"))
+        assert dist.wait(t) is t
+        out = dist.gather(t)
+        assert len(out) == 1
+        got = []
+        dist.scatter_object_list(got, [1, 2, 3])
+        assert got == [1]
+
+    def test_p2p_guidance_and_launch_attr(self):
+        import paddle_tpu.distributed as dist
+        with pytest.raises(RuntimeError):
+            dist.isend(paddle.to_tensor(np.ones(2, "f4")), dst=1)
+        with pytest.raises(RuntimeError):
+            dist.irecv(paddle.to_tensor(np.ones(2, "f4")), src=0)
+        assert hasattr(dist, "launch")
+        task = dist.collective._DoneTask()
+        assert task.is_completed()
+        task.wait()

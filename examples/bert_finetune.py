@@ -1,0 +1,93 @@
+"""Config #2 recipe: BERT/ERNIE sequence-classification fine-tune with
+data parallelism (SURVEY.md §7 M4; BASELINE.md config "ERNIE/BERT
+fine-tune DP").
+
+Single device (CPU smoke or one TPU chip):
+    python examples/bert_finetune.py --smoke
+
+Data parallel over a mesh (virtual CPU devices or a slice):
+    python examples/bert_finetune.py --smoke --dp 2
+
+The example uses synthetic data (this sandbox has no downloads); swap
+`synthetic_batches` for a tokenized dataset + paddle.io.DataLoader in
+real runs.
+"""
+import argparse
+
+
+def synthetic_batches(rng, vocab, batch, seq, num_classes, steps):
+    for _ in range(steps):
+        yield (rng.randint(0, vocab, (batch, seq)),
+               rng.randint(0, num_classes, (batch,)))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=3e-5)
+    p.add_argument("--model", choices=["bert", "ernie"], default="bert")
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import (BertConfig, BertForSequenceClassification,
+                                   ErnieConfig, ErnieForSequenceClassification)
+
+    paddle.seed(0)
+    if args.model == "bert":
+        cfg = (BertConfig.tiny(num_labels=4) if args.smoke
+               else BertConfig(num_labels=4))
+        model = BertForSequenceClassification(cfg)
+    else:
+        cfg = (ErnieConfig.tiny(num_labels=4) if args.smoke
+               else ErnieConfig(num_labels=4))
+        model = ErnieForSequenceClassification(cfg)
+
+    opt = paddle.optimizer.AdamW(
+        learning_rate=paddle.optimizer.lr.LinearWarmup(
+            paddle.optimizer.lr.PolynomialDecay(args.lr, args.steps),
+            warmup_steps=max(args.steps // 10, 1), start_lr=0.0,
+            end_lr=args.lr),
+        parameters=model.parameters(), weight_decay=0.01,
+        apply_decay_param_fun=lambda n: "norm" not in n and "bias" not in n)
+    crit = nn.CrossEntropyLoss()
+
+    if args.dp > 1:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": args.dp, "mp_degree": 1,
+                                   "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = fleet.distributed_model(model)
+        rng = np.random.RandomState(0)
+        for step, (ids, labels) in enumerate(synthetic_batches(
+                rng, cfg.vocab_size, args.batch, args.seq, 4, args.steps)):
+            loss = model.train_batch(
+                [paddle.to_tensor(ids), paddle.to_tensor(labels)],
+                optimizer=opt, loss_fn=lambda lg, y: crit(lg, y))
+            opt._learning_rate.step()
+            if step % 5 == 0:
+                print(f"step {step}: loss {float(loss):.4f}", flush=True)
+        return
+
+    rng = np.random.RandomState(0)
+    for step, (ids, labels) in enumerate(synthetic_batches(
+            rng, cfg.vocab_size, args.batch, args.seq, 4, args.steps)):
+        logits = model(paddle.to_tensor(ids))
+        loss = crit(logits, paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        opt._learning_rate.step()
+        if step % 5 == 0:
+            print(f"step {step}: loss {float(loss.numpy()):.4f}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

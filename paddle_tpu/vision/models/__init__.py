@@ -1,7 +1,8 @@
 """paddle.vision.models (parity: python/paddle/vision/models/)."""
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
-                     resnet152, wide_resnet50_2, resnext50_32x4d,
-                     BasicBlock, BottleneckBlock)
+                     resnet152, wide_resnet50_2, wide_resnet101_2,
+                     resnext50_32x4d, resnext101_32x4d, resnext101_64x4d,
+                     resnext152_32x4d, BasicBlock, BottleneckBlock)
 from .lenet import LeNet
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenetv1 import MobileNetV1, mobilenet_v1

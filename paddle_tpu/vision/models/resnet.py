@@ -160,3 +160,30 @@ def resnext50_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
     return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    """Parity: paddle.vision.models.resnext101_32x4d."""
+    kwargs["groups"] = 32
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    """Parity: paddle.vision.models.resnext101_64x4d."""
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    """Parity: paddle.vision.models.resnext152_32x4d."""
+    kwargs["groups"] = 32
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    """Parity: paddle.vision.models.wide_resnet101_2."""
+    kwargs["width"] = 64 * 2
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)

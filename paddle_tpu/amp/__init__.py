@@ -144,3 +144,53 @@ class debugging:
                 f"check_numerics({op_type}/{var_name}): {n_nan} NaN, "
                 f"{n_inf} Inf values found")
         return t
+
+    class DebugMode:
+        """Parity: paddle.amp.debugging.DebugMode."""
+        CHECK_NAN_INF_AND_ABORT = 0
+        CHECK_NAN_INF = 1
+        CHECK_ALL_FOR_OVERFLOW = 2
+        CHECK_ALL = 3
+        DUMP_ALL = 4
+
+    class TensorCheckerConfig:
+        """Parity: paddle.amp.debugging.TensorCheckerConfig — carries the
+        check mode for enable_tensor_checker."""
+
+        def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                     checked_op_list=None, skipped_op_list=None,
+                     debug_step=None, stack_height_limit=1):
+            self.enable = enable
+            self.debug_mode = debug_mode
+            self.output_dir = output_dir
+
+    @staticmethod
+    def enable_tensor_checker(config=None):
+        """Every op's concrete inputs are scanned for NaN/Inf (the
+        FLAGS_check_nan_inf hook in the dispatcher; jitted programs trap
+        via jax_debug_nans)."""
+        from ..framework.flags import set_flags
+        set_flags({"check_nan_inf": True})
+
+    @staticmethod
+    def disable_tensor_checker():
+        from ..framework.flags import set_flags
+        set_flags({"check_nan_inf": False})
+
+    @staticmethod
+    def check_layer_numerics(func):
+        """Decorator parity: paddle.amp.debugging.check_layer_numerics —
+        scans the wrapped forward's tensor outputs."""
+        import functools as _ft
+
+        @_ft.wraps(func)
+        def wrapper(*args, **kwargs):
+            out = func(*args, **kwargs)
+            from ..tensor import Tensor
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            for o in outs:
+                if isinstance(o, Tensor):
+                    debugging.check_numerics(
+                        o, op_type=getattr(func, "__qualname__", "layer"))
+            return out
+        return wrapper

@@ -112,3 +112,97 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedLinear(Layer):
+    """Parity: paddle.incubate.nn.FusedLinear (upstream fuses the gemm
+    + bias epilogue; XLA does that fusion on TPU)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from ...nn.layers_common import Linear
+        self._transpose = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self._lin = Linear(in_features, out_features,
+                           weight_attr=weight_attr, bias_attr=bias_attr)
+        self.weight = self._lin.weight
+        self.bias = self._lin.bias
+
+    def forward(self, x):
+        from .functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=False)
+
+
+class FusedDropoutAdd(Layer):
+    """Parity: paddle.incubate.nn.FusedDropoutAdd."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self._p = p
+        self._mode = mode
+
+    def forward(self, x, y):
+        from .functional import fused_dropout_add
+        return fused_dropout_add(x, y, p=self._p, training=self.training,
+                                 mode=self._mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Parity: paddle.incubate.nn.FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.initializer import Uniform
+        self._rate = dropout_rate
+        self._eps = epsilon
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=Uniform(1.0, 1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], is_bias=True,
+            default_initializer=Uniform(0.0, 0.0))
+        self.linear_bias = self.create_parameter(
+            [embed_dim], is_bias=True,
+            default_initializer=Uniform(0.0, 0.0))
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self._rate,
+            ln_epsilon=self._eps, training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """Parity: paddle.incubate.nn.FusedMultiTransformer — the stacked
+    inference transformer (upstream fused_multi_transformer CUDA op).
+    Layers share structure; each runs the fused attention + ffn pair.
+    Normalization is pre-LN (the op's convention)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is pre-LN only (the reference op's "
+                "convention)")
+        from ...nn.layers_common import LayerNorm
+        self._layers = []
+        for i in range(num_layers):
+            blk = FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=True)
+            self.add_sublayer(f"layer_{i}", blk)
+            self._layers.append(blk)
+        self.norm = LayerNorm(embed_dim)
+
+    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+        out = src
+        for blk in self._layers:
+            out = blk(out, src_mask=attn_mask)
+        return self.norm(out)

@@ -213,3 +213,34 @@ class TestReviewRegressions:
         _, ids = paddle.top_p_sampling(
             x, ps, threshold=t(np.full((64, 1), 0.25, "float32")))
         assert set(np.unique(ids.numpy())) <= {0, 1}
+
+
+class TestFusedLayersAndDebugging:
+    def test_fused_layers_forward(self):
+        import paddle_tpu.incubate.nn as inn
+        x = t(rng.randn(2, 6, 16).astype("float32"))
+        assert inn.FusedLinear(16, 8)(x).shape == [2, 6, 8]
+        assert inn.FusedDropoutAdd(0.0)(x, x).shape == [2, 6, 16]
+        fb = inn.FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        assert fb(x, x).shape == [2, 6, 16]
+        fmt = inn.FusedMultiTransformer(16, 4, 32, num_layers=2)
+        assert fmt(x).shape == [2, 6, 16]
+
+    def test_tensor_checker(self):
+        import pytest
+        dbg = paddle.amp.debugging
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig())
+        try:
+            bad = t(np.array([1.0, np.nan], "float32"))
+            with pytest.raises(FloatingPointError):
+                _ = bad * 2
+        finally:
+            dbg.disable_tensor_checker()
+
+        @dbg.check_layer_numerics
+        def f(x):
+            return x * 2
+
+        f(t(np.ones(3, "float32")))
+        with pytest.raises(FloatingPointError):
+            f(t(np.array([np.inf], "float32")))

@@ -4,6 +4,7 @@ from __future__ import annotations
 from .layer_base import Layer
 from . import functional
 from . import initializer
+from . import utils
 from .initializer import ParamAttr
 from .layers_common import (
     Sequential, LayerList, LayerDict, ParameterList,

@@ -7,6 +7,7 @@ import math as pymath
 import numbers
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..tensor import Tensor, Parameter
@@ -487,10 +488,50 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Parity: paddle.nn.SpectralNorm (upstream phi spectral_norm
+    kernel): weight / sigma_max, sigma estimated by power iteration
+    with persistent u/v buffers."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
                  dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm lands with GAN family")
+        import numpy as _np
+        from ..framework.random import next_key
+        self._dim = dim
+        self._iters = power_iters
+        self._eps = epsilon
+        h = int(weight_shape[dim])
+        w = int(_np.prod(weight_shape)) // h
+        k1, k2 = jax.random.split(next_key())
+        u0 = jax.random.normal(k1, (h,), jnp.float32)
+        v0 = jax.random.normal(k2, (w,), jnp.float32)
+        self.register_buffer("weight_u",
+                             Tensor(u0 / (jnp.linalg.norm(u0) + epsilon)))
+        self.register_buffer("weight_v",
+                             Tensor(v0 / (jnp.linalg.norm(v0) + epsilon)))
+
+    def forward(self, weight):
+        from ..ops._dispatch import apply
+        from ..ops.creation import _coerce
+        dim, iters, eps = self._dim, self._iters, self._eps
+
+        def fn(wv, u, v):
+            perm = [dim] + [d for d in range(wv.ndim) if d != dim]
+            mat = wv.transpose(perm).reshape(wv.shape[dim], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return wv / sigma, u, v
+
+        out, new_u, new_v = apply(fn, _coerce(weight), self.weight_u,
+                                  self.weight_v)
+        # persistent power-iteration state (detached buffers)
+        self.weight_u._value = new_u._value
+        self.weight_v._value = new_v._value
+        return out
 
 
 # --------------------------------------------------------------- embedding --

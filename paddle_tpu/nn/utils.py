@@ -1,0 +1,154 @@
+"""paddle.nn.utils parity (python/paddle/nn/utils/): weight
+normalization hooks, gradient clipping utilities, parameter
+flattening."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(a for a in range(w.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v / ||v|| (parity:
+    python/paddle/nn/utils/weight_norm_hook.py). The recomputation runs
+    in a forward-pre-hook, so the decomposition stays live under
+    training."""
+    w = getattr(layer, name)
+    wv = w._value
+    g0 = _norm_except(wv, dim)
+    g = layer.create_parameter(list(np.shape(g0)) or [1])
+    g.set_value(Tensor(jnp.reshape(g0, g._value.shape)))
+    v = layer.create_parameter(list(wv.shape))
+    v.set_value(Tensor(wv))
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # the original weight becomes derived state, not a trainable param
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        gv, vv = getattr(lyr, name + "_g"), getattr(lyr, name + "_v")
+        new_w = apply(
+            lambda gg, vx: (jnp.reshape(gg, _norm_except(vx, dim).shape)
+                            * vx / (_norm_except(vx, dim) + 1e-12)),
+            gv, vv)
+        object.__setattr__(lyr, name, new_w)
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (handle, name, dim)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a single parameter."""
+    handle, nm, dim = getattr(layer, "_weight_norm_hook", (None, name, 0))
+    if handle is not None:
+        handle.remove()
+    g = getattr(layer, nm + "_g")
+    v = getattr(layer, nm + "_v")
+    w = apply(lambda gg, vx: (jnp.reshape(gg, _norm_except(vx, dim).shape)
+                              * vx / (_norm_except(vx, dim) + 1e-12)),
+              g, v)
+    p = layer.create_parameter(list(w._value.shape))
+    p.set_value(w)
+    layer.add_parameter(nm, p)
+    del layer._parameters[nm + "_g"]
+    del layer._parameters[nm + "_v"]
+    if hasattr(layer, "_weight_norm_hook"):
+        del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Parity: paddle.nn.utils.spectral_norm — wraps the layer's weight
+    with a power-iteration spectral normalizer on each forward."""
+    from .layers_common import SpectralNorm as _SN
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(list(w._value.shape), dim=dim,
+             power_iters=n_power_iterations, epsilon=eps)
+    orig = layer.create_parameter(list(w._value.shape))
+    orig.set_value(Tensor(w._value))
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        object.__setattr__(lyr, name, sn(getattr(lyr, name + "_orig")))
+        return None
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, None)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm clip of .grad (parity:
+    python/paddle/nn/utils/clip_grad_norm_.py). Returns the total norm."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._value) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            "the total norm for gradients is non-finite; disable "
+            "error_if_nonfinite to clip anyway")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._value = p.grad._value * scale
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise clip of .grad (parity: clip_grad_value_)."""
+    cv = float(clip_value)
+    for p in (parameters if isinstance(parameters, (list, tuple))
+              else [parameters]):
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -cv, cv)
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one vector (parity:
+    python/paddle/nn/utils/transform_parameters.py)."""
+    return Tensor(jnp.concatenate(
+        [jnp.ravel(_coerce(p)._value) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write a flat vector back into the parameter tensors."""
+    v = _coerce(vec)._value
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        p.set_value(Tensor(jnp.reshape(v[off:off + n], p._value.shape)))
+        off += n
+    if off != v.shape[0]:
+        raise ValueError(
+            f"vector length {v.shape[0]} != total parameter size {off}")

@@ -334,3 +334,56 @@ class TestTrainingSmoke:
         out.mean().backward()
         for p in m.parameters():
             assert p.grad is not None, p.name
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip(self):
+        lin = nn.Linear(4, 3)
+        w0 = np.array(lin.weight.numpy())
+        nn.utils.weight_norm(lin, "weight")
+        x = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+        out1 = lin(x).numpy()
+        np.testing.assert_allclose(out1, x.numpy() @ w0 + lin.bias.numpy(),
+                                   atol=1e-5)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_g" in names and "weight" not in names
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        out2 = lin(x).numpy()
+        assert not np.allclose(out1, out2)
+        nn.utils.remove_weight_norm(lin, "weight")
+        assert "weight" in [n for n, _ in lin.named_parameters()]
+        np.testing.assert_allclose(lin(x).numpy(), out2, atol=1e-5)
+
+    def test_clip_and_vector_utils(self):
+        lin = nn.Linear(4, 3)
+        x = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+        ((lin(x) * 100).sum()).backward()
+        total = nn.utils.clip_grad_norm_(lin.parameters(), 1.0)
+        g2 = np.sqrt(sum((p.grad.numpy() ** 2).sum()
+                         for p in lin.parameters()))
+        assert g2 <= 1.0 + 1e-4
+        assert float(total.numpy()) > 1.0  # pre-clip norm was large
+        nn.utils.clip_grad_value_(lin.parameters(), 0.001)
+        assert all(np.abs(p.grad.numpy()).max() <= 0.001 + 1e-9
+                   for p in lin.parameters())
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        assert vec.shape == [4 * 3 + 3]
+        nn.utils.vector_to_parameters(vec * 0 + 1.0, lin.parameters())
+        assert np.allclose(lin.weight.numpy(), 1.0)
+
+    def test_spectral_norm(self):
+        sn = nn.SpectralNorm([4, 8], dim=0, power_iters=10)
+        wmat = paddle.to_tensor(rng.randn(4, 8).astype("float32") * 3)
+        out = sn(wmat)
+        sv = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+        assert abs(sv - 1.0) < 0.02
+        lin = nn.Linear(6, 6)
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=5)
+        for _ in range(3):
+            lin(paddle.to_tensor(rng.randn(2, 6).astype("float32")))
+        sv = np.linalg.svd(lin.weight.numpy(), compute_uv=False)[0]
+        assert abs(sv - 1.0) < 0.05

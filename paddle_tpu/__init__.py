@@ -33,7 +33,8 @@ from .framework.place import (
     CPUPlace, TPUPlace, XLAPlace, CUDAPlace, set_device, get_device,
     is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
 )
-from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.random import (seed, get_rng_state, set_rng_state,
+                               get_cuda_rng_state, set_cuda_rng_state)
 from .framework.flags import set_flags, get_flags
 from .framework import random as _random_mod
 
@@ -90,3 +91,35 @@ def is_grad_enabled_():
 def get_default_place():
     from .framework.place import _default_place
     return _default_place()
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    from . import device as _device
+    return bool(_device.get_all_custom_device_type())
+
+
+def device_count():
+    import jax as _jax
+    return len(_jax.devices())
+
+
+def disable_signal_handler():
+    """Parity shim: paddle installs C++ signal handlers; here python's
+    default handlers are already in charge, so this is a no-op."""
+
+
+class LazyGuard:
+    """Parity: paddle.LazyGuard — upstream defers parameter
+    materialization. Initializers here are cheap jax ops, so the guard
+    is a transparent context (parameters exist immediately, which is a
+    superset of the lazy contract for user code)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -126,3 +126,13 @@ _rng_tracker = RNGStatesTracker()
 
 def get_rng_state_tracker() -> RNGStatesTracker:
     return _rng_tracker
+
+
+# CUDA-named aliases (parity: paddle.get_cuda_rng_state — accelerator
+# RNG state; on TPU the same threefry generator drives everything)
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)

@@ -9,5 +9,7 @@ from .api import (
     enable_static, disable_static, in_dynamic_mode, Program, Executor,
     default_main_program, default_startup_program, program_guard, name_scope,
     InputSpec, data, save, load, save_inference_model, load_inference_model,
+    append_backward, gradients, global_scope, scope_guard, Scope,
+    cpu_places, cuda_places, WeightNormParamAttr,
 )
 from . import nn

@@ -201,3 +201,118 @@ def load_inference_model(path_prefix, executor, **kwargs):
     with open(path_prefix + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
     return [None, meta.get("feeds", []), []]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Parity: paddle.static.append_backward. The facade's programs are
+    live eager tapes, so 'appending the backward' = running the tape
+    backward (grads land in each parameter's .grad, like dygraph).
+    Returns (param, grad) pairs for the requested parameters."""
+    from ..autograd.engine import run_backward, grad as _grad
+    if parameter_list:
+        grads = _grad([loss], list(parameter_list), retain_graph=True,
+                      allow_unused=True)
+        return [(p, g) for p, g in zip(parameter_list, grads)]
+    run_backward([loss], retain_graph=True)
+    return []
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """Parity: paddle.static.gradients — d(targets)/d(inputs) on the
+    recorded (eager-tape) graph."""
+    from ..autograd.engine import grad as _grad
+    tl = targets if isinstance(targets, (list, tuple)) else [targets]
+    il = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gl = (target_gradients
+          if isinstance(target_gradients, (list, tuple)) or
+          target_gradients is None else [target_gradients])
+    return _grad(tl, il, grad_outputs=gl, retain_graph=True,
+                 allow_unused=True,
+                 no_grad_vars=list(no_grad_set) if no_grad_set else None)
+
+
+class _GlobalScope:
+    """Parity: paddle.static.global_scope — a Variable store. Values live
+    on tensors themselves here; the scope keeps name -> Tensor for
+    find_var-style code."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        from ..tensor import Tensor
+        import jax.numpy as jnp
+        if name not in self._vars:
+            self._vars[name] = Tensor(jnp.zeros(()))
+        return _Var(self._vars[name])
+
+    def find_var(self, name):
+        return _Var(self._vars[name]) if name in self._vars else None
+
+
+class _Var:
+    def __init__(self, t):
+        self._t = t
+
+    def get_tensor(self):
+        return self._t
+
+
+_scope = _GlobalScope()
+_scope_stack = []
+
+
+def global_scope():
+    return _scope_stack[-1] if _scope_stack else _scope
+
+
+class scope_guard:
+    """Parity: paddle.static.scope_guard."""
+
+    def __init__(self, scope):
+        self._s = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._s)
+        return self._s
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+def Scope():
+    return _GlobalScope()
+
+
+def cpu_places(device_count=None):
+    """Parity: paddle.static.cpu_places."""
+    from ..framework.place import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Parity shim: accelerator places (TPU chips here)."""
+    import jax as _jax
+    from ..framework.place import TPUPlace
+    ids = (device_ids if device_ids is not None
+           else range(len([d for d in _jax.devices()
+                           if d.platform != "cpu"]) or 1))
+    return [TPUPlace(i) for i in ids]
+
+
+class WeightNormParamAttr:
+    """Parity: paddle.static.WeightNormParamAttr — marks a parameter for
+    weight normalization; the dygraph path (nn.utils.weight_norm) is the
+    recommended TPU route, this records the intent for API compat."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable

@@ -54,3 +54,37 @@ def run_check():
     assert float(y[0, 0]) == 128.0
     print(f"PaddleTPU works well on {n} {jax.default_backend()} "
           f"device{'s' if n > 1 else ''}.")
+
+
+def require_version(min_version, max_version=None):
+    """Parity: paddle.utils.require_version — validates against this
+    package's version string."""
+    from ..version import full_version
+
+    def parse(v):
+        return [int(x) for x in str(v).split(".")[:3] if x.isdigit()]
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed {max_version}")
+
+
+class cpp_extension:
+    """Parity guidance: paddle.utils.cpp_extension builds CUDA custom
+    ops. TPU custom kernels are Pallas (python-level, no build step);
+    host-side native code plugs in through the CustomDevice C-ABI
+    (csrc/capi.cc) or plain ctypes/cffi."""
+
+    @staticmethod
+    def load(**kwargs):
+        raise NotImplementedError(
+            "cpp_extension.load builds CUDA ops; on TPU write the kernel "
+            "in Pallas (paddle_tpu.kernels) or register a host library "
+            "via paddle_tpu.device.register_custom_device")
+
+    CppExtension = load
+    CUDAExtension = load

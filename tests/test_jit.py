@@ -678,3 +678,29 @@ class TestStaticNNLayers:
             fetch_list=[ln, e])
         assert out[0].shape == (2, 10) and out[1].shape == (2, 5, 8)
         assert np.isfinite(out[0]).all()
+
+
+class TestStaticBackwardAndScope:
+    def test_append_backward_and_gradients(self):
+        import paddle_tpu.static as static
+        from paddle_tpu import nn
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            lin = nn.Linear(4, 1)
+            loss = (lin(x) ** 2).mean()
+        pairs = static.append_backward(loss,
+                                       parameter_list=lin.parameters())
+        assert len(pairs) == 2
+        assert pairs[0][1].shape == [4, 1]
+        gs = static.gradients(loss, lin.parameters())
+        assert gs[0].shape == [4, 1]
+
+    def test_scope_and_places(self):
+        import paddle_tpu.static as static
+        with static.scope_guard(static.Scope()):
+            v = static.global_scope().var("foo")
+            assert v.get_tensor() is not None
+        assert static.global_scope().find_var("nope") is None
+        assert len(static.cpu_places(2)) == 2

@@ -87,3 +87,12 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         out = out if isinstance(out, tuple) else (out,)
         i += seg
     return out if len(out) > 1 else out[0]
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Parity: fleet.utils.recompute_hybrid (recompute inside hybrid
+    parallelism, with mp-aware RNG). The mesh-aware RNG is already
+    handled by the engine's fold_in(key, stage/tick) seeding, so this
+    reduces to recompute with the offload knob ignored (XLA manages HBM;
+    host offload is a compile-time choice on TPU)."""
+    return recompute(function, *args, **kwargs)

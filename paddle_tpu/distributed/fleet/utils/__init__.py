@@ -1,6 +1,7 @@
 """fleet.utils (parity: fleet/utils/__init__.py — recompute re-export and
 sequence-parallel utilities)."""
-from ..recompute import recompute, recompute_sequential
+from ..recompute import (recompute, recompute_sequential,
+                         recompute_hybrid)
 from ..meta_parallel.mp_layers import (
     ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp,
     mark_as_sequence_parallel_parameter,

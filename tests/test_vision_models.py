@@ -81,3 +81,45 @@ class TestVisionZoo:
         missing = [n for n, p in m.named_parameters() if p.grad is None]
         assert not missing, missing[:5]
         opt.step()
+
+
+class TestTransformFamily:
+    def test_photometric_functionals(self):
+        img = (np.random.RandomState(0).rand(16, 20, 3) * 255
+               ).astype(np.uint8)
+        from paddle_tpu.vision import transforms as T
+        out = T.adjust_brightness(img, 0.5)
+        np.testing.assert_allclose(
+            out, np.clip(img * 0.5, 0, 255).astype(np.uint8), atol=1)
+        assert T.to_grayscale(img).shape == (16, 20, 1)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2)
+        np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                                   atol=1)
+
+    def test_geometric_functionals(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(16, 20, 3) * 255
+               ).astype(np.uint8)
+        np.testing.assert_allclose(T.rotate(img.astype(np.float32), 0.0),
+                                   img, atol=1)
+        assert T.center_crop(img, 8).shape == (8, 8, 3)
+        assert T.crop(img, 2, 3, 5, 7).shape == (5, 7, 3)
+        e = T.erase(img, 2, 3, 4, 5, 0)
+        assert (e[2:6, 3:8] == 0).all()
+        pts = [(0, 0), (19, 0), (19, 15), (0, 15)]
+        np.testing.assert_allclose(
+            T.perspective(img.astype(np.float32), pts, pts), img, atol=1)
+
+    def test_transform_classes(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(16, 20, 3) * 255
+               ).astype(np.uint8)
+        for cls in [T.ColorJitter(0.1, 0.1, 0.1, 0.1), T.Grayscale(3),
+                    T.RandomRotation(10), T.RandomErasing(prob=1.0),
+                    T.RandomAffine(10, translate=(0.1, 0.1),
+                                   scale=(0.9, 1.1)),
+                    T.RandomPerspective(prob=1.0),
+                    T.ContrastTransform(0.2), T.SaturationTransform(0.2),
+                    T.HueTransform(0.2)]:
+            out = cls(img)
+            assert np.asarray(out).shape[:2] == (16, 20)

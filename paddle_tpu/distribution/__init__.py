@@ -395,3 +395,6 @@ def _kl_categorical(p, q):
         qlog = jax.nn.log_softmax(ql, axis=-1)
         return jnp.sum(jnp.exp(plog) * (plog - qlog), axis=-1)
     return apply(fn, p.logits, q.logits)
+
+
+from ._extra import *  # noqa: F401,F403,E402  (second-tier distributions)

@@ -1,0 +1,617 @@
+"""Second tier of paddle.distribution (parity:
+python/paddle/distribution/{beta,binomial,cauchy,chi2,gamma,dirichlet,
+multinomial,multivariate_normal,student_t,continuous_bernoulli,
+transform,transformed_distribution}.py). Sampling uses jax.random's
+native samplers (reparameterized where jax provides it); log_prob /
+entropy are closed-form jnp expressions routed through apply() so
+gradients flow to the parameters."""
+from __future__ import annotations
+
+import math as pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+from ..framework.random import next_key
+from . import Distribution, _t, _shape, register_kl
+
+__all__ = [
+    "ExponentialFamily", "Beta", "Binomial", "Cauchy",
+    "ContinuousBernoulli", "Chi2", "Dirichlet", "Gamma", "Multinomial",
+    "MultivariateNormal", "StudentT", "Transform", "AffineTransform",
+    "ExpTransform", "SigmoidTransform", "TanhTransform",
+    "PowerTransform", "AbsTransform", "ChainTransform",
+    "IndependentTransform", "ReshapeTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TransformedDistribution",
+]
+
+
+class ExponentialFamily(Distribution):
+    """Parity base class: paddle.distribution.ExponentialFamily."""
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(np.broadcast_shapes(self.alpha._value.shape,
+                                             self.beta._value.shape))
+
+    @property
+    def mean(self):
+        return apply(lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return apply(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                     self.alpha, self.beta)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        k = next_key()
+        return apply(lambda a, b: jax.random.beta(k, a, b, shp),
+                     self.alpha, self.beta)
+
+    def log_prob(self, value):
+        def fn(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.betaln(a, b)))
+        return apply(fn, _coerce(value), self.alpha, self.beta)
+
+    def entropy(self):
+        def fn(a, b):
+            dg = jax.scipy.special.digamma
+            return (jax.scipy.special.betaln(a, b)
+                    - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return apply(fn, self.alpha, self.beta)
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(np.broadcast_shapes(
+            self.concentration._value.shape, self.rate._value.shape))
+
+    @property
+    def mean(self):
+        return apply(lambda c, r: c / r, self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply(lambda c, r: c / (r * r), self.concentration,
+                     self.rate)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        k = next_key()
+        return apply(lambda c, r: jax.random.gamma(k, c, shp) / r,
+                     self.concentration, self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, c, r):
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(c))
+        return apply(fn, _coerce(value), self.concentration, self.rate)
+
+    def entropy(self):
+        def fn(c, r):
+            dg = jax.scipy.special.digamma
+            return (c - jnp.log(r) + jax.scipy.special.gammaln(c)
+                    + (1 - c) * dg(c))
+        return apply(fn, self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    """Parity: paddle.distribution.Chi2 — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df):
+        self.df = _t(df)
+        super().__init__(apply(lambda d: d / 2.0, self.df), 0.5)
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(self.concentration._value.shape[:-1])
+
+    @property
+    def mean(self):
+        return apply(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     self.concentration)
+
+    def sample(self, shape=()):
+        shp = tuple(shape)
+        k = next_key()
+        return apply(lambda c: jax.random.dirichlet(k, c, shp or None),
+                     self.concentration)
+
+    def log_prob(self, value):
+        def fn(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), -1))
+        return apply(fn, _coerce(value), self.concentration)
+
+    def entropy(self):
+        def fn(c):
+            a0 = jnp.sum(c, -1)
+            n = c.shape[-1]
+            dg = jax.scipy.special.digamma
+            lnB = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                   - jax.scipy.special.gammaln(a0))
+            return (lnB + (a0 - n) * dg(a0)
+                    - jnp.sum((c - 1) * dg(c), -1))
+        return apply(fn, self.concentration)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc._value.shape,
+                                             self.scale._value.shape))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        k = next_key()
+        return apply(lambda l, s: l + s * jax.random.cauchy(k, shp),
+                     self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, s):
+            return (-jnp.log(np.float32(pymath.pi)) - jnp.log(s)
+                    - jnp.log1p(((v - l) / s) ** 2))
+        return apply(fn, _coerce(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply(lambda s: jnp.log(4 * np.float32(pymath.pi) * s),
+                     self.scale)
+
+    def cdf(self, value):
+        def fn(v, l, s):
+            return jnp.arctan((v - l) / s) / np.float32(pymath.pi) + 0.5
+        return apply(fn, _coerce(value), self.loc, self.scale)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(
+            self.df._value.shape, self.loc._value.shape,
+            self.scale._value.shape))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        k = next_key()
+        return apply(lambda d, l, s: l + s * jax.random.t(k, d, shp),
+                     self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def fn(v, d, l, s):
+            z = (v - l) / s
+            return (jax.scipy.special.gammaln((d + 1) / 2)
+                    - jax.scipy.special.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * np.float32(pymath.pi)) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+        return apply(fn, _coerce(value), self.df, self.loc, self.scale)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(np.broadcast_shapes(
+            self.total_count._value.shape, self.probs._value.shape))
+
+    @property
+    def mean(self):
+        return apply(lambda n, p: n * p, self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return apply(lambda n, p: n * p * (1 - p), self.total_count,
+                     self.probs)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        k = next_key()
+        return apply(
+            lambda n, p: jax.random.binomial(k, n.astype(jnp.float32),
+                                             p, shp),
+            self.total_count, self.probs)
+
+    def log_prob(self, value):
+        def fn(v, n, p):
+            return (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return apply(fn, _coerce(value), self.total_count, self.probs)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(self.probs._value.shape[:-1])
+
+    def sample(self, shape=()):
+        shp = tuple(shape)
+        k = next_key()
+
+        def fn(p):
+            n = self.total_count
+            logits = jnp.log(p + 1e-30)
+            draws = jax.random.categorical(
+                k, logits, axis=-1,
+                shape=shp + (n,) + p.shape[:-1])       # [*shp, n, *batch]
+            oh = jax.nn.one_hot(draws, p.shape[-1], dtype=p.dtype)
+            counts = jnp.sum(oh, axis=len(shp))         # sum over n draws
+            return counts
+        return apply(fn, self.probs)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            return (jax.scipy.special.gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p + 1e-30), -1))
+        return apply(fn, _coerce(value), self.probs)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _t(loc)
+        if covariance_matrix is not None:
+            self.covariance_matrix = _t(covariance_matrix)
+            self._tril = apply(jnp.linalg.cholesky, self.covariance_matrix)
+        elif scale_tril is not None:
+            self._tril = _t(scale_tril)
+            self.covariance_matrix = apply(
+                lambda t: t @ jnp.swapaxes(t, -1, -2), self._tril)
+        elif precision_matrix is not None:
+            cov = apply(jnp.linalg.inv, _t(precision_matrix))
+            self.covariance_matrix = cov
+            self._tril = apply(jnp.linalg.cholesky, cov)
+        else:
+            raise ValueError("one of covariance_matrix/precision_matrix/"
+                             "scale_tril is required")
+        super().__init__(self.loc._value.shape[:-1])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    def sample(self, shape=()):
+        shp = tuple(shape)
+        k = next_key()
+
+        def fn(l, t):
+            eps = jax.random.normal(k, shp + l.shape, l.dtype)
+            return l + jnp.einsum("...ij,...j->...i", t, eps)
+        return apply(fn, self.loc, self._tril)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v, l, t):
+            d = l.shape[-1]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(t, diff[..., None],
+                                                    lower=True)[..., 0]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(t, axis1=-2, axis2=-1)),
+                             -1)
+            return (-0.5 * jnp.sum(sol * sol, -1) - logdet
+                    - 0.5 * d * np.float32(pymath.log(2 * pymath.pi)))
+        return apply(fn, _coerce(value), self.loc, self._tril)
+
+    def entropy(self):
+        def fn(t):
+            d = t.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(t, axis1=-2, axis2=-1)),
+                             -1)
+            return logdet + 0.5 * d * (1 + np.float32(
+                pymath.log(2 * pymath.pi)))
+        return apply(fn, self._tril)
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(self.probs._value.shape)
+
+    def _log_norm(self, p):
+        # C(p) = 2 atanh(1-2p) / (1-2p), with the p ~ 0.5 limit -> 2
+        near = jnp.logical_and(p > self._lims[0], p < self._lims[1])
+        safe = jnp.where(near, 0.4, p)
+        c = (jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * safe)))
+             - jnp.log(jnp.abs(1 - 2 * safe)))
+        taylor = (np.float32(pymath.log(2.0))
+                  + 4.0 / 3.0 * (p - 0.5) ** 2)
+        return jnp.where(near, taylor, c)
+
+    def log_prob(self, value):
+        def fn(v, p):
+            return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                    + self._log_norm(p))
+        return apply(fn, _coerce(value), self.probs)
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        k = next_key()
+
+        def fn(p):
+            u = jax.random.uniform(k, shp, p.dtype)
+            near = jnp.logical_and(p > self._lims[0], p < self._lims[1])
+            safe = jnp.where(near, 0.4, p)
+            s = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                 / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(near, u, s)
+        return apply(fn, self.probs)
+
+
+# ------------------------------------------------------------- transforms --
+
+class Transform:
+    """Parity: paddle.distribution.Transform (forward/inverse +
+    log-det-Jacobian)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        from ..ops import math as om
+        return om.neg(self.forward_log_det_jacobian(self.inverse(y)))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return apply(lambda v, l, s: l + s * v, _coerce(x), self.loc,
+                     self.scale)
+
+    def inverse(self, y):
+        return apply(lambda v, l, s: (v - l) / s, _coerce(y), self.loc,
+                     self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v, s: jnp.broadcast_to(
+            jnp.log(jnp.abs(s)), v.shape), _coerce(x), self.scale)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply(jnp.exp, _coerce(x))
+
+    def inverse(self, y):
+        return apply(jnp.log, _coerce(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v: v, _coerce(x))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply(jax.nn.sigmoid, _coerce(x))
+
+    def inverse(self, y):
+        return apply(lambda v: jnp.log(v) - jnp.log1p(-v), _coerce(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v),
+                     _coerce(x))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return apply(jnp.tanh, _coerce(x))
+
+    def inverse(self, y):
+        return apply(jnp.arctanh, _coerce(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply(
+            lambda v: 2.0 * (np.float32(pymath.log(2.0)) - v
+                             - jax.nn.softplus(-2.0 * v)), _coerce(x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return apply(lambda v, p: jnp.power(v, p), _coerce(x), self.power)
+
+    def inverse(self, y):
+        return apply(lambda v, p: jnp.power(v, 1.0 / p), _coerce(y),
+                     self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda v, p: jnp.log(jnp.abs(p * jnp.power(v, p - 1))),
+                     _coerce(x), self.power)
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return apply(jnp.abs, _coerce(x))
+
+    def inverse(self, y):
+        return apply(lambda v: v, _coerce(y))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops import math as om
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else om.add(total, j)
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        j = self.base.forward_log_det_jacobian(x)
+        return apply(
+            lambda v: jnp.sum(v, axis=tuple(range(-self.rank, 0))),
+            _coerce(j))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        def fn(v):
+            lead = v.shape[:v.ndim - len(self.in_event_shape)]
+            return v.reshape(lead + self.out_event_shape)
+        return apply(fn, _coerce(x))
+
+    def inverse(self, y):
+        def fn(v):
+            lead = v.shape[:v.ndim - len(self.out_event_shape)]
+            return v.reshape(lead + self.in_event_shape)
+        return apply(fn, _coerce(y))
+
+    def forward_log_det_jacobian(self, x):
+        def fn(v):
+            lead = v.shape[:v.ndim - len(self.in_event_shape)]
+            return jnp.zeros(lead, v.dtype)
+        return apply(fn, _coerce(x))
+
+
+class SoftmaxTransform(Transform):
+    def forward(self, x):
+        return apply(lambda v: jax.nn.softmax(v, axis=-1), _coerce(x))
+
+    def inverse(self, y):
+        return apply(lambda v: jnp.log(v), _coerce(y))
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, x, method):
+        from ..ops.manipulation import stack, unbind
+        parts = unbind(x, axis=self.axis)
+        outs = [getattr(t, method)(p)
+                for t, p in zip(self.transforms, parts)]
+        return stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map(x, "forward")
+
+    def inverse(self, y):
+        return self._map(y, "inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return self._map(x, "forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> simplex interior (parity: paddle's stickbreaking)."""
+
+    def forward(self, x):
+        def fn(v):
+            k = v.shape[-1]
+            offset = jnp.log(jnp.arange(k, 0, -1).astype(v.dtype))
+            z = jax.nn.sigmoid(v - offset)
+            cum = jnp.cumprod(1 - z, axis=-1)
+            pad = jnp.concatenate(
+                [jnp.ones_like(cum[..., :1]), cum[..., :-1]], -1)
+            head = z * pad
+            last = cum[..., -1:]
+            return jnp.concatenate([head, last], -1)
+        return apply(fn, _coerce(x))
+
+    def inverse(self, y):
+        def fn(v):
+            k = v.shape[-1] - 1
+            cum = 1 - jnp.cumsum(v[..., :-1], -1)
+            shifted = jnp.concatenate(
+                [jnp.ones_like(cum[..., :1]), cum[..., :-1]], -1)
+            z = v[..., :-1] / shifted
+            offset = jnp.log(jnp.arange(k, 0, -1).astype(v.dtype))
+            return jnp.log(z) - jnp.log1p(-z) + offset
+        return apply(fn, _coerce(y))
+
+
+class TransformedDistribution(Distribution):
+    """Parity: paddle.distribution.TransformedDistribution."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = (list(transforms)
+                           if isinstance(transforms, (list, tuple))
+                           else [transforms])
+        super().__init__(getattr(base, "_batch_shape", ()))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        from ..ops import math as om
+        y = _t(value)
+        lp = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            j = t.forward_log_det_jacobian(x)
+            lp = j if lp is None else om.add(lp, j)
+            y = x
+        base_lp = self.base.log_prob(y)
+        return om.subtract(base_lp, lp)

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+import paddle_tpu.distribution as D
 from paddle_tpu.distribution import (Normal, Uniform, Bernoulli,
                                      Categorical, Exponential, Laplace,
                                      LogNormal, Gumbel, Poisson,
@@ -140,3 +141,87 @@ class TestGeometricConvention:
         lp2 = float(d.log_prob(paddle.to_tensor(np.float32(2.0))).numpy())
         np.testing.assert_allclose(lp2, 2 * np.log(1 - p) + np.log(p),
                                    atol=1e-6)
+
+
+class TestSecondTierDistributions:
+    """Beta/Gamma/Chi2/Cauchy/StudentT/Binomial/Dirichlet/Multinomial/
+    MultivariateNormal/ContinuousBernoulli + the Transform family, scipy
+    goldens (the reference's own test pattern)."""
+
+    def test_log_prob_scipy_goldens(self):
+        import scipy.stats as st
+        t = paddle.to_tensor
+        f32 = np.float32
+        np.testing.assert_allclose(
+            D.Beta(t(f32(2.0)), t(f32(3.0))).log_prob(t(f32(0.3))).numpy(),
+            st.beta.logpdf(0.3, 2, 3), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Gamma(t(f32(2.0)), t(f32(1.5))).log_prob(t(f32(0.7))).numpy(),
+            st.gamma.logpdf(0.7, 2, scale=1 / 1.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Cauchy(t(f32(0.5)), t(f32(2.0))).log_prob(t(f32(1.0))).numpy(),
+            st.cauchy.logpdf(1.0, 0.5, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.StudentT(t(f32(5.0)), t(f32(0.0)),
+                       t(f32(1.0))).log_prob(t(f32(0.8))).numpy(),
+            st.t.logpdf(0.8, 5), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Chi2(t(f32(4.0))).log_prob(t(f32(2.0))).numpy(),
+            st.chi2.logpdf(2.0, 4), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Binomial(t(f32(10)), t(f32(0.3))).log_prob(t(f32(4))).numpy(),
+            st.binom.logpmf(4, 10, 0.3), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Dirichlet(t(np.array([1., 2., 3.], "float32"))).log_prob(
+                t(np.array([0.2, 0.3, 0.5], "float32"))).numpy(),
+            st.dirichlet.logpdf([0.2, 0.3, 0.5], [1, 2, 3]), rtol=1e-5)
+        cov = np.array([[2.0, 0.3], [0.3, 1.0]], "float32")
+        mvn = D.MultivariateNormal(t(np.zeros(2, "float32")),
+                                   covariance_matrix=t(cov))
+        np.testing.assert_allclose(
+            mvn.log_prob(t(np.array([0.5, -0.2], "float32"))).numpy(),
+            st.multivariate_normal.logpdf([0.5, -0.2], np.zeros(2), cov),
+            rtol=1e-5)
+        m = D.Multinomial(6, t(np.array([0.2, 0.3, 0.5], "float32")))
+        np.testing.assert_allclose(
+            m.log_prob(t(np.array([1., 2., 3.], "float32"))).numpy(),
+            st.multinomial.logpmf([1, 2, 3], 6, [0.2, 0.3, 0.5]),
+            rtol=1e-4)
+
+    def test_samples_and_entropy(self):
+        t = paddle.to_tensor
+        assert D.Beta(t(2.0), t(3.0)).sample([100]).shape[0] == 100
+        g = D.Gamma(t(np.float32(3.0)), t(np.float32(2.0)))
+        s = g.sample([2000])
+        np.testing.assert_allclose(s.numpy().mean(), 1.5, rtol=0.15)
+        assert np.isfinite(g.entropy().numpy())
+        cov = np.array([[2.0, 0.3], [0.3, 1.0]], "float32")
+        mvn = D.MultivariateNormal(t(np.zeros(2, "float32")),
+                                   covariance_matrix=t(cov))
+        assert mvn.sample([7]).shape == [7, 2]
+        m = D.Multinomial(6, t(np.array([0.2, 0.3, 0.5], "float32")))
+        samp = m.sample([4])
+        assert samp.shape == [4, 3]
+        np.testing.assert_allclose(samp.numpy().sum(-1), 6)
+
+    def test_transformed_distribution(self):
+        import scipy.stats as st
+        t = paddle.to_tensor
+        base = D.Normal(t(np.float32(0.0)), t(np.float32(1.0)))
+        ln = D.TransformedDistribution(base, [D.ExpTransform()])
+        np.testing.assert_allclose(ln.log_prob(t(np.float32(2.0))).numpy(),
+                                   st.lognorm.logpdf(2.0, 1.0), rtol=1e-5)
+        aff = D.AffineTransform(t(np.float32(1.0)), t(np.float32(2.0)))
+        x = t(np.float32(0.3))
+        np.testing.assert_allclose(aff.inverse(aff.forward(x)).numpy(),
+                                   0.3, rtol=1e-6)
+        sbt = D.StickBreakingTransform()
+        v = t(np.array([0.2, -0.1], "float32"))
+        y = sbt.forward(v)
+        assert abs(float(y.numpy().sum()) - 1.0) < 1e-6
+        np.testing.assert_allclose(sbt.inverse(y).numpy(), v.numpy(),
+                                   atol=1e-5)
+        sig = D.SigmoidTransform()
+        np.testing.assert_allclose(
+            sig.inverse(sig.forward(t(np.float32(0.7)))).numpy(), 0.7,
+            rtol=1e-5)

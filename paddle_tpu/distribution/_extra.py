@@ -13,11 +13,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..tensor import Tensor
 from ..ops._dispatch import apply
 from ..ops.creation import _coerce
 from ..framework.random import next_key
-from . import Distribution, _t, _shape, register_kl
+from . import Distribution, _t, _shape
 
 __all__ = [
     "ExponentialFamily", "Beta", "Binomial", "Cauchy",
@@ -120,7 +119,8 @@ class Chi2(Gamma):
 class Dirichlet(ExponentialFamily):
     def __init__(self, concentration):
         self.concentration = _t(concentration)
-        super().__init__(self.concentration._value.shape[:-1])
+        super().__init__(self.concentration._value.shape[:-1],
+                         self.concentration._value.shape[-1:])
 
     @property
     def mean(self):
@@ -128,9 +128,10 @@ class Dirichlet(ExponentialFamily):
                      self.concentration)
 
     def sample(self, shape=()):
-        shp = tuple(shape)
+        # jax.random.dirichlet wants shape = sample_shape + batch_shape
+        shp = _shape(shape, self._batch_shape)
         k = next_key()
-        return apply(lambda c: jax.random.dirichlet(k, c, shp or None),
+        return apply(lambda c: jax.random.dirichlet(k, c, shp),
                      self.concentration)
 
     def log_prob(self, value):
@@ -245,7 +246,8 @@ class Multinomial(Distribution):
     def __init__(self, total_count, probs):
         self.total_count = int(total_count)
         self.probs = _t(probs)
-        super().__init__(self.probs._value.shape[:-1])
+        super().__init__(self.probs._value.shape[:-1],
+                         self.probs._value.shape[-1:])
 
     def sample(self, shape=()):
         shp = tuple(shape)
@@ -288,7 +290,8 @@ class MultivariateNormal(Distribution):
         else:
             raise ValueError("one of covariance_matrix/precision_matrix/"
                              "scale_tril is required")
-        super().__init__(self.loc._value.shape[:-1])
+        super().__init__(self.loc._value.shape[:-1],
+                         self.loc._value.shape[-1:])
 
     @property
     def mean(self):
@@ -562,6 +565,22 @@ class StackTransform(Transform):
 
 class StickBreakingTransform(Transform):
     """R^{K-1} -> simplex interior (parity: paddle's stickbreaking)."""
+
+    def forward_log_det_jacobian(self, x):
+        # lower-triangular Jacobian: diag_k = sigmoid'(u_k) *
+        # prod_{j<k}(1 - z_j)
+        def fn(v):
+            k = v.shape[-1]
+            offset = jnp.log(jnp.arange(k, 0, -1).astype(v.dtype))
+            u = v - offset
+            z = jax.nn.sigmoid(u)
+            log_sig_prime = -jax.nn.softplus(-u) - jax.nn.softplus(u)
+            cum = jnp.cumprod(1 - z, axis=-1)
+            log_pad = jnp.concatenate(
+                [jnp.zeros_like(cum[..., :1]),
+                 jnp.log(cum[..., :-1])], -1)
+            return jnp.sum(log_sig_prime + log_pad, -1)
+        return apply(fn, _coerce(x))
 
     def forward(self, x):
         def fn(v):

@@ -225,3 +225,46 @@ class TestSecondTierDistributions:
         np.testing.assert_allclose(
             sig.inverse(sig.forward(t(np.float32(0.7)))).numpy(), 0.7,
             rtol=1e-5)
+
+
+class TestDistributionReviewRegressions:
+    def test_batched_dirichlet_sample(self):
+        c = paddle.to_tensor(np.ones((4, 3), "float32"))
+        s = D.Dirichlet(c).sample([5])
+        assert s.shape == [5, 4, 3]
+        np.testing.assert_allclose(s.numpy().sum(-1), 1.0, rtol=1e-5)
+
+    def test_event_shapes(self):
+        cov = np.eye(2, dtype="float32")
+        mvn = D.MultivariateNormal(paddle.to_tensor(np.zeros(2, "f4")),
+                                   covariance_matrix=paddle.to_tensor(cov))
+        assert mvn.event_shape == [2]
+        assert D.Dirichlet(paddle.to_tensor(
+            np.ones(3, "f4"))).event_shape == [3]
+        assert D.Multinomial(5, paddle.to_tensor(
+            np.ones(3, "f4") / 3)).event_shape == [3]
+
+    def test_stickbreaking_in_transformed_distribution(self):
+        sbt = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.3, -0.2], "float32"))
+        ldj = sbt.forward_log_det_jacobian(x)
+        # finite-difference determinant check
+        eps = 1e-4
+        x_np = x.numpy()
+        J = np.zeros((2, 2))
+        y0 = sbt.forward(x).numpy()[:2]
+        for j in range(2):
+            xp = x_np.copy()
+            xp[j] += eps
+            J[:, j] = (sbt.forward(paddle.to_tensor(xp)).numpy()[:2]
+                       - y0) / eps
+        np.testing.assert_allclose(float(ldj.numpy()),
+                                   np.log(abs(np.linalg.det(J))),
+                                   atol=1e-3)
+
+    def test_star_import_exports_second_tier(self):
+        ns = {}
+        exec("from paddle_tpu.distribution import *", ns)
+        for name in ("Beta", "Gamma", "TransformedDistribution",
+                     "StickBreakingTransform"):
+            assert name in ns, name

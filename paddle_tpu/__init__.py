@@ -67,6 +67,9 @@ from .hapi import Model, summary
 from .hapi.flops import flops
 from . import hub
 from . import text
+from . import base
+from . import fluid
+from . import sysconfig
 from .hapi import callbacks
 
 from . import distributed

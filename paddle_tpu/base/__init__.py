@@ -1,0 +1,22 @@
+"""paddle.base compat namespace (upstream renamed paddle.fluid ->
+paddle.base in 2.6; both spellings appear in real user code). Maps the
+high-traffic symbols onto their modern homes so ported scripts import
+cleanly."""
+from ..framework.core import core  # noqa: F401
+from ..static.api import (  # noqa: F401
+    Program, Executor, program_guard, default_main_program,
+    default_startup_program, global_scope, scope_guard, Scope)
+from ..framework.place import CPUPlace, TPUPlace, XLAPlace  # noqa: F401
+from ..framework.place import TPUPlace as CUDAPlace  # noqa: F401
+from ..tensor import Tensor  # noqa: F401
+from ..autograd.grad_mode import no_grad  # noqa: F401
+from .. import framework  # noqa: F401
+
+
+def dygraph_guard(*a, **k):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _g():
+        yield
+    return _g()

@@ -1,0 +1,20 @@
+"""paddle.fluid compat namespace (the pre-2.6 spelling of paddle.base;
+a vast amount of published Paddle code still imports it)."""
+from ..base import (core, Program, Executor, program_guard,  # noqa: F401
+                    default_main_program, default_startup_program,
+                    global_scope, scope_guard, Scope, CPUPlace, CUDAPlace,
+                    Tensor, no_grad, dygraph_guard, framework)
+from ..static import nn as layers  # noqa: F401  (fluid.layers ~ static.nn)
+from .. import io  # noqa: F401
+from ..optimizer import Optimizer  # noqa: F401
+
+
+class dygraph:
+    """fluid.dygraph compat: to_variable/guard."""
+
+    @staticmethod
+    def to_variable(value, name=None, zero_copy=None):
+        from ..ops.creation import to_tensor
+        return to_tensor(value)
+
+    guard = staticmethod(dygraph_guard)

@@ -1,0 +1,46 @@
+"""paddle.base.core compat shim (upstream: the pybind C++ module
+paddle/fluid/pybind). Exposes the handful of core symbols legacy user
+code touches — places, flags accessors, nccl/cuda predicates — mapped
+to the TPU-native equivalents."""
+from __future__ import annotations
+
+from .place import CPUPlace, TPUPlace, XLAPlace
+from .flags import get_flags, set_flags
+
+CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    from ..device import get_all_custom_device_type
+    return bool(get_all_custom_device_type())
+
+
+def get_cuda_device_count():
+    import jax
+    return sum(1 for d in jax.devices() if d.platform != "cpu")
+
+
+def globals():  # matches core.globals() flag mapping
+    return get_flags(None)
+
+
+class core:
+    """Some code does `from paddle.base import core` then `core.X`; this
+    class body re-exports the module surface for that spelling."""
+    CPUPlace = CPUPlace
+    CUDAPlace = TPUPlace
+    XLAPlace = XLAPlace
+    is_compiled_with_cuda = staticmethod(is_compiled_with_cuda)
+    is_compiled_with_xpu = staticmethod(is_compiled_with_xpu)
+    get_cuda_device_count = staticmethod(get_cuda_device_count)
+    set_flags = staticmethod(set_flags)
+    get_flags = staticmethod(get_flags)

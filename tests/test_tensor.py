@@ -139,3 +139,25 @@ class TestSelectedRows:
         assert sr2.rows() == [1, 3] and sr2.height() == 5
         np.testing.assert_allclose(np.asarray(sr2.get_tensor().numpy()),
                                    [[11, 11], [2, 2]])
+
+
+class TestLegacyCompatNamespaces:
+    def test_fluid_and_base(self):
+        from paddle_tpu import fluid
+        from paddle_tpu.base import core
+
+        v = fluid.dygraph.to_variable(np.ones(3, "float32"))
+        assert v.shape == [3]
+        assert not core.is_compiled_with_cuda()
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = paddle.static.data("x", [2, 3])
+            y = fluid.layers.fc(x, 4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        (out,) = exe.run(main, feed={"x": np.ones((2, 3), "float32")},
+                         fetch_list=[y])
+        assert out.shape == (2, 4)
+
+    def test_sysconfig(self):
+        assert "csrc" in paddle.sysconfig.get_include()
+        assert "_native" in paddle.sysconfig.get_lib()

@@ -7,6 +7,7 @@ are delivered by the Pallas kernels + XLA fusion.
 from . import nn
 from . import distributed
 from . import autograd
+from . import asp
 from ..ops import math as _m
 
 softmax_mask_fuse = None

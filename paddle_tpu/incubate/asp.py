@@ -1,0 +1,103 @@
+"""paddle.incubate.asp — Automatic SParsity (parity:
+python/paddle/incubate/asp/, upstream targets Ampere 2:4 sparse tensor
+cores). On TPU the MXU has no structured-sparsity unit, so the value is
+the WORKFLOW parity: compute 2:4 (n:m) masks, prune weights, and keep
+them pruned through fine-tuning by re-masking after every optimizer
+step (the reference's OptimizerWithSparsityGuarantee)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density"]
+
+_excluded = set()
+_masks = {}  # id(param) -> jnp mask
+
+
+def set_excluded_layers(layers=None, main_program=None):
+    """Record layer (full) names whose params must not be pruned."""
+    for l in layers or []:
+        _excluded.add(l if isinstance(l, str) else getattr(
+            l, "_full_name", str(l)))
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _mask_1d(vec, n, m):
+    """Keep the n largest-|.| of every m consecutive weights."""
+    pad = (-len(vec)) % m
+    v = np.pad(vec, (0, pad))
+    groups = np.abs(v).reshape(-1, m)
+    keep = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(-1)[:len(vec)]
+
+
+def _compute_mask(arr, n, m):
+    """2-D weights are pruned along the input dim (reference
+    get_mask_1d/2d best-effort); other ranks along the flattened view."""
+    a = np.asarray(arr)
+    if a.ndim == 2:
+        cols = [_mask_1d(a[:, j], n, m) for j in range(a.shape[1])]
+        return np.stack(cols, axis=1)
+    return _mask_1d(a.reshape(-1), n, m).reshape(a.shape)
+
+
+def _prunable(name, p):
+    if any(ex in name for ex in _excluded):
+        return False
+    v = p._value
+    # the reference prunes mul/fc/conv weights; skip biases/norms/embeddings
+    return v.ndim >= 2 and v.shape[-1] % 4 == 0 and "embed" not in name
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute n:m masks for every prunable parameter and zero the
+    pruned entries in place. Returns {param_name: mask Tensor}."""
+    out = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = _compute_mask(p._value, n, m).astype(np.asarray(
+            p._value).dtype)
+        mj = jnp.asarray(mask)
+        p._value = p._value * mj
+        if with_mask:
+            _masks[id(p)] = mj
+        out[name] = Tensor(mj)
+    return out
+
+
+def calculate_density(x):
+    a = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float((a != 0).sum() / a.size)
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wraps an optimizer: after each step, re-apply the pruning masks so
+    fine-tuning cannot resurrect pruned weights (reference semantics)."""
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def step(self):
+        self._opt.step()
+        for p in self._opt._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask
+
+
+def decorate(optimizer):
+    """Parity: paddle.incubate.asp.decorate."""
+    return OptimizerWithSparsityGuarantee(optimizer)

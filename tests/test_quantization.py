@@ -93,3 +93,25 @@ class TestPTQ:
         # int8 weight quantization should stay close to fp32 outputs
         assert np.abs(out - ref).max() < 0.15
         assert np.abs(out - ref).max() > 0  # something actually quantized
+
+
+class TestASP:
+    def test_prune_and_finetune_keeps_sparsity(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        masks = asp.prune_model(model, n=2, m=4)
+        assert masks
+        assert asp.calculate_density(model[0].weight) <= 0.5 + 1e-6
+        opt = asp.decorate(
+            paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype("float32"))
+        for _ in range(3):
+            loss = (model(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert asp.calculate_density(model[0].weight) <= 0.5 + 1e-6
+        asp.reset_excluded_layers()

@@ -211,3 +211,66 @@ class VisualDL(Callback):
         if self._writer is not None:
             self._writer.close()
             self._writer = None  # a later fit() reopens a fresh file
+
+
+class ReduceLROnPlateau(Callback):
+    """Parity: paddle.callbacks.ReduceLROnPlateau — shrink the lr when
+    the monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._mode = ("min" if mode == "auto" and "loss" in monitor
+                      else ("max" if mode == "auto" else mode))
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self._mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cool > 0:
+            self._cool -= 1
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        if self._cool > 0:
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = float(opt.get_lr())
+                new = max(lr * self.factor, self.min_lr)
+                if new < lr:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {lr:.3g} -> "
+                              f"{new:.3g}")
+            self._wait = 0
+            self._cool = self.cooldown

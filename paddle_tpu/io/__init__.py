@@ -403,3 +403,37 @@ def get_worker_info():
     """Worker metadata inside DataLoader worker processes (else None)."""
     from ._worker import get_worker_info as _gwi
     return _gwi()
+
+
+class SubsetRandomSampler(Sampler):
+    """Parity: paddle.io.SubsetRandomSampler."""
+
+    def __init__(self, indices):
+        super().__init__(indices)
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import random as _random
+        order = list(self.indices)
+        _random.shuffle(order)
+        return iter(order)
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def default_convert_fn(batch):
+    """Parity: paddle.io.dataloader.collate.default_convert_fn — convert
+    leaves to Tensors without stacking."""
+    from ..tensor import Tensor
+    import numpy as _np
+    import jax.numpy as _jnp
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(default_convert_fn(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    if isinstance(batch, Tensor):
+        return batch
+    if isinstance(batch, (_np.ndarray, _np.generic, int, float)):
+        return Tensor(_jnp.asarray(batch))
+    return batch

@@ -217,3 +217,39 @@ class TestVisualDLCallback:
         w = LogWriter(logdir=str(tmp_path / "w2"))
         w.add_scalar("x/y", 1.5, step=3)
         w.close()
+
+
+class TestCallbackAndSamplerAdditions:
+    def test_subset_random_sampler_and_convert(self):
+        import paddle_tpu.io as io
+        s = io.SubsetRandomSampler([3, 5, 7])
+        assert sorted(s) == [3, 5, 7]
+        out = io.default_convert_fn([np.ones(2), {"a": 3}])
+        assert out[0].shape == [2]
+        assert float(out[1]["a"].numpy()) == 3
+
+    def test_reduce_lr_on_plateau(self):
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss",
+                                                patience=1, factor=0.5,
+                                                verbose=0)
+
+        class FakeOpt:
+            def __init__(self):
+                self._lr = 0.1
+
+            def get_lr(self):
+                return self._lr
+
+            def set_lr(self, v):
+                self._lr = v
+
+        class FakeModel:
+            pass
+
+        fm = FakeModel()
+        fm._optimizer = FakeOpt()
+        cb.model = fm
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})
+        cb.on_epoch_end(2, {"loss": 1.0})
+        assert fm._optimizer._lr < 0.1

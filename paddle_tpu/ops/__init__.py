@@ -252,3 +252,15 @@ def _retain_grads(self):
 
 
 Tensor.retain_grads = _retain_grads
+
+
+# third batch of in-place variants
+for _n, _f in {"index_add_": extras.index_add
+               if hasattr(extras, "index_add") else None,
+               "index_put_": manipulation.index_put,
+               "masked_scatter_": manipulation.masked_scatter
+               if hasattr(manipulation, "masked_scatter") else None,
+               "diagonal_scatter_": manipulation.diagonal_scatter}.items():
+    if _f is not None:
+        setattr(Tensor, _n, _make_inplace(_f))
+        _patched.add(_n)

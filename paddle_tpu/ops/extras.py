@@ -24,6 +24,7 @@ __all__ = [
     "index_fill", "masked_scatter", "select_scatter", "slice_scatter",
     "renorm", "block_diag", "pdist", "positive", "negative",
     "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "cartesian_prod", "combinations", "histogram_bin_edges",
 ]
 
 
@@ -347,3 +348,38 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         v = v.reshape(b, h, w, g, c // g)
         return v.transpose(0, 1, 2, 4, 3).reshape(b, h, w, c)
     return apply(fn, _t(x), _name="channel_shuffle")
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (parity: python/paddle/tensor/
+    math.py cartesian_prod)."""
+    xs = [_coerce(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+
+    def fn(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    out = apply(fn, *xs)
+    return out
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-combinations of a 1-D tensor (parity: python/paddle/tensor/
+    math.py combinations)."""
+    import itertools as _it
+    n = _coerce(x).shape[0]
+    gen = (_it.combinations_with_replacement if with_replacement
+           else _it.combinations)
+    idx = np.asarray(list(gen(range(n), r)), np.int32).reshape(-1, r)
+
+    def fn(v):
+        return v[idx]
+    return apply(fn, _coerce(x))
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    """Parity: python/paddle/tensor/linalg.py histogram_bin_edges."""
+    def fn(v):
+        lo, hi = ((min, max) if (min != 0 or max != 0)
+                  else (v.min(), v.max()))
+        return jnp.histogram_bin_edges(v, bins=bins, range=(lo, hi))
+    return apply(fn, _coerce(x))

@@ -549,3 +549,16 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
         vt = vt.at[..., ii, jj].set(yv)
         return vt.transpose(inv)
     return apply(fn, _coerce(x), _coerce(y))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto the (axis1, axis2) diagonal (parity:
+    python/paddle/tensor/manipulation.py diagonal_scatter)."""
+    return fill_diagonal_tensor(x, y, offset=offset, dim1=axis1,
+                                dim2=axis2)
+
+
+def matrix_transpose(x, name=None):
+    """Swap the last two dims (parity: paddle Tensor.mT /
+    matrix_transpose)."""
+    return apply(lambda v: jnp.swapaxes(v, -1, -2), _coerce(x))

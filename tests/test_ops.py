@@ -424,3 +424,34 @@ class TestExtraOps:
         y = paddle.hstack([x * 2, x * 3]).sum()
         y.backward()
         np.testing.assert_allclose(np.asarray(x.grad.numpy()), [5.0, 5.0])
+
+
+class TestOpTail2:
+    def test_diagonal_scatter_matrix_transpose(self):
+        x = paddle.to_tensor(np.zeros((3, 4), "float32"))
+        y = paddle.to_tensor(np.array([1., 2, 3], "float32"))
+        out = paddle.diagonal_scatter(x, y)
+        assert np.allclose(np.diag(out.numpy()[:, :3]), [1, 2, 3])
+        m = paddle.matrix_transpose(
+            paddle.to_tensor(np.ones((2, 3, 4), "float32")))
+        assert m.shape == [2, 4, 3]
+
+    def test_cartesian_combinations_binedges(self):
+        cp = paddle.cartesian_prod([paddle.to_tensor(np.array([1, 2])),
+                                    paddle.to_tensor(np.array([3, 4, 5]))])
+        assert cp.shape == [6, 2]
+        ref = np.array([[a, b] for a in [1, 2] for b in [3, 4, 5]])
+        np.testing.assert_array_equal(cp.numpy(), ref)
+        cb = paddle.combinations(paddle.to_tensor(np.array([1, 2, 3, 4])),
+                                 r=2)
+        assert cb.shape == [6, 2]
+        be = paddle.histogram_bin_edges(
+            paddle.to_tensor(np.array([0., 1, 2, 3])), bins=4)
+        np.testing.assert_allclose(be.numpy(), [0, 0.75, 1.5, 2.25, 3.0])
+
+    def test_inplace_index_put(self):
+        t = paddle.to_tensor(np.zeros((2, 3), "float32"))
+        t.index_put_([paddle.to_tensor(np.array([0])),
+                      paddle.to_tensor(np.array([1]))],
+                     paddle.to_tensor(np.array([9.0], "float32")))
+        assert t.numpy()[0, 1] == 9.0

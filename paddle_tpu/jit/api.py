@@ -112,8 +112,10 @@ class StaticFunction:
         # non-tensor leaves (python ints/bools/strs...) are baked into
         # the traced program as constants, so they MUST be part of the
         # cache key — f(x, 0) and f(x, 3) are different programs
+        training_now = (self._layer.training if self._layer is not None
+                        else False)
         sig = (tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays),
-               _freeze(struct))
+               _freeze(struct), training_now)
 
         if sig not in self._cache:
             fn = self._fn
@@ -160,6 +162,38 @@ class StaticFunction:
         compiled, out_struct_box = self._cache[sig]
         gen = default_generator()
         key_in = gen.split()
+
+        from ..autograd.grad_mode import is_grad_enabled
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in p_tensors + in_tensors)
+        if needs_grad:
+            # route through the eager tape so loss.backward() on the
+            # compiled forward reaches params/inputs (paddle semantics:
+            # a to_static layer trains like its dygraph form). jax.vjp
+            # differentiates straight through the jitted callable.
+            from ..ops._dispatch import apply
+            n_p, n_b = len(p_tensors), len(b_tensors)
+
+            def tape_fn(*arrays):
+                p_vals = list(arrays[:n_p])
+                b_vals = list(arrays[n_p:n_p + n_b])
+                key = arrays[n_p + n_b]
+                arg_vals = list(arrays[n_p + n_b + 1:])
+                outs, new_b, new_key = compiled(p_vals, b_vals, key,
+                                                arg_vals)
+                return tuple(outs) + tuple(new_b) + (new_key,)
+
+            res = apply(tape_fn, *p_tensors, *b_tensors, key_in,
+                        *in_tensors, _name="to_static")
+            res = res if isinstance(res, tuple) else (res,)
+            n_out = len(res) - n_b - 1
+            for t, v in zip(b_tensors, res[n_out:n_out + n_b]):
+                t._value = v._value
+            # rng: gen.split() above already advanced the host key (the
+            # no-grad path relies on the same convention)
+            it = iter(res[:n_out])
+            return _rebuild(out_struct_box["s"], it, lambda t: t)
+
         outs, new_b, new_key = compiled(
             [t._value for t in p_tensors], [t._value for t in b_tensors],
             key_in, in_arrays)

@@ -14,8 +14,15 @@ What is transformed:
   with the loop-carried variables (names written in the body that are
   read before written, or read by the predicate) as explicit state.
 
+break/continue inside transformed while / for-range loops are supported
+(parity: dy2static's BreakContinueTransformer): the statements become
+loop-carried flags, downstream statements are guarded by
+`if not (brk or cnt):`, and the loop condition gains `and not brk` —
+all of which then lower through the if/while machinery, so a break on a
+traced condition compiles into the lax.while_loop predicate.
+
 Deliberate limitations (transform skipped, original semantics kept):
-branches containing return/break/continue/yield; while-else; functions
+loop bodies containing return/yield; while-else / for-else; functions
 whose source is unavailable or that capture closure cells. Temps that a
 while body assigns before reading are locals of one iteration and are
 not visible after the loop (matching lax.while_loop's carried-state
@@ -71,7 +78,13 @@ class _AssignCollector(ast.NodeVisitor):
         self.generic_visit(n)
 
     def visit_FunctionDef(self, n):
-        self.names.add(n.name)  # the def binds; don't recurse into scope
+        # the def binds; don't recurse into scope. Generated closure
+        # defs (__jst_*) are block-local artifacts of this transform,
+        # never user state — treating them as assignments would drag
+        # them into if-merge outputs / loop carries where they are read
+        # before any binding exists.
+        if not n.name.startswith("__jst_"):
+            self.names.add(n.name)
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -86,18 +99,37 @@ def _assigned(stmts) -> Set[str]:
     return c.names
 
 
+def _is_try_read_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "try_read"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "__jst__")
+
+
 def _loaded(node_or_stmts) -> Set[str]:
     out: Set[str] = set()
     nodes = node_or_stmts if isinstance(node_or_stmts, list) \
         else [node_or_stmts]
+
+    def walk(n):
+        # __jst__.try_read(lambda: x, 'x') probes a possibly-unbound
+        # name defensively — it must not count as a real read, or the
+        # probed name gets dragged into loop carries / closure params
+        # it was never bound for
+        if _is_try_read_call(n):
+            return
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+        elif isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Name):
+            # y += 1 READS y even though the target ctx is Store
+            out.add(n.target.id)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
     for n in nodes:
-        for sub in ast.walk(n):
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
-                out.add(sub.id)
-            elif isinstance(sub, ast.AugAssign) and isinstance(
-                    sub.target, ast.Name):
-                # y += 1 READS y even though the target ctx is Store
-                out.add(sub.target.id)
+        walk(n)
     return out
 
 
@@ -134,6 +166,166 @@ def _has_breaker(stmts) -> bool:
     for s in stmts:
         b.visit(s)
     return b.found
+
+
+class _ReturnFinder(ast.NodeVisitor):
+    """return/yield inside a loop body (any depth short of a nested
+    scope) — these still force python semantics."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, n):
+        self.found = True
+
+    def visit_Yield(self, n):
+        self.found = True
+
+    def visit_YieldFrom(self, n):
+        self.found = True
+
+    def visit_FunctionDef(self, n):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, n):
+        pass
+
+
+def _has_return(stmts) -> bool:
+    f = _ReturnFinder()
+    for s in stmts:
+        f.visit(s)
+    return f.found
+
+
+class _DirectBreakFinder(ast.NodeVisitor):
+    """break/continue bound to THIS loop (does not descend into nested
+    loops, which own their break/continue)."""
+
+    def __init__(self):
+        self.brk = False
+        self.cnt = False
+
+    def visit_Break(self, n):
+        self.brk = True
+
+    def visit_Continue(self, n):
+        self.cnt = True
+
+    def visit_While(self, n):
+        pass
+
+    def visit_For(self, n):
+        pass
+
+    visit_AsyncFor = visit_For
+
+    def visit_FunctionDef(self, n):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, n):
+        pass
+
+
+def _direct_breaks(stmts):
+    f = _DirectBreakFinder()
+    for s in stmts:
+        f.visit(s)
+    return f.brk, f.cnt
+
+
+def _breaks_rewritable(stmts) -> bool:
+    """True iff every direct break/continue sits under plain If nesting —
+    the only shape _rewrite_break_continue handles. A break inside
+    with/try (or any other compound statement) keeps python semantics."""
+    for s in stmts:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            continue
+        if isinstance(s, ast.If):
+            if not _breaks_rewritable(s.body):
+                return False
+            if s.orelse and not _breaks_rewritable(s.orelse):
+                return False
+            continue
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor,
+                          ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested loops/scopes own their breaks
+        b, c = _direct_breaks([s])
+        if b or c:
+            return False
+    return True
+
+
+def _brk_init(brk):
+    return ast.Assign(targets=[_name(brk, store=True)],
+                      value=ast.Constant(False))
+
+
+def _augment_test(test, brk):
+    return ast.BoolOp(op=ast.And(),
+                      values=[ast.UnaryOp(op=ast.Not(),
+                                          operand=_name(brk)), test])
+
+
+def _rewrite_break_continue(body, brk, cnt):
+    """Rewrite a loop body so its DIRECT break/continue statements become
+    flag assignments, with every statement downstream of a conditional
+    break/continue guarded by `if not (brk or cnt):` (parity:
+    dy2static's BreakContinueTransformer). Returns the new body; the
+    caller adds the flag init/reset and augments the loop condition."""
+    def set_flag(name):
+        return ast.Assign(targets=[_name(name, store=True)],
+                          value=ast.Constant(True))
+
+    def guard_test():
+        flags = []
+        if brk:
+            flags.append(_name(brk))
+        if cnt:
+            flags.append(_name(cnt))
+        t = flags[0] if len(flags) == 1 else ast.BoolOp(op=ast.Or(),
+                                                        values=flags)
+        return ast.UnaryOp(op=ast.Not(), operand=t)
+
+    def contains_direct(stmt):
+        b, c = _direct_breaks([stmt])
+        return b or c
+
+    def rewrite_block(stmts):
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(set_flag(brk))
+                return out  # later statements are unreachable
+            if isinstance(s, ast.Continue):
+                out.append(set_flag(cnt))
+                return out
+            if isinstance(s, ast.If) and contains_direct(s):
+                s = ast.If(test=s.test, body=rewrite_block(s.body),
+                           orelse=rewrite_block(s.orelse)
+                           if s.orelse else [])
+                out.append(s)
+                rest = rewrite_block(stmts[i + 1:])
+                if rest:
+                    # identity reads make the flags read-before-write in
+                    # the guard body, so the if-split closures receive
+                    # them as parameters (their inner merges then see
+                    # the real prior value instead of Undefined)
+                    idents = [ast.Assign(targets=[_name(f, store=True)],
+                                         value=_name(f))
+                              for f in (brk, cnt) if f]
+                    out.append(ast.If(test=guard_test(),
+                                      body=idents + rest, orelse=[]))
+                return out
+            # nested loops own their breaks; everything else is opaque
+            out.append(s)
+        return out
+
+    return rewrite_block(list(body))
 
 
 def _read_before_write(stmts) -> Set[str]:
@@ -278,6 +470,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         compiles to lax.while_loop. Non-range iterables, else-clauses,
         and loops containing break/continue/return keep python
         semantics."""
+        brk_name = None
+        it = node.iter
+        if (not node.orelse
+                and isinstance(node.target, ast.Name)
+                and isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            new_body, brk_name, _cnt = self._maybe_rewrite_loop_body(
+                node.body)
+            if new_body is not None:
+                node = ast.For(target=node.target, iter=node.iter,
+                               body=new_body, orelse=[])
         self.generic_visit(node)
         it = node.iter
         if (node.orelse or _has_breaker(node.body)
@@ -328,20 +533,55 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                  op=ast.Add(), value=_name(pvar))])
         test = _jst_call("range_cond",
                          [_name(ivar), _name(svar), _name(pvar)])
+        if brk_name is not None:
+            # `break` support: the loop also stops once the flag is set
+            init.append(_brk_init(brk_name))
+            test = _augment_test(test, brk_name)
         while_node = ast.While(test=test, body=body, orelse=[])
         while_node._jst_extra_carry = [tgt]
         out = self.visit_While(while_node)
         self.changed = True
         return init + (out if isinstance(out, list) else [out])
 
+    def _maybe_rewrite_loop_body(self, body):
+        """Shared break/continue preamble for visit_For/visit_While.
+        Returns (new_body, brk_name, cnt_name), all None when no rewrite
+        applies (no direct breaks, return/yield present, or a break
+        inside with/try — those keep python semantics)."""
+        if _has_return(body):
+            return None, None, None
+        b, c = _direct_breaks(body)
+        if not (b or c) or not _breaks_rewritable(body):
+            return None, None, None
+        self._n += 1
+        brk = f"__jst_brk_{self._n}" if b else None
+        cnt = f"__jst_cnt_{self._n}" if c else None
+        new_body = _rewrite_break_continue(body, brk, cnt)
+        if cnt:
+            new_body = [ast.Assign(targets=[_name(cnt, store=True)],
+                                   value=ast.Constant(False))] + new_body
+        return new_body, brk, cnt
+
     def visit_While(self, node):
+        pre = []
+        if not node.orelse:
+            new_body, brk, _cnt = self._maybe_rewrite_loop_body(node.body)
+            if new_body is not None:
+                test = node.test
+                if brk:
+                    pre.append(_brk_init(brk))
+                    test = _augment_test(test, brk)
+                new_node = ast.While(test=test, body=new_body, orelse=[])
+                new_node._jst_extra_carry = list(
+                    getattr(node, "_jst_extra_carry", []))
+                node = new_node
         self.generic_visit(node)
         if node.orelse or _has_breaker(node.body):
-            return node
+            return (pre + [node]) if pre else node
         carry = sorted(set(_loop_carried(node.body, node.test))
                        | set(getattr(node, "_jst_extra_carry", [])))
         if not carry:
-            return node
+            return (pre + [node]) if pre else node
         self._n += 1
         cname = f"__jst_cond_{self._n}"
         bname = f"__jst_body_{self._n}"
@@ -354,7 +594,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         out = ast.Assign(targets=[_tuple_of(carry, store=True)],
                          value=call)
         self.changed = True
-        return [c_def, b_def, out]
+        return pre + [c_def, b_def, out]
 
 
 # ---------------------------------------------------------------- runtime

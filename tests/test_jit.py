@@ -704,3 +704,180 @@ class TestStaticBackwardAndScope:
             assert v.get_tensor() is not None
         assert static.global_scope().find_var("nope") is None
         assert len(static.cpu_places(2)) == 2
+
+
+class TestBreakContinueTransform:
+    def test_for_range_break(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            total = x * 0
+            for i in range(n):
+                if i >= 3:
+                    break
+                total = total + i
+            return total, i
+
+        x = paddle.to_tensor(np.float32(0.0))
+        out, i = f(x, 10)
+        assert float(out.numpy()) == 3.0
+        assert int(i.numpy() if hasattr(i, "numpy") else i) == 3
+
+    def test_for_range_continue(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            total = x * 0
+            for i in range(n):
+                if i % 2 == 0:
+                    continue
+                total = total + i
+            return total
+
+        out = f(paddle.to_tensor(np.float32(0.0)), 6)
+        assert float(out.numpy()) == 9.0  # 1 + 3 + 5
+
+    def test_while_break_tensor_condition(self):
+        @paddle.jit.to_static
+        def f(x):
+            i = 0
+            s = x * 0
+            while i < 100:
+                s = s + i
+                if s > 10:
+                    break
+                i = i + 1
+            return s, i
+
+        s, i = f(paddle.to_tensor(np.float32(0.0)))
+        assert float(s.numpy()) == 15.0  # 0+..+4=10, +5 -> 15, break
+
+    def test_traced_bound_break_compiles_to_while_loop(self):
+        @paddle.jit.to_static
+        def f(x, bound):
+            total = x * 0
+            for i in range(bound):  # tensor bound -> lax.while_loop
+                if total >= 6.0:
+                    break
+                total = total + 2.0
+            return total
+
+        out = f(paddle.to_tensor(np.float32(0.0)),
+                paddle.to_tensor(np.int64(100)))
+        assert float(out.numpy()) == 6.0
+
+    def test_mix_and_nested(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            acc = x * 0
+            for i in range(n):
+                if i == 1:
+                    continue
+                if i == 4:
+                    break
+                acc = acc + i
+            return acc
+
+        out = f(paddle.to_tensor(np.float32(0.0)), 10)
+        assert float(out.numpy()) == 5.0  # 0 + 2 + 3
+
+        @paddle.jit.to_static
+        def g(x, n):
+            acc = x * 0
+            for i in range(n):
+                for j in range(10):
+                    if j >= 2:
+                        break
+                    acc = acc + 1
+            return acc
+
+        out = g(paddle.to_tensor(np.float32(0.0)), 3)
+        assert float(out.numpy()) == 6.0
+
+
+class TestToStaticTraining:
+    def test_backward_through_compiled_forward(self):
+        """to_static forwards route through the tape when grads are
+        needed, so loss.backward() trains the layer (paddle semantics:
+        a to_static layer trains like its dygraph form)."""
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                h = paddle.tanh(self.fc(x))
+                if h.mean() > 0:   # traced -> lax.cond
+                    h = h * 2.0
+                else:
+                    h = h * 0.5
+                return h
+
+        net = Net()
+        opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype("float32"))
+        losses = []
+        for _ in range(6):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+
+    def test_inference_path_unchanged_under_no_grad(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype("float32"))
+        with paddle.no_grad():
+            out = net(x)
+        assert out.stop_gradient
+        ref = x.numpy() @ net.fc.weight.numpy() + net.fc.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+class TestBreakRewriteEdgeCases:
+    def test_break_inside_with_keeps_python_semantics(self):
+        import contextlib
+
+        @paddle.jit.to_static
+        def f(x, n):
+            total = x * 0
+            for i in range(n):
+                if i >= 2:
+                    with contextlib.nullcontext():
+                        break
+                total = total + 1.0
+            return total
+
+        out = f(paddle.to_tensor(np.float32(0.0)), 10)
+        assert float(out.numpy()) == 2.0
+
+    def test_training_mode_in_cache_key(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.drop = nn.Dropout(0.5)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                return self.drop(x)
+
+        net = Net()
+        x = paddle.to_tensor(np.ones((64,), "float32"))
+        net.train()
+        out_t = net(x)
+        net.eval()
+        out_e = net(x)
+        # eval must be deterministic identity, not the cached train prog
+        np.testing.assert_allclose(out_e.numpy(), np.ones(64), atol=0)
+        assert (out_t.numpy() == 0).any()  # train program really dropped

@@ -19,32 +19,47 @@ __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Slice x into overlapping frames along `axis` (paddle.signal.frame)."""
+    """Slice x into overlapping frames along the time axis
+    (paddle.signal.frame). axis=-1: time last, output
+    [..., frame_length, num_frames]; axis=0: time first, output
+    [num_frames, frame_length, ...] (the reference's mirrored layout)."""
     def fn(v):
-        if axis not in (-1, v.ndim - 1):
-            raise NotImplementedError("frame: only axis=-1 supported")
-        n = v.shape[-1]
+        # for 1-D input axes 0 and -1 coincide; the OUTPUT layout follows
+        # the axis value the caller passed (paddle semantics)
+        first = axis == 0 or (v.ndim > 1 and axis == -v.ndim)
+        if not first and axis not in (-1, v.ndim - 1):
+            raise ValueError("frame: axis must be 0 or -1")
+        vt = jnp.moveaxis(v, 0, -1) if first else v
+        n = vt.shape[-1]
         num = 1 + (n - frame_length) // hop_length
         starts = jnp.arange(num) * hop_length
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]
-        return jnp.moveaxis(v[..., idx], -2, -1)  # [..., frame_length, num]
+        out = jnp.moveaxis(vt[..., idx], -2, -1)  # [..., fl, num]
+        if first:
+            out = jnp.moveaxis(out, (-1, -2), (0, 1))  # [num, fl, ...]
+        return out
     return apply(fn, _coerce(x), _name="frame")
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """Inverse of frame: x [..., frame_length, frames] → signal."""
+    """Inverse of frame. axis=-1: x [..., frame_length, num_frames];
+    axis=0: x [num_frames, frame_length, ...]."""
     def fn(v):
-        if axis not in (-1, v.ndim - 1):
-            raise NotImplementedError("overlap_add: only axis=-1 supported")
-        fl, num = v.shape[-2], v.shape[-1]
+        first = axis == 0 or (v.ndim > 2 and axis == -v.ndim)
+        if not first and axis not in (-1, v.ndim - 1):
+            raise ValueError("overlap_add: axis must be 0 or -1")
+        vt = jnp.moveaxis(v, (0, 1), (-1, -2)) if first else v
+        fl, num = vt.shape[-2], vt.shape[-1]
         out_len = (num - 1) * hop_length + fl
         starts = jnp.arange(num) * hop_length
-        idx = (starts[None, :] + jnp.arange(fl)[:, None]).reshape(-1)
-        flat = jnp.moveaxis(v, -1, -2).reshape(*v.shape[:-2], num * fl)
+        flat = jnp.moveaxis(vt, -1, -2).reshape(*vt.shape[:-2], num * fl)
         # scatter-add frames into the output timeline
-        out = jnp.zeros((*v.shape[:-2], out_len), v.dtype)
+        out = jnp.zeros((*vt.shape[:-2], out_len), vt.dtype)
         idx2 = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
-        return out.at[..., idx2].add(flat)
+        out = out.at[..., idx2].add(flat)
+        if first:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
     return apply(fn, _coerce(x), _name="overlap_add")
 
 

@@ -190,3 +190,15 @@ class TestSparseUnaryAndNN:
         np.testing.assert_allclose(s.to_dense().numpy()[0, [0, 2]], row0,
                                    rtol=1e-5)
         np.testing.assert_allclose(s.to_dense().numpy()[1, 1], 1.0)
+
+
+class TestFrameAxis0:
+    def test_frame_overlap_add_axis0_matches_transposed(self):
+        import paddle_tpu.signal as sig
+        x0 = np.random.RandomState(1).randn(64, 2).astype("float32")
+        f_first = sig.frame(paddle.to_tensor(x0), 16, 8, axis=0)
+        assert f_first.shape == [7, 16, 2]
+        rec0 = sig.overlap_add(f_first, 8, axis=0)
+        fa = sig.frame(paddle.to_tensor(x0.T), 16, 8, axis=-1)
+        ra = sig.overlap_add(fa, 8, axis=-1).numpy()
+        np.testing.assert_allclose(rec0.numpy(), ra.T, atol=1e-6)

@@ -539,6 +539,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             test = _augment_test(test, brk_name)
         while_node = ast.While(test=test, body=body, orelse=[])
         while_node._jst_extra_carry = [tgt]
+        # static-bound hint: lets the runtime lower to a masked lax.scan
+        # (differentiable) instead of lax.while_loop when the range
+        # bounds are concrete
+        while_node._jst_bound_args = (ivar, svar, pvar)
         out = self.visit_While(while_node)
         self.changed = True
         return init + (out if isinstance(out, list) else [out])
@@ -574,6 +578,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 new_node = ast.While(test=test, body=new_body, orelse=[])
                 new_node._jst_extra_carry = list(
                     getattr(node, "_jst_extra_carry", []))
+                new_node._jst_bound_args = getattr(node, "_jst_bound_args",
+                                                   None)
                 node = new_node
         self.generic_visit(node)
         if node.orelse or _has_breaker(node.body):
@@ -589,8 +595,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         b_def = _funcdef(bname, carry,
                          list(node.body) + [ast.Return(
                              value=_tuple_of(carry))])
-        call = _jst_call("while_loop",
-                         [_name(cname), _name(bname), _tuple_of(carry)])
+        ba = getattr(node, "_jst_bound_args", None)
+        kw = []
+        if ba:
+            kw = [ast.keyword(arg="bound", value=_jst_call(
+                "trip_count", [_name(ba[0]), _name(ba[1]), _name(ba[2])]))]
+        call = ast.Call(
+            func=ast.Attribute(value=_name("__jst__"), attr="while_loop",
+                               ctx=ast.Load()),
+            args=[_name(cname), _name(bname), _tuple_of(carry)],
+            keywords=kw)
         out = ast.Assign(targets=[_tuple_of(carry, store=True)],
                          value=call)
         self.changed = True
@@ -797,7 +811,22 @@ class _Helpers:
         return tuple(by_name.get(n, Undefined(n)) for n in names)
 
     @staticmethod
-    def while_loop(cond_fn, body_fn, init):
+    def trip_count(i, stop, step):
+        """Static trip count of range(i, stop, step), or None when any
+        bound is traced (data-dependent)."""
+        from ..tensor import Tensor
+        vals = []
+        for v in (i, stop, step):
+            if _Helpers._is_traced(v):
+                return None
+            vals.append(int(v.numpy()) if isinstance(v, Tensor) else int(v))
+        i0, st, sp = vals
+        if sp > 0:
+            return max(0, -(-(st - i0) // sp))
+        return max(0, -((st - i0) // -sp) if sp else 0)
+
+    @staticmethod
+    def while_loop(cond_fn, body_fn, init, bound=None):
         traced = any(_Helpers._is_traced(v) for v in init)
         from ..tensor import Tensor
         if not traced:
@@ -809,7 +838,6 @@ class _Helpers:
                     return vals
                 out = body_fn(*vals)
                 vals = out if isinstance(out, tuple) else (out,)
-        from ..static.nn import while_loop as _while
         init_t = tuple(_Helpers._coerce_outs(tuple(init)))
 
         def body(*vs):
@@ -817,6 +845,34 @@ class _Helpers:
             out = out if isinstance(out, tuple) else (out,)
             return tuple(_Helpers._coerce_outs(out))
 
+        if bound is not None:
+            # STATIC trip count (for-range with a possibly-traced break
+            # flag): lower to a masked lax.scan instead of while_loop so
+            # reverse-mode works — jax cannot differentiate a dynamic
+            # trip count, but a bounded loop is just a scan whose
+            # iterations no-op once the condition goes false.
+            import jax
+            import jax.numpy as jnp
+
+            def unwrap(vs):
+                return tuple(v._value if isinstance(v, Tensor)
+                             else jnp.asarray(v) for v in vs)
+
+            def step(carry, _):
+                targs = tuple(Tensor(a) for a in carry)
+                pred = cond_fn(*targs)
+                pv = pred._value if isinstance(pred, Tensor) else pred
+                pv = jnp.asarray(pv).reshape(()).astype(bool)
+                new = unwrap(body(*targs))
+                out = tuple(jnp.where(pv, n, c) for n, c in
+                            zip(new, carry))
+                return out, None
+
+            carry, _ = jax.lax.scan(step, unwrap(init_t), None,
+                                    length=int(bound))
+            return tuple(Tensor(a) for a in carry)
+
+        from ..static.nn import while_loop as _while
         outs = _while(cond_fn, body, list(init_t))
         return tuple(outs)
 

@@ -881,3 +881,36 @@ class TestBreakRewriteEdgeCases:
         # eval must be deterministic identity, not the cached train prog
         np.testing.assert_allclose(out_e.numpy(), np.ones(64), atol=0)
         assert (out_t.numpy() == 0).any()  # train program really dropped
+
+
+class TestBoundedScanDifferentiability:
+    def test_grad_through_break_loop_with_static_bound(self):
+        """A traced break condition with a STATIC range bound lowers to
+        a masked lax.scan, so training through the loop works (plain
+        lax.while_loop cannot be reverse-differentiated)."""
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            @paddle.jit.to_static
+            def forward(self, x):
+                h = x
+                for i in range(6):  # static bound
+                    h = paddle.tanh(self.fc(h))
+                    if (h * h).mean() < 1e-6:  # traced break
+                        break
+                return h
+
+        net = Net()
+        opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype("float32"))
+        losses = []
+        for _ in range(5):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses

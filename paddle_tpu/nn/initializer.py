@@ -231,3 +231,38 @@ class ParamAttr:
 constant = Constant
 normal = Normal
 uniform = Uniform
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (parity:
+    paddle.nn.initializer.Bilinear)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as _np
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects 4-D weights")
+        c_out, c_in, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        cw = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = _np.ogrid[:kh, :kw]
+        filt = ((1 - _np.abs(og[0] / f_h - ch))
+                * (1 - _np.abs(og[1] / f_w - cw)))
+        w = _np.zeros(shape, _np.float32)
+        for i in range(c_out):
+            for j in range(c_in):
+                w[i, j] = filt
+        import jax.numpy as _jnp
+        return _jnp.asarray(w, dtype)
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Parity: paddle.nn.initializer.set_global_initializer — default
+    initializers used by create_parameter when neither the attr nor the
+    layer specifies one. Pass None, None to reset."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init

@@ -127,7 +127,11 @@ class Layer:
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
-        init = attr.initializer or default_initializer or \
+        from .initializer import _GLOBAL_INIT
+        # set_global_initializer overrides the layers' built-in defaults
+        # but never an explicit ParamAttr initializer (paddle semantics)
+        g = _GLOBAL_INIT["bias"] if is_bias else _GLOBAL_INIT["weight"]
+        init = attr.initializer or g or default_initializer or \
             (Constant(0.0) if is_bias else XavierUniform())
         value = _resolve_initializer(init)(shape, d)
         p = Parameter(value, trainable=attr.trainable, name=attr.name)

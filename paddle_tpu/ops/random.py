@@ -150,3 +150,33 @@ def randn_like(x, dtype=None, name=None) -> Tensor:
     x = _coerce(x)
     return Tensor(jax.random.normal(next_key(), tuple(x._value.shape),
                                     _dt(dtype, x.dtype)))
+
+
+def standard_gamma(x, name=None) -> Tensor:
+    """Sample Gamma(alpha=x, 1) (parity: paddle.standard_gamma)."""
+    x = _coerce(x)
+    return Tensor(jax.random.gamma(next_key(), x._value).astype(x.dtype))
+
+
+def standard_exponential(x, name=None) -> Tensor:
+    """Sample Exp(1) in x's shape (parity: paddle.standard_exponential)."""
+    x = _coerce(x)
+    return Tensor(jax.random.exponential(next_key(), x._value.shape,
+                                         x._value.dtype))
+
+
+def cauchy_(x, loc=0, scale=1, name=None) -> Tensor:
+    """In-place standard-Cauchy fill (parity: paddle.Tensor.cauchy_)."""
+    x._value = (loc + scale * jax.random.cauchy(
+        next_key(), x._value.shape, x._value.dtype)).astype(x._value.dtype)
+    return x
+
+
+def geometric_(x, probs=0.5, name=None) -> Tensor:
+    """In-place geometric fill (number of Bernoulli(p) trials until the
+    first success, support {1, 2, ...} — paddle.Tensor.geometric_)."""
+    u = jax.random.uniform(next_key(), x._value.shape)
+    import numpy as _np
+    k = jnp.ceil(jnp.log1p(-u) / _np.log1p(-probs))
+    x._value = jnp.maximum(k, 1.0).astype(x._value.dtype)
+    return x

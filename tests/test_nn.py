@@ -387,3 +387,45 @@ class TestNNUtils:
             lin(paddle.to_tensor(rng.randn(2, 6).astype("float32")))
         sv = np.linalg.svd(lin.weight.numpy(), compute_uv=False)[0]
         assert abs(sv - 1.0) < 0.05
+
+
+class TestInitializerAdditions:
+    def test_bilinear_kernel(self):
+        b = nn.initializer.Bilinear()
+        ct = nn.Conv2DTranspose(3, 3, 4, stride=2,
+                                weight_attr=nn.ParamAttr(initializer=b))
+        w = ct.weight.numpy()
+        assert w.shape == (3, 3, 4, 4)
+        expect = np.array([[0.0625, 0.1875, 0.1875, 0.0625],
+                           [0.1875, 0.5625, 0.5625, 0.1875],
+                           [0.1875, 0.5625, 0.5625, 0.1875],
+                           [0.0625, 0.1875, 0.1875, 0.0625]], np.float32)
+        np.testing.assert_allclose(w[0, 0], expect, atol=1e-6)
+
+    def test_set_global_initializer_precedence(self):
+        try:
+            nn.initializer.set_global_initializer(
+                nn.initializer.Constant(0.5))
+            lin = nn.Linear(3, 2)
+            assert np.allclose(lin.weight.numpy(), 0.5)
+            lin3 = nn.Linear(3, 2, weight_attr=nn.ParamAttr(
+                initializer=nn.initializer.Constant(1.5)))
+            assert np.allclose(lin3.weight.numpy(), 1.5)
+        finally:
+            nn.initializer.set_global_initializer(None, None)
+        assert not np.allclose(nn.Linear(3, 2).weight.numpy(), 0.5)
+
+    def test_random_fill_family(self):
+        t2 = paddle.to_tensor(np.zeros(4000, "float32"))
+        t2.geometric_(0.5)
+        assert abs(float(t2.numpy().mean()) - 2.0) < 0.3
+        assert t2.numpy().min() >= 1
+        t = paddle.to_tensor(np.zeros(2000, "float32"))
+        t.cauchy_()
+        assert np.isfinite(np.median(t.numpy()))
+        g = paddle.standard_gamma(
+            paddle.to_tensor(np.full((2000,), 3.0, "float32")))
+        assert abs(float(g.numpy().mean()) - 3.0) < 0.3
+        e = paddle.standard_exponential(
+            paddle.to_tensor(np.zeros(2000, "float32")))
+        assert abs(float(e.numpy().mean()) - 1.0) < 0.2

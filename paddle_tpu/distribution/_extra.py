@@ -312,7 +312,8 @@ class MultivariateNormal(Distribution):
         def fn(v, l, t):
             d = l.shape[-1]
             diff = v - l
-            sol = jax.scipy.linalg.solve_triangular(t, diff[..., None],
+            tb = jnp.broadcast_to(t, diff.shape[:-1] + t.shape[-2:])
+            sol = jax.scipy.linalg.solve_triangular(tb, diff[..., None],
                                                     lower=True)[..., 0]
             logdet = jnp.sum(jnp.log(jnp.diagonal(t, axis1=-2, axis2=-1)),
                              -1)
@@ -634,3 +635,59 @@ class TransformedDistribution(Distribution):
             y = x
         base_lp = self.base.log_prob(y)
         return om.subtract(base_lp, lp)
+
+
+# ----------------------------------------------------------------- KL ------
+from . import register_kl  # noqa: E402
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def fn(a1, b1, a2, b2):
+        dg = jax.scipy.special.digamma
+        bl = jax.scipy.special.betaln
+        return (bl(a2, b2) - bl(a1, b1)
+                + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+    return apply(fn, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def fn(c1, r1, c2, r2):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        return ((c1 - c2) * dg(c1) - gl(c1) + gl(c2)
+                + c2 * (jnp.log(r1) - jnp.log(r2))
+                + c1 * (r2 - r1) / r1)
+    return apply(fn, p.concentration, p.rate, q.concentration, q.rate)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def fn(c1, c2):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        a0 = jnp.sum(c1, -1)
+        return (gl(a0) - jnp.sum(gl(c1), -1)
+                - gl(jnp.sum(c2, -1)) + jnp.sum(gl(c2), -1)
+                + jnp.sum((c1 - c2) * (dg(c1) - dg(a0)[..., None]), -1))
+    return apply(fn, p.concentration, q.concentration)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def fn(l1, t1, l2, t2):
+        d = l1.shape[-1]
+        # KL = 0.5 [ tr(S2^-1 S1) + (m2-m1)^T S2^-1 (m2-m1) - d
+        #            + ln det S2 - ln det S1 ]
+        m = jax.scipy.linalg.solve_triangular(t2, t1, lower=True)
+        tr = jnp.sum(m * m, axis=(-2, -1))
+        diff = l2 - l1
+        sol = jax.scipy.linalg.solve_triangular(t2, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol * sol, -1)
+        ld1 = jnp.sum(jnp.log(jnp.diagonal(t1, axis1=-2, axis2=-1)), -1)
+        ld2 = jnp.sum(jnp.log(jnp.diagonal(t2, axis1=-2, axis2=-1)), -1)
+        return 0.5 * (tr + maha - d) + ld2 - ld1
+    return apply(fn, p.loc, p._tril, q.loc, q._tril)

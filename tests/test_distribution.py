@@ -268,3 +268,39 @@ class TestDistributionReviewRegressions:
         for name in ("Beta", "Gamma", "TransformedDistribution",
                      "StickBreakingTransform"):
             assert name in ns, name
+
+
+class TestSecondTierKL:
+    def _mc_kl(self, p, q, n=100000):
+        s = p.sample([n])
+        return float((p.log_prob(s) - q.log_prob(s)).numpy().mean())
+
+    def test_kl_closed_forms_match_monte_carlo(self):
+        t = paddle.to_tensor
+        f32 = np.float32
+        pairs = [
+            (D.Beta(t(f32(2.0)), t(f32(3.0))),
+             D.Beta(t(f32(4.0)), t(f32(2.0))), 0.03),
+            (D.Gamma(t(f32(3.0)), t(f32(2.0))),
+             D.Gamma(t(f32(2.0)), t(f32(1.0))), 0.03),
+            (D.Dirichlet(t(np.array([1., 2, 3], "float32"))),
+             D.Dirichlet(t(np.array([2., 2, 2], "float32"))), 0.03),
+        ]
+        for p, q, tol in pairs:
+            kl = float(D.kl_divergence(p, q).numpy())
+            assert abs(kl - self._mc_kl(p, q)) < tol
+            assert kl >= 0
+
+    def test_kl_mvn(self):
+        t = paddle.to_tensor
+        c1 = np.array([[2., 0.3], [0.3, 1.]], "float32")
+        c2 = np.eye(2, dtype="float32")
+        p = D.MultivariateNormal(t(np.zeros(2, "float32")),
+                                 covariance_matrix=t(c1))
+        q = D.MultivariateNormal(t(np.ones(2, "float32")),
+                                 covariance_matrix=t(c2))
+        kl = float(D.kl_divergence(p, q).numpy())
+        assert abs(kl - self._mc_kl(p, q)) < 0.05
+        same = D.MultivariateNormal(t(np.zeros(2, "float32")),
+                                    covariance_matrix=t(c1))
+        assert abs(float(D.kl_divergence(p, same).numpy())) < 1e-5

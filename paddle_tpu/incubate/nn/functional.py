@@ -424,3 +424,13 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         out = jnp.einsum("teo,te->to", out, weight_te)
         return out.reshape(bsz, s, e)
     return _apply(fn, *args, _name="fused_moe")
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True, name=None):
+    """Parity: paddle.incubate.nn.memory_efficient_attention ([B, S, H,
+    D] layout) — the flash kernel IS the memory-efficient path on TPU."""
+    from ...kernels.attention import flash_attention_bshd
+    return flash_attention_bshd(query, key, value, attn_mask=attn_bias,
+                                dropout_p=p, training=training,
+                                scale=scale)

@@ -150,3 +150,40 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class FashionMNIST(MNIST):
+    """Parity: paddle.vision.datasets.FashionMNIST — same idx file
+    format as MNIST (offline convention: pass local file paths)."""
+
+
+class Flowers(Dataset):
+    """Parity: paddle.vision.datasets.Flowers. Offline sandbox: load
+    from a local directory of class-subfolder images via DatasetFolder,
+    or use FakeData."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if data_file is None or not os.path.exists(str(data_file)):
+            raise RuntimeError(
+                "Flowers archive not found; this sandbox has no network. "
+                "Point data_file at a local copy, use DatasetFolder over "
+                "an extracted image tree, or FakeData for synthetic data.")
+        raise NotImplementedError(
+            "Flowers .mat parsing needs scipy.io over the local archive; "
+            "extract the images and use DatasetFolder instead")
+
+
+class VOC2012(Dataset):
+    """Parity: paddle.vision.datasets.VOC2012 (offline convention)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None or not os.path.exists(str(data_file)):
+            raise RuntimeError(
+                "VOC2012 archive not found; this sandbox has no network. "
+                "Point data_file at a local VOCtrainval tar, or use "
+                "DatasetFolder / FakeData.")
+        raise NotImplementedError(
+            "VOC2012 segmentation parsing lands with a local archive; "
+            "extract and use DatasetFolder for classification use")

@@ -70,6 +70,7 @@ from . import text
 from . import base
 from . import fluid
 from . import sysconfig
+from . import geometric
 from .hapi import callbacks
 
 from . import distributed

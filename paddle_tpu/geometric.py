@@ -1,0 +1,124 @@
+"""paddle.geometric parity (python/paddle/geometric/): graph
+message-passing primitives. TPU-native: jax.ops.segment_* ARE the
+gather-scatter kernels the reference implements in CUDA
+(phi/kernels/gpu/graph_send_recv_kernel.cu) — one fused scatter per op,
+jit/grad friendly. Segment counts are static (num_segments from the
+destination-node count), which is exactly what XLA wants."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .ops._dispatch import apply
+from .ops.creation import _coerce
+from .tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _num_segments(seg, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(np.asarray(_coerce(seg)._value).max()) + 1
+
+
+def _segment(op, data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    fn = {"sum": jax.ops.segment_sum, "mean": None,
+          "max": jax.ops.segment_max, "min": jax.ops.segment_min}[op]
+
+    def run(d, s):
+        s = s.astype(jnp.int32)
+        if op == "mean":
+            tot = jax.ops.segment_sum(d, s, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            return tot / jnp.maximum(cnt.reshape(shape), 1)
+        out = fn(d, s, num_segments=n)
+        if op in ("max", "min"):
+            # empty segments: paddle fills 0, jax fills +/-inf
+            cnt = jax.ops.segment_sum(jnp.ones_like(s, jnp.int32), s,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            out = jnp.where(cnt.reshape(shape) > 0, out, 0)
+        return out
+    return apply(run, _coerce(data), _coerce(segment_ids))
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Parity: paddle.geometric.segment_sum."""
+    return _segment("sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    """Parity: paddle.geometric.segment_mean."""
+    return _segment("mean", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    """Parity: paddle.geometric.segment_max (empty segments -> 0)."""
+    return _segment("max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    """Parity: paddle.geometric.segment_min (empty segments -> 0)."""
+    return _segment("min", data, segment_ids)
+
+
+def _reduce_to(op, msgs, dst, n):
+    if op == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=n)
+    if op == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst, msgs.dtype), dst,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt.reshape((n,) + (1,) *
+                                             (msgs.ndim - 1)), 1)
+    fn = jax.ops.segment_max if op == "max" else jax.ops.segment_min
+    out = fn(msgs, dst, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(dst, jnp.int32), dst,
+                              num_segments=n)
+    return jnp.where(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)) > 0,
+                     out, 0)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] along edges and reduce at dst (parity:
+    paddle.geometric.send_u_recv; phi graph_send_recv kernel)."""
+    n = out_size if out_size is not None else _coerce(x).shape[0]
+
+    def run(xv, src, dst):
+        msgs = xv[src.astype(jnp.int32)]
+        return _reduce_to(reduce_op, msgs, dst.astype(jnp.int32), int(n))
+    return apply(run, _coerce(x), _coerce(src_index), _coerce(dst_index))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node features combined with edge features, then reduced at dst
+    (parity: paddle.geometric.send_ue_recv)."""
+    n = out_size if out_size is not None else _coerce(x).shape[0]
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+
+    def run(xv, yv, src, dst):
+        msgs = comb(xv[src.astype(jnp.int32)], yv)
+        return _reduce_to(reduce_op, msgs, dst.astype(jnp.int32), int(n))
+    return apply(run, _coerce(x), _coerce(y), _coerce(src_index),
+                 _coerce(dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages x[src] (op) y[dst] (parity:
+    paddle.geometric.send_uv)."""
+    comb = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}[message_op]
+
+    def run(xv, yv, src, dst):
+        return comb(xv[src.astype(jnp.int32)], yv[dst.astype(jnp.int32)])
+    return apply(run, _coerce(x), _coerce(y), _coerce(src_index),
+                 _coerce(dst_index))

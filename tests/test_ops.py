@@ -455,3 +455,39 @@ class TestOpTail2:
                       paddle.to_tensor(np.array([1]))],
                      paddle.to_tensor(np.array([9.0], "float32")))
         assert t.numpy()[0, 1] == 9.0
+
+
+class TestGeometric:
+    def test_segment_family(self):
+        G = paddle.geometric
+        data = paddle.to_tensor(
+            np.array([[1., 2], [3, 4], [5, 6], [7, 8]], "float32"))
+        seg = paddle.to_tensor(np.array([0, 0, 1, 2], "int64"))
+        np.testing.assert_allclose(G.segment_sum(data, seg).numpy(),
+                                   [[4, 6], [5, 6], [7, 8]])
+        np.testing.assert_allclose(G.segment_mean(data, seg).numpy(),
+                                   [[2, 3], [5, 6], [7, 8]])
+        np.testing.assert_allclose(G.segment_max(data, seg).numpy(),
+                                   [[3, 4], [5, 6], [7, 8]])
+        np.testing.assert_allclose(G.segment_min(data, seg).numpy(),
+                                   [[1, 2], [5, 6], [7, 8]])
+
+    def test_send_recv_and_grads(self):
+        G = paddle.geometric
+        x = paddle.to_tensor(
+            np.array([[1., 1], [2, 2], [3, 3]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], "int64"))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], "int64"))
+        out = G.send_u_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(out.numpy(), [[1, 1], [4, 4], [2, 2]])
+        e = paddle.to_tensor(np.array([[10., 10]] * 4, "float32"))
+        out = G.send_ue_recv(x, e, src, dst, "add", "sum")
+        np.testing.assert_allclose(out.numpy(),
+                                   [[11, 11], [24, 24], [12, 12]])
+        uv = G.send_uv(x, x, src, dst, "mul")
+        np.testing.assert_allclose(uv.numpy(),
+                                   [[2, 2], [6, 6], [6, 6], [1, 1]])
+        x.stop_gradient = False
+        G.send_u_recv(x, src, dst, "sum").sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[2, 2], [1, 1], [1, 1]])

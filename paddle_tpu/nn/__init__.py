@@ -14,15 +14,16 @@ from .layers_common import (
     SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
     LocalResponseNorm, SpectralNorm,
     Embedding,
-    Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Dropout, Dropout2D, Dropout3D, AlphaDropout, FeatureAlphaDropout,
     ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Tanhshrink, Hardshrink,
     Hardsigmoid, Hardswish, Hardtanh, Softshrink, Softsign, Swish, Silu, Mish,
     SELU, CELU, ELU, GELU, LeakyReLU, Softplus, Maxout, GLU, Softmax,
-    LogSoftmax, PReLU,
+    LogSoftmax, PReLU, RReLU, Softmax2D, ThresholdedReLU,
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
-    Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    FractionalMaxPool2D, FractionalMaxPool3D,
+    Pad1D, Pad2D, Pad3D, ZeroPad1D, ZeroPad2D, ZeroPad3D,
     Flatten, Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
     PixelShuffle, PixelUnshuffle, Unfold, CosineSimilarity, Bilinear,
     Fold, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, PairwiseDistance,
@@ -36,7 +37,7 @@ from .losses import (
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
     TripletMarginLoss, HingeEmbeddingLoss,
-    CTCLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    CTCLoss, RNNTLoss, SoftMarginLoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
     TripletMarginWithDistanceLoss, PoissonNLLLoss, GaussianNLLLoss,
 )
 from .rnn import (

@@ -104,6 +104,10 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     return apply(lambda v: jnp.where(v >= 0, v, mid * v), x)
 
 
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, value), _coerce(x))
+
+
 def hardtanh(x, min=-1.0, max=1.0, name=None):
     return apply(lambda v: jnp.clip(v, min, max), _coerce(x))
 
@@ -236,20 +240,35 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p, axis=keep_axes, training=training)
 
 
+def _alpha_dropout_impl(x, p, noise_shape):
+    """Shared SELU-preserving dropout core: dropped entries are set to
+    alpha' and the result is rescaled so a zero-mean unit-variance input
+    keeps zero mean / unit variance (a = ((1-p)(1+p*alpha'^2))^-1/2)."""
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, noise_shape)
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * p * alpha_p
+    return apply(lambda v: a * jnp.where(keep, v, alpha_p) + b, x)
+
+
 def alpha_dropout(x, p=0.5, training=True, name=None):
     x = _coerce(x)
     if not training or p == 0.0:
         return x
-    alpha = 1.6732632423543772
-    scale = 1.0507009873554805
-    alpha_p = -alpha * scale
-    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x._value.shape))
-    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
-    b = -a * p * alpha_p
-    def fn(v):
-        m = keep
-        return a * jnp.where(m, v, alpha_p) + b
-    return apply(fn, x)
+    return _alpha_dropout_impl(x, p, tuple(x._value.shape))
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops whole channels (axis 1) at once."""
+    x = _coerce(x)
+    if not training or p == 0.0:
+        return x
+    shape = list(x._value.shape)
+    for i in range(2, len(shape)):
+        shape[i] = 1
+    return _alpha_dropout_impl(x, p, tuple(shape))
 
 
 # ------------------------------------------------------------------- conv --
@@ -1114,6 +1133,44 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return flash_attention_bshd(query, key, value, attn_mask=attn_mask,
                                 dropout_p=dropout_p, is_causal=is_causal,
                                 training=training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity.
+    Layout [B, S, H, D]; returns (out, softmax) — softmax is None unless
+    return_softmax (the reference only materializes it for debugging;
+    here that falls back to the XLA path to keep the kernel online-only).
+    """
+    if return_softmax:
+        # debug path: materializes the softmax, so it cannot use the
+        # online Pallas kernel — plain XLA attention with the same math
+        q, k, v = (_coerce(t) for t in (query, key, value))
+        drop_key = (next_key() if dropout > 0.0 and training else None)
+
+        def fn(qv, kv, vv):
+            qt = jnp.swapaxes(qv, 1, 2)
+            kt = jnp.swapaxes(kv, 1, 2)
+            vt = jnp.swapaxes(vv, 1, 2)
+            scale = qt.shape[-1] ** -0.5
+            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            if causal:
+                qlen, klen = s.shape[-2], s.shape[-1]
+                mask = jnp.tril(jnp.ones((qlen, klen), bool))
+                s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s, axis=-1)
+            if drop_key is not None:
+                keep = jax.random.bernoulli(drop_key, 1.0 - dropout,
+                                            p.shape)
+                p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+            return jnp.swapaxes(o, 1, 2), p
+        return apply(fn, q, k, v, _name="flash_attention")
+    out = scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                       dropout_p=dropout, is_causal=causal,
+                                       training=training)
+    return out, None
 
 
 # ------------------------------------------------------------------ misc ---

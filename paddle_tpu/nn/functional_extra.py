@@ -25,7 +25,8 @@ __all__ = [
     "poisson_nll_loss", "gaussian_nll_loss", "sigmoid_focal_loss",
     "soft_margin_loss", "multi_label_soft_margin_loss", "multi_margin_loss",
     "triplet_margin_with_distance_loss", "hsigmoid_loss",
-    "margin_cross_entropy",
+    "margin_cross_entropy", "fractional_max_pool2d", "fractional_max_pool3d",
+    "class_center_sample", "rnnt_loss",
 ]
 
 
@@ -544,3 +545,218 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
         return red
     out = apply(fn, _coerce(logits), _coerce(label))
     return out
+
+
+# ------------------------------------------------- fractional max pooling --
+
+def _fractional_starts(in_s, out_s, kernel, u):
+    """Pseudorandom pooling-region start indices (Graham, "Fractional
+    Max-Pooling": a_i = ceil(alpha*(i+u))). Static python/numpy — the
+    indices are compile-time constants, so the gather lowers to static
+    slices on TPU. Parity: phi fractional_max_pool kernels."""
+    alpha = in_s / out_s
+    edges = np.ceil(alpha * (np.arange(out_s + 1) + u)).astype(np.int64)
+    edges = edges - edges[0]
+    edges = np.clip(edges, 0, in_s)
+    edges[-1] = in_s
+    starts = edges[:-1]
+    sizes = np.maximum(edges[1:] - edges[:-1], 1)
+    if kernel is not None:
+        sizes = np.full_like(sizes, kernel)
+        starts = np.minimum(starts, in_s - kernel)
+    return starts, sizes
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, return_mask,
+                     ndim):
+    x = _coerce(x)
+    shape = tuple(int(s) for s in x._value.shape)
+    sp = shape[2:]
+    out_sz = ((output_size,) * ndim if not isinstance(output_size,
+                                                     (list, tuple))
+              else tuple(output_size))
+    out_sz = tuple(int(o) if o is not None else s
+                   for o, s in zip(out_sz, sp))
+    ks = (None,) * ndim if kernel_size is None else (
+        (kernel_size,) * ndim if not isinstance(kernel_size, (list, tuple))
+        else tuple(kernel_size))
+    if random_u is None:
+        from ..framework.random import next_key
+        u = float(jax.random.uniform(next_key(), ()))
+        u = min(max(u, 1e-3), 1.0 - 1e-3)
+    else:
+        u = float(random_u)
+    plans = [_fractional_starts(sp[i], out_sz[i], ks[i], u)
+             for i in range(ndim)]
+
+    def _windows(v):
+        """Gather each dim's pooling windows: [N, C, o1..on, k1..kn] plus
+        the matching validity mask (static index plan → static gathers)."""
+        out = v
+        valids = []
+        for d in range(ndim):
+            axis = 2 + d
+            starts, sizes = plans[d]
+            ksz = int(sizes.max())
+            idx = starts[:, None] + np.arange(ksz)[None, :]
+            valids.append(idx < (starts + sizes)[:, None])
+            idx = np.clip(idx, 0, out.shape[axis] - 1)
+            g = jnp.take(out, jnp.asarray(idx.reshape(-1)), axis=axis)
+            g = jnp.moveaxis(g, axis, -1)
+            g = g.reshape(g.shape[:-1] + (len(starts), ksz))
+            out = jnp.moveaxis(g, -2, axis)  # o_d in place, k_d at end
+        shape_o = [len(p[0]) for p in plans]
+        shape_k = [int(p[1].max()) for p in plans]
+        full = np.ones([1] * 2 + shape_o + shape_k, bool)
+        for d, vd in enumerate(valids):
+            sh = [1] * (2 + 2 * ndim)
+            sh[2 + d] = vd.shape[0]
+            sh[2 + ndim + d] = vd.shape[1]
+            full = full & vd.reshape(sh)
+        return out, jnp.asarray(full)
+
+    def fn(v):
+        w, valid = _windows(v)
+        w = jnp.where(valid, w, jnp.finfo(v.dtype).min)
+        out = jnp.max(w, axis=tuple(range(-ndim, 0)))
+        if not return_mask:
+            return out
+        kshape = w.shape[-ndim:]
+        flatk = w.reshape(w.shape[:-ndim] + (-1,))
+        amax = jnp.argmax(flatk, axis=-1)  # [N, C, o1..on]
+        offs = jnp.stack(jnp.unravel_index(amax, kshape), axis=0)
+        flat = jnp.zeros_like(amax)
+        for d in range(ndim):
+            starts = jnp.asarray(plans[d][0])
+            sh = [1] * amax.ndim
+            sh[2 + d] = starts.shape[0]
+            src = starts.reshape(sh) + offs[d]
+            flat = flat * sp[d] + src
+        return out, flat.astype(jnp.int32)
+
+    return apply(fn, x, _name="fractional_max_pool")
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Parity: python/paddle/nn/functional/pooling.py
+    fractional_max_pool2d."""
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Parity: python/paddle/nn/functional/pooling.py
+    fractional_max_pool3d."""
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 3)
+
+
+# ------------------------------------------------------ partial-FC helper --
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample class centers for partial-FC margin softmax (parity:
+    python/paddle/nn/functional/common.py class_center_sample; upstream
+    phi class_center_sample kernel). Returns (remapped_label,
+    sampled_class_indices). Host-side op: labels are concrete data, the
+    sampled set is a static-size [num_samples] vector (TPU-friendly)."""
+    from ..tensor import Tensor
+    lab = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    lab = lab.reshape(-1).astype(np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos  # all positives are always kept (reference semantics)
+    else:
+        from ..framework.random import next_key
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos)
+        k = num_samples - len(pos)
+        perm = np.asarray(jax.random.permutation(next_key(),
+                                                 len(neg_pool)))[:k]
+        sampled = np.concatenate([pos, neg_pool[perm]])
+    sampled = np.sort(sampled)
+    remap = np.full((num_classes,), -1, dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    new_lab = remap[lab]
+    from ..ops.creation import to_tensor
+    return to_tensor(new_lab), to_tensor(sampled)
+
+
+# --------------------------------------------------------------- RNN-T loss --
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (parity: python/paddle/nn/functional/loss.py
+    rnnt_loss; upstream warprnnt kernel). Log-semiring forward DP over
+    the (T, U) lattice as a lax.scan over time — compiler-friendly
+    (static trip count, masked tails) and reverse-differentiable, so no
+    hand-written backward is needed.
+
+    FastEmit (Yu et al. 2021): the reference warprnnt kernel scales the
+    label-emission gradient by (1 + lambda) while reporting the
+    unregularized loss. Reproduced here with a zero-valued loss term
+    whose gradient is the DP's gradient with blank log-probs
+    stop-gradiented (emit-only gradient).
+
+    input: [B, T, U+1, V] log-probs (or logits — normalized here),
+    label: [B, U] int, input_lengths: [B], label_lengths: [B].
+    """
+    args = [_coerce(a) for a in (input, label, input_lengths,
+                                 label_lengths)]
+
+    def fn(acts, labels, t_lens, u_lens):
+        acts = jax.nn.log_softmax(acts, axis=-1)
+        b, t_max, u_max1, _v = acts.shape
+        u_max = u_max1 - 1
+        labels = labels.astype(jnp.int32)
+        lab_lp = jnp.take_along_axis(
+            acts[:, :, :u_max, :], labels[:, None, :, None],
+            axis=3)[..., 0]                               # [B,T,U]
+        neg_inf = jnp.float32(-1e30)
+
+        def dp_nll(blank_lp):
+            # alpha over u for one time step; emits move along u
+            def u_step(alpha_prev_t, t):
+                # horizontal (blank) move from t-1 keeps u
+                from_blank = jnp.where(
+                    t > 0,
+                    alpha_prev_t + blank_lp[:, jnp.maximum(t - 1, 0), :],
+                    jnp.where(jnp.arange(u_max1)[None, :] == 0, 0.0,
+                              neg_inf))
+                # vertical (label) moves within time t: prefix recurrence
+                def emit_scan(carry, u):
+                    prev = carry  # alpha[t, u-1]
+                    cur = jnp.logaddexp(
+                        from_blank[:, u],
+                        prev + jnp.where(u > 0,
+                                         lab_lp[:, t, jnp.maximum(u - 1, 0)],
+                                         neg_inf))
+                    return cur, cur
+                init = jnp.full((b,), neg_inf)
+                _, cols = jax.lax.scan(emit_scan, init, jnp.arange(u_max1))
+                return jnp.transpose(cols)                # [B, U+1]
+
+            def t_step(alpha, t):
+                new = u_step(alpha, t)
+                return new, new
+
+            alpha0 = jnp.full((b, u_max1), neg_inf)
+            _, alphas = jax.lax.scan(t_step, alpha0, jnp.arange(t_max))
+            alphas = jnp.moveaxis(alphas, 0, 1)           # [B,T,U+1]
+            tl = t_lens.astype(jnp.int32) - 1
+            ul = u_lens.astype(jnp.int32)
+            final = alphas[jnp.arange(b), tl, ul]         # alpha[T-1, U]
+            last_blank = blank_lp[jnp.arange(b), tl, ul]
+            return -(final + last_blank)
+
+        blank_lp = acts[..., blank]                       # [B,T,U+1]
+        nll = dp_nll(blank_lp)
+        if fastemit_lambda:
+            # zero-valued term whose gradient is the emit-only gradient:
+            # reported loss matches the unregularized reference value
+            fe = dp_nll(jax.lax.stop_gradient(blank_lp))
+            nll = nll + fastemit_lambda * (fe - jax.lax.stop_gradient(fe))
+        return _reduce(nll, reduction)
+
+    return apply(fn, *args, _name="rnnt_loss")

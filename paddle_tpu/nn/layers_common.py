@@ -599,6 +599,15 @@ class AlphaDropout(Layer):
         return F.alpha_dropout(x, self.p, training=self.training)
 
 
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
 # ------------------------------------------------------------- activations --
 def _act_layer(fname, **fixed):
     class _Act(Layer):
@@ -642,6 +651,27 @@ LeakyReLU = _act_layer("leaky_relu")
 Softplus = _act_layer("softplus")
 Maxout = _act_layer("maxout")
 GLU = _act_layer("glu")
+ThresholdedReLU = _act_layer("thresholded_relu")
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower = lower
+        self._upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW / CHW inputs."""
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects 3-D or 4-D input"
+        return F.softmax(x, axis=-3)
 
 
 class Softmax(Layer):
@@ -720,10 +750,38 @@ AdaptiveMaxPool2D = _adaptive_pool_layer("adaptive_max_pool2d")
 AdaptiveMaxPool3D = _adaptive_pool_layer("adaptive_max_pool3d")
 
 
+class _FractionalMaxPoolNd(Layer):
+    _ndim = 2
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        fname = f"fractional_max_pool{self._ndim}d"
+        o, k, u, m = self._args
+        return getattr(F, fname)(x, o, kernel_size=k, random_u=u,
+                                 return_mask=m)
+
+
+class FractionalMaxPool2D(_FractionalMaxPoolNd):
+    _ndim = 2
+
+
+class FractionalMaxPool3D(_FractionalMaxPoolNd):
+    _ndim = 3
+
+
 # ----------------------------------------------------------------- padding --
 class _PadNd(Layer):
+    _nsp = {"NCL": 1, "NLC": 1, "NCHW": 2, "NHWC": 2,
+            "NCDHW": 3, "NDHWC": 3}
+
     def __init__(self, padding, mode, value, data_format):
         super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * self._nsp.get(data_format, 1))
         self._padding = padding
         self._mode = mode
         self._value = value
@@ -752,7 +810,15 @@ class Pad3D(_PadNd):
         super().__init__(padding, mode, value, data_format)
 
 
+class ZeroPad1D(Pad1D):
+    pass
+
+
 class ZeroPad2D(Pad2D):
+    pass
+
+
+class ZeroPad3D(Pad3D):
     pass
 
 

@@ -244,3 +244,21 @@ class GaussianNLLLoss(Layer):
         from .functional_extra import gaussian_nll_loss
         return gaussian_nll_loss(input, label, variance, self.full,
                                  self.epsilon, self.reduction)
+
+
+class RNNTLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        from .functional_extra import rnnt_loss
+        return rnnt_loss(input, label, input_lengths, label_lengths,
+                         blank=self.blank,
+                         fastemit_lambda=self.fastemit_lambda,
+                         reduction=self.reduction)

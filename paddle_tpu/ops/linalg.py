@@ -356,3 +356,11 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
         u = qmat @ u_b
         return u, s, jnp.swapaxes(vh, -1, -2)
     return apply(fn, *args)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """Alias of paddle.cov under paddle.linalg (parity:
+    python/paddle/tensor/linalg.py re-export)."""
+    from .math import cov as _cov
+    return _cov(x, rowvar=rowvar, ddof=ddof, fweights=fweights,
+                aweights=aweights, name=name)

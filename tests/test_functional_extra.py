@@ -511,3 +511,189 @@ class TestRNNWrappers:
         np.testing.assert_allclose(yr.numpy()[0, :4], yr_short.numpy()[0],
                                    atol=1e-6)
         np.testing.assert_allclose(yr.numpy()[0, 4:], 0.0, atol=0)
+
+
+class TestRound3Tail:
+    def test_fractional_max_pool2d_regions(self):
+        x = rng.randn(2, 3, 13, 13).astype("float32")
+        out, mask = F.fractional_max_pool2d(t(x), 5, random_u=0.3,
+                                            return_mask=True)
+        assert tuple(out.shape) == (2, 3, 5, 5)
+        # every output value must be the input value at its mask index,
+        # and bins must tile the input (monotone coverage)
+        o = out.numpy()
+        m = mask.numpy()
+        flat = x.reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            o, np.take_along_axis(flat, m.reshape(2, 3, -1),
+                                  axis=2).reshape(o.shape))
+        # global max always survives pooling
+        np.testing.assert_allclose(o.max(axis=(2, 3)), x.max(axis=(2, 3)))
+
+    def test_fractional_max_pool2d_torch_golden_kernel(self):
+        # with an explicit kernel_size and the same region starts torch
+        # agrees bin-by-bin only when regions align, so check shape +
+        # max-preservation + determinism for fixed random_u instead
+        x = rng.randn(1, 2, 16, 16).astype("float32")
+        a = F.fractional_max_pool2d(t(x), 4, kernel_size=2, random_u=0.7)
+        b = F.fractional_max_pool2d(t(x), 4, kernel_size=2, random_u=0.7)
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        assert tuple(a.shape) == (1, 2, 4, 4)
+
+    def test_fractional_max_pool3d(self):
+        x = rng.randn(1, 2, 9, 10, 11).astype("float32")
+        out = F.fractional_max_pool3d(t(x), (4, 5, 6), random_u=0.4)
+        assert tuple(out.shape) == (1, 2, 4, 5, 6)
+        np.testing.assert_allclose(out.numpy().max(axis=(2, 3, 4)),
+                                   x.max(axis=(2, 3, 4)))
+
+    def test_class_center_sample(self):
+        lab = np.array([1, 5, 7, 1, 5])
+        new_lab, sampled = F.class_center_sample(t(lab), 20, 6)
+        s = sampled.numpy()
+        nl = new_lab.numpy()
+        assert len(s) == 6 and len(np.unique(s)) == 6
+        for c in (1, 5, 7):
+            assert c in s
+        # remap consistency: sampled[new_label] == original label
+        np.testing.assert_array_equal(s[nl], lab)
+        # positives overflow: all positives kept
+        lab2 = np.arange(8)
+        _, s2 = F.class_center_sample(t(lab2), 20, 4)
+        assert len(s2.numpy()) == 8
+
+    def test_rnnt_loss_brute_force(self):
+        # enumerate all monotone alignments of a tiny lattice and compare
+        # the log-semiring DP against explicit path enumeration
+        import itertools
+        B, T, U, V = 1, 3, 2, 4
+        acts = rng.randn(B, T, U + 1, V).astype("float32")
+        labels = np.array([[1, 2]], np.int32)
+        lp = torch.log_softmax(torch.tensor(acts), dim=-1).numpy()
+
+        def path_score(path):
+            # path: sequence of (t, u, emit?) decisions from (0,0) to
+            # consuming all T blanks (incl. final) and U labels
+            s, tt, uu = 0.0, 0, 0
+            for mv in path:
+                if mv == "lab":
+                    s += lp[0, tt, uu, labels[0, uu]]
+                    uu += 1
+                else:
+                    s += lp[0, tt, uu, 0]
+                    tt += 1
+            return s if (tt == T and uu == U) else None
+
+        scores = []
+        for n_lab_pos in itertools.product(range(T), repeat=U):
+            if not all(n_lab_pos[i] <= n_lab_pos[i + 1]
+                       for i in range(U - 1)):
+                continue
+            # labels emitted at time n_lab_pos[i] (before blank t ->t+1)
+            path = []
+            li = 0
+            for tt in range(T):
+                while li < U and n_lab_pos[li] == tt:
+                    path.append("lab")
+                    li += 1
+                path.append("blank")
+            sc = path_score(path)
+            if sc is not None:
+                scores.append(sc)
+        want = -np.logaddexp.reduce(scores)
+        got = float(F.rnnt_loss(t(acts), t(labels),
+                                t(np.array([T], np.int32)),
+                                t(np.array([U], np.int32)),
+                                reduction="none").numpy()[0])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_rnnt_loss_grad_finite(self):
+        acts = paddle.to_tensor(rng.randn(2, 5, 3, 6).astype("float32"),
+                                stop_gradient=False)
+        labels = t(np.array([[1, 2], [3, 1]], np.int32))
+        tl = t(np.array([5, 4], np.int32))
+        ul = t(np.array([2, 1], np.int32))
+        loss = F.rnnt_loss(acts, labels, tl, ul)
+        loss.backward()
+        g = acts.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_feature_alpha_dropout_channels(self):
+        paddle.seed(7)
+        x = t(np.ones((4, 8, 5, 5), np.float32))
+        y = F.feature_alpha_dropout(x, 0.5, training=True).numpy()
+        # whole channels share one value (dropped or kept together)
+        per_chan = y.reshape(4, 8, -1)
+        assert (per_chan.std(axis=2) < 1e-6).all()
+        z = F.feature_alpha_dropout(x, 0.5, training=False)
+        np.testing.assert_allclose(z.numpy(), x.numpy())
+
+    def test_thresholded_relu(self):
+        x = np.array([-1.0, 0.5, 1.5], np.float32)
+        np.testing.assert_allclose(
+            F.thresholded_relu(t(x), threshold=1.0).numpy(),
+            np.array([0.0, 0.0, 1.5], np.float32))
+
+    def test_new_layers_and_aliases(self):
+        x = t(rng.randn(2, 4, 6, 6).astype("float32"))
+        assert tuple(nn.Softmax2D()(x).shape) == (2, 4, 6, 6)
+        np.testing.assert_allclose(
+            nn.Softmax2D()(x).numpy().sum(axis=1), 1.0, rtol=1e-5)
+        m = nn.RReLU(0.1, 0.3)
+        m.eval()
+        y = m(t(np.array([-2.0, 2.0], np.float32)))
+        np.testing.assert_allclose(y.numpy(), [-2.0 * 0.2, 2.0], rtol=1e-6)
+        assert tuple(nn.ZeroPad1D(1)(t(rng.randn(1, 2, 4).astype(
+            "float32"))).shape) == (1, 2, 6)
+        assert tuple(nn.ZeroPad3D(1)(t(rng.randn(1, 1, 2, 2, 2).astype(
+            "float32"))).shape) == (1, 1, 4, 4, 4)
+        assert tuple(nn.FeatureAlphaDropout(0.2)(x).shape) == (2, 4, 6, 6)
+        assert tuple(nn.FractionalMaxPool3D(2)(t(rng.randn(
+            1, 1, 6, 6, 6).astype("float32"))).shape) == (1, 1, 2, 2, 2)
+        out, _ = F.flash_attention(paddle.randn([2, 8, 2, 16]),
+                                   paddle.randn([2, 8, 2, 16]),
+                                   paddle.randn([2, 8, 2, 16]), causal=True)
+        assert tuple(out.shape) == (2, 8, 2, 16)
+        # return_softmax path agrees with the online kernel path
+        q = paddle.randn([1, 6, 2, 8])
+        k = paddle.randn([1, 6, 2, 8])
+        v = paddle.randn([1, 6, 2, 8])
+        o1, p = F.flash_attention(q, k, v, causal=True, return_softmax=True)
+        o2, _ = F.flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), atol=2e-5)
+        assert p is not None
+        assert tuple(paddle.linalg.cov(paddle.randn([3, 10])).shape) == (3, 3)
+
+    def test_rnnt_fastemit_scales_emit_grad_only(self):
+        acts_np = rng.randn(1, 4, 3, 5).astype("float32")
+        labels = t(np.array([[1, 2]], np.int32))
+        tl = t(np.array([4], np.int32))
+        ul = t(np.array([2], np.int32))
+
+        def grad_of(lmb):
+            a = paddle.to_tensor(acts_np.copy(), stop_gradient=False)
+            F.rnnt_loss(a, labels, tl, ul, fastemit_lambda=lmb).backward()
+            return a.grad.numpy()
+
+        g0 = grad_of(0.0)
+        g1 = grad_of(0.5)
+        # loss VALUE is identical (FastEmit only reshapes the gradient)
+        l0 = float(F.rnnt_loss(t(acts_np), labels, tl, ul,
+                               fastemit_lambda=0.0))
+        l1 = float(F.rnnt_loss(t(acts_np), labels, tl, ul,
+                               fastemit_lambda=0.5))
+        np.testing.assert_allclose(l0, l1, rtol=1e-6)
+        # gradient changes, and the fastemit delta is itself a valid
+        # emit-gradient: g1 = g0 + 0.5 * g_emit with g_emit != 0
+        delta = g1 - g0
+        assert np.abs(delta).sum() > 1e-6
+        g2 = grad_of(1.0)
+        np.testing.assert_allclose(g2 - g0, 2 * delta, rtol=1e-3,
+                                   atol=1e-6)
+
+    def test_alpha_dropout_preserves_moments(self):
+        paddle.seed(123)
+        x = t(rng.randn(200000).astype("float32"))
+        y = F.alpha_dropout(x, 0.3, training=True).numpy()
+        assert abs(y.mean()) < 2e-2
+        assert abs(y.std() - 1.0) < 2e-2

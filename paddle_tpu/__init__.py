@@ -127,3 +127,28 @@ class LazyGuard:
 
     def __exit__(self, *exc):
         return False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Parity: paddle.set_printoptions (python/paddle/tensor/to_string.py).
+    Tensor repr here prints through numpy, so numpy's printoptions ARE the
+    printoptions."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+# paddle.dtype: dtypes in this framework ARE numpy dtype objects
+import numpy as _np_mod  # noqa: E402
+dtype = _np_mod.dtype

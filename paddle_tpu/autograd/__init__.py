@@ -4,10 +4,34 @@ from __future__ import annotations
 from .grad_mode import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
 from .engine import run_backward as backward, grad, GradNode
 from .py_layer import PyLayer, PyLayerContext
-from .functional import jacobian, hessian, Jacobian, Hessian
+from .functional import jacobian, hessian, Jacobian, Hessian, jvp, vjp
+
+
+class saved_tensors_hooks:
+    """Parity: paddle.autograd.saved_tensors_hooks — registers pack/unpack
+    hooks for activation storage during backward. The tape here keeps
+    activations inside jax residuals (managed by XLA), so the hooks are
+    applied to eager-retained tensors only: pack runs when a tensor is
+    recorded for backward, unpack when the engine reads it back."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import engine
+        engine._SAVED_TENSOR_HOOKS.append(
+            (self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from . import engine
+        engine._SAVED_TENSOR_HOOKS.pop()
+        return False
 
 __all__ = [
     "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
     "backward", "grad", "PyLayer", "PyLayerContext", "GradNode",
-    "jacobian", "hessian", "Jacobian", "Hessian",
+    "jacobian", "hessian", "Jacobian", "Hessian", "jvp", "vjp",
+    "saved_tensors_hooks",
 ]

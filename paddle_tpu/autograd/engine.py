@@ -22,6 +22,11 @@ import numpy as np
 
 from ..tensor import Tensor
 
+# Active (pack, unpack) hook pairs from paddle.autograd.saved_tensors_hooks.
+# Consumed by PyLayerContext.save_for_backward; XLA-managed residuals inside
+# jitted programs are not user-visible and bypass this by design.
+_SAVED_TENSOR_HOOKS: list = []
+
 
 class GradNode:
     """Producer node on the tape.

@@ -22,15 +22,26 @@ class PyLayerContext:
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        from .engine import _SAVED_TENSOR_HOOKS
+        if _SAVED_TENSOR_HOOKS:
+            # capture the pair active at save time: the stack may have
+            # unwound by the time backward unpacks
+            pack, self._unpack = _SAVED_TENSOR_HOOKS[-1]
+            self._packed = tuple(pack(t) for t in tensors)
+            self._saved = ()
+        else:
+            self._packed = None
+            self._saved = tensors
 
     @property
     def saved_tensor(self):
+        if getattr(self, "_packed", None) is not None:
+            return tuple(self._unpack(p) for p in self._packed)
         return self._saved
 
     # paddle spells it both ways across versions
     def saved_tensors(self):
-        return self._saved
+        return self.saved_tensor
 
     def mark_not_inplace(self, *args):  # parity no-op (we never alias)
         pass

@@ -14,8 +14,10 @@ from .collective import (
     barrier, scatter, new_group, get_group, is_initialized, ppermute, stream,
     spmd_region, in_spmd_region,
     isend, irecv, wait, gather, all_gather_object, broadcast_object_list,
-    scatter_object_list, destroy_process_group,
+    scatter_object_list, destroy_process_group, P2POp, batch_isend_irecv,
 )
+
+
 from . import launch
 from .mesh import (
     build_mesh, set_mesh, get_mesh, ensure_mesh, mesh_scope, axis_size,
@@ -33,6 +35,55 @@ from .fleet.sharding import group_sharded_parallel, save_group_sharded_model
 
 # paddle.distributed.sharding namespace parity
 from .fleet import sharding
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Parity: paddle.distributed.split (python/paddle/distributed/
+    collective.py) — build a model-parallel linear/embedding over the
+    'model' mesh axis and apply it to x. axis=0 row-parallel /
+    vocab-parallel, axis=1 column-parallel. num_partitions must match the
+    bound model-parallel degree (the mesh, not the argument, determines
+    the sharding here)."""
+    from .mesh import get_mesh
+    from .fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    mesh = get_mesh()
+    mp = int(mesh.shape.get("model", 1)) if mesh is not None else 1
+    if num_partitions not in (1, mp):
+        raise ValueError(
+            f"num_partitions={num_partitions} does not match the bound "
+            f"model-parallel degree {mp}; init fleet with "
+            "mp_degree=num_partitions first")
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        elif axis == 0:
+            if not gather_out:
+                raise ValueError("row-parallel split always produces the "
+                                 "full output (gather_out=False is only "
+                                 "meaningful for axis=1)")
+            layer = RowParallelLinear(in_f, out_f,
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            raise ValueError("linear split axis must be 0 or 1")
+    elif operation == "embedding":
+        n_vocab, emb = size
+        if axis != 0:
+            raise ValueError("embedding split supports axis=0 "
+                             "(vocab-parallel) only")
+        layer = VocabParallelEmbedding(n_vocab, emb,
+                                       weight_attr=weight_attr)
+    else:
+        raise ValueError(f"unsupported split operation {operation!r}")
+    return layer(x)
+
 
 
 def TCPStore(host, port, is_master=False, world_size=1, timeout=90.0):

@@ -442,3 +442,61 @@ def destroy_process_group(group=None):
         jax.distributed.shutdown()
     except Exception:
         pass
+
+
+class P2POp:
+    """Parity: paddle.distributed.P2POp — a deferred p2p operation
+    descriptor for batch_isend_irecv. In the SPMD lowering a batch of
+    matched isend/irecv pairs IS one collective_permute, so the batch
+    object records (op, tensor, peer) and the batch call emits a single
+    ppermute when the pairs form a permutation."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Parity: paddle.distributed.batch_isend_irecv. Each send pair
+    compiles to one lax.ppermute over the bound mesh axis. ppermute needs
+    the GLOBAL permutation, but the batch only describes this rank's
+    pairs — so the lowering assumes the batch is shift-uniform (every
+    rank sends to `rank + shift`, the pattern of pipeline/ring
+    exchanges, which is what the reference uses this API for) and
+    expands the full permutation from the local shift. The i-th irecv is
+    matched with the i-th isend's permute output."""
+    sends = [p for p in p2p_op_list if p.op is isend]
+    recvs = [p for p in p2p_op_list if p.op is irecv]
+    if not sends or len(sends) != len(recvs):
+        raise RuntimeError(
+            "batch_isend_irecv requires matched isend/irecv pairs (the "
+            "batch lowers to collective_permutes)")
+    from .env import get_rank, get_world_size
+    me = get_rank()
+    world = get_world_size()
+    tasks = []
+    for s, r in zip(sends, recvs):
+        shift = (s.peer - me) % world
+        if (me - r.peer) % world != shift:
+            raise RuntimeError(
+                "batch_isend_irecv lowering requires a shift-uniform "
+                f"batch: send peer {s.peer} implies shift {shift}, but "
+                f"the matched irecv expects source {r.peer}")
+        perm = [(rank, (rank + shift) % world) for rank in range(world)]
+        out = ppermute(s.tensor, perm)
+        if isinstance(r.tensor, Tensor):
+            r.tensor._inplace_update(out._value if isinstance(out, Tensor)
+                                     else out)
+        tasks.append(out)
+
+    class _Task:
+        def is_completed(self):
+            return True
+
+        def wait(self):
+            return None
+    return [_Task() for _ in p2p_op_list]

@@ -26,6 +26,7 @@ __all__ = [
     "PowerTransform", "AbsTransform", "ChainTransform",
     "IndependentTransform", "ReshapeTransform", "SoftmaxTransform",
     "StackTransform", "StickBreakingTransform", "TransformedDistribution",
+    "LKJCholesky",
 ]
 
 
@@ -691,3 +692,73 @@ def _kl_mvn(p, q):
         ld2 = jnp.sum(jnp.log(jnp.diagonal(t2, axis1=-2, axis2=-1)), -1)
         return 0.5 * (tr + maha - d) + ld2 - ld1
     return apply(fn, p.loc, p._tril, q.loc, q._tril)
+
+
+class LKJCholesky(Distribution):
+    """LKJ distribution over Cholesky factors of correlation matrices
+    (parity: python/paddle/distribution/lkj_cholesky.py). Sampling uses
+    the onion method (Lewandowski, Kurowicka & Joe 2009); log_prob is the
+    standard row-power density over the Cholesky diagonal."""
+
+    def __init__(self, dim=2, concentration=1.0,
+                 sample_method="onion"):
+        if dim < 2:
+            raise ValueError("LKJCholesky requires dim >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError(f"unknown sample_method {sample_method}")
+        self.dim = int(dim)
+        self.concentration = _t(concentration)
+        self.sample_method = sample_method
+        super().__init__(self.concentration._value.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + tuple(self._batch_shape)
+        d = self.dim
+        k1, k2 = jax.random.split(next_key())
+
+        def fn(conc):
+            # onion method: grow the factor one row at a time; row i's
+            # direction is uniform on the sphere, its radius^2 is
+            # Beta(i/2, conc + (d - 1 - i)/2)
+            beta_a = jnp.arange(1, d, dtype=jnp.float32) / 2.0
+            beta_b = conc[..., None] + (d - 2
+                                        - jnp.arange(d - 1)) / 2.0
+            r2 = jax.random.beta(k1, beta_a, beta_b,
+                                 shp + (d - 1,))            # [..., d-1]
+            z = jax.random.normal(k2, shp + (d - 1, d))
+            # row i uses the first i+1 coords of its gaussian direction
+            mask = (jnp.arange(d) <= jnp.arange(d - 1)[:, None])
+            z = z * mask
+            z = z / jnp.linalg.norm(z, axis=-1, keepdims=True)
+            rows = jnp.sqrt(r2)[..., None] * z               # rows 1..d-1
+            diag_extra = jnp.sqrt(1.0 - r2)                  # w_{ii}
+            L = jnp.zeros(shp + (d, d), jnp.float32)
+            L = L.at[..., 0, 0].set(jnp.float32(1.0))
+            L = L.at[..., 1:, :].set(rows.astype(jnp.float32))
+            ii = jnp.arange(1, d)
+            L = L.at[..., ii, ii].set(diag_extra.astype(jnp.float32))
+            return L
+        return apply(fn, self.concentration)
+
+    def log_prob(self, value):
+        d = self.dim
+
+        def fn(L, conc):
+            order = jnp.arange(2, d + 1, dtype=jnp.float32)
+            expo = 2.0 * (conc[..., None] - 1.0) + d - order
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            unnorm = jnp.sum(expo * jnp.log(diag), axis=-1)
+            # normalizer: per onion row i, the sphere-surface term
+            # (i/2)*log(pi) - lgamma(i/2) plus the Beta(i/2, a_i)
+            # normalizer with a_i = conc + (d - 1 - i)/2
+            i = jnp.arange(1, d, dtype=jnp.float32)
+            a = conc[..., None] + (d - 1 - i) / 2.0
+            logpi = jnp.float32(pymath.log(pymath.pi))
+            logB = (jax.scipy.special.gammaln(i / 2.0)
+                    + jax.scipy.special.gammaln(a)
+                    - jax.scipy.special.gammaln(i / 2.0 + a))
+            lognorm = jnp.sum(i / 2.0 * logpi
+                              - jax.scipy.special.gammaln(i / 2.0)
+                              + logB, axis=-1)
+            return unnorm - lognorm
+        return apply(fn, _coerce(value), self.concentration)

@@ -10,3 +10,24 @@ flow must use lax.cond/while via paddle_tpu.static.nn.cond/while_loop.
 """
 from .api import to_static, not_to_static, save, load, TranslatedLayer, ignore_module
 from .bridge import TrainStep, functionalize
+
+
+def enable_to_static(flag=True):
+    """Parity: paddle.jit.enable_to_static — global switch; when off,
+    to_static-decorated callables run eagerly."""
+    from . import api
+    api._TO_STATIC_ENABLED = bool(flag)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Parity shim: dy2static transformed-code logging verbosity. The
+    AST transformer stores transformed source on the wrapper
+    (`fn.__transformed_source__`); this sets how much gets logged."""
+    from . import api
+    api._CODE_LEVEL = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Parity shim: dy2static logging verbosity."""
+    from . import api
+    api._VERBOSITY = int(level)

@@ -75,6 +75,12 @@ def _rebuild(struct, it, wrap):
     return struct
 
 
+# paddle.jit.enable_to_static / set_code_level / set_verbosity state
+_TO_STATIC_ENABLED = True
+_CODE_LEVEL = 100
+_VERBOSITY = 0
+
+
 class StaticFunction:
     """Wraps a python function/Layer method; compiles per input signature."""
 
@@ -84,6 +90,12 @@ class StaticFunction:
         # lax.cond/while_loop dispatchers so data-dependent control flow
         # compiles instead of freezing at trace time
         self._fn = maybe_ast_transform(fn)
+        src = getattr(self._fn, "__transformed_source__", None)
+        if src is not None and (_VERBOSITY > 0 or _CODE_LEVEL < 100):
+            import logging
+            logging.getLogger("paddle_tpu.dy2static").info(
+                "transformed code of %s:\n%s",
+                getattr(fn, "__qualname__", fn), src)
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
@@ -102,6 +114,8 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         global _IN_TO_STATIC
+        if not _TO_STATIC_ENABLED:
+            return self._fn(*args, **kwargs)
         named_p, named_b = self._state()
         p_tensors = [p for _, p in named_p]
         b_tensors = [b for _, b in named_b]

@@ -920,6 +920,10 @@ def convert_to_static_ast(fn):
     new.__defaults__ = raw.__defaults__
     new.__kwdefaults__ = raw.__kwdefaults__
     functools.update_wrapper(new, raw)
+    try:
+        new.__transformed_source__ = ast.unparse(tree)
+    except Exception:
+        pass
     if raw is not fn and hasattr(fn, "__self__"):
         return new.__get__(fn.__self__)
     return new

@@ -264,3 +264,69 @@ for _n, _f in {"index_add_": extras.index_add
     if _f is not None:
         setattr(Tensor, _n, _make_inplace(_f))
         _patched.add(_n)
+
+
+# fourth batch: remaining documented in-place variants + top-level aliases
+for _n, _f in {"square_": math.square, "frac_": math.frac}.items():
+    setattr(Tensor, _n, _make_inplace(_f))
+    _patched.add(_n)
+
+Tensor.bitwise_invert = logic.bitwise_not
+Tensor.bitwise_invert_ = _make_inplace(logic.bitwise_not)
+
+# top-level in-place function aliases (parity: python/paddle/tensor/ops.py
+# *_-suffixed exports)
+exp_ = lambda x, *a, **kw: x.exp_(*a, **kw)  # noqa: E731
+sqrt_ = lambda x, *a, **kw: x.sqrt_(*a, **kw)  # noqa: E731
+rsqrt_ = lambda x, *a, **kw: x.rsqrt_(*a, **kw)  # noqa: E731
+reciprocal_ = lambda x, *a, **kw: x.reciprocal_(*a, **kw)  # noqa: E731
+floor_ = lambda x, *a, **kw: x.floor_(*a, **kw)  # noqa: E731
+ceil_ = lambda x, *a, **kw: x.ceil_(*a, **kw)  # noqa: E731
+round_ = lambda x, *a, **kw: x.round_(*a, **kw)  # noqa: E731
+trunc_ = lambda x, *a, **kw: x.trunc_(*a, **kw)  # noqa: E731
+lerp_ = lambda x, *a, **kw: x.lerp_(*a, **kw)  # noqa: E731
+subtract_ = lambda x, *a, **kw: x.subtract_(*a, **kw)  # noqa: E731
+square_ = lambda x, *a, **kw: x.square_(*a, **kw)  # noqa: E731
+frac_ = lambda x, *a, **kw: x.frac_(*a, **kw)  # noqa: E731
+zero_ = lambda x: x.zero_()  # noqa: E731
+fill_ = lambda x, v: x.fill_(v)  # noqa: E731
+bitwise_invert = logic.bitwise_not
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """input*beta + alpha*(x @ y) over batched matrices (parity:
+    python/paddle/tensor/math.py baddbmm)."""
+    from .creation import _coerce as _c
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 _c(input), _c(x), _c(y), _name="baddbmm")
+
+
+Tensor.baddbmm = baddbmm
+Tensor.baddbmm_ = _make_inplace(baddbmm)
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (parity: python/paddle/tensor/math.py
+    reduce_as) — the broadcast-inverse reduction."""
+    from .creation import _coerce as _c
+    x = _c(x)
+    tshape = tuple(int(s) for s in
+                   (target.shape if hasattr(target, "shape") else target))
+
+    def fn(v):
+        extra = v.ndim - len(tshape)
+        axes = list(range(extra))
+        for i, ts in enumerate(tshape):
+            if v.shape[extra + i] != ts:
+                axes.append(extra + i)
+        out = jnp.sum(v, axis=tuple(axes), keepdims=True)
+        return out.reshape(tshape)
+    return apply(fn, x, _name="reduce_as")
+
+
+Tensor.reduce_as = reduce_as
+
+
+def tolist(x):
+    """Parity: paddle.tolist (python/paddle/tensor/to_string.py)."""
+    return x.tolist()

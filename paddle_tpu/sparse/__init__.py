@@ -23,7 +23,8 @@ from ..ops.creation import _coerce
 __all__ = [
     "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
     "is_sparse", "is_sparse_coo", "is_sparse_csr",
-    "add", "subtract", "multiply", "matmul", "masked_matmul", "relu",
+    "add", "subtract", "multiply", "divide", "addmm", "matmul",
+    "masked_matmul", "relu",
 ]
 
 
@@ -155,6 +156,30 @@ def multiply(x, y):
         return SparseCooTensor(jsparse.BCOO((bx.data * yv, bx.indices),
                                             shape=bx.shape))
     return SparseCooTensor(jsparse.bcoo_multiply_dense(bx, yv))
+
+
+def divide(x, y):
+    """Elementwise divide; sparse / dense(or scalar) keeps sparsity,
+    sparse / sparse densifies (zero / zero is nan in the reference too,
+    so only matching patterns are meaningful)."""
+    bx = _as_bcoo(x)
+    if isinstance(y, SparseCooTensor):
+        return Tensor(bx.todense() / _as_bcoo(y).todense())
+    yv = _coerce(y)._value
+    if np.ndim(yv) == 0:
+        return SparseCooTensor(jsparse.BCOO((bx.data / yv, bx.indices),
+                                            shape=bx.shape))
+    # dense divisor of any rank: same sampling path as multiply
+    return SparseCooTensor(jsparse.bcoo_multiply_dense(bx, 1.0 / yv))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y) where x is sparse (paddle.sparse.addmm)."""
+    iv = _coerce(input)._value if not isinstance(input, SparseCooTensor) \
+        else _as_bcoo(input).todense()
+    yv = _coerce(y)._value if not isinstance(y, SparseCooTensor) \
+        else _as_bcoo(y).todense()
+    return Tensor(beta * iv + alpha * (_as_bcoo(x) @ yv))
 
 
 def matmul(x, y):

@@ -13,7 +13,7 @@ import warnings
 from . import unique_name
 from . import dlpack
 
-__all__ = ["unique_name", "deprecated", "try_import", "run_check",
+__all__ = ["unique_name", "deprecated", "try_import", "run_check", "download",
            "dlpack"]
 
 
@@ -88,3 +88,6 @@ class cpp_extension:
 
     CppExtension = load
     CUDAExtension = load
+
+
+from . import download  # noqa: E402  (zero-egress-aware cache resolver)

@@ -1,0 +1,37 @@
+"""paddle.utils.download parity (python/paddle/utils/download.py).
+
+This environment is zero-egress: nothing can be fetched. The cache-lookup
+half of the API works (weights a user has placed under the cache dir, or
+any readable path, resolve normally); an actual network fetch raises with
+instructions instead of hanging on a dead socket.
+"""
+from __future__ import annotations
+
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _cache_path(url, root):
+    fname = osp.split(url)[-1]
+    return osp.join(root, fname)
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    if osp.exists(url):  # already a local path
+        return url
+    path = _cache_path(url, root_dir)
+    if check_exist and osp.exists(path):
+        return path
+    raise RuntimeError(
+        f"cannot download '{url}': this environment has no network "
+        f"egress. Place the file at '{path}' (or pass a local path) and "
+        "retry.")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    os.makedirs(WEIGHTS_HOME, exist_ok=True)
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -7,9 +7,27 @@ from .models import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, LeNet, VGG, vgg16, MobileNetV2, mobilenet_v2)
 
 
+_IMAGE_BACKEND = "pil"
+
+
 def set_image_backend(backend):
-    pass
+    """Parity: paddle.vision.set_image_backend ('pil' or 'cv2')."""
+    global _IMAGE_BACKEND
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _IMAGE_BACKEND = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _IMAGE_BACKEND
+
+
+def image_load(path, backend=None):
+    """Parity: paddle.vision.image_load — loads an image file with the
+    configured backend (PIL here; cv2 is not shipped in this image)."""
+    b = backend or _IMAGE_BACKEND
+    if b == "cv2":
+        raise RuntimeError("cv2 backend not available in this "
+                           "environment; use set_image_backend('pil')")
+    from PIL import Image
+    return Image.open(path)

@@ -29,6 +29,7 @@ from ..tensor import Tensor
 __all__ = [
     "nms", "roi_align", "roi_pool", "psroi_pool", "box_coder",
     "deform_conv2d", "yolo_box", "prior_box", "distribute_fpn_proposals",
+    "matrix_nms", "generate_proposals", "yolo_loss",
 ]
 
 
@@ -511,3 +512,277 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
             ).astype(np.int32)))
             for level in range(min_level, max_level + 1)]
     return outs, Tensor(jnp.asarray(restore[:, None])), rois_num_per
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): scores decay by IoU overlap instead of hard
+    suppression (parity: python/paddle/vision/ops.py matrix_nms; upstream
+    phi matrix_nms kernel). bboxes [N, M, 4], scores [N, C, M]. Output
+    rows are [label, score, x1, y1, x2, y2]. Data-dependent output size →
+    eager extraction like nms/distribute_fpn_proposals above."""
+    bb = np.asarray(_coerce(bboxes)._value)
+    sc = np.asarray(_coerce(scores)._value)
+    n, c, m = sc.shape
+    all_rows, all_idx, rois_num = [], [], []
+    for b in range(n):
+        rows, idxs = [], []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[b, cls]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            sel = sel[np.argsort(-s[sel])][:nms_top_k]
+            boxes = bb[b, sel]
+            ss = s[sel]
+            iou = np.asarray(_iou_matrix(jnp.asarray(boxes)))
+            k = sel.size
+            # decay: for each j, min over higher-scored i of
+            # f(iou_ij) / f(iou_cmax_i), iou_cmax_i = i's own max overlap
+            tri = np.triu(iou, 1)  # iou of higher-scored i with j (i<j)
+            # comp[i] = i's own max overlap from anything above it —
+            # j-invariant, computed once (O(k^2) total)
+            comp_full = np.array([tri[:i, i].max() if i else 0.0
+                                  for i in range(k)])
+            decay = np.ones((k,))
+            for j in range(1, k):
+                ov = tri[:j, j]
+                comp = comp_full[:j]
+                if use_gaussian:
+                    d = np.exp(-(ov ** 2 - comp ** 2) / gaussian_sigma)
+                else:
+                    d = (1.0 - ov) / np.maximum(1.0 - comp, 1e-10)
+                decay[j] = d.min()
+            dec = ss * decay
+            keep = np.nonzero(dec > post_threshold)[0]
+            for j in keep:
+                rows.append([float(cls), float(dec[j]), *boxes[j]])
+                idxs.append(b * m + int(sel[j]))
+        if rows:
+            rows = np.asarray(rows, np.float32)
+            idxs = np.asarray(idxs, np.int64)
+            order = np.argsort(-rows[:, 1])[:keep_top_k]
+            rows, idxs = rows[order], idxs[order]
+        else:
+            rows = np.zeros((0, 6), np.float32)
+            idxs = np.zeros((0,), np.int64)
+        all_rows.append(rows)
+        all_idx.append(idxs)
+        rois_num.append(rows.shape[0])
+    out = Tensor(jnp.asarray(np.concatenate(all_rows, axis=0)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(
+            np.concatenate(all_idx)[:, None])))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True,
+                       name=None):
+    """RPN proposal generation (parity: python/paddle/vision/ops.py
+    generate_proposals; upstream phi generate_proposals_v2). scores
+    [N, A, H, W], bbox_deltas [N, 4A, H, W], anchors [H, W, A, 4] (or
+    flattened [HWA, 4]), variances like anchors."""
+    sc = np.asarray(_coerce(scores)._value)
+    bd = np.asarray(_coerce(bbox_deltas)._value)
+    ims = np.asarray(_coerce(img_size)._value)
+    an = np.asarray(_coerce(anchors)._value).reshape(-1, 4)
+    va = np.asarray(_coerce(variances)._value).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)           # HWA
+        d = bd[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc, var = s[order], d[order], an[order], va[order]
+        # decode (x1y1x2y2 anchors; deltas dx dy dw dh scaled by variance)
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        dx, dy, dw, dh = (d * var).T
+        cx = dx * aw + acx
+        cy = dy * ah + acy
+        bw = np.exp(np.minimum(dw, np.log(1000.0 / 16))) * aw
+        bh = np.exp(np.minimum(dh, np.log(1000.0 / 16))) * ah
+        props = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - off, cy + bh * 0.5 - off], axis=1)
+        # clip to image, filter small
+        im_h, im_w = ims[b]
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, im_w - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, im_h - off)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        valid = (ws >= min_size) & (hs >= min_size)
+        props, s = props[valid], s[valid]
+        if props.shape[0]:
+            keep = np.asarray(_nms_keep_mask(jnp.asarray(props),
+                                             jnp.asarray(s), nms_thresh))
+            props, s = props[keep], s[keep]
+            order = np.argsort(-s)[:post_nms_top_n]
+            props, s = props[order], s[order]
+        all_rois.append(props.astype(np.float32))
+        all_probs.append(s.astype(np.float32))
+        nums.append(props.shape[0])
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, axis=0)))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs)[:, None]))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(
+            np.asarray(nums, np.int32)))
+    return rois, probs
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, class_num, gt_score=None,
+              anchor_mask=None, ignore_thresh=0.7, downsample_ratio=32,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss for one detection head (parity: python/paddle/vision/
+    ops.py yolo_loss; upstream phi yolov3_loss kernel). x [N, A*(5+C),
+    H, W]; gt_box [N, B, 4] (cx, cy, w, h in image units); gt_label
+    [N, B]; anchors flat [a0w, a0h, a1w, ...]; anchor_mask picks this
+    head's anchors. Returns per-image loss [N].
+
+    Whole-lattice formulation (no python loop over gt): every gt is
+    matched to its best full-anchor-set IoU; matches belonging to this
+    head's mask become positives at their grid cell. All terms are
+    dense masked reductions — XLA-friendly."""
+    anchors = list(anchors)
+    if anchor_mask is None:
+        anchor_mask = list(range(len(anchors) // 2))
+    xm = _coerce(x)
+    gb = _coerce(gt_box)
+    gl = _coerce(gt_label)
+    gs = _coerce(gt_score) if gt_score is not None else None
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an_mask = np.asarray(anchor_mask, np.int64)
+
+    def fn(xv, gbv, glv, *rest):
+        gsv = rest[0] if rest else None
+        n, ch, h, w = xv.shape
+        na = len(an_mask)
+        nc = class_num
+        xv = xv.reshape(n, na, 5 + nc, h, w)
+        px = jax.nn.sigmoid(xv[:, :, 0]) * scale_x_y \
+            - 0.5 * (scale_x_y - 1.0)
+        py = jax.nn.sigmoid(xv[:, :, 1]) * scale_x_y \
+            - 0.5 * (scale_x_y - 1.0)
+        pw, ph = xv[:, :, 2], xv[:, :, 3]
+        pobj = xv[:, :, 4]
+        pcls = xv[:, :, 5:]
+
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        # gt in grid units
+        gx = gbv[..., 0] / in_w * w
+        gy = gbv[..., 1] / in_h * h
+        gw = gbv[..., 2] / in_w * w
+        gh = gbv[..., 3] / in_h * h
+        valid = (gbv[..., 2] > 0) & (gbv[..., 3] > 0)     # [N,B]
+
+        # anchor assignment: best IoU at the origin over the FULL set
+        aw = an_all[:, 0] / downsample_ratio
+        ah = an_all[:, 1] / downsample_ratio
+        inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(
+            gh[..., None], ah)
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)
+        # positive iff the best anchor belongs to this head
+        local = jnp.full(an_all.shape[0], -1).at[an_mask].set(
+            jnp.arange(na))
+        lanch = local[best]                                # [N,B]
+        pos = valid & (lanch >= 0)
+
+        ci = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+        tx = gx - ci
+        ty = gy - cj
+        sel_aw = aw[jnp.clip(best, 0, an_all.shape[0] - 1)]
+        sel_ah = ah[jnp.clip(best, 0, an_all.shape[0] - 1)]
+        tw = jnp.log(jnp.maximum(gw / sel_aw, 1e-9))
+        th = jnp.log(jnp.maximum(gh / sel_ah, 1e-9))
+        box_w = 2.0 - gw * gh / (w * h)                    # small-box boost
+
+        bidx = jnp.broadcast_to(jnp.arange(n)[:, None], pos.shape)
+        la = jnp.where(pos, lanch, 0)
+
+        def gathered(pred):
+            return pred[bidx, la, cj, ci]                  # [N,B]
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target \
+                + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        obj_w = (gsv[..., 0] if (gsv is not None and gsv.ndim == 3)
+                 else (gsv if gsv is not None else 1.0))
+        wpos = jnp.where(pos, 1.0, 0.0) * obj_w
+        loss_xy = (bce(xv[:, :, 0][bidx, la, cj, ci], tx)
+                   + bce(xv[:, :, 1][bidx, la, cj, ci], ty)) \
+            * box_w * wpos
+        loss_wh = (jnp.abs(gathered(pw) - tw)
+                   + jnp.abs(gathered(ph) - th)) * box_w * wpos
+
+        # objectness: positives -> 1; negatives -> 0 unless their best
+        # pred-gt IoU exceeds ignore_thresh
+        pred_x = (px + jnp.arange(w))                      # [N,A,H,W]
+        pred_y = (py + jnp.arange(h)[:, None])
+        head_aw = aw[jnp.asarray(an_mask)][None, :, None, None]
+        head_ah = ah[jnp.asarray(an_mask)][None, :, None, None]
+        pred_w = jnp.exp(pw) * head_aw
+        pred_h = jnp.exp(ph) * head_ah
+
+        def box_iou(px1, py1, px2, py2, qx1, qy1, qx2, qy2):
+            ix = jnp.maximum(jnp.minimum(px2, qx2)
+                             - jnp.maximum(px1, qx1), 0)
+            iy = jnp.maximum(jnp.minimum(py2, qy2)
+                             - jnp.maximum(py1, qy1), 0)
+            inter = ix * iy
+            ua = (px2 - px1) * (py2 - py1) + (qx2 - qx1) * (qy2 - qy1) \
+                - inter
+            return inter / jnp.maximum(ua, 1e-10)
+
+        # IoU of every prediction with every gt: [N,A,H,W,B]
+        iou = box_iou(
+            (pred_x - pred_w / 2)[..., None],
+            (pred_y - pred_h / 2)[..., None],
+            (pred_x + pred_w / 2)[..., None],
+            (pred_y + pred_h / 2)[..., None],
+            (gx - gw / 2)[:, None, None, None, :],
+            (gy - gh / 2)[:, None, None, None, :],
+            (gx + gw / 2)[:, None, None, None, :],
+            (gy + gh / 2)[:, None, None, None, :])
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = iou.max(axis=-1)
+        noobj_mask = best_iou < ignore_thresh
+
+        obj_t = jnp.zeros_like(pobj)
+        obj_t = obj_t.at[bidx, la, cj, ci].max(
+            jnp.where(pos, 1.0, 0.0))
+        is_pos_cell = obj_t > 0
+        loss_obj = jnp.where(
+            is_pos_cell, bce(pobj, 1.0),
+            jnp.where(noobj_mask, bce(pobj, 0.0), 0.0))
+
+        # classification at positive cells
+        smooth = 1.0 / max(nc, 1) if use_label_smooth else 0.0
+        delta = (1.0 - smooth) if use_label_smooth else 1.0
+        cls_t = jax.nn.one_hot(jnp.where(pos, glv, 0), nc) * delta \
+            + smooth / max(nc, 1)
+        pcls_g = jnp.moveaxis(pcls, 2, -1)[bidx, la, cj, ci]  # [N,B,C]
+        loss_cls = jnp.sum(bce(pcls_g, cls_t), axis=-1) * wpos
+
+        per_img = (jnp.sum(loss_xy + loss_wh + loss_cls, axis=1)
+                   + jnp.sum(loss_obj, axis=(1, 2, 3)))
+        return per_img
+
+    args = [xm, gb, gl] + ([gs] if gs is not None else [])
+    return apply(fn, *args, _name="yolo_loss")

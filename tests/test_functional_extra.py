@@ -697,3 +697,112 @@ class TestRound3Tail:
         y = F.alpha_dropout(x, 0.3, training=True).numpy()
         assert abs(y.mean()) < 2e-2
         assert abs(y.std() - 1.0) < 2e-2
+
+
+class TestDetectionOpsRound3:
+    def test_matrix_nms_decay(self):
+        bb = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                        [50, 50, 60, 60], [0, 0, 0, 0]]], np.float32)
+        sc = np.zeros((1, 2, 4), np.float32)
+        sc[0, 1] = [0.9, 0.8, 0.7, 0.0]
+        from paddle_tpu.vision import ops as vops
+        out, idx, num = vops.matrix_nms(
+            t(bb), t(sc), score_threshold=0.1, post_threshold=0.05,
+            return_index=True)
+        o = out.numpy()
+        assert o.shape[1] == 6 and num.numpy()[0] == o.shape[0]
+        # the heavily-overlapping 0.8 box decays below the distant 0.7 box
+        assert o[0, 1] == np.float32(0.9)
+        assert abs(o[1, 1] - 0.7) < 1e-5
+        assert o[2, 1] < 0.5
+        # gaussian decay also monotone
+        outg = vops.matrix_nms(t(bb), t(sc), 0.1, 0.05,
+                               use_gaussian=True)
+        g = outg[0].numpy() if isinstance(outg, tuple) else outg.numpy()
+        assert (np.sort(g[:, 1])[::-1] == g[:, 1]).all()
+
+    def test_generate_proposals_shapes_and_clip(self):
+        from paddle_tpu.vision import ops as vops
+        rng2 = np.random.RandomState(1)
+        h = w = 6
+        a = 2
+        anch = np.zeros((h, w, a, 4), np.float32)
+        for i in range(h):
+            for j in range(w):
+                for k in range(a):
+                    cx, cy = j * 16 + 8, i * 16 + 8
+                    s = 16 * (k + 1)
+                    anch[i, j, k] = [cx - s / 2, cy - s / 2,
+                                     cx + s / 2, cy + s / 2]
+        rois, probs, num = vops.generate_proposals(
+            t(rng2.rand(1, a, h, w).astype("float32")),
+            t((rng2.randn(1, 4 * a, h, w) * 0.2).astype("float32")),
+            t(np.array([[96, 96]], np.float32)),
+            t(anch), t(np.ones_like(anch)),
+            pre_nms_top_n=40, post_nms_top_n=8, nms_thresh=0.7)
+        r = rois.numpy()
+        assert r.shape[0] == int(num.numpy()[0]) <= 8
+        assert (r >= 0).all() and (r <= 96).all()
+        # probs sorted descending
+        p = probs.numpy()[:, 0]
+        assert (np.sort(p)[::-1] == p).all()
+
+    def test_yolo_loss_targets(self):
+        from paddle_tpu.vision import ops as vops
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = paddle.to_tensor(
+            np.zeros((1, 3 * 9, 4, 4), np.float32), stop_gradient=False)
+        gt = np.zeros((1, 2, 4), np.float32)
+        gt[0, 0] = [64, 64, 16, 30]  # matches anchor 1 exactly
+        lab = np.zeros((1, 2), np.int64)
+        loss = vops.yolo_loss(x, t(gt), t(lab), anchors=anchors,
+                              anchor_mask=[0, 1, 2], class_num=4,
+                              ignore_thresh=0.7, downsample_ratio=32)
+        l0 = float(loss.sum())
+        assert np.isfinite(l0) and l0 > 0
+        loss.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        # a gt with zero w/h contributes nothing: same loss
+        gt2 = gt.copy()
+        gt2[0, 1] = [10, 10, 0, 0]
+        l1 = float(vops.yolo_loss(
+            paddle.to_tensor(np.zeros((1, 27, 4, 4), np.float32)),
+            t(gt2), t(lab), anchors=anchors, anchor_mask=[0, 1, 2],
+            class_num=4, downsample_ratio=32).sum())
+        np.testing.assert_allclose(l0, l1, rtol=1e-5)
+
+    def test_lkj_cholesky(self):
+        from paddle_tpu.distribution import LKJCholesky
+        paddle.seed(0)
+        d = LKJCholesky(dim=3, concentration=2.0)
+        L = d.sample([500]).numpy()
+        R = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(R, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        assert np.abs(np.triu(L, 1)).max() < 1e-6
+        assert np.isfinite(float(d.log_prob(t(L[0]))))
+        tight = LKJCholesky(dim=3, concentration=30.0).sample([500]).numpy()
+        Rt = tight @ np.swapaxes(tight, -1, -2)
+        assert Rt[:, 1, 0].std() < R[:, 1, 0].std()
+        # log_prob favors identity-like factors under high concentration
+        eye = np.eye(3, dtype=np.float32)
+        skew = np.array([[1, 0, 0], [0.9, np.sqrt(1 - 0.81), 0],
+                         [0, 0, 1]], np.float32)
+        dh = LKJCholesky(dim=3, concentration=10.0)
+        assert float(dh.log_prob(t(eye))) > float(dh.log_prob(t(skew)))
+
+    def test_distributed_split_and_p2pop(self):
+        import paddle_tpu.distributed as dist
+        # split without an initialized mp group: degenerates to plain
+        # linear/embedding over a 1-way group
+        x = paddle.randn([4, 8])
+        out = dist.split(x, (8, 12), operation="linear", axis=1)
+        assert tuple(out.shape) == (4, 12)
+        emb = dist.split(t(np.array([[1, 2], [3, 0]])), (10, 6),
+                         operation="embedding")
+        assert tuple(emb.shape) == (2, 2, 6)
+        assert hasattr(dist, "P2POp") and hasattr(dist, "batch_isend_irecv")
+        import pytest
+        with pytest.raises(RuntimeError, match="matched"):
+            dist.batch_isend_irecv([])

@@ -491,3 +491,132 @@ class TestGeometric:
         G.send_u_recv(x, src, dst, "sum").sum().backward()
         np.testing.assert_allclose(x.grad.numpy(),
                                    [[2, 2], [1, 1], [1, 1]])
+
+
+class TestRound3Aliases:
+    def test_inplace_tail_and_toplevel(self):
+        import numpy as np
+        x = paddle.to_tensor([1.7, -2.3])
+        np.testing.assert_allclose(paddle.square_(x.clone()).numpy(),
+                                   [2.89, 5.29], rtol=1e-5)
+        np.testing.assert_allclose(paddle.frac_(x.clone()).numpy(),
+                                   [0.7, -0.3], atol=1e-6)
+        np.testing.assert_allclose(paddle.zero_(x.clone()).numpy(), [0, 0])
+        np.testing.assert_allclose(paddle.exp_(
+            paddle.to_tensor([0.0])).numpy(), [1.0])
+        assert paddle.bitwise_invert(
+            paddle.to_tensor([0])).numpy()[0] == -1
+
+    def test_baddbmm(self):
+        import numpy as np
+        import torch
+        rng = np.random.RandomState(5)
+        i = rng.randn(2, 3, 4).astype("float32")
+        a = rng.randn(2, 3, 5).astype("float32")
+        b = rng.randn(2, 5, 4).astype("float32")
+        out = paddle.baddbmm(paddle.to_tensor(i), paddle.to_tensor(a),
+                             paddle.to_tensor(b), beta=0.5, alpha=2.0)
+        ref = torch.baddbmm(torch.tensor(i), torch.tensor(a),
+                            torch.tensor(b), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_reduce_as(self):
+        import numpy as np
+        x = paddle.ones([2, 3, 4])
+        out = paddle.reduce_as(x, paddle.zeros([3, 1]))
+        assert tuple(out.shape) == (3, 1)
+        np.testing.assert_allclose(out.numpy().sum(), 24.0)
+        out2 = paddle.reduce_as(x, paddle.zeros([2, 1, 4]))
+        assert tuple(out2.shape) == (2, 1, 4)
+
+    def test_set_printoptions_and_dtype(self):
+        paddle.set_printoptions(precision=3)
+        import numpy as np
+        assert np.get_printoptions()["precision"] == 3
+        paddle.set_printoptions(precision=8)
+        assert paddle.dtype("float32") == np.float32
+
+    def test_sparse_divide_addmm(self):
+        import numpy as np
+        import paddle_tpu.sparse as sp
+        dense = np.array([[0, 2.0], [4.0, 0]], np.float32)
+        s = sp.sparse_coo_tensor(
+            paddle.to_tensor(np.array([[0, 1], [1, 0]])),
+            paddle.to_tensor(np.array([2.0, 4.0], np.float32)),
+            shape=[2, 2])
+        q = sp.divide(s, 2.0)
+        np.testing.assert_allclose(q.to_dense().numpy(), dense / 2)
+        inp = np.ones((2, 3), np.float32)
+        y = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = sp.addmm(paddle.to_tensor(inp), s, paddle.to_tensor(y),
+                       beta=0.5, alpha=1.0)
+        np.testing.assert_allclose(out.numpy(), 0.5 * inp + dense @ y)
+
+    def test_autograd_jvp_vjp_exports(self):
+        import numpy as np
+        import paddle_tpu.autograd as ag
+        x = paddle.to_tensor([2.0])
+        out, tang = ag.jvp(lambda v: v * v, x)
+        np.testing.assert_allclose(tang.numpy(), [4.0])
+        out, g = ag.vjp(lambda v: v * v, x)
+        np.testing.assert_allclose(g.numpy(), [4.0])
+
+    def test_saved_tensors_hooks(self):
+        import numpy as np
+        import paddle_tpu.autograd as ag
+        packed, unpacked = [], []
+
+        def pack(t):
+            packed.append(t)
+            return t.numpy()
+
+        def unpack(a):
+            unpacked.append(a)
+            return paddle.to_tensor(a)
+
+        class Sq(ag.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor
+                return 2.0 * x * g
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        with ag.saved_tensors_hooks(pack, unpack):
+            y = Sq.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        assert len(packed) == 1 and len(unpacked) == 1
+
+    def test_jit_enable_to_static(self):
+        import paddle_tpu.jit as jit
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            calls.append(1)
+            return x + 1
+
+        f(paddle.to_tensor([1.0]))
+        n_traced = len(calls)
+        jit.enable_to_static(False)
+        try:
+            f(paddle.to_tensor([1.0]))
+            f(paddle.to_tensor([1.0]))
+            # eager mode: the python body runs every call
+            assert len(calls) == n_traced + 2
+        finally:
+            jit.enable_to_static(True)
+
+    def test_utils_download_local(self):
+        import pytest
+        from paddle_tpu.utils import download
+        assert download.get_path_from_url(__file__, "/tmp") == __file__
+        with pytest.raises(RuntimeError, match="no network"):
+            download.get_path_from_url("http://example.com/w.pdparams",
+                                       "/tmp/definitely_missing_dir")

@@ -12,4 +12,89 @@ from .features import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
                        MFCC)
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+           "LogMelSpectrogram", "MFCC",
+           "backends", "load", "save", "info"]
+
+
+class backends:
+    """Parity shim: paddle.audio.backends. The reference dispatches to
+    soundfile/sox; neither ships in this image, so only the
+    list/query half of the API is live and WAV I/O uses the stdlib
+    `wave` module (see load/save below)."""
+
+    @staticmethod
+    def list_available_backends():
+        return ["wave"]
+
+    @staticmethod
+    def get_current_backend():
+        return "wave"
+
+    @staticmethod
+    def set_backend(backend_name):
+        if backend_name != "wave":
+            raise RuntimeError(
+                "only the stdlib 'wave' backend is available in this "
+                "environment (soundfile/sox are not installed)")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Parity: paddle.audio.load — 16-bit PCM WAV via the stdlib wave
+    module (reference: paddle/audio/backends soundfile_backend.load)."""
+    import wave as _wave
+    import numpy as np
+    from ..tensor import Tensor
+    with _wave.open(str(filepath), "rb") as f:
+        sr = f.getframerate()
+        n_ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    if width != 2:
+        raise RuntimeError(f"only 16-bit PCM WAV supported, got "
+                           f"{8 * width}-bit")
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, n_ch)
+    if normalize:
+        data = (data / 32768.0).astype("float32")
+    arr = data.T if channels_first else data
+    return Tensor(arr), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    """Parity: paddle.audio.save (16-bit PCM WAV)."""
+    import wave as _wave
+    import numpy as np
+    if bits_per_sample != 16:
+        raise RuntimeError("only 16-bit PCM WAV supported")
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype("<i2")
+    elif arr.dtype != np.int16:
+        # wider integer input would silently wrap in the astype below
+        arr = np.clip(arr, -32768, 32767).astype("<i2")
+    with _wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim == 2 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.astype("<i2").tobytes())
+
+
+def info(filepath):
+    """Parity: paddle.audio.info."""
+    import wave as _wave
+
+    class AudioInfo:
+        pass
+    with _wave.open(str(filepath), "rb") as f:
+        ai = AudioInfo()
+        ai.sample_rate = f.getframerate()
+        ai.num_frames = f.getnframes()
+        ai.num_channels = f.getnchannels()
+        ai.bits_per_sample = 8 * f.getsampwidth()
+    return ai

@@ -11,7 +11,7 @@ from ..tensor import Tensor
 
 __all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
            "compute_fbank_matrix", "create_dct", "power_to_db",
-           "get_window"]
+           "get_window", "fft_frequencies"]
 
 
 def hz_to_mel(freq, htk=False):
@@ -116,3 +116,9 @@ def get_window(window, win_length, fftbins=True):
     else:
         raise ValueError(f"unsupported window {window!r}")
     return Tensor(jnp.asarray(w.astype(np.float32)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """Parity: paddle.audio.functional.fft_frequencies."""
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2,
+                               dtype=dtype))

@@ -130,6 +130,10 @@ define_flag("use_pallas_kernels", True,
 define_flag("pallas_interpret", False,
             "Force Pallas kernels ON in interpreter mode (CPU CI coverage: "
             "runs every kernel's real Pallas path without TPU hardware).")
+define_flag("flash_block_q", 128,
+            "Flash-attention Q tile rows (on-device autotune knob).")
+define_flag("flash_block_k", 128,
+            "Flash-attention KV tile rows (on-device autotune knob).")
 define_flag("max_inplace_grad_add", 0, "Parity stub.")
 define_flag("eager_delete_tensor_gb", 0.0, "Parity stub; XLA GC is automatic.")
 define_flag("shm_channel_capacity_mb", 64,

@@ -36,3 +36,23 @@ def segment_mean(data, segment_ids, name=None):
         cnt = jax.ops.segment_sum(jnp.ones_like(d), s, num_segments=n)
         return tot / jnp.maximum(cnt, 1)
     return apply(fn, _coerce(data), _coerce(segment_ids))
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy alias of paddle.geometric.send_u_recv (parity:
+    python/paddle/incubate/operators/graph_send_recv.py)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def identity_loss(x, reduction="none"):
+    """Parity: paddle.incubate.identity_loss — marks x as a loss for
+    graph capture; numerically identity (with optional reduction)."""
+    from ..ops import math as m
+    if reduction in (0, "sum"):
+        return m.sum(x)
+    if reduction in (1, "mean"):
+        return m.mean(x)
+    return x

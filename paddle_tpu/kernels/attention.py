@@ -505,13 +505,22 @@ def _flash_core(q, k, v, scale, causal):
     return _flash_fwd(q, k, v, scale, causal)[0]
 
 
+def _flash_blocks():
+    """Autotune knobs (FLAGS_flash_block_q/_k) — static at trace time."""
+    from ..framework.flags import flag_value
+    return int(flag_value("flash_block_q")), \
+        int(flag_value("flash_block_k"))
+
+
 def _flash_fwd(q, k, v, scale, causal):
     b, sq, h, d = q.shape
     hkv = k.shape[2]
+    bq, bk = _flash_blocks()
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, k.shape[1], d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, v.shape[1], d)
     out, lse = _flash_fwd_pallas(qt, kt, vt, scale, causal,
+                                 block_q=bq, block_k=bk,
                                  n_heads=h, n_kv_heads=hkv)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     return out, (q, k, v, out, lse.reshape(b, h, sq))
@@ -531,10 +540,12 @@ def _flash_bwd(scale, causal, res, g):
 
         def to3(x, s, nh):
             return x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
+        bq, bk = _flash_blocks()
         dq3, dk3, dv3 = _flash_bwd_pallas(
             to3(q, sq, h), to3(k, sk, hkv), to3(v, sk, hkv),
             to3(out, sq, h), lse.reshape(b * h, sq),
             to3(g.astype(q.dtype), sq, h), scale, causal,
+            block_q=bq, block_k=bk,
             n_heads=h, n_kv_heads=hkv)
         dq = dq3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
         # GQA: per-query-head dk/dv group-sum down to the kv heads
@@ -594,7 +605,9 @@ def _flash_fwd_varlen(q, k, v, kv_lens, scale, causal):
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, k.shape[1], d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, v.shape[1], d)
+    bq, bk = _flash_blocks()
     out, lse = _flash_fwd_pallas(qt, kt, vt, scale, causal,
+                                 block_q=bq, block_k=bk,
                                  n_heads=h, n_kv_heads=hkv,
                                  kv_lens=kv_lens)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
@@ -610,10 +623,12 @@ def _flash_bwd_varlen(scale, causal, res, g):
             and sq >= 8 and d % 64 == 0):
         def to3(x, s, nh):
             return x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
+        bq, bk = _flash_blocks()
         dq3, dk3, dv3 = _flash_bwd_pallas(
             to3(q, sq, h), to3(k, sk, hkv), to3(v, sk, hkv),
             to3(out, sq, h), lse.reshape(b * h, sq),
             to3(g.astype(q.dtype), sq, h), scale, causal,
+            block_q=bq, block_k=bk,
             n_heads=h, n_kv_heads=hkv, kv_lens=kv_lens)
         dq = dq3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
         dk = dk3.reshape(b, hkv, h // hkv, sk, d).sum(2).transpose(0, 2, 1, 3)

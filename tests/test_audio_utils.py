@@ -91,3 +91,35 @@ class TestVersionOnnx:
     def test_onnx_export_raises_with_guidance(self):
         with pytest.raises(NotImplementedError, match="jit.save"):
             paddle.onnx.export(None, "model.onnx")
+
+
+class TestAudioIORound3:
+    def test_wav_roundtrip(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.audio as au
+        sig = np.sin(np.linspace(0, 440 * 2 * np.pi, 8000)) \
+            .astype("float32")[None]
+        p = str(tmp_path / "t.wav")
+        au.save(p, paddle.to_tensor(sig), 16000)
+        back, sr = au.load(p)
+        assert sr == 16000
+        np.testing.assert_allclose(back.numpy(), sig, atol=1e-3)
+        ai = au.info(p)
+        assert (ai.sample_rate, ai.num_frames, ai.num_channels,
+                ai.bits_per_sample) == (16000, 8000, 1, 16)
+        # integer input wider than int16 is clipped, not wrapped
+        au.save(p, np.array([[40000, -40000, 100]], np.int32), 8000)
+        b2, _ = au.load(p, normalize=False)
+        assert b2.numpy().tolist() == [[32767, -32768, 100]]
+        # offset/num_frames slicing
+        part, _ = au.load(p, frame_offset=1, num_frames=1,
+                          normalize=False)
+        assert part.numpy().shape == (1, 1)
+        assert au.backends.list_available_backends() == ["wave"]
+
+    def test_fft_frequencies(self):
+        import numpy as np
+        import paddle_tpu.audio as au
+        f = au.functional.fft_frequencies(16000, 512).numpy()
+        assert f.shape == (257,) and f[0] == 0 and abs(f[-1] - 8000) < 1e-3

@@ -1,0 +1,70 @@
+"""On-device flash-attention block-size sweep (run when TPU reachable):
+
+    python tools/tpu_autotune_flash.py [--seq 1024] [--heads 8] [--d 128]
+
+Times fwd+bwd through the Pallas kernel for block_q/block_k in
+{128, 256, 512} at bench shapes and prints a ranked table. Feed the
+winner to the bench via FLAGS_flash_block_q/_k (or set_flags)."""
+import argparse
+import itertools
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--d", type=int, default=128)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.kernels.attention import _flash_core
+
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    key = jax.random.PRNGKey(0)
+    shape = (args.batch, args.seq, args.heads, args.d)
+    q, k, v = (jax.random.normal(kk, shape, dt)
+               for kk in jax.random.split(key, 3))
+    scale = args.d ** -0.5
+
+    def loss(q, k, v):
+        return jnp.sum(_flash_core(q, k, v, scale, True)
+                       .astype(jnp.float32))
+
+    results = []
+    for bq, bk in itertools.product((128, 256, 512), repeat=2):
+        if bq > args.seq or bk > args.seq:
+            continue
+        set_flags({"flash_block_q": bq, "flash_block_k": bk})
+        try:
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            out = g(q, k, v)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = g(q, k, v)
+            jax.block_until_ready(out)
+            dt_ms = (time.perf_counter() - t0) / args.iters * 1e3
+            results.append((dt_ms, bq, bk))
+            print(f"block_q={bq:<4d} block_k={bk:<4d}  {dt_ms:8.3f} ms")
+        except Exception as e:
+            print(f"block_q={bq:<4d} block_k={bk:<4d}  FAILED: "
+                  f"{type(e).__name__}: {str(e)[:120]}")
+    if not results:
+        print("no configuration ran", file=sys.stderr)
+        return 1
+    results.sort()
+    best = results[0]
+    print(f"\nBEST: flash_block_q={best[1]} flash_block_k={best[2]} "
+          f"({best[0]:.3f} ms/iter fwd+bwd)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

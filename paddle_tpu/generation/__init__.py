@@ -38,11 +38,17 @@ class GenerationConfig:
     """Knob bag mirroring PaddleNLP GenerationConfig field names."""
     max_new_tokens: int = 32
     min_new_tokens: int = 0
-    decode_strategy: str = "greedy_search"  # or "sampling"
+    decode_strategy: str = "greedy_search"  # "sampling" | "beam_search"
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
     repetition_penalty: float = 1.0
+    num_beams: int = 1
+    length_penalty: float = 0.0
+    # accepted for config parity: with frozen-finished-beam semantics the
+    # search result is identical either way; once every beam is finished
+    # both implementations skip the remaining model calls automatically
+    early_stopping: bool = False
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     use_cache: bool = True
@@ -115,7 +121,21 @@ class GenerationMixin:
             from ..framework.random import next_key
             key = next_key()
 
-        if cfg.use_cache and self.supports_static_cache:
+        beam = cfg.decode_strategy == "beam_search"
+        if not beam and (cfg.num_beams or 1) > 1:
+            # PaddleNLP raises for greedy/sampling with num_beams > 1 —
+            # silently ignoring either knob would mislead
+            raise ValueError(
+                f"num_beams={cfg.num_beams} requires "
+                "decode_strategy='beam_search' (got "
+                f"{cfg.decode_strategy!r})")
+        if beam and cfg.use_cache and self.supports_static_cache:
+            if (mask == 0).any():
+                ids, mask = _left_pad(ids, mask, cfg.pad_token_id)
+            out, scores = self._generate_beam(ids, mask, cfg)
+        elif beam:
+            out, scores = self._generate_beam_eager(ids, mask, cfg)
+        elif cfg.use_cache and self.supports_static_cache:
             # decoder-only layout: padding goes on the LEFT so every
             # row's last prompt token shares one slot
             if (mask == 0).any():
@@ -347,3 +367,250 @@ class GenerationMixin:
         denom = np.maximum(emitted.sum(axis=1), 1)
         scores = (lp * emitted).sum(axis=1) / denom
         return toks, scores.astype(np.float32)
+
+    # -- beam search ------------------------------------------------------
+    def _generate_beam(self, ids, mask, cfg):
+        """Jitted beam search over the static KV cache: beams live as
+        extra batch rows ([B*K, ...]), each step reorders the cache by
+        the selected parent beams with one gather (parity:
+        PaddleNLP generation beam_search; upstream
+        python/paddle/nn/decode.py BeamSearchDecoder semantics —
+        GNMT-style length normalization score/((5+len)/6)**lp)."""
+        from ..autograd.grad_mode import no_grad
+
+        n_layers, n_kv, head_dim = self._cache_spec()
+        B, S = ids.shape
+        K = int(cfg.num_beams)
+        N = int(cfg.max_new_tokens)
+        ML = S + N
+        sig = ("beam", B, S, N, K, cfg.eos_token_id, cfg.pad_token_id,
+               float(cfg.length_penalty), cfg.min_new_tokens)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        if sig not in cache:
+            cache[sig] = self._build_beam_fn(n_layers, n_kv, head_dim,
+                                             B, S, N, ML, K, cfg)
+        fn = cache[sig]
+        p_vals = [p._value for _, p in self.named_parameters()]
+        b_vals = [b._value for _, b in self.named_buffers()]
+        with no_grad():
+            out, scores = fn(p_vals, b_vals, jnp.asarray(ids, jnp.int32),
+                             jnp.asarray(mask, jnp.int32))
+        return np.asarray(out), np.asarray(scores)
+
+    def _build_beam_fn(self, n_layers, n_kv, head_dim, B, S, N, ML, K,
+                       cfg):
+        from ..jit.bridge import functionalize
+        from ..tensor import Tensor
+
+        was_training = self.training
+        self.eval()
+
+        def model_fn(ids_t, amask_t, posid_t, cachepos_t, *flat_kv):
+            entries = [StaticCacheEntry(flat_kv[2 * i], flat_kv[2 * i + 1],
+                                        cachepos_t)
+                       for i in range(n_layers)]
+            logits, new_entries = self.forward(
+                ids_t, attn_mask=amask_t, position_ids=posid_t,
+                past_key_values=StaticKVCache(entries), use_cache=True)
+            flat = [logits]
+            for e in new_entries:
+                flat.append(e.k)
+                flat.append(e.v)
+            return flat
+
+        pure_fn, _, _, _, _ = functionalize(self, fn=model_fn,
+                                            training=False)
+        if was_training:
+            self.train()
+
+        dtype = self._cache_dtype()
+        eos = cfg.eos_token_id
+        pad = cfg.pad_token_id
+        lp_exp = float(cfg.length_penalty)
+        min_new = cfg.min_new_tokens
+        vocab = self.config.vocab_size
+        BK = B * K
+        NEG = jnp.float32(-1e9)
+
+        def run_model(p, b, ids2d, amask, posid, cachepos, kv):
+            outs, _, _ = pure_fn(p, b, jax.random.key(0),
+                                 Tensor(ids2d), Tensor(amask),
+                                 Tensor(posid), Tensor(cachepos),
+                                 *[Tensor(x) for x in kv])
+            return outs[0]._value, [t._value for t in outs[1:]]
+
+        def lnorm(length):
+            # GNMT: ((5 + len) / 6) ** length_penalty
+            return ((5.0 + length.astype(jnp.float32)) / 6.0) ** lp_exp
+
+        def raw(p, b, ids, mask):
+            # prefill on [B, S] ONCE, then replicate the kv cache to the
+            # beam rows ([B*K, ...]; row b*K + j is beam j of sequence b)
+            # — all beams start identical, so K prefill passes would be
+            # K-1 wasted forwards
+            posid = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0)
+            kv = []
+            for _ in range(n_layers):
+                kv.append(jnp.zeros((B, ML, n_kv, head_dim), dtype))
+                kv.append(jnp.zeros((B, ML, n_kv, head_dim), dtype))
+            kmask1 = jnp.concatenate(
+                [mask.astype(bool), jnp.zeros((B, N), bool)], axis=1)
+            i_ids = jnp.arange(S)[:, None]
+            j_ids = jnp.arange(ML)[None, :]
+            amask = ((j_ids <= i_ids)[None, None]
+                     & kmask1[:, None, None, :])
+            logits, kv = run_model(p, b, ids, amask, posid,
+                                   jnp.int32(0), kv)
+            kv = [jnp.repeat(a, K, axis=0) for a in kv]  # [BK, ...]
+            kmask = jnp.repeat(kmask1, K, axis=0)
+            real_len = jnp.repeat(jnp.sum(mask, axis=1), K)  # [BK]
+            logp0 = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32), axis=-1)
+            if eos is not None and min_new > 0:
+                logp0 = logp0.at[:, eos].set(NEG)
+            first = logp0                                # [B, V]
+            top_lp, top_tok = jax.lax.top_k(first, K)    # [B, K]
+            beam_scores = top_lp                         # [B, K]
+            tokens0 = top_tok.astype(jnp.int32)
+            finished0 = ((tokens0 == eos) if eos is not None
+                         else jnp.zeros((B, K), bool))
+            hist0 = jnp.full((B, K, N), pad, jnp.int32)
+            hist0 = hist0.at[:, :, 0].set(tokens0)
+
+            def step(carry, t):
+                # all-finished short-circuit: skip the model call (and
+                # reorders) once nothing can change — lax.cond picks the
+                # cheap branch at runtime inside the scan
+                return jax.lax.cond(jnp.all(carry[2]),
+                                    lambda c: (c, None),
+                                    lambda c: (_live_step(c, t), None),
+                                    carry)
+
+            def _live_step(carry, t):
+                tok, scores, fin, hist, kvs, km = carry
+                # tok [B,K] current last token per beam
+                slot = S + t
+                km = jax.lax.dynamic_update_slice(
+                    km, jnp.ones((BK, 1), bool),
+                    (jnp.int32(0), slot.astype(jnp.int32)))
+                am = km[:, None, None, :]
+                pid = (real_len + t)[:, None]
+                lg, kvs = run_model(p, b, tok.reshape(BK, 1), am, pid,
+                                    slot, kvs)
+                logp = jax.nn.log_softmax(
+                    lg[:, -1, :].astype(jnp.float32), axis=-1)
+                logp = logp.reshape(B, K, vocab)
+                if eos is not None and min_new > 0:
+                    logp = jnp.where(
+                        (t + 1 < min_new),
+                        logp.at[:, :, eos].set(NEG), logp)
+                # finished beams: freeze (only pad continuation, no cost)
+                cont = scores[:, :, None] + logp         # [B,K,V]
+                frozen = jnp.full((B, K, vocab), NEG)
+                frozen = frozen.at[:, :, pad].set(scores)
+                cand = jnp.where(fin[:, :, None], frozen, cont)
+                flat = cand.reshape(B, K * vocab)
+                best, idx = jax.lax.top_k(flat, K)       # [B,K]
+                parent = (idx // vocab).astype(jnp.int32)
+                ntok = (idx % vocab).astype(jnp.int32)
+                # reorder everything by parent beam
+                gat = (jnp.arange(B)[:, None] * K + parent).reshape(BK)
+                kvs = [a[gat] for a in kvs]
+                km = km[gat]
+                hist = jnp.take_along_axis(
+                    hist, parent[:, :, None], axis=1)
+                fin = jnp.take_along_axis(fin, parent, axis=1)
+                emit = jnp.where(fin, jnp.int32(pad), ntok)
+                hist = hist.at[:, :, t + 1].set(emit)
+                if eos is not None:
+                    fin = fin | (ntok == eos)
+                return (emit, best, fin, hist, kvs, km)
+
+            carry = (tokens0, beam_scores, finished0, hist0, kv, kmask)
+            if N > 1:
+                carry, _ = jax.lax.scan(
+                    step, carry, jnp.arange(N - 1, dtype=jnp.int32))
+            _, scores, fin, hist, _, _ = carry
+            # length-normalized final ranking
+            lens = jnp.sum(hist != pad, axis=2)          # [B,K]
+            norm = scores / lnorm(jnp.maximum(lens, 1))
+            best = jnp.argmax(norm, axis=1)              # [B]
+            out = jnp.take_along_axis(
+                hist, best[:, None, None], axis=1)[:, 0]
+            sc = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+            return out, sc
+
+        return jax.jit(raw)
+
+    def _generate_beam_eager(self, ids, mask, cfg):
+        """Eager beam search (no cache protocol): beams as batch rows,
+        full-prefix recompute per step. Correctness-first fallback for
+        models without static-cache support."""
+        from ..tensor import Tensor
+        from ..autograd.grad_mode import no_grad
+
+        if (mask == 0).any():
+            outs, scores = [], []
+            for b in range(ids.shape[0]):
+                row = ids[b][mask[b].astype(bool)][None, :]
+                o, s = self._generate_beam_eager(
+                    row, np.ones_like(row, dtype=np.int32), cfg)
+                outs.append(o[0])
+                scores.append(s[0])
+            return np.stack(outs), np.asarray(scores, np.float32)
+
+        B, S = ids.shape
+        K = int(cfg.num_beams)
+        N = int(cfg.max_new_tokens)
+        eos, pad = cfg.eos_token_id, cfg.pad_token_id
+        vocab = self.config.vocab_size
+        NEG = np.float32(-1e9)
+        cur = np.repeat(np.asarray(ids), K, axis=0)       # [B*K, S+t]
+        beam_scores = np.full((B, K), NEG, np.float32)
+        beam_scores[:, 0] = 0.0
+        finished = np.zeros((B, K), bool)
+        hist = np.full((B, K, N), pad, np.int32)
+        with no_grad():
+            for t in range(N):
+                out = self.forward(Tensor(jnp.asarray(cur, jnp.int32)))
+                logits = np.asarray((out[0] if isinstance(out, tuple)
+                                     else out)._value)[:, -1, :]
+                # np.array (copy): np.asarray of a jax buffer is
+                # read-only and the eos mask below writes in place
+                logp = np.array(jax.nn.log_softmax(
+                    jnp.asarray(logits, jnp.float32), axis=-1))
+                logp = logp.reshape(B, K, vocab)
+                if eos is not None and t < cfg.min_new_tokens:
+                    logp[:, :, eos] = NEG
+                cont = beam_scores[:, :, None] + logp
+                frozen = np.full((B, K, vocab), NEG, np.float32)
+                frozen[:, :, pad] = beam_scores
+                cand = np.where(finished[:, :, None], frozen, cont)
+                flat = cand.reshape(B, K * vocab)
+                idx = np.argsort(-flat, axis=1)[:, :K]
+                beam_scores = np.take_along_axis(flat, idx, axis=1)
+                parent = idx // vocab
+                ntok = (idx % vocab).astype(np.int32)
+                gat = (np.arange(B)[:, None] * K + parent).reshape(-1)
+                cur = cur[gat]
+                hist = np.take_along_axis(hist, parent[:, :, None],
+                                          axis=1)
+                finished = np.take_along_axis(finished, parent, axis=1)
+                emit = np.where(finished, pad, ntok)
+                hist[:, :, t] = emit
+                if eos is not None:
+                    finished |= ntok == eos
+                cur = np.concatenate([cur, emit.reshape(-1, 1)], axis=1)
+                if finished.all():
+                    break
+        lens = (hist != pad).sum(axis=2)
+        lp_exp = float(cfg.length_penalty)
+        norm = beam_scores / (((5.0 + np.maximum(lens, 1)) / 6.0)
+                              ** lp_exp)
+        best = np.argmax(norm, axis=1)
+        out = np.take_along_axis(hist, best[:, None, None],
+                                 axis=1)[:, 0]
+        sc = np.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+        return out.astype(np.int32), sc.astype(np.float32)

@@ -246,3 +246,107 @@ class TestReviewRegressions:
             qkv[:, :H * D].reshape(2, H, D), kp, vp, bt, cl)
         np.testing.assert_allclose(np.asarray(out.numpy()),
                                    np.asarray(ref.numpy()), atol=1e-5)
+
+
+class TestBeamSearch:
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        m.eval()
+        return m
+
+    def test_beam1_equals_greedy(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        m = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 256, (2, 8)))
+        g, _ = m.generate(ids, max_new_tokens=5,
+                          decode_strategy="greedy_search")
+        b, _ = m.generate(ids, max_new_tokens=5,
+                          decode_strategy="beam_search", num_beams=1)
+        np.testing.assert_array_equal(g.numpy(), b.numpy())
+
+    def test_static_beam_matches_eager_beam(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        m = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(1).randint(1, 256, (2, 6)))
+        s, ss = m.generate(ids, max_new_tokens=5,
+                           decode_strategy="beam_search", num_beams=3)
+        e, es = m.generate(ids, max_new_tokens=5,
+                           decode_strategy="beam_search", num_beams=3,
+                           use_cache=False)
+        np.testing.assert_array_equal(s.numpy(), e.numpy())
+        np.testing.assert_allclose(ss.numpy(), es.numpy(), rtol=1e-4)
+
+    def test_beam_improves_sequence_logp(self):
+        # beam search explores a superset of greedy's single path, so the
+        # best beam's (unnormalized, lp=0) score must be >= greedy's
+        import numpy as np
+        import paddle_tpu as paddle
+        import jax
+        import jax.numpy as jnp
+        m = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(1, 256, (1, 6)))
+
+        def seq_logp(new_tokens):
+            cur = np.concatenate([ids.numpy(), new_tokens[None]], axis=1)
+            out = m(paddle.to_tensor(cur))
+            lg = (out[0] if isinstance(out, tuple) else out).numpy()
+            lp = np.asarray(jax.nn.log_softmax(
+                jnp.asarray(lg, jnp.float32), axis=-1))
+            tot = 0.0
+            start = ids.shape[1] - 1
+            for i, tok in enumerate(new_tokens):
+                tot += lp[0, start + i, tok]
+            return tot
+
+        g, _ = m.generate(ids, max_new_tokens=4,
+                          decode_strategy="greedy_search")
+        b, _ = m.generate(ids, max_new_tokens=4,
+                          decode_strategy="beam_search", num_beams=4,
+                          length_penalty=0.0)
+        assert seq_logp(b.numpy()[0]) >= seq_logp(g.numpy()[0]) - 1e-4
+
+    def test_beam_eos_freezes(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        m = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(1, 256, (1, 5)))
+        out, _ = m.generate(ids, max_new_tokens=8,
+                            decode_strategy="beam_search", num_beams=2,
+                            eos_token_id=7, pad_token_id=0)
+        row = out.numpy()[0]
+        if (row == 7).any():
+            after = row[np.argmax(row == 7) + 1:]
+            assert (after == 0).all()
+
+    def test_eager_beam_min_new_tokens(self):
+        # regression: the eos mask writes into a copied (writable) array
+        import numpy as np
+        import paddle_tpu as paddle
+        m = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(4).randint(1, 256, (1, 5)))
+        out, _ = m.generate(ids, max_new_tokens=4,
+                            decode_strategy="beam_search", num_beams=2,
+                            eos_token_id=7, min_new_tokens=2,
+                            use_cache=False)
+        assert (out.numpy()[0, :2] != 7).all()
+
+    def test_num_beams_requires_beam_strategy(self):
+        import numpy as np
+        import pytest
+        import paddle_tpu as paddle
+        m = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(5).randint(1, 256, (1, 4)))
+        with pytest.raises(ValueError, match="num_beams"):
+            m.generate(ids, max_new_tokens=2,
+                       decode_strategy="sampling", num_beams=3)

@@ -152,3 +152,32 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
 # paddle.dtype: dtypes in this framework ARE numpy dtype objects
 import numpy as _np_mod  # noqa: E402
 dtype = _np_mod.dtype
+
+
+def in_static_mode():
+    """Parity: paddle.in_static_mode (inverse of in_dynamic_mode)."""
+    return not in_dynamic_mode()
+
+
+def is_compiled_with_cinn():
+    """Parity: CINN's role is subsumed by XLA here (SURVEY §2.1)."""
+    return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Parity: paddle.batch — legacy reader-composer (python/paddle/
+    batch.py): wraps a sample reader into a batched reader."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+from .amp import is_autocast_enabled, get_autocast_dtype  # noqa: E402
+amp_guard = amp.amp_guard

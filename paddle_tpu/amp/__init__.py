@@ -194,3 +194,14 @@ class debugging:
                         o, op_type=getattr(func, "__qualname__", "layer"))
             return out
         return wrapper
+
+
+def is_autocast_enabled():
+    """Parity: paddle.is_autocast_enabled / paddle.amp.is_autocast_enabled."""
+    return bool(_state.enabled)
+
+
+def get_autocast_dtype():
+    """Parity: paddle.get_autocast_dtype (name of the active amp dtype)."""
+    from ..framework.dtype import dtype_name
+    return dtype_name(_state.dtype)

@@ -91,8 +91,12 @@ class GenerationMixin:
         """Returns (generated_ids [B, max_new_tokens], scores [B]).
 
         `generated_ids` contains only NEW tokens (PaddleNLP convention);
-        positions after eos are pad_token_id. `scores` is the mean
-        logprob of the emitted tokens.
+        positions after eos are pad_token_id. For greedy/sampling,
+        `scores` is the mean logprob of the emitted tokens; for
+        beam_search it is the best beam's cumulative logprob normalized
+        by the GNMT length penalty ((5+len)/6)**length_penalty — the two
+        are different quantities (beam semantics follow the reference)
+        and should not be compared across strategies.
         """
         from ..tensor import Tensor
 
@@ -176,8 +180,11 @@ class GenerationMixin:
                              jnp.asarray(mask, jnp.int32), key)
         return np.asarray(out), np.asarray(scores)
 
-    def _build_static_fn(self, n_layers, n_kv, head_dim, B, S, N, ML,
-                         greedy, cfg):
+    def _make_cache_runner(self, n_layers):
+        """Functionalize the cached forward ONCE: returns run_model(p, b,
+        ids2d, amask, posid, cachepos, kv) -> (logits, new_kv). Shared
+        cache/attention plumbing for the greedy/sampling AND beam
+        builders — fix it here, both paths get it."""
         from ..jit.bridge import functionalize
         from ..tensor import Tensor
 
@@ -197,19 +204,10 @@ class GenerationMixin:
                 flat.append(e.v)
             return flat
 
-        pure_fn, p_vals, b_vals, _, _ = functionalize(
-            self, fn=model_fn, training=False)
+        pure_fn, _, _, _, _ = functionalize(self, fn=model_fn,
+                                            training=False)
         if was_training:
             self.train()
-
-        dtype = self._cache_dtype()
-        eos = cfg.eos_token_id
-        pad = cfg.pad_token_id
-        temperature, top_k, top_p = cfg.temperature, cfg.top_k, cfg.top_p
-        rep_pen = cfg.repetition_penalty
-        min_new = cfg.min_new_tokens
-        vocab = self.config.vocab_size
-        track_counts = rep_pen != 1.0
 
         def run_model(p, b, ids2d, amask, posid, cachepos, kv):
             outs, _, _ = pure_fn(p, b, jax.random.key(0),
@@ -218,6 +216,40 @@ class GenerationMixin:
             logits = outs[0]._value
             new_kv = [t._value for t in outs[1:]]
             return logits, new_kv
+        return run_model
+
+    @staticmethod
+    def _cache_prefill(run_model, p, b, ids, mask, n_layers, n_kv,
+                       head_dim, ML, dtype):
+        """Zero-init the [rows, ML, ...] cache, build the causal+padding
+        prefill mask, and run the prompt pass. Returns
+        (logits, kv, kmask, posid)."""
+        rows, S = ids.shape
+        posid = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0)
+        kv = []
+        for _ in range(n_layers):
+            kv.append(jnp.zeros((rows, ML, n_kv, head_dim), dtype))
+            kv.append(jnp.zeros((rows, ML, n_kv, head_dim), dtype))
+        kmask = jnp.concatenate(
+            [mask.astype(bool), jnp.zeros((rows, ML - S), bool)], axis=1)
+        i_ids = jnp.arange(S)[:, None]
+        j_ids = jnp.arange(ML)[None, :]
+        amask = ((j_ids <= i_ids)[None, None]
+                 & kmask[:, None, None, :])  # [rows,1,S,ML]
+        logits, kv = run_model(p, b, ids, amask, posid, jnp.int32(0), kv)
+        return logits, kv, kmask, posid
+
+    def _build_static_fn(self, n_layers, n_kv, head_dim, B, S, N, ML,
+                         greedy, cfg):
+        dtype = self._cache_dtype()
+        eos = cfg.eos_token_id
+        pad = cfg.pad_token_id
+        temperature, top_k, top_p = cfg.temperature, cfg.top_k, cfg.top_p
+        rep_pen = cfg.repetition_penalty
+        min_new = cfg.min_new_tokens
+        vocab = self.config.vocab_size
+        track_counts = rep_pen != 1.0
+        run_model = self._make_cache_runner(n_layers)
 
         def sample_step(logits, k, counts, cur_len):
             lg = logits.astype(jnp.float32)
@@ -231,20 +263,10 @@ class GenerationMixin:
             return tok, logp, k
 
         def raw(p, b, ids, mask, key):
-            posid = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0)
             real_len = jnp.sum(mask, axis=1)  # [B]
-            kv = []
-            for _ in range(n_layers):
-                kv.append(jnp.zeros((B, ML, n_kv, head_dim), dtype))
-                kv.append(jnp.zeros((B, ML, n_kv, head_dim), dtype))
-            kmask = jnp.concatenate(
-                [mask.astype(bool), jnp.zeros((B, N), bool)], axis=1)
-            i_ids = jnp.arange(S)[:, None]
-            j_ids = jnp.arange(ML)[None, :]
-            amask = ((j_ids <= i_ids)[None, None]
-                     & kmask[:, None, None, :])  # [B,1,S,ML]
-            logits, kv = run_model(p, b, ids, amask, posid,
-                                   jnp.int32(0), kv)
+            logits, kv, kmask, _ = self._cache_prefill(
+                run_model, p, b, ids, mask, n_layers, n_kv, head_dim,
+                ML, dtype)
             counts = (jnp.zeros((B, vocab), jnp.int32)
                       .at[jnp.arange(B)[:, None], ids].add(
                           mask.astype(jnp.int32))
@@ -293,7 +315,6 @@ class GenerationMixin:
             scores = jnp.sum(all_logps * emitted, axis=1) / denom
             return all_toks, scores
 
-        del p_vals, b_vals  # rebound fresh at every call site
         return jax.jit(raw)
 
     # -- eager fallback (no cache protocol needed) -----------------------
@@ -401,30 +422,6 @@ class GenerationMixin:
 
     def _build_beam_fn(self, n_layers, n_kv, head_dim, B, S, N, ML, K,
                        cfg):
-        from ..jit.bridge import functionalize
-        from ..tensor import Tensor
-
-        was_training = self.training
-        self.eval()
-
-        def model_fn(ids_t, amask_t, posid_t, cachepos_t, *flat_kv):
-            entries = [StaticCacheEntry(flat_kv[2 * i], flat_kv[2 * i + 1],
-                                        cachepos_t)
-                       for i in range(n_layers)]
-            logits, new_entries = self.forward(
-                ids_t, attn_mask=amask_t, position_ids=posid_t,
-                past_key_values=StaticKVCache(entries), use_cache=True)
-            flat = [logits]
-            for e in new_entries:
-                flat.append(e.k)
-                flat.append(e.v)
-            return flat
-
-        pure_fn, _, _, _, _ = functionalize(self, fn=model_fn,
-                                            training=False)
-        if was_training:
-            self.train()
-
         dtype = self._cache_dtype()
         eos = cfg.eos_token_id
         pad = cfg.pad_token_id
@@ -433,13 +430,7 @@ class GenerationMixin:
         vocab = self.config.vocab_size
         BK = B * K
         NEG = jnp.float32(-1e9)
-
-        def run_model(p, b, ids2d, amask, posid, cachepos, kv):
-            outs, _, _ = pure_fn(p, b, jax.random.key(0),
-                                 Tensor(ids2d), Tensor(amask),
-                                 Tensor(posid), Tensor(cachepos),
-                                 *[Tensor(x) for x in kv])
-            return outs[0]._value, [t._value for t in outs[1:]]
+        run_model = self._make_cache_runner(n_layers)
 
         def lnorm(length):
             # GNMT: ((5 + len) / 6) ** length_penalty
@@ -450,19 +441,9 @@ class GenerationMixin:
             # beam rows ([B*K, ...]; row b*K + j is beam j of sequence b)
             # — all beams start identical, so K prefill passes would be
             # K-1 wasted forwards
-            posid = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0)
-            kv = []
-            for _ in range(n_layers):
-                kv.append(jnp.zeros((B, ML, n_kv, head_dim), dtype))
-                kv.append(jnp.zeros((B, ML, n_kv, head_dim), dtype))
-            kmask1 = jnp.concatenate(
-                [mask.astype(bool), jnp.zeros((B, N), bool)], axis=1)
-            i_ids = jnp.arange(S)[:, None]
-            j_ids = jnp.arange(ML)[None, :]
-            amask = ((j_ids <= i_ids)[None, None]
-                     & kmask1[:, None, None, :])
-            logits, kv = run_model(p, b, ids, amask, posid,
-                                   jnp.int32(0), kv)
+            logits, kv, kmask1, _ = self._cache_prefill(
+                run_model, p, b, ids, mask, n_layers, n_kv, head_dim,
+                ML, dtype)
             kv = [jnp.repeat(a, K, axis=0) for a in kv]  # [BK, ...]
             kmask = jnp.repeat(kmask1, K, axis=0)
             real_len = jnp.repeat(jnp.sum(mask, axis=1), K)  # [BK]

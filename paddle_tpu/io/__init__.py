@@ -437,3 +437,22 @@ def default_convert_fn(batch):
     if isinstance(batch, (_np.ndarray, _np.generic, int, float)):
         return Tensor(_jnp.asarray(batch))
     return batch
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Parity: paddle.io.multiprocess_reader (legacy reader composer).
+    The native shm DataLoader worker pool is the fast path here; this
+    shim interleaves the readers in-process (same yielded stream,
+    deterministic round-robin instead of process-race order)."""
+    def composed():
+        iters = [r() for r in readers]
+        alive = [True] * len(iters)
+        while any(alive):
+            for i, it in enumerate(iters):
+                if not alive[i]:
+                    continue
+                try:
+                    yield next(it)
+                except StopIteration:
+                    alive[i] = False
+    return composed

@@ -33,7 +33,9 @@ from .transformer import (
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
+from .decode import BeamSearchDecoder, dynamic_decode
 from .losses import (
+    AdaptiveLogSoftmaxWithLoss,
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
     TripletMarginLoss, HingeEmbeddingLoss,

@@ -1,0 +1,118 @@
+"""Seq2seq decoding API (parity: python/paddle/nn/decode.py
+BeamSearchDecoder / dynamic_decode).
+
+TPU-native shape: the beam dimension is folded into the batch dimension
+([B*K, ...]) so every step is one batched cell call; beam bookkeeping
+(top-k over K*V, parent gather, finished freezing) is the same frozen-
+beam algorithm as generation.GenerationMixin's beam search.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops.creation import _coerce
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Wraps an RNN cell for beam-search decoding.
+
+    embedding_fn maps token ids -> cell inputs; output_fn maps cell
+    outputs -> vocabulary logits (both default to identity like the
+    reference)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- decoder protocol (initialize / step), eager tensors -------------
+    def initialize(self, initial_cell_states):
+        states = initial_cell_states
+        flat = states if isinstance(states, (list, tuple)) else [states]
+        B = int(_coerce(flat[0])._value.shape[0])
+        K = self.beam_size
+        tiled = [Tensor(jnp.repeat(_coerce(s)._value, K, axis=0))
+                 for s in flat]
+        states = (tiled if isinstance(initial_cell_states, (list, tuple))
+                  else tiled[0])
+        ids = np.full((B * K,), self.start_token, np.int64)
+        scores = np.full((B, K), -1e9, np.float32)
+        scores[:, 0] = 0.0
+        finished = np.zeros((B, K), bool)
+        return ids, states, scores, finished
+
+    def _embed(self, ids):
+        t = Tensor(jnp.asarray(ids, jnp.int64))
+        return self.embedding_fn(t) if self.embedding_fn is not None else t
+
+    def step(self, inputs, states):
+        out, next_states = self.cell(inputs, states)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        return logits, next_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
+    """Run decoder to completion (parity: paddle.nn.dynamic_decode).
+
+    Returns (predicted_ids [B, T, beam], final_scores [B, beam]) —
+    beams sorted best-first, positions after end_token filled with
+    end_token (reference convention)."""
+    K = decoder.beam_size
+    end = decoder.end_token
+    ids, states, scores, finished = decoder.initialize(inits)
+    B = scores.shape[0]
+    NEG = np.float32(-1e9)
+    hist = []           # list of [B, K] int arrays
+    parents = []
+
+    def flat_states(ss):
+        return ss if isinstance(ss, (list, tuple)) else [ss]
+
+    for t in range(int(max_step_num)):
+        inp = decoder._embed(ids)
+        logits, states = decoder.step(inp, states)
+        lv = np.asarray(_coerce(logits)._value, np.float32)
+        vocab = lv.shape[-1]
+        logp = np.array(jax.nn.log_softmax(jnp.asarray(lv), axis=-1))
+        logp = logp.reshape(B, K, vocab)
+        cont = scores[:, :, None] + logp
+        frozen = np.full((B, K, vocab), NEG, np.float32)
+        frozen[:, :, end] = scores
+        cand = np.where(finished[:, :, None], frozen, cont)
+        flat = cand.reshape(B, K * vocab)
+        idx = np.argsort(-flat, axis=1)[:, :K]
+        scores = np.take_along_axis(flat, idx, axis=1)
+        parent = idx // vocab
+        tok = (idx % vocab).astype(np.int64)
+        # reorder states by parent beam
+        gat = (np.arange(B)[:, None] * K + parent).reshape(-1)
+        new_states = [Tensor(_coerce(s)._value[jnp.asarray(gat)])
+                      for s in flat_states(states)]
+        states = (new_states if isinstance(states, (list, tuple))
+                  else new_states[0])
+        finished = np.take_along_axis(finished, parent, axis=1)
+        emit = np.where(finished, end, tok)
+        hist.append(emit)
+        parents.append(parent)
+        finished |= tok == end
+        ids = emit.reshape(-1)
+        if finished.all():
+            break
+
+    # backtrack parent pointers into per-beam sequences
+    T = len(hist)
+    out = np.empty((B, T, K), np.int64)
+    cur = np.tile(np.arange(K), (B, 1))
+    for t in range(T - 1, -1, -1):
+        out[:, t, :] = np.take_along_axis(hist[t], cur, axis=1)
+        cur = np.take_along_axis(parents[t], cur, axis=1)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(scores))

@@ -1316,3 +1316,9 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 # second-tier surface (spatial transformer ops, unpooling, loss long
 # tail) lives in functional_extra to keep this module navigable
 from .functional_extra import *  # noqa: F401,F403,E402
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Alias re-export (parity: paddle.nn.functional.diag_embed)."""
+    from ..ops.creation import diag_embed as _de
+    return _de(x, offset=offset, dim1=dim1, dim2=dim2)

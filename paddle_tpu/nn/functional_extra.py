@@ -27,6 +27,7 @@ __all__ = [
     "triplet_margin_with_distance_loss", "hsigmoid_loss",
     "margin_cross_entropy", "fractional_max_pool2d", "fractional_max_pool3d",
     "class_center_sample", "rnnt_loss",
+    "adaptive_log_softmax_with_loss",
 ]
 
 
@@ -760,3 +761,55 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
         return _reduce(nll, reduction)
 
     return apply(fn, *args, _name="rnnt_loss")
+
+
+# ------------------------------------------- adaptive softmax with loss --
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (parity: python/paddle/nn/functional/loss.py
+    adaptive_log_softmax_with_loss; Grave et al. 2017). The head predicts
+    the frequent classes plus one slot per tail cluster; each tail
+    cluster factorizes through a low-rank projection. Returns
+    (output [N] per-sample target log-prob, loss = -mean(output)).
+
+    head_weight: [in, cutoffs[0] + n_clusters]; tail_weights: list of
+    (proj [in, hsz_i], cls [hsz_i, cluster_size_i]) pairs."""
+    n_clusters = len(tail_weights)
+    cutoffs = list(cutoffs)
+    shortlist = cutoffs[0]
+
+    args = [_coerce(input), _coerce(label), _coerce(head_weight)]
+    flat_tails = []
+    for pr, cl in tail_weights:
+        flat_tails += [_coerce(pr), _coerce(cl)]
+    args += flat_tails
+    has_bias = head_bias is not None
+    if has_bias:
+        args.append(_coerce(head_bias))
+
+    def fn(x, lab, hw, *rest):
+        tails = rest[:2 * n_clusters]
+        hb = rest[2 * n_clusters] if has_bias else None
+        lab = lab.reshape(-1).astype(jnp.int32)
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, axis=-1)   # [N, S + C]
+        # shortlist targets read straight from the head
+        out = jnp.take_along_axis(
+            head_lp, jnp.clip(lab, 0, shortlist - 1)[:, None],
+            axis=1)[:, 0]
+        for i in range(n_clusters):
+            lo = cutoffs[i]
+            hi = cutoffs[i + 1]
+            proj, cls = tails[2 * i], tails[2 * i + 1]
+            clus_lp = jax.nn.log_softmax((x @ proj) @ cls, axis=-1)
+            in_cl = (lab >= lo) & (lab < hi)
+            idx = jnp.clip(lab - lo, 0, hi - lo - 1)
+            lp_in = head_lp[:, shortlist + i] + jnp.take_along_axis(
+                clus_lp, idx[:, None], axis=1)[:, 0]
+            out = jnp.where(in_cl, lp_in, out)
+        return out, -jnp.mean(out)
+
+    return apply(fn, *args, _name="adaptive_log_softmax")

@@ -262,3 +262,75 @@ class RNNTLoss(Layer):
                          blank=self.blank,
                          fastemit_lambda=self.fastemit_lambda,
                          reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Parity: python/paddle/nn/layer/loss.py AdaptiveLogSoftmaxWithLoss
+    (Grave et al., "Efficient softmax approximation for GPUs")."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (not cutoffs or cutoffs != sorted(set(cutoffs))
+                or cutoffs[-1] > n_classes - 1):
+            raise ValueError("cutoffs must be unique, sorted, < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        n_clusters = len(self.cutoffs) - 1
+        head_size = self.cutoffs[0] + n_clusters
+        self.head_weight = self.create_parameter([in_features, head_size])
+        self.head_bias = (self.create_parameter([head_size], is_bias=True)
+                         if head_bias else None)
+        self.tail_weights = []
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter([in_features, hsz])
+            cls = self.create_parameter([hsz, osz])
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_cls_{i}", cls)
+            self.tail_weights.append((proj, cls))
+
+    def forward(self, input, label):
+        from .functional_extra import adaptive_log_softmax_with_loss
+        return adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probability table."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops._dispatch import apply
+        from ..ops.creation import _coerce
+        n_clusters = len(self.cutoffs) - 1
+        shortlist = self.cutoffs[0]
+        args = [_coerce(input), _coerce(self.head_weight)]
+        for pr, cl in self.tail_weights:
+            args += [_coerce(pr), _coerce(cl)]
+        if self.head_bias is not None:
+            args.append(_coerce(self.head_bias))
+        cutoffs = self.cutoffs
+        has_bias = self.head_bias is not None
+
+        def fn(x, hw, *rest):
+            tails = rest[:2 * n_clusters]
+            hb = rest[2 * n_clusters] if has_bias else None
+            head = x @ hw
+            if hb is not None:
+                head = head + hb
+            head_lp = jax.nn.log_softmax(head, axis=-1)
+            parts = [head_lp[:, :shortlist]]
+            for i in range(n_clusters):
+                proj, cls = tails[2 * i], tails[2 * i + 1]
+                clus_lp = jax.nn.log_softmax((x @ proj) @ cls, axis=-1)
+                parts.append(head_lp[:, shortlist + i][:, None] + clus_lp)
+            return jnp.concatenate(parts, axis=1)
+        return apply(fn, *args, _name="adaptive_log_prob")
+
+    def predict(self, input):
+        from ..ops import search
+        return search.argmax(self.log_prob(input), axis=1)

@@ -13,3 +13,19 @@ from .api import (
     cpu_places, cuda_places, WeightNormParamAttr,
 )
 from . import nn
+
+
+from .. import amp  # noqa: E402  (paddle.static.amp parity alias)
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """Parity shim: IPU pipelining has no TPU meaning; sharding is
+    expressed through the mesh (paddle.distributed)."""
+    yield
+
+
+def xpu_places(device_ids=None):
+    """Parity: paddle.static.xpu_places — no XPU in this environment."""
+    return []

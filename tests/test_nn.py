@@ -429,3 +429,93 @@ class TestInitializerAdditions:
         e = paddle.standard_exponential(
             paddle.to_tensor(np.zeros(2000, "float32")))
         assert abs(float(e.numpy().mean()) - 1.0) < 0.2
+
+
+class TestAdaptiveSoftmaxAndDecode:
+    def test_adaptive_log_softmax_torch_golden(self):
+        import numpy as np
+        import torch
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [4, 10], div_value=2.0,
+                                          head_bias=True)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(6, 16).astype("float32"))
+        lab = paddle.to_tensor(np.array([0, 3, 5, 9, 12, 19]))
+        out, loss = m(x, lab)
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(
+            16, 20, [4, 10], div_value=2.0, head_bias=True)
+        with torch.no_grad():
+            tm.head.weight.copy_(torch.tensor(m.head_weight.numpy().T))
+            tm.head.bias.copy_(torch.tensor(m.head_bias.numpy()))
+            for i, (pr, cl) in enumerate(m.tail_weights):
+                tm.tail[i][0].weight.copy_(torch.tensor(pr.numpy().T))
+                tm.tail[i][1].weight.copy_(torch.tensor(cl.numpy().T))
+        to, tl = tm(torch.tensor(x.numpy()), torch.tensor(lab.numpy()))
+        np.testing.assert_allclose(float(loss), float(tl), rtol=1e-5)
+        np.testing.assert_allclose(out.numpy(), to.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            m.log_prob(x).numpy(),
+            tm.log_prob(torch.tensor(x.numpy())).detach().numpy(),
+            rtol=1e-4, atol=1e-5)
+        # trainable end-to-end
+        opt = paddle.optimizer.Adam(1e-2, parameters=m.parameters())
+        l0 = None
+        for _ in range(8):
+            _, loss = m(x, lab)
+            loss.backward()
+            opt.step(); opt.clear_grad()
+            if l0 is None:
+                l0 = float(loss)
+        assert float(loss) < l0
+
+    def test_beam_search_decoder_dynamic_decode(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        class Cell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(12, 8)
+                self.cell = nn.GRUCell(8, 8)
+                self.out = nn.Linear(8, 12)
+
+            def __call__(self, ids, states):
+                h, new = self.cell(self.emb(ids), states)
+                return self.out(h), new
+
+        paddle.seed(1)
+        cell = Cell()
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                   beam_size=3)
+        ids, scores = nn.dynamic_decode(dec, inits=paddle.zeros([2, 8]),
+                                        max_step_num=6)
+        assert tuple(ids.shape) == (2, 6, 3)
+        s = scores.numpy()
+        # beams sorted best-first
+        assert (np.diff(s, axis=1) <= 1e-6).all()
+        # beam 0 of a beam_size=1 decode = greedy rollout of the cell
+        dec1 = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                    beam_size=1)
+        ids1, _ = nn.dynamic_decode(dec1, inits=paddle.zeros([2, 8]),
+                                    max_step_num=6)
+        # greedy manual rollout
+        state = paddle.zeros([2, 8])
+        cur = paddle.to_tensor(np.array([1, 1]))
+        toks = []
+        for _ in range(6):
+            logits, state = cell(cur, state)
+            nxt = np.argmax(logits.numpy(), axis=1)
+            toks.append(nxt)
+            cur = paddle.to_tensor(nxt)
+        manual = np.stack(toks, axis=1)
+        got = ids1.numpy()[:, :, 0]
+        # compare until first end token per row
+        for b in range(2):
+            for t in range(6):
+                if manual[b, t] == 2:
+                    break
+                assert got[b, t] == manual[b, t]

@@ -202,6 +202,9 @@ def is_autocast_enabled():
 
 
 def get_autocast_dtype():
-    """Parity: paddle.get_autocast_dtype (name of the active amp dtype)."""
+    """Parity: paddle.get_autocast_dtype — the active amp dtype, or
+    float32 when autocast is off (matching reference behavior)."""
     from ..framework.dtype import dtype_name
+    if not _state.enabled:
+        return "float32"
     return dtype_name(_state.dtype)

@@ -310,10 +310,23 @@ def _export_aot(layer, path, input_spec, meta):
 
     exp = jexport.export(jax.jit(infer), platforms=("cpu", "tpu"))(
         p_avals, b_avals, *arg_avals)
+    # non-persistable buffers (rope caches etc.) are NOT in state_dict /
+    # .pdiparams — they are derived constants, so their values ship
+    # inside the artifact itself
+    persisted = set(layer.state_dict().keys())
+    b_const = {}
+    for name, val in zip(b_names, b_vals):
+        if name not in persisted:
+            arr = np.asarray(val)
+            if str(arr.dtype) == "bfloat16":
+                b_const[name] = ("bfloat16", arr.view(np.uint16))
+            else:
+                b_const[name] = (str(arr.dtype), arr)
     blob = {
         "stablehlo": exp.serialize(),
         "p_names": p_names,
         "b_names": b_names,
+        "b_const": b_const,
     }
     with open(path + ".pdexec", "wb") as f:
         pickle.dump(blob, f, protocol=4)
@@ -404,6 +417,12 @@ class AOTLayer:
             if k in bf16:
                 arr = arr.view(dtypes.bfloat16)
             vals[k] = jnp.asarray(arr)
+        self._persisted = set(vals)
+        # derived (non-persistable) buffers ship inside the artifact
+        for k, (dt, arr) in blob.get("b_const", {}).items():
+            if dt == "bfloat16":
+                arr = arr.view(dtypes.bfloat16)
+            vals[k] = jnp.asarray(arr)
         self._p = [vals[n] for n in blob["p_names"]]
         self._b = [vals[n] for n in blob["b_names"]]
         self._vals = vals
@@ -419,7 +438,10 @@ class AOTLayer:
         return self
 
     def state_dict(self):
-        return {k: Tensor(v) for k, v in self._vals.items()}
+        # mirror the live layer's state_dict: derived (non-persistable)
+        # buffers stay internal, matching what .pdiparams holds
+        return {k: Tensor(v) for k, v in self._vals.items()
+                if k in self._persisted}
 
 
 def load(path, **configs):

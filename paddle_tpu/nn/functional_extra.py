@@ -778,8 +778,22 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
     n_clusters = len(tail_weights)
     cutoffs = list(cutoffs)
     shortlist = cutoffs[0]
+    n_classes = cutoffs[-1]
 
-    args = [_coerce(input), _coerce(label), _coerce(head_weight)]
+    lab_t = _coerce(label)
+    # eager label-range validation (reference raises; a traced label is
+    # clamped inside fn since data-dependent raising can't compile)
+    try:
+        lab_np = np.asarray(lab_t._value)
+        if lab_np.size and (lab_np.min() < 0 or lab_np.max() >= n_classes):
+            raise ValueError(
+                f"adaptive_log_softmax_with_loss: target values must be "
+                f"in [0, {n_classes - 1}], got range "
+                f"[{lab_np.min()}, {lab_np.max()}]")
+    except (TypeError, jax.errors.TracerArrayConversionError):
+        pass  # tracer: no concrete values to validate
+
+    args = [_coerce(input), lab_t, _coerce(head_weight)]
     flat_tails = []
     for pr, cl in tail_weights:
         flat_tails += [_coerce(pr), _coerce(cl)]

@@ -15,7 +15,7 @@ from .api import (
 from . import nn
 
 
-from .. import amp  # noqa: E402  (paddle.static.amp parity alias)
+from . import amp  # noqa: E402  (static-graph amp API, see static/amp.py)
 import contextlib as _ctx
 
 

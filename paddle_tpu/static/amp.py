@@ -1,0 +1,71 @@
+"""paddle.static.amp — the static-graph mixed-precision API surface
+(parity: python/paddle/static/amp/decorator.py). The static decorate
+wraps the OPTIMIZER (unlike dynamic paddle.amp.decorate, which casts
+models); minimize() then runs loss scaling around backward + step."""
+from __future__ import annotations
+
+from ..amp import (auto_cast, amp_guard, GradScaler,  # noqa: F401
+                   is_autocast_enabled, get_autocast_dtype)
+
+
+class CustomOpLists:
+    """Parity: paddle.static.amp.CustomOpLists / AutoMixedPrecisionLists."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+AutoMixedPrecisionLists = CustomOpLists
+
+
+class OptimizerWithMixedPrecision:
+    """The object static decorate() returns: an optimizer whose
+    minimize() applies dynamic loss scaling (GradScaler) around the
+    backward pass, with the amp op lists active during the forward."""
+
+    def __init__(self, optimizer, amp_lists=None, level="O1",
+                 dtype="bfloat16", init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        self._opt = optimizer
+        self._lists = amp_lists or CustomOpLists()
+        self._level = level
+        self._dtype = dtype
+        # bf16 on TPU does not need loss scaling; keep the scaler for
+        # fp16-style configs and API compatibility
+        self._scaler = GradScaler(
+            enable=use_dynamic_loss_scaling and dtype == "float16",
+            init_loss_scaling=init_loss_scaling)
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """Parity no-op: master weights are managed by the optimizer's
+        multi_precision path at step time."""
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        with auto_cast(True, custom_white_list=self._lists.white_list,
+                       custom_black_list=self._lists.black_list,
+                       level=self._level, dtype=self._dtype):
+            scaled = self._scaler.scale(loss)
+        scaled.backward()
+        self._scaler.step(self._opt)
+        self._scaler.update()
+        self._opt.clear_grad()
+        return [], []
+
+
+def decorate(optimizer, amp_lists=None, level="O1", dtype="bfloat16",
+             init_loss_scaling=2.0 ** 15, use_dynamic_loss_scaling=True,
+             **kwargs):
+    """Parity: paddle.static.amp.decorate(optimizer, ...) — wraps the
+    optimizer for mixed-precision minimize()."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists, level=level, dtype=dtype,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling, **kwargs)

@@ -914,3 +914,33 @@ class TestBoundedScanDifferentiability:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0], losses
+
+
+class TestAOTNonPersistableBuffers:
+    """Regression: a model whose forward reads non-persistable buffers
+    (Llama's rope caches) must still AOT-export and reload — the buffer
+    values ship inside the .pdexec artifact, since state_dict (and hence
+    .pdiparams) excludes them."""
+
+    def test_rope_model_aot_roundtrip(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.jit.api import AOTLayer
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.static import InputSpec
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        m.eval()
+        path = str(tmp_path / "llama")
+        paddle.jit.save(m, path,
+                        input_spec=[InputSpec([1, 12], "int64",
+                                              "input_ids")])
+        ids = np.random.RandomState(0).randint(1, 200, (1, 12))
+        out = m(paddle.to_tensor(ids))
+        ref = np.asarray((out[0] if isinstance(out, tuple)
+                          else out).numpy())
+        loaded = paddle.jit.load(path)
+        assert isinstance(loaded, AOTLayer)
+        got = loaded(paddle.to_tensor(ids))
+        got = np.asarray((got[0] if isinstance(got, tuple)
+                          else got).numpy())
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

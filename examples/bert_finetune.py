@@ -32,6 +32,16 @@ def main(argv=None):
     p.add_argument("--model", choices=["bert", "ernie"], default="bert")
     args = p.parse_args(argv)
 
+    if args.smoke:
+        # dev-box mode: force the CPU backend before it initializes
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import nn

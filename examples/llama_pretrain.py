@@ -33,7 +33,18 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=3e-4)
     args = p.parse_args(argv)
 
+    if args.smoke:
+        # dev-box mode: force the CPU backend (with virtual devices for
+        # --dp/--mp) BEFORE the backend initializes — never claims a TPU
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     import jax
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as paddle
     from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
                                    LlamaPretrainingCriterion)

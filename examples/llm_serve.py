@@ -29,7 +29,6 @@ def main():
     ap.add_argument("--max-new", type=int, default=16)
     args = ap.parse_args()
     if args.smoke:
-        os.environ.setdefault("XLA_FLAGS", "")
         import jax
         jax.config.update("jax_platforms", "cpu")
 

@@ -24,7 +24,13 @@ AutoMixedPrecisionLists = CustomOpLists
 class OptimizerWithMixedPrecision:
     """The object static decorate() returns: an optimizer whose
     minimize() applies dynamic loss scaling (GradScaler) around the
-    backward pass, with the amp op lists active during the forward."""
+    backward pass, which runs under the amp op lists.
+
+    Deviation from the reference: upstream static amp rewrites the whole
+    Program's ops at decorate() time. Here the forward has usually
+    already executed by the time minimize(loss) is called, so to cast
+    the forward too, build the model inside `with opt.amp_context():`
+    (the backward pass is always cast)."""
 
     def __init__(self, optimizer, amp_lists=None, level="O1",
                  dtype="bfloat16", init_loss_scaling=2.0 ** 15,
@@ -47,15 +53,21 @@ class OptimizerWithMixedPrecision:
         """Parity no-op: master weights are managed by the optimizer's
         multi_precision path at step time."""
 
+    def amp_context(self):
+        """auto_cast configured with this decoration's op lists — wrap
+        the forward in it to cast the whole step."""
+        return auto_cast(True, custom_white_list=self._lists.white_list,
+                         custom_black_list=self._lists.black_list,
+                         level=self._level, dtype=self._dtype)
+
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        with auto_cast(True, custom_white_list=self._lists.white_list,
-                       custom_black_list=self._lists.black_list,
-                       level=self._level, dtype=self._dtype):
+        with self.amp_context():
             scaled = self._scaler.scale(loss)
-        scaled.backward()
+            scaled.backward()
+        # GradScaler.step() runs update() internally — calling it again
+        # here would double-count good/bad steps
         self._scaler.step(self._opt)
-        self._scaler.update()
         self._opt.clear_grad()
         return [], []
 

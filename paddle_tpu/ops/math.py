@@ -447,9 +447,6 @@ def increment(x, value=1.0, name=None):
     return x
 
 
-def accuracy_like_ops():  # placeholder namespace guard
-    raise NotImplementedError
-
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                 name=None):

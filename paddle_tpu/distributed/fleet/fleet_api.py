@@ -114,6 +114,9 @@ class DistributedModel(Layer):
         """Pipeline/hybrid one-step API (parity: PipelineParallel.
         train_batch). `data` = [inputs..., labels...]."""
         if self._train_step is None:
+            if loss_fn is None:
+                # a PipelineLayer may embed its criterion
+                loss_fn = getattr(self._layers, "_loss_fn", None)
             if loss_fn is None or optimizer is None:
                 raise RuntimeError(
                     "first train_batch needs optimizer and loss_fn (or call "

@@ -3,6 +3,7 @@ TPU-first; SURVEY.md notes the driver configs require Llama/ERNIE-BERT/
 ResNet/SD-UNet capabilities even though their code lives outside the
 reference core repo)."""
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion
+from .llama_pipe import LlamaForCausalLMPipe
 from .bert import BertConfig, BertModel, BertForSequenceClassification
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM
 from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,

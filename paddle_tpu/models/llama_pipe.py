@@ -1,0 +1,139 @@
+"""Pipeline-parallel Llama (ecosystem parity: PaddleNLP
+paddlenlp/transformers/llama/modeling_pp.py LlamaForCausalLMPipe).
+
+The monolithic LlamaForCausalLM decomposes into single-tensor pipeline
+stages for fleet's PipelineLayer engine (scanned shard_map + ppermute
+over the 'stage' axis, meta_parallel/pipeline_parallel.py): embedding ->
+N decoder layers -> final-norm + lm_head. Each decoder stage owns its
+rope trig table (a derived constant — duplicating it per stage costs a
+few KB and keeps stage inputs to ONE activation tensor, which is what
+the p2p handoff wants on TPU)."""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Embedding, Linear
+from ..nn.initializer import Normal
+from ..tensor import Tensor
+from .llama import (LlamaConfig, LlamaDecoderLayer, LlamaRMSNorm,
+                    LlamaPretrainingCriterion, rope_freqs)
+
+__all__ = ["LlamaForCausalLMPipe"]
+
+
+# one trig table per (head_dim, max_pos, theta) — for the 7B config
+# cos+sin is ~4 MB, so per-layer copies would waste ~L*4 MB
+_ROPE_CACHE = {}
+
+
+class _RopeMixin:
+    def _attach_rope(self, config):
+        # plain constants, NOT buffers: the pipeline engine requires
+        # buffer-free stage bodies (PipelineTrainStep threads only
+        # params through the scanned stages); the shared table gets
+        # constant-folded into each stage's XLA program
+        key = (config.hidden_size // config.num_attention_heads,
+               config.max_position_embeddings, config.rope_theta)
+        if key not in _ROPE_CACHE:
+            _ROPE_CACHE[key] = rope_freqs(*key)
+        self._rope_cos, self._rope_sin = _ROPE_CACHE[key]
+
+    def _rope_slice(self, s):
+        return Tensor(self._rope_cos[:s]), Tensor(self._rope_sin[:s])
+
+
+class LlamaEmbeddingPipe(Layer):
+    """Stage 0: token embedding. input_ids -> hidden."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        if config.tensor_parallel:
+            from ..distributed.fleet.meta_parallel.mp_layers import (
+                VocabParallelEmbedding)
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.embed_tokens = Embedding(config.vocab_size,
+                                          config.hidden_size,
+                                          weight_attr=init)
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class LlamaDecoderLayerPipe(Layer, _RopeMixin):
+    """One decoder block as a single-tensor stage (causal, no cache —
+    the pipeline engine is the training path)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.layer = LlamaDecoderLayer(config)
+        self._attach_rope(config)
+
+    def forward(self, hidden_states):
+        s = hidden_states.shape[1]
+        cos, sin = self._rope_slice(s)
+        out, _ = self.layer(hidden_states, cos, sin, None, None, None)
+        return out
+
+
+class LlamaHeadPipe(Layer):
+    """Last stage: final RMSNorm + LM head. hidden -> logits."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = LlamaRMSNorm(config)
+        init = Normal(0.0, config.initializer_range)
+        if config.tensor_parallel:
+            from ..distributed.fleet.meta_parallel.mp_layers import (
+                ColumnParallelLinear)
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, weight_attr=init,
+                has_bias=False, gather_output=False)
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=init, bias_attr=False)
+
+    def forward(self, hidden_states):
+        return self.lm_head(self.norm(hidden_states))
+
+
+def LlamaForCausalLMPipe(config: LlamaConfig, num_stages=None,
+                         num_virtual_pipeline_stages=None,
+                         recompute_interval=0, seg_method="uniform"):
+    """Build the PipelineLayer for Llama causal-LM pretraining.
+
+    Use with fleet (pp_degree > 1):
+        model = fleet.distributed_model(LlamaForCausalLMPipe(cfg))
+        loss = model.train_batch([ids, labels], optimizer=opt)
+    (the embedded LlamaPretrainingCriterion is the default loss_fn;
+    pass loss_fn= to override.)
+
+    The effective stage count comes from the bound mesh's 'stage' axis
+    (fleet pp_degree); num_stages here must match it when a mesh is
+    already initialized.
+    """
+    from ..distributed.fleet.meta_parallel import PipelineLayer
+    from ..distributed.mesh import get_mesh
+    if config.tie_word_embeddings:
+        raise NotImplementedError(
+            "tie_word_embeddings needs SharedLayerDesc weight sharing "
+            "across the first and last pipeline stages; use untied "
+            "embeddings with the pipe model")
+    mesh = get_mesh()
+    if mesh is not None and num_stages is not None:
+        pp = int(mesh.shape.get("stage", 1))
+        if pp != num_stages:
+            raise ValueError(
+                f"num_stages={num_stages} but the bound mesh has "
+                f"stage degree {pp} (fleet pp_degree) — the mesh wins; "
+                "drop num_stages or make them agree")
+    stages = ([LlamaEmbeddingPipe(config)]
+              + [LlamaDecoderLayerPipe(config)
+                 for _ in range(config.num_hidden_layers)]
+              + [LlamaHeadPipe(config)])
+    return PipelineLayer(
+        stages, num_stages=num_stages,
+        num_virtual_pipeline_stages=num_virtual_pipeline_stages,
+        recompute_interval=recompute_interval, seg_method=seg_method,
+        loss_fn=LlamaPretrainingCriterion(config))

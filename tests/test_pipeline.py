@@ -333,3 +333,66 @@ def test_interleaved_virtual_stages_loss_parity(pp, virtual, mb):
     pipe_model.state_dict()
     w_pipe = np.asarray(pipe_model.run_function[2].fc1.weight.numpy())
     assert np.isfinite(w_pipe).all()
+
+
+def test_llama_pipe_parity_with_monolithic():
+    """LlamaForCausalLMPipe (ecosystem parity: PaddleNLP
+    LlamaForCausalLMPipe) = same math as the monolithic model: copy the
+    pipe's weights into LlamaForCausalLM and the first-step loss must
+    match the pipelined train_batch loss."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.mesh import set_mesh
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaForCausalLMPipe,
+                                   LlamaPretrainingCriterion)
+
+    cfg = LlamaConfig.tiny(tensor_parallel=False)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2,
+                               "mp_degree": 1}
+    strategy.pipeline_configs["accumulate_steps"] = 2
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        pipe = fleet.distributed_model(
+            LlamaForCausalLMPipe(cfg, num_stages=2))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters())
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(1, cfg.vocab_size, (4, 32)))
+        crit = LlamaPretrainingCriterion(cfg)
+        psd = {k: np.array(v.numpy())
+               for k, v in pipe.state_dict().items()}
+        l0 = float(pipe.train_batch([ids, ids], optimizer=opt,
+                                    loss_fn=lambda lg, lb: crit(lg, lb)))
+        l1 = float(pipe.train_batch([ids, ids]))
+        assert np.isfinite(l0) and l1 < l0
+
+        # remap pipe keys -> monolithic keys
+        L = cfg.num_hidden_layers
+        mono = LlamaForCausalLM(cfg)
+        remap = {}
+        for k, v in psd.items():
+            parts = k.split(".")
+            idx = int(parts[1])
+            rest = ".".join(parts[2:])
+            if idx == 0:
+                remap["llama." + rest] = v  # embed_tokens.*
+            elif idx == L + 1:
+                if rest.startswith("norm."):
+                    remap["llama." + rest] = v
+                else:
+                    remap[rest] = v         # lm_head.*
+            else:
+                remap[f"llama.layers.{idx - 1}." + rest.replace(
+                    "layer.", "", 1)] = v
+        mono.set_state_dict({k: paddle.to_tensor(v)
+                             for k, v in remap.items()})
+        mono.eval()
+        logits = mono(ids)
+        logits = logits[0] if isinstance(logits, tuple) else logits
+        ref = float(crit(logits, ids))
+        np.testing.assert_allclose(l0, ref, rtol=2e-5)
+    finally:
+        set_mesh(None)

@@ -5,6 +5,7 @@ from .layer_base import Layer
 from . import functional
 from . import initializer
 from . import utils
+from . import quant
 from .initializer import ParamAttr
 from .layers_common import (
     Sequential, LayerList, LayerDict, ParameterList,

@@ -1,0 +1,121 @@
+"""paddle.nn.quant — weight-only quantization for serving (parity:
+python/paddle/nn/quant/quantized_linear.py weight_quantize /
+weight_dequantize / weight_only_linear; upstream phi weight_only_linear
+kernels).
+
+TPU-native design: int8/int4 weights live in HBM at 1/2 - 1/4 the bf16
+footprint; dequantization is expressed as (int -> float cast) * scale
+right before the matmul, which XLA fuses into the dot's operand load —
+the MXU still sees a dense (b)f16 contraction, so there is no custom
+kernel to write, just the storage format."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._dispatch import apply
+from ..ops.creation import _coerce
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def _check_algo(algo):
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported quant algo {algo!r}")
+
+
+def weight_quantize(x, algo="weight_only_int8", group_size=-1):
+    """Per-output-channel absmax quantization of a [in, out] weight.
+    Returns (quantized_weight int8, scale float32 [out]). int4 packs two
+    nibbles per int8 byte along the in dim (row-major pairs)."""
+    _check_algo(algo)
+    w = np.asarray(_coerce(x)._value, np.float32)
+    if group_size not in (-1,):
+        raise NotImplementedError(
+            "grouped scales not implemented; use per-channel (-1)")
+    absmax = np.maximum(np.abs(w).max(axis=0), 1e-8)   # [out]
+    if algo == "weight_only_int4":
+        q = np.clip(np.round(w / absmax * 7.0), -8, 7).astype(np.int8)
+        if q.shape[0] % 2:
+            q = np.concatenate([q, np.zeros((1, q.shape[1]), np.int8)])
+        lo = q[0::2] & 0x0F
+        hi = (q[1::2] & 0x0F) << 4
+        packed = (lo | hi).astype(np.int8)             # [ceil(in/2), out]
+        return Tensor(jnp.asarray(packed)), Tensor(
+            jnp.asarray(absmax / 7.0))
+    q = np.clip(np.round(w / absmax * 127.0), -127, 127).astype(np.int8)
+    return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(absmax / 127.0))
+
+
+def _unpack_int4(packed, in_features=None):
+    """Unpack nibble pairs; `in_features` strips the odd-in-dim pad row
+    the packer added."""
+    lo = (packed & 0x0F).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)               # sign-extend
+    hi = ((packed.astype(jnp.uint8) >> 4) & 0x0F).astype(jnp.int8)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=1)                  # [n, 2, out]
+    out = out.reshape(packed.shape[0] * 2, packed.shape[1])
+    if in_features is not None:
+        out = out[:in_features]
+    return out
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float32"):
+    """Inverse of weight_quantize (float reconstruction). int4 packs in
+    pairs along the in dim, so an odd original in-dim comes back with
+    one trailing zero pad row — slice to the original shape if needed
+    (weight_only_linear strips it automatically)."""
+    _check_algo(algo)
+
+    def fn(q, s):
+        if algo == "weight_only_int4":
+            w = _unpack_int4(q)
+        else:
+            w = q
+        return (w.astype(jnp.float32) * s).astype(out_dtype)
+    return apply(fn, _coerce(x), _coerce(scale), _name="weight_dequant")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", group_size=-1, name=None):
+    """y = x @ dequant(weight) + bias. The dequant-cast-scale chain sits
+    directly on the dot operand so XLA fuses it; weights stay int in
+    HBM (the point of weight-only serving: memory-bandwidth-bound decode
+    reads 1/2 - 1/4 the bytes)."""
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale")
+    if group_size != -1:
+        raise NotImplementedError(
+            "grouped scales not implemented; use per-channel (-1)")
+    args = [_coerce(x), _coerce(weight), _coerce(weight_scale)]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(_coerce(bias))
+    in_features = int(_coerce(x)._value.shape[-1])
+
+    def fn(v, q, s, *rest):
+        if weight_dtype == "int4":
+            w = _unpack_int4(q, in_features)
+        else:
+            w = q
+        w = (w.astype(jnp.float32) * s).astype(v.dtype)
+        y = v @ w
+        if rest:
+            y = y + rest[0]
+        return y
+    return apply(fn, *args, _name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """LLM.int8() style linear (parity: paddle.nn.quant.llm_int8_linear).
+    On TPU the mixed-decomposition trick (outlier columns in fp16) is
+    subsumed by the fused dequant matmul above — implemented as the same
+    computation, keeping the API for ported code."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale,
+                              weight_dtype="int8")

@@ -115,3 +115,67 @@ class TestASP:
             opt.clear_grad()
         assert asp.calculate_density(model[0].weight) <= 0.5 + 1e-6
         asp.reset_excluded_layers()
+
+
+class TestWeightOnlyQuant:
+    def test_int8_roundtrip_and_linear(self):
+        from paddle_tpu.nn import quant
+        rng = np.random.RandomState(0)
+        w = rng.randn(64, 32).astype("float32") * 0.1
+        x = rng.randn(4, 64).astype("float32")
+        qw, sc = quant.weight_quantize(paddle.to_tensor(w))
+        assert str(qw.dtype) == "int8" and tuple(sc.shape) == (32,)
+        wd = quant.weight_dequantize(qw, sc).numpy()
+        assert np.abs(wd - w).max() < np.abs(w).max() / 100
+        y = quant.weight_only_linear(paddle.to_tensor(x), qw,
+                                     weight_scale=sc).numpy()
+        ref = x @ w
+        assert np.abs(y - ref).max() / np.abs(ref).max() < 0.02
+
+    def test_int4_pack_roundtrip(self):
+        from paddle_tpu.nn import quant
+        rng = np.random.RandomState(1)
+        w = rng.randn(16, 8).astype("float32")
+        qw, sc = quant.weight_quantize(paddle.to_tensor(w),
+                                       algo="weight_only_int4")
+        assert tuple(qw.shape) == (8, 8)  # two nibbles per byte
+        wd = quant.weight_dequantize(qw, sc,
+                                     algo="weight_only_int4").numpy()
+        # 4-bit absmax: max error is half a quant step per channel
+        step = np.abs(w).max(axis=0) / 7.0
+        assert (np.abs(wd[:16] - w) <= step / 2 + 1e-6).all()
+        y = quant.weight_only_linear(
+            paddle.to_tensor(rng.randn(2, 16).astype("float32")), qw,
+            weight_scale=sc, weight_dtype="int4")
+        assert tuple(y.shape) == (2, 8)
+
+    def test_weight_only_linear_bias_and_llm_int8(self):
+        from paddle_tpu.nn import quant
+        rng = np.random.RandomState(2)
+        w = rng.randn(32, 16).astype("float32")
+        b = rng.randn(16).astype("float32")
+        x = rng.randn(3, 32).astype("float32")
+        qw, sc = quant.weight_quantize(paddle.to_tensor(w))
+        y = quant.weight_only_linear(paddle.to_tensor(x), qw,
+                                     bias=paddle.to_tensor(b),
+                                     weight_scale=sc).numpy()
+        ref = x @ w + b
+        assert np.abs(y - ref).max() / np.abs(ref).max() < 0.02
+        y2 = quant.llm_int8_linear(paddle.to_tensor(x), qw,
+                                   bias=paddle.to_tensor(b),
+                                   weight_scale=sc).numpy()
+        np.testing.assert_allclose(y, y2)
+
+    def test_int4_odd_in_dim(self):
+        from paddle_tpu.nn import quant
+        rng = np.random.RandomState(3)
+        w = rng.randn(15, 8).astype("float32")
+        x = rng.randn(3, 15).astype("float32")
+        qw, sc = quant.weight_quantize(paddle.to_tensor(w),
+                                       algo="weight_only_int4")
+        y = quant.weight_only_linear(paddle.to_tensor(x), qw,
+                                     weight_scale=sc,
+                                     weight_dtype="int4").numpy()
+        ref = x @ w
+        assert y.shape == ref.shape
+        assert np.abs(y - ref).max() / np.abs(ref).max() < 0.2

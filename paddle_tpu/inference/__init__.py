@@ -235,13 +235,43 @@ class LLMPredictor:
     """
 
     def __init__(self, model, max_batch_size=8, pad_token_id=0,
-                 eos_token_id=None, **generate_defaults):
+                 eos_token_id=None, quant_type=None, **generate_defaults):
         self.model = model
         self.max_batch_size = max_batch_size
         self.pad_token_id = pad_token_id
         self.eos_token_id = eos_token_id
         self.generate_defaults = generate_defaults
         model.eval()
+        if quant_type is not None:
+            self._apply_weight_only(quant_type)
+
+    def _apply_weight_only(self, quant_type):
+        """Round every 2-D projection weight (embeddings excluded)
+        through weight-only quantization (parity: PaddleNLP predictor
+        --quant_type weight_only_int8/int4). The decode loop then reads
+        the quantization-error-bearing weights; on TPU the int storage
+        is realized by the serving artifact, so here the *numerics* of
+        the quantized checkpoint are what's reproduced."""
+        from ..nn.quant import weight_quantize, weight_dequantize
+        from ..nn.layers_common import Embedding
+        from ..distributed.fleet.meta_parallel.mp_layers import (
+            VocabParallelEmbedding)
+        algo = {"int8": "weight_only_int8", "int4": "weight_only_int4",
+                "weight_only_int8": "weight_only_int8",
+                "weight_only_int4": "weight_only_int4"}.get(quant_type)
+        if algo is None:
+            raise ValueError(f"unsupported quant_type {quant_type!r}")
+        for name, layer in self.model.named_sublayers():
+            w = getattr(layer, "weight", None)
+            if (w is None or w.ndim != 2
+                    or isinstance(layer, (Embedding,
+                                          VocabParallelEmbedding))):
+                continue  # embeddings quantize on the wrong axis
+            qw, sc = weight_quantize(w, algo=algo)
+            deq = weight_dequantize(qw, sc, algo=algo)
+            if algo == "weight_only_int4":
+                deq = deq[:int(w.shape[0])]
+            w.set_value(deq.astype(str(w.dtype)))
 
     @staticmethod
     def _bucket(n):

@@ -350,3 +350,36 @@ class TestBeamSearch:
         with pytest.raises(ValueError, match="num_beams"):
             m.generate(ids, max_new_tokens=2,
                        decode_strategy="sampling", num_beams=3)
+
+
+class TestQuantizedPredictor:
+    def test_llm_predictor_weight_only(self):
+        import numpy as np
+        import pytest
+        import paddle_tpu as paddle
+        from paddle_tpu.inference import LLMPredictor
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        w_proj_ref = np.array(
+            m.llama.layers[0].self_attn.q_proj.weight.numpy())
+        w_emb_ref = np.array(m.llama.embed_tokens.weight.numpy())
+        paddle.seed(0)
+        m2 = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        q = LLMPredictor(m2, quant_type="weight_only_int8", seed=0)
+        # quantization actually happened: projections changed (rounded
+        # through int8), embeddings untouched
+        w_proj = m2.llama.layers[0].self_attn.q_proj.weight.numpy()
+        assert np.abs(w_proj - w_proj_ref).max() > 0
+        np.testing.assert_allclose(w_proj, w_proj_ref, atol=2e-3)
+        np.testing.assert_array_equal(
+            m2.llama.embed_tokens.weight.numpy(), w_emb_ref)
+        out = q.generate([[5, 9, 23]], max_new_tokens=4)
+        assert len(out[0]) == 4
+        # int8 weight error rarely flips the greedy argmax on a tiny
+        # model; identical prefixes are expected but not guaranteed —
+        # assert structure + determinism instead
+        out2 = q.generate([[5, 9, 23]], max_new_tokens=4)
+        assert out == out2
+        with pytest.raises(ValueError, match="quant_type"):
+            LLMPredictor(m2, quant_type="fp4")

@@ -321,3 +321,77 @@ class LLMPredictor:
                 toks = toks[:toks.index(eos)]
             decoded.append(toks)
         return decoded
+
+
+class SpeculativePredictor:
+    """Greedy speculative decoding (reference parity: PaddleNLP
+    predictor speculate_method draft_model / upstream fused speculative
+    decode). A small draft model proposes `gamma` tokens; the target
+    model verifies them all with ONE forward pass and accepts the
+    longest matching prefix plus its own correction token.
+
+    With greedy acceptance the output is BITWISE IDENTICAL to plain
+    greedy decoding of the target model — the draft only changes how
+    many target forwards are needed (1 per accepted run instead of 1
+    per token). TPU framing: each verify is a batched prefill-shaped
+    matmul-heavy forward (MXU-friendly), replacing gamma bandwidth-bound
+    single-token decode steps."""
+
+    def __init__(self, model, draft_model, gamma=4, eos_token_id=None):
+        self.model = model
+        self.draft = draft_model
+        self.gamma = int(gamma)
+        self.eos_token_id = eos_token_id
+        model.eval()
+        draft_model.eval()
+        self.stats = {"target_calls": 0, "accepted": 0, "proposed": 0}
+
+    @staticmethod
+    def _greedy_next(model, ids_np, last_only=False):
+        """argmax of the logits; [B, S] int32, or [B] when last_only
+        (draft steps need only the final position — avoids shipping the
+        whole [S, V] logits array to host per proposed token)."""
+        with no_grad():
+            out = model(Tensor(jnp.asarray(ids_np, jnp.int32)))
+        logits = (out[0] if isinstance(out, tuple) else out)._value
+        if last_only:
+            return np.argmax(np.asarray(logits[:, -1]), axis=-1)
+        return np.argmax(np.asarray(logits), axis=-1)
+
+    def generate(self, prompt, max_new_tokens=32):
+        """Single-sequence greedy speculative decode.
+        prompt: List[int] -> List[int] (new tokens)."""
+        cur = list(prompt)
+        new = []
+        while len(new) < max_new_tokens:
+            g = min(self.gamma, max_new_tokens - len(new))
+            # draft proposes g tokens autoregressively (greedy)
+            d_cur = list(cur)
+            proposal = []
+            for _ in range(g):
+                nxt = int(self._greedy_next(self.draft,
+                                            np.asarray([d_cur]),
+                                            last_only=True)[0])
+                proposal.append(nxt)
+                d_cur.append(nxt)
+            # one target forward verifies all proposals
+            verify = np.asarray([cur + proposal])
+            tgt = self._greedy_next(self.model, verify)[0]
+            self.stats["target_calls"] += 1
+            self.stats["proposed"] += g
+            base = len(cur) - 1   # tgt[base] = target's next after cur
+            accepted = 0
+            while (accepted < g
+                   and proposal[accepted] == int(tgt[base + accepted])):
+                accepted += 1
+            self.stats["accepted"] += accepted
+            # accepted prefix + the target's own next token
+            emit = proposal[:accepted] + [int(tgt[base + accepted])]
+            for t in emit:
+                if len(new) >= max_new_tokens:
+                    break
+                new.append(t)
+                cur.append(t)
+                if self.eos_token_id is not None and t == self.eos_token_id:
+                    return new
+        return new

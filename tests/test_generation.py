@@ -383,3 +383,45 @@ class TestQuantizedPredictor:
         assert out == out2
         with pytest.raises(ValueError, match="quant_type"):
             LLMPredictor(m2, quant_type="fp4")
+
+
+class TestSpeculativeDecoding:
+    def test_exact_greedy_parity_and_fewer_calls(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.inference import LLMPredictor, SpeculativePredictor
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        target = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        paddle.seed(1)
+        draft = LlamaForCausalLM(LlamaConfig(
+            vocab_size=256, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=2, max_position_embeddings=512,
+            tensor_parallel=False))
+        prompt = [5, 9, 23, 7]
+        ref = LLMPredictor(target, seed=0).generate(
+            [prompt], max_new_tokens=10,
+            decode_strategy="greedy_search")[0]
+        # arbitrary draft: output must STILL be exactly target-greedy
+        spec = SpeculativePredictor(target, draft, gamma=4)
+        assert spec.generate(prompt, max_new_tokens=10) == ref
+        # perfect draft (target as its own draft): every proposal
+        # accepted, so ~N/(gamma+1) target calls instead of N
+        spec2 = SpeculativePredictor(target, target, gamma=4)
+        assert spec2.generate(prompt, max_new_tokens=10) == ref
+        assert spec2.stats["target_calls"] <= 3
+        assert spec2.stats["accepted"] == spec2.stats["proposed"]
+
+    def test_speculative_eos_stops(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.inference import SpeculativePredictor
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        # pick the model's own first greedy token as "eos" to force a stop
+        spec = SpeculativePredictor(m, m, gamma=3)
+        first = spec.generate([5, 9], max_new_tokens=1)[0]
+        spec2 = SpeculativePredictor(m, m, gamma=3, eos_token_id=first)
+        out = spec2.generate([5, 9], max_new_tokens=8)
+        assert out[-1] == first and len(out) <= 8

@@ -10,7 +10,9 @@ Steps:
   2. reload it in-process through inference.create_predictor (the same
      loader a fresh serving process uses — no model class, no retrace);
   3. run batched beam-search + sampling generation on the live model
-     (the static-cache decode loop, one compiled program per shape).
+     (the static-cache decode loop, one compiled program per shape);
+  4. serve with weight-only int8 quantized projections, and run greedy
+     speculative decoding with a small draft model.
 """
 from __future__ import annotations
 
@@ -72,6 +74,34 @@ def main():
         paddle.to_tensor(prompts), max_new_tokens=args.max_new,
         decode_strategy="sampling", top_p=0.9, temperature=0.8, seed=0)
     print(f"sampling: {sample_out.shape}")
+
+    # -- 4) weight-only int8 serving + speculative decoding ---------------
+    from paddle_tpu.inference import LLMPredictor, SpeculativePredictor
+    paddle.seed(0)
+    m8 = LlamaForCausalLM(cfg)
+    pred8 = LLMPredictor(m8, quant_type="weight_only_int8",
+                         eos_token_id=2)
+    toks = pred8.generate([[5, 9, 23], [7, 11, 9, 14]],
+                          max_new_tokens=8)
+    print(f"weight-only int8 predictor: {[len(t) for t in toks]}")
+
+    # a genuinely smaller draft: 1 layer, quarter width — the accept
+    # rate then reflects real draft/target agreement
+    paddle.seed(0)
+    draft = LlamaForCausalLM(LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size // 4,
+        intermediate_size=cfg.intermediate_size // 4,
+        num_hidden_layers=1,
+        num_attention_heads=max(cfg.num_attention_heads // 4, 1),
+        num_key_value_heads=max(cfg.num_key_value_heads // 4, 1),
+        max_position_embeddings=cfg.max_position_embeddings,
+        tensor_parallel=False))
+    spec = SpeculativePredictor(model, draft, gamma=4)
+    out = spec.generate([5, 9, 23, 7], max_new_tokens=12)
+    calls = spec.stats["target_calls"]
+    print(f"speculative decode: {len(out)} tokens in {calls} target "
+          f"calls (accept rate "
+          f"{spec.stats['accepted'] / max(spec.stats['proposed'], 1):.2f})")
     print("OK")
 
 

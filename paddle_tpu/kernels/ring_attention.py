@@ -50,7 +50,8 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 # Ring attention core (runs INSIDE shard_map; local shards [B, Sl, H, D])
 # ---------------------------------------------------------------------------
 
-def _ring_attention_local_zigzag(q, k, v, *, axis_name, cp, scale):
+def _ring_attention_local_zigzag(q, k, v, kv_lens=None, *, axis_name,
+                                 cp, scale):
     """Causal ring attention over the zig-zag layout: local shard = global
     chunks (idx, 2cp-1-idx). Each ring step processes the 2x2 sub-chunk
     grid, and a sub-block runs only when its q chunk is causally at-or-
@@ -70,6 +71,9 @@ def _ring_attention_local_zigzag(q, k, v, *, axis_name, cp, scale):
         s = jnp.einsum("bqhd,bkhd->bhqk", qh, k_sub.astype(jnp.float32),
                        preferred_element_type=jnp.float32) * scale
         s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
+        if kv_lens is not None:
+            s = jnp.where(k_pos[None, None, None, :]
+                          < kv_lens[:, None, None, None], s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new[..., None])
@@ -129,7 +133,8 @@ def _ring_attention_local_zigzag(q, k, v, *, axis_name, cp, scale):
     return jnp.concatenate(outs, axis=1).astype(q.dtype)
 
 
-def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
+def _ring_attention_local(q, k, v, kv_lens=None, *, axis_name, cp,
+                          causal, scale):
     """Blockwise online-softmax attention with the K/V shard rotating
     around the `axis_name` ring (contiguous sequence layout; the causal
     zig-zag layout has its own kernel above). All accumulation in f32.
@@ -152,10 +157,15 @@ def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
         ring rank `src`."""
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
                        preferred_element_type=jnp.float32) * scale
+        k_pos = src * k.shape[1] + jnp.arange(k.shape[1], dtype=jnp.int32)
         if causal:
-            k_pos = src * k.shape[1] + jnp.arange(k.shape[1],
-                                                  dtype=jnp.int32)
             s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
+        if kv_lens is not None:
+            # varlen padded batch: keys at-or-past a row's true length
+            # never enter the softmax (global positions, so the mask is
+            # exact regardless of which ring rank holds the block)
+            s = jnp.where(k_pos[None, None, None, :]
+                          < kv_lens[:, None, None, None], s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new[..., None])
@@ -199,7 +209,8 @@ def _ring_attention_local(q, k, v, *, axis_name, cp, causal, scale):
 
 
 def ring_attention_jax(query, key, value, *, causal=False, scale=None,
-                       axis_name="context", mesh=None, zigzag=None):
+                       axis_name="context", mesh=None, zigzag=None,
+                       kv_lens=None):
     """Pure-jax ring attention. [B, S, H, D] GLOBAL arrays; the sequence
     dim is sharded over `axis_name` by the shard_map. Falls back to plain
     flash attention when the axis is trivial.
@@ -213,9 +224,13 @@ def ring_attention_jax(query, key, value, *, causal=False, scale=None,
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
     if mesh is None or cp <= 1:
         from .attention import flash_attention_jax
-        return flash_attention_jax(query, key, value, causal=causal, scale=sc)
+        return flash_attention_jax(query, key, value, causal=causal,
+                                   scale=sc, kv_lens=kv_lens)
 
     spec = P(None, axis_name, None, None)
+    lens_spec = P(None)
+    if kv_lens is not None:
+        kv_lens = jnp.asarray(kv_lens, jnp.int32)
     S = query.shape[1]
     if zigzag is None:
         zigzag = causal and S % (2 * cp) == 0
@@ -234,27 +249,41 @@ def ring_attention_jax(query, key, value, *, causal=False, scale=None,
                     .reshape((b, s) + x.shape[2:])
 
         qz, kz, vz = (permute(x, order) for x in (query, key, value))
+        # NOTE: zig-zag permutes SEQUENCE positions, but kv_lens masking
+        # uses the pre-permutation global positions, which sub_update
+        # reconstructs from chunk ids — so the mask stays exact
 
-        def local(q, k, v):
+        def local(q, k, v, *rest):
             return _ring_attention_local_zigzag(
-                q, k, v, axis_name=axis_name, cp=cp, scale=sc)
+                q, k, v, rest[0] if rest else None,
+                axis_name=axis_name, cp=cp, scale=sc)
 
-        out = _shard_map(local, mesh, (spec, spec, spec), spec)(qz, kz, vz)
+        args = [qz, kz, vz]
+        in_specs = [spec, spec, spec]
+        if kv_lens is not None:
+            args.append(kv_lens)
+            in_specs.append(lens_spec)
+        out = _shard_map(local, mesh, tuple(in_specs), spec)(*args)
         return permute(out, inv)
 
-    def local(q, k, v):
-        return _ring_attention_local(q, k, v, axis_name=axis_name, cp=cp,
-                                     causal=causal, scale=sc)
+    def local(q, k, v, *rest):
+        return _ring_attention_local(
+            q, k, v, rest[0] if rest else None, axis_name=axis_name,
+            cp=cp, causal=causal, scale=sc)
 
-    return _shard_map(local, mesh, (spec, spec, spec), spec)(
-        query, key, value)
+    args = [query, key, value]
+    in_specs = [spec, spec, spec]
+    if kv_lens is not None:
+        args.append(kv_lens)
+        in_specs.append(lens_spec)
+    return _shard_map(local, mesh, tuple(in_specs), spec)(*args)
 
 
 # ---------------------------------------------------------------------------
 # Ulysses (DeepSpeed-style) sequence parallelism: two all_to_alls
 # ---------------------------------------------------------------------------
 
-def _ulysses_local(q, k, v, *, axis_name, causal, scale):
+def _ulysses_local(q, k, v, kv_lens=None, *, axis_name, causal, scale):
     """Local shards [B, Sl, H, D] -> a2a -> full-seq [B, S, H/cp, D] ->
     attention -> a2a back."""
     def seq2head(x):
@@ -268,12 +297,13 @@ def _ulysses_local(q, k, v, *, axis_name, causal, scale):
 
     from .attention import flash_attention_jax
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
-    out = flash_attention_jax(qh, kh, vh, causal=causal, scale=scale)
+    out = flash_attention_jax(qh, kh, vh, causal=causal, scale=scale,
+                              kv_lens=kv_lens)
     return head2seq(out)
 
 
 def ulysses_attention_jax(query, key, value, *, causal=False, scale=None,
-                          axis_name="context", mesh=None):
+                          axis_name="context", mesh=None, kv_lens=None):
     """Ulysses attention on GLOBAL [B, S, H, D] arrays (seq sharded over
     `axis_name` inside). Requires num_heads % cp == 0."""
     mesh = mesh or get_mesh()
@@ -282,7 +312,8 @@ def ulysses_attention_jax(query, key, value, *, causal=False, scale=None,
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
     if mesh is None or cp <= 1:
         from .attention import flash_attention_jax
-        return flash_attention_jax(query, key, value, causal=causal, scale=sc)
+        return flash_attention_jax(query, key, value, causal=causal,
+                                   scale=sc, kv_lens=kv_lens)
     if query.shape[2] % cp:
         raise ValueError(
             f"ulysses: num_heads {query.shape[2]} not divisible by "
@@ -290,37 +321,47 @@ def ulysses_attention_jax(query, key, value, *, causal=False, scale=None,
 
     spec = P(None, axis_name, None, None)
 
-    def local(q, k, v):
-        return _ulysses_local(q, k, v, axis_name=axis_name, causal=causal,
+    def local(q, k, v, *rest):
+        return _ulysses_local(q, k, v, rest[0] if rest else None,
+                              axis_name=axis_name, causal=causal,
                               scale=sc)
 
-    return _shard_map(local, mesh, (spec, spec, spec), spec)(
-        query, key, value)
+    args = [query, key, value]
+    in_specs = [spec, spec, spec]
+    if kv_lens is not None:
+        args.append(jnp.asarray(kv_lens, jnp.int32))
+        in_specs.append(P(None))
+    return _shard_map(local, mesh, tuple(in_specs), spec)(*args)
 
 
 # ---------------------------------------------------------------------------
 # Tensor-level API (tape-aware) — PaddleNLP RingFlashAttention parity
 # ---------------------------------------------------------------------------
 
-def _tensor_entry(fn_jax, query, key, value, causal, scale, group):
+def _tensor_entry(fn_jax, query, key, value, causal, scale, group,
+                  kv_lens=None):
     from ..ops._dispatch import apply
     from ..ops.creation import _coerce
 
     axis_name = getattr(group, "axis", None) or "context"
+    args = [_coerce(query), _coerce(key), _coerce(value)]
+    if kv_lens is not None:
+        args.append(_coerce(kv_lens))
 
-    def fn(q, k, v):
+    def fn(q, k, v, *rest):
         return fn_jax(q, k, v, causal=causal, scale=scale,
-                      axis_name=axis_name)
+                      axis_name=axis_name,
+                      kv_lens=rest[0] if rest else None)
 
-    return apply(fn, _coerce(query), _coerce(key), _coerce(value),
-                 _name="ring_attention")
+    return apply(fn, *args, _name="ring_attention")
 
 
 def _check_unsupported(attn_mask, dropout):
     if attn_mask is not None:
         raise NotImplementedError(
-            "ring/Ulysses attention does not support attn_mask yet; use "
-            "is_causal= for causal masking")
+            "ring/Ulysses attention supports causal masking (is_causal=) "
+            "and varlen padded batches (kv_lens=[B] lengths); arbitrary "
+            "dense attn_mask tensors are not supported")
     if dropout:
         raise NotImplementedError(
             "ring/Ulysses attention does not support dropout yet")
@@ -332,24 +373,25 @@ class RingFlashAttention:
 
     @staticmethod
     def apply(query, key, value, group=None, is_causal=True, scale=None,
-              attn_mask=None, dropout=0.0):
+              attn_mask=None, dropout=0.0, kv_lens=None):
         _check_unsupported(attn_mask, dropout)
         return _tensor_entry(ring_attention_jax, query, key, value,
-                             is_causal, scale, group)
+                             is_causal, scale, group, kv_lens=kv_lens)
 
 
 class UlyssesAttention:
     @staticmethod
     def apply(query, key, value, group=None, is_causal=True, scale=None,
-              attn_mask=None, dropout=0.0):
+              attn_mask=None, dropout=0.0, kv_lens=None):
         _check_unsupported(attn_mask, dropout)
         return _tensor_entry(ulysses_attention_jax, query, key, value,
-                             is_causal, scale, group)
+                             is_causal, scale, group, kv_lens=kv_lens)
 
 
 def ring_flash_attention(query, key, value, is_causal=True, scale=None,
-                         group=None):
+                         group=None, kv_lens=None):
     return RingFlashAttention.apply(query, key, value, group=group,
+                                    kv_lens=kv_lens,
                                     is_causal=is_causal, scale=scale)
 
 

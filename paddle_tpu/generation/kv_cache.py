@@ -42,3 +42,22 @@ class StaticKVCache:
 
     def __iter__(self):
         return iter(self.entries)
+
+
+def static_cache_update(entry: StaticCacheEntry, k, v):
+    """Write K/V ([B, s, H, D] Tensors) into the static cache at
+    entry.pos (lax.dynamic_update_slice) — THE cache-write contract,
+    shared by every model family's attention."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops._dispatch import apply
+
+    def upd(cache, new, p):
+        z = jnp.int32(0)
+        return jax.lax.dynamic_update_slice(
+            cache, new.astype(cache.dtype),
+            (z, p.astype(jnp.int32), z, z))
+
+    k_new = apply(upd, entry.k, k, entry.pos, _name="kv_cache_update")
+    v_new = apply(upd, entry.v, v, entry.pos, _name="kv_cache_update")
+    return k_new, v_new, StaticCacheEntry(k_new, v_new, entry.pos)

@@ -46,6 +46,22 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                    check_rep=False)
 
 
+def _sharded_attn(local_core, mesh, spec, q, k, v, kv_lens, lens_spec,
+                  **core_kw):
+    """One shard_map entry for all ring/Ulysses variants: builds the
+    operand + in_specs lists (kv_lens optional) exactly once."""
+    def local(q, k, v, *rest):
+        return local_core(q, k, v, rest[0] if rest else None, **core_kw)
+
+    args = [q, k, v]
+    in_specs = [spec, spec, spec]
+    if kv_lens is not None:
+        args.append(jnp.asarray(kv_lens, jnp.int32))
+        in_specs.append(lens_spec)
+    return _shard_map(local, mesh, tuple(in_specs), spec)(*args)
+
+
+
 # ---------------------------------------------------------------------------
 # Ring attention core (runs INSIDE shard_map; local shards [B, Sl, H, D])
 # ---------------------------------------------------------------------------
@@ -253,30 +269,15 @@ def ring_attention_jax(query, key, value, *, causal=False, scale=None,
         # uses the pre-permutation global positions, which sub_update
         # reconstructs from chunk ids — so the mask stays exact
 
-        def local(q, k, v, *rest):
-            return _ring_attention_local_zigzag(
-                q, k, v, rest[0] if rest else None,
-                axis_name=axis_name, cp=cp, scale=sc)
-
-        args = [qz, kz, vz]
-        in_specs = [spec, spec, spec]
-        if kv_lens is not None:
-            args.append(kv_lens)
-            in_specs.append(lens_spec)
-        out = _shard_map(local, mesh, tuple(in_specs), spec)(*args)
+        out = _sharded_attn(_ring_attention_local_zigzag, mesh, spec,
+                            qz, kz, vz, kv_lens, lens_spec,
+                            axis_name=axis_name, cp=cp, scale=sc)
         return permute(out, inv)
 
-    def local(q, k, v, *rest):
-        return _ring_attention_local(
-            q, k, v, rest[0] if rest else None, axis_name=axis_name,
-            cp=cp, causal=causal, scale=sc)
-
-    args = [query, key, value]
-    in_specs = [spec, spec, spec]
-    if kv_lens is not None:
-        args.append(kv_lens)
-        in_specs.append(lens_spec)
-    return _shard_map(local, mesh, tuple(in_specs), spec)(*args)
+    return _sharded_attn(_ring_attention_local, mesh, spec,
+                         query, key, value, kv_lens, lens_spec,
+                         axis_name=axis_name, cp=cp, causal=causal,
+                         scale=sc)
 
 
 # ---------------------------------------------------------------------------
@@ -320,18 +321,9 @@ def ulysses_attention_jax(query, key, value, *, causal=False, scale=None,
             f"context-parallel degree {cp}")
 
     spec = P(None, axis_name, None, None)
-
-    def local(q, k, v, *rest):
-        return _ulysses_local(q, k, v, rest[0] if rest else None,
-                              axis_name=axis_name, causal=causal,
-                              scale=sc)
-
-    args = [query, key, value]
-    in_specs = [spec, spec, spec]
-    if kv_lens is not None:
-        args.append(jnp.asarray(kv_lens, jnp.int32))
-        in_specs.append(P(None))
-    return _shard_map(local, mesh, tuple(in_specs), spec)(*args)
+    return _sharded_attn(_ulysses_local, mesh, spec, query, key, value,
+                         kv_lens, P(None), axis_name=axis_name,
+                         causal=causal, scale=sc)
 
 
 # ---------------------------------------------------------------------------

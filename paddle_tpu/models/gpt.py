@@ -1,6 +1,7 @@
 """GPT family (ecosystem parity: paddlenlp/transformers/gpt/modeling.py) —
 decoder-only with learned positions; exercises the same TP layers as
-Llama with LayerNorm+GELU instead of RMSNorm+SwiGLU."""
+Llama with LayerNorm+GELU instead of RMSNorm+SwiGLU. Supports the jitted
+static-KV-cache generation loop (generation/__init__.py) like Llama."""
 from __future__ import annotations
 
 from dataclasses import dataclass
@@ -12,6 +13,8 @@ from ..nn.initializer import Normal
 from ..ops import manipulation as M
 from ..ops import creation as C
 from ..generation import GenerationMixin
+from ..generation.kv_cache import (StaticCacheEntry, StaticKVCache,
+                                   static_cache_update)
 from ..distributed.fleet.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     parallel_matmul)
@@ -68,19 +71,32 @@ class GPTBlock(Layer):
         self.attn_drop = config.attention_probs_dropout_prob
         self.drop = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, attn_mask=None, past_key_value=None):
         b, s, h = x.shape
         y = self.ln1(x)
         qkv = M.reshape(self.qkv(y), [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = M.unbind(qkv, axis=2)
+
+        if isinstance(past_key_value, StaticCacheEntry):
+            # static-shape decode cache: in-place write at `pos`
+            k, v, new_cache = static_cache_update(past_key_value, k, v)
+        elif past_key_value is not None:
+            # HF/PaddleNLP-style tuple cache: grow by concatenation
+            k = M.concat([past_key_value[0], k], axis=1)
+            v = M.concat([past_key_value[1], v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = (k, v)
+
+        causal = past_key_value is None
         att = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.attn_drop,
-            training=self.training)
+            q, k, v, attn_mask=attn_mask, is_causal=causal,
+            dropout_p=self.attn_drop, training=self.training)
         att = M.reshape(att, [b, s, h])
         x = x + self.drop(self.proj(att))
         y = self.ln2(x)
         y = self.fc2(F.gelu(self.fc1(y), approximate=True))
-        return x + self.drop(y)
+        return x + self.drop(y), new_cache
 
 
 class GPTModel(Layer):
@@ -102,21 +118,48 @@ class GPTModel(Layer):
                             for _ in range(config.num_hidden_layers)])
         self.ln_f = LayerNorm(config.hidden_size)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                past_key_values=None, use_cache=False):
         s = input_ids.shape[1]
-        pos = C.arange(s, dtype="int64")
+        if position_ids is not None:
+            pos = position_ids
+        else:
+            past_len = 0
+            if (past_key_values is not None
+                    and not isinstance(past_key_values, StaticKVCache)
+                    and past_key_values[0] is not None):
+                past_len = past_key_values[0][0].shape[1]
+            pos = C.arange(past_len, past_len + s, dtype="int64")
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for block in self.h:
-            x = block(x)
-        return self.ln_f(x)
+        caches = []
+        for i, block in enumerate(self.h):
+            pkv = past_key_values[i] if past_key_values is not None else None
+            x, cache = block(x, attn_mask=attn_mask, past_key_value=pkv)
+            caches.append(cache)
+        x = self.ln_f(x)
+        if use_cache:
+            return x, caches
+        return x
 
 
 class GPTForCausalLM(Layer, GenerationMixin):
+    supports_static_cache = True
+
     def __init__(self, config: GPTConfig):
         super().__init__()
+        self.config = config
         self.gpt = GPTModel(config)
 
-    def forward(self, input_ids):
-        h = self.gpt(input_ids)
-        return parallel_matmul(h, self.gpt.wte.weight, transpose_y=True,
-                               tensor_parallel_output=False)
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                past_key_values=None, use_cache=False):
+        out = self.gpt(input_ids, attn_mask, position_ids,
+                       past_key_values, use_cache)
+        if use_cache:
+            h, caches = out
+        else:
+            h = out
+        logits = parallel_matmul(h, self.gpt.wte.weight, transpose_y=True,
+                                 tensor_parallel_output=False)
+        if use_cache:
+            return logits, caches
+        return logits

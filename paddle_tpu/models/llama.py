@@ -134,19 +134,10 @@ class LlamaAttention(Layer):
         q, k = apply(rope_fn, q, k, cos, sin, _name="fused_rope")
 
         if isinstance(past_key_value, StaticCacheEntry):
-            # static-shape decode cache: write K/V in place at `pos`
-            # (one XLA program per step — see generation/kv_cache.py)
-            def upd(cache, new, p):
-                import jax
-                z = jnp.int32(0)
-                return jax.lax.dynamic_update_slice(
-                    cache, new.astype(cache.dtype),
-                    (z, p.astype(jnp.int32), z, z))
-            k = apply(upd, past_key_value.k, k, past_key_value.pos,
-                      _name="kv_cache_update")
-            v = apply(upd, past_key_value.v, v, past_key_value.pos,
-                      _name="kv_cache_update")
-            new_cache = StaticCacheEntry(k, v, past_key_value.pos)
+            # static-shape decode cache: in-place write at `pos` (shared
+            # contract — generation/kv_cache.py static_cache_update)
+            from ..generation.kv_cache import static_cache_update
+            k, v, new_cache = static_cache_update(past_key_value, k, v)
         elif past_key_value is not None:
             k = M.concat([past_key_value[0], k], axis=1)
             v = M.concat([past_key_value[1], v], axis=1)

@@ -95,15 +95,67 @@ class TestSampling:
 
 
 class TestEagerFallback:
-    def test_gpt_generates_via_fallback(self):
-        from paddle_tpu.models import GPTForCausalLM
+    def test_plain_model_generates_via_fallback(self):
+        # a model WITHOUT the static-cache protocol uses the eager loop
+        from paddle_tpu import nn
+        from paddle_tpu.generation import GenerationMixin
+
+        class TinyLM(nn.Layer, GenerationMixin):
+            class _Cfg:
+                vocab_size = 64
+            config = _Cfg()
+
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(64, 16)
+                self.out = nn.Linear(16, 64)
+
+            def forward(self, input_ids):
+                return self.out(self.emb(input_ids))
+
         paddle.seed(0)
-        m = GPTForCausalLM(GPTConfig.tiny(tensor_parallel=False))
+        m = TinyLM()
         m.eval()
         assert not m.supports_static_cache
         ids = np.random.RandomState(0).randint(5, 50, (2, 6))
         out, _ = m.generate(ids, max_new_tokens=4)
         assert out.shape == [2, 4]
+
+    def test_gpt_static_cache_matches_eager(self):
+        from paddle_tpu.models import GPTForCausalLM
+        paddle.seed(0)
+        m = GPTForCausalLM(GPTConfig.tiny(tensor_parallel=False))
+        m.eval()
+        assert m.supports_static_cache
+        ids = np.random.RandomState(0).randint(5, 500, (2, 9))
+        s, _ = m.generate(ids, max_new_tokens=6)
+        e, _ = m.generate(ids, max_new_tokens=6, use_cache=False)
+        np.testing.assert_array_equal(s.numpy(), e.numpy())
+        # ragged batch row = solo run
+        mask = np.ones_like(ids)
+        mask[1, :4] = 0
+        rb, _ = m.generate(ids, attention_mask=mask, max_new_tokens=5)
+        solo, _ = m.generate(ids[1][mask[1].astype(bool)][None],
+                             max_new_tokens=5)
+        np.testing.assert_array_equal(rb.numpy()[1], solo.numpy()[0])
+
+    def test_gpt_tuple_cache_incremental_decode(self):
+        # manual HF-style incremental decoding with tuple caches must
+        # match the full forward's last-position logits
+        from paddle_tpu.models import GPTForCausalLM
+        paddle.seed(0)
+        m = GPTForCausalLM(GPTConfig.tiny(tensor_parallel=False))
+        m.eval()
+        ids = np.random.RandomState(1).randint(5, 500, (1, 7))
+        full = m(paddle.to_tensor(ids))
+        full = (full[0] if isinstance(full, tuple) else full).numpy()
+        # prefill on the first 4, then decode 3 tokens one at a time
+        logits, caches = m(paddle.to_tensor(ids[:, :4]), use_cache=True)
+        for t in range(4, 7):
+            logits, caches = m(paddle.to_tensor(ids[:, t:t + 1]),
+                               past_key_values=caches, use_cache=True)
+            np.testing.assert_allclose(logits.numpy()[:, -1],
+                                       full[:, t], atol=2e-4)
 
 
 class TestPagedAttention:
@@ -425,3 +477,4 @@ class TestSpeculativeDecoding:
         spec2 = SpeculativePredictor(m, m, gamma=3, eos_token_id=first)
         out = spec2.generate([5, 9], max_new_tokens=8)
         assert out[-1] == first and len(out) <= 8
+

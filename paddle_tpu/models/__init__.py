@@ -4,7 +4,9 @@ ResNet/SD-UNet capabilities even though their code lives outside the
 reference core repo)."""
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion
 from .llama_pipe import LlamaForCausalLMPipe
-from .bert import BertConfig, BertModel, BertForSequenceClassification
+from .bert import (BertConfig, BertModel, BertForSequenceClassification,
+                   BertForTokenClassification, BertForQuestionAnswering,
+                   BertForMaskedLM, BertForPretraining)
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM
 from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,
                     ErnieForTokenClassification, ErnieForQuestionAnswering)

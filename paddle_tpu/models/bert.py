@@ -10,6 +10,7 @@ from ..nn.layers_common import Embedding, Linear, LayerNorm, Dropout, LayerList
 from ..nn.transformer import TransformerEncoderLayer, TransformerEncoder
 from ..nn import functional as F
 from ..nn.initializer import Normal
+from ..ops import linalg as L
 from ..ops import manipulation as M
 from ..ops import creation as C
 
@@ -118,6 +119,96 @@ class BertForSequenceClassification(Layer):
         _, pooled = self.bert(input_ids, token_type_ids, position_ids,
                               attention_mask)
         return self.classifier(self.dropout(pooled))
+
+
+class BertForTokenClassification(Layer):
+    """Parity: paddlenlp BertForTokenClassification."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h, _ = self.bert(input_ids, token_type_ids, position_ids,
+                         attention_mask)
+        return self.classifier(self.dropout(h))
+
+
+class BertForQuestionAnswering(Layer):
+    """Parity: paddlenlp BertForQuestionAnswering (SQuAD-style start/end
+    span logits)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.classifier = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h, _ = self.bert(input_ids, token_type_ids, position_ids,
+                         attention_mask)
+        logits = self.classifier(h)
+        start, end = M.unbind(logits, axis=-1)
+        return start, end
+
+
+class BertLMPredictionHead(Layer):
+    """Transform + tied-embedding decoder (parity: paddlenlp
+    BertLMPredictionHead)."""
+
+    def __init__(self, config: BertConfig, embedding_weights):
+        super().__init__()
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.norm = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_eps)
+        # tied weight: keep a plain reference (list sidesteps Layer's
+        # parameter registration) — the embedding owns the parameter
+        self._tied = [embedding_weights]
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True)
+        self.act = config.hidden_act
+
+    def forward(self, h):
+        h = self.norm(getattr(F, self.act)(self.transform(h)))
+        return L.matmul(h, self._tied[0],
+                        transpose_y=True) + self.decoder_bias
+
+
+class BertForMaskedLM(Layer):
+    """Parity: paddlenlp BertForMaskedLM."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h, _ = self.bert(input_ids, token_type_ids, position_ids,
+                         attention_mask)
+        return self.cls(h)
+
+
+class BertForPretraining(Layer):
+    """MLM + next-sentence-prediction heads (parity: paddlenlp
+    BertForPretraining)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+        self.nsp = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.cls(h), self.nsp(pooled)
 
 
 # ERNIE shares the architecture (ecosystem parity: ernie models are

@@ -149,9 +149,12 @@ class Layer:
         return t
 
     # ---------------------------------------------------------- traversal --
-    def named_parameters(self, prefix="", include_sublayers=True
-                         ) -> Iterator[Tuple[str, Parameter]]:
-        seen = set()
+    def named_parameters(self, prefix="", include_sublayers=True,
+                         _seen=None) -> Iterator[Tuple[str, Parameter]]:
+        # _seen is shared across the WHOLE recursion: a tied parameter
+        # (e.g. an LM head holding the embedding weight) must be yielded
+        # once, or optimizers would apply its update twice per step
+        seen = _seen if _seen is not None else set()
         for name, p in self._parameters.items():
             if p is not None and id(p) not in seen:
                 seen.add(id(p))
@@ -161,7 +164,7 @@ class Layer:
                 if layer is None:
                     continue
                 sub_prefix = f"{prefix}.{lname}" if prefix else lname
-                for item in layer.named_parameters(sub_prefix):
+                for item in layer.named_parameters(sub_prefix, _seen=seen):
                     yield item
 
     def parameters(self, include_sublayers=True):

@@ -75,3 +75,63 @@ class TestErnie:
         np.testing.assert_allclose(
             np.asarray(h_masked.numpy())[0, :6],
             np.asarray(h_masked2.numpy())[0, :6], atol=1e-5)
+
+
+class TestBertHeads:
+    def test_heads_shapes_and_tied_mlm_grad(self):
+        from paddle_tpu.models import (
+            BertConfig, BertForTokenClassification,
+            BertForQuestionAnswering, BertForMaskedLM, BertForPretraining)
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        cfg = BertConfig.tiny(num_labels=5)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 200, (2, 12)))
+        assert tuple(BertForTokenClassification(cfg)(ids).shape) \
+            == (2, 12, 5)
+        s, e = BertForQuestionAnswering(cfg)(ids)
+        assert tuple(s.shape) == (2, 12) and tuple(e.shape) == (2, 12)
+        mlm = BertForMaskedLM(cfg)
+        out = mlm(ids)
+        assert tuple(out.shape) == (2, 12, cfg.vocab_size)
+        p, n = BertForPretraining(cfg)(ids)
+        assert tuple(p.shape) == (2, 12, cfg.vocab_size)
+        assert tuple(n.shape) == (2, 2)
+        labels = paddle.to_tensor(np.random.RandomState(1).randint(
+            0, cfg.vocab_size, (2, 12)))
+        loss = F.cross_entropy(out.reshape([-1, cfg.vocab_size]),
+                               labels.reshape([-1]))
+        loss.backward()
+        g = mlm.bert.embeddings.word_embeddings.weight.grad
+        assert g is not None and float(abs(g.numpy()).sum()) > 0
+
+    def test_mlm_trains(self):
+        from paddle_tpu.models import BertConfig, BertForMaskedLM
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        cfg = BertConfig.tiny()
+        m = BertForMaskedLM(cfg)
+        opt = paddle.optimizer.AdamW(5e-4, parameters=m.parameters())
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 200, (4, 10)))
+        l0 = None
+        for _ in range(8):
+            out = m(ids)
+            loss = F.cross_entropy(out.reshape([-1, cfg.vocab_size]),
+                                   ids.reshape([-1]))
+            loss.backward()
+            opt.step(); opt.clear_grad()
+            if l0 is None:
+                l0 = float(loss)
+        assert float(loss) < l0
+
+    def test_tied_weight_counted_once(self):
+        # regression: named_parameters shares its dedup set across the
+        # recursion, so a tied embedding/decoder weight is yielded once
+        from paddle_tpu.models import BertConfig, BertForMaskedLM
+        paddle.seed(0)
+        m = BertForMaskedLM(BertConfig.tiny())
+        ids = [id(p) for p in m.parameters()]
+        assert len(ids) == len(set(ids))
+        emb_id = id(m.bert.embeddings.word_embeddings.weight)
+        assert ids.count(emb_id) == 1

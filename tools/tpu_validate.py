@@ -33,6 +33,11 @@ def main(argv=None):
 
     dev = jax.devices()[0]
     print(f"device: {dev} ({dev.platform})", flush=True)
+    if dev.platform == "cpu":
+        # CI dry-run: force the REAL Pallas paths through the
+        # interpreter — otherwise use_pallas() gates to False on CPU and
+        # every "parity" check compares XLA with itself
+        set_flags({"pallas_interpret": True})
     failures = []
 
     def check(name, got, want, dtype):
@@ -102,6 +107,38 @@ def main(argv=None):
         rx = knorm.fused_rms_norm(x2, w2, 1e-6)
         set_flags({"use_pallas_kernels": True})
         check(f"rms_norm fwd {dn}", rp, rx, dtype)
+
+        # layer norm
+        b2 = jax.random.normal(jax.random.PRNGKey(2), (1024,), dtype)
+        lp = knorm.fused_layer_norm(x2, w2, b2, 1e-5)
+        set_flags({"use_pallas_kernels": False})
+        lx = knorm.fused_layer_norm(x2, w2, b2, 1e-5)
+        set_flags({"use_pallas_kernels": True})
+        check(f"layer_norm fwd {dn}", lp, lx, dtype)
+
+        # non-default flash block sizes (the autotune knobs must not
+        # change the math)
+        set_flags({"flash_block_q": 256, "flash_block_k": 256})
+        out_b = flash_attention_jax(q, k, v, causal=True)
+        set_flags({"flash_block_q": 128, "flash_block_k": 128})
+        out_r = flash_attention_jax(q, k, v, causal=True)
+        check(f"flash blocks 256 vs 128 {dn}", out_b, out_r, dtype)
+
+    # paged attention (serving decode) — f32 path
+    from paddle_tpu.kernels.paged_attention import (
+        _paged_attention_pallas, _paged_attention_xla)
+    rs = np.random.RandomState(0)
+    qd = jnp.asarray(rs.randn(3, 8, 128).astype(np.float32))
+    kp = jnp.asarray(rs.randn(12, 16, 8, 128).astype(np.float32))
+    vp = jnp.asarray(rs.randn(12, 16, 8, 128).astype(np.float32))
+    bt = jnp.asarray(rs.choice(12, (3, 3), replace=False).astype(np.int32))
+    cl = jnp.asarray(np.array([40, 17, 5], np.int32))
+    sc = float(1.0 / np.sqrt(128))
+    interp = jax.default_backend() == "cpu"  # CI dry-runs interpret
+    pg_p = _paged_attention_pallas(qd, kp, vp, bt, cl, sc,
+                                   interpret=interp)
+    pg_x = _paged_attention_xla(qd, kp, vp, bt, cl, sc)
+    check("paged_attention f32", pg_p, pg_x, jnp.float32)
 
     print(("ALL PASS" if not failures else
            f"{len(failures)} FAILURES: {failures}"), flush=True)

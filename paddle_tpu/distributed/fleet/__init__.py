@@ -23,3 +23,30 @@ from .meta_parallel import (
 from .sharding import group_sharded_parallel
 from .recompute import recompute
 from . import utils
+
+
+class UserDefinedRoleMaker:
+    """Parity shim: paddle.distributed.fleet.UserDefinedRoleMaker — the
+    PS-era role assignment. Under jax.distributed the coordinator
+    assigns process indices, so this just records what it is given."""
+
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    """Parity shim: role/rank comes from the launcher env
+    (PADDLE_TRAINER_ID etc.) — read by distributed/env.py."""
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Parity: fleet.save_persistables — static-graph checkpointing of
+    persistable vars. Here the live layer registry serves that role:
+    use paddle.save(model.state_dict(), path) or the orbax sharded
+    checkpoint for multi-host."""
+    raise NotImplementedError(
+        "save_persistables is a static-graph PS-era API; use "
+        "paddle.save(model.state_dict(), path) or "
+        "paddle.distributed.checkpoint.save_state_dict")

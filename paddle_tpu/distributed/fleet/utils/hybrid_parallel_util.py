@@ -1,0 +1,24 @@
+"""Parity: paddle.distributed.fleet.utils.hybrid_parallel_util — manual
+grad-sync helpers for the NCCL hybrid engine. Compiled collectives make
+them no-ops here (XLA inserts the reductions inside the train step);
+kept so ported trainer scripts run unchanged."""
+from __future__ import annotations
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "broadcast_sharding_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    return None
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    return None
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    return None

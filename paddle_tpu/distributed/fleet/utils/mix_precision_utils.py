@@ -1,0 +1,18 @@
+"""Parity: paddle.distributed.fleet.utils.mix_precision_utils — upstream
+wraps layers/optimizers for pure-fp16 training (master weights held by
+the wrapper). The TrainStep keeps f32 master weights automatically
+(optimizer multi_precision), so these are identity adapters that keep
+ported trainers running unchanged."""
+from __future__ import annotations
+
+__all__ = ["MixPrecisionLayer", "MixPrecisionOptimizer"]
+
+
+class MixPrecisionLayer:
+    def __new__(cls, layer, dtype="float16"):
+        return layer
+
+
+class MixPrecisionOptimizer:
+    def __new__(cls, optimizer):
+        return optimizer

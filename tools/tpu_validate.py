@@ -134,9 +134,10 @@ def main(argv=None):
     bt = jnp.asarray(rs.choice(12, (3, 3), replace=False).astype(np.int32))
     cl = jnp.asarray(np.array([40, 17, 5], np.int32))
     sc = float(1.0 / np.sqrt(128))
-    interp = jax.default_backend() == "cpu"  # CI dry-runs interpret
+    # same source of truth as the flag set at startup for CPU dry-runs
+    from paddle_tpu.kernels._common import pallas_interpret
     pg_p = _paged_attention_pallas(qd, kp, vp, bt, cl, sc,
-                                   interpret=interp)
+                                   interpret=pallas_interpret())
     pg_x = _paged_attention_xla(qd, kp, vp, bt, cl, sc)
     check("paged_attention f32", pg_p, pg_x, jnp.float32)
 

@@ -30,7 +30,8 @@ from .framework.dtype import (
     set_default_dtype, get_default_dtype, finfo, iinfo,
 )
 from .framework.place import (
-    CPUPlace, TPUPlace, XLAPlace, CUDAPlace, set_device, get_device,
+    CPUPlace, TPUPlace, XLAPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+    set_device, get_device,
     is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
 )
 from .framework.random import (seed, get_rng_state, set_rng_state,

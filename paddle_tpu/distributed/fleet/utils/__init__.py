@@ -12,52 +12,47 @@ from . import sequence_parallel_utils
 
 # ---------------------------------------------------------------- shims --
 
+import os
+import os.path as osp
+import shutil
+
+
 class LocalFS:
     """Parity: paddle.distributed.fleet.utils.LocalFS — local filesystem
     client used by fleet checkpoint paths."""
 
     def ls_dir(self, path):
-        import os
         if not os.path.exists(path):
             return [], []
         dirs, files = [], []
         for e in os.listdir(path):
-            import os.path as osp
             (dirs if osp.isdir(osp.join(path, e)) else files).append(e)
         return dirs, files
 
     def mkdirs(self, path):
-        import os
         os.makedirs(path, exist_ok=True)
 
     def is_exist(self, path):
-        import os
         return os.path.exists(path)
 
     def is_dir(self, path):
-        import os
         return os.path.isdir(path)
 
     def is_file(self, path):
-        import os
         return os.path.isfile(path)
 
     def delete(self, path):
-        import shutil, os
         if os.path.isdir(path):
             shutil.rmtree(path, ignore_errors=True)
         elif os.path.exists(path):
             os.remove(path)
 
     def touch(self, path, exist_ok=True):
-        import os
         if os.path.exists(path) and not exist_ok:
             raise FileExistsError(path)
         open(path, "a").close()
 
     def mv(self, src, dst, overwrite=False):
-        import os
-        import shutil
         if os.path.exists(dst):
             if not overwrite:
                 raise FileExistsError(
@@ -66,11 +61,9 @@ class LocalFS:
         shutil.move(src, dst)
 
     def upload(self, local, remote):
-        import shutil
         shutil.copy(local, remote)
 
     def download(self, remote, local):
-        import shutil
         shutil.copy(remote, local)
 
 

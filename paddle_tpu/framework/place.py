@@ -127,3 +127,22 @@ def is_compiled_with_xpu() -> bool:  # parity stub
 
 def is_compiled_with_tpu() -> bool:
     return _accelerator_available()
+
+
+class CUDAPinnedPlace(Place):
+    """Parity shim: pinned host memory is an explicit-staging CUDA
+    concept; on TPU host arrays are staged by the runtime. Behaves as
+    the CPU place."""
+    _kind = "cpu"
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+class XPUPlace(Place):
+    """Parity shim: no XPU in this stack; accepted for ported code and
+    mapped to the accelerator place."""
+    _kind = "tpu"
+
+    def __repr__(self):
+        return f"XPUPlace({self._device_id})"

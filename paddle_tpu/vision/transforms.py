@@ -564,3 +564,15 @@ class RandomPerspective(BaseTransform):
                 h - 1 - pyrandom.uniform(0, dy)),
                (pyrandom.uniform(0, dx), h - 1 - pyrandom.uniform(0, dy))]
         return perspective(img, start, end, fill=self.fill)
+
+
+# paddle.vision.transforms.functional is a submodule in the reference;
+# transforms_functional imports back from this module, which is safe
+# here because every functional def is above this line. Registering in
+# sys.modules makes ALL upstream import forms work:
+#   import paddle.vision.transforms.functional as F
+#   from paddle.vision.transforms.functional import resize
+#   paddle.vision.transforms.functional.resize(...)
+import sys as _sys  # noqa: E402
+from . import transforms_functional as functional  # noqa: E402
+_sys.modules[__name__ + ".functional"] = functional

@@ -1,0 +1,17 @@
+"""paddle.vision.transforms.functional parity — the functional forms of
+the transform ops (python/paddle/vision/transforms/functional.py). Thin
+re-exports of the implementations in transforms.py with the reference's
+public names."""
+from __future__ import annotations
+
+from .transforms import (  # noqa: F401
+    normalize, resize, hflip, vflip, adjust_brightness, adjust_contrast,
+    adjust_saturation, adjust_hue, to_grayscale, crop, center_crop, pad,
+    erase, affine, rotate, perspective,
+)
+from .transforms import to_tensor_fn as to_tensor  # noqa: F401
+
+__all__ = ["normalize", "resize", "hflip", "vflip", "adjust_brightness",
+           "adjust_contrast", "adjust_saturation", "adjust_hue",
+           "to_grayscale", "crop", "center_crop", "pad", "erase",
+           "affine", "rotate", "perspective", "to_tensor"]

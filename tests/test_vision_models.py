@@ -123,3 +123,21 @@ class TestTransformFamily:
                     T.HueTransform(0.2)]:
             out = cls(img)
             assert np.asarray(out).shape[:2] == (16, 20)
+
+
+class TestTransformsFunctional:
+    def test_functional_submodule(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.vision.transforms as T
+        from paddle_tpu.vision.transforms import functional as TF
+        assert T.functional is TF
+        img = np.random.RandomState(0).randint(
+            0, 255, (16, 16, 3)).astype("uint8")
+        assert np.asarray(TF.resize(img, 8)).shape[:2] == (8, 8)
+        t = TF.to_tensor(img)
+        assert tuple(t.shape) == (3, 16, 16)
+        n = TF.normalize(TF.to_tensor(img).numpy(), [0.5] * 3, [0.5] * 3)
+        assert np.asarray(n).shape == (3, 16, 16)
+        assert repr(paddle.CUDAPinnedPlace()) == "CUDAPinnedPlace"
+        assert "XPUPlace" in repr(paddle.XPUPlace(0))

@@ -106,7 +106,9 @@ def _parse_place(device) -> Place:
         kind, idx = s, 0
     if kind == "cpu":
         return CPUPlace(idx)
-    if kind in ("tpu", "gpu", "xla", "cuda"):
+    if kind in ("tpu", "gpu", "xla", "cuda", "xpu"):
+        # ported XPU scripts select via set_device('xpu:N') — map to the
+        # accelerator place like the XPUPlace class shim
         return TPUPlace(idx)
     raise ValueError(f"unknown device {device!r}")
 

@@ -330,3 +330,46 @@ Tensor.reduce_as = reduce_as
 def tolist(x):
     """Parity: paddle.tolist (python/paddle/tensor/to_string.py)."""
     return x.tolist()
+
+
+# contiguity / storage introspection parity (pybind eager_method.cc):
+# XLA arrays are always dense row-major from the API's viewpoint
+Tensor.is_contiguous = lambda self: True
+Tensor.contiguous = lambda self: self
+
+
+def _strides(self):
+    """Row-major element strides (parity: Tensor.strides)."""
+    shape = self.shape
+    out = [1] * len(shape)
+    for i in range(len(shape) - 2, -1, -1):
+        out[i] = out[i + 1] * int(shape[i + 1])
+    return out
+
+
+Tensor.strides = property(_strides)
+Tensor.get_strides = _strides
+
+
+def _data_ptr(self):
+    """Device buffer address (parity: Tensor.data_ptr). Best-effort:
+    jax exposes it for committed device arrays; tracers have none."""
+    v = self._value
+    try:
+        return v.unsafe_buffer_pointer()
+    except (AttributeError, NotImplementedError) as e:
+        raise RuntimeError(f"data_ptr unavailable: {e}") from e
+
+
+def _set_data(self, value):
+    """Paddle's Tensor.data is settable (weight surgery / EMA updates):
+    assignment rebinds this tensor's value in place."""
+    self._inplace_update(value if isinstance(value, Tensor)
+                         else Tensor(jnp.asarray(value)))
+
+
+Tensor.data_ptr = _data_ptr
+# legacy accessors: the eager Tensor IS its own data/DenseTensor here
+Tensor.data = property(lambda self: self, _set_data)
+Tensor.value = lambda self: self
+Tensor.get_tensor = lambda self: self

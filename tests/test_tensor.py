@@ -161,3 +161,30 @@ class TestLegacyCompatNamespaces:
     def test_sysconfig(self):
         assert "csrc" in paddle.sysconfig.get_include()
         assert "_native" in paddle.sysconfig.get_lib()
+
+
+class TestStorageIntrospection:
+    def test_contiguity_strides_accessors(self):
+        import paddle_tpu as paddle
+        t = paddle.to_tensor([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        assert t.is_contiguous() and t.contiguous() is t
+        assert t.strides == [3, 1] and t.get_strides() == [3, 1]
+        assert t.data is t and t.value() is t and t.get_tensor() is t
+        assert isinstance(t.data_ptr(), int)
+        s = paddle.to_tensor(0.0)
+        assert s.strides == []
+
+    def test_place_shims_and_settable_data(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        assert repr(paddle.CUDAPinnedPlace()) == "CUDAPinnedPlace"
+        assert "XPUPlace" in repr(paddle.XPUPlace(0))
+        # xpu device strings resolve (ported-script path)
+        from paddle_tpu.framework.place import _parse_place
+        assert _parse_place("xpu:0") is not None
+        # Tensor.data is settable (EMA/weight-surgery parity)
+        t = paddle.to_tensor([1.0, 2.0])
+        t.data = paddle.to_tensor([5.0, 6.0])
+        np.testing.assert_allclose(t.numpy(), [5.0, 6.0])
+        t.data = np.array([7.0, 8.0], np.float32)
+        np.testing.assert_allclose(t.numpy(), [7.0, 8.0])

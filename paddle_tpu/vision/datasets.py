@@ -158,32 +158,128 @@ class FashionMNIST(MNIST):
 
 
 class Flowers(Dataset):
-    """Parity: paddle.vision.datasets.Flowers. Offline sandbox: load
-    from a local directory of class-subfolder images via DatasetFolder,
-    or use FakeData."""
+    """Parity: paddle.vision.datasets.Flowers (Oxford 102). Offline
+    convention: pass local copies of the official files —
+    data_file=102flowers.tgz (or the extracted directory CONTAINING
+    jpg/), label_file=imagelabels.mat, setid_file=setid.mat. Labels are
+    the raw 1-based Oxford classes, as in the reference."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
-        if data_file is None or not os.path.exists(str(data_file)):
-            raise RuntimeError(
-                "Flowers archive not found; this sandbox has no network. "
-                "Point data_file at a local copy, use DatasetFolder over "
-                "an extracted image tree, or FakeData for synthetic data.")
-        raise NotImplementedError(
-            "Flowers .mat parsing needs scipy.io over the local archive; "
-            "extract the images and use DatasetFolder instead")
+        if mode not in self._SPLIT_KEY:
+            raise ValueError(
+                f"mode must be one of {sorted(self._SPLIT_KEY)}, "
+                f"got {mode!r}")
+        for f, what in ((data_file, "data_file (102flowers.tgz)"),
+                        (label_file, "label_file (imagelabels.mat)"),
+                        (setid_file, "setid_file (setid.mat)")):
+            if f is None or not os.path.exists(str(f)):
+                raise RuntimeError(
+                    f"Flowers {what} not found; this sandbox has no "
+                    "network — point it at a local copy (or use "
+                    "DatasetFolder / FakeData)")
+        import scipy.io as sio
+        labels = sio.loadmat(str(label_file))["labels"].reshape(-1)
+        setid = sio.loadmat(str(setid_file))
+        self._indexes = setid[self._SPLIT_KEY[mode]].reshape(-1) \
+            .astype(int)  # 1-based image ids
+        self._labels = labels
+        self._transform = transform
+        data_file = str(data_file)
+        self._dir = data_file if os.path.isdir(data_file) else None
+        self._blobs = None
+        if self._dir is None:
+            # load this split's members once: random extractfile() on a
+            # gzip tar re-decompresses from the archive start on every
+            # backward seek, and an open TarFile is unpicklable for
+            # DataLoader workers
+            wanted = {f"jpg/image_{int(i):05d}.jpg"
+                      for i in self._indexes}
+            self._blobs = {}
+            with tarfile.open(data_file) as tf:
+                for m in tf:
+                    if m.name in wanted:
+                        self._blobs[m.name] = tf.extractfile(m).read()
+
+    def _img_bytes(self, idx1):
+        name = f"jpg/image_{idx1:05d}.jpg"
+        if self._dir is not None:
+            with open(os.path.join(self._dir, name), "rb") as f:
+                return f.read()
+        return self._blobs[name]
+
+    def __getitem__(self, i):
+        import io
+        from PIL import Image
+        idx1 = int(self._indexes[i])
+        img = Image.open(io.BytesIO(self._img_bytes(idx1))).convert("RGB")
+        label = int(self._labels[idx1 - 1])  # raw 1-based (reference)
+        if self._transform is not None:
+            img = self._transform(img)
+        return img, np.array([label])
+
+    def __len__(self):
+        return len(self._indexes)
 
 
 class VOC2012(Dataset):
-    """Parity: paddle.vision.datasets.VOC2012 (offline convention)."""
+    """Parity: paddle.vision.datasets.VOC2012 — segmentation pairs
+    (image, label mask). Offline convention: data_file points at the
+    official VOCtrainval tar (or an extracted VOCdevkit directory)."""
+
+    _SPLIT = {"train": "train.txt", "valid": "val.txt",
+              "trainval": "trainval.txt"}
+    _ROOT = "VOCdevkit/VOC2012"
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
+        if mode not in self._SPLIT:
+            raise ValueError(
+                f"mode must be one of {sorted(self._SPLIT)}, got {mode!r}")
         if data_file is None or not os.path.exists(str(data_file)):
             raise RuntimeError(
                 "VOC2012 archive not found; this sandbox has no network. "
-                "Point data_file at a local VOCtrainval tar, or use "
-                "DatasetFolder / FakeData.")
-        raise NotImplementedError(
-            "VOC2012 segmentation parsing lands with a local archive; "
-            "extract and use DatasetFolder for classification use")
+                "Point data_file at a local VOCtrainval tar (or the "
+                "extracted VOCdevkit), or use DatasetFolder / FakeData.")
+        data_file = str(data_file)
+        self._dir = data_file if os.path.isdir(data_file) else None
+        self._blobs = None
+        if self._dir is None:
+            # one sequential pass: random tar access is pathological on
+            # gzip and an open TarFile breaks DataLoader pickling
+            self._blobs = {}
+            with tarfile.open(data_file) as tf:
+                for m in tf:
+                    if m.isfile() and (
+                            "/JPEGImages/" in m.name
+                            or "/SegmentationClass/" in m.name
+                            or "/ImageSets/Segmentation/" in m.name):
+                        self._blobs[m.name] = tf.extractfile(m).read()
+        split = self._SPLIT[mode]
+        names = self._read(
+            f"{self._ROOT}/ImageSets/Segmentation/{split}")
+        self._names = [n for n in names.decode().split("\n") if n.strip()]
+        self._transform = transform
+
+    def _read(self, rel):
+        if self._dir is not None:
+            with open(os.path.join(self._dir, rel), "rb") as f:
+                return f.read()
+        return self._blobs[rel]
+
+    def __getitem__(self, i):
+        import io
+        from PIL import Image
+        n = self._names[i].strip()
+        img = Image.open(io.BytesIO(self._read(
+            f"{self._ROOT}/JPEGImages/{n}.jpg"))).convert("RGB")
+        mask = Image.open(io.BytesIO(self._read(
+            f"{self._ROOT}/SegmentationClass/{n}.png")))
+        if self._transform is not None:
+            img = self._transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._names)

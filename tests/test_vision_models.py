@@ -3,6 +3,7 @@
 Small inputs / scaled-down widths where the architecture allows, to keep
 CPU compile times bounded.
 """
+import os
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -139,3 +140,74 @@ class TestTransformsFunctional:
         assert tuple(t.shape) == (3, 16, 16)
         n = TF.normalize(TF.to_tensor(img).numpy(), [0.5] * 3, [0.5] * 3)
         assert np.asarray(n).shape == (3, 16, 16)
+
+
+class TestOfflineArchiveDatasets:
+    def _flowers_fixture(self, d):
+        import io
+        import tarfile
+        import scipy.io as sio
+        from PIL import Image
+        tgz = os.path.join(d, "102flowers.tgz")
+        with tarfile.open(tgz, "w:gz") as tf:
+            for i in range(1, 7):
+                img = Image.fromarray(
+                    np.full((8, 8, 3), i * 30, np.uint8))
+                b = io.BytesIO()
+                img.save(b, "JPEG")
+                data = b.getvalue()
+                info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        sio.savemat(os.path.join(d, "imagelabels.mat"),
+                    {"labels": np.array([[1, 1, 2, 2, 3, 3]])})
+        sio.savemat(os.path.join(d, "setid.mat"),
+                    {"trnid": np.array([[1, 3, 5]]),
+                     "valid": np.array([[2]]),
+                     "tstid": np.array([[4, 6]])})
+        return tgz
+
+    def test_flowers_local_archive(self, tmp_path):
+        from paddle_tpu.vision.datasets import Flowers
+        d = str(tmp_path)
+        tgz = self._flowers_fixture(d)
+        ds = Flowers(data_file=tgz,
+                     label_file=os.path.join(d, "imagelabels.mat"),
+                     setid_file=os.path.join(d, "setid.mat"),
+                     mode="train")
+        assert len(ds) == 3
+        img, lab = ds[0]
+        assert np.asarray(img).shape == (8, 8, 3) and int(lab[0]) == 1
+        te = Flowers(data_file=tgz,
+                     label_file=os.path.join(d, "imagelabels.mat"),
+                     setid_file=os.path.join(d, "setid.mat"), mode="test")
+        # raw 1-based Oxford labels (reference semantics)
+        assert [int(te[i][1][0]) for i in range(len(te))] == [2, 3]
+        import pytest
+        with pytest.raises(ValueError, match="mode"):
+            Flowers(data_file=tgz,
+                    label_file=os.path.join(d, "imagelabels.mat"),
+                    setid_file=os.path.join(d, "setid.mat"), mode="val")
+        # picklable (DataLoader num_workers contract)
+        import pickle
+        assert len(pickle.loads(pickle.dumps(ds))) == 3
+
+    def test_voc2012_local_tree(self, tmp_path):
+        from PIL import Image
+        from paddle_tpu.vision.datasets import VOC2012
+        root = tmp_path / "VOCdevkit" / "VOC2012"
+        (root / "ImageSets" / "Segmentation").mkdir(parents=True)
+        (root / "JPEGImages").mkdir()
+        (root / "SegmentationClass").mkdir()
+        for n in ("2007_000001", "2007_000002"):
+            Image.fromarray(np.zeros((6, 6, 3), np.uint8)).save(
+                root / "JPEGImages" / f"{n}.jpg")
+            Image.fromarray(np.ones((6, 6), np.uint8)).save(
+                root / "SegmentationClass" / f"{n}.png")
+        (root / "ImageSets" / "Segmentation" / "train.txt").write_text(
+            "2007_000001\n2007_000002\n")
+        ds = VOC2012(data_file=str(tmp_path), mode="train")
+        assert len(ds) == 2
+        img, mask = ds[0]
+        assert np.asarray(img).shape == (6, 6, 3)
+        assert np.asarray(mask).shape == (6, 6)

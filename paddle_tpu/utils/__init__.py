@@ -1,5 +1,5 @@
 """paddle.utils parity (unique_name, deprecated, try_import, dlpack,
-cpp_extension pointer).
+cpp_extension (real JIT C-extension builder, see cpp_extension.py).
 
 Reference parity: python/paddle/utils/ — the pieces user code commonly
 touches. `download` is gated (zero-egress environments); cpp_extension
@@ -73,21 +73,5 @@ def require_version(min_version, max_version=None):
             f"installed version {full_version} > allowed {max_version}")
 
 
-class cpp_extension:
-    """Parity guidance: paddle.utils.cpp_extension builds CUDA custom
-    ops. TPU custom kernels are Pallas (python-level, no build step);
-    host-side native code plugs in through the CustomDevice C-ABI
-    (csrc/capi.cc) or plain ctypes/cffi."""
-
-    @staticmethod
-    def load(**kwargs):
-        raise NotImplementedError(
-            "cpp_extension.load builds CUDA ops; on TPU write the kernel "
-            "in Pallas (paddle_tpu.kernels) or register a host library "
-            "via paddle_tpu.device.register_custom_device")
-
-    CppExtension = load
-    CUDAExtension = load
-
-
 from . import download  # noqa: E402  (zero-egress-aware cache resolver)
+from . import cpp_extension  # noqa: E402  (JIT C-extension builder)

@@ -1,0 +1,76 @@
+"""paddle.utils.cpp_extension parity (python/paddle/utils/cpp_extension/).
+
+Custom C++ operators here are plain C extensions built with setuptools
+(the baked toolchain has g++/cmake/ninja; pybind11 is NOT shipped, so
+extensions use the CPython C API or ctypes — see csrc/ for the
+in-tree examples: tcp_store.cc, shm_channel.cc, capi.cc built by
+csrc/Makefile). CUDA-specific pieces have no TPU meaning: device
+compute belongs in Pallas kernels, not custom device ops."""
+from __future__ import annotations
+
+__all__ = ["CppExtension", "CUDAExtension", "setup", "load",
+           "get_build_directory"]
+
+
+def get_build_directory():
+    import os
+    d = os.path.expanduser("~/.cache/paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def CppExtension(sources, *args, **kwargs):
+    """Build descriptor for a C++ custom op (setuptools.Extension)."""
+    from setuptools import Extension
+    name = kwargs.pop("name", "paddle_custom_ext")
+    return Extension(name, sources=list(sources), *args, **kwargs)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise NotImplementedError(
+        "CUDA custom ops have no TPU lowering; write device compute as "
+        "a Pallas kernel (paddle_tpu/kernels/ shows the patterns) and "
+        "host-side native code as a CppExtension")
+
+
+def setup(**kwargs):
+    """Parity: cpp_extension.setup — delegates to setuptools.setup with
+    the ext_modules passed through."""
+    from setuptools import setup as _setup
+    kwargs.setdefault("script_args", ["build_ext", "--inplace"])
+    return _setup(**kwargs)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kwargs):
+    """JIT-compile a C extension from sources and import it (parity:
+    cpp_extension.load). Uses the CPython C API toolchain in-place."""
+    import importlib.util
+    import os
+    import subprocess
+    import sysconfig
+
+    bdir = build_directory or get_build_directory()
+    os.makedirs(bdir, exist_ok=True)
+    so_path = os.path.join(bdir, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(so_path) \
+            or os.path.getmtime(so_path) < newest_src:
+        cmd = ["g++", "-O2", "-shared", "-fPIC",
+               f"-I{sysconfig.get_paths()['include']}"]
+        for inc in (extra_include_paths or []):
+            cmd.append(f"-I{inc}")
+        cmd += (extra_cxx_cflags or [])
+        cmd += srcs + ["-o", so_path]
+        if verbose:
+            print(" ".join(cmd))
+        res = subprocess.run(cmd, capture_output=not verbose, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                "cpp_extension.load: compilation failed\n"
+                + (res.stderr or "") + (res.stdout or ""))
+    spec = importlib.util.spec_from_file_location(name, so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -78,8 +78,6 @@ class MNIST(Dataset):
         return img, self.labels[idx]
 
 
-FashionMNIST = MNIST
-
 
 class Cifar10(Dataset):
     def __init__(self, data_file=None, mode="train", transform=None,
@@ -246,18 +244,30 @@ class VOC2012(Dataset):
         data_file = str(data_file)
         self._dir = data_file if os.path.isdir(data_file) else None
         self._blobs = None
+        split = self._SPLIT[mode]
         if self._dir is None:
-            # one sequential pass: random tar access is pathological on
-            # gzip and an open TarFile breaks DataLoader pickling
+            # sequential passes: random tar access is pathological on
+            # gzip and an open TarFile breaks DataLoader pickling. Pass 1
+            # grabs the split list + masks; pass 2 keeps ONLY this
+            # split's JPEGs (the full VOC tar holds ~17k images but a
+            # segmentation split references <3k — loading all of them
+            # would multiply across DataLoader workers)
             self._blobs = {}
             with tarfile.open(data_file) as tf:
                 for m in tf:
                     if m.isfile() and (
-                            "/JPEGImages/" in m.name
-                            or "/SegmentationClass/" in m.name
+                            "/SegmentationClass/" in m.name
                             or "/ImageSets/Segmentation/" in m.name):
                         self._blobs[m.name] = tf.extractfile(m).read()
-        split = self._SPLIT[mode]
+            names_blob = self._blobs[
+                f"{self._ROOT}/ImageSets/Segmentation/{split}"]
+            wanted = {f"{self._ROOT}/JPEGImages/{n.strip()}.jpg"
+                      for n in names_blob.decode().split("\n")
+                      if n.strip()}
+            with tarfile.open(data_file) as tf:
+                for m in tf:
+                    if m.name in wanted:
+                        self._blobs[m.name] = tf.extractfile(m).read()
         names = self._read(
             f"{self._ROOT}/ImageSets/Segmentation/{split}")
         self._names = [n for n in names.decode().split("\n") if n.strip()]

@@ -1,0 +1,98 @@
+"""Recipe: long-context training with context parallelism (SURVEY §5.7).
+
+    python examples/long_context.py --smoke
+
+Shards the sequence over the mesh 'context' axis and trains a small
+transformer whose attention runs as ring attention (K/V shards rotate
+via ppermute with online-softmax accumulation; zig-zag layout balances
+causal work). Ragged documents use kv_lens varlen masking instead of a
+dense mask. On hardware, scale --seq and the mesh; the same script
+compiles unchanged.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--cp", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+    if args.smoke:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        args.seq = min(args.seq, 256)
+        args.steps = min(args.steps, 30)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.distributed.mesh import build_mesh, mesh_scope
+    from paddle_tpu.kernels.ring_attention import ring_attention_jax
+
+    B, S, H, D, V = 2, args.seq, 4, 32, 512
+    cp = args.cp
+    mesh = build_mesh(dp=1, cp=cp)
+    rng = np.random.RandomState(0)
+    # structured documents (token t+1 = token t + 1 mod V): the LM can
+    # actually learn the successor rule, so the loss trajectory is a
+    # meaningful health signal rather than irreducible entropy
+    starts = rng.randint(1, V, (B, 1))
+    ids = jnp.asarray((starts + np.arange(S)) % V)
+    lens = jnp.asarray([S, max(S // 3, 8)], jnp.int32)  # ragged docs
+
+    p = {
+        "emb": jnp.asarray(rng.randn(V, H * D).astype(np.float32) * 0.02),
+        "qkv": jnp.asarray(rng.randn(H * D, 3 * H * D).astype(np.float32)
+                           * 0.02),
+        "out": jnp.asarray(rng.randn(H * D, V).astype(np.float32) * 0.02),
+    }
+
+    def loss_fn(p):
+        x = p["emb"][ids]                                # [B, S, HD]
+        qkv = (x @ p["qkv"]).reshape(B, S, 3, H, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = ring_attention_jax(q, k, v, causal=True, mesh=mesh,
+                                 kv_lens=lens)
+        h = x + att.reshape(B, S, H * D)                 # residual
+        logits = h @ p["out"]                            # [B, S, V]
+        lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        tgt = jnp.take_along_axis(lp, ids[:, 1:, None], axis=-1)[..., 0]
+        valid = (jnp.arange(S - 1)[None, :] < (lens[:, None] - 1))
+        return -jnp.sum(tgt * valid) / jnp.sum(valid)
+
+    import optax
+    opt = optax.adam(3e-2)
+
+    with mesh_scope(mesh):
+        opt_state = opt.init(p)
+
+        @jax.jit
+        def step(p, opt_state):
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            updates, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        l0 = None
+        for i in range(args.steps):
+            p, opt_state, loss = step(p, opt_state)
+            if l0 is None:
+                l0 = float(loss)
+        print(f"ring-attention LM over cp={cp}: loss {l0:.4f} -> "
+              f"{float(loss):.4f}  (seq={S}, ragged lens="
+              f"{list(map(int, lens))})")
+        assert float(loss) < l0 * 0.8
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,10 @@
 """paddle.utils parity (unique_name, deprecated, try_import, dlpack,
-cpp_extension (real JIT C-extension builder, see cpp_extension.py).
+cpp_extension, download).
 
 Reference parity: python/paddle/utils/ — the pieces user code commonly
-touches. `download` is gated (zero-egress environments); cpp_extension
-maps to the repo's csrc/ ctypes build (paddle_tpu._native).
+touches. `download` is gated (zero-egress environments); `cpp_extension`
+is a real JIT C-extension builder over the baked toolchain (see
+cpp_extension.py); the in-tree native runtime itself lives in csrc/.
 """
 from __future__ import annotations
 
@@ -13,8 +14,8 @@ import warnings
 from . import unique_name
 from . import dlpack
 
-__all__ = ["unique_name", "deprecated", "try_import", "run_check", "download",
-           "dlpack"]
+__all__ = ["unique_name", "deprecated", "try_import", "run_check",
+           "download", "dlpack", "cpp_extension"]
 
 
 def deprecated(update_to="", since="", reason="", level=0):

@@ -19,11 +19,13 @@ def get_build_directory():
     return d
 
 
-def CppExtension(sources, *args, **kwargs):
-    """Build descriptor for a C++ custom op (setuptools.Extension)."""
+def CppExtension(sources, **kwargs):
+    """Build descriptor for a C++ custom op (setuptools.Extension).
+    Extra Extension options (include_dirs, extra_compile_args, ...)
+    pass through as keywords."""
     from setuptools import Extension
     name = kwargs.pop("name", "paddle_custom_ext")
-    return Extension(name, sources=list(sources), *args, **kwargs)
+    return Extension(name, sources=list(sources), **kwargs)
 
 
 def CUDAExtension(sources, *args, **kwargs):
@@ -34,17 +36,23 @@ def CUDAExtension(sources, *args, **kwargs):
 
 
 def setup(**kwargs):
-    """Parity: cpp_extension.setup — delegates to setuptools.setup with
-    the ext_modules passed through."""
+    """Parity: cpp_extension.setup — delegates to setuptools.setup.
+    When invoked with no command (`python setup.py`), defaults to
+    `build_ext --inplace`; an explicit command line wins."""
+    import sys
     from setuptools import setup as _setup
-    kwargs.setdefault("script_args", ["build_ext", "--inplace"])
+    if len(sys.argv) < 2 and "script_args" not in kwargs:
+        kwargs["script_args"] = ["build_ext", "--inplace"]
     return _setup(**kwargs)
 
 
-def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
-         build_directory=None, verbose=False, **kwargs):
+def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
+         extra_include_paths=None, build_directory=None, verbose=False):
     """JIT-compile a C extension from sources and import it (parity:
-    cpp_extension.load). Uses the CPython C API toolchain in-place."""
+    cpp_extension.load). Uses the CPython C API toolchain in-place.
+    Rebuilds when sources are newer OR the build configuration
+    (source list / flags / includes) changed since the cached build."""
+    import hashlib
     import importlib.util
     import os
     import subprocess
@@ -54,15 +62,20 @@ def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
     os.makedirs(bdir, exist_ok=True)
     so_path = os.path.join(bdir, f"{name}.so")
     srcs = [os.path.abspath(s) for s in sources]
+    cmd = ["g++", "-O2", "-shared", "-fPIC",
+           f"-I{sysconfig.get_paths()['include']}"]
+    for inc in (extra_include_paths or []):
+        cmd.append(f"-I{inc}")
+    cmd += (extra_cxx_cflags or [])
+    cmd += srcs + ["-o", so_path] + (extra_ldflags or [])
+    sig = hashlib.sha256(" ".join(cmd).encode()).hexdigest()
+    sig_path = so_path + ".sig"
     newest_src = max(os.path.getmtime(s) for s in srcs)
-    if not os.path.exists(so_path) \
-            or os.path.getmtime(so_path) < newest_src:
-        cmd = ["g++", "-O2", "-shared", "-fPIC",
-               f"-I{sysconfig.get_paths()['include']}"]
-        for inc in (extra_include_paths or []):
-            cmd.append(f"-I{inc}")
-        cmd += (extra_cxx_cflags or [])
-        cmd += srcs + ["-o", so_path]
+    stale = (not os.path.exists(so_path)
+             or os.path.getmtime(so_path) < newest_src
+             or not os.path.exists(sig_path)
+             or open(sig_path).read() != sig)
+    if stale:
         if verbose:
             print(" ".join(cmd))
         res = subprocess.run(cmd, capture_output=not verbose, text=True)
@@ -70,6 +83,8 @@ def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
             raise RuntimeError(
                 "cpp_extension.load: compilation failed\n"
                 + (res.stderr or "") + (res.stdout or ""))
+        with open(sig_path, "w") as f:
+            f.write(sig)
     spec = importlib.util.spec_from_file_location(name, so_path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)

@@ -259,18 +259,25 @@ class VOC2012(Dataset):
                             "/SegmentationClass/" in m.name
                             or "/ImageSets/Segmentation/" in m.name):
                         self._blobs[m.name] = tf.extractfile(m).read()
-            names_blob = self._blobs[
-                f"{self._ROOT}/ImageSets/Segmentation/{split}"]
-            wanted = {f"{self._ROOT}/JPEGImages/{n.strip()}.jpg"
-                      for n in names_blob.decode().split("\n")
-                      if n.strip()}
+            split_key = f"{self._ROOT}/ImageSets/Segmentation/{split}"
+            if split_key not in self._blobs:
+                raise RuntimeError(
+                    f"VOC2012 archive has no {split_key} — is this the "
+                    "official VOCtrainval tar?")
+            self._names = [
+                n.strip() for n in
+                self._blobs[split_key].decode().split("\n") if n.strip()]
+            wanted = {f"{self._ROOT}/JPEGImages/{n}.jpg"
+                      for n in self._names}
             with tarfile.open(data_file) as tf:
                 for m in tf:
                     if m.name in wanted:
                         self._blobs[m.name] = tf.extractfile(m).read()
-        names = self._read(
-            f"{self._ROOT}/ImageSets/Segmentation/{split}")
-        self._names = [n for n in names.decode().split("\n") if n.strip()]
+        else:
+            names = self._read(
+                f"{self._ROOT}/ImageSets/Segmentation/{split}")
+            self._names = [n.strip() for n in names.decode().split("\n")
+                           if n.strip()]
         self._transform = transform
 
     def _read(self, rel):

@@ -56,3 +56,6 @@ def identity_loss(x, reduction="none"):
     if reduction in (1, "mean"):
         return m.mean(x)
     return x
+
+
+from . import optimizer  # noqa: E402  (LookAhead/ModelAverage)

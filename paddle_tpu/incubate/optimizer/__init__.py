@@ -1,0 +1,145 @@
+"""paddle.incubate.optimizer parity (python/paddle/incubate/optimizer/):
+LookAhead and ModelAverage — optimizer wrappers over slow/fast weights.
+Functional state (plain Tensors updated eagerly), so they compose with
+any inner optimizer and with the compiled TrainStep's eager fallback."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead optimizer (Zhang et al. 2019; parity:
+    python/paddle/incubate/optimizer/lookahead.py). Every k inner steps,
+    slow weights move toward fast weights by alpha and the fast weights
+    reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = None
+
+    @property
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def _ensure_slow(self):
+        if self._slow is None:
+            self._slow = [np.array(p.numpy()) for p in self._params]
+
+    def step(self):
+        self._ensure_slow()
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p, s in zip(self._params, self._slow):
+                new_slow = s + self.alpha * (p.numpy() - s)
+                s[...] = new_slow
+                p.set_value(Tensor(new_slow.astype(s.dtype)))
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return [], []
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        if self._slow is not None:
+            for i, s in enumerate(self._slow):
+                sd[f"lookahead_slow_{i}"] = s
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)  # never mutate the caller's dict
+        self._step_num = int(sd.pop("lookahead_step", 0))
+        slow = []
+        i = 0
+        while f"lookahead_slow_{i}" in sd:
+            slow.append(np.array(sd.pop(f"lookahead_slow_{i}")))
+            i += 1
+        self._slow = slow or None
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Parameter averaging for evaluation (parity:
+    python/paddle/incubate/optimizer/modelaverage.py). Upstream keeps a
+    sliding window of roughly clamp(rate * num_updates, min_window,
+    max_window) recent updates via rotating partial sums; the same
+    two-block rotation is used here. apply() swaps the averaged weights
+    in (restore() swaps back)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires parameters=")
+        self._params = list(parameters)
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        # two-block rotation: sum_1 = current block, sum_2 = previous
+        # block (upstream sum_1/2/3 collapse to two blocks here)
+        self._sum1 = [np.zeros(p.shape, np.float64) for p in self._params]
+        self._sum2 = [np.zeros(p.shape, np.float64) for p in self._params]
+        self._num1 = 0
+        self._num2 = 0
+        self._total = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values."""
+        self._total += 1
+        window = max(self._min_w, min(self._max_w,
+                                      int(self._rate * self._total)))
+        if self._num1 >= window:
+            # rotate: the old previous block falls out of the window
+            for s1, s2 in zip(self._sum1, self._sum2):
+                s2[...] = s1
+                s1[...] = 0
+            self._num2 = self._num1
+            self._num1 = 0
+        for p, s in zip(self._params, self._sum1):
+            s += np.asarray(p.numpy(), np.float64)
+        self._num1 += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in; with need_restore=False the
+        averaged weights are committed (no backup, restore() is a
+        no-op), matching the reference contract."""
+        n = self._num1 + self._num2
+        if n == 0:
+            return
+        if self._backup is not None:
+            raise RuntimeError(
+                "ModelAverage.apply() called twice without restore(); "
+                "call restore() first or pass need_restore=False")
+        if need_restore:
+            self._backup = [np.array(p.numpy()) for p in self._params]
+        for p, s1, s2 in zip(self._params, self._sum1, self._sum2):
+            p.set_value(Tensor(((s1 + s2) / n).astype(str(p.dtype))))
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.set_value(Tensor(b))
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return [], []

@@ -47,7 +47,8 @@ def setup(**kwargs):
 
 
 def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
-         extra_include_paths=None, build_directory=None, verbose=False):
+         extra_include_paths=None, build_directory=None, verbose=False,
+         extra_cuda_cflags=None):
     """JIT-compile a C extension from sources and import it (parity:
     cpp_extension.load). Uses the CPython C API toolchain in-place.
     Rebuilds when sources are newer OR the build configuration
@@ -57,6 +58,11 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
     import os
     import subprocess
     import sysconfig
+
+    if extra_cuda_cflags:
+        import warnings
+        warnings.warn("extra_cuda_cflags ignored: no CUDA toolchain here; "
+                      "device kernels are Pallas")
 
     bdir = build_directory or get_build_directory()
     os.makedirs(bdir, exist_ok=True)

@@ -587,3 +587,73 @@ class TestSecondWaveOptimizers:
             sch.step()
         assert abs(vals[0] - 0.05) < 1e-9
         assert abs(vals[4] - 0.1) < 1e-9 and abs(vals[5] - 0.1) < 1e-9
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_converges_and_syncs_slow(self):
+        import numpy as np
+        from paddle_tpu.incubate.optimizer import LookAhead
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        inner = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=3)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype("float32"))
+        l0 = None
+        for i in range(12):
+            loss = ((m(x) - x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if l0 is None:
+                l0 = float(loss)
+        assert float(loss) < l0
+        # after a sync step the fast weights EQUAL the slow ones
+        assert opt._step_num % opt.k == 0
+        for p, s in zip(m.parameters(), opt._slow):
+            np.testing.assert_allclose(p.numpy(), s, rtol=1e-6)
+        sd = opt.state_dict()
+        opt.set_state_dict(sd)
+        assert opt._step_num == 12
+
+    def test_model_average_apply_restore(self):
+        import numpy as np
+        import pytest
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        paddle.seed(1)
+        m = nn.Linear(3, 3)
+        ma = ModelAverage(0.15, parameters=m.parameters(),
+                          min_average_window=2, max_average_window=10)
+        vals = []
+        for i in range(4):
+            m.weight.set_value(paddle.to_tensor(
+                np.full((3, 3), float(i), np.float32)))
+            ma.step()
+            vals.append(float(i))
+        live = np.array(m.weight.numpy())
+        ma.apply()
+        # window covers the recent blocks (all 4 here: window >= min_w=2
+        # grows with rate*total but blocks keep the last rotation)
+        got = float(m.weight.numpy()[0, 0])
+        assert 0.0 < got < 3.0  # a mean of recent values, not the live w
+        # double-apply without restore is an error (would lose the live
+        # weights)
+        with pytest.raises(RuntimeError, match="restore"):
+            ma.apply()
+        ma.restore()
+        np.testing.assert_allclose(m.weight.numpy(), live)
+        # windowing: with min_window=1 and rate tiny, only the newest
+        # block survives rotation
+        ma2 = ModelAverage(0.001, parameters=m.parameters(),
+                           min_average_window=1, max_average_window=5)
+        for i in range(6):
+            m.weight.set_value(paddle.to_tensor(
+                np.full((3, 3), float(i), np.float32)))
+            ma2.step()
+        ma2.apply(need_restore=False)
+        # need_restore=False commits: restore() is a no-op
+        committed = np.array(m.weight.numpy())
+        ma2.restore()
+        np.testing.assert_allclose(m.weight.numpy(), committed)
+        # the average reflects only the window's blocks (recent values)
+        assert float(committed[0, 0]) >= 3.0

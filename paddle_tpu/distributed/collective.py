@@ -489,8 +489,8 @@ def batch_isend_irecv(p2p_op_list):
         perm = [(rank, (rank + shift) % world) for rank in range(world)]
         out = ppermute(s.tensor, perm)
         if isinstance(r.tensor, Tensor):
-            r.tensor._inplace_update(out._value if isinstance(out, Tensor)
-                                     else out)
+            r.tensor._inplace_update(out if isinstance(out, Tensor)
+                                     else Tensor(out))
         tasks.append(out)
 
     class _Task:

@@ -90,12 +90,6 @@ class StaticFunction:
         # lax.cond/while_loop dispatchers so data-dependent control flow
         # compiles instead of freezing at trace time
         self._fn = maybe_ast_transform(fn)
-        src = getattr(self._fn, "__transformed_source__", None)
-        if src is not None and (_VERBOSITY > 0 or _CODE_LEVEL < 100):
-            import logging
-            logging.getLogger("paddle_tpu.dy2static").info(
-                "transformed code of %s:\n%s",
-                getattr(fn, "__qualname__", fn), src)
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
@@ -132,6 +126,14 @@ class StaticFunction:
                _freeze(struct), training_now)
 
         if sig not in self._cache:
+            # verbosity/code-level are read at trace time, not decoration
+            # time, so set_verbosity() after @to_static still takes effect
+            src = getattr(self._fn, "__transformed_source__", None)
+            if src is not None and (_VERBOSITY > 0 or _CODE_LEVEL < 100):
+                import logging
+                logging.getLogger("paddle_tpu.dy2static").info(
+                    "transformed code of %s:\n%s",
+                    getattr(self._fn, "__qualname__", self._fn), src)
             fn = self._fn
             training = self._layer.training if self._layer is not None else False
 

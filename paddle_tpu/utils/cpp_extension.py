@@ -77,20 +77,32 @@ def load(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
     sig = hashlib.sha256(" ".join(cmd).encode()).hexdigest()
     sig_path = so_path + ".sig"
     newest_src = max(os.path.getmtime(s) for s in srcs)
-    stale = (not os.path.exists(so_path)
-             or os.path.getmtime(so_path) < newest_src
-             or not os.path.exists(sig_path)
-             or open(sig_path).read() != sig)
-    if stale:
-        if verbose:
-            print(" ".join(cmd))
-        res = subprocess.run(cmd, capture_output=not verbose, text=True)
-        if res.returncode != 0:
-            raise RuntimeError(
-                "cpp_extension.load: compilation failed\n"
-                + (res.stderr or "") + (res.stdout or ""))
-        with open(sig_path, "w") as f:
-            f.write(sig)
+
+    # serialize concurrent ranks/workers building the same extension:
+    # exclusive flock around the stale-check+build, and the .so lands via
+    # atomic rename so a reader never imports a half-written file
+    import fcntl
+    with open(so_path + ".lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        stale = (not os.path.exists(so_path)
+                 or os.path.getmtime(so_path) < newest_src
+                 or not os.path.exists(sig_path)
+                 or open(sig_path).read() != sig)
+        if stale:
+            tmp_so = so_path + f".tmp{os.getpid()}"
+            build_cmd = [tmp_so if a == so_path else a for a in cmd]
+            if verbose:
+                print(" ".join(build_cmd))
+            res = subprocess.run(build_cmd, capture_output=not verbose,
+                                 text=True)
+            if res.returncode != 0:
+                raise RuntimeError(
+                    "cpp_extension.load: compilation failed\n"
+                    + (res.stderr or "") + (res.stdout or ""))
+            os.replace(tmp_so, so_path)
+            with open(sig_path + ".tmp", "w") as f:
+                f.write(sig)
+            os.replace(sig_path + ".tmp", sig_path)
     spec = importlib.util.spec_from_file_location(name, so_path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)

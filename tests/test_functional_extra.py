@@ -806,3 +806,15 @@ class TestDetectionOpsRound3:
         import pytest
         with pytest.raises(RuntimeError, match="matched"):
             dist.batch_isend_irecv([])
+        # functional path with a Tensor recv buffer (regression: raw jax
+        # array used to be handed to _inplace_update and crashed)
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        buf = paddle.zeros([2, 3])
+        tasks = dist.batch_isend_irecv([
+            dist.P2POp(dist.isend, x, 0),
+            dist.P2POp(dist.irecv, buf, 0),
+        ])
+        for task in tasks:
+            task.wait()
+            assert task.is_completed()
+        np.testing.assert_allclose(buf.numpy(), x.numpy())

@@ -76,12 +76,6 @@ class DistributedModel(Layer):
                 raise TypeError(
                     "pp_degree > 1 requires the model to be a "
                     "fleet.meta_parallel.PipelineLayer")
-            if stage and int(stage) > 0:
-                raise NotImplementedError(
-                    "pp_degree > 1 with sharding_stage > 0 (ZeRO) is not "
-                    "composed yet: PipelineTrainStep shards stage bodies "
-                    "over 'stage' but replicates pre/post params. Drop "
-                    "sharding_configs or use dp x mp x ZeRO without pp.")
             if n_model_inputs != 1:
                 raise NotImplementedError(
                     "PipelineTrainStep feeds exactly one model input "
@@ -89,18 +83,16 @@ class DistributedModel(Layer):
                     f"{n_model_inputs}")
             if batch_specs is not None:
                 raise NotImplementedError(
-                    "batch_specs is not supported with pp_degree > 1; the "
-                    "pipeline shards batch dim 0 over 'data' automatically")
-            if scaler is not None and scaler.is_enable():
-                raise NotImplementedError(
-                    "GradScaler with pp_degree > 1 is not wired yet; use "
-                    "bf16 (no scaler needed on TPU) for pipeline models")
+                    "batch_specs is not supported with pp_degree > 1; drop it — "
+                    "the pipeline shards batch dim 0 over 'data' "
+                    "automatically")
             acc = int(st.pipeline_configs.get("accumulate_steps", 1) or 1)
             self._train_step = PipelineTrainStep(
                 self._layers, opt, loss_fn,
                 num_microbatches=max(acc, 1), mesh=mesh,
                 num_virtual_stages=getattr(self._layers,
-                                           "_num_virtual_stages", 1))
+                                           "_num_virtual_stages", 1),
+                zero_stage=int(stage or 0), scaler=scaler)
             return self._train_step
         self._train_step = DistTrainStep(
             self._layers, opt, loss_fn, n_model_inputs=n_model_inputs,

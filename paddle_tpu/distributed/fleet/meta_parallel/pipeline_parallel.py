@@ -120,35 +120,56 @@ def _run_layers(layers, p_tensors, p_vals, b_tensors, b_vals, x_val,
 # the scanned-shard_map schedules (GPipe and interleaved)
 # ---------------------------------------------------------------------------
 
-def _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh, axis):
-    """Shared harness for both schedules: manual over the 'stage' axis,
-    auto over everything else; params sharded on their leading chunk dim,
-    activations/key replicated in-spec (the stage body's own TP tags
-    compose via GSPMD).
+def _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh, axis,
+                    x_spec=P()):
+    """Shared harness for both schedules: manual over the 'stage' axis
+    (plus the sequence axis named in x_spec, if any), auto over
+    everything else; params sharded on their leading chunk dim, the
+    stage body's own TP tags compose via GSPMD.
+
+    When x_spec shards the sequence dim (context parallelism composed
+    with pp), activations stay sequence-sharded through the whole
+    schedule — each stage holds only its 1/cp sequence slice, and ring
+    attention inside the body runs its local kernel over the manual
+    'context' axis (nested manual computations cannot be lowered).
 
     check_vma=True is required: this jax version's partial-manual
     shard_map mis-builds internal specs with check_vma=False.
     """
+    manual = {axis} | {a for a in x_spec if a is not None}
     run = jax.shard_map(
         staged, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
-                  P(), P()),
-        out_specs=P(axis),
-        axis_names={axis}, check_vma=True)
+                  x_spec, P()),
+        out_specs=P(axis, *x_spec),
+        axis_names=manual, check_vma=True)
     outs = run(stacked_params, x_micro,
                rng_key if rng_key is not None else jax.random.key(0))
     return outs[-1]
 
 
-def _varying(axis, val):
+def _varying(axes, val):
     """Mark a scan carry stage-varying up front (scan requires carry
     types invariant across iterations)."""
-    return jax.lax.pcast(val, (axis,), to="varying")
+    if isinstance(axes, str):
+        axes = (axes,)
+    return jax.lax.pcast(val, tuple(axes), to="varying")
+
+
+def _seq_spec(x_micro, mesh, seq_axis):
+    """PartitionSpec sharding x_micro's sequence dim (dim 2 of
+    [M, Bm, T, ...]) over seq_axis, or P() when not applicable."""
+    if not seq_axis or mesh.shape.get(seq_axis, 1) <= 1:
+        return P()
+    if x_micro.ndim < 4 or x_micro.shape[2] % mesh.shape[seq_axis]:
+        return P()
+    return P(*([None, None, seq_axis] + [None] * (x_micro.ndim - 3)))
 
 
 def pipeline_spmd(body_fn: Callable, stacked_params, x_micro, *,
                   num_stages: int, mesh: Mesh, rng_key=None,
-                  use_remat: bool = True, axis: str = "stage"):
+                  use_remat: bool = True, axis: str = "stage",
+                  seq_axis: Optional[str] = None):
     """Run the pipelined forward.
 
     body_fn(params_one_stage, x, key) -> y with y.shape == x.shape.
@@ -156,6 +177,12 @@ def pipeline_spmd(body_fn: Callable, stacked_params, x_micro, *,
     x_micro: [M, Bm, ...] microbatched stage-0 inputs (already embedded).
     Returns [M, Bm, ...] last-stage outputs. Differentiable (jax.grad
     reverses the schedule).
+
+    seq_axis: context parallelism composed with pp — x_micro's sequence
+    dim (dim 2) is sharded over this mesh axis and activations stay
+    sequence-sharded through the schedule; the body must use ring/
+    Ulysses attention (any op mixing sequence positions directly would
+    act on the local slice only).
     """
     S = int(num_stages)
     M = int(x_micro.shape[0])
@@ -170,14 +197,17 @@ def pipeline_spmd(body_fn: Callable, stacked_params, x_micro, *,
 
     body = jax.checkpoint(body_fn) if use_remat else body_fn
     perm = [(i, (i + 1) % S) for i in range(S)]
+    x_spec = _seq_spec(x_micro, mesh, seq_axis)
+    vary = (axis,) + tuple(a for a in x_spec if a is not None)
 
     def staged(p_local, xm, key):
         # p_local leaves: [1, ...] (this stage's slice); xm replicated
+        # (or sequence-sharded under seq_axis)
         sid = jax.lax.axis_index(axis)
         p_mine = jax.tree_util.tree_map(lambda a: a[0], p_local)
-        state0 = _varying(axis, jnp.zeros(xm.shape[1:], xm.dtype))
+        state0 = _varying(vary, jnp.zeros(xm.shape[1:], xm.dtype))
         outbuf0 = _varying(
-            axis, jnp.zeros((M,) + tuple(xm.shape[1:]), xm.dtype))
+            vary, jnp.zeros((M,) + tuple(xm.shape[1:]), xm.dtype))
 
         def tick(carry, t):
             state, outbuf = carry
@@ -202,13 +232,14 @@ def pipeline_spmd(body_fn: Callable, stacked_params, x_micro, *,
         return outbuf[None]  # [1, M, Bm, ...] -> concat over 'stage'
 
     return _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh,
-                           axis)
+                           axis, x_spec)
 
 
 def pipeline_spmd_interleaved(body_fn: Callable, stacked_params, x_micro,
                               *, num_stages: int, num_virtual: int,
                               mesh: Mesh, rng_key=None,
-                              use_remat: bool = True, axis: str = "stage"):
+                              use_remat: bool = True, axis: str = "stage",
+                              seq_axis: Optional[str] = None):
     """Interleaved virtual-stage schedule (reference parity:
     fleet/meta_parallel/pipeline_parallel.py
     PipelineParallelWithInterleave). Each device owns V chunks — chunk c
@@ -238,14 +269,16 @@ def pipeline_spmd_interleaved(body_fn: Callable, stacked_params, x_micro,
     T = ((M - 1) // S) * W + ((M - 1) % S) + C
     body = jax.checkpoint(body_fn) if use_remat else body_fn
     perm = [(i, (i + 1) % S) for i in range(S)]
+    x_spec = _seq_spec(x_micro, mesh, seq_axis)
+    vary = (axis,) + tuple(a for a in x_spec if a is not None)
 
     def staged(p_local, xm, key):
         sid = jax.lax.axis_index(axis)
         # p_local leaves: [V, ...] — this device's chunk stack
-        state0 = _varying(axis, jnp.zeros(xm.shape[1:], xm.dtype))
+        state0 = _varying(vary, jnp.zeros(xm.shape[1:], xm.dtype))
         tag0 = _varying(axis, jnp.full((2,), -1, jnp.int32))
         outbuf0 = _varying(
-            axis, jnp.zeros((M,) + tuple(xm.shape[1:]), xm.dtype))
+            vary, jnp.zeros((M,) + tuple(xm.shape[1:]), xm.dtype))
 
         def tick(carry, t):
             act, tags, outbuf = carry
@@ -284,7 +317,7 @@ def pipeline_spmd_interleaved(body_fn: Callable, stacked_params, x_micro,
         return outbuf[None]
 
     return _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh,
-                           axis)
+                           axis, x_spec)
 
 
 def _ring_order(S: int, V: int):
@@ -317,7 +350,8 @@ class PipelineTrainStep:
                  num_microbatches: int = 1, mesh: Optional[Mesh] = None,
                  n_pre: Optional[int] = None, n_post: Optional[int] = None,
                  use_remat: bool = True, donate_state: bool = True,
-                 num_virtual_stages: int = 1):
+                 num_virtual_stages: int = 1, zero_stage: int = 0,
+                 scaler=None):
         from ....optimizer.optimizer import Lamb
         if isinstance(optimizer, Lamb):
             raise ValueError(
@@ -336,6 +370,15 @@ class PipelineTrainStep:
         self._M = int(num_microbatches)
         self._use_remat = use_remat
         self._donate = donate_state
+        # ZeRO composition (reference: dygraph sharding stages under pp).
+        # stage >= 1 shards optimizer state over 'data'; stage == 3 also
+        # shards the parameters themselves — GSPMD inserts the all-gather
+        # at use / reduce-scatter of grads, the collectives the reference
+        # issues by hand in group_sharded_parallel.
+        self._zero = int(zero_stage)
+        self._dp = self._mesh.shape.get("data", 1)
+        self._scaler = scaler if (scaler is not None
+                                  and scaler.is_enable()) else None
 
         layers = list(model.run_function)
         if n_pre is None or n_post is None:
@@ -366,16 +409,46 @@ class PipelineTrainStep:
         self._pos_named = [self._chunk_named[c] for c in self._order]
 
         self._stacked_sh = []
+        self._stacked_zsh = []  # opt-state sharding base (ZeRO >= 1)
         for j, (_, p0) in enumerate(self._tmpl_named):
             tag = list(getattr(p0, "_partition_spec", P()) or ())
-            spec = P("stage", *tag)
+            shape = (self._C,) + tuple(p0._value.shape)
+            zspec = self._zspec(shape, ["stage"] + tag)
+            spec = zspec if self._zero >= 3 else P("stage", *tag)
             self._stacked_sh.append(NamedSharding(self._mesh, spec))
+            self._stacked_zsh.append(
+                NamedSharding(self._mesh, zspec) if self._zero >= 1
+                else self._stacked_sh[-1])
 
-        # pre/post params + buffers (trained unstaged)
+        # pre/post params + buffers (trained unstaged). A parameter
+        # OBJECT appearing in both (tied embeddings: the lm head reads
+        # the stage-0 embedding table) is owned by the pre list and
+        # bound into the postamble's trace by reference — one traced
+        # value, one gradient accumulating both uses, one update.
         self._pre_named = _named_params(self._pre)
-        self._post_named = _named_params(self._post)
+        pre_ids = {id(p): i for i, (_, p) in enumerate(self._pre_named)}
+        self._shared_post = []  # (tensor, index into pre list)
+        self._post_named = []
+        for n, p in _named_params(self._post):
+            if id(p) in pre_ids:
+                self._shared_post.append((p, pre_ids[id(p)]))
+            else:
+                self._post_named.append((n, p))
         self._pre_p = [p for _, p in self._pre_named]
         self._post_p = [p for _, p in self._post_named]
+
+        def _edge_sh(named):
+            psh, zsh = [], []
+            for _, p in named:
+                tag = list(getattr(p, "_partition_spec", P()) or ())
+                zspec = self._zspec(tuple(p._value.shape), tag)
+                psh.append(NamedSharding(
+                    self._mesh, zspec if self._zero >= 3 else P(*tag)))
+                zsh.append(NamedSharding(self._mesh, zspec)
+                           if self._zero >= 1 else psh[-1])
+            return psh, zsh
+        self._pre_sh, self._pre_zsh = _edge_sh(self._pre_named)
+        self._post_sh, self._post_zsh = _edge_sh(self._post_named)
         self._edge_b_named = _named_buffers(self._pre) + \
             _named_buffers(self._post)
         self._edge_b = [b for _, b in self._edge_b_named]
@@ -409,7 +482,8 @@ class PipelineTrainStep:
         if getattr(optimizer, "_lr_ratio", None) is not None:
             raise NotImplementedError(
                 "AdamW(lr_ratio=...) is parameter-object based and cannot "
-                "be applied to stage-stacked pipeline params")
+                "be applied to stage-stacked pipeline params; use a "
+                "plain learning_rate (or an LRScheduler) instead")
         self._p_names = (self._pre_names + self._chunk_names[0]
                          + self._post_names)
         self._seed_params = (self._pre_p + [None] * len(self._tmpl_named)
@@ -420,6 +494,42 @@ class PipelineTrainStep:
         # step must also trigger a re-read of the stacked leaves
         model._deferred_invalidate = self._mark_stale
         optimizer._deferred_invalidate = self._mark_stale
+
+    def _seq_axis(self):
+        """Sequence (context) parallelism composed with pp: enabled when
+        the mesh carries a context axis > 1 — i.e. the user configured
+        sep_degree — which is a CONTRACT that stage bodies use ring/
+        Ulysses attention (any op mixing sequence positions directly
+        would act on its local slice; same contract as the reference's
+        sep parallel). Warned once because it cannot be verified
+        statically."""
+        if self._mesh.shape.get("context", 1) <= 1:
+            return None
+        if not getattr(self, "_seq_warned", False):
+            self._seq_warned = True
+            import warnings
+            warnings.warn(
+                "pipeline with sep/context degree > 1: activations are "
+                "sequence-sharded through the stages. Stage bodies MUST "
+                "use ring/Ulysses attention (paddle_tpu.kernels."
+                "ring_attention) — plain dense/flash attention would "
+                "silently attend within each local sequence slice only.",
+                stacklevel=3)
+        return "context"
+
+    def _zspec(self, shape, base):
+        """ZeRO spec: insert 'data' into the first free dim of `base`
+        that divides by the dp degree (params/opt-state sharded over the
+        data axis; GSPMD all-gathers at use)."""
+        spec = list(base) + [None] * (len(shape) - len(base))
+        if self._dp > 1:
+            start = 1 if (spec and spec[0] == "stage") else 0
+            for i in range(start, len(shape)):
+                if (spec[i] is None and shape[i] >= self._dp
+                        and shape[i] % self._dp == 0):
+                    spec[i] = "data"
+                    break
+        return P(*spec)
 
     def _refresh_from_layers(self):
         """(Re)build the stage-stacked param leaves from the live layer
@@ -465,10 +575,10 @@ class PipelineTrainStep:
                     cand = jnp.stack(per_stage)
                     if cand.shape == st[k].shape:
                         st[k] = cand
-        # opt state mirrors each param's sharding
+        # opt state mirrors each param's sharding (ZeRO >= 1: the
+        # 'data'-sharded spec even where the param itself is replicated)
         repl = NamedSharding(self._mesh, P())
-        all_sh = ([repl] * len(self._pre_p) + self._stacked_sh
-                  + [repl] * len(self._post_p))
+        all_sh = self._pre_zsh + self._stacked_zsh + self._post_zsh
         placed = []
         self._s_sh = []
         for st, psh, pv in zip(self._opt_state, all_sh, all_vals):
@@ -512,6 +622,7 @@ class PipelineTrainStep:
         body = self._body_fn()
         pre_layers, post_layers = self._pre, self._post
         pre_p_t, post_p_t = self._pre_p, self._post_p
+        shared_post = self._shared_post
         edge_b_t = self._edge_b
         use_remat = self._use_remat
         n_pre = len(self._pre_p)
@@ -519,8 +630,12 @@ class PipelineTrainStep:
         p_names = self._p_names
         seed_params = self._seed_params
 
-        def step_fn(pre_v, stk_v, post_v, eb_v, opt_state, key, lr, batch):
+        scaler = self._scaler
+
+        def step_fn(pre_v, stk_v, post_v, eb_v, opt_state, key, lr, batch,
+                    scaler_st):
             x, labels = batch[0], batch[1:]
+            scale = scaler_st[0] if scaler is not None else None
 
             def loss_of(pre_v, stk_v, post_v):
                 k_pre, k_body, k_post = jax.random.split(key, 3)
@@ -529,38 +644,59 @@ class PipelineTrainStep:
                 B = h.shape[0]
                 hm = h.reshape((M, B // M) + tuple(h.shape[1:]))
                 stk_tree = list(stk_v)
+                seq_ax = self._seq_axis()
                 if V > 1:
                     om = pipeline_spmd_interleaved(
                         body, stk_tree, hm, num_stages=S, num_virtual=V,
-                        mesh=mesh, rng_key=k_body, use_remat=use_remat)
+                        mesh=mesh, rng_key=k_body, use_remat=use_remat,
+                        seq_axis=seq_ax)
                 else:
                     om = pipeline_spmd(body, stk_tree, hm, num_stages=S,
                                        mesh=mesh, rng_key=k_body,
-                                       use_remat=use_remat)
+                                       use_remat=use_remat,
+                                       seq_axis=seq_ax)
                 out = om.reshape((B,) + tuple(om.shape[2:]))
-                out2, new_b2 = _run_layers(post_layers, post_p_t, post_v,
+                # tied params: rebind the pre-side traced value into the
+                # postamble too (same value -> grads from both uses
+                # accumulate on the one pre-list entry)
+                sh_t = [p for p, _ in shared_post]
+                sh_v = [pre_v[i] for _, i in shared_post]
+                out2, new_b2 = _run_layers(post_layers,
+                                           post_p_t + sh_t,
+                                           post_v + sh_v,
                                            edge_b_t, new_b1, out,
                                            rng_key=k_post)
                 loss = loss_fn(Tensor(out2),
                                *[Tensor(l) for l in labels])
                 lv = loss._value if isinstance(loss, Tensor) else loss
-                return lv, new_b2
+                if scale is not None:
+                    # scale in f32: an f16 cast of scale > 65504 overflows
+                    return (lv.astype(jnp.float32) * scale, (lv, new_b2))
+                return lv, (lv, new_b2)
 
-            (loss_val, new_eb), grads = jax.value_and_grad(
+            (_, (loss_val, new_eb)), grads = jax.value_and_grad(
                 loss_of, argnums=(0, 1, 2), has_aux=True)(
                     list(pre_v), list(stk_v), list(post_v))
             flat_g = list(grads[0]) + list(grads[1]) + list(grads[2])
             flat_p = list(pre_v) + list(stk_v) + list(post_v)
+            if scaler is not None:
+                from ....amp.grad_scaler import (compiled_unscale,
+                                                 compiled_select_and_adapt)
+                flat_g, found_inf = compiled_unscale(scale, flat_g)
             flat_g = _clip_grads_functional(flat_g, grad_clip)
             new_p, new_state = opt._fn_apply_all(
                 flat_p, flat_g, opt_state, lr, p_names, seed_params)
+            if scaler is not None:
+                new_p, new_state, scaler_st = compiled_select_and_adapt(
+                    scaler, found_inf, new_p, flat_p, new_state,
+                    opt_state, scaler_st)
             return (loss_val, new_p[:n_pre], new_p[n_pre:n_pre + n_stk],
-                    new_p[n_pre + n_stk:], new_eb, new_state)
+                    new_p[n_pre + n_stk:], new_eb, new_state, scaler_st)
 
         repl = NamedSharding(mesh, P())
         donate = (0, 1, 2, 3, 4) if self._donate else ()
-        pre_sh = [repl] * len(self._pre_p)
-        post_sh = [repl] * len(self._post_p)
+        pre_sh = list(self._pre_sh)
+        post_sh = list(self._post_sh)
         eb_sh = [repl] * len(self._edge_b)
         # batch dim 0 shards over 'data' when divisible (dp x pp hybrid)
         dsize = mesh.shape.get("data", 1)
@@ -573,9 +709,9 @@ class PipelineTrainStep:
         jitted = jax.jit(
             step_fn,
             in_shardings=(pre_sh, self._stacked_sh, post_sh, eb_sh,
-                          self._s_sh, None, None, batch_sh),
+                          self._s_sh, None, None, batch_sh, None),
             out_shardings=(repl, pre_sh, self._stacked_sh, post_sh, eb_sh,
-                           self._s_sh),
+                           self._s_sh, None),
             donate_argnums=donate)
 
         def run(*args):
@@ -600,12 +736,17 @@ class PipelineTrainStep:
         gen = default_generator()
         key_in = gen.split()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        from ....amp.grad_scaler import scaler_state_in, scaler_state_out
+        sc = self._scaler
+        sc_in = scaler_state_in(sc) if sc is not None else ()
         (loss, new_pre, new_stk, new_post, new_eb,
-         new_state) = self._compiled[sig](
+         new_state, sc_out) = self._compiled[sig](
             [p._value for p in self._pre_p], list(self._stacked),
             [p._value for p in self._post_p],
             [b._value for b in self._edge_b],
-            self._opt_state, key_in, lr, arrays)
+            self._opt_state, key_in, lr, arrays, sc_in)
+        if sc is not None:
+            scaler_state_out(sc, sc_out)
         for t, v in zip(self._pre_p, new_pre):
             t._value = v
         for t, v in zip(self._post_p, new_post):

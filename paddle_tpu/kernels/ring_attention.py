@@ -35,11 +35,55 @@ from ..distributed.mesh import get_mesh, axis_size
 _NEG_INF = -1e30
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def _inside_manual(axis_name):
+    """True when tracing inside a shard_map that already manualizes
+    axis_name (values are local shards; collectives over it are legal)."""
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        return (ctx is not None and not ctx.empty
+                and axis_name in set(getattr(ctx, "manual_axes", ()) or ()))
+    except AttributeError:
+        return False
+
+
+def _pvary(x, axis_name):
+    """Mark x device-varying over every currently-manual mesh axis
+    (vma typing). check_vma=True needs every lax.cond branch / scan
+    carry to agree on vma; the online-softmax init states start out
+    replicated, while the q/k/v they merge with vary over axis_name AND
+    any outer shard_map's manual axes (e.g. the pipeline 'stage')."""
+    axes = {axis_name}
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx is not None and not ctx.empty:
+            axes |= set(ctx.manual_axes)
+    except AttributeError:
+        pass
+    try:
+        return lax.pcast(x, tuple(sorted(axes)), to="varying")
+    except (AttributeError, TypeError):
+        return x
+
+
+def _shard_map(fn, mesh, in_specs, out_specs, axis_name):
+    # Nesting: when called from inside another shard_map (e.g. the
+    # pipeline engine's stage body, manual over 'stage'), the inner
+    # shard_map must be built against the CONTEXT abstract mesh — whose
+    # already-manual axes are typed Manual — not the concrete mesh, and
+    # must manualize ONLY its own axis so the outer axes stay auto.
+    # check_vma=True is required for a correct transpose: with vma
+    # checking off, the backward of the nested ring mis-placed psums and
+    # produced silently wrong dq/dk/dv under an outer pipeline shard_map.
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx is not None and not ctx.empty and ctx._any_axis_manual:
+            mesh = ctx
+    except AttributeError:
+        pass
     try:
         from jax import shard_map as _sm  # jax >= 0.8
         return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_vma=False)
+                   axis_names={axis_name}, check_vma=True)
     except (ImportError, TypeError):
         from jax.experimental.shard_map import shard_map as _sm
         return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -58,7 +102,8 @@ def _sharded_attn(local_core, mesh, spec, q, k, v, kv_lens, lens_spec,
     if kv_lens is not None:
         args.append(jnp.asarray(kv_lens, jnp.int32))
         in_specs.append(lens_spec)
-    return _shard_map(local, mesh, tuple(in_specs), spec)(*args)
+    return _shard_map(local, mesh, tuple(in_specs), spec,
+                      core_kw["axis_name"])(*args)
 
 
 
@@ -124,10 +169,12 @@ def _ring_attention_local_zigzag(q, k, v, kv_lens=None, *, axis_name,
                     (new_m[qi], new_l[qi], new_acc[qi]))
         return tuple(new_m), tuple(new_l), tuple(new_acc)
 
-    m0 = tuple(jnp.full((b, h, half), _NEG_INF, jnp.float32)
+    m0 = tuple(_pvary(jnp.full((b, h, half), _NEG_INF, jnp.float32),
+                      axis_name) for _ in range(2))
+    l0 = tuple(_pvary(jnp.zeros((b, h, half), jnp.float32), axis_name)
                for _ in range(2))
-    l0 = tuple(jnp.zeros((b, h, half), jnp.float32) for _ in range(2))
-    acc0 = tuple(jnp.zeros((b, half, h, d), jnp.float32) for _ in range(2))
+    acc0 = tuple(_pvary(jnp.zeros((b, half, h, d), jnp.float32), axis_name)
+                 for _ in range(2))
 
     ms, ls, accs = process_block(k, v, idx, m0, l0, acc0)
 
@@ -161,9 +208,9 @@ def _ring_attention_local(q, k, v, kv_lens=None, *, axis_name, cp,
     idx = lax.axis_index(axis_name)
     qf = q.astype(jnp.float32)
 
-    m0 = jnp.full((b, h, sl), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sl), jnp.float32)
-    acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    m0 = _pvary(jnp.full((b, h, sl), _NEG_INF, jnp.float32), axis_name)
+    l0 = _pvary(jnp.zeros((b, h, sl), jnp.float32), axis_name)
+    acc0 = _pvary(jnp.zeros((b, sl, h, d), jnp.float32), axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     q_pos = idx * sl + jnp.arange(sl, dtype=jnp.int32)
@@ -243,6 +290,19 @@ def ring_attention_jax(query, key, value, *, causal=False, scale=None,
         return flash_attention_jax(query, key, value, causal=causal,
                                    scale=sc, kv_lens=kv_lens)
 
+    if _inside_manual(axis_name):
+        # already inside a shard_map that is manual over axis_name (the
+        # pipeline engine runs stage bodies with sequence-sharded
+        # activations: manual over {'stage', 'context'}). q/k/v here ARE
+        # the local contiguous-sequence shards — run the ring directly;
+        # XLA cannot lower a nested manual computation over the same
+        # mesh, and the layout is contiguous (no zig-zag pre-permute).
+        if kv_lens is not None:
+            kv_lens = jnp.asarray(kv_lens, jnp.int32)
+        return _ring_attention_local(query, key, value, kv_lens,
+                                     axis_name=axis_name, cp=cp,
+                                     causal=causal, scale=sc)
+
     spec = P(None, axis_name, None, None)
     lens_spec = P(None)
     if kv_lens is not None:
@@ -320,6 +380,12 @@ def ulysses_attention_jax(query, key, value, *, causal=False, scale=None,
             f"ulysses: num_heads {query.shape[2]} not divisible by "
             f"context-parallel degree {cp}")
 
+    if _inside_manual(axis_name):
+        if kv_lens is not None:
+            kv_lens = jnp.asarray(kv_lens, jnp.int32)
+        return _ulysses_local(query, key, value, kv_lens,
+                              axis_name=axis_name, causal=causal, scale=sc)
+
     spec = P(None, axis_name, None, None)
     return _sharded_attn(_ulysses_local, mesh, spec, query, key, value,
                          kv_lens, P(None), axis_name=axis_name,
@@ -351,12 +417,13 @@ def _tensor_entry(fn_jax, query, key, value, causal, scale, group,
 def _check_unsupported(attn_mask, dropout):
     if attn_mask is not None:
         raise NotImplementedError(
-            "ring/Ulysses attention supports causal masking (is_causal=) "
-            "and varlen padded batches (kv_lens=[B] lengths); arbitrary "
-            "dense attn_mask tensors are not supported")
+            "ring/Ulysses attention: arbitrary dense attn_mask tensors are "
+            "not supported; use is_causal= for causal masking and "
+            "kv_lens=[B] for varlen padded batches instead")
     if dropout:
         raise NotImplementedError(
-            "ring/Ulysses attention does not support dropout yet")
+            "ring/Ulysses attention does not support dropout yet; apply "
+            "dropout on the attention output instead")
 
 
 class RingFlashAttention:

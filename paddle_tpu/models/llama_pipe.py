@@ -78,13 +78,25 @@ class LlamaDecoderLayerPipe(Layer, _RopeMixin):
 
 
 class LlamaHeadPipe(Layer):
-    """Last stage: final RMSNorm + LM head. hidden -> logits."""
+    """Last stage: final RMSNorm + LM head. hidden -> logits.
 
-    def __init__(self, config: LlamaConfig):
+    tie_word_embeddings: holds the stage-0 embedding layer itself (one
+    shared Parameter object). Both embedding and head run UNSTAGED (pre/
+    postamble of PipelineTrainStep), and the train step rebinds the
+    pre-side traced value into the postamble — one gradient, one update
+    (the SharedLayerDesc role of the reference's modeling_pp.py)."""
+
+    def __init__(self, config: LlamaConfig, embedding=None):
         super().__init__()
         self.norm = LlamaRMSNorm(config)
         init = Normal(0.0, config.initializer_range)
-        if config.tensor_parallel:
+        if config.tie_word_embeddings:
+            if embedding is None:
+                raise ValueError(
+                    "tie_word_embeddings head needs the embedding stage")
+            self.lm_head = None
+            self.tied_embed = embedding
+        elif config.tensor_parallel:
             from ..distributed.fleet.meta_parallel.mp_layers import (
                 ColumnParallelLinear)
             self.lm_head = ColumnParallelLinear(
@@ -95,7 +107,12 @@ class LlamaHeadPipe(Layer):
                                   weight_attr=init, bias_attr=False)
 
     def forward(self, hidden_states):
-        return self.lm_head(self.norm(hidden_states))
+        h = self.norm(hidden_states)
+        if self.lm_head is None:
+            from .llama import parallel_matmul
+            return parallel_matmul(h, self.tied_embed.embed_tokens.weight,
+                                   transpose_y=True)
+        return self.lm_head(h)
 
 
 def LlamaForCausalLMPipe(config: LlamaConfig, num_stages=None,
@@ -115,11 +132,6 @@ def LlamaForCausalLMPipe(config: LlamaConfig, num_stages=None,
     """
     from ..distributed.fleet.meta_parallel import PipelineLayer
     from ..distributed.mesh import get_mesh
-    if config.tie_word_embeddings:
-        raise NotImplementedError(
-            "tie_word_embeddings needs SharedLayerDesc weight sharing "
-            "across the first and last pipeline stages; use untied "
-            "embeddings with the pipe model")
     mesh = get_mesh()
     if mesh is not None and num_stages is not None:
         pp = int(mesh.shape.get("stage", 1))
@@ -128,10 +140,12 @@ def LlamaForCausalLMPipe(config: LlamaConfig, num_stages=None,
                 f"num_stages={num_stages} but the bound mesh has "
                 f"stage degree {pp} (fleet pp_degree) — the mesh wins; "
                 "drop num_stages or make them agree")
-    stages = ([LlamaEmbeddingPipe(config)]
+    embed = LlamaEmbeddingPipe(config)
+    stages = ([embed]
               + [LlamaDecoderLayerPipe(config)
                  for _ in range(config.num_hidden_layers)]
-              + [LlamaHeadPipe(config)])
+              + [LlamaHeadPipe(config, embedding=embed
+                               if config.tie_word_embeddings else None)])
     return PipelineLayer(
         stages, num_stages=num_stages,
         num_virtual_pipeline_stages=num_virtual_pipeline_stages,

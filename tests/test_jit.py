@@ -645,6 +645,42 @@ class TestStaticProgramReplay:
         ref2 = np.maximum(b @ lin.weight.numpy() + lin.bias.numpy(), 0) * 2
         np.testing.assert_allclose(out2, ref2, atol=1e-5)
 
+    def test_executor_compiles_whole_program_once(self):
+        """Executor.run lowers the captured op list to ONE jitted program
+        per (program, feed-signature) — repeated runs hit the compile
+        cache (InterpreterCore's compile-and-cache role), and mutated
+        external tensors (params) are runtime inputs, never baked."""
+        import paddle_tpu.static as static
+        from paddle_tpu import nn
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            lin = nn.Linear(8, 3)
+            z = (lin(x) ** 2).mean()
+        assert len(main._build_ops) >= 3  # a multi-op graph, not one fn
+
+        exe = static.Executor()
+        a = np.random.RandomState(0).randn(4, 8).astype("float32")
+        (l0,) = exe.run(main, feed={"x": a}, fetch_list=[z])
+        for _ in range(5):
+            exe.run(main, feed={"x": a}, fetch_list=[z])
+        assert len(main._exec_cache) == 1  # 6 runs, one compiled program
+
+        # externals are inputs: mutate a param eagerly, same compiled
+        # program must observe the new value
+        lin.weight.set_value(np.zeros_like(lin.weight.numpy()))
+        (l1,) = exe.run(main, feed={"x": a}, fetch_list=[z])
+        assert len(main._exec_cache) == 1
+        b0 = float(np.mean(lin.bias.numpy() ** 2))
+        np.testing.assert_allclose(float(l1), b0, rtol=1e-5)
+        assert not np.allclose(l0, l1)
+
+        # a new feed shape is a new signature -> second cache entry
+        a2 = np.random.RandomState(1).randn(2, 8).astype("float32")
+        exe.run(main, feed={"x": a2}, fetch_list=[z])
+        assert len(main._exec_cache) == 2
+
     def test_recording_stops_outside_guard(self):
         import paddle_tpu.static as static
         main = static.Program()

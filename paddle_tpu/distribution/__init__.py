@@ -358,7 +358,8 @@ def kl_divergence(p, q):
         if isinstance(p, pc) and isinstance(q, qc):
             return fn(p, q)
     raise NotImplementedError(
-        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__}); "
+        "use @register_kl to add the pair")
 
 
 @register_kl(Normal, Normal)

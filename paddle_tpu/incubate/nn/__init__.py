@@ -186,23 +186,22 @@ class FusedMultiTransformer(Layer):
                  dropout_rate=0.0, activation="gelu", normalize_before=True,
                  num_layers=1, nranks=1, ring_id=-1, name=None):
         super().__init__()
-        if not normalize_before:
-            raise NotImplementedError(
-                "FusedMultiTransformer is pre-LN only (the reference op's "
-                "convention)")
         from ...nn.layers_common import LayerNorm
+        self._pre_ln = bool(normalize_before)
         self._layers = []
         for i in range(num_layers):
             blk = FusedTransformerEncoderLayer(
                 embed_dim, num_heads, dim_feedforward,
                 dropout_rate=dropout_rate, activation=activation,
-                normalize_before=True)
+                normalize_before=normalize_before)
             self.add_sublayer(f"layer_{i}", blk)
             self._layers.append(blk)
-        self.norm = LayerNorm(embed_dim)
+        # final norm exists only in the pre-LN convention (post-LN blocks
+        # already end with a layer norm)
+        self.norm = LayerNorm(embed_dim) if self._pre_ln else None
 
     def forward(self, src, attn_mask=None, caches=None, **kwargs):
         out = src
         for blk in self._layers:
             out = blk(out, src_mask=attn_mask)
-        return self.norm(out)
+        return self.norm(out) if self.norm is not None else out

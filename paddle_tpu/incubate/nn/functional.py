@@ -177,11 +177,11 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     semantics of the reference)."""
     from ...kernels.attention import flash_attention_bshd
 
-    if ring_id not in (-1, None):
-        raise NotImplementedError(
-            "fused_multi_head_attention(ring_id>=0): the tensor-parallel "
-            "allreduce path lives in meta_parallel (ColumnParallelLinear/"
-            "RowParallelLinear); compose those instead")
+    # ring_id >= 0 asks the reference kernel for a tensor-parallel
+    # allreduce after the out projection. Under GSPMD that collective is
+    # inserted by XLA whenever the projection weights carry mp partition
+    # specs (meta_parallel mp_layers tag them), and is a no-op for
+    # replicated weights — so the flag is accepted and subsumed.
     residual = x
     out = x
     if pre_layer_norm:
@@ -282,14 +282,28 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
     from ...ops._dispatch import apply as _apply
     from ...ops.creation import _coerce as _c
 
-    if pre_cache_length:
-        raise NotImplementedError(
-            "variable_length_memory_efficient_attention: "
-            "pre_cache_length>0 (prefix cache) — use the generation "
-            "stack's paged_attention for cached serving")
     q = transpose(query, [0, 2, 1, 3])      # -> [B, S, H, D]
     k = transpose(key, [0, 2, 1, 3])
     v = transpose(value, [0, 2, 1, 3])
+    if pre_cache_length and causal:
+        # prefix cache: k/v carry pre_cache_length cached tokens the
+        # queries may always attend; causality applies with that offset
+        # (q row i sees kv cols <= i + pre_cache_length). Expressed as
+        # an additive mask; compat shim for the reference serving op.
+        sq = int(_c(q)._value.shape[1])
+        skv = int(_c(k)._value.shape[1])
+        qpos = jnp.arange(sq)[:, None] + int(pre_cache_length)
+        kpos = jnp.arange(skv)[None, :]
+        oc = (kpos <= qpos)[None, None]         # bool keep-mask
+        if mask is None:
+            mask = oc
+        elif _c(mask)._value.dtype == jnp.bool_:
+            mask = _apply(lambda m: jnp.logical_and(m, oc), _c(mask))
+        else:
+            mask = _apply(
+                lambda m: m + jnp.where(oc, 0.0, -1e30).astype(m.dtype),
+                _c(mask))
+        causal = False
     out = flash_attention_bshd(q, k, v, attn_mask=mask, is_causal=causal,
                                scale=scale, kv_lens=kv_seq_lens)
     if seq_lens is not None:
@@ -318,15 +332,15 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
 
     if cache_kv is None:
         raise ValueError("masked_multihead_attention requires cache_kv")
-    if src_mask is not None or rotary_tensor is not None or rotary_emb_dims:
-        raise NotImplementedError(
-            "masked_multihead_attention: src_mask/rotary_tensor are not "
-            "wired yet — apply RoPE via fused_rotary_position_embedding "
-            "before the cache write, masks via flash_attention_bshd")
+    if rotary_emb_dims not in (0, 1):
+        raise ValueError(
+            "masked_multihead_attention: rotary_emb_dims must be 0 or 1 "
+            "(2-D rope is not a TPU serving configuration)")
     if qkv_out_scale is not None or out_scale != -1:
         raise NotImplementedError(
             "masked_multihead_attention: int8 quant legs are a GPU "
-            "serving path; TPU serving uses the bf16 predictor")
+            "serving path; use the bf16 predictor (weight-only int8 "
+            "lives in LLMPredictor quant_type=)")
     args = [_c(x), _c(cache_kv)]
     has_bias = bias is not None
     if has_bias:
@@ -334,16 +348,43 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     has_seq = sequence_lengths is not None
     if has_seq:
         args.append(_c(sequence_lengths))
+    has_rope = rotary_tensor is not None
+    if has_rope:
+        args.append(_c(rotary_tensor))
+    has_mask = src_mask is not None
+    if has_mask:
+        args.append(_c(src_mask))
+
+    def _rope1(q, cos, sin):
+        """[B, H, D] with [B, D] cos/sin at the current position."""
+        if use_neox_rotary_style:
+            dh = q.shape[-1] // 2
+            q1, q2 = q[..., :dh], q[..., dh:]
+            rot = jnp.concatenate([-q2, q1], axis=-1)
+        else:
+            q1 = q[..., 0::2]
+            q2 = q[..., 1::2]
+            rot = jnp.stack([-q2, q1], axis=-1).reshape(q.shape)
+        return q * cos[:, None, :] + rot * sin[:, None, :]
 
     def fn(xv, cache, *rest):
         it = iter(rest)
         bv = next(it) if has_bias else None
         sl = next(it) if has_seq else None
+        rope = next(it) if has_rope else None
+        smask = next(it) if has_mask else None
         if bv is not None:
             xv = xv + bv
         two, b, h, t, d = cache.shape
         qkv = xv.reshape(b, 3, h, d)
         q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        if rope is not None:
+            # reference rotary_tensor: [2, B, 1, S, D] cos/sin for the
+            # current decode position (S == 1 single-token step)
+            cos = rope[0].reshape(b, -1, d)[:, -1]
+            sin = rope[1].reshape(b, -1, d)[:, -1]
+            q = _rope1(q, cos, sin)
+            k_new = _rope1(k_new, cos, sin)
         # write position: current length (same for the whole batch if no
         # per-sequence lengths given — step index from mask of zeros)
         if sl is None:
@@ -362,6 +403,9 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         tpos = jnp.arange(t)[None, None, :]
         live = tpos <= pos[:, None, None]
         s = jnp.where(live, s, -1e30)
+        if smask is not None:
+            # additive [B, 1, 1, T]-style mask over the cache positions
+            s = s + smask.reshape(b, 1, -1)[..., :t].astype(s.dtype)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bht,bhtd->bhd", p, vals)
         return out.reshape(b, h * d), cache

@@ -26,27 +26,47 @@ def _check_algo(algo):
         raise ValueError(f"unsupported quant algo {algo!r}")
 
 
+def _group_check(n_in, group_size):
+    if group_size == -1:
+        return
+    if group_size < 2 or group_size % 2 or n_in % group_size:
+        raise ValueError(
+            f"group_size {group_size} must be even and divide the in "
+            f"dim {n_in} (use -1 for per-channel scales)")
+
+
 def weight_quantize(x, algo="weight_only_int8", group_size=-1):
-    """Per-output-channel absmax quantization of a [in, out] weight.
-    Returns (quantized_weight int8, scale float32 [out]). int4 packs two
-    nibbles per int8 byte along the in dim (row-major pairs)."""
+    """Absmax quantization of a [in, out] weight. group_size=-1: one
+    scale per output channel, scale [out]; group_size=g: one scale per
+    (g-row in-dim block, output channel), scale [in//g, out] — the
+    finer-grained scheme GPTQ/AWQ checkpoints use. int4 packs two
+    nibbles per int8 byte along the in dim (row-major pairs; g is even,
+    so pairs never straddle a group boundary)."""
     _check_algo(algo)
     w = np.asarray(_coerce(x)._value, np.float32)
-    if group_size not in (-1,):
-        raise NotImplementedError(
-            "grouped scales not implemented; use per-channel (-1)")
-    absmax = np.maximum(np.abs(w).max(axis=0), 1e-8)   # [out]
+    _group_check(w.shape[0], group_size)
+    if group_size == -1:
+        absmax = np.maximum(np.abs(w).max(axis=0), 1e-8)     # [out]
+        row_max = absmax                                     # bcasts [in,out]
+    else:
+        g = group_size
+        wg = w.reshape(w.shape[0] // g, g, w.shape[1])
+        absmax = np.maximum(np.abs(wg).max(axis=1), 1e-8)    # [in//g, out]
+        row_max = np.repeat(absmax, g, axis=0)               # [in, out]
     if algo == "weight_only_int4":
-        q = np.clip(np.round(w / absmax * 7.0), -8, 7).astype(np.int8)
+        q = np.clip(np.round(w / row_max * 7.0), -8, 7).astype(np.int8)
         if q.shape[0] % 2:
             q = np.concatenate([q, np.zeros((1, q.shape[1]), np.int8)])
         lo = q[0::2] & 0x0F
         hi = (q[1::2] & 0x0F) << 4
         packed = (lo | hi).astype(np.int8)             # [ceil(in/2), out]
-        return Tensor(jnp.asarray(packed)), Tensor(
-            jnp.asarray(absmax / 7.0))
-    q = np.clip(np.round(w / absmax * 127.0), -127, 127).astype(np.int8)
-    return Tensor(jnp.asarray(q)), Tensor(jnp.asarray(absmax / 127.0))
+        scale = absmax / 7.0
+    else:
+        q = np.clip(np.round(w / row_max * 127.0),
+                    -127, 127).astype(np.int8)
+        packed = q
+        scale = absmax / 127.0
+    return Tensor(jnp.asarray(packed)), Tensor(jnp.asarray(scale))
 
 
 def _unpack_int4(packed, in_features=None):
@@ -64,18 +84,32 @@ def _unpack_int4(packed, in_features=None):
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8",
-                      out_dtype="float32"):
+                      out_dtype="float32", group_size=-1):
     """Inverse of weight_quantize (float reconstruction). int4 packs in
     pairs along the in dim, so an odd original in-dim comes back with
     one trailing zero pad row — slice to the original shape if needed
     (weight_only_linear strips it automatically)."""
     _check_algo(algo)
 
+    if group_size != -1:
+        n_groups = int(_coerce(scale)._value.shape[0])
+        _group_check(n_groups * group_size, group_size)
+
     def fn(q, s):
         if algo == "weight_only_int4":
             w = _unpack_int4(q)
         else:
             w = q
+        if group_size != -1:
+            # grouped quantization requires an even group dividing the in
+            # dim, so the unpacked weight has exactly n_groups*g rows
+            if s.shape[0] * group_size != w.shape[0]:
+                raise ValueError(
+                    f"group_size {group_size} x {s.shape[0]} scale "
+                    f"groups covers {s.shape[0] * group_size} rows, but "
+                    f"the weight has {w.shape[0]} — pass the group_size "
+                    "used at quantization")
+            s = jnp.repeat(s, group_size, axis=0)
         return (w.astype(jnp.float32) * s).astype(out_dtype)
     return apply(fn, _coerce(x), _coerce(scale), _name="weight_dequant")
 
@@ -88,20 +122,21 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     reads 1/2 - 1/4 the bytes)."""
     if weight_scale is None:
         raise ValueError("weight_only_linear requires weight_scale")
-    if group_size != -1:
-        raise NotImplementedError(
-            "grouped scales not implemented; use per-channel (-1)")
     args = [_coerce(x), _coerce(weight), _coerce(weight_scale)]
     has_bias = bias is not None
     if has_bias:
         args.append(_coerce(bias))
     in_features = int(_coerce(x)._value.shape[-1])
+    _group_check(in_features, group_size)
 
     def fn(v, q, s, *rest):
         if weight_dtype == "int4":
             w = _unpack_int4(q, in_features)
         else:
             w = q
+        if group_size != -1:
+            # s: [in//g, out] — expand to per-row scales
+            s = jnp.repeat(s, group_size, axis=0)
         w = (w.astype(jnp.float32) * s).astype(v.dtype)
         y = v @ w
         if rest:

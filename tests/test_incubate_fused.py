@@ -181,20 +181,72 @@ class TestReviewRegressions:
         assert cache.shape == [2, B, 5, H, D]
         assert out1.shape == [B, 1, E]
 
+    def test_masked_mmha_rope_and_src_mask(self):
+        """rotary_tensor applies rope to q/k before the cache write;
+        src_mask adds to the scores over cache positions."""
+        T = 4
+        cache = t(np.zeros((2, B, H, T, D), "float32"))
+        x = rng.randn(B, 3 * H * D).astype("float32")
+        cos = rng.randn(B, D).astype("float32")
+        sin = rng.randn(B, D).astype("float32")
+        rope = np.stack([cos, sin]).reshape(2, B, 1, 1, D)
+        o, cache = IF.masked_multihead_attention(
+            t(x), cache_kv=cache, rotary_tensor=t(rope),
+            rotary_emb_dims=1, use_neox_rotary_style=True)
+        qkv = x.reshape(B, 3, H, D)
+
+        def rope_np(v):
+            dh = D // 2
+            rot = np.concatenate([-v[..., dh:], v[..., :dh]], -1)
+            return v * cos[:, None] + rot * sin[:, None]
+        q, k, vv = rope_np(qkv[:, 0]), rope_np(qkv[:, 1]), qkv[:, 2]
+        # single live cache slot -> softmax over one key = 1 -> out = v
+        np.testing.assert_allclose(o.numpy(), vv.reshape(B, H * D),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cache.numpy())[0, :, :, 0],
+                                   k, rtol=1e-4, atol=1e-5)
+
+        # src_mask: block slot 0 => step-2 output attends only slot 1
+        x2 = rng.randn(B, 3 * H * D).astype("float32")
+        smask = np.zeros((B, 1, 1, T), "float32")
+        smask[..., 0] = -1e30
+        o2, cache = IF.masked_multihead_attention(
+            t(x2), cache_kv=cache, src_mask=t(smask))
+        v2 = x2.reshape(B, 3, H, D)[:, 2]
+        np.testing.assert_allclose(o2.numpy(), v2.reshape(B, H * D),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_varlen_attention_pre_cache(self):
+        """pre_cache_length: queries attend the cached prefix plus the
+        offset-causal part of the fresh tokens."""
+        pre, Sq = 2, 3
+        Skv = pre + Sq
+        q = rng.randn(B, H, Sq, D).astype("float32")
+        k = rng.randn(B, H, Skv, D).astype("float32")
+        v = rng.randn(B, H, Skv, D).astype("float32")
+        lens = np.full((B,), Skv, "int32")
+        out = IF.variable_length_memory_efficient_attention(
+            t(q), t(k), t(v), t(np.full((B,), Sq, "i4")), t(lens),
+            causal=True, pre_cache_length=pre)
+        qb = np.transpose(q, (0, 2, 1, 3))
+        kb = np.transpose(k, (0, 2, 1, 3))
+        vb = np.transpose(v, (0, 2, 1, 3))
+        s = np.einsum("bqhd,bkhd->bhqk", qb, kb) / np.sqrt(D)
+        keep = (np.arange(Skv)[None, :]
+                <= (np.arange(Sq)[:, None] + pre))[None, None]
+        s = np.where(keep, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bkhd->bqhd", p, vb)
+        np.testing.assert_allclose(
+            out.numpy(), np.transpose(ref, (0, 2, 1, 3)), atol=2e-4)
+
     def test_unsupported_args_raise(self):
         import pytest
         cache = t(np.zeros((2, B, H, 4, D), "float32"))
         x = t(rng.randn(B, 3 * H * D).astype("float32"))
-        with pytest.raises(NotImplementedError):
-            IF.masked_multihead_attention(
-                x, cache_kv=cache, rotary_tensor=x, rotary_emb_dims=1)
-        with pytest.raises(NotImplementedError):
-            IF.variable_length_memory_efficient_attention(
-                t(rng.randn(B, H, 4, D).astype("f4")),
-                t(rng.randn(B, H, 4, D).astype("f4")),
-                t(rng.randn(B, H, 4, D).astype("f4")),
-                t(np.array([4, 4], "i4")), t(np.array([4, 4], "i4")),
-                pre_cache_length=2)
+        with pytest.raises(NotImplementedError, match="bf16 predictor"):
+            IF.masked_multihead_attention(x, cache_kv=cache, out_scale=0.5)
         with pytest.raises(ValueError):
             paddle.to_tensor(np.zeros((2, 3, 4), "f4")).fill_diagonal_(1.0)
 

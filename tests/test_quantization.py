@@ -149,6 +149,46 @@ class TestWeightOnlyQuant:
             weight_scale=sc, weight_dtype="int4")
         assert tuple(y.shape) == (2, 8)
 
+    def test_grouped_scales_int8_and_int4(self):
+        """group_size=g: per-(in-block, out-channel) scales — tighter
+        reconstruction than per-channel when row magnitudes vary."""
+        from paddle_tpu.nn import quant
+        rng = np.random.RandomState(3)
+        # rows with wildly different magnitudes (worst case for one
+        # per-channel scale)
+        w = (rng.randn(64, 16) *
+             np.logspace(-2, 0, 64)[:, None]).astype("float32")
+        x = rng.randn(4, 64).astype("float32")
+        ref = x @ w
+
+        qw, sc = quant.weight_quantize(paddle.to_tensor(w), group_size=16)
+        assert tuple(sc.shape) == (4, 16)
+        wd = quant.weight_dequantize(qw, sc, group_size=16).numpy()
+        y = quant.weight_only_linear(paddle.to_tensor(x), qw,
+                                     weight_scale=sc,
+                                     group_size=16).numpy()
+        # grouped must beat per-channel on this weight (mean error —
+        # the small-magnitude rows get their own, finer scale)
+        qw_pc, sc_pc = quant.weight_quantize(paddle.to_tensor(w))
+        wd_pc = quant.weight_dequantize(qw_pc, sc_pc).numpy()
+        assert np.abs(wd - w).mean() < np.abs(wd_pc - w).mean() / 2
+        assert np.abs(y - ref).max() / np.abs(ref).max() < 0.02
+
+        # int4 grouped
+        q4, s4 = quant.weight_quantize(paddle.to_tensor(w),
+                                       algo="weight_only_int4",
+                                       group_size=16)
+        assert tuple(s4.shape) == (4, 16)
+        y4 = quant.weight_only_linear(paddle.to_tensor(x), q4,
+                                      weight_scale=s4,
+                                      weight_dtype="int4",
+                                      group_size=16).numpy()
+        assert np.abs(y4 - ref).max() / np.abs(ref).max() < 0.2
+
+        import pytest
+        with pytest.raises(ValueError, match="group_size"):
+            quant.weight_quantize(paddle.to_tensor(w), group_size=7)
+
     def test_weight_only_linear_bias_and_llm_int8(self):
         from paddle_tpu.nn import quant
         rng = np.random.RandomState(2)

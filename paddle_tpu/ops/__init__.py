@@ -196,11 +196,6 @@ def _zero_(self):
 Tensor.fill_ = _fill_
 Tensor.zero_ = _zero_
 
-# module-level inplace aliases paddle exposes
-scale_ = lambda x, *a, **kw: x.scale_(*a, **kw)  # noqa: E731
-clip_ = lambda x, *a, **kw: x.clip_(*a, **kw)  # noqa: E731
-tanh_ = lambda x, *a, **kw: x.tanh_(*a, **kw)  # noqa: E731
-
 
 # second batch of in-place variants (the long tail paddle exposes)
 _INPLACE2 = {
@@ -276,20 +271,6 @@ Tensor.bitwise_invert_ = _make_inplace(logic.bitwise_not)
 
 # top-level in-place function aliases (parity: python/paddle/tensor/ops.py
 # *_-suffixed exports)
-exp_ = lambda x, *a, **kw: x.exp_(*a, **kw)  # noqa: E731
-sqrt_ = lambda x, *a, **kw: x.sqrt_(*a, **kw)  # noqa: E731
-rsqrt_ = lambda x, *a, **kw: x.rsqrt_(*a, **kw)  # noqa: E731
-reciprocal_ = lambda x, *a, **kw: x.reciprocal_(*a, **kw)  # noqa: E731
-floor_ = lambda x, *a, **kw: x.floor_(*a, **kw)  # noqa: E731
-ceil_ = lambda x, *a, **kw: x.ceil_(*a, **kw)  # noqa: E731
-round_ = lambda x, *a, **kw: x.round_(*a, **kw)  # noqa: E731
-trunc_ = lambda x, *a, **kw: x.trunc_(*a, **kw)  # noqa: E731
-lerp_ = lambda x, *a, **kw: x.lerp_(*a, **kw)  # noqa: E731
-subtract_ = lambda x, *a, **kw: x.subtract_(*a, **kw)  # noqa: E731
-square_ = lambda x, *a, **kw: x.square_(*a, **kw)  # noqa: E731
-frac_ = lambda x, *a, **kw: x.frac_(*a, **kw)  # noqa: E731
-zero_ = lambda x: x.zero_()  # noqa: E731
-fill_ = lambda x, v: x.fill_(v)  # noqa: E731
 bitwise_invert = logic.bitwise_not
 
 
@@ -373,3 +354,133 @@ Tensor.data_ptr = _data_ptr
 Tensor.data = property(lambda self: self, _set_data)
 Tensor.value = lambda self: self
 Tensor.get_tensor = lambda self: self
+
+
+# ---------------------------------------------------------------------------
+# surface tail (round 4): aliases, module-level in-place exports, and the
+# remaining small ops ported code reaches for (reference:
+# python/paddle/tensor/__init__.py name inventory)
+# ---------------------------------------------------------------------------
+
+absolute = math.abs                       # paddle.absolute == paddle.abs
+less = logic.less_than                    # alias pair of less_than
+reverse = manipulation.flip               # legacy name for flip
+
+
+def sigmoid(x, name=None):
+    import jax.nn as _jnn
+    return apply(_jnn.sigmoid, x, _name="sigmoid")
+
+
+def fliplr(x, name=None):
+    """Flip along dim 1 (parity: paddle.fliplr; requires ndim >= 2)."""
+    return manipulation.flip(x, axis=1)
+
+
+def flipud(x, name=None):
+    """Flip along dim 0 (parity: paddle.flipud)."""
+    return manipulation.flip(x, axis=0)
+
+
+def vdot(x, y, name=None):
+    """Flattened conj-dot (parity: paddle.vdot / torch.vdot)."""
+    def fn(a, b):
+        return jnp.vdot(a, b)
+    return apply(fn, x, y, _name="vdot")
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    """input + value * tensor1 * tensor2 (parity: paddle.addcmul)."""
+    def fn(a, t1, t2):
+        return a + value * t1 * t2
+    return apply(fn, input, tensor1, tensor2, _name="addcmul")
+
+
+def addcdiv(input, tensor1, tensor2, value=1.0, name=None):
+    """input + value * tensor1 / tensor2 (parity: paddle.addcdiv)."""
+    def fn(a, t1, t2):
+        return a + value * t1 / t2
+    return apply(fn, input, tensor1, tensor2, _name="addcdiv")
+
+
+def chain_matmul(*mats, name=None):
+    """Chained matmul of 2-D tensors (parity: legacy chain_matmul)."""
+    if len(mats) == 1 and isinstance(mats[0], (list, tuple)):
+        mats = tuple(mats[0])
+    out = mats[0]
+    for m in mats[1:]:
+        out = linalg.matmul(out, m)
+    return out
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (parity:
+    paddle.cholesky_inverse)."""
+    def fn(l):
+        import jax.scipy.linalg as jsl
+        eye = jnp.eye(l.shape[-1], dtype=l.dtype)
+        # jsl.cho_solve takes `lower`; paddle's flag is `upper`
+        return jsl.cho_solve((l, not upper), eye)
+    return apply(fn, x, _name="cholesky_inverse")
+
+
+def nonzero_static(x, size, fill_value=-1, name=None):
+    """Static-shape nonzero (parity: paddle.nonzero_static): returns
+    [size, ndim] indices padded/truncated with fill_value — the
+    jit-compatible form (dynamic nonzero cannot live under jit)."""
+    def fn(v):
+        idx = jnp.nonzero(v, size=int(size), fill_value=None)
+        # jnp fills out-of-range with the last valid index; rebuild the
+        # paddle fill semantics from the true count
+        n = jnp.sum((v != 0).astype(jnp.int64))
+        stacked = jnp.stack(idx, axis=1).astype(jnp.int64)
+        live = jnp.arange(int(size))[:, None] < n
+        return jnp.where(live, stacked, jnp.int64(fill_value))
+    return apply(fn, x, _name="nonzero_static")
+
+
+def _log_normal_(self, mean=1.0, std=2.0, shape=None, name=None):
+    """In-place log-normal fill (parity: Tensor.log_normal_)."""
+    self._check_inplace()
+    from ..framework.random import next_key
+    import jax.random as jrandom
+
+    def fn(v):
+        k = next_key()
+        return jnp.exp(mean + std * jrandom.normal(k, v.shape,
+                                                   jnp.float32)
+                       ).astype(v.dtype)
+    return self._inplace_update(apply(fn, self, _name="log_normal_"))
+
+
+Tensor.log_normal_ = _log_normal_
+
+# remaining Tensor in-place methods the reference exposes
+_INPLACE3 = {
+    "tan_": math.tan, "tril_": creation.tril, "triu_": creation.triu,
+    "masked_scatter_": extras.masked_scatter,
+    "index_add_": (lambda self, index, axis, value:
+                   manipulation.index_add(self, index, axis, value)),
+}
+for _n, _f in _INPLACE3.items():
+    setattr(Tensor, _n, _make_inplace(_f))
+    _patched.add(_n)
+
+
+def _module_inplace(name):
+    def fn(x, *a, **kw):
+        return getattr(x, name)(*a, **kw)
+    fn.__name__ = name
+    return fn
+
+
+# module-level in-place exports (paddle.sin_(x) etc. mirror Tensor.sin_)
+for _n in ("sin_", "cos_", "tan_", "pow_", "mod_", "tril_", "triu_",
+           "index_add_", "index_fill_", "index_put_", "masked_fill_",
+           "masked_scatter_", "fill_diagonal_", "flatten_", "sigmoid_",
+           "log_normal_", "lerp_", "erfinv_", "trunc_", "renorm_",
+           "add_", "subtract_", "multiply_", "divide_", "exp_", "sqrt_",
+           "rsqrt_", "reciprocal_", "floor_", "ceil_", "round_", "abs_",
+           "neg_", "remainder_", "cast_", "fill_", "zero_", "t_",
+           "scale_", "clip_", "tanh_", "square_", "frac_"):
+    globals().setdefault(_n, _module_inplace(_n))

@@ -31,6 +31,13 @@ SURFACE = {
         take_along_axis tan tanh tensordot tile to_tensor tolist topk
         trace transpose tril triu trunc unbind unflatten unfold uniform
         unique unsqueeze unstack vander var where zeros
+        absolute addcdiv addcmul chain_matmul cholesky_inverse fliplr
+        flipud less nonzero_static reverse sigmoid vdot
+        sin_ cos_ tan_ pow_ mod_ tril_ triu_ index_add_ index_fill_
+        index_put_ masked_fill_ masked_scatter_ fill_diagonal_ flatten_
+        sigmoid_ log_normal_ lerp_ erfinv_ trunc_ add_ subtract_
+        multiply_ divide_ exp_ sqrt_ rsqrt_ reciprocal_ floor_ ceil_
+        round_ abs_ neg_ remainder_ cast_ fill_ zero_ t_
         reduce_as set_printoptions batch in_dynamic_mode in_static_mode
         is_autocast_enabled get_autocast_dtype amp_guard save load seed
         no_grad enable_grad set_grad_enabled is_grad_enabled grad

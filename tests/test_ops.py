@@ -620,3 +620,107 @@ class TestRound3Aliases:
         with pytest.raises(RuntimeError, match="no network"):
             download.get_path_from_url("http://example.com/w.pdparams",
                                        "/tmp/definitely_missing_dir")
+
+
+class TestSurfaceTailR4:
+    """OpTest-style numpy goldens for the round-4 surface-tail ops."""
+
+    def setup_method(self):
+        self.rng = np.random.RandomState(7)
+
+    def test_aliases(self):
+        x = paddle.to_tensor(self.rng.randn(3, 4).astype("float32"))
+        np.testing.assert_allclose(paddle.absolute(x).numpy(),
+                                   np.abs(x.numpy()))
+        y = paddle.to_tensor(self.rng.randn(3, 4).astype("float32"))
+        np.testing.assert_array_equal(paddle.less(x, y).numpy(),
+                                      x.numpy() < y.numpy())
+        np.testing.assert_allclose(paddle.reverse(x, axis=0).numpy(),
+                                   x.numpy()[::-1])
+        np.testing.assert_allclose(paddle.fliplr(x).numpy(),
+                                   np.fliplr(x.numpy()))
+        np.testing.assert_allclose(paddle.flipud(x).numpy(),
+                                   np.flipud(x.numpy()))
+        np.testing.assert_allclose(
+            paddle.sigmoid(x).numpy(),
+            1.0 / (1.0 + np.exp(-x.numpy())), rtol=1e-6)
+
+    def test_addc_family(self):
+        a = self.rng.randn(4).astype("float32")
+        t1 = self.rng.randn(4).astype("float32")
+        t2 = self.rng.rand(4).astype("float32") + 0.5
+        np.testing.assert_allclose(
+            paddle.addcmul(paddle.to_tensor(a), paddle.to_tensor(t1),
+                           paddle.to_tensor(t2), value=0.5).numpy(),
+            a + 0.5 * t1 * t2, rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.addcdiv(paddle.to_tensor(a), paddle.to_tensor(t1),
+                           paddle.to_tensor(t2), value=0.5).numpy(),
+            a + 0.5 * t1 / t2, rtol=1e-6)
+
+    def test_chain_matmul_and_vdot(self):
+        ms = [self.rng.randn(3, 4).astype("float32"),
+              self.rng.randn(4, 5).astype("float32"),
+              self.rng.randn(5, 2).astype("float32")]
+        out = paddle.chain_matmul(*[paddle.to_tensor(m) for m in ms])
+        np.testing.assert_allclose(out.numpy(), ms[0] @ ms[1] @ ms[2],
+                                   rtol=1e-5)
+        v = self.rng.randn(6).astype("float32")
+        w = self.rng.randn(6).astype("float32")
+        np.testing.assert_allclose(
+            paddle.vdot(paddle.to_tensor(v), paddle.to_tensor(w)).numpy(),
+            np.vdot(v, w), rtol=1e-6)
+
+    def test_cholesky_inverse(self):
+        L = np.tril(self.rng.rand(4, 4) + 4 * np.eye(4)).astype("float32")
+        A = L @ L.T
+        inv = paddle.cholesky_inverse(paddle.to_tensor(L)).numpy()
+        np.testing.assert_allclose(inv @ A, np.eye(4), atol=1e-5)
+        invU = paddle.cholesky_inverse(paddle.to_tensor(L.T.copy()),
+                                       upper=True).numpy()
+        np.testing.assert_allclose(invU @ A, np.eye(4), atol=1e-5)
+
+    def test_nonzero_static(self):
+        x = np.array([[0.0, 2.0], [3.0, 0.0]], "float32")
+        out = paddle.nonzero_static(paddle.to_tensor(x), size=4).numpy()
+        np.testing.assert_array_equal(
+            out, [[0, 1], [1, 0], [-1, -1], [-1, -1]])
+        # truncation when size < count
+        out2 = paddle.nonzero_static(paddle.to_tensor(x), size=1).numpy()
+        np.testing.assert_array_equal(out2, [[0, 1]])
+        # works under jit (the reason this op exists)
+        import paddle_tpu
+        f = paddle_tpu.jit.to_static(
+            lambda v: paddle.nonzero_static(v, size=4))
+        np.testing.assert_array_equal(f(paddle.to_tensor(x)).numpy(), out)
+
+    def test_module_level_inplace(self):
+        x = paddle.to_tensor(np.full((2, 2), 0.5, "float32"))
+        paddle.sin_(x)
+        np.testing.assert_allclose(x.numpy(), np.sin(np.full((2, 2), 0.5)),
+                                   rtol=1e-6)
+        m = paddle.to_tensor(self.rng.randn(3, 3).astype("float32"))
+        ref = np.tril(m.numpy())
+        paddle.tril_(m)
+        np.testing.assert_allclose(m.numpy(), ref)
+        s = paddle.to_tensor(self.rng.randn(5).astype("float32"))
+        paddle.sigmoid_(s)
+        assert (s.numpy() > 0).all() and (s.numpy() < 1).all()
+        g = paddle.to_tensor(np.zeros(2000, "float32"))
+        paddle.log_normal_(g, mean=0.0, std=0.5)
+        vals = g.numpy()
+        assert (vals > 0).all()
+        assert abs(np.log(vals).mean()) < 0.1  # log-mean ~ 0
+
+    def test_inplace_masked_scatter_and_index_add(self):
+        x = paddle.to_tensor(np.zeros((2, 3), "float32"))
+        mask = paddle.to_tensor(np.array([[True, False, True],
+                                          [False, True, False]]))
+        vals = paddle.to_tensor(np.arange(1, 7, dtype=np.float32))
+        x.masked_scatter_(mask, vals)
+        np.testing.assert_allclose(
+            x.numpy(), [[1, 0, 2], [0, 3, 0]])
+        y = paddle.to_tensor(np.zeros((3, 2), "float32"))
+        paddle.index_add_(y, paddle.to_tensor(np.array([0, 2])), 0,
+                          paddle.to_tensor(np.ones((2, 2), "float32")))
+        np.testing.assert_allclose(y.numpy(), [[1, 1], [0, 0], [1, 1]])

@@ -1,0 +1,137 @@
+// Package paddle binds the paddle_tpu C inference ABI (csrc/capi.cc,
+// header csrc/pd_inference_c_api.h) for Go via cgo.
+//
+// Reference parity: paddle/fluid/inference/goapi — the upstream Go
+// inference client over capi_exp. Build with the shared library from
+// `make -C csrc` on the library path:
+//
+//	CGO_CFLAGS="-I${REPO}/csrc" CGO_LDFLAGS="-L${REPO}/csrc -lpaddle_capi" \
+//	  go build ./...
+//
+// Validated by tests/test_native.py::test_go_binding_compiles when a Go
+// toolchain is present (skipped otherwise — the CI image ships none).
+package paddle
+
+/*
+#cgo CFLAGS: -I..
+#cgo LDFLAGS: -lpaddle_capi
+#include <stdlib.h>
+#include "pd_inference_c_api.h"
+*/
+import "C"
+
+import (
+	"fmt"
+	"unsafe"
+)
+
+// Predictor serves a paddle_tpu.jit.save'd StableHLO artifact.
+type Predictor struct {
+	handle unsafe.Pointer
+}
+
+// Version reports the native library version string.
+func Version() string {
+	return C.GoString(C.PD_GetVersion())
+}
+
+func lastError() error {
+	return fmt.Errorf("paddle: %s", C.GoString(C.PD_GetLastError()))
+}
+
+// NewPredictor loads the artifact at modelPath (without extension, as
+// PD_PredictorCreate expects).
+func NewPredictor(modelPath string) (*Predictor, error) {
+	cpath := C.CString(modelPath)
+	defer C.free(unsafe.Pointer(cpath))
+	h := C.PD_PredictorCreate(cpath)
+	if h == nil {
+		return nil, lastError()
+	}
+	return &Predictor{handle: h}, nil
+}
+
+// Destroy releases the native predictor.
+func (p *Predictor) Destroy() {
+	if p.handle != nil {
+		C.PD_PredictorDestroy(p.handle)
+		p.handle = nil
+	}
+}
+
+// SetInputNum declares how many inputs the next Run consumes.
+func (p *Predictor) SetInputNum(n int) {
+	C.PD_PredictorSetInputNum(p.handle, C.int(n))
+}
+
+// SetInputFloat32 binds a float32 tensor to input slot index.
+func (p *Predictor) SetInputFloat32(index int, shape []int64,
+	data []float32) error {
+	return p.setInput(index, "float32", shape, unsafe.Pointer(&data[0]))
+}
+
+// SetInputInt64 binds an int64 tensor to input slot index.
+func (p *Predictor) SetInputInt64(index int, shape []int64,
+	data []int64) error {
+	return p.setInput(index, "int64", shape, unsafe.Pointer(&data[0]))
+}
+
+func (p *Predictor) setInput(index int, dtype string, shape []int64,
+	data unsafe.Pointer) error {
+	cdtype := C.CString(dtype)
+	defer C.free(unsafe.Pointer(cdtype))
+	rc := C.PD_PredictorSetInput(p.handle, C.int(index), cdtype,
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), C.int(len(shape)), data)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Run executes the compiled model on the bound inputs.
+func (p *Predictor) Run() error {
+	if rc := C.PD_PredictorRun(p.handle); rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// OutputNum reports how many outputs the last Run produced.
+func (p *Predictor) OutputNum() int {
+	return int(C.PD_PredictorGetOutputNum(p.handle))
+}
+
+// OutputShape returns output i's shape.
+func (p *Predictor) OutputShape(i int) []int64 {
+	nd := int(C.PD_PredictorGetOutputNdim(p.handle, C.int(i)))
+	if nd <= 0 {
+		return nil
+	}
+	shape := make([]int64, nd)
+	C.PD_PredictorGetOutputShape(p.handle, C.int(i),
+		(*C.int64_t)(unsafe.Pointer(&shape[0])))
+	return shape
+}
+
+// OutputDtype returns output i's dtype string ("float32", "int64", ...).
+func (p *Predictor) OutputDtype(i int) string {
+	return C.GoString(C.PD_PredictorGetOutputDtype(p.handle, C.int(i)))
+}
+
+// OutputFloat32 copies output i into a new float32 slice.
+func (p *Predictor) OutputFloat32(i int) ([]float32, error) {
+	nbytes := int64(C.PD_PredictorGetOutputBytes(p.handle, C.int(i)))
+	if nbytes < 0 {
+		return nil, lastError()
+	}
+	out := make([]float32, nbytes/4)
+	if len(out) == 0 {
+		return out, nil
+	}
+	rc := C.PD_PredictorCopyOutput(p.handle, C.int(i),
+		unsafe.Pointer(&out[0]))
+	if rc != 0 {
+		return nil, lastError()
+	}
+	return out, nil
+}

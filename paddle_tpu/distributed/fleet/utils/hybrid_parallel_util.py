@@ -1,24 +1,52 @@
 """Parity: paddle.distributed.fleet.utils.hybrid_parallel_util — manual
 grad-sync helpers for the NCCL hybrid engine. Compiled collectives make
 them no-ops here (XLA inserts the reductions inside the train step);
-kept so ported trainer scripts run unchanged."""
+kept so ported trainer scripts run unchanged.
+
+CAVEAT (warned once at runtime): these are no-ops ONLY when training
+goes through a compiled Dist/Pipeline train step. A ported script that
+hand-rolls its loop eagerly and relies on fused_allreduce_gradients for
+dp grad sync will silently train un-synced — use
+fleet.distributed_model(...).train_batch or DistTrainStep instead.
+"""
 from __future__ import annotations
+
+import warnings
 
 __all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
            "broadcast_dp_parameters", "broadcast_sharding_parameters"]
 
+_warned = set()
+
+
+def _noop_notice(name):
+    if name in _warned:
+        return
+    _warned.add(name)
+    warnings.warn(
+        f"{name} is a no-op in paddle_tpu: gradient/parameter sync is "
+        "inserted by XLA inside the compiled train step. If you are "
+        "hand-rolling an eager training loop and relying on this call "
+        "for synchronization, it is NOT happening — run the step through "
+        "fleet.distributed_model(...).train_batch / DistTrainStep.",
+        stacklevel=3)
+
 
 def fused_allreduce_gradients(parameter_list, hcg=None):
+    _noop_notice("fused_allreduce_gradients")
     return None
 
 
 def broadcast_mp_parameters(model, hcg=None):
+    _noop_notice("broadcast_mp_parameters")
     return None
 
 
 def broadcast_dp_parameters(model, hcg=None):
+    _noop_notice("broadcast_dp_parameters")
     return None
 
 
 def broadcast_sharding_parameters(model, hcg=None):
+    _noop_notice("broadcast_sharding_parameters")
     return None

@@ -30,3 +30,27 @@ _cache = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+# ---------------------------------------------------------------------------
+# shard markers: one marker per file so CI (and humans) can split the
+# suite — `pytest -m distributed`, `pytest -m "not kernels"`, or run
+# shards in parallel processes (`pytest -n 4`, pytest-xdist).
+# ---------------------------------------------------------------------------
+_SHARDS = {
+    "kernels": {"test_pallas_train.py", "test_long_context.py"},
+    "distributed": {"test_distributed.py", "test_pipeline.py",
+                    "test_moe.py", "test_multiprocess.py",
+                    "test_launch.py", "test_trainer.py"},
+    "surface": {"test_ops.py", "test_tensor.py", "test_api_surface.py",
+                "test_functional_extra.py", "test_guards.py"},
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pt
+    for item in items:
+        base = item.fspath.basename
+        for mark, files in _SHARDS.items():
+            if base in files:
+                item.add_marker(getattr(_pt.mark, mark))

@@ -1,0 +1,117 @@
+/**
+ * Java client for the paddle_tpu C inference ABI (csrc/capi.cc, header
+ * csrc/pd_inference_c_api.h) via JNA — no JNI glue to compile.
+ *
+ * Reference parity: paddle/fluid/inference/javaapi (upstream's Java
+ * inference client over capi_exp).
+ *
+ * Build: put jna.jar on the classpath and libpaddle_capi.so (from
+ * `make -C csrc`) on jna.library.path:
+ *
+ *   javac -cp jna.jar PaddleInference.java
+ *   java  -cp jna.jar:. -Djna.library.path=$REPO/csrc Demo
+ *
+ * Validated by tests/test_native.py::TestJavaBinding when a JDK is
+ * present (skipped otherwise — the CI image ships none).
+ */
+import com.sun.jna.Library;
+import com.sun.jna.Native;
+import com.sun.jna.Pointer;
+
+public class PaddleInference implements AutoCloseable {
+
+    /** Direct mapping of pd_inference_c_api.h. */
+    public interface CApi extends Library {
+        CApi INSTANCE = Native.load("paddle_capi", CApi.class);
+
+        String PD_GetVersion();
+        String PD_GetLastError();
+
+        Pointer PD_PredictorCreate(String modelPath);
+        void PD_PredictorDestroy(Pointer predictor);
+
+        void PD_PredictorSetInputNum(Pointer predictor, int n);
+        int PD_PredictorSetInput(Pointer predictor, int index, String dtype,
+                                 long[] shape, int ndim, float[] data);
+        int PD_PredictorRun(Pointer predictor);
+
+        int PD_PredictorGetOutputNum(Pointer predictor);
+        int PD_PredictorGetOutputNdim(Pointer predictor, int i);
+        int PD_PredictorGetOutputShape(Pointer predictor, int i,
+                                       long[] shape);
+        String PD_PredictorGetOutputDtype(Pointer predictor, int i);
+        long PD_PredictorGetOutputBytes(Pointer predictor, int i);
+        int PD_PredictorCopyOutput(Pointer predictor, int i, float[] dst);
+    }
+
+    private Pointer handle;
+
+    public PaddleInference(String modelPath) {
+        handle = CApi.INSTANCE.PD_PredictorCreate(modelPath);
+        if (handle == null) {
+            throw new RuntimeException(
+                "paddle: " + CApi.INSTANCE.PD_GetLastError());
+        }
+    }
+
+    public static String version() {
+        return CApi.INSTANCE.PD_GetVersion();
+    }
+
+    public void setInputNum(int n) {
+        CApi.INSTANCE.PD_PredictorSetInputNum(handle, n);
+    }
+
+    public void setInputFloat(int index, long[] shape, float[] data) {
+        int rc = CApi.INSTANCE.PD_PredictorSetInput(
+            handle, index, "float32", shape, shape.length, data);
+        if (rc != 0) {
+            throw new RuntimeException(
+                "paddle: " + CApi.INSTANCE.PD_GetLastError());
+        }
+    }
+
+    public void run() {
+        if (CApi.INSTANCE.PD_PredictorRun(handle) != 0) {
+            throw new RuntimeException(
+                "paddle: " + CApi.INSTANCE.PD_GetLastError());
+        }
+    }
+
+    public int outputNum() {
+        return CApi.INSTANCE.PD_PredictorGetOutputNum(handle);
+    }
+
+    public long[] outputShape(int i) {
+        int nd = CApi.INSTANCE.PD_PredictorGetOutputNdim(handle, i);
+        long[] shape = new long[Math.max(nd, 0)];
+        if (nd > 0) {
+            CApi.INSTANCE.PD_PredictorGetOutputShape(handle, i, shape);
+        }
+        return shape;
+    }
+
+    public float[] outputFloat(int i) {
+        long nbytes = CApi.INSTANCE.PD_PredictorGetOutputBytes(handle, i);
+        if (nbytes < 0) {
+            throw new RuntimeException(
+                "paddle: " + CApi.INSTANCE.PD_GetLastError());
+        }
+        float[] out = new float[(int) (nbytes / 4)];
+        if (out.length > 0
+                && CApi.INSTANCE.PD_PredictorCopyOutput(handle, i, out)
+                   != 0) {
+            throw new RuntimeException(
+                "paddle: " + CApi.INSTANCE.PD_GetLastError());
+        }
+        return out;
+    }
+
+    @Override
+    public void close() {
+        if (handle != null) {
+            CApi.INSTANCE.PD_PredictorDestroy(handle);
+            handle = null;
+        }
+    }
+}

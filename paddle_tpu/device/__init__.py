@@ -3,6 +3,9 @@ from __future__ import annotations
 
 import jax
 
+from ..framework.place import (  # noqa: F401
+    is_compiled_with_xpu,
+)
 from ..framework.place import (
     set_device, get_device, CPUPlace, TPUPlace, XLAPlace, CUDAPlace,
     is_compiled_with_cuda, is_compiled_with_tpu,
@@ -131,8 +134,10 @@ class cuda:
             return {}
 
 
-# paddle.device.tpu mirrors the cuda shim (same queries, honest name)
+# paddle.device.tpu mirrors the cuda shim (same queries, honest name);
+# device.xpu too (ported Kunlun scripts query it before falling back)
 tpu = cuda
+xpu = cuda
 
 
 def synchronize(device=None):

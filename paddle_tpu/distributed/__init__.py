@@ -27,8 +27,10 @@ from . import fleet
 from .fleet import DistributedStrategy
 from .auto_parallel_api import (
     ProcessMesh, shard_tensor, shard_op, Shard, Replicate, Partial,
-    dtensor_from_fn, reshard, shard_layer,
+    dtensor_from_fn, reshard, shard_layer, unshard_dtensor,
+    shard_optimizer, in_auto_parallel_align_mode, Strategy, to_static,
 )
+from . import auto_parallel_api as auto_parallel
 from . import checkpoint
 from . import rpc
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model

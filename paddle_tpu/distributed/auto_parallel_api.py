@@ -216,3 +216,150 @@ def shard_op(op, mesh: ProcessMesh = None, in_placements=None,
 
 def get_mesh_from_tensor(t):
     return getattr(t, "_process_mesh", None)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a sharded tensor to a fully replicated dense tensor
+    (parity: paddle.distributed.unshard_dtensor). Under the single-
+    controller model the global array already holds the logical value —
+    unsharding is dropping the placement annotation and replicating."""
+    import jax
+    from .mesh import get_mesh
+    t = dist_tensor
+    v = t._value
+    mesh = get_mesh()
+    if mesh is not None and getattr(v, "sharding", None) is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        v = jax.device_put(v, NamedSharding(mesh, PartitionSpec()))
+    out = Tensor(v, stop_gradient=t.stop_gradient)
+    return out
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """paddle.distributed.shard_optimizer parity. The reference rewrites
+    the optimizer so its accumulators follow each param's placement; here
+    the compiled train steps already mirror optimizer-state sharding
+    from the param shardings (DistTrainStep._s_sh /
+    PipelineTrainStep._stacked_zsh), so the optimizer passes through
+    with the intent recorded."""
+    optimizer._shard_fn = shard_fn
+    return optimizer
+
+
+def in_auto_parallel_align_mode():
+    """Alignment-debug mode of the reference's auto-parallel engine;
+    never active here (single-controller SPMD has nothing to align)."""
+    return False
+
+
+class Strategy:
+    """paddle.distributed.Strategy (auto-parallel training strategy)
+    parity: option bags consumed by dist.to_static. Each sub-config is an
+    attribute namespace like the reference's."""
+
+    class _Cfg:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        cfg = config or {}
+
+        def sub(name, **defaults):
+            merged = {**defaults, **cfg.get(name, {})}
+            return Strategy._Cfg(**merged)
+
+        self.sharding = sub("sharding", enable=False, degree=8, stage=1)
+        self.fused_passes = sub("fused_passes", enable=False,
+                                fused_passes_list=[])
+        self.gradient_merge = sub("gradient_merge", enable=False,
+                                  k_steps=1, avg=True)
+        self.pipeline = sub("pipeline", enable=False,
+                            schedule_mode="1F1B", micro_batch_size=1,
+                            accumulate_steps=1)
+        self.amp = sub("amp", enable=False, dtype="float16", level="O1")
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """paddle.distributed.to_static parity: wrap a (possibly
+    shard_tensor-annotated) layer + loss + optimizer into a compiled
+    DistModel-style object with train/eval/predict modes. The engine role
+    (reference: auto_parallel/api.py DistModel) is filled by
+    DistTrainStep: one jitted SPMD step per mode.
+
+    Batch convention (matching the reference's (inputs, labels) loader
+    contract): every element but the LAST is a model input; the last is
+    the loss label. Strategy is applied where it maps: sharding.enable ->
+    ZeRO stage on the step; amp.enable -> a GradScaler (float16) inside
+    the step; unsupported bags (gradient_merge, fused_passes) warn."""
+    from .fleet.dist_step import DistTrainStep
+    from .mesh import ensure_mesh
+
+    class DistModel:
+        def __init__(self):
+            self._layer = layer
+            self._loss = loss
+            self._opt = optimizer
+            self._loader = loader
+            self._strategy = strategy
+            self._mode = "train"
+            self._step = None
+
+        def train(self):
+            self._mode = "train"
+
+        def eval(self):
+            self._mode = "eval"
+
+        def predict(self):
+            self._mode = "predict"
+
+        def _strategy_kwargs(self):
+            st = self._strategy
+            kw = {}
+            if st is None:
+                return kw
+            import warnings
+            if getattr(st.sharding, "enable", False):
+                kw["sharding_stage"] = int(st.sharding.stage)
+            if getattr(st.amp, "enable", False):
+                from ..amp import GradScaler
+                kw["scaler"] = GradScaler()
+            for name in ("gradient_merge", "fused_passes"):
+                if getattr(getattr(st, name), "enable", False):
+                    warnings.warn(
+                        f"dist.to_static: Strategy.{name} is not applied "
+                        "here (XLA performs pass fusion; accumulate via "
+                        "pipeline accumulate_steps)", stacklevel=2)
+            return kw
+
+        def __call__(self, *batch):
+            n_in = max(len(batch) - 1, 1)
+            if self._mode == "train":
+                if self._step is None:
+                    if self._loss is None or self._opt is None:
+                        raise RuntimeError(
+                            "train mode needs loss and optimizer; call "
+                            "dist.to_static(layer, loader, loss, opt)")
+                    self._step = DistTrainStep(
+                        self._layer, self._opt,
+                        (lambda out, *lbl: self._loss(out, *lbl)),
+                        n_model_inputs=n_in, mesh=ensure_mesh(),
+                        **self._strategy_kwargs())
+                return self._step(*batch)
+            if self._mode == "predict":
+                return self._layer(*batch)
+            out = self._layer(*batch[:n_in])
+            if self._loss is not None:
+                return self._loss(out, *batch[n_in:])
+            return out
+
+        def state_dict(self, *a, **kw):
+            return self._layer.state_dict(*a, **kw)
+
+        def set_state_dict(self, *a, **kw):
+            return self._layer.set_state_dict(*a, **kw)
+
+        def dist_main_program(self, mode=None):
+            return None  # PIR program introspection — XLA owns the graph
+
+    return DistModel()

@@ -20,7 +20,8 @@ from .tensor import Tensor
 __all__ = [
     "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
     "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
-    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+    "hfft", "ihfft", "hfft2", "hfftn", "ihfft2", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
 ]
 
 
@@ -122,3 +123,58 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), _coerce(x),
                  _name="ifftshift")
+
+
+def _res_axes(x, s, axes):
+    nd = _coerce(x)._value.ndim
+    if axes is None:
+        axes = (tuple(range(nd)) if s is None
+                else tuple(range(nd - len(s), nd)))
+    res = tuple(a % nd for a in axes)
+    if len(set(res)) != len(res):
+        raise ValueError(
+            f"duplicate transform axes {tuple(axes)} for a {nd}-D input")
+    if s is not None and len(s) != len(res):
+        raise ValueError(
+            f"s has {len(s)} entries but {len(res)} transform axes")
+    return res
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """n-D FFT of a signal Hermitian-symmetric along the LAST transform
+    axis (real output). jnp has no hfftn; composed as fft over the
+    leading axes then hfft over the last (distinct-axis transforms
+    commute). Reference: python/paddle/fft.py hfftn."""
+    axes = _res_axes(x, s, axes)
+
+    def fn(v):
+        out = v
+        for i, ax in enumerate(axes[:-1]):
+            n = s[i] if s is not None else None
+            out = jnp.fft.fft(out, n=n, axis=ax, norm=_norm(norm))
+        n_last = s[-1] if s is not None else None
+        return jnp.fft.hfft(out, n=n_last, axis=axes[-1], norm=_norm(norm))
+    return apply(fn, _coerce(x), _name="hfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: ihfft along the last transform axis (real ->
+    half-spectrum complex), then ifft along the leading axes."""
+    axes = _res_axes(x, s, axes)
+
+    def fn(v):
+        n_last = s[-1] if s is not None else None
+        out = jnp.fft.ihfft(v, n=n_last, axis=axes[-1], norm=_norm(norm))
+        for i, ax in enumerate(axes[:-1]):
+            n = s[i] if s is not None else None
+            out = jnp.fft.ifft(out, n=n, axis=ax, norm=_norm(norm))
+        return out
+    return apply(fn, _coerce(x), _name="ihfftn")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)

@@ -15,7 +15,8 @@ from .ops.creation import _coerce
 from .tensor import Tensor
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
-           "send_u_recv", "send_ue_recv", "send_uv"]
+           "send_u_recv", "send_ue_recv", "send_uv",
+           "sample_neighbors", "reindex_graph"]
 
 
 def _num_segments(seg, out_size):
@@ -122,3 +123,68 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
         return comb(xv[src.astype(jnp.int32)], yv[dst.astype(jnp.int32)])
     return apply(run, _coerce(x), _coerce(y), _coerce(src_index),
                  _coerce(dst_index))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling from a CSC graph (parity:
+    paddle.geometric.sample_neighbors, phi graph_sample_neighbors).
+    Host-side op by design: it runs in the dataloader/graph-sampler
+    stage (variable-size outputs cannot live under jit), like the
+    reference's CPU kernel in a GraphSampler worker."""
+    # seeded from the framework generator: paddle.seed makes sampling
+    # reproducible, like the reference kernel's seeded curand stream
+    from .framework.random import default_generator
+    sub = default_generator().split()
+    rng = np.random.default_rng(
+        int(jax.random.randint(sub, (), 0, 2 ** 31 - 1)))
+    rowv = np.asarray(_coerce(row)._value)
+    ptr = np.asarray(_coerce(colptr)._value)
+    nodes = np.asarray(_coerce(input_nodes)._value).reshape(-1)
+    eidv = (np.asarray(_coerce(eids)._value)
+            if eids is not None else None)
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        lo, hi = int(ptr[v]), int(ptr[v + 1])
+        neigh = rowv[lo:hi]
+        idx = np.arange(lo, hi)
+        if sample_size != -1 and (hi - lo) > sample_size:
+            pick = rng.choice(hi - lo, size=sample_size, replace=False)
+            neigh, idx = neigh[pick], idx[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if eidv is not None:
+            out_e.append(eidv[idx])
+    neighbors = Tensor(jnp.asarray(
+        np.concatenate(out_n) if out_n else np.empty(0, rowv.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        if eidv is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, counts, Tensor(jnp.asarray(
+            np.concatenate(out_e) if out_e else np.empty(0, eidv.dtype)))
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Relabel a sampled subgraph to contiguous local ids (parity:
+    paddle.geometric.reindex_graph, phi graph_reindex). Host-side for
+    the same reason as sample_neighbors."""
+    xv = np.asarray(_coerce(x)._value).reshape(-1)
+    nb = np.asarray(_coerce(neighbors)._value).reshape(-1)
+    cnt = np.asarray(_coerce(count)._value).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(xv)}
+    order = list(xv)
+    for v in nb:
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(order)
+            order.append(v)
+    reindex_src = np.asarray([mapping[int(v)] for v in nb],
+                             np.int64)
+    reindex_dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(order, xv.dtype))))

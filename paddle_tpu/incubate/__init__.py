@@ -59,3 +59,57 @@ def identity_loss(x, reduction="none"):
 
 
 from . import optimizer  # noqa: E402  (LookAhead/ModelAverage)
+
+
+# graph_* legacy aliases (parity: paddle.incubate graph ops; the real
+# implementations live in paddle.geometric)
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Parity: paddle.incubate.graph_khop_sampler — multi-hop uniform
+    sampling built on geometric.sample_neighbors. Returns the
+    reference's 4-tuple (edge_src, edge_dst, sample_index,
+    reindex_nodes): sample_index holds the GLOBAL ids of every sampled
+    node (edges index into it), reindex_nodes the relabeled positions of
+    input_nodes (a prefix, by construction)."""
+    if return_eids:
+        raise ValueError(
+            "graph_khop_sampler(return_eids=True) is not supported; "
+            "call graph_sample_neighbors(..., eids=, return_eids=True) "
+            "per hop to recover edge ids")
+    from ..geometric import sample_neighbors, reindex_graph
+    from ..ops.creation import _coerce
+    import numpy as _np
+    from ..tensor import Tensor as _T
+    import jax.numpy as _jnp
+    cur = input_nodes
+    all_edges_src, all_edges_dst = [], []
+    for k in sample_sizes:
+        nbr, cnt = sample_neighbors(row, colptr, cur, sample_size=int(k))
+        src, dst, out_nodes = reindex_graph(cur, nbr, cnt)
+        all_edges_src.append(src)
+        all_edges_dst.append(dst)
+        cur = out_nodes
+    edge_src = _T(_jnp.concatenate([_np.asarray(s.numpy()).reshape(-1)
+                                    for s in all_edges_src]).astype("int64"))
+    edge_dst = _T(_jnp.concatenate([_np.asarray(d.numpy()).reshape(-1)
+                                    for d in all_edges_dst]).astype("int64"))
+    n_in = int(_np.asarray(
+        _coerce(input_nodes)._value).reshape(-1).shape[0])
+    reindex_nodes = _T(_jnp.arange(n_in, dtype=_jnp.int64))
+    return edge_src, edge_dst, cur, reindex_nodes
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)

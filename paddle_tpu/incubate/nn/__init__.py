@@ -205,3 +205,44 @@ class FusedMultiTransformer(Layer):
         for blk in self._layers:
             out = blk(out, src_mask=attn_mask)
         return self.norm(out) if self.norm is not None else out
+
+
+class FusedEcMoe(Layer):
+    """Parity: paddle.incubate.nn.FusedEcMoe (expert-choice MoE layer over
+    the fused_moe dense-dispatch formulation). forward(x, gate_logits)
+    with x [B, S, d] and gate_logits [B, S, E]."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        self._act = act_type
+        self.bmm_weight0 = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm_bias0 = self.create_parameter(
+            [num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.bmm_bias1 = self.create_parameter(
+            [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        from ...ops._dispatch import apply as _apply
+        from ...ops.creation import _coerce as _c
+        import jax
+        import jax.numpy as jnp
+        act = (jax.nn.gelu if self._act == "gelu" else jax.nn.relu)
+
+        def fn(xv, gv, w0, b0, w1, b1):
+            probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)
+            # dense dispatch: every token -> every expert, combined by
+            # its gate prob (expert-choice capacity == all tokens); the
+            # MXU-friendly formulation of the reference's fused kernel
+            h = jnp.einsum("bsd,edi->bsei", xv, w0) + b0[:, 0]
+            h = act(h)
+            y = jnp.einsum("bsei,eid->bsed", h, w1) + b1[:, 0]
+            return jnp.einsum("bsed,bse->bsd", y,
+                              probs.astype(y.dtype))
+        return _apply(fn, _c(x), _c(gate), self.bmm_weight0, self.bmm_bias0,
+                      self.bmm_weight1, self.bmm_bias1, _name="fused_ec_moe")

@@ -27,7 +27,7 @@ __all__ = [
     "triplet_margin_with_distance_loss", "hsigmoid_loss",
     "margin_cross_entropy", "fractional_max_pool2d", "fractional_max_pool3d",
     "class_center_sample", "rnnt_loss",
-    "adaptive_log_softmax_with_loss",
+    "adaptive_log_softmax_with_loss", "sparse_attention",
 ]
 
 
@@ -827,3 +827,65 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
         return out, -jnp.mean(out)
 
     return apply(fn, *args, _name="adaptive_log_softmax")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block/CSR-sparse attention (parity: paddle.nn.functional.
+    sparse_attention, phi sparse_attention CUDA kernel). q/k/v
+    [B, H, S, D]; per-(batch, head) CSR pattern — offset [B, H, S+1],
+    columns [B, H, nnz]. TPU-native formulation: gather scores at the
+    nnz coordinates and run a segment-softmax per query row — static
+    shapes (nnz fixed), one fused gather/scatter pair, no S x S mask.
+    Masks are additive (0 keep / -inf drop), matching the reference."""
+    import jax as _jax
+    from ..ops._dispatch import apply as _apply
+    from ..ops.creation import _coerce as _c
+
+    args = [_c(query), _c(key), _c(value), _c(sparse_csr_offset),
+            _c(sparse_csr_columns)]
+    has_kpm = key_padding_mask is not None
+    if has_kpm:
+        args.append(_c(key_padding_mask))
+    has_am = attn_mask is not None
+    if has_am:
+        args.append(_c(attn_mask))
+
+    def fn(q, k, v, off, cols, *rest):
+        it = iter(rest)
+        kpm = next(it) if has_kpm else None
+        am = next(it) if has_am else None
+        B, H, S, D = q.shape
+        nnz = cols.shape[-1]
+        j = jnp.arange(nnz)
+        rows = _jax.vmap(_jax.vmap(
+            lambda o: jnp.searchsorted(o, j, side="right") - 1))(
+                off.astype(jnp.int32))                       # [B, H, nnz]
+        rows = jnp.clip(rows, 0, S - 1)
+        colsc = jnp.clip(cols.astype(jnp.int32), 0, S - 1)
+        qg = jnp.take_along_axis(q, rows[..., None], axis=2)
+        kg = jnp.take_along_axis(k, colsc[..., None], axis=2)
+        s = jnp.einsum("bhnd,bhnd->bhn", qg.astype(jnp.float32),
+                       kg.astype(jnp.float32)) / jnp.sqrt(
+                           jnp.float32(D))
+        if kpm is not None:   # [B, S] additive over key positions
+            s = s + jnp.take_along_axis(
+                kpm.astype(jnp.float32)[:, None, :].repeat(H, 1),
+                colsc, axis=2)
+        if am is not None:    # [S, S] additive over (row, col)
+            s = s + am.astype(jnp.float32)[rows, colsc]
+
+        def per_head(s_h, rows_h, v_h, cols_h):
+            m = _jax.ops.segment_max(s_h, rows_h, num_segments=S)
+            e = jnp.exp(s_h - m[rows_h])
+            z = _jax.ops.segment_sum(e, rows_h, num_segments=S)
+            p = e / jnp.where(z == 0.0, 1.0, z)[rows_h]
+            vg = v_h[cols_h].astype(jnp.float32)
+            return _jax.ops.segment_sum(p[:, None] * vg, rows_h,
+                                        num_segments=S)
+
+        out = _jax.vmap(_jax.vmap(per_head))(s, rows, v, colsc)
+        return out.astype(q.dtype)
+
+    return _apply(fn, *args, _name="sparse_attention")

@@ -364,3 +364,10 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     from .math import cov as _cov
     return _cov(x, rowvar=rowvar, ddof=ddof, fweights=fweights,
                 aweights=aweights, name=name)
+
+
+def matrix_transpose(x, name=None):
+    """Swap the last two dims (parity: paddle.linalg.matrix_transpose)."""
+    from ._dispatch import apply as _apply
+    return _apply(lambda v: jnp.swapaxes(v, -2, -1), x,
+                  _name="matrix_transpose")

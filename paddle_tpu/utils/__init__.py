@@ -76,3 +76,5 @@ def require_version(min_version, max_version=None):
 
 from . import download  # noqa: E402  (zero-egress-aware cache resolver)
 from . import cpp_extension  # noqa: E402  (JIT C-extension builder)
+# legacy paddle.utils.profiler namespace -> the real profiler module
+from .. import profiler  # noqa: E402

@@ -99,7 +99,9 @@ SURFACE = {
         batch_isend_irecv ppermute ReduceOp DataParallel fleet
         DistributedStrategy ProcessMesh shard_tensor reshard Shard
         Replicate Partial checkpoint rpc launch TCPStore
-        broadcast_object_list scatter_object_list""",
+        broadcast_object_list scatter_object_list
+        auto_parallel in_auto_parallel_align_mode unshard_dtensor
+        shard_optimizer to_static Strategy""",
     "io": """Dataset IterableDataset TensorDataset DataLoader
         BatchSampler DistributedBatchSampler RandomSampler
         SequenceSampler WeightedRandomSampler SubsetRandomSampler
@@ -111,12 +113,13 @@ SURFACE = {
     "vision.ops": """nms roi_align roi_pool psroi_pool box_coder
         deform_conv2d yolo_box yolo_loss prior_box matrix_nms
         generate_proposals distribute_fpn_proposals""",
-    "linalg": """cholesky cholesky_solve cond corrcoef cov det eig eigh
+    "linalg": """matrix_transpose cholesky cholesky_solve cond corrcoef cov det eig eigh
         eigvals eigvalsh householder_product inv lstsq lu lu_unpack
         matrix_exp matrix_norm matrix_power matrix_rank multi_dot norm
         ormqr pinv qr slogdet solve svd svd_lowrank svdvals
         triangular_solve vector_norm pca_lowrank""",
     "fft": """fft ifft fft2 ifft2 fftn ifftn rfft irfft rfft2 irfft2
+        hfft2 hfftn ihfft2 ihfftn
         hfft ihfft fftfreq rfftfreq fftshift ifftshift""",
     "sparse": """sparse_coo_tensor sparse_csr_tensor add subtract
         multiply divide addmm matmul masked_matmul relu nn""",
@@ -133,9 +136,11 @@ SURFACE = {
         cuda_places xpu_places ipu_shard_guard name_scope""",
     "metric": """Accuracy Auc Precision Recall accuracy""",
     "audio": """functional features backends load save info""",
-    "geometric": """segment_sum segment_mean segment_max segment_min
+    "geometric": """sample_neighbors reindex_graph
+        segment_sum segment_mean segment_max segment_min
         send_u_recv send_ue_recv send_uv""",
     "incubate": """segment_sum graph_send_recv identity_loss asp
+        graph_khop_sampler graph_reindex graph_sample_neighbors
         autograd nn""",
     "utils": """deprecated try_import run_check download dlpack
         unique_name""",

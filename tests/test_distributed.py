@@ -424,3 +424,70 @@ class TestObjectCollectivesAndShims:
         task = dist.collective._DoneTask()
         assert task.is_completed()
         task.wait()
+
+
+class TestAutoParallelTail:
+    """Round-4 auto-parallel surface: Strategy / to_static / shard_optimizer
+    / unshard_dtensor (reference: python/paddle/distributed/auto_parallel)."""
+
+    def test_strategy_config_merge(self):
+        st = dist.Strategy({"pipeline": {"enable": True,
+                                         "accumulate_steps": 4},
+                            "amp": {"dtype": "bfloat16"}})
+        assert st.pipeline.enable and st.pipeline.accumulate_steps == 4
+        assert st.pipeline.schedule_mode == "1F1B"  # default survives
+        assert st.amp.dtype == "bfloat16" and st.amp.enable is False
+        assert dist.in_auto_parallel_align_mode() is False
+
+    def test_dist_to_static_train_eval_predict(self):
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 4))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        opt = dist.shard_optimizer(opt)
+        dm = dist.to_static(net, None, paddle.nn.MSELoss(), opt,
+                            dist.Strategy())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        losses = [float(dm(x, y)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        dm.eval()
+        assert float(dm(x, y)) > 0
+        dm.predict()
+        assert dm(x).shape == [4, 4]
+
+    def test_dist_to_static_multi_input_and_strategy(self):
+        paddle.seed(1)
+
+        class TwoIn(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(8, 4)
+
+            def forward(self, a, b):
+                return self.fc(a) + self.fc(b)
+
+        net = TwoIn()
+        opt = paddle.optimizer.AdamW(
+            1e-2, parameters=net.parameters())
+        st = dist.Strategy({"sharding": {"enable": True, "stage": 2}})
+        dm = dist.to_static(net, None, paddle.nn.MSELoss(), opt, st)
+        rng = np.random.RandomState(3)
+        a = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        b = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        losses = [float(dm(a, b, y)) for _ in range(3)]
+        assert losses[-1] < losses[0]
+        assert dm._step._stage == 2  # Strategy applied
+        dm.predict()
+        assert dm(a, b).shape == [4, 4]
+
+    def test_unshard_dtensor(self):
+        mesh = dist.ProcessMesh([8])
+        t = dist.shard_tensor(paddle.ones([8, 4]), mesh, [dist.Shard(0)])
+        u = dist.unshard_dtensor(t)
+        assert u.shape == [8, 4]
+        np.testing.assert_allclose(u.numpy(), np.ones((8, 4)))
+        # placement annotation is gone
+        assert getattr(u, "_process_mesh", None) is None

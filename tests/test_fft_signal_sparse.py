@@ -202,3 +202,93 @@ class TestFrameAxis0:
         fa = sig.frame(paddle.to_tensor(x0.T), 16, 8, axis=-1)
         ra = sig.overlap_add(fa, 8, axis=-1).numpy()
         np.testing.assert_allclose(rec0.numpy(), ra.T, atol=1e-6)
+
+
+class TestHermitianFFTAndSparseAttention:
+    def test_hfftn_ihfftn_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 6).astype("float32")
+        spec = paddle.fft.ihfftn(paddle.to_tensor(x))
+        back = paddle.fft.hfftn(spec, s=[3, 6])
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-5)
+        spec2 = paddle.fft.ihfft2(paddle.to_tensor(x))
+        back2 = paddle.fft.hfft2(spec2, s=[3, 6])
+        np.testing.assert_allclose(back2.numpy(), x, atol=1e-5)
+        # 1-axis consistency with the 1-D hermitian transform
+        y = rng.randn(8).astype("float32")
+        np.testing.assert_allclose(
+            paddle.fft.hfftn(paddle.to_tensor(
+                np.fft.ihfft(y)), s=[8]).numpy(),
+            np.fft.hfft(np.fft.ihfft(y), 8), atol=1e-5)
+
+    def test_matrix_transpose(self):
+        x = np.random.RandomState(1).randn(2, 3, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_transpose(paddle.to_tensor(x)).numpy(),
+            np.swapaxes(x, -2, -1))
+
+    def test_sparse_attention_matches_dense(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(2)
+        B, H, S, D = 2, 2, 4, 8
+        q = rng.randn(B, H, S, D).astype("float32")
+        k = rng.randn(B, H, S, D).astype("float32")
+        v = rng.randn(B, H, S, D).astype("float32")
+        # full CSR pattern == dense attention
+        off = np.tile(np.arange(0, S * S + 1, S, dtype="int32"), (B, H, 1))
+        cols = np.tile(np.tile(np.arange(S, dtype="int32"), S), (B, H, 1))
+        out = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v), paddle.to_tensor(off),
+                                 paddle.to_tensor(cols))
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, atol=2e-5)
+        # diagonal pattern: each row attends only itself -> output == v
+        off2 = np.tile(np.arange(0, S + 1, dtype="int32"), (B, H, 1))
+        cols2 = np.tile(np.arange(S, dtype="int32"), (B, H, 1))
+        out2 = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                  paddle.to_tensor(v),
+                                  paddle.to_tensor(off2),
+                                  paddle.to_tensor(cols2))
+        np.testing.assert_allclose(out2.numpy(), v, atol=1e-6)
+        # additive attn_mask blocks a column
+        am = np.zeros((S, S), "float32")
+        am[:, 0] = -1e30
+        out3 = F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                  paddle.to_tensor(v), paddle.to_tensor(off),
+                                  paddle.to_tensor(cols),
+                                  attn_mask=paddle.to_tensor(am))
+        s3 = s + am[None, None]
+        p3 = np.exp(s3 - s3.max(-1, keepdims=True))
+        p3 /= p3.sum(-1, keepdims=True)
+        ref3 = np.einsum("bhqk,bhkd->bhqd", p3, v)
+        np.testing.assert_allclose(out3.numpy(), ref3, atol=2e-5)
+
+    def test_graph_sampling(self):
+        # triangle graph in CSC: node i's neighbors are the other two
+        row = paddle.to_tensor(np.array([1, 2, 0, 2, 0, 1], "int64"))
+        colptr = paddle.to_tensor(np.array([0, 2, 4, 6], "int64"))
+        nodes = paddle.to_tensor(np.array([0, 2], "int64"))
+        nbr, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes)
+        np.testing.assert_array_equal(cnt.numpy(), [2, 2])
+        np.testing.assert_array_equal(np.sort(nbr.numpy()[:2]), [1, 2])
+        nbr1, cnt1 = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                       sample_size=1)
+        np.testing.assert_array_equal(cnt1.numpy(), [1, 1])
+        # reproducible under paddle.seed (sampling draws from the
+        # framework generator)
+        paddle.seed(123)
+        a1, _ = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                  sample_size=1)
+        paddle.seed(123)
+        a2, _ = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                  sample_size=1)
+        np.testing.assert_array_equal(a1.numpy(), a2.numpy())
+        src, dst, out_nodes = paddle.geometric.reindex_graph(nodes, nbr, cnt)
+        # dst indexes into `nodes` positions, src into out_nodes
+        assert dst.numpy().tolist() == [0, 0, 1, 1]
+        np.testing.assert_array_equal(out_nodes.numpy()[:2], [0, 2])
+        assert set(out_nodes.numpy().tolist()) == {0, 1, 2}
+        assert (np.asarray(src.numpy()) < len(out_nodes.numpy())).all()

@@ -296,3 +296,33 @@ class TestFusedLayersAndDebugging:
         f(t(np.ones(3, "float32")))
         with pytest.raises(FloatingPointError):
             f(t(np.array([np.inf], "float32")))
+
+
+class TestFusedEcMoeAndGraphAliases:
+    def test_fused_ec_moe(self):
+        from paddle_tpu.incubate.nn import FusedEcMoe
+        paddle.seed(3)
+        m = FusedEcMoe(16, 32, 4)
+        x = paddle.randn([2, 6, 16])
+        gate = paddle.randn([2, 6, 4])
+        y = m(x, gate)
+        assert y.shape == [2, 6, 16]
+        assert np.isfinite(y.numpy()).all()
+        # gradient flows to the expert banks
+        y.sum().backward()
+        assert m.bmm_weight0.grad is not None
+
+    def test_incubate_graph_aliases(self):
+        import paddle_tpu.incubate as inc
+        row = paddle.to_tensor(np.array([1, 2, 0, 2, 0, 1], "int64"))
+        colptr = paddle.to_tensor(np.array([0, 2, 4, 6], "int64"))
+        nodes = paddle.to_tensor(np.array([0], "int64"))
+        nbr, cnt = inc.graph_sample_neighbors(row, colptr, nodes)
+        np.testing.assert_array_equal(cnt.numpy(), [2])
+        src, dst, out_nodes = inc.graph_reindex(nodes, nbr, cnt)
+        assert dst.numpy().tolist() == [0, 0]
+        es, ed, final, reindex = inc.graph_khop_sampler(row, colptr, nodes,
+                                                        [2, 2])
+        assert reindex.numpy().tolist() == [0]
+        assert len(es.numpy()) == len(ed.numpy())
+        assert set(final.numpy().tolist()) == {0, 1, 2}

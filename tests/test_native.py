@@ -386,3 +386,39 @@ class TestGoBinding:
         r = subprocess.run([go, "vet", "./..."], cwd=work, env=env,
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr[-2000:]
+
+
+class TestJavaBinding:
+    def test_java_binding_compiles(self, tmp_path):
+        """The Java inference client (csrc/java/PaddleInference.java) is
+        real JNA over the C ABI; with a JDK present it must typecheck
+        (a JNA stub interface is enough to compile against)."""
+        import shutil
+        import subprocess
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        src = os.path.join(repo, "csrc", "java", "PaddleInference.java")
+        assert os.path.exists(src)
+        text = open(src).read()
+        for sym in ("PD_PredictorCreate", "PD_PredictorRun",
+                    "PD_PredictorCopyOutput", "PD_GetLastError"):
+            assert sym in text, sym
+        javac = shutil.which("javac")
+        if javac is None:
+            pytest.skip("no JDK in this image")
+        # minimal JNA stubs so the binding compiles without the jar
+        stub = tmp_path / "com" / "sun" / "jna"
+        stub.mkdir(parents=True)
+        (stub / "Library.java").write_text(
+            "package com.sun.jna;\npublic interface Library {}\n")
+        (stub / "Pointer.java").write_text(
+            "package com.sun.jna;\npublic class Pointer {}\n")
+        (stub / "Native.java").write_text(
+            "package com.sun.jna;\npublic class Native {\n"
+            "  public static <T> T load(String n, Class<T> c)"
+            " { return null; }\n}\n")
+        work = tmp_path / "PaddleInference.java"
+        work.write_text(text)
+        r = subprocess.run([javac, "-cp", str(tmp_path), str(work)],
+                           capture_output=True, text=True, timeout=300,
+                           cwd=tmp_path)
+        assert r.returncode == 0, r.stderr[-2000:]

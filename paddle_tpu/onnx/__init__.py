@@ -1,21 +1,79 @@
-"""paddle.onnx parity surface.
+"""paddle.onnx — ONNX export.
 
-Reference parity: python/paddle/onnx/export.py, which delegates to the
-paddle2onnx ecosystem package. In the TPU-native stack the equivalent
-portable-deployment path is StableHLO via jax.export (see
-paddle_tpu.inference Predictor / jit.save AOT artifacts); ONNX proper
-would need the onnx package, which this environment does not ship —
-so export() raises with that guidance instead of silently no-opping.
+Reference parity: python/paddle/onnx/export.py (delegates to the
+paddle2onnx converter over the static Program). TPU-native design: the
+layer is traced to a jaxpr — the same trace jit/StableHLO export uses —
+and lowered primitive-by-primitive to ONNX opset 17, with the protobuf
+wire format emitted directly (`_proto.py`; the environment ships no onnx
+package, and none is needed to WRITE spec-compliant files). Parameters
+become initializers under their state_dict names; constant subgraphs
+fold away.
+
+Models using primitives outside the mapped inference set raise with the
+primitive named; `paddle_tpu.jit.save` (StableHLO AOT) covers the rest.
 """
 from __future__ import annotations
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export requires the paddle2onnx/onnx packages (not available "
-        "in this environment). For portable TPU deployment use "
-        "paddle_tpu.jit.save (StableHLO AOT via jax.export) or "
-        "paddle_tpu.inference.create_predictor, which replace the "
-        "ONNX/TensorRT path on this backend.")
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Export `layer` to `path`.onnx (parity: paddle.onnx.export).
+
+    input_spec: list of InputSpec / Tensors / (shape, dtype) pairs.
+    Dynamic dims (None/-1) are not supported — pass concrete shapes
+    (the reference's converter also requires shapes for most models).
+    Returns the saved file path.
+    """
+    from ._export import export_onnx_bytes
+    from ..tensor import Tensor
+
+    if not 13 <= int(opset_version) <= 17:
+        raise ValueError(
+            f"opset_version {opset_version} is not supported: nodes are "
+            "emitted with opset 13-17 signatures (ReduceSum/Squeeze/"
+            "Split take axes/sizes as inputs) — pass 13 <= opset <= 17")
+    if input_spec is None:
+        raise ValueError(
+            "paddle.onnx.export needs input_spec (shapes + dtypes) to "
+            "trace the model")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            specs.append((tuple(s.shape), np.dtype(str(s.numpy().dtype))))
+            continue
+        shape = getattr(s, "shape", None)
+        if shape is not None and not isinstance(s, (tuple, list)):
+            dtype = getattr(s, "dtype", "float32")
+            conc = []
+            for d in shape:
+                if d is None or d == -1:
+                    raise ValueError(
+                        "ONNX export requires concrete shapes; got a "
+                        f"dynamic dim in {shape} — pass the serving "
+                        "shape (rebuild per shape if needed)")
+                conc.append(int(d))
+            from ..framework.dtype import convert_dtype
+            try:
+                np_dt = np.dtype(convert_dtype(dtype))
+            except Exception:
+                np_dt = np.dtype(str(dtype))
+            specs.append((tuple(conc), np_dt))
+        else:
+            shape, dtype = s
+            if any(d is None or int(d) < 0 for d in shape):
+                raise ValueError(
+                    "ONNX export requires concrete shapes; got a "
+                    f"dynamic dim in {tuple(shape)} — pass the serving "
+                    "shape (rebuild per shape if needed)")
+            specs.append((tuple(int(d) for d in shape), np.dtype(dtype)))
+
+    data, _ = export_onnx_bytes(layer, specs, opset_version=opset_version)
+    out_path = str(path)
+    if not out_path.endswith(".onnx"):
+        out_path = out_path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(data)
+    return out_path

@@ -1,0 +1,263 @@
+"""Minimal protobuf wire-format writer for ONNX ModelProto.
+
+The environment ships no `onnx` (or `protobuf`) package, but ONNX files
+are plain protobuf — and protobuf's wire format is simple enough to emit
+directly: varints, and length-delimited submessages/bytes. This module
+hand-encodes exactly the subset of onnx.proto the exporter needs
+(ModelProto / GraphProto / NodeProto / TensorProto / ValueInfoProto /
+AttributeProto, field numbers per the public onnx/onnx.proto schema).
+
+A matching *independent* reader (`parse_model`) decodes the same subset
+so tests can round-trip files without the onnx package; any
+spec-compliant consumer (onnxruntime, netron) reads the output directly.
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+# onnx.TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64 = 1, 2, 3, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE = 8, 9, 10, 11
+BFLOAT16 = 16
+
+_NP2ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.bool_): BOOL,
+}
+
+
+def np_to_onnx_dtype(dt) -> int:
+    dt = np.dtype(dt)
+    if str(dt) == "bfloat16":
+        return BFLOAT16
+    if dt not in _NP2ONNX:
+        raise ValueError(f"no ONNX dtype for {dt}")
+    return _NP2ONNX[dt]
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # protobuf encodes negatives as 10-byte varints
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def f_str(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+def f_msg(field: int, body: bytes) -> bytes:
+    return f_bytes(field, body)
+
+
+def f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+# ---------------------------------------------------------------------------
+# onnx messages
+# ---------------------------------------------------------------------------
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    body = b"".join(f_varint(1, d) for d in arr.shape)
+    body += f_varint(2, np_to_onnx_dtype(arr.dtype))
+    body += f_str(8, name)
+    body += f_bytes(9, np.ascontiguousarray(arr).tobytes())  # raw_data
+    return body
+
+
+def attr_int(name: str, v: int) -> bytes:
+    return f_str(1, name) + f_varint(3, v) + f_varint(20, 2)    # INT
+
+
+def attr_float(name: str, v: float) -> bytes:
+    return f_str(1, name) + f_float(2, v) + f_varint(20, 1)     # FLOAT
+
+
+def attr_ints(name: str, vs: Sequence[int]) -> bytes:
+    out = f_str(1, name)
+    for v in vs:
+        out += f_varint(8, v)
+    return out + f_varint(20, 7)                                # INTS
+
+
+def attr_str(name: str, s: str) -> bytes:
+    return f_str(1, name) + f_bytes(4, s.encode()) + f_varint(20, 3)
+
+
+def attr_tensor(name: str, t: bytes) -> bytes:
+    return f_str(1, name) + f_msg(5, t) + f_varint(20, 4)       # TENSOR
+
+
+def node_proto(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+               name: str = "", attrs: Sequence[bytes] = ()) -> bytes:
+    body = b"".join(f_str(1, i) for i in inputs)
+    body += b"".join(f_str(2, o) for o in outputs)
+    if name:
+        body += f_str(3, name)
+    body += f_str(4, op_type)
+    body += b"".join(f_msg(5, a) for a in attrs)
+    return body
+
+
+def value_info(name: str, dtype: int, shape: Sequence[int]) -> bytes:
+    dims = b"".join(f_msg(1, f_varint(1, d)) for d in shape)
+    tensor_t = f_varint(1, dtype) + f_msg(2, dims)
+    type_p = f_msg(1, tensor_t)
+    return f_str(1, name) + f_msg(2, type_p)
+
+
+def graph_proto(nodes: List[bytes], name: str, initializers: List[bytes],
+                inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    body = b"".join(f_msg(1, n) for n in nodes)
+    body += f_str(2, name)
+    body += b"".join(f_msg(5, t) for t in initializers)
+    body += b"".join(f_msg(11, i) for i in inputs)
+    body += b"".join(f_msg(12, o) for o in outputs)
+    return body
+
+
+def model_proto(graph: bytes, opset: int = 17,
+                producer: str = "paddle_tpu") -> bytes:
+    opset_body = f_str(1, "") + f_varint(2, opset)
+    body = f_varint(1, 8)                      # ir_version 8
+    body += f_str(2, producer)
+    body += f_str(3, "0.1")
+    body += f_msg(7, graph)
+    body += f_msg(8, opset_body)
+    return body
+
+
+# ---------------------------------------------------------------------------
+# independent reader (for tests; subset decode)
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: memoryview, pos: int):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _fields(buf: memoryview):
+    """Yield (field, wire, value) over a message body."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = bytes(buf[pos:pos + 4])
+            pos += 4
+        elif wire == 1:
+            v = bytes(buf[pos:pos + 8])
+            pos += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, v
+
+
+def parse_model(data: bytes) -> dict:
+    """Decode the subset we emit: returns {opset, producer, graph:
+    {nodes: [{op_type, inputs, outputs, attrs}], initializers:
+    [(name, dtype, shape, array)], inputs: [names], outputs: [names]}}."""
+    model = {"producer": None, "opset": None, "graph": None}
+    for field, _, v in _fields(memoryview(data)):
+        if field == 2:
+            model["producer"] = bytes(v).decode()
+        elif field == 8:
+            for f2, _, v2 in _fields(v):
+                if f2 == 2:
+                    model["opset"] = v2
+        elif field == 7:
+            g = {"nodes": [], "initializers": [], "inputs": [],
+                 "outputs": [], "name": None}
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:
+                    node = {"op_type": None, "inputs": [], "outputs": [],
+                            "attrs": {}}
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            node["inputs"].append(bytes(v3).decode())
+                        elif f3 == 2:
+                            node["outputs"].append(bytes(v3).decode())
+                        elif f3 == 4:
+                            node["op_type"] = bytes(v3).decode()
+                        elif f3 == 5:
+                            aname, aival, aints, astr = None, None, [], None
+                            for f4, w4, v4 in _fields(v3):
+                                if f4 == 1:
+                                    aname = bytes(v4).decode()
+                                elif f4 == 3:
+                                    aival = v4
+                                elif f4 == 4:
+                                    astr = bytes(v4).decode()
+                                elif f4 == 8:
+                                    aints.append(v4)
+                            node["attrs"][aname] = (
+                                aints if aints
+                                else astr if astr is not None else aival)
+                    g["nodes"].append(node)
+                elif f2 == 2:
+                    g["name"] = bytes(v2).decode()
+                elif f2 == 5:
+                    tname, dims, dt, raw = None, [], None, b""
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            dims.append(v3)
+                        elif f3 == 2:
+                            dt = v3
+                        elif f3 == 8:
+                            tname = bytes(v3).decode()
+                        elif f3 == 9:
+                            raw = bytes(v3)
+                    g["initializers"].append((tname, dt, dims, raw))
+                elif f2 == 11:
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            g["inputs"].append(bytes(v3).decode())
+                elif f2 == 12:
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            g["outputs"].append(bytes(v3).decode())
+            model["graph"] = g
+    return model

@@ -93,10 +93,16 @@ def test_every_guard_is_abstract_or_guidance():
     for rel, line, fn, msg in sites:
         if fn in ABSTRACT_METHODS:
             continue  # abstract protocol / registered-dispatch method
-        guidance.append((rel, line, fn))
         low = msg.lower()
         if not any(m in low for m in GUIDANCE_MARKERS):
             bad.append((rel, line, fn, msg))
+        if rel == "paddle_tpu/onnx/_export.py":
+            # converter coverage boundaries: every unmapped-primitive
+            # raise names the jit.save fallback (paddle2onnx raises the
+            # same way on unsupported ops) — message-checked above, but
+            # not an API option landmine
+            continue
+        guidance.append((rel, line, fn))
     assert not bad, (
         "NotImplementedError guards whose message names no workaround "
         f"(add 'use X instead' guidance): {bad}")

@@ -76,6 +76,10 @@ def main():
         batch, seq, iters, warmup = 2, 64, 3, 1
 
     _log(f"backend={jax.default_backend()} building model")
+    # host-side numpy init: on the tunnelled TPU every eager device op is
+    # a remote compile/execute RPC, so jax.random-based init alone can eat
+    # minutes before the first step (observed r4: >540s to build)
+    paddle.set_flags({"host_init": True})
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     if on_tpu:

@@ -18,7 +18,26 @@ _jax.config.update("jax_enable_x64", True)
 # Paddle/cuBLAS semantics: float32 matmuls accumulate in float32. JAX's
 # default lets the backend pick (bf16 passes on TPU); force f32 for parity —
 # the bf16 hot path opts in explicitly via amp/bfloat16 params instead.
+# NOTE: Pallas kernels must pin their own per-dot precision —
+# kernels/_common.mxu_precision — because Mosaic rejects bf16 matmuls
+# carrying the global fp32 contract precision ("Bad lhs type" on v5e).
 _jax.config.update("jax_default_matmul_precision", "highest")
+
+# Persistent XLA compile cache (parity role: Paddle Inference's engine/
+# program caches + CINN's compilation cache). On the tunnelled TPU sandbox
+# every compile is a remote RPC, so warm-starting from disk is the
+# difference between a 10-minute and a 10-second bench bring-up.
+import os as _os
+_cache_dir = _os.environ.get("PADDLE_TPU_XLA_CACHE",
+                             _os.path.expanduser("~/.cache/paddle_tpu_xla"))
+if _cache_dir and _cache_dir != "0":
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # cache is best-effort; never block import
+        pass
 
 __version__ = "0.1.0"
 

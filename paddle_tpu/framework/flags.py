@@ -134,6 +134,12 @@ define_flag("flash_block_q", 128,
             "Flash-attention Q tile rows (on-device autotune knob).")
 define_flag("flash_block_k", 128,
             "Flash-attention KV tile rows (on-device autotune knob).")
+define_flag("host_init", False,
+            "Sample parameter initializers on the host (numpy) instead of "
+            "via device jax.random ops. Same statistical distributions and "
+            "seed-determinism, different random stream. On a tunnelled/"
+            "remote-compile TPU this removes every per-parameter "
+            "compile+execute roundtrip from model construction.")
 define_flag("max_inplace_grad_add", 0, "Parity stub.")
 define_flag("eager_delete_tensor_gb", 0.0, "Parity stub; XLA GC is automatic.")
 define_flag("shm_channel_capacity_mb", 64,

@@ -28,6 +28,7 @@ class _GlobalGenerator:
     def __init__(self, seed: int = 0):
         self._lazy_key = None
         self._seed = seed
+        self._host_draws = 0
 
     @property
     def _key(self):
@@ -42,12 +43,24 @@ class _GlobalGenerator:
     def manual_seed(self, seed: int):
         self._seed = int(seed)
         self._lazy_key = None
+        self._host_draws = 0
         return self
 
     def split(self):
         """Return a fresh subkey; advances the global state."""
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def host_rng(self) -> np.random.Generator:
+        """A deterministic host-side (numpy) stream for FLAGS_host_init:
+        each draw gets a fresh Philox keyed on (seed, draw counter), so
+        same-seed processes produce identical parameters without a single
+        device roundtrip. Independent of the jax.random key state."""
+        rng = np.random.Generator(
+            np.random.Philox(key=[self._seed & 0xFFFFFFFFFFFFFFFF,
+                                  self._host_draws]))
+        self._host_draws += 1
+        return rng
 
     def get_state(self):
         return jax.random.key_data(self._key)

@@ -41,3 +41,19 @@ def pallas_dtype_ok(*arrays) -> bool:
         if a.dtype in (jnp.float64,):
             return False
     return True
+
+
+def mxu_precision(*operands):
+    """Explicit contract precision for matmuls INSIDE Pallas kernels.
+
+    paddle_tpu sets jax_default_matmul_precision="highest" globally for
+    f32 CUDA-parity, but Mosaic rejects a bf16 tpu.matmul carrying fp32
+    contract precision ("Bad lhs type", observed on v5e) — and for bf16
+    operands the MXU multiplies natively, so "highest" buys nothing.
+    DEFAULT for sub-f32 operands, HIGHEST for f32.
+    """
+    import jax.numpy as jnp
+    for o in operands:
+        if o.dtype in (jnp.bfloat16, jnp.float16):
+            return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST

@@ -28,7 +28,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
-                      pallas_dtype_ok, pallas_interpret)
+                      pallas_dtype_ok, pallas_interpret, mxu_precision)
 
 
 def _zero_tail_rows(arr, blk_idx, block, limit):
@@ -59,10 +59,20 @@ def _lens_rows(kv_lens, bh):
 def _gqa_kv_row(h, H, Hkv):
     """Map a flattened [B*H] query-head row index onto its [B*Hkv] kv row
     (GQA group folding). The fwd and bwd BlockSpec index maps MUST agree
-    on this formula — single definition, used by both."""
+    on this formula — single definition, used by both.
+
+    Uses lax.div/rem with explicit i32 constants rather than `//`/`%`:
+    with jax_enable_x64 on, jnp.floor_divide(tracer, python_int) bakes an
+    int64->int32 convert_element_type into the index-map jaxpr, and
+    Mosaic's scalar convert lowering recurses forever on it (observed on
+    v5e). h is a non-negative grid index, so truncating div == floor."""
     if H == Hkv:
         return h
-    return (h // H) * Hkv + (h % H) // (H // Hkv)
+    if isinstance(h, (int, np.integer)):
+        return (h // H) * Hkv + (h % H) // (H // Hkv)
+    i32 = lambda n: jnp.asarray(n, jnp.int32)
+    return (jax.lax.div(h, i32(H)) * i32(Hkv)
+            + jax.lax.div(jax.lax.rem(h, i32(H)), i32(H // Hkv)))
 
 
 def _pad_d_for_dtype(dtype, d):
@@ -103,7 +113,8 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
         v = _zero_tail_rows(v_ref[0], j, block_k, seq_k)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * np.float32(scale)
+            preferred_element_type=jnp.float32,
+            precision=mxu_precision(q, k)) * np.float32(scale)
 
         if causal or seq_k % block_k or has_lens:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
@@ -129,7 +140,8 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
                       jax.lax.dot_general(
                           p.astype(v.dtype), v,
                           (((1,), (0,)), ((), ())),
-                          preferred_element_type=jnp.float32))
+                          preferred_element_type=jnp.float32,
+                          precision=mxu_precision(v)))
         m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 

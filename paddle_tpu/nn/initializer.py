@@ -17,6 +17,113 @@ from ..framework.random import next_key
 from ..tensor import Tensor
 
 
+def _host_init() -> bool:
+    from ..framework.flags import flag_value
+    return bool(flag_value("host_init"))
+
+
+def _np_dtype(dtype):
+    """Normalize to a numpy dtype via the framework's converter (handles
+    str / np.dtype / jnp scalar types / ml_dtypes bf16 uniformly)."""
+    d = dtypes.convert_dtype(dtype)
+    return np.dtype(d) if d is not None else np.float32
+
+
+def _randn(shape, dtype):
+    """Standard normal: device jax.random, or host numpy under
+    FLAGS_host_init (no compile/execute roundtrip — see flag help)."""
+    if _host_init():
+        from ..framework.random import default_generator
+        r = default_generator().host_rng().standard_normal(tuple(shape))
+        return np.asarray(r, dtype=_np_dtype(dtype))
+    return jax.random.normal(next_key(), tuple(shape), dtype)
+
+
+def _randu(shape, dtype, low, high):
+    if _host_init():
+        from ..framework.random import default_generator
+        r = default_generator().host_rng().uniform(low, high, tuple(shape))
+        return np.asarray(r, dtype=_np_dtype(dtype))
+    return jax.random.uniform(next_key(), tuple(shape), dtype,
+                              minval=low, maxval=high)
+
+
+def _ndtri(p):
+    """Inverse standard-normal CDF (Acklam's rational approximation,
+    |rel err| < 1.2e-9, refined by one Halley step via math.erf) — exact
+    enough for initializer sampling without a scipy dependency."""
+    p = np.asarray(p, np.float64)
+    a = [-3.969683028665376e+01, 2.209460984245205e+02,
+         -2.759285104469687e+02, 1.383577518672690e+02,
+         -3.066479806614716e+01, 2.506628277459239e+00]
+    b = [-5.447609879822406e+01, 1.615858368580409e+02,
+         -1.556989798598866e+02, 6.680131188771972e+01,
+         -1.328068155288572e+01]
+    c = [-7.784894002430293e-03, -3.223964580411365e-01,
+         -2.400758277161838e+00, -2.549732539343734e+00,
+         4.374664141464968e+00, 2.938163982698783e+00]
+    d = [7.784695709041462e-03, 3.224671290700398e-01,
+         2.445134137142996e+00, 3.754408661907416e+00]
+    plow, phigh = 0.02425, 1 - 0.02425
+    x = np.empty_like(p)
+    lo = p < plow
+    hi = p > phigh
+    mid = ~(lo | hi)
+    if lo.any():
+        q = np.sqrt(-2 * np.log(p[lo]))
+        x[lo] = ((((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4])
+                  * q + c[5])
+                 / ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1))
+    if hi.any():
+        q = np.sqrt(-2 * np.log(1 - p[hi]))
+        x[hi] = -((((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4])
+                   * q + c[5])
+                  / ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1))
+    if mid.any():
+        q = p[mid] - 0.5
+        r = q * q
+        x[mid] = ((((((a[0] * r + a[1]) * r + a[2]) * r + a[3]) * r + a[4])
+                   * r + a[5]) * q
+                  / (((((b[0] * r + b[1]) * r + b[2]) * r + b[3]) * r + b[4])
+                     * r + 1))
+    # one Halley refinement against the exact CDF
+    import math
+    erf = np.vectorize(math.erf)
+    e = 0.5 * (1 + erf(x / np.sqrt(2.0))) - p
+    u = e * np.sqrt(2 * np.pi) * np.exp(x * x / 2.0)
+    return x - u / (1 + x * u / 2)
+
+
+def _randtrunc(shape, dtype, a, b):
+    if _host_init():
+        from ..framework.random import default_generator
+        rng = default_generator().host_rng()
+        # inverse-CDF sampling: exact for ANY [a, b], including far-tail
+        # ranges where rejection sampling would degenerate
+        import math
+        ca = 0.5 * (1 + math.erf(a / math.sqrt(2.0)))
+        cb = 0.5 * (1 + math.erf(b / math.sqrt(2.0)))
+        u = rng.uniform(ca, cb, tuple(shape))
+        out = _ndtri(u)
+        return np.asarray(np.clip(out, a, b), dtype=_np_dtype(dtype))
+    return jax.random.truncated_normal(next_key(), a, b, tuple(shape), dtype)
+
+
+def _cast_host(fn):
+    """Numpy dtype promotion undoes a bf16/f16 sample dtype when the
+    initializer applies `* std + mean` — re-cast host results to the
+    requested dtype after the affine."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, shape, dtype):
+        out = fn(self, shape, dtype)
+        if isinstance(out, np.ndarray):
+            out = np.asarray(out, _np_dtype(dtype))
+        return out
+    return wrapper
+
+
 class Initializer:
     def __call__(self, shape, dtype):
         raise NotImplementedError
@@ -27,6 +134,8 @@ class Constant(Initializer):
         self.value = value
 
     def __call__(self, shape, dtype):
+        if _host_init():
+            return np.full(tuple(shape), self.value, _np_dtype(dtype))
         return jnp.full(tuple(shape), self.value, dtype)
 
 
@@ -34,19 +143,18 @@ class Normal(Initializer):
     def __init__(self, mean=0.0, std=1.0, name=None):
         self.mean, self.std = mean, std
 
+    @_cast_host
     def __call__(self, shape, dtype):
-        return (jax.random.normal(next_key(), tuple(shape), dtype) * self.std
-                + self.mean)
+        return _randn(shape, dtype) * self.std + self.mean
 
 
 class TruncatedNormal(Initializer):
     def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
         self.mean, self.std, self.a, self.b = mean, std, a, b
 
+    @_cast_host
     def __call__(self, shape, dtype):
-        return (jax.random.truncated_normal(
-            next_key(), self.a, self.b, tuple(shape), dtype) * self.std
-            + self.mean)
+        return _randtrunc(shape, dtype, self.a, self.b) * self.std + self.mean
 
 
 class Uniform(Initializer):
@@ -54,8 +162,7 @@ class Uniform(Initializer):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype):
-        return jax.random.uniform(next_key(), tuple(shape), dtype,
-                                  minval=self.low, maxval=self.high)
+        return _randu(shape, dtype, self.low, self.high)
 
 
 def _fans(shape):
@@ -77,12 +184,13 @@ class XavierNormal(Initializer):
     def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
 
+    @_cast_host
     def __call__(self, shape, dtype):
         fi, fo = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         std = self.gain * pymath.sqrt(2.0 / (fi + fo))
-        return jax.random.normal(next_key(), tuple(shape), dtype) * std
+        return _randn(shape, dtype) * std
 
 
 class XavierUniform(Initializer):
@@ -94,8 +202,7 @@ class XavierUniform(Initializer):
         fi = self.fan_in if self.fan_in is not None else fi
         fo = self.fan_out if self.fan_out is not None else fo
         limit = self.gain * pymath.sqrt(6.0 / (fi + fo))
-        return jax.random.uniform(next_key(), tuple(shape), dtype,
-                                  minval=-limit, maxval=limit)
+        return _randu(shape, dtype, -limit, limit)
 
 
 class KaimingNormal(Initializer):
@@ -105,13 +212,14 @@ class KaimingNormal(Initializer):
         self.negative_slope = negative_slope
         self.nonlinearity = nonlinearity
 
+    @_cast_host
     def __call__(self, shape, dtype):
         fi, _ = _fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
         gain = pymath.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
             if self.nonlinearity in ("relu", "leaky_relu") else 1.0
         std = gain / pymath.sqrt(fi)
-        return jax.random.normal(next_key(), tuple(shape), dtype) * std
+        return _randn(shape, dtype) * std
 
 
 class KaimingUniform(Initializer):
@@ -127,8 +235,7 @@ class KaimingUniform(Initializer):
         gain = pymath.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
             if self.nonlinearity in ("relu", "leaky_relu") else 1.0
         limit = gain * pymath.sqrt(3.0 / fi)
-        return jax.random.uniform(next_key(), tuple(shape), dtype,
-                                  minval=-limit, maxval=limit)
+        return _randu(shape, dtype, -limit, limit)
 
 
 class Assign(Initializer):
@@ -166,8 +273,14 @@ class Orthogonal(Initializer):
     def __call__(self, shape, dtype):
         rows = shape[0]
         cols = int(np.prod(shape[1:]))
-        flat = jax.random.normal(next_key(), (max(rows, cols), min(rows, cols)),
-                                 jnp.float32)
+        flat = _randn((max(rows, cols), min(rows, cols)), jnp.float32)
+        if isinstance(flat, np.ndarray):  # host path: host QR too
+            q, r = np.linalg.qr(flat)
+            q = q * np.sign(np.diagonal(r))
+            if rows < cols:
+                q = q.T
+            return np.asarray(self.gain * q[:rows, :cols],
+                              _np_dtype(dtype)).reshape(tuple(shape))
         q, r = jnp.linalg.qr(flat)
         q = q * jnp.sign(jnp.diagonal(r))
         if rows < cols:

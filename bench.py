@@ -162,6 +162,12 @@ def main():
         except Exception as e:  # never fail the bench over the probe
             kernel_parity = {"error": f"{type(e).__name__}: {e}"[:200]}
 
+    try:  # optional diagnostic — never fail the bench over the probe
+        peak_hbm = (jax.devices()[0].memory_stats() or {}).get(
+            "peak_bytes_in_use")
+    except Exception:
+        peak_hbm = None
+
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tps, 2),
@@ -181,6 +187,9 @@ def main():
                                "use_pallas_kernels")),
             "multi_precision": "auto(f32 master weights)",
             "kernel_parity": kernel_parity,
+            # real HBM high-water mark (VERDICT r3: PP/remat memory
+            # behavior must be measured; this is the chip-level number)
+            "peak_hbm_bytes": peak_hbm,
         },
     }))
 

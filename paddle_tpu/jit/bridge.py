@@ -194,12 +194,16 @@ class TrainStep:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
-    def __call__(self, *batch):
+    def _ensure_compiled(self, batch):
         arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         if sig not in self._compiled:
             self._compiled[sig] = self._build(sig)
+        return arrays, sig
+
+    def __call__(self, *batch):
+        arrays, sig = self._ensure_compiled(batch)
         gen = default_generator()
         key_in = gen.split()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
@@ -221,6 +225,21 @@ class TrainStep:
         # (checkpointing) observes the compiled step's state
         self._opt._fn_sync_to_accumulators(self._p, new_state)
         return Tensor(loss)
+
+    def memory_analysis(self, *batch):
+        """Compile for this batch signature WITHOUT executing and return
+        XLA's CompiledMemoryStats (temp_size_in_bytes = activation +
+        workspace high-water mark). Does not advance RNG or consume any
+        donated buffer."""
+        arrays, sig = self._ensure_compiled(batch)
+        from ..amp.grad_scaler import scaler_state_in
+        sc_in = (scaler_state_in(self._scaler)
+                 if self._scaler is not None else ())
+        lowered = self._compiled[sig].lower(
+            [p._value for p in self._p], [b._value for b in self._b],
+            self._opt_state, jax.random.key(0),
+            jnp.asarray(0.0, jnp.float32), arrays, sc_in)
+        return lowered.compile().memory_analysis()
 
     @property
     def opt_state(self):

@@ -777,6 +777,11 @@ class PipelineTrainStep:
         exists to bound; VERDICT r3 weak #3 asked for it to be measured,
         not asserted). Does not advance the RNG or consume any buffer."""
         arrays, sig = self._ensure_compiled(batch)
+        cache = getattr(self, "_mem_stats", None)
+        if cache is None:
+            cache = self._mem_stats = {}
+        if sig in cache:  # a second AOT compile is minutes on TPU
+            return cache[sig]
         jitted = self._compiled[sig]._jitted
         from ....amp.grad_scaler import scaler_state_in
         sc_in = scaler_state_in(self._scaler) if self._scaler is not None \
@@ -789,7 +794,8 @@ class PipelineTrainStep:
                 [p._value for p in self._post_p],
                 [b._value for b in self._edge_b],
                 self._opt_state, key, lr, arrays, sc_in)
-            return lowered.compile().memory_analysis()
+            cache[sig] = lowered.compile().memory_analysis()
+        return cache[sig]
 
     def sync_state(self):
         """Flush the compiled step's authoritative state back into the live

@@ -232,14 +232,19 @@ class TrainStep:
         workspace high-water mark). Does not advance RNG or consume any
         donated buffer."""
         arrays, sig = self._ensure_compiled(batch)
-        from ..amp.grad_scaler import scaler_state_in
-        sc_in = (scaler_state_in(self._scaler)
-                 if self._scaler is not None else ())
-        lowered = self._compiled[sig].lower(
-            [p._value for p in self._p], [b._value for b in self._b],
-            self._opt_state, jax.random.key(0),
-            jnp.asarray(0.0, jnp.float32), arrays, sc_in)
-        return lowered.compile().memory_analysis()
+        cache = getattr(self, "_mem_stats", None)
+        if cache is None:
+            cache = self._mem_stats = {}
+        if sig not in cache:  # a second AOT compile is minutes on TPU
+            from ..amp.grad_scaler import scaler_state_in
+            sc_in = (scaler_state_in(self._scaler)
+                     if self._scaler is not None else ())
+            lowered = self._compiled[sig].lower(
+                [p._value for p in self._p], [b._value for b in self._b],
+                self._opt_state, jax.random.key(0),
+                jnp.asarray(0.0, jnp.float32), arrays, sc_in)
+            cache[sig] = lowered.compile().memory_analysis()
+        return cache[sig]
 
     @property
     def opt_state(self):

@@ -21,6 +21,7 @@ from .layers_common import (
     SELU, CELU, ELU, GELU, LeakyReLU, Softplus, Maxout, GLU, Softmax,
     LogSoftmax, PReLU, RReLU, Softmax2D, ThresholdedReLU,
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    LPPool1D, LPPool2D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
     FractionalMaxPool2D, FractionalMaxPool3D,

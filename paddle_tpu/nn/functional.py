@@ -770,6 +770,49 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  data_format == "NDHWC", ceil_mode, exclusive)
 
 
+def _lp_pool(x, norm_type, kernel_size, stride, padding, ndim,
+             ceil_mode, channel_last, avg_fn, fmt):
+    """(sum over window of x^p)^(1/p); p=inf degenerates to max pool.
+    Composed as inclusive-avg-pool of x^p scaled by the window size
+    (zero padding contributes 0 to the sum). NOTE reference semantics:
+    no abs — negative inputs with odd/fractional p produce NaN exactly
+    as the reference implementation does."""
+    p = float(norm_type)
+    if p == float("inf"):
+        return _pool(x, "max", None, kernel_size, stride, padding, ndim,
+                     channel_last, ceil_mode)
+    if p <= 0:  # note: rejects -inf too
+        raise ValueError("lp_pool norm_type must be positive")
+    ks = _pair(kernel_size, ndim)
+    win = 1
+    for k in ks:
+        win *= k
+    xp = _coerce(x) ** p
+    s = avg_fn(xp, kernel_size, stride, padding, ceil_mode=ceil_mode,
+               exclusive=False, data_format=fmt) * float(win)
+    return s ** (1.0 / p)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """Power-average pooling (parity: paddle.nn.functional.lp_pool1d)."""
+    def a1(v, k, s_, pad, ceil_mode, exclusive, data_format):
+        return avg_pool1d(v, k, s_, pad, exclusive=exclusive,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1,
+                    ceil_mode, data_format == "NLC", a1, data_format)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """Power-average pooling (parity: paddle.nn.functional.lp_pool2d)."""
+    def a2(v, k, s_, pad, ceil_mode, exclusive, data_format):
+        return avg_pool2d(v, k, s_, pad, ceil_mode=ceil_mode,
+                          exclusive=exclusive, data_format=data_format)
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
+                    ceil_mode, data_format == "NHWC", a2, data_format)
+
+
 def _adaptive_pool(x, output_size, ndim, op, channel_last):
     x = _coerce(x)
     out_sz = _pair(output_size, ndim)

@@ -722,6 +722,44 @@ def _pool_layer(fname):
     return _Pool
 
 
+class LPPool1D(Layer):
+    """Power-average pooling (parity: paddle.nn.LPPool1D)."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.kernel_size,
+                           self.stride, self.padding, self.ceil_mode,
+                           self.data_format)
+
+
+class LPPool2D(Layer):
+    """Power-average pooling (parity: paddle.nn.LPPool2D)."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size,
+                           self.stride, self.padding, self.ceil_mode,
+                           self.data_format)
+
+
 MaxPool1D = _pool_layer("max_pool1d")
 MaxPool2D = _pool_layer("max_pool2d")
 MaxPool3D = _pool_layer("max_pool3d")

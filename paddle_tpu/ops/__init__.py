@@ -199,6 +199,8 @@ Tensor.zero_ = _zero_
 
 # second batch of in-place variants (the long tail paddle exposes)
 _INPLACE2 = {
+    "log_": math.log, "log2_": math.log2, "log10_": math.log10,
+    "log1p_": math.log1p, "expm1_": math.expm1,
     "sin_": math.sin, "cos_": math.cos, "erfinv_": math.erfinv,
     "lerp_": math.lerp, "mod_": math.mod, "trunc_": math.trunc,
     "renorm_": extras.renorm, "t_": manipulation.t,
@@ -482,5 +484,6 @@ for _n in ("sin_", "cos_", "tan_", "pow_", "mod_", "tril_", "triu_",
            "add_", "subtract_", "multiply_", "divide_", "exp_", "sqrt_",
            "rsqrt_", "reciprocal_", "floor_", "ceil_", "round_", "abs_",
            "neg_", "remainder_", "cast_", "fill_", "zero_", "t_",
-           "scale_", "clip_", "tanh_", "square_", "frac_"):
+           "scale_", "clip_", "tanh_", "square_", "frac_",
+           "log_", "log2_", "log10_", "log1p_", "expm1_"):
     globals().setdefault(_n, _module_inplace(_n))

@@ -371,3 +371,12 @@ def matrix_transpose(x, name=None):
     from ._dispatch import apply as _apply
     return _apply(lambda v: jnp.swapaxes(v, -2, -1), x,
                   _name="matrix_transpose")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """Vector dot along `axis` with conjugation of x (parity:
+    paddle.linalg.vecdot)."""
+    from ._dispatch import apply as _apply
+    from .creation import _coerce
+    return _apply(lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis),
+                  _coerce(x), _coerce(y), _name="vecdot")

@@ -46,6 +46,7 @@ def _unary(jfn, name):
 
 exp = _unary(jnp.exp, "exp")
 expm1 = _unary(jnp.expm1, "expm1")
+exp2 = _unary(jnp.exp2, "exp2")
 log = _unary(jnp.log, "log")
 log2 = _unary(jnp.log2, "log2")
 log10 = _unary(jnp.log10, "log10")

@@ -36,6 +36,7 @@ SURFACE = {
         sin_ cos_ tan_ pow_ mod_ tril_ triu_ index_add_ index_fill_
         index_put_ masked_fill_ masked_scatter_ fill_diagonal_ flatten_
         sigmoid_ log_normal_ lerp_ erfinv_ trunc_ add_ subtract_
+        log_ log2_ log10_ log1p_ expm1_ exp2
         multiply_ divide_ exp_ sqrt_ rsqrt_ reciprocal_ floor_ ceil_
         round_ abs_ neg_ remainder_ cast_ fill_ zero_ t_
         reduce_as set_printoptions batch in_dynamic_mode in_static_mode
@@ -49,7 +50,7 @@ SURFACE = {
         Embedding Dropout AlphaDropout FeatureAlphaDropout ReLU GELU
         Silu Swish Mish SELU CELU ELU LeakyReLU PReLU RReLU Softmax
         Softmax2D LogSoftmax ThresholdedReLU MaxPool2D AvgPool2D
-        AdaptiveAvgPool2D AdaptiveMaxPool2D FractionalMaxPool2D
+        AdaptiveAvgPool2D AdaptiveMaxPool2D LPPool1D LPPool2D FractionalMaxPool2D
         FractionalMaxPool3D MaxUnPool2D Pad1D Pad2D Pad3D ZeroPad1D
         ZeroPad2D ZeroPad3D Upsample PixelShuffle ChannelShuffle Fold
         Unfold Flatten Identity CosineSimilarity PairwiseDistance
@@ -61,7 +62,7 @@ SURFACE = {
         AdaptiveLogSoftmaxWithLoss BeamSearchDecoder dynamic_decode
         ClipGradByValue ClipGradByNorm ClipGradByGlobalNorm ParamAttr
         initializer utils functional""",
-    "nn.functional": """relu gelu silu mish selu celu elu leaky_relu
+    "nn.functional": """lp_pool1d lp_pool2d relu gelu silu mish selu celu elu leaky_relu
         prelu rrelu thresholded_relu hardtanh hardshrink softshrink
         tanhshrink hardsigmoid hardswish softplus softsign maxout glu
         softmax log_softmax gumbel_softmax linear dropout dropout2d
@@ -113,7 +114,7 @@ SURFACE = {
     "vision.ops": """nms roi_align roi_pool psroi_pool box_coder
         deform_conv2d yolo_box yolo_loss prior_box matrix_nms
         generate_proposals distribute_fpn_proposals""",
-    "linalg": """matrix_transpose cholesky cholesky_solve cond corrcoef cov det eig eigh
+    "linalg": """vecdot matrix_transpose cholesky cholesky_solve cond corrcoef cov det eig eigh
         eigvals eigvalsh householder_product inv lstsq lu lu_unpack
         matrix_exp matrix_norm matrix_power matrix_rank multi_dot norm
         ormqr pinv qr slogdet solve svd svd_lowrank svdvals

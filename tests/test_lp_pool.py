@@ -1,0 +1,70 @@
+"""lp_pool1d/2d + LPPool layers + small surface-tail ops (torch goldens).
+
+Reference parity: paddle.nn.functional.lp_pool1d/lp_pool2d and
+paddle.nn.LPPool1D/LPPool2D (power-average pooling, no abs — negative
+inputs with odd p produce NaN like the reference); paddle.linalg.vecdot;
+module-level in-place log_ family.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+
+@pytest.mark.parametrize("p,k,s", [(2.0, 4, 2), (3.0, 3, 3),
+                                   (1.0, 2, 2), (1.5, 4, 4)])
+def test_lp_pool1d_torch_golden(p, k, s):
+    x = np.abs(np.random.RandomState(0).randn(2, 3, 16)).astype("float32")
+    got = np.asarray(F.lp_pool1d(paddle.to_tensor(x), p, k, s)._value)
+    want = TF.lp_pool1d(torch.tensor(x), p, k, s).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lp_pool1d_negative_even_p():
+    x = np.random.RandomState(1).randn(2, 3, 16).astype("float32")
+    got = np.asarray(F.lp_pool1d(paddle.to_tensor(x), 2.0, 4, 2)._value)
+    want = TF.lp_pool1d(torch.tensor(x), 2.0, 4, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lp_pool2d_golden_and_layer_grad():
+    x = np.abs(np.random.RandomState(2).randn(2, 3, 8, 8)).astype("float32")
+    got = np.asarray(F.lp_pool2d(paddle.to_tensor(x), 2.0, 2, 2)._value)
+    want = TF.lp_pool2d(torch.tensor(x), 2.0, 2, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    l = nn.LPPool2D(2.0, 2)
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    l(xt).mean().backward()
+    assert xt.grad is not None
+    assert np.isfinite(np.asarray(xt.grad._value)).all()
+
+
+def test_lp_pool_inf_is_max():
+    x = np.random.RandomState(3).randn(2, 3, 16).astype("float32")
+    got = np.asarray(
+        F.lp_pool1d(paddle.to_tensor(x), float("inf"), 4, 4)._value)
+    want = np.asarray(F.max_pool1d(paddle.to_tensor(x), 4, 4)._value)
+    np.testing.assert_allclose(got, want)
+
+
+def test_surface_tail_ops():
+    assert abs(float(paddle.exp2(paddle.to_tensor(3.0))) - 8.0) < 1e-6
+    v = paddle.linalg.vecdot(paddle.to_tensor([[1., 2.], [3., 4.]]),
+                             paddle.to_tensor([[5., 6.], [7., 8.]]))
+    np.testing.assert_allclose(np.asarray(v._value), [17., 53.])
+    t = paddle.to_tensor([1.0, float(np.e)])
+    t.log_()
+    np.testing.assert_allclose(np.asarray(t._value), [0., 1.], atol=1e-6)
+    t2 = paddle.to_tensor([4.0])
+    paddle.log2_(t2)
+    np.testing.assert_allclose(np.asarray(t2._value), [2.0])
+    t3 = paddle.to_tensor([100.0])
+    paddle.log10_(t3)
+    np.testing.assert_allclose(np.asarray(t3._value), [2.0], atol=1e-6)

@@ -5,9 +5,13 @@
 Times fwd+bwd through the Pallas kernel for block_q/block_k in
 {128, 256, 512} at bench shapes and prints a ranked table. Feed the
 winner to the bench via FLAGS_flash_block_q/_k (or set_flags)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 import itertools
-import sys
 import time
 
 

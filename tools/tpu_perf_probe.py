@@ -1,0 +1,118 @@
+"""On-device perf probe: quick decisions before committing a bench config.
+
+Measures, on the real chip:
+  1. flash attention Pallas vs XLA at bench shapes (fwd+bwd walltime)
+  2. full TrainStep tokens/s at a few batch sizes (compile cached on disk)
+
+Usage: python tools/tpu_perf_probe.py [--batches 8,16,32] [--skip-train]
+Prints one line per measurement; exits non-zero only on hard errors.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _t(fn, iters=5):
+    fn()  # compile+warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    _block(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _block(x):
+    import jax
+    jax.tree.map(lambda a: a.block_until_ready()
+                 if hasattr(a, "block_until_ready") else a, x)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="8,16,32")
+    ap.add_argument("--skip-train", action="store_true")
+    ap.add_argument("--skip-flash", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    print(f"devices: {jax.devices()}", flush=True)
+
+    from paddle_tpu.framework.flags import set_flags
+
+    if not args.skip_flash:
+        from paddle_tpu.kernels.attention import _flash_core, _xla_attention
+        B, S, H, D = 8, 1024, 8, 128
+        ks = [jax.random.normal(jax.random.PRNGKey(i), (B, S, H, D),
+                                jnp.bfloat16) for i in range(3)]
+        sc = D ** -0.5
+
+        def mk(fn):
+            f = jax.jit(jax.value_and_grad(
+                lambda q, k, v: jnp.sum(fn(q, k, v, sc, True).astype(
+                    jnp.float32)), argnums=(0, 1, 2)))
+            return lambda: f(*ks)
+
+        set_flags({"use_pallas_kernels": True})
+        tp = _t(mk(_flash_core))
+        tx = _t(mk(_xla_attention))
+        print(f"[probe] flash fwd+bwd bf16 B{B} S{S} H{H} D{D}: "
+              f"pallas {tp*1e3:.2f} ms  xla {tx*1e3:.2f} ms  "
+              f"speedup x{tx/tp:.2f}", flush=True)
+
+    if not args.skip_train:
+        import paddle_tpu as paddle
+        from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                       LlamaPretrainingCriterion)
+        from paddle_tpu.jit.bridge import TrainStep
+        paddle.set_flags({"host_init": True})
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        seq = 1024
+        for b in [int(x) for x in args.batches.split(",")]:
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            model.bfloat16()
+            crit = LlamaPretrainingCriterion(cfg)
+            opt = paddle.optimizer.AdamW(1e-4,
+                                         parameters=model.parameters())
+            step = TrainStep(model, opt, lambda lg, lb: crit(lg, lb))
+            ids = paddle.to_tensor(np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (b, seq)))
+            t0 = time.perf_counter()
+            loss = step(ids, ids)
+            float(loss)
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            n = 5
+            for _ in range(n):
+                loss = step(ids, ids)
+            fl = float(loss)
+            dt = (time.perf_counter() - t0) / n
+            tps = b * seq / dt
+            n_params = sum(p.size for p in model.parameters())
+            mfu = 6.0 * n_params * tps / 197e12
+            try:
+                peak = (jax.devices()[0].memory_stats() or {}).get(
+                    "peak_bytes_in_use", 0)
+            except Exception:
+                peak = 0
+            print(f"[probe] train b={b} seq={seq}: {tps:,.0f} tok/s  "
+                  f"mfu_est {mfu:.3f}  loss {fl:.3f}  "
+                  f"compile {compile_s:.1f}s  peak_hbm "
+                  f"{peak/2**30:.2f} GiB", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,8 +8,12 @@ asked for ("on-device pallas-vs-XLA parity asserted for every kernel").
 
 Exit 0 = all parities within tolerance; prints one line per check.
 """
-import argparse
+
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import argparse
 
 import numpy as np
 

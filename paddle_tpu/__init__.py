@@ -75,6 +75,21 @@ from . import metric
 from .framework_io import save, load
 from .nn.initializer import ParamAttr
 
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone trainable Parameter (parity:
+    python/paddle/tensor/creation.py create_parameter — LayerHelper path
+    without requiring a Layer)."""
+    from .nn.layer_base import Layer
+    helper = Layer()
+    p = helper.create_parameter(shape, attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if p is not None and name is not None:
+        p.name = name
+    return p
+
 from . import jit
 from . import static
 from .static.api import enable_static, disable_static, in_dynamic_mode

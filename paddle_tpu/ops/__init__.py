@@ -264,7 +264,9 @@ for _n, _f in {"index_add_": extras.index_add
 
 
 # fourth batch: remaining documented in-place variants + top-level aliases
-for _n, _f in {"square_": math.square, "frac_": math.frac}.items():
+for _n, _f in {"square_": math.square, "frac_": math.frac,
+               "hypot_": math.hypot, "ldexp_": extras.ldexp,
+               "gammaln_": extras.gammaln, "i0_": math.i0}.items():
     setattr(Tensor, _n, _make_inplace(_f))
     _patched.add(_n)
 
@@ -485,5 +487,14 @@ for _n in ("sin_", "cos_", "tan_", "pow_", "mod_", "tril_", "triu_",
            "rsqrt_", "reciprocal_", "floor_", "ceil_", "round_", "abs_",
            "neg_", "remainder_", "cast_", "fill_", "zero_", "t_",
            "scale_", "clip_", "tanh_", "square_", "frac_",
-           "log_", "log2_", "log10_", "log1p_", "expm1_"):
+           "log_", "log2_", "log10_", "log1p_", "expm1_",
+           "hypot_", "ldexp_", "gammaln_", "i0_"):
     globals().setdefault(_n, _module_inplace(_n))
+
+# matrix-view properties (parity: paddle.Tensor.T reverses ALL axes;
+# Tensor.mT swaps the trailing two — python/paddle/tensor/attribute.py)
+Tensor.T = property(lambda self: manipulation.transpose(
+    self, perm=list(range(self.ndim))[::-1]) if self.ndim >= 2 else self)
+Tensor.mT = property(lambda self: manipulation.transpose(
+    self, perm=list(range(self.ndim - 2)) + [self.ndim - 1, self.ndim - 2]))
+Tensor.sigmoid = sigmoid

@@ -54,7 +54,13 @@ class SparseCooTensor:
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
-        return Tensor(self._bcoo.todense())
+        b = self._bcoo
+        if b.dtype == jnp.bool_:
+            # BCOO.todense lowers to scatter-add, which rejects bool
+            cast = jsparse.BCOO((b.data.astype(jnp.int8), b.indices),
+                                shape=b.shape)
+            return Tensor(cast.todense().astype(jnp.bool_))
+        return Tensor(b.todense())
 
     def coalesce(self):
         return SparseCooTensor(self._bcoo.sum_duplicates())
@@ -307,5 +313,74 @@ def reshape(x, shape, name=None):
 def is_same_shape(x, y):
     return list(x.shape) == list(y.shape)
 
+
+isnan = _unary(jnp.isnan)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector (parity: python/paddle/sparse/binary.py
+    mv): [*, M, N] @ [N] -> [*, M]."""
+    b = _as_bcoo(x)
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(b @ v)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Reduce a sparse tensor (parity: python/paddle/sparse/unary.py
+    sparse sum). Returns dense for full reduction (paddle returns a
+    0-nnz sparse scalar; dense is the usable equivalent), sparse when an
+    axis survives."""
+    b = _as_bcoo(x)
+    dense = b.todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim, dtype=dtype)
+    if axis is None:
+        return Tensor(out)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse COO tensor along `axes` (parity:
+    python/paddle/sparse/multiary.py slice): filter coordinates inside
+    the window, shift indices to the new origin."""
+    b = _as_bcoo(x)
+    shape = list(b.shape)
+    lo = {int(a): int(s) for a, s in zip(axes, starts)}
+    hi = {}
+    for a, e in zip(axes, ends):
+        a, e = int(a), int(e)
+        if e < 0:
+            e += shape[a]
+        hi[a] = min(e, shape[a])
+    for a in list(lo):
+        if lo[a] < 0:
+            lo[a] += shape[a]
+    keep = jnp.ones((b.indices.shape[0],), bool)
+    for a in lo:
+        col = b.indices[:, a]
+        keep = keep & (col >= lo[a]) & (col < hi[a])
+    # host-side compaction (indices are concrete outside jit)
+    import numpy as _np
+    keep_np = _np.asarray(keep)
+    idx = _np.asarray(b.indices)[keep_np]
+    dat = _np.asarray(b.data)[keep_np]
+    for a in lo:
+        idx[:, a] -= lo[a]
+        shape[a] = hi[a] - lo[a]
+    return SparseCooTensor(jsparse.BCOO((jnp.asarray(dat), jnp.asarray(idx)),
+                                        shape=tuple(shape)))
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's entries at mask's nonzero coordinate pattern (parity:
+    python/paddle/sparse/unary.py mask_as): dense x, sparse mask ->
+    sparse with mask's sparsity."""
+    m = _as_bcoo(mask)
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    cols = tuple(m.indices[:, d] for d in range(m.indices.shape[1]))
+    vals = xv[cols]
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+__all__ += ["isnan", "mv", "sum", "slice", "mask_as"]
 
 from . import nn  # noqa: E402  (paddle.sparse.nn layers)

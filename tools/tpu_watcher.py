@@ -3,20 +3,34 @@
 The sandbox's TPU tunnel intermittently wedges at backend init (rounds
 1-3: ``jax.devices()`` blocks forever at the claim step).  Instead of
 giving up for the round, this watcher probes the backend in a fresh
-subprocess every few minutes; the moment init succeeds it runs, in
-order:
+subprocess; the moment init succeeds it runs, in order:
 
   1. ``tools/tpu_validate.py``      -> output/tpu_validate_r04.log
   2. ``tools/tpu_autotune_flash.py``-> output/tpu_autotune_r04.log
   3. ``bench.py`` (Pallas ON)       -> output/bench_r04.json/.log
 
-then exits.  Each probe is a subprocess so a wedged init never poisons
-the watcher itself.  Run it detached: ``python tools/tpu_watcher.py &``.
+Hard-won mechanics (round 4, first session with a live tunnel):
+
+- NEVER ``capture_output=True`` on a subprocess that inits the axon
+  backend: the plugin spawns helpers that inherit the pipe, so after a
+  timeout-kill the parent blocks forever draining a pipe that never
+  hits EOF.  All child output goes to FILES.
+- Kill the WHOLE process group on timeout (``start_new_session=True`` +
+  ``killpg``): a half-claimed client left alive wedges the relay for
+  every later claim.
+- The device platform under the tunnel is not necessarily ``tpu`` —
+  accept any non-cpu platform.
+- Backend init can legitimately take minutes over the tunnel; probe
+  timeout must be generous (300s), and failed claims appear to wedge
+  the relay for a while, so back off meaningfully between probes.
+
+Run it detached: ``python tools/tpu_watcher.py &``.
 """
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -26,8 +40,8 @@ OUT = os.path.join(REPO, "output")
 os.makedirs(OUT, exist_ok=True)
 STATE = os.path.join(OUT, "tpu_watcher_state.json")
 
-PROBE_TIMEOUT = 180  # seconds for jax.devices() in a subprocess
-SLEEP_BETWEEN = 240  # seconds between probes
+PROBE_TIMEOUT = 300   # seconds for jax.devices() in a subprocess
+SLEEP_BETWEEN = 240   # seconds between probes
 
 
 def log(msg: str) -> None:
@@ -48,41 +62,56 @@ def save_state(**kw) -> None:
         json.dump(st, f, indent=1)
 
 
-def probe() -> bool:
+def run_group(argv: list[str], logfile: str, timeout: int) -> int:
+    """Run argv in its own process group, output to `logfile`; on
+    timeout SIGKILL the whole group (axon helpers included). Returns rc,
+    or -9 on timeout-kill."""
+    with open(logfile, "a") as f:
+        p = subprocess.Popen(argv, stdout=f, stderr=subprocess.STDOUT,
+                             cwd=REPO, env={**os.environ},
+                             start_new_session=True)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rc = p.poll()
+        if rc is not None:
+            return rc
+        time.sleep(2)
+    try:
+        os.killpg(p.pid, signal.SIGKILL)
+    except Exception:
+        pass
+    try:
+        p.wait(timeout=30)
+    except Exception:
+        pass
+    return -9
+
+
+def probe(attempt: int) -> bool:
     """True iff the TPU backend initialises in a fresh subprocess."""
     code = (
         "import jax; ds=jax.devices(); "
-        "print(ds[0].platform, len(ds))"
+        "print('PROBE-PLATFORM', ds[0].platform, len(ds), flush=True)"
     )
+    logfile = os.path.join(OUT, "tpu_probe.log")
+    rc = run_group([sys.executable, "-c", code], logfile, PROBE_TIMEOUT)
+    out = ""
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
-            cwd=REPO, env={**os.environ},
-        )
-    except subprocess.TimeoutExpired:
+        with open(logfile) as f:
+            for line in f:
+                if "PROBE-PLATFORM" in line:
+                    out = line.strip()
+    except Exception:
+        pass
+    if rc != 0:
+        log(f"probe rc={rc} (timeout-kill=-9) out={out!r}")
         return False
-    if r.returncode != 0:
-        log(f"probe failed rc={r.returncode}: {r.stderr.strip()[-200:]}")
+    if not out:
+        log(f"probe rc=0 but no platform line")
         return False
-    out = r.stdout.strip()
+    plat = out.split()[1].lower()
     log(f"probe OK: {out}")
-    return out.startswith("tpu")
-
-
-def run_step(name: str, argv: list[str], logfile: str,
-             timeout: int = 3600) -> int:
-    log(f"running {name} -> {logfile}")
-    with open(logfile, "w") as f:
-        try:
-            r = subprocess.run(argv, stdout=f, stderr=subprocess.STDOUT,
-                               timeout=timeout, cwd=REPO)
-            rc = r.returncode
-        except subprocess.TimeoutExpired:
-            rc = -9
-    log(f"{name} rc={rc}")
-    save_state(**{name: rc, name + "_ts": time.time()})
-    return rc
+    return plat != "cpu"
 
 
 def main() -> None:
@@ -92,18 +121,26 @@ def main() -> None:
         attempt += 1
         log(f"probe attempt {attempt}")
         save_state(attempts=attempt, last_probe=time.time())
-        if probe():
+        if probe(attempt):
             save_state(status="tpu-up", tpu_up_ts=time.time())
             break
         time.sleep(SLEEP_BETWEEN)
 
     py = sys.executable
-    run_step("tpu_validate", [py, "tools/tpu_validate.py"],
-             os.path.join(OUT, "tpu_validate_r04.log"), timeout=2400)
-    run_step("tpu_autotune", [py, "tools/tpu_autotune_flash.py"],
-             os.path.join(OUT, "tpu_autotune_r04.log"), timeout=2400)
+
+    def step(name: str, argv: list[str], logfile: str, timeout: int) -> int:
+        log(f"running {name} -> {logfile}")
+        rc = run_group(argv, logfile, timeout)
+        log(f"{name} rc={rc}")
+        save_state(**{name: rc, name + "_ts": time.time()})
+        return rc
+
+    step("tpu_validate", [py, "tools/tpu_validate.py"],
+         os.path.join(OUT, "tpu_validate_r04.log"), timeout=2400)
+    step("tpu_autotune", [py, "tools/tpu_autotune_flash.py"],
+         os.path.join(OUT, "tpu_autotune_r04.log"), timeout=2400)
     benchlog = os.path.join(OUT, "bench_r04.log")
-    rc = run_step("bench", [py, "bench.py"], benchlog, timeout=3600)
+    rc = step("bench", [py, "bench.py"], benchlog, timeout=3600)
     # extract the JSON line for convenience
     try:
         with open(benchlog) as f:

@@ -19,7 +19,11 @@ def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def _backend_watchdog(timeout_s=240):
+def _backend_watchdog(timeout_s=None):
+    if timeout_s is None:
+        # init over the tunnel has been observed to take 3-5 min when
+        # healthy; don't declare a wedge before giving it real time
+        timeout_s = int(os.environ.get("BENCH_INIT_TIMEOUT_S", "420"))
     """The sandbox's TPU tunnel sometimes wedges at the claim step and
     jax.devices() then blocks forever (known environmental failure; see
     round-1/2 bench notes). Probe backend init on a side thread so the
@@ -204,31 +208,52 @@ def _orchestrate():
        retried once with FLAGS_use_pallas_kernels=0 so a crashed kernel
        build still yields a real (annotated) XLA-path measurement.
     """
+    import signal
     import subprocess
+    import tempfile
 
-    deadline = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "300"))
+    # NEVER capture_output=True here: the axon plugin spawns helpers that
+    # inherit the pipe, and after a timeout-kill the parent then blocks
+    # forever draining a pipe that never reaches EOF (observed r4). The
+    # child writes to files; on timeout the WHOLE process group dies.
+    deadline = int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "900"))
     attempts = [dict(os.environ),
                 {**os.environ, "FLAGS_use_pallas_kernels": "0"}]
     for i, env in enumerate(attempts):
-        try:
-            res = subprocess.run(
-                [sys.executable, __file__, "--worker"], env=env,
-                capture_output=True, text=True, timeout=deadline)
-        except subprocess.TimeoutExpired as e:
+        out_f = tempfile.NamedTemporaryFile("w+", suffix=".out", delete=False)
+        err_f = tempfile.NamedTemporaryFile("w+", suffix=".err", delete=False)
+        p = subprocess.Popen(
+            [sys.executable, __file__, "--worker"], env=env,
+            stdout=out_f, stderr=err_f, start_new_session=True)
+        t_end = time.time() + deadline
+        while time.time() < t_end and p.poll() is None:
+            time.sleep(2)
+        timed_out = p.poll() is None
+        if timed_out:
             _log(f"attempt {i}: child exceeded {deadline}s "
-                 f"({'pallas on' if i == 0 else 'pallas off'}), killed")
-            if e.stderr:  # the stall breadcrumbs are the diagnostic
-                tail = e.stderr if isinstance(e.stderr, str) else \
-                    e.stderr.decode(errors="replace")
-                sys.stderr.write(tail[-2000:])
+                 f"({'pallas on' if i == 0 else 'pallas off'}), "
+                 "killing process group")
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except Exception:
+                pass
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                pass
+        out_f.close(), err_f.close()
+        stderr_txt = open(err_f.name, errors="replace").read()
+        stdout_txt = open(out_f.name, errors="replace").read()
+        os.unlink(out_f.name), os.unlink(err_f.name)
+        sys.stderr.write(stderr_txt[-4000:])
+        if timed_out:
             continue
-        sys.stderr.write(res.stderr)
-        if res.returncode == 0 and res.stdout.strip():
-            sys.stdout.write(res.stdout)
+        if p.returncode == 0 and stdout_txt.strip():
+            sys.stdout.write(stdout_txt)
             return 0
-        if res.returncode == 3:
-            return 3  # wedged tunnel: retrying cannot help
-        _log(f"attempt {i}: child rc={res.returncode}")
+        if p.returncode == 3:
+            return 3  # wedged tunnel: a later retry (watcher) may help
+        _log(f"attempt {i}: child rc={p.returncode}")
     _log("FATAL: all bench attempts failed")
     return 1
 

@@ -15,7 +15,8 @@ SURFACE = {
         cross cummax cummin cumprod cumsum diag diag_embed diagonal diff
         digamma dist divide dot einsum empty equal equal_all erf erfinv
         exp expand eye flatten flip floor full gather gather_nd gcd
-        heaviside histogram hypot i0 index_add index_fill index_put
+        heaviside histogram hypot hypot_ i0 i0_ ldexp_ gammaln_
+        create_parameter index_add index_fill index_put
         index_sample index_select inner inverse isclose isfinite isinf
         isnan kron kthvalue lcm lerp lgamma linspace log log10 log1p
         log2 logaddexp logcumsumexp logical_and logit logspace logsumexp
@@ -123,7 +124,9 @@ SURFACE = {
         hfft2 hfftn ihfft2 ihfftn
         hfft ihfft fftfreq rfftfreq fftshift ifftshift""",
     "sparse": """sparse_coo_tensor sparse_csr_tensor add subtract
-        multiply divide addmm matmul masked_matmul relu nn""",
+        multiply divide addmm matmul masked_matmul relu nn
+        isnan mv sum slice mask_as is_same_shape coalesce transpose
+        reshape""",
     "amp": """auto_cast decorate GradScaler amp_guard
         is_float16_supported is_bfloat16_supported debugging
         is_autocast_enabled get_autocast_dtype""",

@@ -292,3 +292,44 @@ class TestHermitianFFTAndSparseAttention:
         np.testing.assert_array_equal(out_nodes.numpy()[:2], [0, 2])
         assert set(out_nodes.numpy().tolist()) == {0, 1, 2}
         assert (np.asarray(src.numpy()) < len(out_nodes.numpy())).all()
+
+
+class TestSparseTailOps:
+    """round-4 sparse surface tail (parity: python/paddle/sparse/unary.py
+    isnan/mask_as, binary.py mv, multiary.py slice, unary.py sum)."""
+
+    def _coo(self, d):
+        import paddle_tpu.sparse as sp
+        idx = np.nonzero(d)
+        return sp.sparse_coo_tensor(idx, d[idx], shape=d.shape)
+
+    def test_sum_mv_slice_mask_isnan(self):
+        import paddle_tpu.sparse as sp
+        d = np.array([[0, 1., 0], [2., 0, 3.]], np.float32)
+        s = self._coo(d)
+        np.testing.assert_allclose(sp.sum(s).numpy(), d.sum())
+        np.testing.assert_allclose(sp.sum(s, axis=1).to_dense().numpy(),
+                                   d.sum(1))
+        np.testing.assert_allclose(
+            sp.mv(s, paddle.to_tensor(np.array([1., 2., 3.], "f"))).numpy(),
+            d @ np.array([1, 2, 3.]))
+        sl = sp.slice(s, [1], [1], [3])
+        np.testing.assert_allclose(sl.to_dense().numpy(), d[:, 1:3])
+        m = sp.mask_as(paddle.to_tensor(np.full_like(d, 7.0)), s)
+        np.testing.assert_allclose(m.to_dense().numpy(),
+                                   (d != 0) * 7.0)
+        assert not sp.isnan(s).to_dense().numpy().any()
+
+    def test_tensor_T_mT(self):
+        t = paddle.to_tensor(np.arange(6, dtype="f").reshape(2, 3) * 1.0)
+        assert t.T.shape == [3, 2] and t.mT.shape == [3, 2]
+        t3 = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype("f"))
+        assert t3.T.shape == [4, 3, 2] and t3.mT.shape == [2, 4, 3]
+        np.testing.assert_allclose(t3.mT.numpy(),
+                                   np.swapaxes(t3.numpy(), -1, -2))
+        # in-place tail
+        x = paddle.to_tensor(np.array([3.0, 4.0], "f"))
+        x.hypot_(paddle.to_tensor(np.array([4.0, 3.0], "f")))
+        np.testing.assert_allclose(x.numpy(), [5, 5])
+        p = paddle.create_parameter([2, 3], "float32")
+        assert p.trainable and p.shape == [2, 3]

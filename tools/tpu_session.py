@@ -1,0 +1,110 @@
+"""One-claim TPU session: validate + bench + autotune in a SINGLE
+process.
+
+Each tool run as its own process costs one relay claim, and claims are
+the fragile step of the sandbox tunnel (a timed-out claim wedges the
+relay for a while).  This runner claims once and spends the session:
+
+  1. kernel parity (tools/tpu_validate.main)    — VERDICT r3 next #1
+  2. bench measurement (bench.main, Pallas ON)  — BENCH_r04 evidence
+  3. flash block-size sweep (tpu_autotune_flash) — VERDICT r3 next #2
+
+Failures in one stage don't abort the rest (SystemExit/Exception are
+caught and logged); the bench's JSON line is tee'd to
+output/bench_r04.json.  Run via tools/tpu_watcher.py, which probes for
+a live backend first.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "output")
+os.makedirs(OUT, exist_ok=True)
+
+
+def _log(msg: str) -> None:
+    print(f"[tpu-session {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def _stage(name, fn):
+    _log(f"=== stage {name} start ===")
+    t0 = time.time()
+    try:
+        rc = fn()
+        _log(f"=== stage {name} done rc={rc} ({time.time() - t0:.0f}s) ===")
+        return rc if isinstance(rc, int) else 0
+    except SystemExit as e:
+        _log(f"=== stage {name} SystemExit {e.code} "
+             f"({time.time() - t0:.0f}s) ===")
+        return int(e.code or 0)
+    except Exception:
+        _log(f"=== stage {name} EXCEPTION ({time.time() - t0:.0f}s) ===")
+        traceback.print_exc()
+        return 1
+
+
+def main() -> int:
+    import importlib.util
+
+    def load(path, name):
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    results = {}
+
+    tv = load(os.path.join(REPO, "tools", "tpu_validate.py"), "tpu_validate")
+    results["validate"] = _stage("validate", lambda: tv.main([]))
+
+    # bench: main() is the worker path (measures in THIS process); tee
+    # stdout so the JSON line also lands in output/bench_r04.json
+    bench = load(os.path.join(REPO, "bench.py"), "bench_mod")
+
+    def run_bench():
+        cap = io.StringIO()
+        real = sys.stdout
+
+        class Tee:
+            def write(self, s):
+                real.write(s)
+                cap.write(s)
+
+            def flush(self):
+                real.flush()
+
+        sys.stdout = Tee()
+        try:
+            bench.main()
+        finally:
+            sys.stdout = real
+        for line in cap.getvalue().splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                with open(os.path.join(OUT, "bench_r04.json"), "w") as g:
+                    g.write(line + "\n")
+                _log("bench JSON captured -> output/bench_r04.json")
+        return 0
+
+    results["bench"] = _stage("bench", run_bench)
+
+    at = load(os.path.join(REPO, "tools", "tpu_autotune_flash.py"),
+              "tpu_autotune_flash")
+    results["autotune"] = _stage("autotune", lambda: at.main([]))
+
+    with open(os.path.join(OUT, "tpu_session_result.json"), "w") as f:
+        json.dump({**results, "ts": time.time()}, f, indent=1)
+    _log(f"session results: {results}")
+    # session succeeds if the bench produced its artifact
+    return 0 if results.get("bench") == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,43 +116,34 @@ def probe(attempt: int) -> bool:
 
 def main() -> None:
     attempt = 0
+    cycle = 0
     save_state(started=time.time(), status="probing")
+    py = sys.executable
+    bench_json = os.path.join(OUT, "bench_r04.json")
     while True:
         attempt += 1
         log(f"probe attempt {attempt}")
         save_state(attempts=attempt, last_probe=time.time())
-        if probe(attempt):
-            save_state(status="tpu-up", tpu_up_ts=time.time())
-            break
+        if not probe(attempt):
+            time.sleep(SLEEP_BETWEEN)
+            continue
+        save_state(status="tpu-up", tpu_up_ts=time.time())
+        # ONE claim, whole session: validate + bench + autotune in a
+        # single process (claims are the fragile step — spend them well)
+        cycle += 1
+        sess_log = os.path.join(OUT, f"tpu_session_r04_c{cycle}.log")
+        log(f"running tpu_session (cycle {cycle}) -> {sess_log}")
+        rc = run_group([py, "tools/tpu_session.py"], sess_log, timeout=7200)
+        log(f"tpu_session rc={rc}")
+        save_state(session_rc=rc, session_cycle=cycle,
+                   session_ts=time.time())
+        if rc == 0 and os.path.exists(bench_json):
+            save_state(status="done", done_ts=time.time())
+            log("watcher done: bench artifact present")
+            return
+        log("session incomplete; resuming probe loop")
+        save_state(status="probing")
         time.sleep(SLEEP_BETWEEN)
-
-    py = sys.executable
-
-    def step(name: str, argv: list[str], logfile: str, timeout: int) -> int:
-        log(f"running {name} -> {logfile}")
-        rc = run_group(argv, logfile, timeout)
-        log(f"{name} rc={rc}")
-        save_state(**{name: rc, name + "_ts": time.time()})
-        return rc
-
-    step("tpu_validate", [py, "tools/tpu_validate.py"],
-         os.path.join(OUT, "tpu_validate_r04.log"), timeout=2400)
-    step("tpu_autotune", [py, "tools/tpu_autotune_flash.py"],
-         os.path.join(OUT, "tpu_autotune_r04.log"), timeout=2400)
-    benchlog = os.path.join(OUT, "bench_r04.log")
-    rc = step("bench", [py, "bench.py"], benchlog, timeout=3600)
-    # extract the JSON line for convenience
-    try:
-        with open(benchlog) as f:
-            for line in f:
-                line = line.strip()
-                if line.startswith("{") and '"metric"' in line:
-                    with open(os.path.join(OUT, "bench_r04.json"), "w") as g:
-                        g.write(line + "\n")
-    except Exception:
-        pass
-    save_state(status="done", done_ts=time.time(), bench_rc=rc)
-    log("watcher done")
 
 
 if __name__ == "__main__":

@@ -84,6 +84,19 @@ def main():
     # a remote compile/execute RPC, so jax.random-based init alone can eat
     # minutes before the first step (observed r4: >540s to build)
     paddle.set_flags({"host_init": True})
+    # pick up autotuned flash block sizes if a sweep has run
+    # (tools/tpu_autotune_flash.py persists its winner); explicit env
+    # FLAGS_flash_block_q wins over the file
+    tune_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "output", "flash_tune.json")
+    if os.path.exists(tune_path) and "FLAGS_flash_block_q" not in os.environ:
+        try:
+            tune = json.load(open(tune_path))
+            paddle.set_flags({"flash_block_q": int(tune["flash_block_q"]),
+                              "flash_block_k": int(tune["flash_block_k"])})
+            _log(f"flash tune applied: {tune}")
+        except Exception as e:
+            _log(f"flash tune ignored: {e!r}")
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     if on_tpu:

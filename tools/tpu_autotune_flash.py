@@ -67,6 +67,15 @@ def main(argv=None):
     best = results[0]
     print(f"\nBEST: flash_block_q={best[1]} flash_block_k={best[2]} "
           f"({best[0]:.3f} ms/iter fwd+bwd)")
+    # persist the winner so bench.py picks it up automatically
+    import json
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "output")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "flash_tune.json"), "w") as f:
+        json.dump({"flash_block_q": best[1], "flash_block_k": best[2],
+                   "ms_per_iter": round(best[0], 3),
+                   "shape": list(shape), "dtype": args.dtype}, f)
     return 0
 
 

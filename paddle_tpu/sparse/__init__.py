@@ -281,7 +281,8 @@ def reshape(x, shape, name=None):
         strides.append(acc)
         acc *= s
     strides = list(reversed(strides))
-    lin = sum(b.indices[:, d].astype(jnp.int64) * strides[d]
+    import builtins
+    lin = builtins.sum(b.indices[:, d].astype(jnp.int64) * strides[d]
               for d in range(len(b.shape)))
     shape = [int(s) for s in shape]
     n_elem = 1

@@ -74,7 +74,11 @@ def main():
                           num_attention_heads=8, num_key_value_heads=8,
                           max_position_embeddings=2048,
                           tensor_parallel=False)
-        batch, seq, iters, warmup = 8, 1024, 10, 2
+        # batch 16 (was 8 through r3): no green on-device run exists yet
+        # to compare against, and the larger batch roughly doubles
+        # per-step MXU work at negligible HBM cost for this model size
+        batch, seq, iters, warmup = int(os.environ.get("BENCH_BATCH", "16")), \
+            int(os.environ.get("BENCH_SEQ", "1024")), 10, 2
     else:  # smoke mode for CPU dev runs
         cfg = LlamaConfig.tiny(tensor_parallel=False)
         batch, seq, iters, warmup = 2, 64, 3, 1

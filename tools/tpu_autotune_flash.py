@@ -17,7 +17,8 @@ import time
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--batch", type=int,
+                   default=int(os.environ.get("BENCH_BATCH", "16")))
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--d", type=int, default=128)

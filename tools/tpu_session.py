@@ -3,11 +3,15 @@ process.
 
 Each tool run as its own process costs one relay claim, and claims are
 the fragile step of the sandbox tunnel (a timed-out claim wedges the
-relay for a while).  This runner claims once and spends the session:
+relay for a while).  This runner claims once and spends the session, must-have artifact
+first so a tunnel drop mid-session still leaves evidence:
 
-  1. kernel parity (tools/tpu_validate.main)    — VERDICT r3 next #1
-  2. bench measurement (bench.main, Pallas ON)  — BENCH_r04 evidence
+  1. bench measurement (bench.main, Pallas ON + its built-in
+     kernel-parity check)                        — BENCH_r04 evidence
+  2. kernel parity, all kernels (tpu_validate)   — VERDICT r3 next #1
   3. flash block-size sweep (tpu_autotune_flash) — VERDICT r3 next #2
+  4. re-bench with tuned blocks (kept only if faster)
+  5. serving decode bench (tools/serve_bench.py)
 
 Failures in one stage don't abort the rest (SystemExit/Exception are
 caught and logged); the bench's JSON line is tee'd to
@@ -61,9 +65,6 @@ def main() -> int:
 
     results = {}
 
-    tv = load(os.path.join(REPO, "tools", "tpu_validate.py"), "tpu_validate")
-    results["validate"] = _stage("validate", lambda: tv.main([]))
-
     # bench: main() is the worker path (measures in THIS process); tee
     # stdout so the JSON line also lands in output/bench_r04.json —
     # keeping the BEST tokens/s across runs (pre- and post-autotune)
@@ -108,7 +109,13 @@ def main() -> int:
                      f"({best['value']:.0f}); artifact kept")
         return 0
 
+    # ORDER: bench first — it is the must-have artifact and carries its
+    # own opportunistic kernel-parity check; a tunnel drop mid-session
+    # then still leaves BENCH_r04 evidence. Validate/autotune refine it.
     results["bench"] = _stage("bench", run_bench)
+
+    tv = load(os.path.join(REPO, "tools", "tpu_validate.py"), "tpu_validate")
+    results["validate"] = _stage("validate", lambda: tv.main([]))
 
     at = load(os.path.join(REPO, "tools", "tpu_autotune_flash.py"),
               "tpu_autotune_flash")
@@ -118,6 +125,9 @@ def main() -> int:
     # output/flash_tune.json); only overwrites the artifact if faster
     if results["autotune"] == 0 and results["bench"] == 0:
         results["bench_tuned"] = _stage("bench_tuned", run_bench)
+
+    sb = load(os.path.join(REPO, "tools", "serve_bench.py"), "serve_bench")
+    results["serve"] = _stage("serve", lambda: sb.main([]))
 
     with open(os.path.join(OUT, "tpu_session_result.json"), "w") as f:
         json.dump({**results, "ts": time.time()}, f, indent=1)

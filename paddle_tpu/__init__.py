@@ -68,6 +68,7 @@ from .ops import *  # noqa: F401,F403
 from . import ops
 
 from . import nn
+from . import regularizer
 from . import optimizer
 from . import amp
 from . import io

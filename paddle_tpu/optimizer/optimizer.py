@@ -43,6 +43,29 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
         self._accum_meta: Dict[int, str] = {}
 
+    # ----------------------------------------------------- regularization --
+    def _decayed_grad(self, p, g):
+        """Fold the weight-decay penalty into the gradient. A
+        per-parameter regularizer (ParamAttr(regularizer=...)) takes
+        priority over the optimizer-level weight_decay (upstream
+        python/paddle/optimizer/optimizer.py priority rule)."""
+        return self._fn_decayed_grad(p._value, g, p)
+
+    def _fn_decayed_grad(self, p, g, param=None):
+        """Functional-path twin of _decayed_grad: p/g are raw arrays
+        (possibly tracers inside a compiled step); `param` is the
+        originating Parameter when the caller has it, carrying the
+        per-param regularizer override."""
+        reg = getattr(param, "regularizer", None) if param is not None \
+            else None
+        if reg is None:
+            reg = self._regularization_coeff
+        if not reg:
+            return g
+        if callable(reg):
+            return reg(p, g)
+        return g + float(reg) * p
+
     # ------------------------------------------------------------ LR API --
     def get_lr(self):
         return _as_float(self._learning_rate)
@@ -186,8 +209,7 @@ class SGD(Optimizer):
         self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         return _sgd_kernel(p._value, g, lr)
 
 
@@ -206,8 +228,7 @@ class Momentum(Optimizer):
         self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         vel = self._get_accumulator("velocity", p)
         new_p, new_v = _momentum_kernel(p._value, g, vel, lr, self._momentum,
                                         self._use_nesterov)
@@ -237,8 +258,7 @@ class Adam(Optimizer):
         self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
         t = self._get_accumulator("step", p,
@@ -275,7 +295,20 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
                          name=name)
-        self._wd = float(weight_decay) if isinstance(weight_decay, (int, float)) else weight_decay
+        from ..regularizer import L2Decay, WeightDecayRegularizer
+        if isinstance(weight_decay, (int, float)):
+            self._wd = float(weight_decay)
+        elif isinstance(weight_decay, L2Decay):
+            # AdamW's decay is decoupled; an L2Decay object degrades to
+            # its coefficient (upstream accepts float/Tensor here)
+            self._wd = weight_decay.coeff
+        elif isinstance(weight_decay, WeightDecayRegularizer):
+            raise TypeError(
+                "AdamW applies decoupled L2 decay; pass a float (or "
+                "L2Decay) as weight_decay, or attach the regularizer "
+                "per-parameter via ParamAttr(regularizer=...)")
+        else:
+            self._wd = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 
@@ -283,6 +316,10 @@ class AdamW(Adam):
         wd = self._wd
         if self._apply_decay_param_fun is not None and \
                 not self._apply_decay_param_fun(getattr(p, "name", "") or ""):
+            wd = 0.0
+        if getattr(p, "regularizer", None) is not None:
+            # per-param regularizer wins over the decoupled decay
+            g = self._decayed_grad(p, g)
             wd = 0.0
         if self._lr_ratio is not None:
             lr = lr * self._lr_ratio(p)
@@ -311,8 +348,7 @@ class Adagrad(Optimizer):
         self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         acc = self._get_accumulator(
             "moment", p, init=lambda x: jnp.full_like(x, self._init_acc))
         new_p, new_acc = _adagrad_kernel(p._value, g, acc, lr, self.epsilon)
@@ -335,8 +371,7 @@ class Adamax(Optimizer):
         self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         m = self._get_accumulator("moment", p)
         u = self._get_accumulator("inf_norm", p)
         t = self._get_accumulator("step", p,
@@ -369,8 +404,7 @@ class RMSProp(Optimizer):
         self._multi_precision = multi_precision
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         ms = self._get_accumulator("mean_square", p)
         mg = self._get_accumulator("mean_grad", p)
         mom = self._get_accumulator("momentum", p)
@@ -528,8 +562,7 @@ def _sgd_fn_init(self, a):
 
 
 def _sgd_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     return _sgd_math(p, g, lr), ()
 
 
@@ -542,8 +575,7 @@ def _momentum_fn_init(self, a):
 
 
 def _momentum_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, v2 = _momentum_math(p, g, s["velocity"], lr, self._momentum,
                             self._use_nesterov)
     return p2, {"velocity": v2}
@@ -559,8 +591,7 @@ def _adam_fn_init(self, a):
 
 
 def _adam_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, m2, v2, t2 = _adam_math(p, g, s["moment1"], s["moment2"], s["step"],
                                 lr, self.beta1, self.beta2, self.epsilon, 0.0)
     return p2, {"moment1": m2, "moment2": v2, "step": t2}
@@ -574,6 +605,11 @@ def _adamw_fn_apply(self, p, g, s, lr, name, param=None):
     wd = self._wd
     if self._apply_decay_param_fun is not None and \
             not self._apply_decay_param_fun(name or ""):
+        wd = 0.0
+    if param is not None and getattr(param, "regularizer", None) is not None:
+        # per-param regularizer wins over the decoupled decay (mirrors
+        # AdamW._update's eager-path rule)
+        g = self._fn_decayed_grad(p, g, param)
         wd = 0.0
     if self._lr_ratio is not None and param is not None:
         lr = lr * self._lr_ratio(param)
@@ -590,8 +626,7 @@ def _adagrad_fn_init(self, a):
 
 
 def _adagrad_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, acc2 = _adagrad_math(p, g, s["moment"], lr, self.epsilon)
     return p2, {"moment": acc2}
 
@@ -606,8 +641,7 @@ def _adamax_fn_init(self, a):
 
 
 def _adamax_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, m2, u2, t2 = _adamax_math(p, g, s["moment"], s["inf_norm"], s["step"],
                                   lr, self.beta1, self.beta2, self.epsilon)
     return p2, {"moment": m2, "inf_norm": u2, "step": t2}
@@ -623,8 +657,7 @@ def _rmsprop_fn_init(self, a):
 
 
 def _rmsprop_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, ms2, mg2, mom2 = _rmsprop_math(
         p, g, s["mean_square"], s["mean_grad"], s["momentum"], lr, self.rho,
         self.epsilon, self.momentum, self.centered)
@@ -671,8 +704,7 @@ class Adadelta(Optimizer):
         self.epsilon, self.rho = epsilon, rho
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         avg_sq = self._get_accumulator("avg_squared_grad", p)
         avg_up = self._get_accumulator("avg_squared_update", p)
         new_p, new_sq, new_up = _adadelta_kernel(
@@ -731,8 +763,7 @@ class ASGD(Optimizer):
         self.batch_num = batch_num
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         d = self._get_accumulator("d", p)
         ys = self._get_accumulator("ys", p)
         n = self._get_accumulator("n", p,
@@ -767,8 +798,7 @@ class NAdam(Optimizer):
         self.momentum_decay = momentum_decay
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
         mu_prod = self._get_accumulator(
@@ -807,8 +837,7 @@ class RAdam(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def _update(self, p, g, lr):
-        if self._regularization_coeff:
-            g = g + self._regularization_coeff * p._value
+        g = self._decayed_grad(p, g)
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
         t = self._get_accumulator("step", p,
@@ -861,8 +890,7 @@ def _adadelta_fn_init(self, a):
 
 
 def _adadelta_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, sq2, up2 = _adadelta_math(p, g, s["avg_squared_grad"],
                                   s["avg_squared_update"], lr, self.rho,
                                   self.epsilon)
@@ -880,8 +908,7 @@ def _nadam_fn_init(self, a):
 
 
 def _nadam_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, m2, v2, mp2, t2 = _nadam_math(
         p, g, s["moment1"], s["moment2"], s["mu_product"], s["step"], lr,
         self.beta1, self.beta2, self.epsilon, self.momentum_decay)
@@ -899,8 +926,7 @@ def _radam_fn_init(self, a):
 
 
 def _radam_fn_apply(self, p, g, s, lr, name, param=None):
-    if self._regularization_coeff:
-        g = g + self._regularization_coeff * p
+    g = self._fn_decayed_grad(p, g, param)
     p2, m2, v2, t2 = _radam_math(p, g, s["moment1"], s["moment2"],
                                  s["step"], lr, self.beta1, self.beta2,
                                  self.epsilon)

@@ -1,0 +1,59 @@
+"""Weight-decay regularizers (parity: python/paddle/regularizer.py).
+
+Semantics mirrored from the reference:
+- ``weight_decay=`` on an optimizer may be a float (L2 coefficient) or a
+  regularizer instance.
+- A regularizer set per-parameter via ``ParamAttr(regularizer=...)``
+  takes PRIORITY over the optimizer-level one for that parameter
+  (upstream Optimizer docstring rule).
+- Coupled optimizers fold the penalty into the gradient
+  (g + coeff*p for L2, g + coeff*sign(p) for L1); AdamW keeps its
+  decoupled decay for parameters without their own regularizer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base class; subclasses implement __call__(param, grad) -> grad."""
+
+    def __call__(self, param, grad):
+        raise NotImplementedError("subclass L1Decay/L2Decay and implement "
+                                  "__call__(param, grad)")
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (classic L2 / ridge penalty gradient)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param, grad):
+        return grad + self._coeff * param
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param) (lasso penalty gradient)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param, grad):
+        return grad + self._coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"

@@ -30,6 +30,8 @@ __all__ = [
     "nms", "roi_align", "roi_pool", "psroi_pool", "box_coder",
     "deform_conv2d", "yolo_box", "prior_box", "distribute_fpn_proposals",
     "matrix_nms", "generate_proposals", "yolo_loss",
+    "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D",
+    "ConvNormActivation",
 ]
 
 
@@ -786,3 +788,104 @@ def yolo_loss(x, gt_box, gt_label, anchors, class_num, gt_score=None,
 
     args = [xm, gb, gl] + ([gs] if gs is not None else [])
     return apply(fn, *args, _name="yolo_loss")
+
+
+# ---------------------------------------------------------------------------
+# Layer-class wrappers (parity: python/paddle/vision/ops.py RoIAlign/
+# RoIPool/PSRoIPool/DeformConv2D/ConvNormActivation)
+# ---------------------------------------------------------------------------
+
+from ..nn.layer_base import Layer as _Layer  # noqa: E402
+
+
+class RoIAlign(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class DeformConv2D(_Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw], attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._deformable_groups,
+                             groups=self._groups, mask=mask)
+
+
+class ConvNormActivation(_Layer):
+    """Conv2D + Norm + Activation block (parity: python/paddle/vision/
+    ops.py ConvNormActivation — torchvision-style building block)."""
+
+    _DEFAULT = object()  # upstream defaults are BatchNorm2D/ReLU; an
+    # EXPLICIT None must disable the layer (torchvision semantics)
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=_DEFAULT,
+                 activation_layer=_DEFAULT, dilation=1, bias=None):
+        super().__init__()
+        from .. import nn as _nn
+        if norm_layer is ConvNormActivation._DEFAULT:
+            norm_layer = _nn.BatchNorm2D
+        if activation_layer is ConvNormActivation._DEFAULT:
+            activation_layer = _nn.ReLU
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [_nn.Conv2D(in_channels, out_channels, kernel_size,
+                             stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        self._block = _nn.Sequential(*layers)
+
+    def forward(self, x):
+        return self._block(x)

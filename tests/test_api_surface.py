@@ -139,6 +139,7 @@ SURFACE = {
         data nn amp save_inference_model load_inference_model cpu_places
         cuda_places xpu_places ipu_shard_guard name_scope""",
     "metric": """Accuracy Auc Precision Recall accuracy""",
+    "regularizer": """L1Decay L2Decay WeightDecayRegularizer""",
     "audio": """functional features backends load save info""",
     "geometric": """sample_neighbors reindex_graph
         segment_sum segment_mean segment_max segment_min

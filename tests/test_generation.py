@@ -487,7 +487,7 @@ class TestServeBenchTool:
     """tools/serve_bench.py must stay runnable (VERDICT r3: tools that
     never run rot); CPU smoke exercises the full measurement path."""
 
-    def test_serve_bench_smoke(self, tmp_path, monkeypatch, capsys):
+    def test_serve_bench_smoke(self, capsys):
         import importlib.util
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         spec = importlib.util.spec_from_file_location(

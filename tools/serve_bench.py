@@ -29,7 +29,7 @@ def main(argv=None):
 
     import paddle_tpu as paddle
     from paddle_tpu.inference import LLMPredictor
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import LlamaConfig
 
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,

@@ -57,6 +57,11 @@ def _stage(name, fn):
 def main() -> int:
     import importlib.util
 
+    # an unhealthy claim resolves to UNAVAILABLE only after ~25 min
+    # (observed r4); the bench's init watchdog must outlast that window
+    # or it would declare a wedge while the grant is still pending
+    os.environ.setdefault("BENCH_INIT_TIMEOUT_S", "2400")
+
     def load(path, name):
         spec = importlib.util.spec_from_file_location(name, path)
         mod = importlib.util.module_from_spec(spec)

@@ -1,30 +1,22 @@
 """Round-long TPU retry watcher.
 
-The sandbox's TPU tunnel intermittently wedges at backend init (rounds
-1-3: ``jax.devices()`` blocks forever at the claim step).  Instead of
-giving up for the round, this watcher probes the backend in a fresh
-subprocess; the moment init succeeds it runs, in order:
+Observed tunnel behavior (round 4, live sessions):
+- A healthy claim is granted in seconds-to-minutes (the round's first
+  python process got the chip at 03:16).
+- An unhealthy claim does NOT block forever: it resolves to
+  ``UNAVAILABLE: TPU backend setup/compile error`` after ~25 min.
+- jax (via the axon shim's ``_axon_get_backend_uncached``) retries a
+  FRESH claim on the next ``jax.devices()`` after a failure, so one
+  process can ride out several unavailability windows.
+- Killing a claim mid-flight (earlier probe-with-timeout design) risks
+  orphaned helpers that wedge the relay; letting the claim resolve
+  naturally is clean.
 
-  1. ``tools/tpu_validate.py``      -> output/tpu_validate_r04.log
-  2. ``tools/tpu_autotune_flash.py``-> output/tpu_autotune_r04.log
-  3. ``bench.py`` (Pallas ON)       -> output/bench_r04.json/.log
+So: no probes. Run ``tools/tpu_session.py`` (one process, one-or-more
+claims, all stages) in a loop with a generous timeout; between attempts
+sleep. A session that produced ``output/bench_r04.json`` ends the loop.
 
-Hard-won mechanics (round 4, first session with a live tunnel):
-
-- NEVER ``capture_output=True`` on a subprocess that inits the axon
-  backend: the plugin spawns helpers that inherit the pipe, so after a
-  timeout-kill the parent blocks forever draining a pipe that never
-  hits EOF.  All child output goes to FILES.
-- Kill the WHOLE process group on timeout (``start_new_session=True`` +
-  ``killpg``): a half-claimed client left alive wedges the relay for
-  every later claim.
-- The device platform under the tunnel is not necessarily ``tpu`` —
-  accept any non-cpu platform.
-- Backend init can legitimately take minutes over the tunnel; probe
-  timeout must be generous (300s), and failed claims appear to wedge
-  the relay for a while, so back off meaningfully between probes.
-
-Run it detached: ``python tools/tpu_watcher.py &``.
+Run detached: ``python tools/tpu_watcher.py &``.
 """
 from __future__ import annotations
 
@@ -40,8 +32,8 @@ OUT = os.path.join(REPO, "output")
 os.makedirs(OUT, exist_ok=True)
 STATE = os.path.join(OUT, "tpu_watcher_state.json")
 
-PROBE_TIMEOUT = 300   # seconds for jax.devices() in a subprocess
-SLEEP_BETWEEN = 240   # seconds between probes
+SESSION_TIMEOUT = 3 * 3600   # one session may ride several 25-min windows
+SLEEP_BETWEEN = 300
 
 
 def log(msg: str) -> None:
@@ -63,9 +55,8 @@ def save_state(**kw) -> None:
 
 
 def run_group(argv: list[str], logfile: str, timeout: int) -> int:
-    """Run argv in its own process group, output to `logfile`; on
-    timeout SIGKILL the whole group (axon helpers included). Returns rc,
-    or -9 on timeout-kill."""
+    """Run argv in its own process group, output appended to `logfile`;
+    on timeout SIGKILL the whole group (axon helpers included)."""
     with open(logfile, "a") as f:
         p = subprocess.Popen(argv, stdout=f, stderr=subprocess.STDOUT,
                              cwd=REPO, env={**os.environ},
@@ -75,7 +66,7 @@ def run_group(argv: list[str], logfile: str, timeout: int) -> int:
         rc = p.poll()
         if rc is not None:
             return rc
-        time.sleep(2)
+        time.sleep(5)
     try:
         os.killpg(p.pid, signal.SIGKILL)
     except Exception:
@@ -87,62 +78,25 @@ def run_group(argv: list[str], logfile: str, timeout: int) -> int:
     return -9
 
 
-def probe(attempt: int) -> bool:
-    """True iff the TPU backend initialises in a fresh subprocess."""
-    code = (
-        "import jax; ds=jax.devices(); "
-        "print('PROBE-PLATFORM', ds[0].platform, len(ds), flush=True)"
-    )
-    logfile = os.path.join(OUT, "tpu_probe.log")
-    rc = run_group([sys.executable, "-c", code], logfile, PROBE_TIMEOUT)
-    out = ""
-    try:
-        with open(logfile) as f:
-            for line in f:
-                if "PROBE-PLATFORM" in line:
-                    out = line.strip()
-    except Exception:
-        pass
-    if rc != 0:
-        log(f"probe rc={rc} (timeout-kill=-9) out={out!r}")
-        return False
-    if not out:
-        log(f"probe rc=0 but no platform line")
-        return False
-    plat = out.split()[1].lower()
-    log(f"probe OK: {out}")
-    return plat != "cpu"
-
-
 def main() -> None:
-    attempt = 0
     cycle = 0
-    save_state(started=time.time(), status="probing")
     py = sys.executable
     bench_json = os.path.join(OUT, "bench_r04.json")
+    save_state(started=time.time(), status="looping", mode="session-loop")
     while True:
-        attempt += 1
-        log(f"probe attempt {attempt}")
-        save_state(attempts=attempt, last_probe=time.time())
-        if not probe(attempt):
-            time.sleep(SLEEP_BETWEEN)
-            continue
-        save_state(status="tpu-up", tpu_up_ts=time.time())
-        # ONE claim, whole session: validate + bench + autotune in a
-        # single process (claims are the fragile step — spend them well)
         cycle += 1
         sess_log = os.path.join(OUT, f"tpu_session_r04_c{cycle}.log")
-        log(f"running tpu_session (cycle {cycle}) -> {sess_log}")
-        rc = run_group([py, "tools/tpu_session.py"], sess_log, timeout=7200)
-        log(f"tpu_session rc={rc}")
-        save_state(session_rc=rc, session_cycle=cycle,
-                   session_ts=time.time())
+        log(f"tpu_session cycle {cycle} -> {sess_log}")
+        save_state(cycle=cycle, cycle_start=time.time())
+        rc = run_group([py, "tools/tpu_session.py"], sess_log,
+                       SESSION_TIMEOUT)
+        log(f"tpu_session cycle {cycle} rc={rc}")
+        save_state(session_rc=rc, session_end=time.time())
         if rc == 0 and os.path.exists(bench_json):
             save_state(status="done", done_ts=time.time())
             log("watcher done: bench artifact present")
             return
-        log("session incomplete; resuming probe loop")
-        save_state(status="probing")
+        log(f"cycle incomplete; sleeping {SLEEP_BETWEEN}s")
         time.sleep(SLEEP_BETWEEN)
 
 

@@ -131,6 +131,17 @@ def main():
     # MFU: ~6*N flops/token (fwd+bwd) vs chip peak (v5e ≈ 197e12 bf16)
     peak = 197e12 if on_tpu else 1e12
     mfu = (6.0 * n_params * tps) / peak
+    # XLA's own cost model for the whole step (fwd+bwd+update): the
+    # defensible MFU numerator (6*N undercounts attention FLOPs and
+    # overcounts nothing XLA fused away)
+    step_flops_xla = mfu_xla = None
+    try:
+        ca = step.cost_analysis(ids, ids)
+        step_flops_xla = float(ca.get("flops", 0.0)) or None
+        if step_flops_xla:
+            mfu_xla = step_flops_xla * (iters / dt) / peak
+    except Exception as e:
+        _log(f"cost_analysis unavailable: {e!r}")
 
     # vs_baseline: ratio against the best previous round, else 1.0
     baseline = None
@@ -197,6 +208,8 @@ def main():
         "aux": {
             "params": n_params,
             "mfu_est": round(mfu, 4),
+            "mfu_xla": round(mfu_xla, 4) if mfu_xla else None,
+            "step_flops_xla": step_flops_xla,
             "final_loss": round(final_loss, 4),
             "loss_finite": bool(np.isfinite(final_loss)),
             "batch": batch, "seq": seq, "iters": iters,

@@ -226,25 +226,47 @@ class TrainStep:
         self._opt._fn_sync_to_accumulators(self._p, new_state)
         return Tensor(loss)
 
-    def memory_analysis(self, *batch):
-        """Compile for this batch signature WITHOUT executing and return
-        XLA's CompiledMemoryStats (temp_size_in_bytes = activation +
-        workspace high-water mark). Does not advance RNG or consume any
-        donated buffer."""
-        arrays, sig = self._ensure_compiled(batch)
-        cache = getattr(self, "_mem_stats", None)
+    def _aot_lower(self, sig, arrays):
+        """Lower for this signature WITHOUT executing (cached). Does not
+        advance RNG or consume donated buffers."""
+        cache = getattr(self, "_aot_cache", None)
         if cache is None:
-            cache = self._mem_stats = {}
-        if sig not in cache:  # a second AOT compile is minutes on TPU
+            cache = self._aot_cache = {}
+        if sig not in cache:
             from ..amp.grad_scaler import scaler_state_in
             sc_in = (scaler_state_in(self._scaler)
                      if self._scaler is not None else ())
-            lowered = self._compiled[sig].lower(
+            cache[sig] = self._compiled[sig].lower(
                 [p._value for p in self._p], [b._value for b in self._b],
                 self._opt_state, jax.random.key(0),
                 jnp.asarray(0.0, jnp.float32), arrays, sc_in)
-            cache[sig] = lowered.compile().memory_analysis()
         return cache[sig]
+
+    def memory_analysis(self, *batch):
+        """XLA's CompiledMemoryStats for this batch signature
+        (temp_size_in_bytes = activation + workspace high-water mark).
+        Needs a backend compile (cached via the persistent XLA cache,
+        but still a second executable — minutes cold on TPU)."""
+        arrays, sig = self._ensure_compiled(batch)
+        cache = getattr(self, "_mem_cache", None)
+        if cache is None:
+            cache = self._mem_cache = {}
+        if sig not in cache:
+            cache[sig] = self._aot_lower(sig, arrays).compile() \
+                             .memory_analysis()
+        return cache[sig]
+
+    def cost_analysis(self, *batch):
+        """XLA's cost model for the whole train step (fwd+bwd+update);
+        ``cost_analysis()["flops"]`` is the per-step FLOP count — the
+        defensible numerator for MFU (vs the 6*N*tokens estimate).
+        Reads the LOWERED module's cost model (no backend compile)."""
+        arrays, sig = self._ensure_compiled(batch)
+        ca = self._aot_lower(sig, arrays).cost_analysis()
+        # older jax / some backends return a per-device list
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return ca
 
     @property
     def opt_state(self):

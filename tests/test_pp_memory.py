@@ -122,3 +122,26 @@ def test_pipeline_table():
     # M*V-tick scan carry) — the interleave trades memory for bubble,
     # opposite of remat; the table records the real ratio.
     assert 0 < by[(4, True, 2)] <= 8 * by[(4, True, 1)]
+
+
+class TestCostAnalysis:
+    """TrainStep.cost_analysis: XLA's cost model feeds the bench's
+    mfu_xla (fwd+bwd+update FLOPs, not the 6*N estimate)."""
+
+    def test_trainstep_flops_positive_and_scales(self):
+        from paddle_tpu import nn
+        from paddle_tpu.jit.bridge import TrainStep
+
+        def flops_at(batch):
+            paddle.seed(0)
+            net = nn.Linear(32, 32, bias_attr=False)
+            opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+            step = TrainStep(net, opt, lambda p, t: ((p - t) ** 2).mean())
+            x = paddle.to_tensor(np.zeros((batch, 32), np.float32))
+            ca = step.cost_analysis(x, x)
+            return float(ca["flops"])
+
+        f8, f32 = flops_at(8), flops_at(32)
+        assert f8 > 0
+        # matmul-dominated step: 4x batch => roughly 4x flops
+        assert 2.5 < f32 / f8 < 6, (f8, f32)

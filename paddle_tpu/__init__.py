@@ -114,6 +114,7 @@ from .distributed.parallel import DataParallel
 
 from . import fft
 from . import signal
+from . import multiprocessing
 from . import sparse
 from . import distribution
 from . import audio
@@ -133,8 +134,7 @@ def get_default_place():
     return _default_place()
 
 
-def is_compiled_with_rocm():
-    return False
+from .framework.place import is_compiled_with_rocm  # noqa: E402
 
 
 def is_compiled_with_custom_device(device_type=None):

@@ -8,7 +8,7 @@ from ..framework.place import (  # noqa: F401
 )
 from ..framework.place import (
     set_device, get_device, CPUPlace, TPUPlace, XLAPlace, CUDAPlace,
-    is_compiled_with_cuda, is_compiled_with_tpu,
+    is_compiled_with_cuda, is_compiled_with_tpu, is_compiled_with_rocm,
 )
 
 
@@ -140,6 +140,20 @@ tpu = cuda
 xpu = cuda
 
 
+def _attach_stream_api():
+    """paddle.device.cuda.Stream/Event/current_stream/... mirror the
+    device-level stream facades (upstream python/paddle/device/cuda/
+    __init__.py exports them from the cuda namespace too). Deferred:
+    Stream/Event are defined later in this module."""
+    cuda.Stream = staticmethod(Stream)
+    cuda.Event = staticmethod(Event)
+    cuda.current_stream = staticmethod(current_stream)
+    cuda.stream_guard = staticmethod(stream_guard)
+    cuda.get_device_properties = staticmethod(get_device_properties)
+    cuda.get_device_name = staticmethod(get_device_name)
+    cuda.get_device_capability = staticmethod(get_device_capability)
+
+
 def synchronize(device=None):
     cuda.synchronize(device)
 
@@ -228,3 +242,41 @@ class stream_guard:
     def __exit__(self, *exc):
         set_stream(self._prev)
         return False
+
+
+class _DeviceProperties:
+    """Parity shape of paddle.device.cuda.get_device_properties output."""
+
+    def __init__(self, name, total_memory, multi_processor_count=1,
+                 major=0, minor=0):
+        self.name = name
+        self.total_memory = total_memory
+        self.multi_processor_count = multi_processor_count
+        self.major = major
+        self.minor = minor
+
+    def __repr__(self):
+        return (f"_DeviceProperties(name='{self.name}', "
+                f"total_memory={self.total_memory})")
+
+
+def get_device_properties(device=None):
+    d = jax.devices()[0]
+    try:
+        total = (d.memory_stats() or {}).get("bytes_limit", 0)
+    except Exception:
+        total = 0
+    return _DeviceProperties(str(d), total)
+
+
+def get_device_name(device=None):
+    return str(jax.devices()[0])
+
+
+def get_device_capability(device=None):
+    """No CUDA compute capability on TPU; (0, 0) keeps ported
+    `major >= N` feature gates conservative."""
+    return (0, 0)
+
+
+_attach_stream_api()

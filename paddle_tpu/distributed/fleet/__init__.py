@@ -12,6 +12,7 @@ from .base.topology import HybridCommunicateGroup, CommunicateTopology
 from .fleet_api import (
     init, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
     worker_index, worker_num, is_first_worker, barrier_worker,
+    is_worker, init_worker,
     DistributedModel, DistributedOptimizer,
 )
 from .dist_step import DistTrainStep

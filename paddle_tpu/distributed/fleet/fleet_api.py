@@ -173,5 +173,18 @@ def barrier_worker():
     barrier()
 
 
+def is_worker():
+    """Collective mode has no parameter-server roles: every rank is a
+    worker (upstream returns role==WORKER; ps mode is not built — TPU
+    training is all-collective per SURVEY §2.3)."""
+    return True
+
+
+def init_worker(scopes=None):
+    """Parameter-server worker init is a no-op in collective mode (the
+    upstream call prepares PS communicators; XLA collectives need none)."""
+    return None
+
+
 def stop_worker():
     pass

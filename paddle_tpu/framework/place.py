@@ -131,6 +131,10 @@ def is_compiled_with_tpu() -> bool:
     return _accelerator_available()
 
 
+def is_compiled_with_rocm() -> bool:  # parity stub
+    return False
+
+
 class CUDAPinnedPlace(Place):
     """Parity shim: pinned host memory is an explicit-staging CUDA
     concept; on TPU host arrays are staged by the runtime. Behaves as

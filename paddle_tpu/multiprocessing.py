@@ -1,0 +1,74 @@
+"""paddle.multiprocessing parity (python/paddle/incubate/multiprocessing
+and torch-style module surface).
+
+Upstream's role: spawn workers that exchange tensors through shared
+memory (CUDA IPC handles on GPU). The TPU-native equivalent: device
+arrays cannot be shared across processes (each process claims its own
+runtime), so tensors cross process boundaries as host numpy buffers —
+the same strategy the reference uses for CPU tensors (file_system
+sharing). The DataLoader's native shm worker pool (csrc/shm_channel.cc)
+is the high-bandwidth path; this module covers ad-hoc user spawning.
+"""
+from __future__ import annotations
+
+import multiprocessing as _mp
+from multiprocessing import *  # noqa: F401,F403 — stdlib surface
+
+from multiprocessing.reduction import ForkingPickler as _ForkingPickler
+
+_SHARING_STRATEGY = "file_system"
+
+
+def get_all_sharing_strategies():
+    return ("file_system",)
+
+
+def get_sharing_strategy():
+    return _SHARING_STRATEGY
+
+
+def set_sharing_strategy(strategy):
+    if strategy not in get_all_sharing_strategies():
+        raise ValueError(
+            f"unsupported sharing strategy {strategy!r}; TPU processes "
+            "cannot share device memory — use 'file_system' (host numpy "
+            "buffers) or keep data loading in the DataLoader's native "
+            "shm workers")
+    # single supported strategy; nothing to switch
+
+
+def _rebuild_tensor(cls_name, arr, stop_gradient, name, persistable):
+    from .tensor import Tensor, Parameter
+    import jax.numpy as jnp
+    if cls_name == "Parameter":
+        t = Parameter(jnp.asarray(arr), trainable=not stop_gradient,
+                      name=name)
+    else:
+        t = Tensor(jnp.asarray(arr), stop_gradient=stop_gradient, name=name)
+    t.persistable = persistable
+    return t
+
+
+def _reduce_tensor(t):
+    """Ship a Tensor across a process boundary as its host numpy value
+    (device buffers are not shareable across runtime processes),
+    preserving subclass and metadata. Registered ONLY on the
+    multiprocessing ForkingPickler — plain pickle keeps the default
+    (device-aware) reduction."""
+    return _rebuild_tensor, (type(t).__name__, t.numpy(),
+                             bool(t.stop_gradient),
+                             getattr(t, "name", None),
+                             bool(getattr(t, "persistable", False)))
+
+
+def _register_reductions():
+    from .tensor import Tensor, Parameter
+    _ForkingPickler.register(Tensor, _reduce_tensor)
+    _ForkingPickler.register(Parameter, _reduce_tensor)
+
+
+_register_reductions()
+
+
+def get_context(method=None):
+    return _mp.get_context(method)

@@ -140,6 +140,18 @@ SURFACE = {
         cuda_places xpu_places ipu_shard_guard name_scope""",
     "metric": """Accuracy Auc Precision Recall accuracy""",
     "regularizer": """L1Decay L2Decay WeightDecayRegularizer""",
+    "multiprocessing": """get_context Process Queue Pipe
+        get_sharing_strategy set_sharing_strategy
+        get_all_sharing_strategies""",
+    "device.cuda": """Stream Event current_stream synchronize
+        device_count memory_allocated max_memory_allocated
+        memory_reserved max_memory_reserved stream_guard
+        get_device_properties get_device_name get_device_capability
+        empty_cache memory_stats""",
+    "distributed.fleet": """init is_first_worker worker_index worker_num
+        is_worker barrier_worker init_worker distributed_model
+        distributed_optimizer DistributedStrategy utils meta_parallel
+        DistTrainStep""",
     "audio": """functional features backends load save info""",
     "geometric": """sample_neighbors reindex_graph
         segment_sum segment_mean segment_max segment_min

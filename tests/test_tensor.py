@@ -188,3 +188,35 @@ class TestStorageIntrospection:
         np.testing.assert_allclose(t.numpy(), [5.0, 6.0])
         t.data = np.array([7.0, 8.0], np.float32)
         np.testing.assert_allclose(t.numpy(), [7.0, 8.0])
+
+
+class TestMultiprocessingModule:
+    """paddle.multiprocessing (parity: incubate/multiprocessing): tensor
+    reductions are scoped to the mp ForkingPickler; plain pickle keeps
+    the default device-aware reduction (review r4 regression guard)."""
+
+    def test_forking_pickler_preserves_subclass_and_flags(self):
+        import io
+        import pickle
+        from multiprocessing.reduction import ForkingPickler
+        import jax.numpy as jnp
+        from paddle_tpu.tensor import Parameter
+
+        p = Parameter(jnp.ones((2, 2)), trainable=True, name="w0")
+        buf = io.BytesIO()
+        ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(p)
+        p2 = pickle.loads(buf.getvalue())
+        assert isinstance(p2, Parameter) and p2.trainable
+        assert p2.name == "w0" and p2.persistable
+        np.testing.assert_array_equal(p2.numpy(), p.numpy())
+        # plain pickle still round-trips a Parameter as a Parameter
+        p3 = pickle.loads(pickle.dumps(p))
+        assert isinstance(p3, Parameter) and not p3.stop_gradient
+
+    def test_sharing_strategy_api(self):
+        import paddle_tpu.multiprocessing as pmp
+        assert pmp.get_sharing_strategy() == "file_system"
+        pmp.set_sharing_strategy("file_system")
+        with pytest.raises(ValueError):
+            pmp.set_sharing_strategy("cuda_ipc")
+        assert pmp.get_context("spawn") is not None

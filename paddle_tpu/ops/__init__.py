@@ -116,6 +116,52 @@ Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
 Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
 Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
 Tensor.__hash__ = lambda s: id(s)
+Tensor.__lshift__ = lambda s, o: logic.bitwise_left_shift(s, o)
+Tensor.__rlshift__ = lambda s, o: logic.bitwise_left_shift(o, s)
+Tensor.__rshift__ = lambda s, o: logic.bitwise_right_shift(s, o)
+Tensor.__rrshift__ = lambda s, o: logic.bitwise_right_shift(o, s)
+
+
+def _tensor_divmod(s, o):
+    return apply(jnp.divmod, _coerce(s), _coerce(o), _name="divmod")
+
+
+Tensor.__divmod__ = _tensor_divmod
+Tensor.__rdivmod__ = lambda s, o: _tensor_divmod(o, s)
+
+
+def _tensor_iter(self):
+    # without __iter__, python's fallback loops __getitem__(0, 1, ...)
+    # forever (jax indexing clamps out-of-range instead of raising);
+    # the ndim check must run EAGERLY, not inside the generator
+    if self.ndim == 0:
+        raise TypeError("iteration over a 0-D tensor")
+
+    def gen():
+        for i in range(self._value.shape[0]):
+            yield self[i]
+
+    return gen()
+
+
+def _tensor_contains(self, item):
+    return bool(jnp.any(self._value == _coerce(item)._value))
+
+
+Tensor.__iter__ = _tensor_iter
+Tensor.__contains__ = _tensor_contains
+
+
+def _tensor_dlpack(self, *a, **kw):
+    return self._value.__dlpack__(*a, **kw)
+
+
+def _tensor_dlpack_device(self):
+    return self._value.__dlpack_device__()
+
+
+Tensor.__dlpack__ = _tensor_dlpack
+Tensor.__dlpack_device__ = _tensor_dlpack_device
 
 # ---------------------------------------------------------------------------
 # method attachment

@@ -220,3 +220,28 @@ class TestMultiprocessingModule:
         with pytest.raises(ValueError):
             pmp.set_sharing_strategy("cuda_ipc")
         assert pmp.get_context("spawn") is not None
+
+
+class TestIterationAndDunderTail:
+    """r4: `for row in tensor` must terminate (python's __getitem__
+    fallback looped forever because jax indexing clamps instead of
+    raising IndexError); plus shift/divmod/contains/dlpack dunders."""
+
+    def test_iteration_terminates_and_yields_rows(self):
+        t = paddle.to_tensor(np.arange(6, dtype="f").reshape(2, 3))
+        rows = list(t)
+        assert len(rows) == 2 and rows[0].shape == [3]
+        with pytest.raises(TypeError):
+            iter(paddle.to_tensor(np.float32(1.0)))
+
+    def test_contains_shift_divmod_dlpack(self):
+        t = paddle.to_tensor(np.arange(6, dtype="f").reshape(2, 3))
+        assert 5.0 in t and not (99.0 in t)
+        i = paddle.to_tensor(np.array([4], np.int32))
+        one = paddle.to_tensor(np.array([1], np.int32))
+        assert int(i << one) == 8 and int(i >> one) == 2
+        q, r = divmod(paddle.to_tensor([7.0]), paddle.to_tensor([2.0]))
+        assert float(q) == 3.0 and float(r) == 1.0
+        import jax.numpy as jnp
+        assert jnp.from_dlpack(
+            paddle.to_tensor(np.ones((2, 2), "f"))).shape == (2, 2)

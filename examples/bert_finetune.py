@@ -12,6 +12,10 @@ The example uses synthetic data (this sandbox has no downloads); swap
 `synthetic_batches` for a tokenized dataset + paddle.io.DataLoader in
 real runs.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 
 
@@ -34,7 +38,6 @@ def main(argv=None):
 
     if args.smoke:
         # dev-box mode: force the CPU backend before it initializes
-        import os
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (

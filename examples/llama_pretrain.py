@@ -12,8 +12,11 @@ Elastic restart: the Trainer auto-resumes from output_dir/checkpoints; on
 SIGTERM (TPU preemption / launcher restart) it checkpoints and exits so
 the relaunch continues from the same step.
 """
-import argparse
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import argparse
 
 import numpy as np
 
@@ -36,7 +39,6 @@ def main(argv=None):
     if args.smoke:
         # dev-box mode: force the CPU backend (with virtual devices for
         # --dp/--mp) BEFORE the backend initializes — never claims a TPU
-        import os
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (

@@ -16,8 +16,12 @@ Steps:
 """
 from __future__ import annotations
 
-import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
 import tempfile
 
 import numpy as np

@@ -25,6 +25,7 @@ __all__ = [
     "Exponential", "Laplace", "LogNormal", "Gumbel", "Geometric",
     "Poisson", "kl_divergence", "register_kl",
     "ExponentialFamily", "Beta", "Binomial", "Cauchy", "ContinuousBernoulli", "Chi2", "Dirichlet", "Gamma", "Multinomial", "MultivariateNormal", "StudentT", "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform", "TanhTransform", "PowerTransform", "AbsTransform", "ChainTransform", "IndependentTransform", "ReshapeTransform", "SoftmaxTransform", "StackTransform", "StickBreakingTransform", "TransformedDistribution",
+    "LKJCholesky", "Independent",
 ]
 
 

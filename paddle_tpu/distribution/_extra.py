@@ -762,3 +762,60 @@ class LKJCholesky(Distribution):
                               + logB, axis=-1)
             return unnorm - lognorm
         return apply(fn, _coerce(value), self.concentration)
+
+
+def _sum_rightmost(v, k):
+    return jnp.sum(v, axis=tuple(range(v.ndim - k, v.ndim)))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_rank` batch dims of
+    a base distribution as event dims (parity:
+    python/paddle/distribution/independent.py): log_prob sums over the
+    reinterpreted dims, sample passes through."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Distribution):
+            raise TypeError(
+                f"base must be a Distribution, got {type(base).__name__}")
+        k = int(reinterpreted_batch_rank)
+        if k < 1 or k > len(base.batch_shape):
+            raise ValueError(
+                "reinterpreted_batch_rank must be in [1, "
+                f"len(base.batch_shape)={len(base.batch_shape)}], got {k}")
+        self.base = base
+        self._reinterpreted_batch_rank = k
+        bs = tuple(base.batch_shape)
+        super().__init__(bs[:len(bs) - k],
+                         bs[len(bs) - k:] + tuple(base.event_shape))
+
+    @property
+    def reinterpreted_batch_rank(self):
+        return self._reinterpreted_batch_rank
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        return apply(lambda v: _sum_rightmost(
+            v, self._reinterpreted_batch_rank),
+            self.base.log_prob(value), _name="independent_log_prob")
+
+    def entropy(self):
+        return apply(lambda v: _sum_rightmost(
+            v, self._reinterpreted_batch_rank),
+            self.base.entropy(), _name="independent_entropy")
+
+
+__all__.append("Independent")

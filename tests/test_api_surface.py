@@ -93,7 +93,7 @@ SURFACE = {
         MultivariateNormal TransformedDistribution kl_divergence
         register_kl AffineTransform ExpTransform SigmoidTransform
         TanhTransform PowerTransform ChainTransform ReshapeTransform
-        StickBreakingTransform""",
+        StickBreakingTransform Independent""",
     "distributed": """init_parallel_env get_rank get_world_size
         all_reduce all_gather all_gather_object reduce_scatter broadcast
         reduce scatter gather alltoall alltoall_single send recv isend

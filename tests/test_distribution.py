@@ -304,3 +304,28 @@ class TestSecondTierKL:
         same = D.MultivariateNormal(t(np.zeros(2, "float32")),
                                     covariance_matrix=t(c1))
         assert abs(float(D.kl_divergence(p, same).numpy())) < 1e-5
+
+
+class TestIndependent:
+    """paddle.distribution.Independent (torch-golden verified)."""
+
+    def test_log_prob_entropy_match_torch(self):
+        import torch
+        import torch.distributions as td
+        from paddle_tpu.distribution import Independent, Normal
+
+        loc = np.random.RandomState(0).randn(3, 4).astype("f")
+        sc = np.abs(np.random.RandomState(1).randn(3, 4).astype("f")) + 0.5
+        d = Independent(Normal(paddle.to_tensor(loc), paddle.to_tensor(sc)), 1)
+        ref = td.Independent(td.Normal(torch.tensor(loc), torch.tensor(sc)), 1)
+        assert d.batch_shape == [3] and d.event_shape == [4]
+        v = np.random.RandomState(2).randn(3, 4).astype("f")
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(v)).numpy(),
+            ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(d.entropy().numpy(),
+                                   ref.entropy().numpy(), rtol=1e-5)
+        assert d.sample().shape == [3, 4]
+        with pytest.raises(ValueError):
+            Independent(Normal(paddle.to_tensor(loc),
+                               paddle.to_tensor(sc)), 3)

@@ -507,7 +507,7 @@ class IndependentTransform(Transform):
     def forward_log_det_jacobian(self, x):
         j = self.base.forward_log_det_jacobian(x)
         return apply(
-            lambda v: jnp.sum(v, axis=tuple(range(-self.rank, 0))),
+            lambda v: _sum_rightmost(v, self.rank),
             _coerce(j))
 
 

@@ -81,7 +81,11 @@ def run_group(argv: list[str], logfile: str, timeout: int) -> int:
 def main() -> None:
     cycle = 0
     py = sys.executable
-    bench_json = os.path.join(OUT, "bench_r04.json")
+    import re as _re
+    rnd = 1 + max((int(m.group(1)) for name in os.listdir(REPO)
+                   if (m := _re.fullmatch(r"BENCH_r(\d+)\.json", name))),
+                  default=0)
+    bench_json = os.path.join(OUT, f"bench_r{rnd:02d}.json")
     save_state(started=time.time(), status="looping", mode="session-loop")
     while True:
         cycle += 1

@@ -224,8 +224,20 @@ def main():
             # real HBM high-water mark (VERDICT r3: PP/remat memory
             # behavior must be measured; this is the chip-level number)
             "peak_hbm_bytes": peak_hbm,
+            # fingerprint for the replay path: a replay is only valid if
+            # the measuring code is the code being scored
+            "bench_code_sha": _bench_code_sha(),
         },
     }))
+
+
+def _bench_code_sha():
+    import hashlib
+    try:
+        with open(os.path.abspath(__file__), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+    except Exception:
+        return None
 
 
 def _current_round():
@@ -320,6 +332,12 @@ def _orchestrate():
                 rec = json.loads(open(path).read())
                 if not isinstance(rec, dict) or "value" not in rec:
                     raise ValueError("not a bench record")
+                rec_sha = (rec.get("aux") or {}).get("bench_code_sha")
+                if rec_sha != _bench_code_sha():
+                    raise ValueError(
+                        f"bench code changed since measurement "
+                        f"(recorded {rec_sha}, current "
+                        f"{_bench_code_sha()}): replay refused")
                 rec.setdefault("aux", {})["replayed"] = {
                     "from": prev,
                     "reason": "tunnel claim unavailable now; value was "

@@ -38,6 +38,20 @@ def segment_mean(data, segment_ids, name=None):
     return apply(fn, _coerce(data), _coerce(segment_ids))
 
 
+def segment_max(data, segment_ids, name=None):
+    """Parity: python/paddle/incubate/tensor/math.py segment_max —
+    alias of the geometric implementation (empty segments fill 0,
+    matching upstream)."""
+    from ..geometric import segment_max as _impl
+    return _impl(data, segment_ids, name)
+
+
+def segment_min(data, segment_ids, name=None):
+    """Parity: python/paddle/incubate/tensor/math.py segment_min."""
+    from ..geometric import segment_min as _impl
+    return _impl(data, segment_ids, name)
+
+
 def graph_send_recv(x, src_index, dst_index, pool_type="sum",
                     out_size=None, name=None):
     """Legacy alias of paddle.geometric.send_u_recv (parity:

@@ -478,3 +478,52 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
     return flash_attention_bshd(query, key, value, attn_mask=attn_bias,
                                 dropout_p=p, training=training,
                                 scale=scale)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Parity: python/paddle/incubate/nn/functional/fused_matmul_bias.py
+    — one fused GEMM+bias (cublasLt epilogue upstream; XLA fuses the add
+    into the matmul on TPU natively)."""
+    import jax.numpy as jnp
+    from ...ops._dispatch import apply as _apply
+    from ...ops.creation import _coerce
+    args = [_coerce(x), _coerce(y)] + ([_coerce(bias)]
+                                       if bias is not None else [])
+
+    def fn(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    return _apply(fn, *args, _name="fused_matmul_bias")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Parity: python/paddle/incubate/nn/functional/fused_ec_moe.py —
+    the functional leg of FusedEcMoe. Contract matches the layer:
+    x [B,S,D], gate = gate LOGITS [B,S,E] (softmaxed here), biases
+    [E,1,*]; act_type in {gelu, relu}."""
+    from ...ops._dispatch import apply as _apply
+    from ...ops.creation import _coerce
+    import jax
+    import jax.numpy as jnp
+
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"unsupported act_type {act_type!r}")
+    act = jax.nn.gelu if act_type == "gelu" else jax.nn.relu
+    args = [_coerce(x), _coerce(gate), _coerce(bmm0_weight),
+            _coerce(bmm0_bias), _coerce(bmm1_weight), _coerce(bmm1_bias)]
+
+    def fn(xv, gv, w0, b0, w1, b1):
+        probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)
+        h = jnp.einsum("bsd,edi->bsei", xv, w0) + b0[:, 0]
+        h = act(h)
+        y = jnp.einsum("bsei,eid->bsed", h, w1) + b1[:, 0]
+        return jnp.einsum("bsed,bse->bsd", y, probs.astype(y.dtype))
+    return _apply(fn, *args, _name="fused_ec_moe")

@@ -326,3 +326,21 @@ class TestFusedEcMoeAndGraphAliases:
         assert reindex.numpy().tolist() == [0]
         assert len(es.numpy()) == len(ed.numpy())
         assert set(final.numpy().tolist()) == {0, 1, 2}
+
+
+class TestSegmentMaxMin:
+    """incubate.segment_max/min (parity: incubate/tensor/math.py) with
+    gradient flow through the XLA scatter."""
+
+    def test_values_and_grads(self):
+        d = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 0.]], "f"))
+        s = paddle.to_tensor(np.array([0, 0, 1], "i"))
+        np.testing.assert_allclose(
+            paddle.incubate.segment_max(d, s).numpy(), [[3, 4], [5, 0]])
+        np.testing.assert_allclose(
+            paddle.incubate.segment_min(d, s).numpy(), [[1, 2], [5, 0]])
+        d2 = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 0.]], "f"))
+        d2.stop_gradient = False
+        paddle.incubate.segment_max(d2, s).sum().backward()
+        np.testing.assert_allclose(d2.grad.numpy(),
+                                   [[0, 0], [1, 1], [1, 1]])

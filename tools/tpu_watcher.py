@@ -81,15 +81,18 @@ def run_group(argv: list[str], logfile: str, timeout: int) -> int:
 def main() -> None:
     cycle = 0
     py = sys.executable
-    import re as _re
-    rnd = 1 + max((int(m.group(1)) for name in os.listdir(REPO)
-                   if (m := _re.fullmatch(r"BENCH_r(\d+)\.json", name))),
-                  default=0)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    rnd = bench_mod._current_round()
     bench_json = os.path.join(OUT, f"bench_r{rnd:02d}.json")
     save_state(started=time.time(), status="looping", mode="session-loop")
     while True:
         cycle += 1
-        sess_log = os.path.join(OUT, f"tpu_session_r04_c{cycle}.log")
+        sess_log = os.path.join(OUT,
+                        f"tpu_session_r{rnd:02d}_c{cycle}.log")
         log(f"tpu_session cycle {cycle} -> {sess_log}")
         save_state(cycle=cycle, cycle_start=time.time())
         rc = run_group([py, "tools/tpu_session.py"], sess_log,

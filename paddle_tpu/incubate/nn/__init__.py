@@ -228,21 +228,8 @@ class FusedEcMoe(Layer):
             [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
 
     def forward(self, x, gate):
-        from ...ops._dispatch import apply as _apply
-        from ...ops.creation import _coerce as _c
-        import jax
-        import jax.numpy as jnp
-        act = (jax.nn.gelu if self._act == "gelu" else jax.nn.relu)
-
-        def fn(xv, gv, w0, b0, w1, b1):
-            probs = jax.nn.softmax(gv.astype(jnp.float32), axis=-1)
-            # dense dispatch: every token -> every expert, combined by
-            # its gate prob (expert-choice capacity == all tokens); the
-            # MXU-friendly formulation of the reference's fused kernel
-            h = jnp.einsum("bsd,edi->bsei", xv, w0) + b0[:, 0]
-            h = act(h)
-            y = jnp.einsum("bsei,eid->bsed", h, w1) + b1[:, 0]
-            return jnp.einsum("bsed,bse->bsd", y,
-                              probs.astype(y.dtype))
-        return _apply(fn, _c(x), _c(gate), self.bmm_weight0, self.bmm_bias0,
-                      self.bmm_weight1, self.bmm_bias1, _name="fused_ec_moe")
+        # single implementation of the kernel: the functional op
+        from .functional import fused_ec_moe
+        return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
+                            self.bmm_weight1, self.bmm_bias1,
+                            act_type=self._act)

@@ -516,7 +516,10 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
 
     if act_type not in ("gelu", "relu"):
         raise ValueError(f"unsupported act_type {act_type!r}")
-    act = jax.nn.gelu if act_type == "gelu" else jax.nn.relu
+    # exact gelu (approximate=False): matches this repo's F.gelu default
+    # and paddle's gelu convention
+    act = ((lambda v: jax.nn.gelu(v, approximate=False))
+           if act_type == "gelu" else jax.nn.relu)
     args = [_coerce(x), _coerce(gate), _coerce(bmm0_weight),
             _coerce(bmm0_bias), _coerce(bmm1_weight), _coerce(bmm1_bias)]
 

@@ -84,12 +84,23 @@ def mode(x, axis=-1, keepdim=False, name=None):
         ax = int(axis) % v.ndim
         srt = jnp.sort(v, axis=ax)
         n = v.shape[ax]
-        # count runs in sorted order; mode = value with max run length
-        eq = jnp.concatenate([jnp.ones_like(jnp.take(srt, [0], axis=ax), dtype=bool),
-                              jnp.take(srt, jnp.arange(1, n), axis=ax) ==
-                              jnp.take(srt, jnp.arange(n - 1), axis=ax)], axis=ax)
-        run = jax.lax.associative_scan(
-            lambda a, b: b * (a + 1), eq.astype(jnp.int32), axis=ax)
+        # run lengths in sorted order (mode = value with max run length):
+        # start-of-run flags -> running max of start positions gives each
+        # element its run start; length = pos - start + 1. (The previous
+        # `associative_scan(b*(a+1))` combine was NOT associative and
+        # produced wrong run lengths for some inputs — r4 fuzz find.)
+        is_start = jnp.concatenate(
+            [jnp.ones_like(jnp.take(srt, jnp.arange(1), axis=ax),
+                           dtype=bool),
+             jnp.take(srt, jnp.arange(1, n), axis=ax) !=
+             jnp.take(srt, jnp.arange(n - 1), axis=ax)], axis=ax)
+        shape = [1] * v.ndim
+        shape[ax] = n
+        pos = jnp.arange(n, dtype=jnp.int32).reshape(shape)
+        start = jax.lax.cummax(jnp.where(is_start, pos, 0), axis=ax)
+        run = pos - start + 1
+        # argmax picks the FIRST maximal run -> smallest modal value on
+        # ties (matching torch/paddle tie behavior on sorted data)
         best = jnp.argmax(run, axis=ax, keepdims=True)
         vals = jnp.take_along_axis(srt, best, axis=ax)
         # paddle returns the index of (one) occurrence in the original array

@@ -724,3 +724,25 @@ class TestSurfaceTailR4:
         paddle.index_add_(y, paddle.to_tensor(np.array([0, 2])), 0,
                           paddle.to_tensor(np.ones((2, 2), "float32")))
         np.testing.assert_allclose(y.numpy(), [[1, 1], [0, 0], [1, 1]])
+
+
+class TestModeParity:
+    """paddle.mode vs torch over randomized trials (r4 fuzz found the
+    old run-length scan produced wrong modes: non-associative combine)."""
+
+    def test_mode_matches_torch_fuzz(self):
+        import torch
+        rs = np.random.RandomState(0)
+        for _ in range(50):
+            a = rs.randint(0, 4, (5, 7))
+            v, i = paddle.mode(paddle.to_tensor(a), axis=1)
+            tv = torch.mode(torch.tensor(a), dim=1).values.numpy()
+            np.testing.assert_array_equal(v.numpy(), tv, err_msg=str(a))
+            for r in range(5):
+                assert a[r, int(i.numpy()[r])] == v.numpy()[r]
+
+    def test_mode_regression_case(self):
+        # the exact row the old scan got wrong: mode([2,3,0,2,0,0,0])=0
+        v, _ = paddle.mode(paddle.to_tensor(
+            np.array([[2, 3, 0, 2, 0, 0, 0]])), axis=1)
+        assert int(v.numpy()[0]) == 0

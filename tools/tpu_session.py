@@ -103,15 +103,20 @@ def main() -> int:
 
             def keep_best(dest):
                 """Write `line` to dest unless dest already records a
-                better value. Per-destination: output/ must ALWAYS get
-                seeded (the watcher's stop condition checks it) even if
-                a committed artifacts/ copy from an earlier container
-                holds a higher number."""
+                better value FROM THE SAME BENCH CODE. A higher number
+                from older bench code must not shadow a fresh
+                measurement: bench.py's replay validator refuses
+                mismatched-sha records, so keeping one would leave the
+                round with no replayable result."""
+                new_sha = (new.get("aux") or {}).get("bench_code_sha")
                 try:
                     prior = json.loads(open(dest).read())
-                    if float(prior["value"]) > float(new["value"]):
+                    prior_sha = (prior.get("aux") or {}).get(
+                        "bench_code_sha")
+                    if (prior_sha == new_sha
+                            and float(prior["value"]) > float(new["value"])):
                         _log(f"{dest}: prior {prior['value']:.0f} beats "
-                             f"{new['value']:.0f}; kept")
+                             f"{new['value']:.0f} (same code); kept")
                         return
                 except Exception:
                     pass

@@ -818,3 +818,23 @@ class TestDetectionOpsRound3:
             task.wait()
             assert task.is_completed()
         np.testing.assert_allclose(buf.numpy(), x.numpy())
+
+
+class TestBicubicParity:
+    """bicubic interpolate uses the a=-0.75 Keys kernel (torch/paddle);
+    jax.image's cubic (a=-0.5) diverged ~1e-1 — r4 fuzz find."""
+
+    def test_bicubic_matches_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 3, 8, 8).astype("f")
+        for ac in (False, True):
+            for size in ((5, 5), (13, 11), (3, 9), (1, 1)):
+                p = F.interpolate(paddle.to_tensor(x), size=list(size),
+                                  mode="bicubic", align_corners=ac)
+                t = TF.interpolate(torch.tensor(x), size=size,
+                                   mode="bicubic", align_corners=ac)
+                np.testing.assert_allclose(p.numpy(), t.numpy(),
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=f"{ac} {size}")

@@ -957,8 +957,17 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
     def fn(logp, lab, *w):
         valid = lab != ignore_index
         safe = jnp.where(valid, lab, 0)
-        picked = -jnp.take_along_axis(logp, safe[..., None] if logp.ndim == lab.ndim + 1 else safe, axis=1 if logp.ndim > 1 else 0)
-        picked = jnp.squeeze(picked, axis=1) if picked.ndim > lab.ndim else picked
+        if logp.ndim == lab.ndim + 1:
+            # class dim is axis 1 for any rank — (N,C), (N,C,d1,d2,...);
+            # the index must be expanded THERE, not at the last axis
+            # (spatial nll was picking along W — r4 fuzz find)
+            cls_axis = 1 if logp.ndim > 1 else 0
+            picked = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, cls_axis), axis=cls_axis)
+            picked = jnp.squeeze(picked, axis=cls_axis)
+        else:
+            picked = -jnp.take_along_axis(logp, safe,
+                                          axis=1 if logp.ndim > 1 else 0)
         if has_w:
             picked = picked * jnp.take(w[0], safe)
         return jnp.where(valid, picked, 0.0)
@@ -969,6 +978,13 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
             safe = jnp.where(valid, lab, 0)
             return jnp.sum(l) / jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0))
         return apply(den_fn, loss, args[1], args[2])
+    if reduction == "mean":
+        # mean over NON-IGNORED entries (torch/paddle denominator), not
+        # the total element count (review r4 find)
+        def mean_fn(l, lab):
+            valid = lab != ignore_index
+            return jnp.sum(l) / jnp.maximum(jnp.sum(valid), 1)
+        return apply(mean_fn, loss, args[1])
     return _reduce_loss(loss, reduction)
 
 
@@ -1273,7 +1289,10 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         taps = taps.reshape(new_shape)
         wshape = [1] * len(new_shape)
         wshape[axis], wshape[axis + 1] = out_len, 4
-        return jnp.sum(taps * w.reshape(wshape), axis=axis + 1)
+        # weights are f32; keep the input dtype (bf16 pipelines must not
+        # silently upcast — every other interpolate mode preserves dtype)
+        return jnp.sum(taps.astype(jnp.float32) * w.reshape(wshape),
+                       axis=axis + 1).astype(v.dtype)
 
     def fn(v):
         shape = list(v.shape)

@@ -838,3 +838,27 @@ class TestBicubicParity:
                 np.testing.assert_allclose(p.numpy(), t.numpy(),
                                            rtol=1e-4, atol=1e-5,
                                            err_msg=f"{ac} {size}")
+
+
+class TestNLLLossSpatial:
+    """nll_loss with (N,C,d1,d2) input picked along the WRONG axis for
+    spatial targets (r4 fuzz find) — torch-golden across reductions."""
+
+    def test_spatial_nll_matches_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 3, 4, 4).astype("f")
+        lbl = rs.randint(0, 3, (2, 4, 4))
+        lbl[0, 0, 0] = -100
+        w = np.abs(rs.randn(3)).astype("f") + 0.1
+        for red in ("mean", "sum", "none"):
+            p = F.nll_loss(F.log_softmax(paddle.to_tensor(x), axis=1),
+                           paddle.to_tensor(lbl),
+                           weight=paddle.to_tensor(w),
+                           ignore_index=-100, reduction=red)
+            t = TF.nll_loss(TF.log_softmax(torch.tensor(x), dim=1),
+                            torch.tensor(lbl), weight=torch.tensor(w),
+                            ignore_index=-100, reduction=red)
+            np.testing.assert_allclose(p.numpy(), t.numpy(),
+                                       rtol=1e-5, atol=1e-6, err_msg=red)

@@ -519,3 +519,52 @@ class TestAdaptiveSoftmaxAndDecode:
                 if manual[b, t] == 2:
                     break
                 assert got[b, t] == manual[b, t]
+
+
+class TestTorchWeightCopyParity:
+    """LSTM and MultiHeadAttention match torch with copied weights —
+    integration oracle over the recurrent scan and attention paths."""
+
+    def test_lstm_parity(self):
+        import torch
+        rs = np.random.RandomState(9)
+        x = rs.randn(2, 5, 4).astype("f")
+        pl = nn.LSTM(4, 6, num_layers=1, direction="forward",
+                     time_major=False)
+        tl = torch.nn.LSTM(4, 6, num_layers=1, batch_first=True)
+        pmap = dict(pl.named_parameters())
+        for k in ("weight_ih_l0", "weight_hh_l0", "bias_ih_l0",
+                  "bias_hh_l0"):
+            pmap[k].set_value(paddle.to_tensor(
+                getattr(tl, k).detach().numpy()))
+        po, _ = pl(paddle.to_tensor(x))
+        to, _ = tl(torch.tensor(x))
+        np.testing.assert_allclose(po.numpy(), to.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mha_parity(self):
+        import torch
+        rs = np.random.RandomState(9)
+        d, h = 8, 2
+        pmha = nn.MultiHeadAttention(d, h)
+        q = rs.randn(2, 3, d).astype("f")
+        tmha = torch.nn.MultiheadAttention(d, h, batch_first=True)
+        names = dict(pmha.named_parameters())
+        wq, wk, wv = (names[f"{k}_proj.weight"].numpy()
+                      for k in ("q", "k", "v"))
+        bq, bk, bv = (names[f"{k}_proj.bias"].numpy()
+                      for k in ("q", "k", "v"))
+        with torch.no_grad():
+            tmha.in_proj_weight.copy_(torch.tensor(
+                np.concatenate([wq.T, wk.T, wv.T], 0)))
+            tmha.in_proj_bias.copy_(torch.tensor(
+                np.concatenate([bq, bk, bv], 0)))
+            tmha.out_proj.weight.copy_(
+                torch.tensor(names["out_proj.weight"].numpy().T))
+            tmha.out_proj.bias.copy_(
+                torch.tensor(names["out_proj.bias"].numpy()))
+        p = pmha(paddle.to_tensor(q), paddle.to_tensor(q),
+                 paddle.to_tensor(q))
+        t, _ = tmha(torch.tensor(q), torch.tensor(q), torch.tensor(q))
+        np.testing.assert_allclose(p.numpy(), t.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)

@@ -10,7 +10,32 @@ from . import autograd
 from . import asp
 from ..ops import math as _m
 
-softmax_mask_fuse = None
+def softmax_mask_fuse(x, mask, name=None):
+    """Parity: python/paddle/incubate/operators/softmax_mask_fuse.py —
+    softmax(x + mask) in one fused op (upstream CUDA kernel; XLA fuses
+    the add into the softmax on TPU). x [B,H,S,S], mask broadcastable
+    (typically [B,1,S,S])."""
+    import jax
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+    return apply(lambda v, m: jax.nn.softmax(v + m, axis=-1),
+                 _coerce(x), _coerce(mask), _name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Parity: incubate softmax_mask_fuse_upper_triangle — causal-masked
+    softmax (upper triangle masked out) without materializing the mask."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops._dispatch import apply
+    from ..ops.creation import _coerce
+
+    def fn(v):
+        s = v.shape[-1]
+        keep = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(
+            jnp.where(keep, v, jnp.finfo(v.dtype).min), axis=-1)
+    return apply(fn, _coerce(x), _name="softmax_mask_fuse_upper_triangle")
 
 
 def segment_sum(data, segment_ids, name=None):

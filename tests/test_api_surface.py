@@ -156,7 +156,7 @@ SURFACE = {
     "geometric": """sample_neighbors reindex_graph
         segment_sum segment_mean segment_max segment_min
         send_u_recv send_ue_recv send_uv""",
-    "incubate": """segment_sum segment_mean segment_max segment_min graph_send_recv identity_loss asp
+    "incubate": """segment_sum segment_mean segment_max segment_min softmax_mask_fuse softmax_mask_fuse_upper_triangle graph_send_recv identity_loss asp
         graph_khop_sampler graph_reindex graph_sample_neighbors
         autograd nn""",
     "utils": """deprecated try_import run_check download dlpack

@@ -344,3 +344,24 @@ class TestSegmentMaxMin:
         paddle.incubate.segment_max(d2, s).sum().backward()
         np.testing.assert_allclose(d2.grad.numpy(),
                                    [[0, 0], [1, 1], [1, 1]])
+
+
+class TestSoftmaxMaskFuse:
+    """incubate.softmax_mask_fuse (+_upper_triangle) — was a None stub
+    until r4; softmax(x+mask) fused, causal variant maskless."""
+
+    def test_matches_unfused_and_causal(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 2, 4, 4).astype("f")
+        m = np.where(rs.rand(2, 1, 4, 4) > 0.5, 0, -1e9).astype("f")
+        out = paddle.incubate.softmax_mask_fuse(paddle.to_tensor(x),
+                                                paddle.to_tensor(m))
+        import paddle_tpu.nn.functional as F
+        np.testing.assert_allclose(
+            out.numpy(), F.softmax(paddle.to_tensor(x + m), axis=-1).numpy(),
+            rtol=1e-6)
+        ut = paddle.incubate.softmax_mask_fuse_upper_triangle(
+            paddle.to_tensor(x))
+        assert np.allclose(np.triu(ut.numpy()[0, 0], 1), 0)
+        np.testing.assert_allclose(ut.numpy().sum(-1),
+                                   np.ones((2, 2, 4)), rtol=1e-5)

@@ -56,15 +56,10 @@ def main():
               f"- session logs: {len(logs)}",
               f"- stage executions: {total_stages}",
               f"- claims resolved UNAVAILABLE: {unavailable}",
-              "",
-              "Observed tunnel behavior this round: the container's FIRST "
-              "`jax.devices()` (03:16 UTC) was granted the chip instantly; "
-              "every claim after it resolved `UNAVAILABLE: TPU backend "
-              "setup/compile error` after an ~18-25 min pending window "
-              "(grant appears to leak on client process exit). The "
-              "watcher/session harness (tools/tpu_watcher.py, "
-              "tools/tpu_session.py) retried continuously for the rest "
-              "of the round.", ""]
+              ""]
+    notes = os.path.join(ART, "TPU_NOTES.md")
+    if os.path.exists(notes):
+        lines += ["## Operator notes", "", open(notes).read(), ""]
 
     path = os.path.join(ART, "TPU_ATTEMPTS.md")
     with open(path, "w") as f:

@@ -245,3 +245,31 @@ class TestIterationAndDunderTail:
         import jax.numpy as jnp
         assert jnp.from_dlpack(
             paddle.to_tensor(np.ones((2, 2), "f"))).shape == (2, 2)
+
+
+class TestDeviceCudaShim:
+    """paddle.device.cuda stream/event/properties surface (r4): ported
+    CUDA timing code must run unmodified."""
+
+    def test_event_timing_and_streams(self):
+        c = paddle.device.cuda
+        start, end = c.Event(), c.Event()
+        start.record()
+        _ = paddle.matmul(paddle.ones([8, 8]), paddle.ones([8, 8]))
+        end.record()
+        assert start.elapsed_time(end) >= 0
+        s = c.Stream()
+        with c.stream_guard(s) as cur:
+            assert cur is s
+            assert c.current_stream() is s
+        assert c.current_stream() is not s
+        props = c.get_device_properties()
+        assert hasattr(props, "total_memory")
+        assert c.get_device_capability() == (0, 0)
+        assert isinstance(c.get_device_name(), str)
+        assert c.memory_stats() is not None
+
+    def test_fleet_worker_shims(self):
+        from paddle_tpu.distributed import fleet
+        assert fleet.is_worker() is True
+        assert fleet.init_worker() is None

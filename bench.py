@@ -338,6 +338,10 @@ def _orchestrate():
                         f"bench code changed since measurement "
                         f"(recorded {rec_sha}, current "
                         f"{_bench_code_sha()}): replay refused")
+                # top-level marker so consumers that parse only
+                # metric/value cannot mistake a replay for a fresh
+                # measurement (advisor r4)
+                rec["replayed"] = True
                 rec.setdefault("aux", {})["replayed"] = {
                     "from": prev,
                     "reason": "tunnel claim unavailable now; value was "

@@ -60,11 +60,17 @@ class Optimizer:
             else None
         if reg is None:
             reg = self._regularization_coeff
-        if not reg:
+        if reg is None:
             return g
         if callable(reg):
             return reg(p, g)
-        return g + float(reg) * p
+        reg = getattr(reg, "_value", reg)
+        if isinstance(reg, (int, float)):
+            return g if not reg else g + float(reg) * p
+        # array-valued coefficient (upstream allows Tensor weight_decay;
+        # inside a compiled step it may be a tracer): truth-testing would
+        # raise, so always apply — a zero array is still correct
+        return g + reg * p
 
     # ------------------------------------------------------------ LR API --
     def get_lr(self):
@@ -281,7 +287,10 @@ def _adam_math(p, g, m, v, t, lr, b1, b2, eps, wd):
     mhat = m2 / (1 - b1 ** tf)
     vhat = v2 / (1 - b2 ** tf)
     upd = lr * mhat / (jnp.sqrt(vhat) + eps)
-    if wd:  # decoupled decay (AdamW)
+    # decoupled decay (AdamW); wd may be an array/tracer coefficient in
+    # the compiled path, where truth-testing would raise — always apply
+    if not isinstance(wd, (int, float)) or wd:
+        wd = getattr(wd, "_value", wd)
         upd = upd + lr * wd * p.astype(m.dtype)
     p2 = (p.astype(m.dtype) - upd).astype(p.dtype)
     return p2, m2, v2, t2
@@ -295,17 +304,17 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
                          name=name)
-        from ..regularizer import L2Decay, WeightDecayRegularizer
-        if isinstance(weight_decay, (int, float)):
+        from ..regularizer import WeightDecayRegularizer
+        if weight_decay is None:
+            self._wd = 0.0
+        elif isinstance(weight_decay, (int, float)):
             self._wd = float(weight_decay)
-        elif isinstance(weight_decay, L2Decay):
-            # AdamW's decay is decoupled; an L2Decay object degrades to
-            # its coefficient (upstream accepts float/Tensor here)
-            self._wd = weight_decay.coeff
         elif isinstance(weight_decay, WeightDecayRegularizer):
+            # upstream adamw.py raises for any regularizer object here:
+            # coeff must be float or Tensor (decay is decoupled)
             raise TypeError(
-                "AdamW applies decoupled L2 decay; pass a float (or "
-                "L2Decay) as weight_decay, or attach the regularizer "
+                "AdamW's weight_decay (coeff) must be float or Tensor, "
+                f"not {type(weight_decay).__name__}; attach regularizers "
                 "per-parameter via ParamAttr(regularizer=...)")
         else:
             self._wd = weight_decay
@@ -318,11 +327,16 @@ class AdamW(Adam):
                 not self._apply_decay_param_fun(getattr(p, "name", "") or ""):
             wd = 0.0
         if getattr(p, "regularizer", None) is not None:
-            # per-param regularizer wins over the decoupled decay
+            # per-param regularizer folds into the gradient; the
+            # decoupled decay still applies (upstream runs the
+            # regularization pass independently of AdamW's coeff)
             g = self._decayed_grad(p, g)
-            wd = 0.0
         if self._lr_ratio is not None:
             lr = lr * self._lr_ratio(p)
+        if not isinstance(wd, (int, float)):
+            # Tensor coefficient: the eager kernel treats wd as static,
+            # so read its current value once per step
+            wd = float(getattr(wd, "_value", wd))
         m = self._get_accumulator("moment1", p)
         v = self._get_accumulator("moment2", p)
         t = self._get_accumulator("step", p,
@@ -607,10 +621,9 @@ def _adamw_fn_apply(self, p, g, s, lr, name, param=None):
             not self._apply_decay_param_fun(name or ""):
         wd = 0.0
     if param is not None and getattr(param, "regularizer", None) is not None:
-        # per-param regularizer wins over the decoupled decay (mirrors
-        # AdamW._update's eager-path rule)
+        # per-param regularizer folds into the gradient; decoupled decay
+        # still applies (mirrors AdamW._update's eager-path rule)
         g = self._fn_decayed_grad(p, g, param)
-        wd = 0.0
     if self._lr_ratio is not None and param is not None:
         lr = lr * self._lr_ratio(param)
     p2, m2, v2, t2 = _adam_math(p, g, s["moment1"], s["moment2"], s["step"],

@@ -330,13 +330,38 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     """Reduce a sparse tensor (parity: python/paddle/sparse/unary.py
     sparse sum). Returns dense for full reduction (paddle returns a
     0-nnz sparse scalar; dense is the usable equivalent), sparse when an
-    axis survives."""
+    axis survives.
+
+    O(nnz): reduces over the stored values/indices directly (a full
+    densify would be O(prod(shape)) memory and defeat sparsity)."""
     b = _as_bcoo(x)
-    dense = b.todense()
-    out = jnp.sum(dense, axis=axis, keepdims=keepdim, dtype=dtype)
-    if axis is None:
+    nd = len(b.shape)
+    axes = (list(range(nd)) if axis is None
+            else [axis] if isinstance(axis, (int, np.integer))
+            else list(axis))
+    axes = [int(a) + nd if int(a) < 0 else int(a) for a in axes]
+    surv = [d for d in range(nd) if d not in axes]
+    if not surv:
+        out = jnp.sum(b.data, dtype=dtype)
+        if keepdim:
+            out = out.reshape((1,) * nd)
         return Tensor(out)
-    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+    # coalesce duplicate surviving coordinates host-side (indices are
+    # concrete outside jit, same pattern as slice below)
+    idx = np.asarray(b.indices)[:, surv]
+    uniq, inv = np.unique(idx, axis=0, return_inverse=True)
+    data = b.data if dtype is None else b.data.astype(dtype)
+    out_data = jax.ops.segment_sum(data, jnp.asarray(inv.ravel()),
+                                   num_segments=uniq.shape[0])
+    if keepdim:
+        full = np.zeros((uniq.shape[0], nd), np.int32)
+        full[:, surv] = uniq
+        new_shape = tuple(1 if d in axes else b.shape[d] for d in range(nd))
+        return SparseCooTensor(jsparse.BCOO(
+            (out_data, jnp.asarray(full)), shape=new_shape))
+    new_shape = tuple(b.shape[d] for d in surv)
+    return SparseCooTensor(jsparse.BCOO(
+        (out_data, jnp.asarray(uniq.astype(np.int32))), shape=new_shape))
 
 
 def slice(x, axes, starts, ends, name=None):

@@ -320,6 +320,22 @@ class TestSparseTailOps:
                                    (d != 0) * 7.0)
         assert not sp.isnan(s).to_dense().numpy().any()
 
+    def test_sum_all_axes_keepdim(self):
+        # advisor r4: sum must reduce over stored values (O(nnz)), not
+        # densify — keep full parity across axis/keepdim combinations,
+        # including duplicate surviving coordinates
+        import paddle_tpu.sparse as sp
+        d = np.zeros((4, 5), np.float32)
+        d[0, 1], d[2, 3], d[0, 4] = 2.0, -1.0, 3.0
+        s = self._coo(d)
+        for ax, kd in [(None, False), (0, False), (1, False),
+                       (0, True), (1, True), ((0, 1), False)]:
+            got = sp.sum(s, axis=ax, keepdim=kd)
+            got = got.to_dense().numpy() if hasattr(got, "to_dense") \
+                else got.numpy()
+            np.testing.assert_allclose(got, d.sum(axis=ax, keepdims=kd),
+                                       atol=1e-6, err_msg=f"{ax},{kd}")
+
     def test_tensor_T_mT(self):
         t = paddle.to_tensor(np.arange(6, dtype="f").reshape(2, 3) * 1.0)
         assert t.T.shape == [3, 2] and t.mT.shape == [3, 2]

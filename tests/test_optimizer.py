@@ -701,16 +701,19 @@ class TestRegularizer:
         np.testing.assert_allclose(w2.numpy(), np.full((2, 2), 2.0),
                                    rtol=1e-6)  # untouched
 
-    def test_adamw_param_regularizer_replaces_decoupled(self):
+    def test_adamw_param_regularizer_composes_with_decoupled(self):
+        # upstream applies the regularization pass independently of the
+        # decoupled coeff (advisor r4): a per-param L2Decay(0) folds a
+        # zero penalty into the grad, and the decoupled 0.5 decay STILL
+        # fires — param shrinks by lr*wd*p = 0.1*0.5*2.0
         w = self._param()
-        w.regularizer = paddle.regularizer.L2Decay(0.0)  # explicit none
+        w.regularizer = paddle.regularizer.L2Decay(0.0)
         opt = paddle.optimizer.AdamW(0.1, parameters=[w], weight_decay=0.5)
         w.grad = paddle.zeros([2, 2])
         opt.step()
-        # zero grad + zero per-param penalty -> adam update is 0; the
-        # decoupled 0.5 decay must NOT fire for this param
-        np.testing.assert_allclose(w.numpy(), np.full((2, 2), 2.0),
+        np.testing.assert_allclose(w.numpy(), np.full((2, 2), 2.0 - 0.1),
                                    atol=1e-6)
+
 
     def test_layer_param_attr_plumbing(self):
         from paddle_tpu import nn
@@ -756,15 +759,21 @@ class TestRegularizer:
                                    rtol=1e-5, atol=1e-6)
 
     def test_adamw_weight_decay_object(self):
+        # upstream adamw.py: coeff must be float/Tensor — ANY regularizer
+        # object (incl. L2Decay) raises (advisor r4)
+        for reg in (paddle.regularizer.L2Decay(0.5),
+                    paddle.regularizer.L1Decay(0.5)):
+            with pytest.raises(TypeError):
+                paddle.optimizer.AdamW(
+                    0.1, parameters=[self._param()], weight_decay=reg)
+        # Tensor coefficient is accepted (eager path reads it per step)
         w = self._param()
         opt = paddle.optimizer.AdamW(
-            0.1, parameters=[w],
-            weight_decay=paddle.regularizer.L2Decay(0.5))
-        assert opt._wd == 0.5  # degraded to decoupled coefficient
-        with pytest.raises(TypeError):
-            paddle.optimizer.AdamW(
-                0.1, parameters=[self._param()],
-                weight_decay=paddle.regularizer.L1Decay(0.5))
+            0.1, parameters=[w], weight_decay=paddle.to_tensor(0.5))
+        w.grad = paddle.zeros([2, 2])
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), np.full((2, 2), 1.9),
+                                   atol=1e-6)
 
     def test_conv_norm_activation_disable(self):
         import paddle_tpu.vision.ops as vops

@@ -84,18 +84,79 @@ def _pad_d_for_dtype(dtype, d):
     return d
 
 
+def _fmix32(x):
+    """murmur3 finalizer: avalanche mix of an i32 lane. Pure vector int
+    ops (mul wraps two's-complement, logical shifts) — identical
+    semantics under Mosaic, the Pallas interpreter, and plain XLA, so
+    forward, backward and host-side tests regenerate the same bits."""
+    m1 = jnp.int32(np.int32(np.uint32(0x85EBCA6B)))
+    m2 = jnp.int32(np.int32(np.uint32(0xC2B2AE35)))
+    # explicit i32 shift amounts: with jax_enable_x64 on, a bare python
+    # literal traces as i64 and lax.shift_right_logical rejects the mix
+    s16, s13 = jnp.int32(16), jnp.int32(13)
+    x = x ^ jax.lax.shift_right_logical(x, s16)
+    x = x * m1
+    x = x ^ jax.lax.shift_right_logical(x, s13)
+    x = x * m2
+    x = x ^ jax.lax.shift_right_logical(x, s16)
+    return x
+
+
+def dropout_keep_mask(q_ids, k_ids, row, seed0, seed1, seq_q, seq_k,
+                      dropout_p):
+    """Counter-based attention-dropout keep mask (reference parity: the
+    philox counter RNG of flash_attn_kernel.cu — same idea, cheaper
+    hash). Element (row, q, k) is kept iff
+    fmix32(fmix32(fmix32(row ^ s0) ^ q) ^ k ^ s1) >= p·2^32 in uint32
+    order. The three coordinates are mixed as SEPARATE words (each
+    < 2^31 on its own) rather than as one linearized counter, so the
+    pattern never wraps/collides however large B·H·Sq·Sk gets, and it
+    is independent of block sizes and grid iteration order — the
+    backward kernels (and tests, on the host) regenerate the exact
+    forward pattern. The uint32 compare is done in the signed domain
+    (x ^ 0x80000000 preserves order) to avoid unsigned vector compares
+    in Mosaic. seq_q/seq_k are unused (kept for call-site symmetry)."""
+    del seq_q, seq_k
+    i32 = lambda n: jnp.asarray(n, jnp.int32)
+    x = _fmix32(i32(row) ^ i32(seed0))
+    x = _fmix32(x ^ q_ids)
+    x = _fmix32(x ^ k_ids ^ i32(seed1))
+    thresh = np.uint32(min(0xFFFFFFFF, int(round(dropout_p * 4294967296.0))))
+    sign = jnp.int32(np.int32(np.uint32(0x80000000)))
+    t_signed = jnp.int32(np.int32(thresh ^ np.uint32(0x80000000)))
+    return (x ^ sign) >= t_signed
+
+
+def _mask_row(h, H, Bm, Hm):
+    """Map a flattened [B*H] row index onto its row of the [Bm*Hm, Sq,
+    Sk] attention-mask array (Bm ∈ {1, B}, Hm ∈ {1, H}): batch- and/or
+    head-broadcast masks are tiled straight from HBM, never repeated.
+    lax.div/rem with explicit i32 — see _gqa_kv_row for why."""
+    if Bm == 1 and Hm == 1:
+        return _Z
+    if isinstance(h, (int, np.integer)):
+        b, hh = h // H, h % H
+        return (b if Bm > 1 else 0) * Hm + (hh if Hm > 1 else 0)
+    i32 = lambda n: jnp.asarray(n, jnp.int32)
+    b = jax.lax.div(h, i32(H))
+    hh = jax.lax.rem(h, i32(H))
+    row = b * i32(Hm) if Bm > 1 else i32(0)
+    return row + hh if Hm > 1 else row
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward kernel: works on [BH, S, D]
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
-    if has_lens:
-        (q_ref, k_ref, v_ref, lens_ref, o_ref, lse_ref,
-         m_scr, l_scr, acc_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, o_ref, lse_ref,
-         m_scr, l_scr, acc_scr) = refs
-        lens_ref = None
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_q, seq_k,
+                has_lens, has_mask=False, dropout_p=0.0):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    mask_ref = next(it) if has_mask else None
+    lens_ref = next(it) if has_lens else None
+    seed_ref = next(it) if dropout_p else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = it
+    hrow = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -115,12 +176,19 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=mxu_precision(q, k)) * np.float32(scale)
+        if has_mask:
+            # additive mask tile (bool masks are converted to additive
+            # _NEG_INF outside); applied BEFORE the -inf clamp below so
+            # NaN padding in tail mask blocks can't survive it
+            s = s + mask_ref[0].astype(jnp.float32)
 
-        if causal or seq_k % block_k or has_lens:
+        q_ids = k_ids = None
+        if causal or seq_k % block_k or has_lens or dropout_p:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
+        if causal or seq_k % block_k or has_lens:
             keep = k_ids < seq_k  # kv tail: padded columns must not
             if causal:           # enter the softmax denominator
                 keep = jnp.logical_and(keep, q_ids >= k_ids)
@@ -135,10 +203,20 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_prev - m_new)
+        # the normalizer uses pre-dropout p: dropout applies to
+        # softmax(S), i.e. AFTER normalization (flash_attn semantics)
         l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        if dropout_p:
+            keep_d = dropout_keep_mask(
+                q_ids, k_ids, hrow, seed_ref[0, 0, 0],
+                seed_ref[0, 0, 1], seq_q, seq_k, dropout_p)
+            p_acc = jnp.where(keep_d, p, 0.0) * np.float32(
+                1.0 / (1.0 - dropout_p))
+        else:
+            p_acc = p
         acc_scr[:] = (acc_scr[:] * alpha[:, None] +
                       jax.lax.dot_general(
-                          p.astype(v.dtype), v,
+                          p_acc.astype(v.dtype), v,
                           (((1,), (0,)), ((), ())),
                           preferred_element_type=jnp.float32,
                           precision=mxu_precision(v)))
@@ -165,12 +243,19 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_k, has_lens):
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
-                      n_heads=None, n_kv_heads=None, kv_lens=None):
+                      n_heads=None, n_kv_heads=None, kv_lens=None,
+                      mask3=None, mask_dims=(1, 1), seeds=None,
+                      dropout_p=0.0):
     """q: [B*H, S, D]; k,v: [B*Hkv, S, D] → (out [B*H,S,D], lse [B*H,S]).
 
     Native GQA/MQA (reference: flash_attn_kernel.cu's num_heads_k <
     num_heads path): when Hkv < H the kv BlockSpec index maps fold the
     query head onto its kv group — kv shards are NEVER repeated in HBM.
+
+    mask3 ([Bm*Hm, Sq, Sk] additive float, Bm/Hm given by mask_dims):
+    broadcast masks are tiled from HBM without repetition. seeds
+    ((1,1,128) i32, lanes 0/1) + dropout_p: in-kernel counter-hash
+    attention dropout (see dropout_keep_mask).
 
     bf16/f16 with d % 128 != 0: Mosaic rejects the sub-lane-width bf16
     matmul operand ("Bad lhs type"), so D is zero-padded to the 128-lane
@@ -183,7 +268,9 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
         q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
         out, lse = _flash_fwd_pallas(q, k, v, scale, causal, block_q,
                                      block_k, n_heads, n_kv_heads,
-                                     kv_lens=kv_lens)
+                                     kv_lens=kv_lens, mask3=mask3,
+                                     mask_dims=mask_dims, seeds=seeds,
+                                     dropout_p=dropout_p)
         return out[..., :d], lse
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -197,9 +284,16 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
         return (_gqa_kv_row(h, H, Hkv), j, _Z)
 
     has_lens = kv_lens is not None
+    has_mask = mask3 is not None
+    Bm, Hm = mask_dims
+    # masks broadcast over the query axis ([.., 1, Sk], e.g. key-padding
+    # masks) are tiled as (1, 1, block_k) rows — never expanded to S×S
+    # in HBM; the kernel's `s + mask` broadcasts the row
+    mask_q1 = has_mask and mask3.shape[1] == 1 and sq > 1
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=sk, has_lens=has_lens)
+        block_k=block_k, seq_q=sq, seq_k=sk, has_lens=has_lens,
+        has_mask=has_mask, dropout_p=dropout_p)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, _Z)),
@@ -207,10 +301,20 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
         pl.BlockSpec((1, block_k, d), kv_index),
     ]
     args = [q, k, v]
+    if has_mask:
+        args.append(mask3)
+        in_specs.append(pl.BlockSpec(
+            (1, 1 if mask_q1 else block_q, block_k),
+            lambda h, i, j: (_mask_row(h, H, Bm, Hm),
+                             _Z if mask_q1 else i, j)))
     if has_lens:
         args.append(_lens_rows(kv_lens, bh))
         in_specs.append(
             pl.BlockSpec((1, 1, 128), lambda h, i, j: (h, _Z, _Z)))
+    if dropout_p:
+        args.append(seeds)
+        in_specs.append(
+            pl.BlockSpec((1, 1, 128), lambda h, i, j: (_Z, _Z, _Z)))
 
     out, lse = pl.pallas_call(
         kernel,
@@ -244,12 +348,13 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128,
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      *refs, scale, causal, block_q, block_k, seq_q, seq_k,
-                     has_lens=False):
-    if has_lens:
-        lens_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
-    else:
-        dk_ref, dv_ref, dk_scr, dv_scr = refs
-        lens_ref = None
+                     has_lens=False, has_mask=False, dropout_p=0.0):
+    it = iter(refs)
+    mask_ref = next(it) if has_mask else None
+    lens_ref = next(it) if has_lens else None
+    seed_ref = next(it) if dropout_p else None
+    dk_ref, dv_ref, dk_scr, dv_scr = it
+    hrow = pl.program_id(0)
     j = pl.program_id(1)   # kv block
     i = pl.program_id(2)   # q block (innermost: accumulation axis)
     ni = pl.num_programs(2)
@@ -274,11 +379,16 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32
                                 ) * np.float32(scale)
-        if causal or seq_q % block_q or seq_k % block_k or has_lens:
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)
+        q_ids = k_ids = None
+        if (causal or seq_q % block_q or seq_k % block_k or has_lens
+                or dropout_p):
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
+        if causal or seq_q % block_q or seq_k % block_k or has_lens:
             # padded q rows (garbage lse/delta) and padded kv columns
             # must contribute nothing to dk/dv
             keep = jnp.logical_and(q_ids < seq_q, k_ids < seq_k)
@@ -291,13 +401,30 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         else:
             keep = None
             p = jnp.exp(s - lse[:, None])    # (bq, bk)
-        # dv += p^T do
+        if dropout_p:
+            # regenerate the forward's exact keep pattern; with
+            # O = (P∘D)V and D = keep/(1-p):
+            #   dV = (P∘D)^T dO,  dS = P ∘ (dP_d∘D − delta)
+            # (delta = rowsum(dO∘O) stays valid: it equals
+            # rowsum((P∘D) ∘ dP_d))
+            keep_d = dropout_keep_mask(
+                q_ids, k_ids, hrow, seed_ref[0, 0, 0],
+                seed_ref[0, 0, 1], seq_q, seq_k, dropout_p)
+            dmul = jnp.where(keep_d, np.float32(1.0 / (1.0 - dropout_p)),
+                             np.float32(0.0))
+            pd = p * dmul
+        else:
+            dmul = None
+            pd = p
+        # dv += (p∘D)^T do
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pd, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dmul is not None:
+            dp = dp * dmul
         ds = p * (dp - delta[:, None]) * np.float32(scale)
         if keep is not None:
             # guard against NaN/Inf garbage in out-of-bounds lse/delta
@@ -324,12 +451,14 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *refs, scale, causal, block_q, block_k,
-                   seq_q, seq_k, has_lens=False):
-    if has_lens:
-        lens_ref, dq_ref, dq_scr = refs
-    else:
-        dq_ref, dq_scr = refs
-        lens_ref = None
+                   seq_q, seq_k, has_lens=False, has_mask=False,
+                   dropout_p=0.0):
+    it = iter(refs)
+    mask_ref = next(it) if has_mask else None
+    lens_ref = next(it) if has_lens else None
+    seed_ref = next(it) if dropout_p else None
+    dq_ref, dq_scr = it
+    hrow = pl.program_id(0)
     i = pl.program_id(1)   # q block
     j = pl.program_id(2)   # kv block (innermost: accumulation axis)
     nj = pl.num_programs(2)
@@ -350,12 +479,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32
                                 ) * np.float32(scale)
-        keep = None
-        if causal or seq_k % block_k or has_lens:
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)
+        keep = q_ids = k_ids = None
+        if causal or seq_k % block_k or has_lens or dropout_p:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_ids = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
+        if causal or seq_k % block_k or has_lens:
             # kv-tail columns must not contribute to dq; q-tail rows
             # compute garbage but their dq writes land out of bounds
             # and are dropped
@@ -370,6 +502,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p:
+            # dS = P ∘ (dP_d∘D − delta); see _bwd_dkdv_kernel
+            keep_d = dropout_keep_mask(
+                q_ids, k_ids, hrow, seed_ref[0, 0, 0],
+                seed_ref[0, 0, 1], seq_q, seq_k, dropout_p)
+            dp = dp * jnp.where(keep_d,
+                                np.float32(1.0 / (1.0 - dropout_p)),
+                                np.float32(0.0))
         ds = p * (dp - delta[:, None]) * np.float32(scale)
         if keep is not None:
             ds = jnp.where(keep, ds, 0.0)
@@ -392,10 +532,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
                       block_q=128, block_k=128, n_heads=None,
-                      n_kv_heads=None, kv_lens=None):
+                      n_kv_heads=None, kv_lens=None, mask3=None,
+                      mask_dims=(1, 1), seeds=None, dropout_p=0.0):
     """q,o,do: [B*H, S, D]; k,v: [B*Hkv, S, D]; lse: [B*H, S] (f32).
     Returns dq [B*H,...], dk/dv [B*H,...] (per query head — group-sum for
-    GQA)."""
+    GQA). mask3/seeds/dropout_p as in _flash_fwd_pallas — the dropout
+    keep pattern is regenerated in-kernel from the same seeds."""
     bh, sq, d = q.shape
     d_pad = _pad_d_for_dtype(q.dtype, d)
     if d_pad != d:
@@ -403,7 +545,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
         q, k, v, o, do = (jnp.pad(a, pad) for a in (q, k, v, o, do))
         dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
                                        block_q, block_k, n_heads,
-                                       n_kv_heads, kv_lens=kv_lens)
+                                       n_kv_heads, kv_lens=kv_lens,
+                                       mask3=mask3, mask_dims=mask_dims,
+                                       seeds=seeds, dropout_p=dropout_p)
         return dq[..., :d], dk[..., :d], dv[..., :d]
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -433,18 +577,43 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
     # group-sums them down to [B*Hkv, ...] — kv inputs are still never
     # repeated in HBM.
     has_lens = kv_lens is not None
-    lens_args = []
+    has_mask = mask3 is not None
+    Bm, Hm = mask_dims
+    mask_q1 = has_mask and mask3.shape[1] == 1 and sq > 1
+    extra_args = []
+    if has_mask:
+        extra_args.append(mask3)
     if has_lens:
-        lens_args = [_lens_rows(kv_lens, bh)]
+        extra_args.append(_lens_rows(kv_lens, bh))
+    if dropout_p:
+        extra_args.append(seeds)
+
+    def extra_specs(q_blk, kv_blk):
+        # q_blk/kv_blk pick which grid axis is the q/kv block index for
+        # the mask tile ((h, a, b) -> logical (q block, kv block))
+        sp = []
+        if has_mask:
+            sp.append(pl.BlockSpec(
+                (1, 1 if mask_q1 else block_q, block_k),
+                lambda h, a, b: (_mask_row(h, H, Bm, Hm),
+                                 _Z if mask_q1 else (a, b)[q_blk],
+                                 (a, b)[kv_blk])))
+        if has_lens:
+            sp.append(pl.BlockSpec((1, 1, 128),
+                                   lambda h, a, b: (h, _Z, _Z)))
+        if dropout_p:
+            sp.append(pl.BlockSpec((1, 1, 128),
+                                   lambda h, a, b: (_Z, _Z, _Z)))
+        return sp
 
     dkdv_in = [q_spec_i, k_in_j, k_in_j, q_spec_i, row_i, row_i]
-    if has_lens:
-        dkdv_in.append(
-            pl.BlockSpec((1, 1, 128), lambda h, a, b: (h, _Z, _Z)))
+    # dkdv grid is (bh, kv block, q block): mask tile q index is axis b
+    dkdv_in.extend(extra_specs(q_blk=1, kv_blk=0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          seq_q=sq, seq_k=sk, has_lens=has_lens),
+                          seq_q=sq, seq_k=sk, has_lens=has_lens,
+                          has_mask=has_mask, dropout_p=dropout_p),
         grid=(bh, nk, nq),
         in_specs=dkdv_in,
         out_specs=[k_out_j, k_out_j],
@@ -453,26 +622,26 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=pallas_interpret(),
-    )(q, k, v, do, lse, delta, *lens_args)
+    )(q, k, v, do, lse, delta, *extra_args)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda h, a, b: (h, a, _Z))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda h, a, b: kv_in(h, a, b, b))
     row_q = pl.BlockSpec((1, 1, block_q), lambda h, a, b: (h, _Z, a))
     dq_in = [q_spec, kv_spec, kv_spec, q_spec, row_q, row_q]
-    if has_lens:
-        dq_in.append(
-            pl.BlockSpec((1, 1, 128), lambda h, a, b: (h, _Z, _Z)))
+    # dq grid is (bh, q block, kv block)
+    dq_in.extend(extra_specs(q_blk=0, kv_blk=1))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          seq_q=sq, seq_k=sk, has_lens=has_lens),
+                          seq_q=sq, seq_k=sk, has_lens=has_lens,
+                          has_mask=has_mask, dropout_p=dropout_p),
         grid=(bh, nq, nk),
         in_specs=dq_in,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=pallas_interpret(),
-    )(q, k, v, do, lse, delta, *lens_args)
+    )(q, k, v, do, lse, delta, *extra_args)
     return dq, dk, dv
 
 
@@ -538,6 +707,32 @@ def _flash_fwd(q, k, v, scale, causal):
     return out, (q, k, v, out, lse.reshape(b, h, sq))
 
 
+def _bwd_pallas_bshd(q, k, v, out, lse, g, scale, causal, kv_lens=None,
+                     mask3=None, mask_dims=(1, 1), seeds=None,
+                     dropout_p=0.0):
+    """[B,S,H,D]-layout marshalling around _flash_bwd_pallas, shared by
+    every custom_vjp bwd: flatten heads, run the kernels, unflatten and
+    group-sum dk/dv down to the kv heads (GQA)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hkv = k.shape[2]
+
+    def to3(x, s, nh):
+        return x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
+    bq, bk = _flash_blocks()
+    dq3, dk3, dv3 = _flash_bwd_pallas(
+        to3(q, sq, h), to3(k, sk, hkv), to3(v, sk, hkv),
+        to3(out, sq, h), lse.reshape(b * h, sq),
+        to3(g.astype(q.dtype), sq, h), scale, causal,
+        block_q=bq, block_k=bk, n_heads=h, n_kv_heads=hkv,
+        kv_lens=kv_lens, mask3=mask3, mask_dims=mask_dims,
+        seeds=seeds, dropout_p=dropout_p)
+    dq = dq3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk3.reshape(b, hkv, h // hkv, sk, d).sum(2).transpose(0, 2, 1, 3)
+    dv = dv3.reshape(b, hkv, h // hkv, sk, d).sum(2).transpose(0, 2, 1, 3)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
 def _flash_bwd(scale, causal, res, g):
     """Backward: Pallas flash-2 kernels when available (dk/dv and dq
     accumulated blockwise from the saved lse — no S×S materialization),
@@ -546,26 +741,7 @@ def _flash_bwd(scale, causal, res, g):
     d = q.shape[-1]
     if (_use_pallas() and pallas_dtype_ok(q, k, v, g)
             and q.shape[1] >= 8 and d % 64 == 0):
-        b, sq, h, _ = q.shape
-        sk = k.shape[1]
-        hkv = k.shape[2]
-
-        def to3(x, s, nh):
-            return x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
-        bq, bk = _flash_blocks()
-        dq3, dk3, dv3 = _flash_bwd_pallas(
-            to3(q, sq, h), to3(k, sk, hkv), to3(v, sk, hkv),
-            to3(out, sq, h), lse.reshape(b * h, sq),
-            to3(g.astype(q.dtype), sq, h), scale, causal,
-            block_q=bq, block_k=bk,
-            n_heads=h, n_kv_heads=hkv)
-        dq = dq3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-        # GQA: per-query-head dk/dv group-sum down to the kv heads
-        dk = dk3.reshape(b, hkv, h // hkv, sk, d).sum(2)
-        dv = dv3.reshape(b, hkv, h // hkv, sk, d).sum(2)
-        dk = dk.transpose(0, 2, 1, 3)
-        dv = dv.transpose(0, 2, 1, 3)
-        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+        return _bwd_pallas_bshd(q, k, v, out, lse, g, scale, causal)
     if k.shape[2] != q.shape[2]:
         # GQA fallback: repeat kv, compute per-q-head, group-sum at the end
         rep = q.shape[2] // k.shape[2]
@@ -633,18 +809,8 @@ def _flash_bwd_varlen(scale, causal, res, g):
     hkv = k.shape[2]
     if (_use_pallas() and pallas_dtype_ok(q, k, v, g)
             and sq >= 8 and d % 64 == 0):
-        def to3(x, s, nh):
-            return x.transpose(0, 2, 1, 3).reshape(b * nh, s, d)
-        bq, bk = _flash_blocks()
-        dq3, dk3, dv3 = _flash_bwd_pallas(
-            to3(q, sq, h), to3(k, sk, hkv), to3(v, sk, hkv),
-            to3(out, sq, h), lse.reshape(b * h, sq),
-            to3(g.astype(q.dtype), sq, h), scale, causal,
-            block_q=bq, block_k=bk,
-            n_heads=h, n_kv_heads=hkv, kv_lens=kv_lens)
-        dq = dq3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-        dk = dk3.reshape(b, hkv, h // hkv, sk, d).sum(2).transpose(0, 2, 1, 3)
-        dv = dv3.reshape(b, hkv, h // hkv, sk, d).sum(2).transpose(0, 2, 1, 3)
+        dq, dk, dv = _bwd_pallas_bshd(q, k, v, out, lse, g, scale,
+                                      causal, kv_lens=kv_lens)
     else:
         lens_mask = (jnp.arange(sk)[None, None, None, :]
                      < kv_lens[:, None, None, None])
@@ -668,37 +834,180 @@ _flash_core_varlen.defvjp(
     _flash_bwd_varlen)
 
 
+# general core: additive mask and/or in-kernel dropout (and optionally
+# varlen lens) on the Pallas fast path (reference parity: the
+# attn_mask + dropout arguments of flash_attn_kernel.cu, which upstream
+# keeps on the fused kernel). NOTE mask gradients: like upstream's
+# flash binding, this path does NOT produce a mask cotangent (zeros are
+# returned) — flash_attention_bshd routes masks that require grad to
+# the XLA path, where autodiff handles them.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_core_gen(q, k, v, mask3, extras, scale, cfg):
+    return _flash_fwd_gen(q, k, v, mask3, extras, scale, cfg)[0]
+
+
+def _flash_fwd_gen(q, k, v, mask3, extras, scale, cfg):
+    causal, dropout_p, Bm, Hm = cfg
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    bq, bk = _flash_blocks()
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, k.shape[1], d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, v.shape[1], d)
+    out, lse = _flash_fwd_pallas(
+        qt, kt, vt, scale, causal, block_q=bq, block_k=bk,
+        n_heads=h, n_kv_heads=hkv, kv_lens=extras.get("kv_lens"),
+        mask3=mask3, mask_dims=(Bm, Hm), seeds=extras.get("seeds"),
+        dropout_p=dropout_p)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v, mask3, extras, out, lse.reshape(b, h, sq))
+
+
+def _gen_reference(q, k, v, mask3, kv_lens, seeds, scale, causal,
+                   dropout_p, Bm, Hm):
+    """XLA reference with the general core's EXACT semantics, including
+    the counter-hash dropout pattern — used as the non-Pallas bwd
+    fallback and by tests as the parity oracle."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if k.shape[2] != h:
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * np.float32(scale)
+    if mask3 is not None:
+        # mask3's q axis may be a broadcast singleton (key-padding masks)
+        s = s + mask3.reshape(Bm, Hm, mask3.shape[1],
+                              mask3.shape[2]).astype(jnp.float32)
+    qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    if causal:
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    if kv_lens is not None:
+        lens_keep = (jnp.arange(sk)[None, None, None, :]
+                     < kv_lens[:, None, None, None])
+        s = jnp.where(lens_keep, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_p:
+        rows = jnp.arange(b * h, dtype=jnp.int32).reshape(b, h, 1, 1)
+        keep = dropout_keep_mask(qi[None, None], ki[None, None], rows,
+                                 seeds[0, 0, 0], seeds[0, 0, 1],
+                                 sq, sk, dropout_p)
+        p = jnp.where(keep, p, 0.0) * np.float32(1.0 / (1.0 - dropout_p))
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _flash_bwd_gen(scale, cfg, res, g):
+    causal, dropout_p, Bm, Hm = cfg
+    q, k, v, mask3, extras, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hkv = k.shape[2]
+    kv_lens = extras.get("kv_lens")
+    seeds = extras.get("seeds")
+    if (_use_pallas() and pallas_dtype_ok(q, k, v, g)
+            and sq >= 8 and d % 64 == 0):
+        dq, dk, dv = _bwd_pallas_bshd(q, k, v, out, lse, g, scale,
+                                      causal, kv_lens=kv_lens,
+                                      mask3=mask3, mask_dims=(Bm, Hm),
+                                      seeds=seeds, dropout_p=dropout_p)
+    else:
+        def ref(q_, k_, v_):
+            return _gen_reference(q_, k_, v_, mask3, kv_lens, seeds,
+                                  scale, causal, dropout_p, Bm, Hm)
+        _, pull = jax.vjp(ref, q, k, v)
+        dq, dk, dv = pull(g.astype(q.dtype))
+    dmask = None if mask3 is None else jnp.zeros_like(mask3)
+    dex = {}
+    if kv_lens is not None:
+        dex["kv_lens"] = np.zeros(kv_lens.shape, float0_dtype())
+    if seeds is not None:
+        dex["seeds"] = np.zeros(seeds.shape, float0_dtype())
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dmask, dex)
+
+
+_flash_core_gen.defvjp(_flash_fwd_gen, _flash_bwd_gen)
+
+
 def flash_attention_jax(query, key, value, *, causal=False, scale=None,
                         mask=None, dropout_p=0.0, dropout_key=None,
-                        kv_lens=None):
+                        kv_lens=None, allow_pallas_mask=True):
     """Pure-jax entry ([B,S,H,D] arrays). Chooses Pallas vs XLA.
 
     kv_lens ([B] i32): per-sequence valid kv length for padded batches —
     masked inside the Pallas kernels (varlen parity, no S x S mask
-    tensor)."""
+    tensor).
+
+    Masks (bool or additive float, [Bm, Hm, Sq', Sk'] with Bm∈{1,B},
+    Hm∈{1,H}, singleton Sq'/Sk' broadcast) and dropout stay on the
+    Pallas fast path: masks as blockwise additive tiles, dropout via the
+    in-kernel counter hash. allow_pallas_mask=False forces masked calls
+    to the XLA path (used when the mask itself needs gradients — the
+    fast path, like upstream's flash binding, doesn't produce them)."""
     d = query.shape[-1]
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    b, sq = query.shape[0], query.shape[1]
+    h = query.shape[2]
+    sk = key.shape[1]
     # d only needs to be a multiple of 64: the kernel's block last-dim
     # equals the full array dim, which TPU tiling always accepts (lanes
     # are padded to 128 internally for d=64 — still beats XLA attention)
-    plausible = (_use_pallas() and pallas_dtype_ok(query, key, value)
-                 and mask is None and dropout_p == 0.0
-                 and query.shape[1] >= 8 and d % 64 == 0
-                 and query.shape[2] % key.shape[2] == 0)
+    base = (_use_pallas() and pallas_dtype_ok(query, key, value)
+            and sq >= 8 and d % 64 == 0 and h % key.shape[2] == 0)
     if kv_lens is not None:
         kv_lens = jnp.asarray(kv_lens, jnp.int32)
-        if plausible:
+    # dropout is active only when a key was supplied (training mode)
+    eff_drop = float(dropout_p) if dropout_key is not None else 0.0
+    mask_fast_ok = (
+        mask is None
+        or (allow_pallas_mask and mask.ndim == 4
+            and mask.shape[0] in (1, b) and mask.shape[1] in (1, h)
+            and mask.shape[2] in (1, sq) and mask.shape[3] in (1, sk)))
+
+    if base and mask is None and eff_drop == 0.0:
+        if kv_lens is not None:
             return _flash_core_varlen(query, key, value, kv_lens, sc,
                                       causal)
-        sk = key.shape[1]
+        return _flash_core(query, key, value, sc, causal)
+
+    if base and mask_fast_ok and eff_drop < 1.0:
+        mask3, dims = None, (1, 1)
+        if mask is not None:
+            m = mask
+            if m.dtype == jnp.bool_:
+                m = jnp.where(m, np.float32(0.0), _NEG_INF)
+            if m.shape[3] != sk:
+                m = jnp.broadcast_to(m, m.shape[:3] + (sk,))
+            # a singleton q axis stays singleton: the kernels tile it as
+            # (1, block_k) rows instead of materializing S×S in HBM
+            dims = (m.shape[0], m.shape[1])
+            mask3 = m.reshape(dims[0] * dims[1], m.shape[2], sk)
+        extras = {}
+        if kv_lens is not None:
+            extras["kv_lens"] = kv_lens
+        if eff_drop > 0.0:
+            s01 = jax.random.randint(
+                dropout_key, (2,), jnp.iinfo(jnp.int32).min,
+                jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+            extras["seeds"] = (jnp.zeros((1, 1, 128), jnp.int32)
+                               .at[0, 0, 0].set(s01[0])
+                               .at[0, 0, 1].set(s01[1]))
+        cfg = (bool(causal), float(eff_drop), dims[0], dims[1])
+        return _flash_core_gen(query, key, value, mask3, extras, sc, cfg)
+
+    if kv_lens is not None:
         lens_mask = (jnp.arange(sk)[None, None, None, :]
                      < kv_lens[:, None, None, None])
-        m2 = lens_mask if mask is None else jnp.logical_and(
-            lens_mask, mask.astype(bool))
+        m2 = lens_mask if mask is None else (
+            jnp.logical_and(lens_mask, mask) if mask.dtype == jnp.bool_
+            else mask + jnp.where(lens_mask, np.float32(0.0), _NEG_INF))
         return _xla_attention(query, key, value, sc, causal, mask=m2,
                               dropout_p=dropout_p, dropout_key=dropout_key)
-    if plausible:
-        return _flash_core(query, key, value, sc, causal)
     return _xla_attention(query, key, value, sc, causal, mask=mask,
                           dropout_p=dropout_p, dropout_key=dropout_key)
 
@@ -718,8 +1027,14 @@ def flash_attention_bshd(query, key, value, attn_mask=None, dropout_p=0.0,
 
     args = [_coerce(query), _coerce(key), _coerce(value)]
     has_mask = attn_mask is not None
+    # the Pallas fast path doesn't produce mask gradients (upstream
+    # flash_attn parity) — a mask that REQUIRES grad (e.g. a learned
+    # relative-position bias) goes to the XLA path where autodiff
+    # differentiates it
+    mask_no_grad = True
     if has_mask:
         args.append(_coerce(attn_mask))
+        mask_no_grad = bool(getattr(attn_mask, "stop_gradient", True))
     has_lens = kv_lens is not None
     if has_lens:
         args.append(_coerce(kv_lens))
@@ -733,7 +1048,8 @@ def flash_attention_bshd(query, key, value, attn_mask=None, dropout_p=0.0,
             q, k, v, causal=is_causal, scale=scale,
             mask=m, kv_lens=lens,
             dropout_p=dropout_p if training else 0.0,
-            dropout_key=key_drop)
+            dropout_key=key_drop,
+            allow_pallas_mask=mask_no_grad)
     return apply(fn, *args, _name="flash_attention")
 
 

@@ -31,6 +31,15 @@ from .auto_parallel_api import (
     shard_optimizer, in_auto_parallel_align_mode, Strategy, to_static,
 )
 from . import auto_parallel_api as auto_parallel
+
+# make the upstream module paths importable (`from paddle.distributed.
+# auto_parallel.static.engine import Engine`): the alias modules must be
+# registered with the import system, not just bound as attributes
+import sys as _sys
+_sys.modules[__name__ + ".auto_parallel"] = auto_parallel
+_sys.modules[__name__ + ".auto_parallel.static"] = auto_parallel.static
+_sys.modules[__name__ + ".auto_parallel.static.engine"] = (
+    auto_parallel.static.engine)
 from . import checkpoint
 from . import rpc
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model

@@ -314,23 +314,8 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
             self._mode = "predict"
 
         def _strategy_kwargs(self):
-            st = self._strategy
-            kw = {}
-            if st is None:
-                return kw
-            import warnings
-            if getattr(st.sharding, "enable", False):
-                kw["sharding_stage"] = int(st.sharding.stage)
-            if getattr(st.amp, "enable", False):
-                from ..amp import GradScaler
-                kw["scaler"] = GradScaler()
-            for name in ("gradient_merge", "fused_passes"):
-                if getattr(getattr(st, name), "enable", False):
-                    warnings.warn(
-                        f"dist.to_static: Strategy.{name} is not applied "
-                        "here (XLA performs pass fusion; accumulate via "
-                        "pipeline accumulate_steps)", stacklevel=2)
-            return kw
+            from .auto_parallel_static import _strategy_step_kwargs
+            return _strategy_step_kwargs(self._strategy)
 
         def __call__(self, *batch):
             n_in = max(len(batch) - 1, 1)
@@ -363,3 +348,9 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
             return None  # PIR program introspection — XLA owns the graph
 
     return DistModel()
+
+
+# static Engine (reference: auto_parallel/static/engine.py) — importable
+# as dist.auto_parallel.static.Engine / ...static.engine.Engine
+from . import auto_parallel_static as static          # noqa: E402
+from .auto_parallel_static import Engine              # noqa: E402

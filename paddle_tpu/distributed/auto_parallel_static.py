@@ -1,0 +1,272 @@
+"""Auto-parallel static Engine.
+
+Reference parity: python/paddle/distributed/auto_parallel/static/engine.py
+(Engine: the fit/evaluate/predict entry of the auto-parallel static
+graph). TPU-native design: the reference builds a distributed static
+program (dist ops + reshard passes) and drives an executor; here the
+"static program" is the jitted SPMD step that DistTrainStep compiles
+over the device mesh — one XLA program per mode, shardings from the
+model's shard_tensor annotations plus the Strategy's ZeRO stage. The
+Engine is the epoch/metric/checkpoint loop around those compiled steps.
+
+Importable as paddle.distributed.auto_parallel.static.Engine (and
+...static.engine.Engine, mirroring the upstream module path).
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Engine"]
+
+
+def _strategy_step_kwargs(strategy):
+    """Map a dist.Strategy onto DistTrainStep kwargs (shared with
+    dist.to_static's DistModel)."""
+    kw = {}
+    if strategy is None:
+        return kw
+    import warnings
+    if getattr(getattr(strategy, "sharding", None), "enable", False):
+        kw["sharding_stage"] = int(strategy.sharding.stage)
+    if getattr(getattr(strategy, "amp", None), "enable", False):
+        from ..amp import GradScaler
+        kw["scaler"] = GradScaler()
+    for name in ("gradient_merge", "fused_passes"):
+        cfg = getattr(strategy, name, None)
+        if cfg is not None and getattr(cfg, "enable", False):
+            warnings.warn(
+                f"auto_parallel Engine: Strategy.{name} is not applied "
+                "here (XLA performs pass fusion; accumulate via "
+                "pipeline accumulate_steps)", stacklevel=3)
+    return kw
+
+
+class Engine:
+    """Auto-parallel training/eval/predict engine (reference:
+    auto_parallel/static/engine.py Engine).
+
+    engine = Engine(model, loss, optimizer, metrics, strategy=strategy)
+    history = engine.fit(train_data, epochs=2, batch_size=8)
+    result = engine.evaluate(valid_data)
+    outs = engine.predict(test_data)
+
+    Data may be a paddle_tpu.io.Dataset (wrapped in a DataLoader with
+    `batch_size`), an existing DataLoader/iterable of batches, or a
+    tuple/list of arrays forming ONE batch. Each sample/batch is a
+    sequence; `*_sample_split` gives the number of leading elements
+    that are model inputs (default: all but the last, which is the
+    loss/metric label — the reference's (inputs, labels) contract).
+    """
+
+    def __init__(self, model=None, loss=None, optimizer=None,
+                 metrics=None, cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._opt = optimizer
+        from ..metric import Metric
+        ms = metrics if metrics is not None else []
+        self._metrics = list(ms) if isinstance(ms, (list, tuple)) else [ms]
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(
+                    f"metrics must be paddle.metric.Metric, got {type(m)}")
+        self._cluster = cluster  # accepted for signature parity; the
+        # device topology comes from the mesh (jax.devices)
+        self._strategy = strategy
+        self._train_step = None
+        self.history = None
+
+    # ------------------------------------------------------------ data --
+    def _loader(self, data, batch_size, sample_split, collate_fn=None):
+        """Yield (inputs_tuple, labels_tuple) batches."""
+        from ..io import DataLoader, Dataset, IterableDataset
+        from ..tensor import Tensor
+        if data is None or (isinstance(data, (tuple, list))
+                            and len(data) == 0):
+            return
+        if isinstance(data, (Dataset, IterableDataset)):
+            data = DataLoader(data, batch_size=batch_size,
+                              collate_fn=collate_fn)
+        elif isinstance(data, (tuple, list)) and not isinstance(
+                data[0], (tuple, list)):
+            data = [tuple(data)]  # a single ready-made batch
+        for batch in data:
+            if isinstance(batch, (Tensor, np.ndarray)):
+                batch = (batch,)
+            batch = tuple(batch)
+            split = (len(batch) - 1 if sample_split is None
+                     else int(sample_split))
+            split = max(1, min(split, len(batch)))
+            yield batch[:split], batch[split:]
+
+    def _ensure_train_step(self, n_inputs):
+        if self._train_step is not None:
+            return self._train_step
+        if self._loss is None or self._opt is None:
+            raise RuntimeError(
+                "Engine.fit needs loss and optimizer: "
+                "Engine(model, loss, optimizer, ...)")
+        from .fleet.dist_step import DistTrainStep
+        from .mesh import ensure_mesh
+        self._train_step = DistTrainStep(
+            self._model, self._opt,
+            (lambda out, *lbl: self._loss(out, *lbl)),
+            n_model_inputs=n_inputs, mesh=ensure_mesh(),
+            **_strategy_step_kwargs(self._strategy))
+        return self._train_step
+
+    # ------------------------------------------------------------- fit --
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
+            save_freq=1, valid_data=None, valid_sample_split=None,
+            valid_freq=1, valid_steps=None, collate_fn=None,
+            callbacks=None, verbose=1):
+        if callbacks:
+            import warnings
+            warnings.warn(
+                "auto_parallel Engine.fit: callbacks are not invoked "
+                "here; use paddle.Model (hapi) for the callback "
+                "protocol", stacklevel=2)
+        # history keys: 'loss' per epoch; metric results (computed on
+        # valid_data) land under 'eval_<name>'
+        history = {"loss": []}
+        for epoch in range(epochs):
+            t0 = time.time()
+            losses = []
+            for step, (ins, lbls) in enumerate(self._loader(
+                    train_data, batch_size, train_sample_split,
+                    collate_fn)):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                trainer = self._ensure_train_step(len(ins))
+                self._last_batch = (*ins, *lbls)
+                loss = trainer(*ins, *lbls)
+                losses.append(float(np.asarray(loss.numpy())))
+                if verbose and log_freq and step % log_freq == 0:
+                    print(f"[auto_parallel Engine] epoch {epoch} "
+                          f"step {step} loss {losses[-1]:.6f}",
+                          file=sys.stderr)
+            epoch_loss = float(np.mean(losses)) if losses else float("nan")
+            history["loss"].append(epoch_loss)
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                ev = self.evaluate(valid_data, valid_sample_split,
+                                   batch_size, steps=valid_steps,
+                                   collate_fn=collate_fn, verbose=0)
+                for k, v in ev.items():
+                    history.setdefault(k, []).append(v)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch{epoch}", training=True)
+            if verbose:
+                print(f"[auto_parallel Engine] epoch {epoch} done "
+                      f"loss {epoch_loss:.6f} "
+                      f"({time.time() - t0:.1f}s)", file=sys.stderr)
+        self.history = history
+        return history
+
+    # -------------------------------------------------------- evaluate --
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, verbose=1):
+        if self._model is None:
+            raise RuntimeError("Engine has no model")
+        from .. import no_grad
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        with no_grad():
+            for step, (ins, lbls) in enumerate(self._loader(
+                    valid_data, batch_size, valid_sample_split,
+                    collate_fn)):
+                if steps is not None and step >= steps:
+                    break
+                out = self._model(*ins)
+                if self._loss is not None and lbls:
+                    losses.append(float(np.asarray(
+                        self._loss(out, *lbls).numpy())))
+                for m in self._metrics:
+                    # Metric.compute may return one tensor or a tuple;
+                    # update() receives it unsplatted-unless-tuple
+                    # (upstream hapi's to_list semantics)
+                    r = m.compute(out, *lbls)
+                    m.update(*r) if isinstance(r, (tuple, list)) \
+                        else m.update(r)
+        result = {}
+        if losses:
+            result["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, (list, tuple)):
+                for n, a in zip(name, acc if isinstance(
+                        acc, (list, tuple)) else [acc]):
+                    result[f"eval_{n}"] = a
+            else:
+                result[f"eval_{name}"] = acc
+        return result
+
+    # --------------------------------------------------------- predict --
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, verbose=0):
+        if self._model is None:
+            raise RuntimeError("Engine has no model")
+        from .. import no_grad
+        outs = []
+        with no_grad():
+            # same (inputs, labels) split convention as fit/evaluate:
+            # a trailing label in the test data is simply ignored
+            for step, (ins, _lbls) in enumerate(self._loader(
+                    test_data, batch_size, test_sample_split,
+                    collate_fn)):
+                if steps is not None and step >= steps:
+                    break
+                outs.append(self._model(*ins))
+        return outs
+
+    # ------------------------------------------------------- save/load --
+    def save(self, path, training=True):
+        """Save model (and optimizer accumulators when training=True) —
+        reference Engine.save semantics over framework_io."""
+        from .. import save as pd_save
+        pd_save(self._model.state_dict(), path + ".pdparams")
+        if training and self._opt is not None and hasattr(
+                self._opt, "state_dict"):
+            pd_save(self._opt.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from .. import load as pd_load
+        self._model.set_state_dict(pd_load(path + ".pdparams"))
+        if load_optimizer and self._opt is not None and hasattr(
+                self._opt, "set_state_dict"):
+            try:
+                self._opt.set_state_dict(pd_load(path + ".pdopt"))
+            except FileNotFoundError:
+                pass
+        # a loaded state invalidates the compiled step's captured state
+        self._train_step = None
+
+    # ----------------------------------------------------------- misc --
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Upstream pre-builds the program per mode; jit compiles lazily
+        at first call, so prepare only validates the configuration."""
+        if mode == "train" and (self._loss is None or self._opt is None):
+            raise RuntimeError("train mode needs loss and optimizer")
+        return self
+
+    def cost(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Static cost model (reference: Engine.cost's estimated global
+        cost): XLA's cost_analysis of the compiled hybrid step at the
+        last-seen batch signature — e.g. cost()["flops"]. None until
+        fit() has run a step."""
+        step = self._train_step
+        batch = getattr(self, "_last_batch", None)
+        if step is None or batch is None:
+            return None
+        return step.cost_analysis(*batch)
+
+
+# upstream path parity: paddle.distributed.auto_parallel.static.engine
+# is a module whose attribute Engine is this class
+engine = sys.modules[__name__]

@@ -209,11 +209,37 @@ class DistTrainStep:
             with mesh_scope(mesh_):
                 return jitted(p_vals, b_vals, opt_state, key, lr, arrays,
                               scaler_st)
+        run._jitted = jitted  # for cost_analysis (lower without running)
         return run
 
     @property
     def opt_state(self):
         return self._opt_state
+
+    def cost_analysis(self, *batch):
+        """XLA's cost model for the whole hybrid-parallel step
+        (fwd+bwd+update) at this batch signature — same contract as
+        TrainStep.cost_analysis: reads the LOWERED module (no backend
+        compile/execute)."""
+        arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        if sig not in self._compiled:
+            self._compiled[sig] = self._build(self._batch_shardings(arrays))
+        from ...amp.grad_scaler import scaler_state_in
+        sc_in = (scaler_state_in(self._scaler)
+                 if self._scaler is not None else ())
+        gen = default_generator()
+        with mesh_scope(self._mesh):
+            lowered = self._compiled[sig]._jitted.lower(
+                [p._value for p in self._p], [b._value for b in self._b],
+                self._opt_state, gen.split(),
+                jnp.asarray(self._opt.get_lr(), jnp.float32), arrays,
+                sc_in)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return ca
 
     def __call__(self, *batch):
         arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)

@@ -45,9 +45,128 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler writing a chrome-trace JSON of the capture
+    into dir_name (parity: paddle.profiler.export_chrome_tracing)."""
     def handler(prof):
-        prof._export_dir = dir_name
+        os.makedirs(dir_name, exist_ok=True)
+        if getattr(prof, "_stats", None) is not None:
+            prof._stats.to_chrome_trace(os.path.join(
+                dir_name, (worker_name or "worker") + ".json"))
     return handler
+
+
+class _OpStat:
+    __slots__ = ("calls", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self):
+        self.calls = 0
+        self.total_ns = 0.0
+        self.max_ns = 0.0
+        self.min_ns = float("inf")
+
+    def add(self, dur_ns):
+        self.calls += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = min(self.min_ns, dur_ns)
+
+
+class _TraceStats:
+    """Op-level statistics parsed from the captured XPlane (reference:
+    the operator/kernel summary tables of python/paddle/profiler/
+    profiler_statistic.py). jax.profiler.ProfileData reads the .pb
+    natively — no TF proto dependency.
+
+    Host side = the trace's `python`/host lines (op dispatch, user
+    RecordEvent scopes); device side = every other line (XLA op/kernel
+    executions: the PjRt client lines on CPU, /device:TPU planes on
+    real hardware)."""
+
+    def __init__(self, trace_dir):
+        import glob
+        self.host = {}
+        self.device = {}
+        self.events = []   # (side, line, name, start_ns, dur_ns)
+        for pb in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                            recursive=True):
+            pd = jax.profiler.ProfileData.from_file(pb)
+            for plane in pd.planes:
+                for line in plane.lines:
+                    host_side = (line.name == "python"
+                                 or plane.name.startswith("/host")
+                                 and "PjRt" not in line.name
+                                 and "xla" not in line.name.lower())
+                    table = self.host if host_side else self.device
+                    for ev in line.events:
+                        dur = float(ev.duration_ns or 0.0)
+                        name = ev.name
+                        if dur <= 0.0:
+                            continue
+                        table.setdefault(name, _OpStat()).add(dur)
+                        self.events.append(
+                            ("host" if host_side else "device", line.name,
+                             name, float(ev.start_ns or 0.0), dur))
+
+    _SORT_FIELD = {
+        "CPUTotal": ("host", "total_ns"), "CPUAvg": ("host", "avg"),
+        "CPUMax": ("host", "max_ns"), "CPUMin": ("host", "min_ns"),
+        "GPUTotal": ("device", "total_ns"), "GPUAvg": ("device", "avg"),
+        "GPUMax": ("device", "max_ns"), "GPUMin": ("device", "min_ns"),
+    }
+
+    def rows(self, side, sort_field="total_ns", descending=True):
+        table = self.host if side == "host" else self.device
+        def key(item):
+            st = item[1]
+            return (st.total_ns / st.calls if sort_field == "avg"
+                    else getattr(st, sort_field))
+        return sorted(table.items(), key=key, reverse=descending)
+
+    def format_table(self, sorted_by=None, time_unit="ms", limit=None):
+        unit_div = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
+        sb = (sorted_by.name if isinstance(sorted_by, enum.Enum)
+              else sorted_by or "CPUTotal")
+        side_pref, field = self._SORT_FIELD.get(sb, ("host", "total_ns"))
+        out = []
+        for side, title in (("host", "Host (python ops / user scopes)"),
+                            ("device", "Device / XLA kernels")):
+            rows = self.rows(side, field if side == side_pref
+                             else "total_ns")
+            if limit:
+                rows = rows[:limit]
+            if not rows:
+                continue
+            w = max([len(n) for n, _ in rows[:40]] + [24])
+            w = min(w, 60)
+            out.append(f"---- {title} " + "-" * max(8, 70 - len(title)))
+            out.append(f"{'Name':<{w}}  {'Calls':>6}  {'Total':>10}  "
+                       f"{'Avg':>10}  {'Max':>10}  {'Min':>10}  "
+                       f"({time_unit})")
+            for name, st in rows:
+                nm = name if len(name) <= w else name[:w - 3] + "..."
+                out.append(
+                    f"{nm:<{w}}  {st.calls:>6}  "
+                    f"{st.total_ns / unit_div:>10.4f}  "
+                    f"{st.total_ns / st.calls / unit_div:>10.4f}  "
+                    f"{st.max_ns / unit_div:>10.4f}  "
+                    f"{st.min_ns / unit_div:>10.4f}")
+        return "\n".join(out) if out else "(empty trace)"
+
+    def to_chrome_trace(self, path):
+        """Write a chrome://tracing / Perfetto-loadable JSON with every
+        event (user RecordEvent scopes included)."""
+        import json
+        pids = {}
+        evs = []
+        for side, line, name, start_ns, dur_ns in self.events:
+            pid = pids.setdefault(side, len(pids))
+            evs.append({"ph": "X", "pid": pid, "tid": line, "name": name,
+                        "ts": start_ns / 1e3, "dur": dur_ns / 1e3})
+        meta = [{"ph": "M", "pid": p, "name": "process_name",
+                 "args": {"name": s}} for s, p in pids.items()]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": meta + evs}, f)
+        return path
 
 
 class Profiler:
@@ -60,6 +179,7 @@ class Profiler:
         self._running = False
         self._step = 0
         self._export_dir = None
+        self._stats: Optional[_TraceStats] = None
 
     def start(self):
         if self._timer_only:
@@ -70,9 +190,16 @@ class Profiler:
         self._running = True
 
     def stop(self):
-        if self._running and not self._timer_only:
+        if not self._running:
+            return  # idempotent: explicit stop() inside a with-block
+        if not self._timer_only:
             jax.profiler.stop_trace()
         self._running = False
+        if self._dir is not None:
+            try:
+                self._stats = _TraceStats(self._dir)
+            except Exception:   # stats are best-effort; the raw trace
+                self._stats = None  # dir remains the artifact of record
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -90,12 +217,35 @@ class Profiler:
         self.stop()
         return False
 
+    @property
+    def stats(self) -> Optional["_TraceStats"]:
+        """Parsed op-level statistics (None for timer_only runs)."""
+        return self._stats
+
     def export(self, path, format="json"):
-        return self._dir
+        """Write a chrome-trace JSON (format='json'; RecordEvent scopes
+        included) or return the raw XPlane trace dir (format='pb')."""
+        if format != "json":
+            return self._dir
+        if self._stats is None:
+            raise RuntimeError(
+                "no parsed trace to export: the profiler ran timer_only, "
+                "was never stopped, or stats parsing failed "
+                f"(raw trace dir: {self._dir})")
+        return self._stats.to_chrome_trace(path)
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        return f"trace dir: {self._dir} (open in TensorBoard/Perfetto)"
+        """Op-level host + device tables (name, calls, total/avg/max/min),
+        sorted per SortedKeys — printable, like upstream's
+        profiler.summary()."""
+        if self._stats is None:
+            return (f"trace dir: {self._dir} (no parsed stats; open in "
+                    "TensorBoard/Perfetto)")
+        head = f"trace dir: {self._dir}\n"
+        return head + self._stats.format_table(
+            sorted_by=sorted_by, time_unit=time_unit,
+            limit=None if op_detail else 20)
 
 
 class RecordEvent:
@@ -124,7 +274,16 @@ class RecordEvent:
 
 
 def load_profiler_result(filename):
-    raise NotImplementedError("open the trace directory in TensorBoard")
+    """Parse a previously captured trace (a trace dir or a directory
+    containing *.xplane.pb) into op-level stats (parity:
+    paddle.profiler.load_profiler_result)."""
+    root = filename if os.path.isdir(filename) \
+        else os.path.dirname(filename) or "."
+    stats = _TraceStats(root)
+    if not stats.events:
+        raise FileNotFoundError(
+            f"no *.xplane.pb trace found under {root!r}")
+    return stats
 
 
 class SortedKeys(enum.Enum):

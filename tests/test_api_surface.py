@@ -104,6 +104,8 @@ SURFACE = {
         broadcast_object_list scatter_object_list
         auto_parallel in_auto_parallel_align_mode unshard_dtensor
         shard_optimizer to_static Strategy""",
+    "distributed.auto_parallel": """ProcessMesh shard_tensor reshard
+        Engine static Strategy to_static""",
     "io": """Dataset IterableDataset TensorDataset DataLoader
         BatchSampler DistributedBatchSampler RandomSampler
         SequenceSampler WeightedRandomSampler SubsetRandomSampler

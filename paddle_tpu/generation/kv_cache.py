@@ -61,3 +61,64 @@ def static_cache_update(entry: StaticCacheEntry, k, v):
     k_new = apply(upd, entry.k, k, entry.pos, _name="kv_cache_update")
     v_new = apply(upd, entry.v, v, entry.pos, _name="kv_cache_update")
     return k_new, v_new, StaticCacheEntry(k_new, v_new, entry.pos)
+
+
+class PagedCacheEntry(NamedTuple):
+    """Per-layer paged KV cache (reference parity: the block KV layout of
+    paddle/phi/kernels/fusion/gpu block_multihead_attention / vLLM).
+
+    `k_pages`/`v_pages`: [num_pages, page_size, n_kv_heads, head_dim];
+    `block_table`: [B, pages_per_seq] int32 page ids per slot;
+    `context_lens`: [B] int32 tokens already cached per slot (BEFORE the
+    token being decoded).
+    """
+    k_pages: object
+    v_pages: object
+    block_table: object
+    context_lens: object
+
+
+class PagedKVCache:
+    """A list of per-layer PagedCacheEntry, passed as `past_key_values`."""
+
+    def __init__(self, entries: List[PagedCacheEntry]):
+        self.entries = entries
+
+    def __len__(self):
+        return len(self.entries)
+
+    def __getitem__(self, i):
+        return self.entries[i]
+
+    def __iter__(self):
+        return iter(self.entries)
+
+
+def paged_cache_update_attend(entry: PagedCacheEntry, q, k, v, scale=None):
+    """Decode-step contract for the paged cache: write this step's K/V
+    (one token per slot) into each slot's current page position, then
+    attend the query token against the slot's pages with the paged
+    Pallas kernel. q: [B, 1, H, D]; k/v: [B, 1, Hkv, D] → (out
+    [B, 1, H, D], updated entry). Gradients are not defined (serving
+    path)."""
+    import jax.numpy as jnp
+    from ..ops._dispatch import apply
+    from ..kernels.paged_attention import paged_attention
+
+    def fn(kp, vp, bt, cl, qv, kv, vv):
+        bsz = qv.shape[0]
+        page = kp.shape[1]
+        rows = jnp.arange(bsz)
+        pidx = bt[rows, (cl // page).astype(jnp.int32)]
+        off = (cl % page).astype(jnp.int32)
+        kp2 = kp.at[pidx, off].set(kv[:, 0].astype(kp.dtype))
+        vp2 = vp.at[pidx, off].set(vv[:, 0].astype(vp.dtype))
+        out = paged_attention(qv[:, 0], kp2, vp2, bt, cl + 1, scale)
+        return out[:, None].astype(qv.dtype), kp2, vp2
+
+    out, kp2, vp2 = apply(fn, entry.k_pages, entry.v_pages,
+                          entry.block_table, entry.context_lens, q, k, v,
+                          _name="paged_attention_decode")
+    new_entry = PagedCacheEntry(kp2, vp2, entry.block_table,
+                                entry.context_lens)
+    return out, new_entry

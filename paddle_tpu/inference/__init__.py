@@ -395,3 +395,230 @@ class SpeculativePredictor:
                 if self.eos_token_id is not None and t == self.eos_token_id:
                     return new
         return new
+
+
+class PagedKVPool:
+    """Host-side page allocator over the device-resident paged KV arrays
+    (reference parity: the block manager of PaddleNLP's serving /
+    vLLM's BlockSpaceManager). Pages are shared by all slots; the free
+    list lives on host, the page contents on device."""
+
+    def __init__(self, n_layers, num_pages, page_size, n_kv_heads,
+                 head_dim, dtype="float32"):
+        import jax.numpy as jnp
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        shape = (num_pages, page_size, n_kv_heads, head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        self._free = list(range(num_pages))
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    def alloc(self, n):
+        """n page ids, or None if the pool can't satisfy the request."""
+        if n > len(self._free):
+            return None
+        got, self._free = self._free[:n], self._free[n:]
+        return got
+
+    def release(self, ids):
+        self._free.extend(ids)
+
+
+class ContinuousBatchingPredictor:
+    """Continuous-batching LLM server loop (reference parity: the
+    PaddleNLP inference server's in-flight batching over
+    block_multihead_attention).
+
+    Fixed decode slots share one paged KV pool. Requests are admitted
+    into free slots (prefill via the model's standard forward, KV
+    written into freshly allocated pages), every decode step advances
+    ALL active slots with ONE compiled [B, 1] forward through the paged
+    attention kernel, and finished sequences (eos / max tokens / pool
+    exhausted) are evicted mid-flight — their pages return to the pool
+    and the slot admits the next queued request without draining the
+    batch. The decode step compiles ONCE (static shapes); prefill
+    compiles per prompt-length bucket.
+
+    Greedy decoding (argmax), matching model.generate's default."""
+
+    def __init__(self, model, max_batch_size=4, page_size=16,
+                 num_pages=None, max_seq_len=512, pad_token_id=0,
+                 eos_token_id=None, kv_dtype=None):
+        import math as _m
+        model.eval()
+        if kv_dtype is None:
+            # KV pages match the model's compute dtype (a bf16 model
+            # must not pay fp32 page bandwidth)
+            kv_dtype = str(next(iter(model.parameters())).dtype)
+        self.model = model
+        cfg = model.config
+        self.B = int(max_batch_size)
+        self.page = int(page_size)
+        self.max_seq_len = int(max_seq_len)
+        self.pages_per_seq = _m.ceil(max_seq_len / page_size)
+        if num_pages is None:
+            num_pages = self.B * self.pages_per_seq
+        self.pad_token_id = pad_token_id
+        self.eos_token_id = eos_token_id
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.pool = PagedKVPool(cfg.num_hidden_layers, num_pages + 1,
+                                page_size, cfg.num_key_value_heads,
+                                head_dim, dtype=kv_dtype)
+        # inactive slots need somewhere harmless to point their block
+        # table (the decode step writes one K/V row for EVERY slot):
+        # a dedicated trash page absorbs those writes
+        self._trash = self.pool.alloc(1)[0]
+        self.stats = {"prefills": 0, "decode_steps": 0, "evictions": 0,
+                      "max_in_flight": 0}
+
+    # ---------------------------------------------------------- prefill --
+    def _prefill(self, prompt):
+        """Run the prompt through the standard forward; returns (first
+        token, per-layer K/V [L, Hkv, D])."""
+        import numpy as np
+        from ..tensor import Tensor
+        from .._grad_mode import no_grad
+        L = len(prompt)
+        bucket = LLMPredictor._bucket(L)
+        ids = np.full((1, bucket), self.pad_token_id, np.int32)
+        ids[0, bucket - L:] = prompt
+        pos = np.zeros((1, bucket), np.int32)
+        pos[0, bucket - L:] = np.arange(L)
+        mask = np.zeros((1, 1, bucket, bucket), np.float32)
+        mask[0, 0, :, :bucket - L] = -1e30          # padding columns
+        tri = np.triu(np.full((bucket, bucket), -1e30, np.float32), 1)
+        mask[0, 0] += tri                            # causal
+        with no_grad():
+            logits, caches = self.model(
+                Tensor(ids), attn_mask=Tensor(mask),
+                position_ids=Tensor(pos), use_cache=True)
+        first = int(np.asarray(logits.numpy())[0, -1].argmax())
+        kvs = []
+        for (k, v) in caches:
+            kvs.append((np.asarray(k.numpy())[0, bucket - L:],
+                        np.asarray(v.numpy())[0, bucket - L:]))
+        self.stats["prefills"] += 1
+        return first, kvs
+
+    def _write_prefill_pages(self, kvs, page_ids, L):
+        """Scatter a prompt's prefill K/V into its allocated pages."""
+        import jax.numpy as jnp
+        import numpy as np
+        n = len(page_ids)
+        padded = n * self.page
+        idx = jnp.asarray(page_ids, jnp.int32)
+        for li, (k, v) in enumerate(kvs):
+            kp = np.zeros((n, self.page) + k.shape[1:], k.dtype)
+            kp.reshape(padded, *k.shape[1:])[:L] = k
+            vp = np.zeros_like(kp)
+            vp.reshape(padded, *v.shape[1:])[:L] = v
+            self.pool.k[li] = self.pool.k[li].at[idx].set(
+                jnp.asarray(kp).astype(self.pool.k[li].dtype))
+            self.pool.v[li] = self.pool.v[li].at[idx].set(
+                jnp.asarray(vp).astype(self.pool.v[li].dtype))
+
+    # ------------------------------------------------------------ serve --
+    def generate(self, prompts, max_new_tokens=32):
+        """Continuous batching over a stream of prompts: List[List[int]]
+        → List[List[int]] (new tokens per prompt, in request order).
+        Sequences join and leave the running batch mid-flight."""
+        import numpy as np
+        from ..tensor import Tensor
+        from .._grad_mode import no_grad
+        from ..generation.kv_cache import PagedCacheEntry, PagedKVCache
+
+        queue = list(range(len(prompts)))
+        results = [None] * len(prompts)
+        # slot state (host): -1 = free
+        slot_req = [-1] * self.B
+        slot_pages = [[] for _ in range(self.B)]
+        slot_new = [[] for _ in range(self.B)]
+        tables = np.full((self.B, self.pages_per_seq), self._trash,
+                         np.int32)
+        ctx = np.ones((self.B,), np.int32)   # inactive slots: 1 dummy tok
+        last_tok = np.zeros((self.B,), np.int32)
+
+        def evict(b):
+            r = slot_req[b]
+            results[r] = slot_new[b]
+            self.pool.release(slot_pages[b])
+            slot_req[b], slot_pages[b], slot_new[b] = -1, [], []
+            tables[b, :] = self._trash
+            ctx[b] = 1
+            self.stats["evictions"] += 1
+
+        def admit(b):
+            while queue:
+                r = queue[0]
+                prompt = prompts[r]
+                if len(prompt) + max_new_tokens > self.max_seq_len:
+                    queue.pop(0)
+                    results[r] = []      # over-long request: rejected
+                    continue
+                need = -(-(len(prompt) + max_new_tokens) // self.page)
+                pages = self.pool.alloc(need)
+                if pages is None:
+                    return               # pool full: wait for evictions
+                queue.pop(0)
+                first, kvs = self._prefill(prompt)
+                self._write_prefill_pages(kvs, pages, len(prompt))
+                slot_req[b], slot_pages[b] = r, pages
+                slot_new[b] = [first]
+                tables[b, :len(pages)] = pages
+                ctx[b] = len(prompt)
+                last_tok[b] = first
+                if (self.eos_token_id is not None
+                        and first == self.eos_token_id):
+                    slot_new[b] = []     # parity: eos is stripped
+                    evict(b)
+                    continue
+                if len(slot_new[b]) >= max_new_tokens:
+                    evict(b)             # budget met at admission
+                    continue
+                return
+
+        while queue or any(r >= 0 for r in slot_req):
+            for b in range(self.B):
+                if slot_req[b] < 0:
+                    admit(b)
+            active = [b for b in range(self.B) if slot_req[b] >= 0]
+            if not active:
+                break
+            self.stats["max_in_flight"] = max(self.stats["max_in_flight"],
+                                              len(active))
+            # ONE compiled step advances every active slot
+            entries = [PagedCacheEntry(self.pool.k[li], self.pool.v[li],
+                                       Tensor(tables), Tensor(ctx))
+                       for li in range(len(self.pool.k))]
+            with no_grad():
+                logits, caches = self.model(
+                    Tensor(last_tok[:, None]),
+                    position_ids=Tensor(ctx[:, None].astype(np.int32)),
+                    past_key_values=PagedKVCache(entries), use_cache=True)
+            for li, e in enumerate(caches):
+                kp, vp = e.k_pages, e.v_pages
+                self.pool.k[li] = getattr(kp, "_value", kp)
+                self.pool.v[li] = getattr(vp, "_value", vp)
+            self.stats["decode_steps"] += 1
+            nxt = np.asarray(logits.numpy())[:, -1].argmax(-1)
+            ctx[active] += 1
+            for b in active:
+                t = int(nxt[b])
+                slot_new[b].append(t)
+                last_tok[b] = t
+                done = (len(slot_new[b]) >= max_new_tokens
+                        or (self.eos_token_id is not None
+                            and t == self.eos_token_id))
+                if done:
+                    if (self.eos_token_id is not None
+                            and t == self.eos_token_id):
+                        slot_new[b].pop()
+                    evict(b)
+        for r, res in enumerate(results):
+            if res is None:
+                results[r] = []
+        return results

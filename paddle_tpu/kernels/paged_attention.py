@@ -169,3 +169,145 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                                        context_lens, sc, interpret=interpret)
     return _paged_attention_xla(q, k_pages, v_pages, block_tables,
                                 context_lens, sc)
+
+
+# ---------------------------------------------------------------------------
+# Ragged variant: the grid runs over ONLY the valid (sequence, page)
+# pairs (cf. PAPERS.md "Ragged Paged Attention"): no wasted DMA or
+# compute for short sequences in a mixed-length batch. Page metadata is
+# host-built (build_ragged_meta) and enters via scalar prefetch; the
+# flat entry count buckets to a power of two so serving steps reuse the
+# compiled kernel.
+# ---------------------------------------------------------------------------
+
+def build_ragged_meta(block_tables, context_lens, page_size, bucket_to=None):
+    """Flatten per-sequence page lists into kernel metadata.
+
+    block_tables: [B, pages_per_seq] int (host); context_lens: [B] int
+    (host). Returns dict of int32 arrays of length G (bucketed):
+    seq (owning sequence), page (physical page id), ordinal (page index
+    within its sequence), first/last (1 at a sequence's first/last
+    page), valid (0 on padding entries). Padding entries sit at the
+    end and are fully skipped by the kernel."""
+    bt = np.asarray(block_tables)
+    cl = np.asarray(context_lens)
+    seqs, pages, ords, firsts, lasts = [], [], [], [], []
+    for b in range(bt.shape[0]):
+        n = int(-(-int(cl[b]) // page_size)) if int(cl[b]) > 0 else 0
+        for j in range(n):
+            seqs.append(b)
+            pages.append(int(bt[b, j]))
+            ords.append(j)
+            firsts.append(1 if j == 0 else 0)
+            lasts.append(1 if j == n - 1 else 0)
+    g = len(seqs)
+    if bucket_to is None:
+        bucket_to = 8
+        while bucket_to < g:
+            bucket_to *= 2
+    if g > bucket_to:
+        raise ValueError(f"{g} page entries exceed bucket {bucket_to}")
+    pad = bucket_to - g
+    # padding entries alias the LAST real entry's seq/page: their output
+    # window then never moves after the final real flush, so the
+    # end-of-grid writeback re-emits that row's already-correct block
+    # (a fill of 0 would drag stale buffer contents into row 0)
+    fill_seq = seqs[-1] if seqs else 0
+    fill_page = pages[-1] if pages else 0
+    mk = lambda xs, fill: np.asarray(xs + [fill] * pad, np.int32)
+    return {
+        "seq": mk(seqs, fill_seq), "page": mk(pages, fill_page),
+        "ordinal": mk(ords, 0),
+        "first": mk(firsts, 0), "last": mk(lasts, 0),
+        "valid": np.asarray([1] * g + [0] * pad, np.int32),
+    }
+
+
+def _ragged_kernel(seq_ref, page_ref, ord_ref, first_ref, last_ref,
+                   valid_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size):
+    g = pl.program_id(0)
+
+    @pl.when(first_ref[g] == 1)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(valid_ref[g] == 1)
+    def _compute():
+        ctx = lens_ref[seq_ref[g]]
+        q = q_ref[0].astype(jnp.float32)   # (H, D)
+        k = k_ref[0].astype(jnp.float32)   # (page, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.sum(q[None, :, :] * k, axis=-1) * np.float32(scale)
+        tok = ord_ref[g] * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        s = jnp.where(tok < ctx, s, _NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))
+        p = jnp.exp(s - m_new[None, :])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=0)
+        acc_scr[:] = (acc_scr[:] * alpha[:, None]
+                      + jnp.sum(p[:, :, None] * v, axis=0))
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(last_ref[g] == 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == np.float32(0.0), np.float32(1.0), l)
+        o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_ragged(q, k_pages, v_pages, context_lens, meta,
+                           scale=None, interpret=False):
+    """Ragged-grid paged decode attention. q: [B, H, D]; meta from
+    build_ragged_meta (same page_size as the pools). Sequences with
+    context_lens == 0 produce zeros. H == Hkv, D % 128 == 0, H % 8 == 0
+    (the fixed-grid `paged_attention` covers the rest)."""
+    b, h, d = q.shape
+    page = k_pages.shape[1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    interpret = interpret or pallas_interpret()
+    G = int(meta["seq"].shape[0])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec((1, h, d),
+                         lambda g, sq, pg, od, fr, ls, va, ln: (sq[g], _Z, _Z)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda g, sq, pg, od, fr, ls, va, ln:
+                         (pg[g], _Z, _Z, _Z)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda g, sq, pg, od, fr, ls, va, ln:
+                         (pg[g], _Z, _Z, _Z)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h, d), lambda g, sq, pg, od, fr, ls, va, ln: (sq[g], _Z, _Z)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_ragged_kernel, scale=sc, page_size=page)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(meta["seq"], jnp.int32),
+      jnp.asarray(meta["page"], jnp.int32),
+      jnp.asarray(meta["ordinal"], jnp.int32),
+      jnp.asarray(meta["first"], jnp.int32),
+      jnp.asarray(meta["last"], jnp.int32),
+      jnp.asarray(meta["valid"], jnp.int32),
+      jnp.asarray(context_lens, jnp.int32),
+      q, k_pages, v_pages)
+    # sequences with no pages never write their output row
+    has = jnp.asarray(context_lens, jnp.int32) > 0
+    return jnp.where(has[:, None, None], out, 0)

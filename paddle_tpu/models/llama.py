@@ -39,7 +39,8 @@ from ..distributed.fleet.meta_parallel.mp_layers import (
     parallel_matmul, mark_partition)
 from ..distributed.fleet.recompute import recompute
 from ..generation import GenerationMixin
-from ..generation.kv_cache import StaticCacheEntry, StaticKVCache
+from ..generation.kv_cache import (StaticCacheEntry, StaticKVCache,
+                                   PagedKVCache)
 
 
 @dataclass
@@ -133,6 +134,17 @@ class LlamaAttention(Layer):
             return apply_rotary_emb(qv, kv, cv, sv)
         q, k = apply(rope_fn, q, k, cos, sin, _name="fused_rope")
 
+        from ..generation.kv_cache import PagedCacheEntry
+        if isinstance(past_key_value, PagedCacheEntry):
+            # paged decode cache (serving continuous batching): write the
+            # step's K/V into each slot's page and attend via the paged
+            # Pallas kernel — shared contract,
+            # generation/kv_cache.py paged_cache_update_attend
+            from ..generation.kv_cache import paged_cache_update_attend
+            out, new_cache = paged_cache_update_attend(
+                past_key_value, q, k, v)
+            out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), new_cache
         if isinstance(past_key_value, StaticCacheEntry):
             # static-shape decode cache: in-place write at `pos` (shared
             # contract — generation/kv_cache.py static_cache_update)
@@ -234,7 +246,8 @@ class LlamaModel(Layer):
                 past_key_values=None, use_cache=False):
         h = self.embed_tokens(input_ids)
         s = input_ids.shape[1]
-        static_cache = isinstance(past_key_values, StaticKVCache)
+        static_cache = isinstance(past_key_values,
+                                  (StaticKVCache, PagedKVCache))
         if position_ids is not None:
             # per-row positions (left-padded generation): gather trig rows
             cos = apply(lambda c, p: jnp.take(c, p, axis=0),

@@ -462,13 +462,18 @@ class P2POp:
 
 def batch_isend_irecv(p2p_op_list):
     """Parity: paddle.distributed.batch_isend_irecv. Each send pair
-    compiles to one lax.ppermute over the bound mesh axis. ppermute needs
-    the GLOBAL permutation, but the batch only describes this rank's
-    pairs — so the lowering assumes the batch is shift-uniform (every
-    rank sends to `rank + shift`, the pattern of pipeline/ring
-    exchanges, which is what the reference uses this API for) and
-    expands the full permutation from the local shift. The i-th irecv is
-    matched with the i-th isend's permute output."""
+    compiles to one lax.ppermute over the bound mesh axis. ppermute
+    needs the GLOBAL permutation, but the batch only describes this
+    rank's pairs — so the lowering assumes each pair is shift-uniform
+    (every rank sends to `rank + shift` for that pair's shift).
+
+    Pairs are matched by IMPLIED SHIFT, not list order: an irecv from
+    peer p belongs with the send whose shift is `(me - p) % world`.
+    Multi-shift batches therefore work (e.g. a bidirectional ring
+    exchange: send next + send prev + both recvs, in any order) — the
+    batch lowers to one ppermute per send. Genuinely rank-asymmetric
+    MPMD graphs (different ranks running different code) cannot be
+    expressed in a single-controller SPMD program and still raise."""
     sends = [p for p in p2p_op_list if p.op is isend]
     recvs = [p for p in p2p_op_list if p.op is irecv]
     if not sends or len(sends) != len(recvs):
@@ -478,20 +483,29 @@ def batch_isend_irecv(p2p_op_list):
     from .env import get_rank, get_world_size
     me = get_rank()
     world = get_world_size()
-    tasks = []
-    for s, r in zip(sends, recvs):
-        shift = (s.peer - me) % world
-        if (me - r.peer) % world != shift:
+    # match each recv to an unclaimed send with the same implied shift
+    unclaimed = list(range(len(sends)))
+    pairing = []
+    for r in recvs:
+        want = (me - r.peer) % world
+        for i in unclaimed:
+            if (sends[i].peer - me) % world == want:
+                unclaimed.remove(i)
+                pairing.append((sends[i], r))
+                break
+        else:
             raise RuntimeError(
-                "batch_isend_irecv lowering requires a shift-uniform "
-                f"batch: send peer {s.peer} implies shift {shift}, but "
-                f"the matched irecv expects source {r.peer}")
+                "batch_isend_irecv lowering requires shift-uniform "
+                f"pairs: no isend in the batch has shift {want} to "
+                f"match the irecv from peer {r.peer} (rank-asymmetric "
+                "MPMD patterns cannot lower to collective_permute)")
+    for s, r in pairing:
+        shift = (s.peer - me) % world
         perm = [(rank, (rank + shift) % world) for rank in range(world)]
         out = ppermute(s.tensor, perm)
         if isinstance(r.tensor, Tensor):
             r.tensor._inplace_update(out if isinstance(out, Tensor)
                                      else Tensor(out))
-        tasks.append(out)
 
     class _Task:
         def is_completed(self):

@@ -349,9 +349,45 @@ class PipelineTrainStep:
     def __init__(self, model: PipelineLayer, optimizer, loss_fn: Callable,
                  num_microbatches: int = 1, mesh: Optional[Mesh] = None,
                  n_pre: Optional[int] = None, n_post: Optional[int] = None,
-                 use_remat: bool = True, donate_state: bool = True,
-                 num_virtual_stages: int = 1, zero_stage: int = 0,
-                 scaler=None):
+                 use_remat: Optional[bool] = None, donate_state: bool = True,
+                 num_virtual_stages: Optional[int] = None,
+                 zero_stage: int = 0, scaler=None,
+                 schedule_mode: Optional[str] = None):
+        # Named schedules (reference parity: the schedule_mode strings of
+        # fleet/meta_parallel/pipeline_parallel.py + strategy.pipeline).
+        # Under the scanned-shard_map design XLA owns instruction order,
+        # so a mode selects the configuration whose per-stage MEMORY
+        # bound matches the named schedule (test_pp_memory.py asserts
+        # the bound):
+        #   "1F1B"   -> remat scan, V=1: ≤ S in-flight microbatch
+        #               activations per stage, 1F1B's steady-state bound
+        #   "VPP"    -> interleaved virtual stages (1F1B-interleave)
+        #   "F-then-B"/"FThenB" -> no-remat GPipe: all M activations
+        #               live (the reference's F-then-B memory profile)
+        # Explicitly passed use_remat/num_virtual_stages that CONFLICT
+        # with the named mode raise rather than being silently reset.
+        if schedule_mode is not None:
+            mode = schedule_mode.replace("-", "").replace("_", "").lower()
+            want = {"1f1b": (True, 1),
+                    "vpp": (True, num_virtual_stages
+                            if (num_virtual_stages or 0) > 1 else 2),
+                    "fthenb": (False, num_virtual_stages or 1)}.get(mode)
+            if want is None:
+                raise ValueError(
+                    f"unknown schedule_mode {schedule_mode!r}; expected "
+                    "'1F1B', 'VPP' or 'F-then-B'")
+            for name, given, w in (("use_remat", use_remat, want[0]),
+                                   ("num_virtual_stages",
+                                    num_virtual_stages, want[1])):
+                if given is not None and given != w:
+                    raise ValueError(
+                        f"schedule_mode={schedule_mode!r} implies "
+                        f"{name}={w}, but {name}={given} was passed — "
+                        "drop one of the two")
+            use_remat, num_virtual_stages = want
+        use_remat = True if use_remat is None else use_remat
+        num_virtual_stages = num_virtual_stages or 1
+        self.schedule_mode = schedule_mode
         from ....optimizer.optimizer import Lamb
         if isinstance(optimizer, Lamb):
             raise ValueError(

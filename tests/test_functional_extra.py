@@ -819,6 +819,37 @@ class TestDetectionOpsRound3:
             assert task.is_completed()
         np.testing.assert_allclose(buf.numpy(), x.numpy())
 
+    def test_batch_isend_irecv_multi_shift(self):
+        # round 5: pairs match by implied shift, not list order — a
+        # bidirectional ring exchange in shuffled order must lower (on
+        # the 1-rank eager group both shifts are identity; the pairing
+        # logic is what's under test, plus the asymmetric reject)
+        import paddle_tpu.distributed as dist
+        import pytest
+        a = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        b = paddle.to_tensor(np.arange(4, dtype=np.float32) * 10)
+        ra, rb = paddle.zeros([4]), paddle.zeros([4])
+        tasks = dist.batch_isend_irecv([
+            dist.P2POp(dist.irecv, ra, 0),
+            dist.P2POp(dist.isend, a, 0),
+            dist.P2POp(dist.isend, b, 0),
+            dist.P2POp(dist.irecv, rb, 0),
+        ])
+        assert len(tasks) == 4
+        got = sorted([ra.numpy().sum(), rb.numpy().sum()])
+        assert got == sorted([a.numpy().sum(), b.numpy().sum()])
+        # a recv whose implied shift matches no send must raise — needs
+        # world > 1 for shifts to be distinguishable (mod-1 is all 0)
+        from unittest import mock
+        import paddle_tpu.distributed.env as denv
+        with mock.patch.object(denv, "get_world_size", return_value=4), \
+                mock.patch.object(denv, "get_rank", return_value=0):
+            with pytest.raises(RuntimeError, match="shift"):
+                dist.batch_isend_irecv([
+                    dist.P2POp(dist.isend, a, 1),   # shift +1
+                    dist.P2POp(dist.irecv, ra, 2),  # wants shift +2
+                ])
+
 
 class TestBicubicParity:
     """bicubic interpolate uses the a=-0.75 Keys kernel (torch/paddle);

@@ -56,7 +56,7 @@ def _model(stages):
         num_stages=stages)
 
 
-def _mem(pp, mb, use_remat, virtual=1):
+def _mem(pp, mb, use_remat=None, virtual=None, schedule_mode=None):
     mesh = build_mesh(pp=pp)
     set_mesh(mesh)
     try:
@@ -66,7 +66,8 @@ def _mem(pp, mb, use_remat, virtual=1):
         step = PipelineTrainStep(m, opt, lambda o, t: ((o - t) ** 2).mean(),
                                  num_microbatches=mb, mesh=mesh,
                                  use_remat=use_remat,
-                                 num_virtual_stages=virtual)
+                                 num_virtual_stages=virtual,
+                                 schedule_mode=schedule_mode)
         x = paddle.to_tensor(np.zeros((B, D), np.float32))
         return step.memory_analysis(x, x)
     finally:
@@ -145,3 +146,21 @@ class TestCostAnalysis:
         assert f8 > 0
         # matmul-dominated step: 4x batch => roughly 4x flops
         assert 2.5 < f32 / f8 < 6, (f8, f32)
+
+
+def test_named_schedule_modes():
+    """round 5: schedule_mode strings (reference parity: the
+    fleet pipeline's schedule_mode) select the matching memory config —
+    '1F1B' == remat scan, 'F-then-B' == no-remat, 'VPP' == interleave;
+    unknown names and conflicting explicit knobs are rejected."""
+    m1 = _mem(pp=4, mb=4, schedule_mode="1F1B")
+    mf = _mem(pp=4, mb=4, schedule_mode="F-then-B")
+    assert m1.temp_size_in_bytes < 0.9 * mf.temp_size_in_bytes
+    r1 = _mem(pp=4, mb=4, use_remat=True)
+    assert m1.temp_size_in_bytes == r1.temp_size_in_bytes
+    with pytest.raises(ValueError):
+        _mem(pp=2, mb=2, schedule_mode="zigzag")
+    with pytest.raises(ValueError, match="implies"):
+        _mem(pp=2, mb=2, schedule_mode="1F1B", virtual=4)
+    with pytest.raises(ValueError, match="implies"):
+        _mem(pp=2, mb=2, schedule_mode="F-then-B", use_remat=True)

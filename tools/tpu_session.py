@@ -152,6 +152,11 @@ def main() -> int:
     tv = load(os.path.join(REPO, "tools", "tpu_validate.py"), "tpu_validate")
     results["validate"] = _stage("validate", lambda: tv.main([]))
 
+    # on-chip PP/remat memory evidence (VERDICT r4 #7)
+    pm = load(os.path.join(REPO, "tools", "tpu_pp_memory.py"),
+              "tpu_pp_memory")
+    results["pp_memory"] = _stage("pp_memory", lambda: pm.main([]))
+
     at = load(os.path.join(REPO, "tools", "tpu_autotune_flash.py"),
               "tpu_autotune_flash")
     results["autotune"] = _stage("autotune", lambda: at.main([]))

@@ -447,7 +447,7 @@ class ContinuousBatchingPredictor:
 
     def __init__(self, model, max_batch_size=4, page_size=16,
                  num_pages=None, max_seq_len=512, pad_token_id=0,
-                 eos_token_id=None, kv_dtype=None):
+                 eos_token_id=None, kv_dtype=None, use_ragged="auto"):
         import math as _m
         model.eval()
         if kv_dtype is None:
@@ -474,6 +474,20 @@ class ContinuousBatchingPredictor:
         self._trash = self.pool.alloc(1)[0]
         self.stats = {"prefills": 0, "decode_steps": 0, "evictions": 0,
                       "max_in_flight": 0}
+        # ragged-grid paged attention: only valid (slot, page) pairs
+        # enter the decode kernel's grid. "auto" enables it when the
+        # kernel's constraints hold (H == Hkv, D % 128 == 0, H % 8 == 0)
+        # and a Pallas path exists; the grid buckets to the constant
+        # B * pages_per_seq so every decode step reuses one compile.
+        if use_ragged == "auto":
+            from ..kernels._common import (use_pallas as _use_pallas,
+                                           pallas_interpret)
+            use_ragged = (
+                (cfg.num_attention_heads == cfg.num_key_value_heads)
+                and head_dim % 128 == 0
+                and cfg.num_attention_heads % 8 == 0
+                and (_use_pallas() or pallas_interpret()))
+        self.use_ragged = bool(use_ragged)
 
     # ---------------------------------------------------------- prefill --
     def _prefill(self, prompt):
@@ -591,8 +605,14 @@ class ContinuousBatchingPredictor:
             self.stats["max_in_flight"] = max(self.stats["max_in_flight"],
                                               len(active))
             # ONE compiled step advances every active slot
+            meta = None
+            if self.use_ragged:
+                from ..kernels.paged_attention import build_ragged_meta
+                meta = build_ragged_meta(
+                    tables, ctx + 1, self.page,
+                    bucket_to=self.B * self.pages_per_seq)
             entries = [PagedCacheEntry(self.pool.k[li], self.pool.v[li],
-                                       Tensor(tables), Tensor(ctx))
+                                       Tensor(tables), Tensor(ctx), meta)
                        for li in range(len(self.pool.k))]
             with no_grad():
                 logits, caches = self.model(

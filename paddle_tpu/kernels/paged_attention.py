@@ -191,15 +191,19 @@ def build_ragged_meta(block_tables, context_lens, page_size, bucket_to=None):
     end and are fully skipped by the kernel."""
     bt = np.asarray(block_tables)
     cl = np.asarray(context_lens)
-    seqs, pages, ords, firsts, lasts = [], [], [], [], []
-    for b in range(bt.shape[0]):
-        n = int(-(-int(cl[b]) // page_size)) if int(cl[b]) > 0 else 0
-        for j in range(n):
-            seqs.append(b)
-            pages.append(int(bt[b, j]))
-            ords.append(j)
-            firsts.append(1 if j == 0 else 0)
-            lasts.append(1 if j == n - 1 else 0)
+    # vectorized flatten (this runs on the host before EVERY decode
+    # step in the serving loop — no per-page python iteration)
+    n_pages = np.where(cl > 0, -(-cl // page_size), 0).astype(np.int64)
+    seqs_a = np.repeat(np.arange(bt.shape[0]), n_pages)
+    ords_a = np.concatenate([np.arange(n) for n in n_pages]) \
+        if len(n_pages) else np.zeros(0, np.int64)
+    pages_a = bt[seqs_a, ords_a] if seqs_a.size else seqs_a
+    firsts_a = (ords_a == 0).astype(np.int64)
+    lasts_a = (ords_a == n_pages[seqs_a] - 1).astype(np.int64) \
+        if seqs_a.size else seqs_a
+    seqs, pages = seqs_a.tolist(), pages_a.tolist()
+    ords, firsts, lasts = (ords_a.tolist(), firsts_a.tolist(),
+                           lasts_a.tolist())
     g = len(seqs)
     if bucket_to is None:
         bucket_to = 8

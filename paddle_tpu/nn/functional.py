@@ -566,7 +566,9 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         for i in range(size):
             idx[ch] = slice(i, i + v.shape[ch])
             acc = acc + sq[tuple(idx)]
-        return v / (k + alpha * acc) ** beta
+        # torch/paddle divide the window sum by `size` (both implement
+        # LRN via zero-padded avg_pool — r5 fuzz find)
+        return v / (k + alpha * acc / size) ** beta
     return apply(fn, _coerce(x))
 
 
@@ -601,26 +603,23 @@ def _pool(x, op, init, kernel_size, stride, padding, ndim, channel_last,
     pd = _pair(padding, ndim)
 
     def fn(v):
+        sp_off = 1 if channel_last else 2
         if channel_last:
             window = (1,) + ks + (1,)
             strides = (1,) + st + (1,)
-            pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
         else:
             window = (1, 1) + ks
             strides = (1, 1) + st
-            pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
-        if ceil_mode:
-            # extend upper padding so the last partial window is included
-            pads = list(pads)
-            sp_off = 1 if channel_last else 2
-            for i in range(ndim):
-                d = sp_off + i
-                size = v.shape[d] + 2 * pd[i]
-                rem = (size - ks[i]) % st[i]
-                if rem != 0:
-                    lo, hi = pads[d]
-                    pads[d] = (lo, hi + (st[i] - rem))
-            pads = tuple(pads)
+        base = [(0, 0)] * v.ndim
+        extra = [0] * v.ndim
+        for i in range(ndim):
+            d = sp_off + i
+            base[d] = (pd[i], pd[i])
+            out = _pool_out_size(v.shape[d], ks[i], st[i], pd[i],
+                                 ceil_mode)
+            extra[d] = max(0, (out - 1) * st[i] + ks[i]
+                           - (v.shape[d] + 2 * pd[i]))
+        pads = tuple((lo, hi + e) for (lo, hi), e in zip(base, extra))
         if op == "max":
             return jax.lax.reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min,
                                          jax.lax.max, window, strides, pads)
@@ -630,11 +629,31 @@ def _pool(x, op, init, kernel_size, stride, padding, ndim, channel_last,
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                         strides, pads)
             return s / cnt
-        denom = 1.0
-        for k in ks:
-            denom *= k
-        return s / denom
+        # include-pad divisor counts the window ∩ padded extent: the
+        # base padding counts as cells, the ceil-mode overhang does not
+        # (torch count_include_pad / paddle exclusive=False; r5 fuzz
+        # find — dividing by k**n overcounted overhanging windows)
+        ones_p = jnp.pad(jnp.ones_like(v), tuple(base),
+                         constant_values=1.0)
+        ext = tuple((0, e) for e in extra)
+        cnt = jax.lax.reduce_window(ones_p, 0.0, jax.lax.add, window,
+                                    strides, ext)
+        return s / cnt
     return apply(fn, _coerce(x), _name=f"{op}_pool")
+
+
+def _pool_out_size(n, k, s, p, ceil_mode):
+    """Pooling output extent. ceil_mode allows a last partial window,
+    but a window that would START in the right padding is skipped
+    (torch/paddle rule; r5 fuzz find — naive ceil produced an extra
+    output column for e.g. n=11, k=2, s=2, p=1)."""
+    size = n + 2 * p
+    if ceil_mode:
+        out = -(-(size - k) // s) + 1
+        if (out - 1) * s >= n + p:
+            out -= 1
+        return out
+    return (size - k) // s + 1
 
 
 def _max_pool_idx_raw(v, ks, st, pd, ceil_mode):
@@ -649,17 +668,14 @@ def _max_pool_idx_raw(v, ks, st, pd, ceil_mode):
     pos = jnp.broadcast_to(pos, v.shape)
     window = (1, 1) + ks
     strides = (1, 1) + st
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
-    if ceil_mode:
-        pads = list(pads)
-        for i in range(ndim):
-            d = 2 + i
-            size = v.shape[d] + 2 * pd[i]
-            rem = (size - ks[i]) % st[i]
-            if rem != 0:
-                lo, hi = pads[d]
-                pads[d] = (lo, hi + (st[i] - rem))
-        pads = tuple(pads)
+    pads = list(((0, 0), (0, 0)) + tuple((p, p) for p in pd))
+    for i in range(ndim):
+        d = 2 + i
+        out = _pool_out_size(v.shape[d], ks[i], st[i], pd[i], ceil_mode)
+        e = max(0, (out - 1) * st[i] + ks[i] - (v.shape[d] + 2 * pd[i]))
+        lo, hi = pads[d]
+        pads[d] = (lo, hi + e)
+    pads = tuple(pads)
     neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
            else jnp.iinfo(v.dtype).min)
 
@@ -912,7 +928,20 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             oh = oh * (1 - label_smoothing) + label_smoothing / n
         nll = -jnp.sum(oh * logp, axis=axis)
         if has_w:
-            nll = nll * jnp.take(w[0], safe)
+            # paddle smears the class weight over the SMOOTHED target
+            # distribution (loss.py: weight_gather = q @ w), not just
+            # the hard label (r5 fuzz find):
+            #   w_i = (1-ls)·w[y_i] + (ls/n)·Σ_c w_c
+            wi = jnp.take(w[0], safe)
+            if label_smoothing > 0.0:
+                wi = ((1 - label_smoothing) * wi
+                      + (label_smoothing / n) * jnp.sum(w[0]))
+            nll = nll * wi
+        # an out-of-range label (not ignore_index) must surface loudly:
+        # jax one_hot silently yields an all-zero row and a 0.0 loss
+        # (the upstream kernel PADDLE_ENFORCEs label < C; r5 find)
+        oob = valid & ((lab_i < 0) | (lab_i >= n))
+        nll = jnp.where(oob, jnp.nan, nll)
         return jnp.where(valid, nll, 0.0)
 
     loss = apply(fn, *args, _name="cross_entropy")
@@ -927,10 +956,20 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 valid = li != ignore_index
                 if has_w:
                     safe = jnp.where(valid, li, 0)
-                    den = jnp.sum(jnp.where(valid, jnp.take(w[0], safe), 0.0))
+                    wi = jnp.take(w[0], safe)
+                    if label_smoothing > 0.0:
+                        # denominator uses the same smeared weights as
+                        # the numerator (paddle: sum(weight_gather))
+                        n = int(w[0].shape[0])
+                        wi = ((1 - label_smoothing) * wi
+                              + (label_smoothing / n) * jnp.sum(w[0]))
+                    den = jnp.sum(jnp.where(valid, wi, 0.0))
                 else:
                     den = jnp.sum(valid.astype(l.dtype))
-                return jnp.sum(l) / jnp.maximum(den, 1.0)
+                # the guard only protects the all-ignored case (0/0 → 0);
+                # clamping to 1.0 corrupted weighted means whose weight
+                # sum is < 1 (r5 fuzz find)
+                return jnp.sum(l) / jnp.maximum(den, 1e-12)
             return apply(mean_fn, loss, lab, *args[2:])
         return _reduce_loss(loss, "mean")
     return _reduce_loss(loss, reduction)
@@ -1245,9 +1284,14 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         if isinstance(size, Tensor):
             size = size.tolist()
         out_sizes = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
-    else:
+    scales = None
+    if size is None:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
         out_sizes = [int(in_sizes[i] * float(sf[i])) for i in range(nd)]
+        # the kernels map coordinates with the EXACT scale when one was
+        # given (paddle: ratio = 1/scale), not the derived size ratio —
+        # they differ for fractional factors (r5 fuzz find)
+        scales = [float(s) for s in sf]
 
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
@@ -1274,7 +1318,8 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                    jnp.arange(out_len, dtype=jnp.float32) *
                    ((s - 1) / (out_len - 1)))
         else:
-            scale_ = s / out_len
+            sc = scales[axis - sp_off] if scales is not None else None
+            scale_ = (1.0 / sc) if sc else (s / out_len)
             src = (jnp.arange(out_len, dtype=jnp.float32) + 0.5) * \
                 scale_ - 0.5
         base = jnp.floor(src)
@@ -1294,12 +1339,56 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         return jnp.sum(taps.astype(jnp.float32) * w.reshape(wshape),
                        axis=axis + 1).astype(v.dtype)
 
+    def _nearest_1d(v, axis, out_len):
+        """torch/paddle nearest mapping: src = floor(dst·in/out)
+        (align_corners: round(dst·(in-1)/(out-1))). jax.image.resize's
+        half-pixel-rounded nearest picked different source rows on
+        downscale — r5 fuzz find."""
+        s = v.shape[axis]
+        o = np.arange(out_len)
+        sc = scales[axis - sp_off] if scales is not None else None
+        if align_corners:
+            idx = (np.zeros(1) if out_len == 1
+                   else np.round(o * ((s - 1) / (out_len - 1))))
+        else:
+            ratio = (1.0 / sc) if sc else (s / out_len)
+            idx = np.floor(o * ratio)
+        idx = np.clip(idx.astype(np.int32), 0, s - 1)
+        return jnp.take(v, jnp.asarray(idx), axis=axis)
+
+    def _area_1d(v, axis, out_len):
+        """'area' is adaptive average pooling (torch/paddle): cell o
+        averages rows floor(o·in/out) .. ceil((o+1)·in/out); separable
+        per axis. The previous linear-resample fallback produced
+        fractional-weighted averages — r5 fuzz find."""
+        s = v.shape[axis]
+        o = np.arange(out_len)
+        starts = np.floor(o * s / out_len).astype(np.int32)
+        ends = np.ceil((o + 1) * s / out_len).astype(np.int32)
+        cs = jnp.cumsum(v.astype(jnp.float32), axis=axis)
+        zero = jnp.zeros_like(jnp.take(cs, jnp.asarray([0]), axis=axis))
+        cs = jnp.concatenate([zero, cs], axis=axis)
+        upper = jnp.take(cs, jnp.asarray(ends), axis=axis)
+        lower = jnp.take(cs, jnp.asarray(starts), axis=axis)
+        shape = [1] * v.ndim
+        shape[axis] = out_len
+        cnt = jnp.asarray((ends - starts).astype(np.float32)).reshape(shape)
+        return ((upper - lower) / cnt).astype(v.dtype)
+
     def fn(v):
         shape = list(v.shape)
         for i in range(nd):
             shape[sp_off + i] = out_sizes[i]
         if jmode == "nearest":
-            return jax.image.resize(v, shape, method="nearest")
+            out = v
+            for i in range(nd):
+                out = _nearest_1d(out, sp_off + i, out_sizes[i])
+            return out
+        if mode == "area":
+            out = v
+            for i in range(nd):
+                out = _area_1d(out, sp_off + i, out_sizes[i])
+            return out
         if jmode == "cubic":
             out = v
             for i in range(nd):
@@ -1324,7 +1413,10 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             bat = v if not channel_last else jnp.moveaxis(v, -1, 1)
             out = jax.vmap(jax.vmap(sample))(bat)
             return out if not channel_last else jnp.moveaxis(out, 1, -1)
-        return jax.image.resize(v, shape, method=jmode)
+        # antialias=False: torch/paddle linear interpolation does not
+        # low-pass filter on downscale (jax.image.resize's default
+        # antialias=True diverged there — r5 fuzz find)
+        return jax.image.resize(v, shape, method=jmode, antialias=False)
     return apply(fn, x)
 
 

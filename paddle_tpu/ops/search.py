@@ -115,10 +115,26 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,
                  name=None):
+    """Parity: paddle.searchsorted — an N-D sorted_sequence searches
+    row-wise (innermost dim), with leading dims matching `values`
+    (jnp.searchsorted is 1-D only; rows vmap — r5 fuzz find)."""
     side = "right" if right else "left"
     d = dtypes.int32 if out_int32 else dtypes.int64
-    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(d),
-                 _coerce(sorted_sequence), _coerce(values))
+
+    def fn(s, v):
+        if s.ndim <= 1:
+            return jnp.searchsorted(s, v, side=side).astype(d)
+        if s.shape[:-1] != v.shape[:-1]:
+            raise ValueError(
+                f"searchsorted: leading dims of sorted_sequence "
+                f"{s.shape} must match values {v.shape}")
+        flat_s = s.reshape(-1, s.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda ss, vv: jnp.searchsorted(
+            ss, vv, side=side))(flat_s, flat_v)
+        return out.reshape(v.shape).astype(d)
+
+    return apply(fn, _coerce(sorted_sequence), _coerce(values))
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):

@@ -19,8 +19,11 @@ from ..io import Dataset
 class FakeData(Dataset):
     """Synthetic classification dataset (deterministic per index)."""
 
-    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
                  transform=None, dtype="float32"):
+        # num_classes defaults to 10 (torchvision FakeData parity): the
+        # old default of 1000 silently fed out-of-range labels to
+        # 10-class models (r5 find)
         self.size = size
         self.image_shape = tuple(image_shape)
         self.num_classes = num_classes
@@ -33,7 +36,7 @@ class FakeData(Dataset):
     def __getitem__(self, idx):
         rng = np.random.RandomState(idx % 65536)
         img = rng.rand(*self.image_shape).astype(self.dtype)
-        label = np.int64(idx % self.num_classes)
+        label = np.int64(rng.randint(0, self.num_classes))
         if self.transform is not None:
             img = self.transform(img)
         return img, label

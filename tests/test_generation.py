@@ -587,3 +587,38 @@ class TestRaggedPagedAttention:
         finally:
             set_flags({k.removeprefix("FLAGS_"): v
                        for k, v in old.items()})
+
+
+def test_continuous_batching_ragged_decode_parity():
+    """round 5: the ragged-grid kernel drives the continuous-batching
+    decode (use_ragged auto-enables at H==Hkv, D%128==0) and stays
+    token-exact with the fixed-grid path and the static greedy
+    oracle."""
+    from paddle_tpu.framework.flags import set_flags, get_flags
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import (ContinuousBatchingPredictor,
+                                      LLMPredictor)
+    old = get_flags(["use_pallas_kernels", "pallas_interpret"])
+    set_flags({"use_pallas_kernels": True, "pallas_interpret": True})
+    try:
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=1024,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=128)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(2, 128, (n,)).tolist()
+                   for n in (5, 11, 3, 8)]
+        cb = ContinuousBatchingPredictor(model, max_batch_size=2,
+                                         page_size=8, max_seq_len=48)
+        assert cb.use_ragged
+        out = cb.generate(prompts, max_new_tokens=6)
+        cbf = ContinuousBatchingPredictor(model, max_batch_size=2,
+                                          page_size=8, max_seq_len=48,
+                                          use_ragged=False)
+        ref = LLMPredictor(model, max_batch_size=1).generate(
+            prompts, max_new_tokens=6)
+        assert out == ref == cbf.generate(prompts, max_new_tokens=6)
+    finally:
+        set_flags({k.removeprefix("FLAGS_"): v for k, v in old.items()})

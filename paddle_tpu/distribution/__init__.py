@@ -187,14 +187,32 @@ class Bernoulli(Distribution):
 
 
 class Categorical(Distribution):
+    """paddle.distribution.Categorical parity: `logits` are
+    NON-NEGATIVE unnormalized probabilities, normalized by their SUM
+    (upstream categorical.py divides by sum everywhere; its doc example
+    draws them from paddle.rand) — NOT softmax'd log-space scores
+    (r5 fuzz find: the old softmax reading diverged for the documented
+    positional usage). The torch-style `probs=` kwarg is an alias with
+    the same normalization."""
+
     def __init__(self, logits=None, probs=None, name=None):
         if (probs is None) == (logits is None):
             raise ValueError("pass exactly one of probs/logits")
-        if logits is not None:
-            self.logits = _t(logits)
-        else:
-            self.logits = Tensor(jnp.log(jnp.clip(_v(probs), 1e-12)))
-        self.probs = Tensor(jax.nn.softmax(self.logits._value, axis=-1))
+        src = _t(logits if logits is not None else probs)
+        import jax.core as jcore
+        if not isinstance(src._value, jcore.Tracer):
+            w = np.asarray(src._value)
+            if (w < 0).any() or (w.sum(-1) == 0).any():
+                raise ValueError(
+                    "Categorical weights must be non-negative with a "
+                    "positive sum (paddle normalizes by sum; log-space "
+                    "scores belong in e.g. softmax(logits) first)")
+        # normalization goes through apply() so log_prob/entropy
+        # gradients reach a caller-owned weight tensor (advisor r5)
+        self.probs = apply(
+            lambda w: w / jnp.sum(w, axis=-1, keepdims=True), src)
+        self.logits = apply(
+            lambda p: jnp.log(jnp.clip(p, 1e-12)), self.probs)
         super().__init__(self.logits._value.shape[:-1])
 
     def sample(self, shape=()):
@@ -205,8 +223,17 @@ class Categorical(Distribution):
     def log_prob(self, value):
         def fn(v, logits):
             lp = jax.nn.log_softmax(logits, axis=-1)
-            return jnp.take_along_axis(
-                lp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            vi = v.astype(jnp.int32)
+            batch = lp.shape[:-1]
+            if not batch:
+                # unbatched distribution, any-shaped value: plain gather
+                # (take_along_axis needed matching ranks — r5 fuzz find)
+                return jnp.take(lp, vi)
+            vb = jnp.broadcast_to(
+                vi, jnp.broadcast_shapes(vi.shape, batch))
+            lpb = jnp.broadcast_to(lp, vb.shape + lp.shape[-1:])
+            return jnp.take_along_axis(lpb, vb[..., None],
+                                       axis=-1)[..., 0]
         return apply(fn, _coerce(value), self.logits)
 
     def entropy(self):

@@ -79,7 +79,9 @@ class TestDiscrete:
         assert abs(s.mean() - 0.7) < 0.03
 
     def test_categorical(self):
-        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        # paddle semantics: logits are unnormalized probabilities,
+        # normalized by SUM (upstream categorical.py; r5 fuzz find)
+        logits = np.array([0.4, 0.6, 1.0], np.float32)  # /2 -> .2/.3/.5
         d = Categorical(logits=logits)
         lp = float(d.log_prob(paddle.to_tensor(np.int64(2))).numpy())
         np.testing.assert_allclose(lp, np.log(0.5), atol=1e-5)
@@ -329,3 +331,43 @@ class TestIndependent:
         with pytest.raises(ValueError):
             Independent(Normal(paddle.to_tensor(loc),
                                paddle.to_tensor(sc)), 3)
+
+
+class TestRound5CategoricalSemantics:
+    def test_positional_weights_sum_normalize(self):
+        # paddle doc usage: Categorical(paddle.rand([C])) — weights
+        # normalize by sum; log_prob of batched values broadcasts
+        # against the unbatched distribution (r5 fuzz finds)
+        rs = np.random.RandomState(0)
+        w = rs.rand(5).astype(np.float32)
+        d = Categorical(paddle.to_tensor(w))
+        p = w / w.sum()
+        np.testing.assert_allclose(np.asarray(d.probs.numpy()), p,
+                                   rtol=1e-6)
+        kk = rs.randint(0, 5, (6,)).astype(np.int64)
+        lp = d.log_prob(paddle.to_tensor(kk))
+        np.testing.assert_allclose(np.asarray(lp.numpy()),
+                                   np.log(p)[kk], rtol=1e-5)
+        # batched distribution x batched values
+        w2 = rs.rand(3, 4).astype(np.float32)
+        d2 = Categorical(paddle.to_tensor(w2))
+        k2 = rs.randint(0, 4, (3,)).astype(np.int64)
+        lp2 = d2.log_prob(paddle.to_tensor(k2))
+        p2 = w2 / w2.sum(-1, keepdims=True)
+        np.testing.assert_allclose(
+            np.asarray(lp2.numpy()),
+            np.log(p2)[np.arange(3), k2], rtol=1e-5)
+
+    def test_weights_differentiable_and_validated(self):
+        # advisor r5: log_prob must differentiate back to caller-owned
+        # weights (REINFORCE); negative/zero weights raise
+        w = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        w.stop_gradient = False
+        d = Categorical(w)
+        d.log_prob(paddle.to_tensor(np.int64(1))).backward()
+        assert w.grad is not None
+        assert np.abs(np.asarray(w.grad.numpy())).sum() > 0
+        with pytest.raises(ValueError, match="non-negative"):
+            Categorical(np.log(np.array([0.2, 0.3, 0.5], np.float32)))
+        with pytest.raises(ValueError, match="non-negative"):
+            Categorical(np.zeros(3, np.float32))

@@ -198,10 +198,14 @@ class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
         if (probs is None) == (logits is None):
             raise ValueError("pass exactly one of probs/logits")
-        src = _t(logits if logits is not None else probs)
-        import jax.core as jcore
-        if not isinstance(src._value, jcore.Tracer):
-            w = np.asarray(src._value)
+        raw = logits if logits is not None else probs
+        src = _t(raw)
+        # validate host-originated weights only (numpy/list inputs —
+        # the usual source of log-space mistakes); device arrays skip
+        # the check to avoid a blocking device->host sync per
+        # construction (advisor r5)
+        if isinstance(raw, (np.ndarray, list, tuple, float, int)):
+            w = np.asarray(raw)
             if (w < 0).any() or (w.sum(-1) == 0).any():
                 raise ValueError(
                     "Categorical weights must be non-negative with a "

@@ -127,9 +127,13 @@ def qr(x, mode="reduced", name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """Parity: paddle.linalg.svd returns (U, S, VH) with
+    x = U @ diag(S) @ VH — VH, not V (the doc's third output is named
+    vh; r5 fuzz find: the old V-transposed return broke
+    reconstruction for every consumer following the upstream
+    contract)."""
     def fn(v):
-        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V not V^H
+        return jnp.linalg.svd(v, full_matrices=full_matrices)
     return apply(fn, _coerce(x))
 
 

@@ -274,9 +274,14 @@ class TestLinalg:
         np.testing.assert_allclose(L @ L.T, spd, rtol=1e-6)
         q, r = paddle.linalg.qr(paddle.to_tensor(a))
         np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-6, atol=1e-8)
-        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
+        # paddle returns (U, S, VH): x == U @ diag(S) @ VH (r5 fix)
+        u, s, vh = paddle.linalg.svd(paddle.to_tensor(a))
         np.testing.assert_allclose(
-            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, rtol=1e-6, atol=1e-8)
+            u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), a,
+            rtol=1e-6, atol=1e-8)
+        _, _, np_vh = np.linalg.svd(a, full_matrices=False)
+        np.testing.assert_allclose(np.abs(vh.numpy()), np.abs(np_vh),
+                                   rtol=1e-5, atol=1e-8)
 
     def test_eigh(self):
         a = rng.rand(3, 3).astype(np.float64)

@@ -200,12 +200,14 @@ class Categorical(Distribution):
             raise ValueError("pass exactly one of probs/logits")
         raw = logits if logits is not None else probs
         src = _t(raw)
-        # validate host-originated weights only (numpy/list inputs —
-        # the usual source of log-space mistakes); device arrays skip
-        # the check to avoid a blocking device->host sync per
-        # construction (advisor r5)
-        if isinstance(raw, (np.ndarray, list, tuple, float, int)):
-            w = np.asarray(raw)
+        # validate every CONCRETE weight (Tensor or numpy — the guard
+        # exists to catch log-space mistakes, which arrive as Tensors
+        # too); only traced values skip it. The host read is a sync on
+        # device arrays, accepted: construction is not a hot path and a
+        # silently inverted distribution is worse (advisor r5, twice).
+        import jax.core as _jcore
+        if not isinstance(src._value, _jcore.Tracer):
+            w = np.asarray(src._value)
             if (w < 0).any() or (w.sum(-1) == 0).any():
                 raise ValueError(
                     "Categorical weights must be non-negative with a "

@@ -214,7 +214,29 @@ class OneCycleLR(LRScheduler):
         self.end_lr = end_learning_rate
         self.phase_pct = phase_pct
         self.anneal = anneal_strategy
+        self.three_phase = three_phase
         super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def _schedule(self):
+        """Phase boundaries as FRACTIONAL step indices ending at
+        total_steps - 1 (paddle lr.py mirrors torch's
+        `pct_start * total_steps - 1` convention; r5 sweep found an
+        int(pct*total) boundary shifted the whole curve). Derived from
+        the serialized scalars on every call so set_state_dict restores
+        stay coherent (advisor r5)."""
+        if self.three_phase:
+            bounds = [self.phase_pct * self.total_steps - 1,
+                      2 * self.phase_pct * self.total_steps - 2,
+                      self.total_steps - 1]
+            phases = [(self.initial_lr, self.max_lr),
+                      (self.max_lr, self.initial_lr),
+                      (self.initial_lr, self.end_lr)]
+        else:
+            bounds = [self.phase_pct * self.total_steps - 1,
+                      self.total_steps - 1]
+            phases = [(self.initial_lr, self.max_lr),
+                      (self.max_lr, self.end_lr)]
+        return bounds, phases
 
     def _interp(self, start, end, pct):
         if self.anneal == "cos":
@@ -222,13 +244,15 @@ class OneCycleLR(LRScheduler):
         return (end - start) * pct + start
 
     def get_lr(self):
-        step = min(self.last_epoch, self.total_steps)
-        up = int(self.phase_pct * self.total_steps)
-        if step <= up and up > 0:
-            return self._interp(self.initial_lr, self.max_lr, step / up)
-        down = self.total_steps - up
-        pct = (step - up) / max(down, 1)
-        return self._interp(self.max_lr, self.end_lr, pct)
+        bounds, phases = self._schedule()
+        step = min(self.last_epoch, self.total_steps - 1)
+        start_step = 0.0
+        for i, (bound, (lo, hi)) in enumerate(zip(bounds, phases)):
+            if step <= bound or i == len(bounds) - 1:
+                denom = max(bound - start_step, 1e-12)
+                return self._interp(lo, hi, (step - start_step) / denom)
+            start_step = bound
+        return self.end_lr
 
 
 class CyclicLR(LRScheduler):

@@ -851,3 +851,49 @@ class TestTrainCurveParityVsTorch:
             tc = torch_curve(tcls, **tkw)
             np.testing.assert_allclose(pc, tc, atol=2e-4,
                                        err_msg=f"{pname} curve diverged")
+
+
+class TestOneCycleR5:
+    def test_onecycle_matches_torch_both_modes(self):
+        """r5 sweep find: phase boundaries are fractional indices ending
+        at total_steps-1 (upstream pct*total-1 convention); curves must
+        match torch for both two- and three-phase schedules."""
+        import torch
+        L = paddle.optimizer.lr
+        for three in (False, True):
+            ps = L.OneCycleLR(max_learning_rate=0.1, total_steps=12,
+                              end_learning_rate=0.004 / 1e4,
+                              three_phase=three)
+            ours = []
+            for _ in range(12):
+                ours.append(float(ps()))
+                ps.step()
+            p = [torch.nn.Parameter(torch.zeros(1))]
+            o = torch.optim.SGD(p, lr=0.1)
+            ts = torch.optim.lr_scheduler.OneCycleLR(
+                o, 0.1, total_steps=12, three_phase=three)
+            theirs = []
+            for _ in range(12):
+                theirs.append(o.param_groups[0]["lr"])
+                o.step()
+                ts.step()
+            np.testing.assert_allclose(ours, theirs, rtol=1e-5,
+                                       atol=1e-7,
+                                       err_msg=f"three={three}")
+
+    def test_onecycle_state_dict_restore(self):
+        # advisor r5: restoring into a differently-configured scheduler
+        # must use the RESTORED total_steps for the curve
+        L = paddle.optimizer.lr
+        a = L.OneCycleLR(max_learning_rate=0.1, total_steps=100)
+        for _ in range(50):
+            a.step()
+        b = L.OneCycleLR(max_learning_rate=0.1, total_steps=10)
+        b.set_state_dict(a.state_dict())
+        np.testing.assert_allclose(float(b()), float(a()), rtol=1e-6)
+
+    def test_categorical_tensor_weights_validated(self):
+        import paddle_tpu.distribution as D
+        with pytest.raises(ValueError, match="non-negative"):
+            D.Categorical(paddle.to_tensor(
+                np.array([0.2, -0.5, 1.0], np.float32)))

@@ -1,0 +1,157 @@
+"""Fuzz round 3: dy2static control flow, fft/signal, linalg decomps."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import paddle_tpu as paddle
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+fails = []
+t = paddle.to_tensor
+
+def check(name, got, want, atol=1e-4, rtol=1e-4, info=""):
+    try:
+        g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        w = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+        assert g.shape == w.shape, f"shape {g.shape} vs {w.shape}"
+        np.testing.assert_allclose(g, w, atol=atol, rtol=rtol)
+    except Exception as e:
+        fails.append((name, info, str(e)[:250]))
+
+# --- dy2static: converted control flow must equal eager ---
+for it in range(N):
+    x_np = rs.randn(4, 5).astype("f")
+    k = int(rs.randint(1, 5))
+    th = float(rs.randn())
+
+    def f1(x):
+        s = paddle.zeros([1])
+        for i in range(k):
+            if (x.sum() > th):
+                s = s + x.mean() * (i + 1)
+            else:
+                s = s - x.mean()
+        return s
+
+    def f2(x):
+        acc = x
+        i = 0
+        while i < k:
+            acc = acc * 0.9 + 0.1
+            i += 1
+        return acc.sum()
+
+    def f3(x):
+        out = []
+        for i in range(3):
+            if i == 1:
+                continue
+            out.append(x * i)
+        s = out[0] + out[1]
+        for i in range(10):
+            if i > 2:
+                break
+            s = s + 1.0
+        return s.mean()
+
+    for nm, fn in (("for_if", f1), ("while", f2), ("break_cont", f3)):
+        try:
+            eager = fn(t(x_np.copy()))
+            st = paddle.jit.to_static(fn)
+            static = st(t(x_np.copy()))
+            check(f"d2s_{nm}", static, eager, info=f"k={k} th={th:.2f}")
+        except Exception as e:
+            fails.append((f"d2s_{nm}", f"k={k}", repr(e)[:250]))
+
+# --- fft family ---
+for it in range(N):
+    n = int(rs.randint(3, 17))
+    x = rs.randn(3, n).astype("f")
+    xc = (rs.randn(3, n) + 1j * rs.randn(3, n)).astype("complex64")
+    nfft = int(rs.choice([n, n + 3, max(2, n - 2)]))
+    norm = ["backward", "ortho", "forward"][rs.randint(3)]
+    try:
+        check("rfft", paddle.fft.rfft(t(x), n=nfft, norm=norm),
+              torch.fft.rfft(torch.tensor(x), n=nfft, norm=norm),
+              atol=1e-3, info=f"n={n} nfft={nfft} {norm}")
+        check("fft", paddle.fft.fft(t(xc), n=nfft, norm=norm),
+              torch.fft.fft(torch.tensor(xc), n=nfft, norm=norm),
+              atol=1e-3, info=f"n={n} nfft={nfft} {norm}")
+        check("ifft", paddle.fft.ifft(t(xc), n=nfft, norm=norm),
+              torch.fft.ifft(torch.tensor(xc), n=nfft, norm=norm),
+              atol=1e-3)
+        check("irfft", paddle.fft.irfft(t(xc[:, :n // 2 + 1].copy()), n=n, norm=norm),
+              torch.fft.irfft(torch.tensor(xc[:, :n // 2 + 1].copy()), n=n, norm=norm),
+              atol=1e-3, info=f"n={n}")
+        check("fftshift", paddle.fft.fftshift(t(x)),
+              torch.fft.fftshift(torch.tensor(x)))
+        check("hfft", paddle.fft.hfft(t(xc[:, :n // 2 + 1].copy()), n=n),
+              torch.fft.hfft(torch.tensor(xc[:, :n // 2 + 1].copy()), n=n),
+              atol=1e-3, info=f"n={n}")
+        x2 = rs.randn(4, 6, 6).astype("f")
+        check("fft2", paddle.fft.fft2(t(x2.astype("complex64"))),
+              torch.fft.fft2(torch.tensor(x2, dtype=torch.complex64)),
+              atol=1e-3)
+    except Exception as e:
+        fails.append(("fft", f"n={n}", repr(e)[:250]))
+    # stft/istft roundtrip + torch parity
+    try:
+        sig = rs.randn(2, 64).astype("f")
+        nf = int(rs.choice([8, 16]))
+        hop = nf // int(rs.choice([2, 4]))
+        win = np.hanning(nf).astype("f")
+        ours = paddle.signal.stft(t(sig), n_fft=nf, hop_length=hop,
+                                  window=t(win), center=True)
+        theirs = torch.stft(torch.tensor(sig), n_fft=nf, hop_length=hop,
+                            window=torch.tensor(win), center=True,
+                            return_complex=True)
+        check("stft", ours, theirs, atol=1e-3, info=f"nf={nf} hop={hop}")
+        rec = paddle.signal.istft(ours, n_fft=nf, hop_length=hop,
+                                  window=t(win), center=True, length=64)
+        trec = torch.istft(theirs, n_fft=nf, hop_length=hop,
+                           window=torch.tensor(win), center=True, length=64)
+        check("istft", rec, trec, atol=1e-3, info=f"nf={nf} hop={hop}")
+    except Exception as e:
+        fails.append(("stft", "", repr(e)[:250]))
+
+# --- linalg decompositions (compare reconstructions, not factors) ---
+for it in range(N):
+    m, n = int(rs.randint(2, 6)), int(rs.randint(2, 6))
+    A = rs.randn(m, n).astype("f")
+    try:
+        q, r = paddle.linalg.qr(t(A))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), A, atol=1e-4)
+        u, s, vh = paddle.linalg.svd(t(A), full_matrices=False)
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), A, atol=1e-4)
+        ts = torch.linalg.svdvals(torch.tensor(A)).numpy()
+        np.testing.assert_allclose(np.sort(s.numpy())[::-1], ts, atol=1e-4)
+        B = rs.randn(m, int(rs.randint(1, 4))).astype("f")
+        sol = paddle.linalg.lstsq(t(A), t(B))[0]
+        tsol = torch.linalg.lstsq(torch.tensor(A), torch.tensor(B)).solution
+        if m >= n:
+            np.testing.assert_allclose(sol.numpy(), tsol.numpy(), atol=1e-3)
+        S = A @ A.T + m * np.eye(m, dtype="f")
+        w_, v_ = paddle.linalg.eigh(t(S))
+        tw = torch.linalg.eigvalsh(torch.tensor(S)).numpy()
+        np.testing.assert_allclose(np.asarray(w_.numpy()), tw, atol=1e-3)
+        lu, piv = paddle.linalg.lu(t(A))[:2]
+        # triangular_solve
+        L = np.tril(rs.randn(m, m).astype("f")) + m * np.eye(m, dtype="f")
+        bb = rs.randn(m, 2).astype("f")
+        got = paddle.linalg.triangular_solve(t(L), t(bb), upper=False)
+        want = torch.linalg.solve_triangular(torch.tensor(L),
+                                             torch.tensor(bb), upper=False)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-3)
+    except Exception as e:
+        fails.append(("linalg", f"{m}x{n}", repr(e)[:250]))
+
+print(f"fuzz3 done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:60])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70); print(name, info); print(msg[:300])

@@ -1,0 +1,132 @@
+"""Gradient fuzz: paddle_tpu backward vs torch autograd."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import torch.nn.functional as tF
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N_ITER = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+fails = []
+
+def grad_pair(name, x_np, pf, tfn, atol=1e-3, info=""):
+    try:
+        xp = paddle.to_tensor(x_np.copy())
+        xp.stop_gradient = False
+        out = pf(xp)
+        out.sum().backward()
+        gp = np.asarray(xp.grad.numpy())
+        xt = torch.tensor(x_np.copy(), requires_grad=True)
+        tfn(xt).sum().backward()
+        gt = xt.grad.numpy()
+        assert gp.shape == gt.shape, f"shape {gp.shape} vs {gt.shape}"
+        np.testing.assert_allclose(gp, gt, atol=atol, rtol=1e-3)
+    except Exception as e:
+        fails.append((name, info, str(e)[:250]))
+
+for it in range(N_ITER):
+    H, W = int(rs.randint(4, 9)), int(rs.randint(4, 9))
+    x = rs.randn(2, 3, H, W).astype("f")
+    oh, ow = int(rs.randint(2, 12)), int(rs.randint(2, 12))
+    grad_pair("interp_bilinear_g", x,
+              lambda v: F.interpolate(v, size=[oh, ow], mode="bilinear",
+                                      align_corners=False),
+              lambda v: tF.interpolate(v, size=(oh, ow), mode="bilinear",
+                                       align_corners=False),
+              info=f"{H}x{W}->{oh}x{ow}")
+    grad_pair("interp_nearest_g", x,
+              lambda v: F.interpolate(v, size=[oh, ow], mode="nearest"),
+              lambda v: tF.interpolate(v, size=(oh, ow), mode="nearest"),
+              info=f"{H}x{W}->{oh}x{ow}")
+    grad_pair("interp_area_g", x,
+              lambda v: F.interpolate(v, size=[oh, ow], mode="area"),
+              lambda v: tF.interpolate(v, size=(oh, ow), mode="area"),
+              info=f"{H}x{W}->{oh}x{ow}")
+    grad_pair("interp_bicubic_g", x,
+              lambda v: F.interpolate(v, size=[oh, ow], mode="bicubic",
+                                      align_corners=True),
+              lambda v: tF.interpolate(v, size=(oh, ow), mode="bicubic",
+                                       align_corners=True),
+              atol=5e-3, info=f"{H}x{W}->{oh}x{ow}")
+    # pooling grads incl ceil_mode
+    k = int(rs.randint(1, 4)); st = int(rs.randint(1, 3))
+    pd = int(rs.randint(0, min(k // 2 + 1, 2))); cm = bool(rs.randint(2))
+    grad_pair("max_pool_g", x,
+              lambda v: F.max_pool2d(v, k, stride=st, padding=pd,
+                                     ceil_mode=cm),
+              lambda v: tF.max_pool2d(v, k, stride=st, padding=pd,
+                                      ceil_mode=cm),
+              info=f"k={k} s={st} p={pd} cm={cm} {H}x{W}")
+    grad_pair("avg_pool_g", x,
+              lambda v: F.avg_pool2d(v, k, stride=st, padding=pd,
+                                     ceil_mode=cm),
+              lambda v: tF.avg_pool2d(v, k, stride=st, padding=pd,
+                                      ceil_mode=cm,
+                                      count_include_pad=False),
+              info=f"k={k} s={st} p={pd} cm={cm} {H}x{W}")
+    # lrn grad
+    grad_pair("lrn_g", x,
+              lambda v: F.local_response_norm(v, 3, alpha=0.02, beta=0.7),
+              lambda v: tF.local_response_norm(v, 3, alpha=0.02, beta=0.7))
+    # losses
+    C = int(rs.randint(2, 6))
+    lg = rs.randn(5, C).astype("f")
+    lb = rs.randint(0, C, (5,)).astype("i8")
+    w = rs.rand(C).astype("f") + 0.1
+    red = ["mean", "sum"][rs.randint(2)]
+    grad_pair("ce_weight_g", lg,
+              lambda v: F.cross_entropy(v, paddle.to_tensor(lb), weight=paddle.to_tensor(w), reduction=red),
+              lambda v: tF.cross_entropy(v, torch.tensor(lb), weight=torch.tensor(w), reduction=red),
+              info=f"red={red}")
+    # norms
+    L = int(rs.randint(3, 8))
+    xx = rs.randn(4, L).astype("f")
+    grad_pair("layer_norm_g", xx,
+              lambda v: F.layer_norm(v, [L]),
+              lambda v: tF.layer_norm(v, (L,)))
+    grad_pair("softmax_g", xx,
+              lambda v: F.softmax(v, axis=-1) ** 2,
+              lambda v: torch.softmax(v, -1) ** 2)
+    grad_pair("logsumexp_g", xx,
+              lambda v: paddle.logsumexp(v, 1),
+              lambda v: torch.logsumexp(v, 1))
+    # cumulative
+    grad_pair("cumsum_g", xx, lambda v: paddle.cumsum(v, 1) ** 2,
+              lambda v: torch.cumsum(v, 1) ** 2)
+    grad_pair("cummax_g", xx, lambda v: paddle.cummax(v, 1)[0] * 2,
+              lambda v: torch.cummax(v, 1)[0] * 2)
+    grad_pair("logcumsumexp_g", xx, lambda v: paddle.logcumsumexp(v, 1),
+              lambda v: torch.logcumsumexp(v, 1))
+    # take_along_axis / gather grads
+    idx = rs.randint(0, L, (4, 3)).astype("i8")
+    grad_pair("take_along_g", xx,
+              lambda v: paddle.take_along_axis(v, paddle.to_tensor(idx), 1) ** 2,
+              lambda v: torch.take_along_dim(v, torch.tensor(idx), 1) ** 2)
+    # grid_sample grad
+    gr = (rs.rand(2, 3, 4, 2).astype("f") * 1.6 - 0.8)
+    grad_pair("grid_sample_g", x,
+              lambda v: F.grid_sample(v, paddle.to_tensor(gr),
+                                      align_corners=True),
+              lambda v: tF.grid_sample(v, torch.tensor(gr),
+                                       align_corners=True))
+    # topk grad
+    grad_pair("topk_g", xx,
+              lambda v: paddle.topk(v, 2, axis=1)[0] * 3,
+              lambda v: torch.topk(v, 2, dim=1)[0] * 3)
+    # prod grad (zero entries)
+    xz = xx.copy(); xz[0, 0] = 0.0
+    grad_pair("prod_g", xz, lambda v: paddle.prod(v, 1),
+              lambda v: torch.prod(v, 1), atol=5e-3)
+
+print(f"gradfuzz done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:60])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70)
+    print(name, info)
+    print(msg[:350])

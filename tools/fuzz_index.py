@@ -1,0 +1,118 @@
+"""Fuzz tensor indexing (getitem/setitem) vs torch."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import paddle_tpu as paddle
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+fails = []
+t = paddle.to_tensor
+
+def check(name, got, want, info=""):
+    try:
+        g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        w = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+        assert g.shape == w.shape, f"shape {g.shape} vs {w.shape}"
+        np.testing.assert_allclose(g, w, atol=1e-6)
+    except Exception as e:
+        fails.append((name, info, str(e)[:220]))
+
+def rand_slice(n):
+    a = rs.randint(-n - 1, n + 1)
+    b = rs.randint(-n - 1, n + 1)
+    st = rs.choice([1, 1, 2, 3, -1, -2])
+    return slice(int(a) if rs.rand() < 0.8 else None,
+                 int(b) if rs.rand() < 0.8 else None, int(st))
+
+for it in range(N):
+    sh = tuple(int(rs.randint(2, 7)) for _ in range(int(rs.randint(1, 4))))
+    x = rs.randn(*sh).astype("f")
+    xt = torch.tensor(x)
+    # --- getitem with mixed slice/int/None/Ellipsis ---
+    try:
+        idx = []
+        used_ell = False
+        for d, n in enumerate(sh):
+            r = rs.rand()
+            if r < 0.35:
+                idx.append(rand_slice(n))
+            elif r < 0.55:
+                idx.append(int(rs.randint(-n, n)))
+            elif r < 0.65 and not used_ell:
+                idx.append(Ellipsis)
+                used_ell = True
+                break
+            elif r < 0.8:
+                idx.append(None)
+            else:
+                idx.append(slice(None))
+        idx = tuple(idx)
+        try:
+            ref = x[idx]   # oracle first: skip indices numpy rejects
+        except Exception:
+            ref = None
+        if ref is not None:
+            check("getitem_mixed", t(x.copy())[idx], ref,
+                  info=f"{sh} {idx}")
+    except Exception as e:
+        fails.append(("getitem_mixed", f"{sh} {idx}", repr(e)[:220]))
+    # --- bool mask getitem ---
+    try:
+        m = rs.rand(*sh) > 0.5
+        check("getitem_boolmask", t(x.copy())[t(m)], xt[torch.tensor(m)],
+              info=f"{sh}")
+        m0 = rs.rand(sh[0]) > 0.5
+        check("getitem_boolmask_d0", t(x.copy())[t(m0)],
+              xt[torch.tensor(m0)], info=f"{sh}")
+    except Exception as e:
+        fails.append(("getitem_bool", f"{sh}", repr(e)[:220]))
+    # --- integer tensor indexing ---
+    try:
+        ii = rs.randint(-sh[0], sh[0], (4,)).astype("i8")
+        check("getitem_inttensor", t(x.copy())[t(ii)],
+              xt[torch.tensor(ii)], info=f"{sh}")
+        if len(sh) >= 2:
+            jj = rs.randint(0, sh[1], (4,)).astype("i8")
+            check("getitem_2tensor", t(x.copy())[t(ii), t(jj)],
+                  xt[torch.tensor(ii), torch.tensor(jj)], info=f"{sh}")
+    except Exception as e:
+        fails.append(("getitem_int", f"{sh}", repr(e)[:220]))
+    # --- setitem: slices, masks, tensors, scalars & broadcast ---
+    try:
+        a = x.copy(); at = torch.tensor(x.copy())
+        sl = rand_slice(sh[0])
+        val = float(rs.randn())
+        pa = t(a.copy()); pa[sl] = val
+        an = a.copy(); an[sl] = val
+        check("setitem_slice_scalar", pa, an, info=f"{sh} {sl}")
+        m = rs.rand(*sh) > 0.5
+        pa = t(a.copy()); pa[t(m)] = 7.5
+        at2 = torch.tensor(a.copy()); at2[torch.tensor(m)] = 7.5
+        check("setitem_boolmask", pa, at2, info=f"{sh}")
+        ii = rs.randint(0, sh[0], (3,)).astype("i8")
+        row = rs.randn(*sh[1:]).astype("f") if len(sh) > 1 else float(rs.randn())
+        pa = t(a.copy()); pa[t(ii)] = t(row) if len(sh) > 1 else row
+        at2 = torch.tensor(a.copy()); at2[torch.tensor(ii)] = (
+            torch.tensor(row) if len(sh) > 1 else row)
+        check("setitem_inttensor", pa, at2, info=f"{sh}")
+    except Exception as e:
+        fails.append(("setitem", f"{sh}", repr(e)[:220]))
+    # --- chained/neg-step combos ---
+    try:
+        if len(sh) >= 2:
+            got = t(x.copy())[::-1, 1:]
+            want = xt.flip(0)[:, 1:]
+            check("negstep_combo", got, want, info=f"{sh}")
+    except Exception as e:
+        fails.append(("negstep", f"{sh}", repr(e)[:220]))
+
+print(f"indexfuzz done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:70])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70); print(name, info); print(msg[:300])

@@ -1,0 +1,205 @@
+"""Fuzz paddle_tpu ops against torch CPU oracle."""
+import os, sys, traceback
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N_ITER = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+fails = []
+
+def t(x): return paddle.to_tensor(x)
+def tt(x): return torch.tensor(x)
+
+def check(name, got, want, atol=1e-4, rtol=1e-4, info=""):
+    try:
+        g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        w = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+        np.testing.assert_allclose(g, w, atol=atol, rtol=rtol)
+    except Exception as e:
+        fails.append((name, info, str(e)[:400]))
+
+def rand_shape(nd_min=1, nd_max=4, mx=9):
+    nd = rs.randint(nd_min, nd_max + 1)
+    return tuple(int(rs.randint(1, mx)) for _ in range(nd))
+
+for it in range(N_ITER):
+    # --- reductions with keepdim/axis combos ---
+    sh = rand_shape(1, 4)
+    x = rs.randn(*sh).astype("f")
+    ax = int(rs.randint(0, len(sh)))
+    kd = bool(rs.randint(2))
+    for opn, pop, top in [("logsumexp", paddle.logsumexp, torch.logsumexp),
+                          ("amax", paddle.amax, torch.amax),
+                          ("amin", paddle.amin, torch.amin)]:
+        try:
+            check(opn, pop(t(x), ax, keepdim=kd), top(tt(x), ax, keepdim=kd),
+                  info=f"{sh} ax={ax} kd={kd}")
+        except Exception as e:
+            fails.append((opn, f"{sh} ax={ax}", repr(e)[:200]))
+    # nanmean/nansum with NaNs
+    xn = x.copy(); xn[rs.rand(*sh) < 0.2] = np.nan
+    try:
+        check("nanmean", paddle.nanmean(t(xn), ax, keepdim=kd),
+              torch.nanmean(tt(xn), ax, keepdim=kd), info=f"{sh}")
+        check("nansum", paddle.nansum(t(xn), ax, keepdim=kd),
+              torch.nansum(tt(xn), ax, keepdim=kd), info=f"{sh}")
+    except Exception as e:
+        fails.append(("nanmean/sum", f"{sh}", repr(e)[:200]))
+    # --- manipulation: roll/flip/strided slice/take_along_axis ---
+    try:
+        shifts = int(rs.randint(-5, 6))
+        check("roll", paddle.roll(t(x), shifts, ax),
+              torch.roll(tt(x), shifts, ax), info=f"{sh} s={shifts}")
+        idx = rs.randint(0, sh[ax], size=sh).astype("i8")
+        check("take_along_axis",
+              paddle.take_along_axis(t(x), t(idx), ax),
+              torch.take_along_dim(tt(x), tt(idx), ax), info=f"{sh}")
+    except Exception as e:
+        fails.append(("manip", f"{sh}", repr(e)[:300]))
+    # --- cumulative ---
+    try:
+        check("cumsum", paddle.cumsum(t(x), ax), torch.cumsum(tt(x), ax))
+        check("cummax", paddle.cummax(t(x), ax)[0],
+              torch.cummax(tt(x), ax)[0], info=f"{sh} ax={ax}")
+        check("cummin", paddle.cummin(t(x), ax)[0],
+              torch.cummin(tt(x), ax)[0], info=f"{sh} ax={ax}")
+        check("logcumsumexp", paddle.logcumsumexp(t(x), ax),
+              torch.logcumsumexp(tt(x), ax), info=f"{sh} ax={ax}")
+    except Exception as e:
+        fails.append(("cum", f"{sh} ax={ax}", repr(e)[:300]))
+    # --- losses with reduction/weights ---
+    try:
+        C = int(rs.randint(2, 6)); B = int(rs.randint(1, 7))
+        logits = rs.randn(B, C).astype("f")
+        labels = rs.randint(0, C, (B,)).astype("i8")
+        red = ["mean", "sum", "none"][rs.randint(3)]
+        w = rs.rand(C).astype("f") + 0.1
+        ls = float(rs.choice([0.0, 0.1]))
+        pk = dict(weight=t(w), reduction=red)
+        tk = dict(weight=tt(w), reduction=red)
+        if ls:
+            pk["label_smoothing"] = ls
+            # paddle semantics: weight smeared over smoothed target
+            logp = torch.log_softmax(tt(logits), -1).numpy()
+            q = np.full((B, C), ls / C, "f")
+            q[np.arange(B), labels] += 1 - ls
+            per = (q @ w) * (-(q * logp).sum(-1))
+            want = {"none": per, "sum": per.sum(),
+                    "mean": per.sum() / (q @ w).sum()}[red]
+            check("cross_entropy_w_ls",
+                  F.cross_entropy(t(logits), t(labels), **pk), want,
+                  info=f"B={B} C={C} red={red} ls={ls}")
+        else:
+            check("cross_entropy_w",
+                  F.cross_entropy(t(logits), t(labels), **pk),
+                  torch.nn.functional.cross_entropy(tt(logits), tt(labels), **tk),
+                  info=f"B={B} C={C} red={red}")
+        # kl_div
+        lp = torch.log_softmax(tt(logits), -1).numpy()
+        tg = torch.softmax(tt(rs.randn(B, C).astype('f')), -1).numpy()
+        check("kl_div", F.kl_div(t(lp), t(tg), reduction=red),
+              torch.nn.functional.kl_div(tt(lp), tt(tg), reduction=red),
+              info=f"red={red}")
+        # huber/smooth_l1 with delta
+        pr = rs.randn(B, C).astype("f"); gt = rs.randn(B, C).astype("f")
+        d = float(rs.choice([0.5, 1.0, 2.0]))
+        check("smooth_l1",
+              F.smooth_l1_loss(t(pr), t(gt), reduction=red, delta=d),
+              torch.nn.functional.huber_loss(tt(pr), tt(gt), reduction=red, delta=d),
+              info=f"red={red} d={d}")
+    except Exception as e:
+        fails.append(("loss", "", repr(e)[:300]))
+    # --- pooling with odd configs ---
+    try:
+        B, C = int(rs.randint(1, 3)), int(rs.randint(1, 4))
+        H, W = int(rs.randint(4, 12)), int(rs.randint(4, 12))
+        k = int(rs.randint(1, 4)); st = int(rs.randint(1, 3))
+        pd = int(rs.randint(0, min(k // 2 + 1, 2)))
+        cm = bool(rs.randint(2))
+        xi = rs.randn(B, C, H, W).astype("f")
+        check("max_pool2d",
+              F.max_pool2d(t(xi), k, stride=st, padding=pd, ceil_mode=cm),
+              torch.nn.functional.max_pool2d(tt(xi), k, stride=st, padding=pd, ceil_mode=cm),
+              info=f"k={k} st={st} pd={pd} cm={cm} {H}x{W}")
+        check("avg_pool2d",
+              F.avg_pool2d(t(xi), k, stride=st, padding=pd, ceil_mode=cm),
+              torch.nn.functional.avg_pool2d(tt(xi), k, stride=st, padding=pd,
+                                             ceil_mode=cm,
+                                             count_include_pad=False),
+              info=f"k={k} st={st} pd={pd} cm={cm} {H}x{W}")
+        check("avg_pool2d_inc",
+              F.avg_pool2d(t(xi), k, stride=st, padding=pd, ceil_mode=cm,
+                           exclusive=False),
+              torch.nn.functional.avg_pool2d(tt(xi), k, stride=st, padding=pd,
+                                             ceil_mode=cm,
+                                             count_include_pad=True),
+              info=f"k={k} st={st} pd={pd} cm={cm} {H}x{W}")
+        op = int(rs.randint(1, 5))
+        check("adaptive_avg2d", F.adaptive_avg_pool2d(t(xi), op),
+              torch.nn.functional.adaptive_avg_pool2d(tt(xi), op),
+              info=f"{H}x{W}->{op}")
+        check("adaptive_max2d", F.adaptive_max_pool2d(t(xi), op),
+              torch.nn.functional.adaptive_max_pool2d(tt(xi), op),
+              info=f"{H}x{W}->{op}")
+    except Exception as e:
+        fails.append(("pool", "", repr(e)[:300]))
+    # --- linalg ---
+    try:
+        n = int(rs.randint(2, 5))
+        A = rs.randn(n, n).astype("f"); A = A @ A.T + n * np.eye(n, dtype="f")
+        check("cholesky", paddle.linalg.cholesky(t(A)),
+              torch.linalg.cholesky(tt(A)), atol=1e-3)
+        check("slogdet", paddle.linalg.slogdet(t(A))[1],
+              torch.linalg.slogdet(tt(A))[1], atol=1e-3)
+        check("matrix_rank", paddle.linalg.matrix_rank(t(A)),
+              torch.linalg.matrix_rank(tt(A)))
+        B2 = rs.randn(n, n).astype("f")
+        check("solve", paddle.linalg.solve(t(A), t(B2)),
+              torch.linalg.solve(tt(A), tt(B2)), atol=1e-3)
+        check("pinv", paddle.linalg.pinv(t(B2)), torch.linalg.pinv(tt(B2)),
+              atol=1e-3)
+        tau = rs.randn(n).astype("f")
+        check("householder_product",
+              paddle.linalg.householder_product(t(B2), t(tau)),
+              torch.linalg.householder_product(tt(B2), tt(tau)),
+              atol=1e-3)
+    except Exception as e:
+        fails.append(("linalg", f"n={n}", repr(e)[:300]))
+    # --- sorting/searching ---
+    try:
+        k2 = int(rs.randint(1, sh[ax] + 1))
+        largest = bool(rs.randint(2))
+        pv, pi = paddle.topk(t(x), k2, axis=ax, largest=largest)
+        tv, ti = torch.topk(tt(x), k2, dim=ax, largest=largest)
+        check("topk_v", pv, tv, info=f"{sh} k={k2} lg={largest}")
+        check("kthvalue", paddle.kthvalue(t(x), k2, axis=ax)[0],
+              torch.kthvalue(tt(x), k2, dim=ax)[0], info=f"{sh} k={k2}")
+        check("median", paddle.median(t(x), ax, keepdim=kd),
+              np.median(x, axis=ax, keepdims=kd), info=f"{sh} ax={ax}")
+        check("median_min", paddle.median(t(x), ax, keepdim=kd, mode="min")[0]
+              if isinstance(paddle.median(t(x), ax, keepdim=kd, mode="min"), tuple)
+              else paddle.median(t(x), ax, keepdim=kd, mode="min"),
+              tt(x).median(ax, keepdim=kd)[0], info=f"{sh} ax={ax}")
+        q = float(rs.rand())
+        check("quantile", paddle.quantile(t(x), q, ax),
+              torch.quantile(tt(x), q, ax), info=f"{sh} q={q:.3f}")
+        check("searchsorted",
+              paddle.searchsorted(t(np.sort(x, -1)), t(x)),
+              torch.searchsorted(tt(np.sort(x, -1)), tt(x)),
+              info=f"{sh}")
+    except Exception as e:
+        fails.append(("sort", f"{sh}", repr(e)[:300]))
+
+print(f"fuzz done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:80])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70)
+    print(name, info)
+    print(msg)

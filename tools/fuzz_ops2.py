@@ -1,0 +1,184 @@
+"""Fuzz round 2: conv / interpolate / norm / pad / einsum vs torch."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import torch.nn.functional as tF
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N_ITER = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+fails = []
+
+def t(x): return paddle.to_tensor(x)
+def tt(x): return torch.tensor(x)
+
+def check(name, got, want, atol=1e-4, rtol=1e-4, info=""):
+    try:
+        g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        w = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+        assert g.shape == w.shape, f"shape {g.shape} vs {w.shape}"
+        np.testing.assert_allclose(g, w, atol=atol, rtol=rtol)
+    except Exception as e:
+        fails.append((name, info, str(e)[:300]))
+
+for it in range(N_ITER):
+    # --- conv2d with dilation/groups/asymmetric strides ---
+    try:
+        Ci = int(rs.randint(1, 3)) * 2
+        Co = int(rs.randint(1, 3)) * 2
+        g = int(rs.choice([1, 2]))
+        H, W = int(rs.randint(6, 14)), int(rs.randint(6, 14))
+        kh, kw = int(rs.randint(1, 4)), int(rs.randint(1, 4))
+        sh_, sw_ = int(rs.randint(1, 3)), int(rs.randint(1, 3))
+        dh, dw = int(rs.randint(1, 3)), int(rs.randint(1, 3))
+        ph, pw = int(rs.randint(0, 3)), int(rs.randint(0, 3))
+        if (kh - 1) * dh + 1 > H + 2 * ph or (kw - 1) * dw + 1 > W + 2 * pw:
+            raise ValueError("skip")
+        x = rs.randn(2, Ci, H, W).astype("f")
+        wgt = rs.randn(Co, Ci // g, kh, kw).astype("f")
+        b = rs.randn(Co).astype("f")
+        check("conv2d",
+              F.conv2d(t(x), t(wgt), t(b), stride=[sh_, sw_],
+                       padding=[ph, pw], dilation=[dh, dw], groups=g),
+              tF.conv2d(tt(x), tt(wgt), tt(b), stride=(sh_, sw_),
+                        padding=(ph, pw), dilation=(dh, dw), groups=g),
+              atol=1e-3, info=f"C{Ci}->{Co} g={g} k=({kh},{kw}) s=({sh_},{sw_}) d=({dh},{dw}) p=({ph},{pw})")
+        # conv_transpose2d
+        wt = rs.randn(Ci, Co // g, kh, kw).astype("f")
+        op_h = int(rs.randint(0, sh_)); op_w = int(rs.randint(0, sw_))
+        check("conv2d_transpose",
+              F.conv2d_transpose(t(x), t(wt), stride=[sh_, sw_],
+                                 padding=[ph, pw], groups=g,
+                                 output_padding=[op_h, op_w]),
+              tF.conv_transpose2d(tt(x), tt(wt), stride=(sh_, sw_),
+                                  padding=(ph, pw), groups=g,
+                                  output_padding=(op_h, op_w)),
+              atol=1e-3, info=f"g={g} k=({kh},{kw}) s=({sh_},{sw_}) p=({ph},{pw}) op=({op_h},{op_w})")
+    except ValueError:
+        pass
+    except Exception as e:
+        fails.append(("conv", "", repr(e)[:250]))
+    # --- interpolate modes ---
+    try:
+        H, W = int(rs.randint(3, 10)), int(rs.randint(3, 10))
+        oh, ow = int(rs.randint(1, 14)), int(rs.randint(1, 14))
+        x = rs.randn(1, 2, H, W).astype("f")
+        for mode in ("nearest", "bilinear", "area", "bicubic"):
+            kw = {}
+            tm = mode
+            if mode in ("bilinear", "bicubic"):
+                ac = bool(rs.randint(2))
+                kw = {"align_corners": ac}
+            check(f"interp_{mode}",
+                  F.interpolate(t(x), size=[oh, ow], mode=mode, **kw),
+                  tF.interpolate(tt(x), size=(oh, ow), mode=tm, **kw),
+                  atol=1e-3, info=f"{H}x{W}->{oh}x{ow} {kw}")
+        # scale_factor path
+        sf = float(rs.choice([0.5, 1.5, 2.0, 2.7]))
+        check("interp_scale",
+              F.interpolate(t(x), scale_factor=sf, mode="nearest"),
+              tF.interpolate(tt(x), scale_factor=sf, mode="nearest"),
+              info=f"{H}x{W} sf={sf}")
+    except Exception as e:
+        fails.append(("interp", "", repr(e)[:250]))
+    # --- norms eval/train ---
+    try:
+        C = int(rs.randint(2, 6))
+        N, L = int(rs.randint(2, 5)), int(rs.randint(3, 8))
+        x = rs.randn(N, C, L).astype("f")
+        wg = rs.randn(C).astype("f"); bs = rs.randn(C).astype("f")
+        rm = rs.randn(C).astype("f"); rv = rs.rand(C).astype("f") + 0.5
+        check("batch_norm_eval",
+              F.batch_norm(t(x), t(rm.copy()), t(rv.copy()), t(wg), t(bs),
+                           training=False),
+              tF.batch_norm(tt(x), tt(rm.copy()), tt(rv.copy()), tt(wg),
+                            tt(bs), training=False),
+              atol=1e-4, info=f"C={C}")
+        gs = int(rs.choice([1, 2]))
+        if C % gs == 0:
+            check("group_norm",
+                  F.group_norm(t(x), gs, weight=t(wg), bias=t(bs)),
+                  tF.group_norm(tt(x), gs, tt(wg), tt(bs)),
+                  atol=1e-4, info=f"C={C} g={gs}")
+        check("instance_norm", F.instance_norm(t(x)),
+              tF.instance_norm(tt(x)), atol=1e-4)
+        # rms/layer norm
+        check("layer_norm", F.layer_norm(t(x), [L]),
+              tF.layer_norm(tt(x), (L,)), atol=1e-4)
+        eps = float(rs.choice([1e-5, 1e-3]))
+        w1 = rs.randn(L).astype("f")
+        check("rms_norm", F.rms_norm(t(x), t(w1), epsilon=eps),
+              tF.rms_norm(tt(x), (L,), tt(w1), eps=eps), atol=1e-4)
+        # local_response_norm
+        check("lrn", F.local_response_norm(t(x), 3),
+              tF.local_response_norm(tt(x), 3), atol=1e-4)
+    except Exception as e:
+        fails.append(("norm", "", repr(e)[:250]))
+    # --- pad modes ---
+    try:
+        H, W = int(rs.randint(4, 9)), int(rs.randint(4, 9))
+        x = rs.randn(1, 2, H, W).astype("f")
+        l, r, tp, bt = (int(rs.randint(0, 3)) for _ in range(4))
+        for pm in ("constant", "reflect", "replicate", "circular"):
+            if pm == "reflect" and (l >= W or r >= W or tp >= H or bt >= H):
+                continue
+            kw = {"value": 1.5} if pm == "constant" else {}
+            tkw = {"value": 1.5} if pm == "constant" else {}
+            check(f"pad_{pm}",
+                  F.pad(t(x), [l, r, tp, bt], mode=pm, **kw),
+                  tF.pad(tt(x), (l, r, tp, bt), mode=pm, **tkw),
+                  info=f"{H}x{W} {(l,r,tp,bt)}")
+    except Exception as e:
+        fails.append(("pad", "", repr(e)[:250]))
+    # --- einsum random contractions ---
+    try:
+        a = rs.randn(3, 4, 5).astype("f")
+        b = rs.randn(5, 4, 2).astype("f")
+        for eq, ops in [("abc,cbd->ad", (a, b)), ("abc,cbd->abd", (a, b)),
+                        ("abc->ca", (a,)), ("abc,abc->", (a, a)),
+                        ("abc,cbd->bad", (a, b))]:
+            check(f"einsum_{eq}", paddle.einsum(eq, *[t(o) for o in ops]),
+                  torch.einsum(eq, *[tt(o) for o in ops]), atol=1e-4)
+    except Exception as e:
+        fails.append(("einsum", "", repr(e)[:250]))
+    # --- activations long tail ---
+    try:
+        x = (rs.randn(*[int(rs.randint(1, 7)) for _ in range(2)]) * 3).astype("f")
+        pairs = [("celu", lambda v: F.celu(t(v), alpha=1.3),
+                  lambda v: tF.celu(tt(v), alpha=1.3)),
+                 ("hardshrink", lambda v: F.hardshrink(t(v), threshold=0.4),
+                  lambda v: tF.hardshrink(tt(v), lambd=0.4)),
+                 ("softshrink", lambda v: F.softshrink(t(v), threshold=0.4),
+                  lambda v: tF.softshrink(tt(v), lambd=0.4)),
+                 ("tanhshrink", lambda v: F.tanhshrink(t(v)),
+                  lambda v: tF.tanhshrink(tt(v))),
+                 ("logsigmoid", lambda v: F.log_sigmoid(t(v)),
+                  lambda v: tF.logsigmoid(tt(v))),
+                 ("rrelu_eval", lambda v: F.rrelu(t(v), training=False),
+                  lambda v: tF.rrelu(tt(v), training=False)),
+                 ("glu", lambda v: F.glu(t(np.concatenate([v, v], -1))),
+                  lambda v: tF.glu(tt(np.concatenate([v, v], -1)))),
+                 ("mish", lambda v: F.mish(t(v)), lambda v: tF.mish(tt(v))),
+                 ("softsign", lambda v: F.softsign(t(v)),
+                  lambda v: tF.softsign(tt(v))),
+                 ("hardsigmoid", lambda v: F.hardsigmoid(t(v)),
+                  lambda v: tF.hardsigmoid(tt(v))),
+                 ("hardswish", lambda v: F.hardswish(t(v)),
+                  lambda v: tF.hardswish(tt(v)))]
+        for nm, pf, tfn in pairs:
+            check(nm, pf(x), tfn(x), atol=1e-4)
+    except Exception as e:
+        fails.append(("act", "", repr(e)[:250]))
+
+print(f"fuzz2 done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:60])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70)
+    print(name, info)
+    print(msg[:350])

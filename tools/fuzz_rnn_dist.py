@@ -1,0 +1,101 @@
+"""Fuzz RNN cells/layers (weight-copy parity vs torch) + distributions."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+fails = []
+
+def check(name, got, want, atol=2e-4, info=""):
+    try:
+        g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        w = want.detach().numpy() if hasattr(want, "detach") else np.asarray(want)
+        assert g.shape == w.shape, f"shape {g.shape} vs {w.shape}"
+        np.testing.assert_allclose(g, w, atol=atol, rtol=1e-3)
+    except Exception as e:
+        fails.append((name, info, str(e)[:250]))
+
+for it in range(N):
+    I, Hd = int(rs.randint(2, 6)), int(rs.randint(2, 6))
+    B, T = int(rs.randint(1, 4)), int(rs.randint(2, 6))
+    x = rs.randn(B, T, I).astype("f")
+    for kind in ("LSTM", "GRU", "SimpleRNN"):
+        try:
+            bidir = bool(rs.randint(2))
+            layers = int(rs.randint(1, 3))
+            pk = dict(num_layers=layers,
+                      direction="bidirect" if bidir else "forward")
+            p = getattr(nn, kind)(I, Hd, **pk)
+            tname = {"LSTM": "LSTM", "GRU": "GRU", "SimpleRNN": "RNN"}[kind]
+            q = getattr(torch.nn, tname)(I, Hd, num_layers=layers,
+                                         bidirectional=bidir,
+                                         batch_first=True)
+            # copy weights torch -> paddle
+            sd = {}
+            for tn, tv in q.named_parameters():
+                sd[tn] = tv.detach().numpy()
+            psd = p.state_dict()
+            for pn in psd:
+                if pn in sd:
+                    psd[pn] = paddle.to_tensor(sd[pn])
+                else:
+                    fails.append((kind, f"param name mismatch {pn}", ""))
+            p.set_state_dict({k: (v if isinstance(v, paddle.Tensor)
+                                  else paddle.to_tensor(v))
+                              for k, v in psd.items()})
+            po, _ = p(paddle.to_tensor(x))
+            to, _ = q(torch.tensor(x))
+            check(kind, po, to, info=f"I={I} H={Hd} L={layers} bi={bidir}")
+        except Exception as e:
+            fails.append((kind, "", repr(e)[:250]))
+
+# distributions: log_prob/entropy/kl vs torch
+import paddle_tpu.distribution as D
+import torch.distributions as TD
+for it in range(N):
+    try:
+        loc = float(rs.randn()); sc = float(rs.rand() + 0.2)
+        v = rs.randn(7).astype("f")
+        check("normal_lp", D.Normal(loc, sc).log_prob(paddle.to_tensor(v)),
+              TD.Normal(loc, sc).log_prob(torch.tensor(v)))
+        check("normal_ent", D.Normal(loc, sc).entropy(),
+              TD.Normal(loc, sc).entropy())
+        r1, r2 = float(rs.rand() + 0.5), float(rs.rand() + 0.5)
+        vp = (rs.rand(7).astype("f") + 0.1) * 3
+        check("gamma_lp", D.Gamma(r1, r2).log_prob(paddle.to_tensor(vp)),
+              TD.Gamma(r1, r2).log_prob(torch.tensor(vp)))
+        bv = np.clip(rs.rand(7).astype("f"), 0.01, 0.99)
+        check("beta_lp", D.Beta(r1, r2).log_prob(paddle.to_tensor(bv)),
+              TD.Beta(r1, r2).log_prob(torch.tensor(bv)))
+        probs = rs.rand(5).astype("f"); probs /= probs.sum()
+        kk = rs.randint(0, 5, (6,)).astype("i8")
+        check("categorical_lp",
+              D.Categorical(paddle.to_tensor(probs)).log_prob(paddle.to_tensor(kk)),
+              TD.Categorical(torch.tensor(probs)).log_prob(torch.tensor(kk)))
+        check("kl_normal",
+              D.kl_divergence(D.Normal(loc, sc), D.Normal(0.0, 1.0)),
+              TD.kl_divergence(TD.Normal(loc, sc), TD.Normal(0.0, 1.0)))
+        lam = float(rs.rand() * 3 + 0.3)
+        vpo = rs.poisson(2, 7).astype("f")
+        check("poisson_lp", D.Poisson(lam).log_prob(paddle.to_tensor(vpo)),
+              TD.Poisson(lam).log_prob(torch.tensor(vpo)))
+        # laplace, gumbel
+        check("laplace_lp", D.Laplace(loc, sc).log_prob(paddle.to_tensor(v)),
+              TD.Laplace(loc, sc).log_prob(torch.tensor(v)))
+        check("gumbel_lp", D.Gumbel(loc, sc).log_prob(paddle.to_tensor(v)),
+              TD.Gumbel(loc, sc).log_prob(torch.tensor(v)))
+    except Exception as e:
+        fails.append(("dist", "", repr(e)[:250]))
+
+print(f"rnn/dist fuzz done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:60])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70); print(name, info); print(msg[:300])

@@ -72,6 +72,14 @@ def split(x, num_or_sections, axis=0, name=None):
     ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
     dim = x._value.shape[ax]
     if isinstance(num_or_sections, int):
+        if dim % num_or_sections:
+            # paddle contract: an int num must evenly divide the axis
+            # (the old silent floor-split put the remainder in the last
+            # chunk — r5 fuzz find); pass explicit sections for ragged
+            raise ValueError(
+                f"paddle.split: axis {ax} (size {dim}) is not divisible "
+                f"by num_or_sections={num_or_sections}; pass a sections "
+                "list for uneven splits")
         idx = np.cumsum([dim // num_or_sections] * (num_or_sections - 1))
     else:
         secs = [int(s) for s in num_or_sections]

@@ -266,7 +266,11 @@ def vflip(img):
 def _blend(a, b, alpha):
     out = np.asarray(a, np.float32) * alpha + np.asarray(b, np.float32) \
         * (1 - alpha)
-    return np.clip(out, 0, 255).astype(np.asarray(a).dtype)
+    # value range follows the dtype: float images live in [0, 1],
+    # integer images in [0, 255] (r5 fuzz find — float inputs were
+    # clipped at 255, i.e. never)
+    hi = 255 if np.issubdtype(np.asarray(a).dtype, np.integer) else 1.0
+    return np.clip(out, 0, hi).astype(np.asarray(a).dtype)
 
 
 def adjust_brightness(img, brightness_factor):
@@ -334,7 +338,10 @@ def center_crop(img, output_size):
     h, w = _img_hw(img)
     oh, ow = ((output_size, output_size) if isinstance(output_size, int)
               else tuple(output_size))
-    return crop(img, (h - oh) // 2, (w - ow) // 2, oh, ow)
+    # round(), not floor: the upstream/torchvision origin convention
+    # (differs for odd margins — r5 fuzz find)
+    return crop(img, int(round((h - oh) / 2.0)),
+                int(round((w - ow) / 2.0)), oh, ow)
 
 
 def pad(img, padding, fill=0, padding_mode="constant"):

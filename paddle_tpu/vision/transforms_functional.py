@@ -1,15 +1,79 @@
 """paddle.vision.transforms.functional parity — the functional forms of
-the transform ops (python/paddle/vision/transforms/functional.py). Thin
-re-exports of the implementations in transforms.py with the reference's
-public names."""
+the transform ops (python/paddle/vision/transforms/functional.py).
+
+The ndarray/PIL path works on HWC images (the reference's numpy
+contract). Tensor inputs are CHW (the reference's tensor contract) and
+return Tensors — r5 fuzz find: CHW Tensors were being cropped/flipped
+along the wrong axes when handed to the HWC implementations."""
 from __future__ import annotations
 
-from .transforms import (  # noqa: F401
-    normalize, resize, hflip, vflip, adjust_brightness, adjust_contrast,
-    adjust_saturation, adjust_hue, to_grayscale, crop, center_crop, pad,
-    erase, affine, rotate, perspective,
-)
+import functools
+
+import numpy as np
+
+from . import transforms as _T
 from .transforms import to_tensor_fn as to_tensor  # noqa: F401
+
+
+def _wrap_chw(fn):
+    """Adapt an HWC-ndarray transform to accept CHW Tensors. Only 3-D
+    image Tensors are accepted — paddle's functional rejects batched
+    tensors, and passing one through the HWC path would silently
+    transform the wrong axes."""
+    @functools.wraps(fn)
+    def wrapped(img, *args, **kwargs):
+        from ..tensor import Tensor
+        if isinstance(img, Tensor):
+            arr = np.asarray(img.numpy())
+            if arr.ndim != 3:
+                raise ValueError(
+                    f"{fn.__name__}: Tensor images must be 3-D CHW, got "
+                    f"shape {tuple(arr.shape)} (apply per image for "
+                    "batches)")
+            out = fn(arr.transpose(1, 2, 0), *args, **kwargs)
+            if isinstance(out, np.ndarray) and out.ndim == 3:
+                out = out.transpose(2, 0, 1)
+            return Tensor(np.ascontiguousarray(out))
+        return fn(img, *args, **kwargs)
+    return wrapped
+
+
+resize = _wrap_chw(_T.resize)
+hflip = _wrap_chw(_T.hflip)
+vflip = _wrap_chw(_T.vflip)
+adjust_brightness = _wrap_chw(_T.adjust_brightness)
+adjust_contrast = _wrap_chw(_T.adjust_contrast)
+adjust_saturation = _wrap_chw(_T.adjust_saturation)
+adjust_hue = _wrap_chw(_T.adjust_hue)
+to_grayscale = _wrap_chw(_T.to_grayscale)
+crop = _wrap_chw(_T.crop)
+center_crop = _wrap_chw(_T.center_crop)
+pad = _wrap_chw(_T.pad)
+affine = _wrap_chw(_T.affine)
+rotate = _wrap_chw(_T.rotate)
+perspective = _wrap_chw(_T.perspective)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """CHW Tensors erase in their native layout with a (C, h, w) value
+    (the upstream tensor contract — the HWC adapter would transpose the
+    region but not `v`); ndarray/PIL inputs use the HWC path."""
+    from ..tensor import Tensor
+    if isinstance(img, Tensor):
+        arr = np.asarray(img.numpy()).copy()
+        val = np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+        arr[..., i:i + h, j:j + w] = val
+        out = Tensor(arr)
+        if inplace:
+            img._inplace_update(out)
+            return img
+        return out
+    return _T.erase(img, i, j, h, w, v, inplace)
+
+
+# Normalize handles Tensor inputs and data_format natively
+normalize = _T.normalize
+
 
 __all__ = ["normalize", "resize", "hflip", "vflip", "adjust_brightness",
            "adjust_contrast", "adjust_saturation", "adjust_hue",

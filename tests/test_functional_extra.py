@@ -1030,3 +1030,42 @@ class TestRound5FuzzFinds:
         ig = np.array([3, -100, 2, 5], "i8")
         out2 = F.cross_entropy(t(lg), t(ig), reduction="none")
         assert np.isfinite(out2.numpy()).all() and out2.numpy()[1] == 0
+
+    def test_vision_transforms_chw_tensor_and_conventions(self):
+        # r5 fuzz finds: CHW Tensors route through the CHW adapter;
+        # center_crop rounds its origin; float images clip at 1.0;
+        # split with a non-divisible int raises (paddle contract)
+        import paddle_tpu.vision.transforms.functional as TVF
+        rs = np.random.RandomState(0)
+        img = rs.rand(3, 10, 16).astype("f")
+        got = TVF.crop(t(img.copy()), 2, 8, 4, 5)
+        np.testing.assert_allclose(got.numpy(), img[:, 2:6, 8:13])
+        got = TVF.hflip(t(img.copy()))
+        np.testing.assert_allclose(got.numpy(), img[:, :, ::-1])
+        got = TVF.center_crop(t(img.copy()), 9)
+        # round((10-9)/2)=0 (banker's), round((16-9)/2)=4
+        np.testing.assert_allclose(got.numpy(), img[:, 0:9, 4:13])
+        got = TVF.adjust_brightness(t(img.copy()), 1.7)
+        np.testing.assert_allclose(got.numpy(),
+                                   np.clip(img * 1.7, 0, 1.0), atol=1e-6)
+        # HWC ndarray path unchanged
+        hwc = img.transpose(1, 2, 0)
+        np.testing.assert_allclose(TVF.vflip(hwc), hwc[::-1])
+        with pytest.raises(ValueError, match="divisible"):
+            paddle.split(t(np.zeros((5, 2), "f")), 4, axis=0)
+
+    def test_vision_erase_chw_and_batched_reject(self):
+        import paddle_tpu.vision.transforms.functional as TVF
+        img = t(np.zeros((3, 6, 8), "f"))
+        v = t(np.ones((3, 2, 2), "f") * 5)
+        out = TVF.erase(img, 1, 2, 2, 2, v)
+        o = out.numpy()
+        assert (o[:, 1:3, 2:4] == 5).all() and o.sum() == 5 * 12
+        # inplace writes back into the caller's tensor
+        img2 = t(np.zeros((3, 6, 8), "f"))
+        r = TVF.erase(img2, 0, 0, 1, 1, t(np.ones((3, 1, 1), "f")),
+                      inplace=True)
+        assert r is img2 and img2.numpy()[:, 0, 0].sum() == 3
+        # batched tensors are rejected, not silently mis-flipped
+        with pytest.raises(ValueError, match="3-D CHW"):
+            TVF.hflip(t(np.zeros((2, 3, 4, 5), "f")))

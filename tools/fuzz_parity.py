@@ -3,7 +3,8 @@
     env -u PALLAS_AXON_POOL_IPS python tools/fuzz_parity.py [family] [seed] [iters]
 
 Families: ops (reductions/manipulation/losses/pooling/linalg/sorting),
-ops2 (conv/interpolate/norm/pad/einsum/activations), grads (backward vs
+ops2 (conv/interpolate/norm/pad/einsum/activations), vision
+(transforms + manipulation long tail), grads (backward vs
 torch autograd), rnn_dist (RNN weight-copy + distribution goldens),
 cf_fft_linalg (dy2static control flow, fft/stft, decompositions),
 index (getitem/setitem). Default: every family, seed 0.
@@ -29,6 +30,7 @@ FAMILIES = {
     "rnn_dist": "fuzz_rnn_dist.py",
     "cf_fft_linalg": "fuzz3.py",
     "index": "fuzz_index.py",
+    "vision": "fuzz_vision.py",
 }
 
 

@@ -1,0 +1,133 @@
+"""Fuzz vision transforms + manipulation long tail."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import paddle_tpu as paddle
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 15
+fails = []
+t = paddle.to_tensor
+
+def check(name, got, want, atol=1e-4, info=""):
+    try:
+        g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        w = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+        assert g.shape == w.shape, f"shape {g.shape} vs {w.shape}"
+        np.testing.assert_allclose(g, w, atol=atol, rtol=1e-4)
+    except Exception as e:
+        fails.append((name, info, str(e)[:250]))
+
+import paddle_tpu.vision.transforms as T
+import paddle_tpu.vision.transforms.functional as TVF
+
+for it in range(N):
+    H, W = int(rs.randint(6, 20)), int(rs.randint(6, 20))
+    img = rs.rand(3, H, W).astype("f")   # CHW float
+    # --- functional transforms vs manual numpy ---
+    try:
+        # normalize
+        mean = rs.rand(3).astype("f").tolist()
+        std = (rs.rand(3).astype("f") + 0.5).tolist()
+        got = TVF.normalize(t(img.copy()), mean, std)
+        want = (img - np.array(mean)[:, None, None]) / np.array(std)[:, None, None]
+        check("normalize", got, want, info=f"{H}x{W}")
+        # hflip/vflip
+        check("hflip", TVF.hflip(t(img.copy())), img[:, :, ::-1])
+        check("vflip", TVF.vflip(t(img.copy())), img[:, ::-1])
+        # crop
+        ch, cw = int(rs.randint(1, H)), int(rs.randint(1, W))
+        ty, tx = int(rs.randint(0, H - ch + 1)), int(rs.randint(0, W - cw + 1))
+        check("crop", TVF.crop(t(img.copy()), ty, tx, ch, cw),
+              img[:, ty:ty + ch, tx:tx + cw], info=f"{ty},{tx},{ch},{cw}")
+        # center crop
+        cc = int(rs.randint(1, min(H, W)))
+        got = TVF.center_crop(t(img.copy()), cc)
+        y0 = int(round((H - cc) / 2.0)); x0 = int(round((W - cc) / 2.0))
+        check("center_crop", got, img[:, y0:y0+cc, x0:x0+cc], info=f"cc={cc} {H}x{W}")
+        # pad
+        pl, pr2, pt, pb = (int(rs.randint(0, 4)) for _ in range(4))
+        got = TVF.pad(t(img.copy()), [pl, pt, pr2, pb])
+        want = np.pad(img, [(0, 0), (pt, pb), (pl, pr2)])
+        check("tv_pad", got, want, info=f"{(pl,pt,pr2,pb)}")
+        # adjust brightness/contrast (vs torchvision formulas)
+        fb = float(rs.rand() * 2)
+        check("brightness", TVF.adjust_brightness(t(img.copy()), fb),
+              np.clip(img * fb, 0, 1), info=f"f={fb:.2f}")
+        # to_grayscale on CHW
+        g1 = TVF.to_grayscale(t(img.copy()), num_output_channels=1) \
+            if hasattr(TVF, "to_grayscale") else None
+    except Exception as e:
+        fails.append(("transforms", "", repr(e)[:250]))
+    # --- manipulation long tail vs torch ---
+    try:
+        sh = tuple(int(rs.randint(1, 6)) for _ in range(3))
+        x = rs.randn(*sh).astype("f")
+        xt = torch.tensor(x)
+        reps = [int(rs.randint(1, 4)) for _ in range(3)]
+        check("tile", paddle.tile(t(x), reps), xt.repeat(*reps))
+        ax = int(rs.randint(0, 3))
+        r = int(rs.randint(1, 4))
+        check("repeat_interleave",
+              paddle.repeat_interleave(t(x), r, axis=ax),
+              torch.repeat_interleave(xt, r, dim=ax), info=f"ax={ax} r={r}")
+        # per-element repeats
+        nr = rs.randint(1, 4, (sh[ax],)).astype("i8")
+        check("repeat_interleave_vec",
+              paddle.repeat_interleave(t(x), t(nr), axis=ax),
+              torch.repeat_interleave(xt, torch.tensor(nr), dim=ax),
+              info=f"ax={ax}")
+        # unbind/chunk/split
+        outs = paddle.unbind(t(x), axis=ax)
+        touts = torch.unbind(xt, dim=ax)
+        for a, b in zip(outs, touts):
+            check("unbind", a, b)
+        divs = [d for d in range(1, sh[ax] + 1) if sh[ax] % d == 0]
+        nch = int(divs[rs.randint(len(divs))])
+        pch = paddle.chunk(t(x), nch, axis=ax)
+        tch = torch.chunk(xt, nch, dim=ax)
+        assert len(pch) == len(tch), (len(pch), len(tch))
+        for a, b in zip(pch, tch):
+            check("chunk", a, b, info=f"ax={ax} n={nch} sh={sh}")
+        # flatten/unflatten
+        check("flatten02", paddle.flatten(t(x), 0, 1),
+              torch.flatten(xt, 0, 1))
+        # diff / diag tails
+        check("diff", paddle.diff(t(x), axis=ax), torch.diff(xt, dim=ax))
+        m2 = rs.randn(4, 5).astype("f")
+        off = int(rs.randint(-3, 4))
+        check("diagonal", paddle.diagonal(t(m2), offset=off),
+              torch.diagonal(torch.tensor(m2), offset=off), info=f"off={off}")
+        check("diag_embed", paddle.diag_embed(t(m2[0])),
+              torch.diag_embed(torch.tensor(m2[0])))
+        check("rot90", paddle.rot90(t(m2), k=int(rs.randint(-3, 4))),
+              torch.rot90(torch.tensor(m2), k=0), info="k-varies") if False else None
+        k_ = int(rs.randint(-3, 4))
+        check("rot90", paddle.rot90(t(m2), k=k_),
+              torch.rot90(torch.tensor(m2), k=k_), info=f"k={k_}")
+        # masked ops
+        mm = rs.rand(4, 5) > 0.5
+        check("masked_select", paddle.masked_select(t(m2), t(mm)),
+              torch.masked_select(torch.tensor(m2), torch.tensor(mm)))
+        check("masked_fill", paddle.masked_fill(t(m2), t(mm), 9.0),
+              torch.tensor(m2).masked_fill(torch.tensor(mm), 9.0))
+        # index_select / index_add
+        ii = rs.randint(0, 4, (3,)).astype("i8")
+        check("index_select", paddle.index_select(t(m2), t(ii), axis=0),
+              torch.index_select(torch.tensor(m2), 0, torch.tensor(ii)))
+        src = rs.randn(3, 5).astype("f")
+        check("index_add", paddle.index_add(t(m2.copy()), t(ii), 0, t(src)),
+              torch.tensor(m2).index_add(0, torch.tensor(ii),
+                                         torch.tensor(src)))
+    except Exception as e:
+        fails.append(("manip2", "", repr(e)[:250]))
+
+print(f"visionfuzz done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:70])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70); print(name, info); print(msg[:300])

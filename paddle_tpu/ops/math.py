@@ -113,9 +113,29 @@ def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
 
 
 # --------------------------------------------------------------- binary ----
+def _float_scalar_vs_int_tensor(s, other):
+    """paddle/torch scalar rule: a python float (or complex) paired
+    with an integer/bool tensor promotes to the DEFAULT dtype —
+    float32/complex64 — where under jax_enable_x64 the weak python
+    scalar would drag the result to float64/complex128 (r5 fuzz find).
+    Inexact tensors keep weak-scalar behavior (f32 + 0.5 stays f32,
+    f64 + 0.5 stays f64). Note the isinstance ladder: python floats ARE
+    instances of complex, so float is tested first."""
+    if (isinstance(other, Tensor)
+            and not jnp.issubdtype(other._value.dtype, jnp.inexact)):
+        if isinstance(s, float):
+            return np.float32(s)
+        if isinstance(s, complex):
+            return np.complex64(s)
+    return s
+
+
 def _binary(jfn, name):
     def op(x, y, name=None):
-        return apply(jfn, _scalarize(x), _scalarize(y), _name=name)
+        a, b = _scalarize(x), _scalarize(y)
+        a, b = (_float_scalar_vs_int_tensor(a, b),
+                _float_scalar_vs_int_tensor(b, a))
+        return apply(jfn, a, b, _name=name)
     op.__name__ = name
     return op
 
@@ -148,12 +168,19 @@ ldexp = _binary(lambda x, y: x * (2.0 ** y).astype(x.dtype)
 
 
 def divide_no_nan(x, y, name=None):
+    a, b = _scalarize(x), _scalarize(y)
+    a, b = (_float_scalar_vs_int_tensor(a, b),
+            _float_scalar_vs_int_tensor(b, a))
     return apply(lambda a, b: jnp.where(b == 0, 0, a / jnp.where(b == 0, 1, b)),
-                 _scalarize(x), _scalarize(y))
+                 a, b)
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     s = scale.item() if isinstance(scale, Tensor) else scale
+    xt = _coerce(x)
+    s = _float_scalar_vs_int_tensor(s, xt)
+    bias = _float_scalar_vs_int_tensor(bias, xt)
+    x = xt
     if bias_after_scale:
         out = apply(lambda v: v * s + bias, _coerce(x))
     else:

@@ -751,3 +751,21 @@ class TestModeParity:
         v, _ = paddle.mode(paddle.to_tensor(
             np.array([[2, 3, 0, 2, 0, 0, 0]])), axis=1)
         assert int(v.numpy()[0]) == 0
+
+
+class TestScalarPromotionR5:
+    def test_float_scalar_with_int_tensor_gives_f32(self):
+        """r5 fuzz find: int tensor + python float promotes to the
+        default float dtype (f32), matching paddle/torch — not the
+        weak-f64 jax_enable_x64 would produce."""
+        a = paddle.to_tensor(np.array([1, 2, 3], np.int64))
+        for out in (a + 0.5, 0.5 + a, a * 2.5, a - 0.5, a / 2.0):
+            assert str(out.dtype).endswith("float32"), out.dtype
+        np.testing.assert_allclose((a + 0.5).numpy(), [1.5, 2.5, 3.5])
+        # float tensors keep their own dtype against weak scalars
+        f64 = paddle.to_tensor(np.array([1.0], np.float64))
+        assert str((f64 + 0.5).dtype).endswith("float64")
+        f32 = paddle.to_tensor(np.array([1.0], np.float32))
+        assert str((f32 + 0.5).dtype).endswith("float32")
+        b = paddle.to_tensor(np.array([True, False]))
+        assert str((b + 0.5).dtype).endswith("float32")

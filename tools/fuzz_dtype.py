@@ -1,0 +1,98 @@
+"""Fuzz dtype promotion + broadcasting + comparison/bitwise ops."""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import paddle_tpu as paddle
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+fails = []
+t = paddle.to_tensor
+
+DTYPES = ["float32", "float64", "int32", "int64", "bool", "float16"]
+
+def check(name, got, want_arr, want_dtype, info=""):
+    try:
+        g = got.numpy()
+        assert str(got.dtype).replace("paddle.", "") == want_dtype, \
+            f"dtype {got.dtype} vs {want_dtype}"
+        np.testing.assert_allclose(
+            np.asarray(g, np.float64), np.asarray(want_arr, np.float64),
+            rtol=1e-3, atol=1e-3)
+    except Exception as e:
+        fails.append((name, info, str(e)[:200]))
+
+for it in range(N):
+    d1, d2 = DTYPES[rs.randint(len(DTYPES))], DTYPES[rs.randint(len(DTYPES))]
+    a = (rs.rand(3, 4) * 4 + 1).astype(d1)
+    b = (rs.rand(3, 4) * 4 + 1).astype(d2)
+    ta, tb = torch.tensor(a), torch.tensor(b)
+    for opn, pop, topk in [("add", lambda x, y: x + y, lambda x, y: x + y),
+                           ("mul", lambda x, y: x * y, lambda x, y: x * y),
+                           ("sub", lambda x, y: x - y, lambda x, y: x - y)]:
+        if "bool" in (d1, d2) and opn == "sub":
+            continue
+        try:
+            want = topk(ta, tb)
+            got = pop(t(a.copy()), t(b.copy()))
+            check(f"{opn}_{d1}_{d2}", got, want.numpy(),
+                  str(want.dtype).replace("torch.", ""), info=f"{d1}+{d2}")
+        except Exception as e:
+            fails.append((f"{opn}_{d1}_{d2}", "", repr(e)[:200]))
+    # scalar promotion: int tensor + python float -> float
+    try:
+        ai = (rs.rand(3) * 5).astype("int64")
+        got = t(ai) + 0.5
+        want = torch.tensor(ai) + 0.5
+        check("int_plus_pyfloat", got, want.numpy(),
+              str(want.dtype).replace("torch.", ""))
+        gf = t(rs.rand(3).astype("float32")) * 2
+        assert str(gf.dtype).endswith("float32"), gf.dtype
+    except Exception as e:
+        fails.append(("scalar_promo", "", repr(e)[:200]))
+    # comparisons return bool; bitwise on ints
+    try:
+        x = (rs.rand(4) * 9).astype("int32")
+        y = (rs.rand(4) * 9).astype("int32")
+        for opn, pfn, tfn in [
+                ("bitwise_and", paddle.bitwise_and, torch.bitwise_and),
+                ("bitwise_xor", paddle.bitwise_xor, torch.bitwise_xor),
+                ("bitwise_or", paddle.bitwise_or, torch.bitwise_or)]:
+            got = pfn(t(x), t(y))
+            want = tfn(torch.tensor(x), torch.tensor(y))
+            check(opn, got, want.numpy(), "int32")
+        got = t(x) > t(y)
+        assert str(got.dtype).endswith("bool"), got.dtype
+        # shifts
+        got = t(x) << 2
+        want = torch.tensor(x) << 2
+        check("lshift", got, want.numpy(), "int32")
+        got = t(x) >> 1
+        want = torch.tensor(x) >> 1
+        check("rshift", got, want.numpy(), "int32")
+        # floor_divide / mod with negatives
+        xn = (rs.randint(-9, 9, (6,))).astype("int64")
+        yn = np.where(rs.randint(0, 2, 6) > 0, 3, -4).astype("int64")
+        got = paddle.floor_divide(t(xn), t(yn))
+        want = torch.floor_divide(torch.tensor(xn), torch.tensor(yn))
+        check("floor_div_neg", got, want.numpy(), "int64")
+        got = paddle.mod(t(xn), t(yn))
+        want = torch.remainder(torch.tensor(xn), torch.tensor(yn))
+        check("mod_neg", got, want.numpy(), "int64")
+        xf = rs.randn(6).astype("f") * 5
+        yf = np.where(rs.rand(6) > 0.5, 2.5, -1.5).astype("f")
+        got = paddle.remainder(t(xf), t(yf))
+        want = torch.remainder(torch.tensor(xf), torch.tensor(yf))
+        check("remainder_f", got, want.numpy(), "float32")
+    except Exception as e:
+        fails.append(("intops", "", repr(e)[:200]))
+
+print(f"dtypefuzz done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name.split("_")[0], msg[:50])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70); print(name, info); print(msg[:250])

@@ -31,6 +31,7 @@ FAMILIES = {
     "cf_fft_linalg": "fuzz3.py",
     "index": "fuzz_index.py",
     "vision": "fuzz_vision.py",
+    "dtype": "fuzz_dtype.py",
 }
 
 

@@ -315,6 +315,46 @@ class LlamaForCausalLM(Layer, GenerationMixin):
     def backbone(self):
         return self.llama
 
+    def load_hf_state_dict(self, hf_state_dict):
+        """Import HuggingFace Llama weights (ecosystem parity:
+        PaddleNLP's convert from transformers checkpoints). Accepts an
+        HF model's state_dict (torch tensors or arrays); names map 1:1
+        with the `model.` → `llama.` prefix swap and 2-D Linear weights
+        transpose to paddle's [in, out] layout. Verified bit-tight
+        against transformers (tests/test_hf_parity.py)."""
+        from ..tensor import Tensor
+        import numpy as np
+
+        def to_np(p):
+            if hasattr(p, "detach"):          # torch tensor: may be
+                p = p.detach().cpu()          # CUDA-resident or bf16,
+                if str(p.dtype) == "torch.bfloat16":
+                    p = p.float()             # which .numpy() rejects
+                return p.numpy()
+            return np.asarray(p)
+
+        sd = {}
+        for name, p in hf_state_dict.items():
+            if name == "lm_head.weight" and self.lm_head is None:
+                # tied-embedding checkpoints carry the tied weight under
+                # both keys; the tied model reads embed_tokens only
+                continue
+            a = to_np(p)
+            our = name.replace("model.", "llama.", 1)
+            if name.endswith(".weight") and a.ndim == 2 \
+                    and "embed_tokens" not in name:
+                a = a.T
+            sd[our] = Tensor(np.ascontiguousarray(a))
+        own = set(self.state_dict())
+        unknown = [k for k in sd if k not in own]
+        missing = [k for k in own if k not in sd]
+        if unknown or missing:
+            raise ValueError(
+                f"HF state_dict mismatch: unknown={unknown[:5]} "
+                f"missing={missing[:5]}")
+        self.set_state_dict(sd)
+        return self
+
 
 class LlamaPretrainingCriterion(Layer):
     """Shift-labels causal LM loss (ecosystem parity: PaddleNLP

@@ -150,6 +150,10 @@ class GPTForCausalLM(Layer, GenerationMixin):
         self.config = config
         self.gpt = GPTModel(config)
 
+    def load_hf_state_dict(self, hf_state_dict):
+        """Import HuggingFace GPT-2 weights — see _load_hf_gpt2."""
+        return _load_hf_gpt2(self, hf_state_dict)
+
     def forward(self, input_ids, attn_mask=None, position_ids=None,
                 past_key_values=None, use_cache=False):
         out = self.gpt(input_ids, attn_mask, position_ids,
@@ -163,3 +167,36 @@ class GPTForCausalLM(Layer, GenerationMixin):
         if use_cache:
             return logits, caches
         return logits
+
+
+def _gpt2_hf_key(name):
+    """HF GPT-2 key → our key (transformer.h.N.attn.c_attn → gpt.h.N.qkv
+    etc.). HF's Conv1D already stores [in, out] — no transposes."""
+    n = name.replace("transformer.", "gpt.")
+    return (n.replace(".attn.c_attn", ".qkv")
+             .replace(".attn.c_proj", ".proj")
+             .replace(".mlp.c_fc", ".fc1")
+             .replace(".mlp.c_proj", ".fc2")
+             .replace(".ln_1.", ".ln1.")
+             .replace(".ln_2.", ".ln2."))
+
+
+def _load_hf_gpt2(self, hf_state_dict):
+    """Import HuggingFace GPT-2 weights (ecosystem parity with the
+    transformers checkpoint format; logits verified to ~1e-5 in
+    tests/test_hf_parity.py). The lm head is tied to wte in both
+    models, so HF's alias key is skipped; `attn.bias` causal-mask
+    buffers are layout artifacts, not parameters."""
+    import numpy as np
+    from ..tensor import Tensor
+    from ._hf_import import hf_tensor_to_numpy, validate_keys
+    sd = {}
+    for name, p in hf_state_dict.items():
+        if name == "lm_head.weight" or name.endswith(".attn.bias") \
+                or name.endswith(".attn.masked_bias"):
+            continue
+        sd[_gpt2_hf_key(name)] = Tensor(
+            np.ascontiguousarray(hf_tensor_to_numpy(p)))
+    validate_keys(self, sd, "HF GPT-2")
+    self.set_state_dict(sd)
+    return self

@@ -323,16 +323,8 @@ class LlamaForCausalLM(Layer, GenerationMixin):
         transpose to paddle's [in, out] layout. Verified bit-tight
         against transformers (tests/test_hf_parity.py)."""
         from ..tensor import Tensor
+        from ._hf_import import hf_tensor_to_numpy as to_np, validate_keys
         import numpy as np
-
-        def to_np(p):
-            if hasattr(p, "detach"):          # torch tensor: may be
-                p = p.detach().cpu()          # CUDA-resident or bf16,
-                if str(p.dtype) == "torch.bfloat16":
-                    p = p.float()             # which .numpy() rejects
-                return p.numpy()
-            return np.asarray(p)
-
         sd = {}
         for name, p in hf_state_dict.items():
             if name == "lm_head.weight" and self.lm_head is None:
@@ -345,13 +337,7 @@ class LlamaForCausalLM(Layer, GenerationMixin):
                     and "embed_tokens" not in name:
                 a = a.T
             sd[our] = Tensor(np.ascontiguousarray(a))
-        own = set(self.state_dict())
-        unknown = [k for k in sd if k not in own]
-        missing = [k for k in own if k not in sd]
-        if unknown or missing:
-            raise ValueError(
-                f"HF state_dict mismatch: unknown={unknown[:5]} "
-                f"missing={missing[:5]}")
+        validate_keys(self, sd, "HF Llama")
         self.set_state_dict(sd)
         return self
 

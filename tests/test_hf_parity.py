@@ -94,3 +94,37 @@ class TestLlamaHFParity:
         opt.step()
         opt.clear_grad()
         assert np.isfinite(float(loss.numpy()))
+
+
+class TestGPT2HFParity:
+    def test_logits_and_generate_match(self):
+        from transformers import GPT2Config, GPT2LMHeadModel
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        torch.manual_seed(0)
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=96, n_positions=32, n_embd=32, n_layer=2,
+            n_head=2, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0)).eval()
+        ours = GPTForCausalLM(GPTConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=128,
+            max_position_embeddings=32, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
+        ours.eval()
+        ours.load_hf_state_dict(hf.state_dict())
+        ids = np.random.RandomState(0).randint(0, 96, (2, 9))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(ours(paddle.to_tensor(
+            ids.astype(np.int64))).numpy())
+        np.testing.assert_allclose(got, want, atol=2e-5)
+        prompt = np.random.RandomState(1).randint(2, 96, (1, 5))
+        with torch.no_grad():
+            hf_out = hf.generate(torch.tensor(prompt), max_new_tokens=10,
+                                 do_sample=False, num_beams=1,
+                                 pad_token_id=0)
+        want_t = hf_out.numpy()[0, 5:].tolist()
+        out, _ = ours.generate(prompt.astype(np.int64),
+                               max_new_tokens=10, do_sample=False)
+        got_t = np.asarray(out.numpy())[0, :10].tolist()
+        assert got_t == want_t, (got_t, want_t)

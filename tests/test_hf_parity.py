@@ -128,3 +128,73 @@ class TestGPT2HFParity:
                                max_new_tokens=10, do_sample=False)
         got_t = np.asarray(out.numpy())[0, :10].tolist()
         assert got_t == want_t, (got_t, want_t)
+
+
+class TestBertHFParity:
+    def test_masked_lm_logits_match(self):
+        from transformers import BertConfig as HFC
+        from transformers import BertForMaskedLM as HFBert
+        from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+        torch.manual_seed(0)
+        kw = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=2, intermediate_size=64,
+                  max_position_embeddings=32)
+        hf = HFBert(HFC(hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0,
+                        type_vocab_size=2, **kw)).eval()
+        ours = BertForMaskedLM(BertConfig(
+            hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0, **kw))
+        ours.eval()
+        ours.load_hf_state_dict(hf.state_dict())
+        ids = np.random.RandomState(0).randint(0, 64, (2, 12))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        out = ours(paddle.to_tensor(ids.astype(np.int64)))
+        got = np.asarray((out[0] if isinstance(out, tuple)
+                          else out).numpy())
+        np.testing.assert_allclose(got, want, atol=2e-5)
+        assert (got.argmax(-1) == want.argmax(-1)).all()
+
+    def test_sequence_classification_logits_match(self):
+        from transformers import BertConfig as HFC
+        from transformers import (
+            BertForSequenceClassification as HFBertCls)
+        from paddle_tpu.models.bert import (BertConfig,
+                                            BertForSequenceClassification)
+        torch.manual_seed(1)
+        kw = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=2, intermediate_size=64,
+                  max_position_embeddings=32)
+        hf = HFBertCls(HFC(hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           classifier_dropout=0.0, type_vocab_size=2,
+                           num_labels=3, **kw)).eval()
+        ours = BertForSequenceClassification(BertConfig(
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            num_labels=3, **kw))
+        ours.eval()
+        ours.load_hf_state_dict(hf.state_dict())
+        ids = np.random.RandomState(1).randint(0, 64, (2, 10))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        out = ours(paddle.to_tensor(ids.astype(np.int64)))
+        got = np.asarray((out[0] if isinstance(out, tuple)
+                          else out).numpy())
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_untied_decoder_rejected(self):
+        from transformers import BertConfig as HFC
+        from transformers import BertForMaskedLM as HFBert
+        from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+        torch.manual_seed(2)
+        kw = dict(vocab_size=64, hidden_size=32, num_hidden_layers=1,
+                  num_attention_heads=2, intermediate_size=64,
+                  max_position_embeddings=32)
+        hf = HFBert(HFC(type_vocab_size=2, **kw)).eval()
+        sd = dict(hf.state_dict())
+        sd["cls.predictions.decoder.weight"] = (
+            sd["cls.predictions.decoder.weight"] + 1.0)  # diverge
+        ours = BertForMaskedLM(BertConfig(**kw))
+        with pytest.raises(ValueError, match="UNTIED"):
+            ours.load_hf_state_dict(sd)

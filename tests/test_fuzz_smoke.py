@@ -14,7 +14,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.mark.parametrize("family,iters", [
     ("ops", "4"), ("ops2", "3"), ("grads", "3"),
     ("rnn_dist", "3"), ("cf_fft_linalg", "3"), ("index", "8"),
-    ("vision", "5"), ("dtype", "8"),
+    ("vision", "5"), ("dtype", "8"), ("einsum_io", "2"),
 ])
 def test_fuzz_family_smoke(family, iters):
     env = {k: v for k, v in os.environ.items()

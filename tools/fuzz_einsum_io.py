@@ -1,0 +1,97 @@
+"""Fuzz einsum advanced forms + save/load roundtrips."""
+import os, sys, tempfile
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import torch
+import paddle_tpu as paddle
+
+rs = np.random.RandomState(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+fails = []
+t = paddle.to_tensor
+
+def check(name, got, want, atol=1e-4, info=""):
+    try:
+        g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        w = want.numpy() if hasattr(want, "numpy") else np.asarray(want)
+        assert g.shape == w.shape, f"shape {g.shape} vs {w.shape}"
+        np.testing.assert_allclose(g, w, atol=atol, rtol=1e-4)
+    except Exception as e:
+        fails.append((name, info, str(e)[:220]))
+
+for it in range(N):
+    a = rs.randn(2, 3, 4).astype("f")
+    b = rs.randn(2, 4, 5).astype("f")
+    c = rs.randn(4, 4).astype("f")
+    eqs = [
+        ("...ij,...jk->...ik", (a, b)),
+        ("bij,bjk->bik", (a, b)),
+        ("ii->i", (c,)),          # diagonal
+        ("ii->", (c,)),           # trace
+        ("...i->...", (a,)),      # sum last
+        ("ij...,ij...->ij", (a, a)),
+        ("i,j->ij", (a[0, 0], b[0, :, 0])),  # outer
+        ("bij->jbi", (a,)),       # pure transpose
+        ("bij,bij->b", (a, a)),
+    ]
+    for eq, ops in eqs:
+        try:
+            check(f"einsum[{eq}]",
+                  paddle.einsum(eq, *[t(o.copy()) for o in ops]),
+                  torch.einsum(eq, *[torch.tensor(o.copy()) for o in ops]),
+                  info=eq)
+        except Exception as e:
+            fails.append((f"einsum[{eq}]", "", repr(e)[:220]))
+
+# save/load roundtrips
+for it in range(min(N, 4)):
+    try:
+        from paddle_tpu import nn
+        paddle.seed(it)
+        net = nn.Sequential(nn.Linear(6, 8), nn.LayerNorm(8),
+                            nn.Linear(8, 3))
+        opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+        x = t(rs.rand(4, 6).astype("f"))
+        (net(x).sum()).backward(); opt.step(); opt.clear_grad()
+        with tempfile.TemporaryDirectory() as d:
+            paddle.save(net.state_dict(), d + "/m.pdparams")
+            paddle.save(opt.state_dict(), d + "/m.pdopt")
+            net2 = nn.Sequential(nn.Linear(6, 8), nn.LayerNorm(8),
+                                 nn.Linear(8, 3))
+            net2.set_state_dict(paddle.load(d + "/m.pdparams"))
+            check("state_roundtrip", net2(x), net(x))
+            opt2 = paddle.optimizer.Adam(1e-3, parameters=net2.parameters())
+            opt2.set_state_dict(paddle.load(d + "/m.pdopt"))
+            # a step after restore matches a step on the original
+            (net(x).sum()).backward(); opt.step(); opt.clear_grad()
+            (net2(x).sum()).backward(); opt2.step(); opt2.clear_grad()
+            check("opt_state_roundtrip", net2(x), net(x))
+        # jit.save/load AOT artifact
+        with tempfile.TemporaryDirectory() as d:
+            st = paddle.jit.to_static(net)
+            _ = st(x)
+            paddle.jit.save(st, d + "/mod", input_spec=[
+                paddle.static.InputSpec([4, 6], "float32")])
+            loaded = paddle.jit.load(d + "/mod")
+            check("jit_save_load", loaded(x), net(x))
+        # pickle of raw tensors dict incl int/bool
+        with tempfile.TemporaryDirectory() as d:
+            obj = {"w": t(rs.rand(3, 3).astype("f")),
+                   "i": t(rs.randint(0, 9, (4,)).astype("i8")),
+                   "nested": [t(np.array([True, False]))]}
+            paddle.save(obj, d + "/obj.pd")
+            back = paddle.load(d + "/obj.pd")
+            check("pickle_f", back["w"], obj["w"])
+            check("pickle_i", back["i"], obj["i"])
+            check("pickle_b", back["nested"][0], obj["nested"][0])
+    except Exception as e:
+        fails.append(("io", "", repr(e)[:300]))
+
+print(f"einsum/io fuzz done: {len(fails)} failures")
+seen = set()
+for name, info, msg in fails:
+    key = (name, msg[:60])
+    if key in seen: continue
+    seen.add(key)
+    print("=" * 70); print(name, info); print(msg[:300])

@@ -7,7 +7,9 @@ ops2 (conv/interpolate/norm/pad/einsum/activations), vision
 (transforms + manipulation long tail), grads (backward vs
 torch autograd), rnn_dist (RNN weight-copy + distribution goldens),
 cf_fft_linalg (dy2static control flow, fft/stft, decompositions),
-index (getitem/setitem). Default: every family, seed 0.
+index (getitem/setitem), dtype (promotion/scalar rules/bitwise),
+einsum_io (einsum advanced forms, save/load + jit.save roundtrips).
+Default: every family, seed 0.
 
 This harness found and fixed 10 real parity bugs in round 5 (see
 tests/test_functional_extra.py TestRound5FuzzFinds and the
@@ -32,6 +34,7 @@ FAMILIES = {
     "index": "fuzz_index.py",
     "vision": "fuzz_vision.py",
     "dtype": "fuzz_dtype.py",
+    "einsum_io": "fuzz_einsum_io.py",
 }
 
 

@@ -127,6 +127,18 @@ def dropout_keep_mask(q_ids, k_ids, row, seed0, seed1, seq_q, seq_k,
     return (x ^ sign) >= t_signed
 
 
+def dropout_seeds(dropout_key):
+    """Derive the (1, 1, 128) i32 seed array the kernels read (lanes
+    0/1) from a jax PRNG key — the ONE definition shared by
+    flash_attention_jax, the validator and the tests, so the in-kernel
+    pattern and every oracle stay in lockstep."""
+    s01 = jax.random.randint(
+        dropout_key, (2,), jnp.iinfo(jnp.int32).min,
+        jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    return (jnp.zeros((1, 1, 128), jnp.int32)
+            .at[0, 0, 0].set(s01[0]).at[0, 0, 1].set(s01[1]))
+
+
 def _mask_row(h, H, Bm, Hm):
     """Map a flattened [B*H] row index onto its row of the [Bm*Hm, Sq,
     Sk] attention-mask array (Bm ∈ {1, B}, Hm ∈ {1, H}): batch- and/or
@@ -991,12 +1003,7 @@ def flash_attention_jax(query, key, value, *, causal=False, scale=None,
         if kv_lens is not None:
             extras["kv_lens"] = kv_lens
         if eff_drop > 0.0:
-            s01 = jax.random.randint(
-                dropout_key, (2,), jnp.iinfo(jnp.int32).min,
-                jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-            extras["seeds"] = (jnp.zeros((1, 1, 128), jnp.int32)
-                               .at[0, 0, 0].set(s01[0])
-                               .at[0, 0, 1].set(s01[1]))
+            extras["seeds"] = dropout_seeds(dropout_key)
         cfg = (bool(causal), float(eff_drop), dims[0], dims[1])
         return _flash_core_gen(query, key, value, mask3, extras, sc, cfg)
 

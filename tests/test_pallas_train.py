@@ -357,11 +357,8 @@ def _qkv(rs, B=2, Sq=48, Sk=64, H=4, Hkv=2, D=64, dtype="float32"):
 
 
 def _drop_seeds(key):
-    import jax, jax.numpy as jnp
-    s01 = jax.random.randint(key, (2,), jnp.iinfo(jnp.int32).min,
-                             jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-    return (jnp.zeros((1, 1, 128), jnp.int32)
-            .at[0, 0, 0].set(s01[0]).at[0, 0, 1].set(s01[1]))
+    from paddle_tpu.kernels.attention import dropout_seeds
+    return dropout_seeds(key)
 
 
 def test_flash_mask_fast_path_parity(pallas_interpret):

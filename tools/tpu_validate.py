@@ -32,7 +32,9 @@ def main(argv=None):
     import jax.numpy as jnp
     from paddle_tpu.framework.flags import set_flags
     from paddle_tpu.kernels.attention import (flash_attention_jax,
-                                              _xla_attention)
+                                              _xla_attention,
+                                              _gen_reference,
+                                              dropout_seeds)
     from paddle_tpu.kernels import norm as knorm
 
     dev = jax.devices()[0]
@@ -95,6 +97,57 @@ def main(argv=None):
                 < lens[:, None, None, None])
         out_x = _xla_attention(q, k, v, 1.0 / np.sqrt(D), False, mask=mask)
         check(f"flash varlen fwd {dn}", out_p, out_x, dtype)
+
+        # additive mask on the fast path (round 5): key-padding tile +
+        # full [B,H,S,S] tile, parity vs the XLA path
+        pad_mask = jnp.where(mask, jnp.float32(0), jnp.float32(-1e30))
+        out_p = flash_attention_jax(q, k, v, mask=pad_mask)
+        out_x = _xla_attention(q, k, v, 1.0 / np.sqrt(D), False,
+                               mask=pad_mask)
+        check(f"flash mask(pad) fwd {dn}", out_p, out_x, dtype)
+        bias = (jax.random.uniform(jax.random.PRNGKey(3),
+                                   (B, H, S, S)) * -2.0).astype(
+                                       jnp.float32)
+        out_p = flash_attention_jax(q, k, v, mask=bias, causal=True)
+        out_x = _xla_attention(q, k, v, 1.0 / np.sqrt(D), True, mask=bias)
+        check(f"flash mask(bias) fwd {dn}", out_p, out_x, dtype)
+
+        # in-kernel dropout (round 5): parity vs the counter-hash
+        # reference, which regenerates the exact keep pattern
+        dkey = jax.random.PRNGKey(11)
+        seeds = dropout_seeds(dkey)
+        out_p = flash_attention_jax(q, k, v, dropout_p=0.2,
+                                    dropout_key=dkey, causal=True)
+        out_r = _gen_reference(q, k, v, None, None, seeds,
+                               1.0 / np.sqrt(D), True, 0.2, 1, 1)
+        check(f"flash dropout fwd {dn}", out_p, out_r, dtype)
+
+        if not args.quick:
+            # gen-core BACKWARD kernels (mask + dropout dq/dk/dv) on
+            # device — fwd-only checks would let a bwd tile/seed bug
+            # through (advisor r5)
+            g2 = jax.random.normal(jax.random.PRNGKey(13), q.shape,
+                                   dtype)
+
+            def loss_p(q_, k_, v_):
+                o = flash_attention_jax(q_, k_, v_, mask=bias,
+                                        dropout_p=0.2, dropout_key=dkey,
+                                        causal=True)
+                return jnp.vdot(o.astype(jnp.float32),
+                                g2.astype(jnp.float32))
+
+            def loss_r(q_, k_, v_):
+                o = _gen_reference(q_, k_, v_,
+                                   bias.reshape(B * H, S, S), None,
+                                   seeds, 1.0 / np.sqrt(D), True, 0.2,
+                                   B, H)
+                return jnp.vdot(o.astype(jnp.float32),
+                                g2.astype(jnp.float32))
+
+            gp = jax.grad(loss_p, (0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+            for nm, a, b in zip("qkv", gp, gr):
+                check(f"flash mask+drop bwd d{nm} {dn}", a, b, dtype)
 
         # GQA
         kv2 = k[:, :, :2, :], v[:, :, :2, :]

@@ -22,3 +22,45 @@ def validate_keys(model, sd, what):
     if unknown or missing:
         raise ValueError(f"{what} state_dict mismatch: "
                          f"unknown={unknown[:5]} missing={missing[:5]}")
+
+
+ENCODER_KEY_MAP = [
+    ("encoder.layer.", "encoder.layers."),
+    (".attention.self.query", ".self_attn.q_proj"),
+    (".attention.self.key", ".self_attn.k_proj"),
+    (".attention.self.value", ".self_attn.v_proj"),
+    (".attention.output.dense", ".self_attn.out_proj"),
+    (".attention.output.LayerNorm", ".norm1"),
+    (".intermediate.dense", ".linear1"),
+    (".output.dense", ".linear2"),
+    (".output.LayerNorm", ".norm2"),
+]
+
+
+def load_hf_encoder_state(model, hf_state_dict, key_fn, what,
+                          skip=lambda n: False,
+                          backfill_prefixes=()):
+    """Shared BERT-style encoder import: skip position_ids buffers and
+    caller-specified keys, rename via key_fn (ENCODER_KEY_MAP + model
+    specifics), transpose 2-D non-embedding Linear weights to paddle's
+    [in, out], backfill model-owned params HF checkpoints omit (e.g.
+    the pooler when HF built the head with add_pooling_layer=False),
+    validate and load."""
+    from ..tensor import Tensor
+    sd = {}
+    for name, p in hf_state_dict.items():
+        if name.endswith("position_ids") or skip(name):
+            continue
+        n = key_fn(name)
+        a = hf_tensor_to_numpy(p)
+        if n.endswith(".weight") and a.ndim == 2 and "embeddings" not in n:
+            a = a.T
+        sd[n] = Tensor(np.ascontiguousarray(a))
+    own = model.state_dict()
+    for k in own:
+        if any(k.startswith(pfx) for pfx in backfill_prefixes) \
+                and k not in sd:
+            sd[k] = own[k]
+    validate_keys(model, sd, what)
+    model.set_state_dict(sd)
+    return model

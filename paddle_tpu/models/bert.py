@@ -219,17 +219,13 @@ ErnieForSequenceClassification = BertForSequenceClassification
 
 
 def _bert_hf_key(n):
-    """HF BERT key → our key."""
+    """HF BERT key → our key (shared encoder map + MLM head renames;
+    the QA head is `classifier` where HF uses `qa_outputs`)."""
+    from ._hf_import import ENCODER_KEY_MAP
     n = n.replace("bert.embeddings.LayerNorm", "bert.embeddings.layer_norm")
-    n = n.replace("encoder.layer.", "encoder.layers.")
-    n = n.replace(".attention.self.query", ".self_attn.q_proj")
-    n = n.replace(".attention.self.key", ".self_attn.k_proj")
-    n = n.replace(".attention.self.value", ".self_attn.v_proj")
-    n = n.replace(".attention.output.dense", ".self_attn.out_proj")
-    n = n.replace(".attention.output.LayerNorm", ".norm1")
-    n = n.replace(".intermediate.dense", ".linear1")
-    n = n.replace(".output.dense", ".linear2")
-    n = n.replace(".output.LayerNorm", ".norm2")
+    n = n.replace("qa_outputs.", "classifier.")
+    for a, b in ENCODER_KEY_MAP:
+        n = n.replace(a, b)
     n = n.replace("cls.predictions.transform.dense", "cls.transform")
     n = n.replace("cls.predictions.transform.LayerNorm", "cls.norm")
     return n
@@ -242,41 +238,29 @@ def _load_hf_bert(self, hf_state_dict):
     MaskedLM checkpoints carry no pooler — ours keeps its initialized
     pooler in that case (the MLM head never reads it)."""
     import numpy as np
-    from ..tensor import Tensor
-    from ._hf_import import hf_tensor_to_numpy, validate_keys
-    sd = {}
-    for name, p in hf_state_dict.items():
-        if name.endswith("embeddings.position_ids"):
-            continue  # persistent buffer in transformers < 4.31 dicts
-        if name == "cls.predictions.decoder.weight":
-            # our MLM head is always tied to the word embeddings: an
-            # untied/diverged decoder cannot be represented — verify
-            # instead of silently mis-importing
-            dec = hf_tensor_to_numpy(p)
-            emb = hf_tensor_to_numpy(
-                hf_state_dict["bert.embeddings.word_embeddings.weight"])
-            if not np.allclose(dec, emb, atol=1e-6):
-                raise ValueError(
-                    "HF BERT checkpoint has an UNTIED mlm decoder "
-                    "weight; this model ties the decoder to the word "
-                    "embeddings and cannot represent it")
-            continue
-        if name == "cls.predictions.decoder.bias":
-            continue  # alias of cls.predictions.bias
-        n = _bert_hf_key(
-            "cls.decoder_bias" if name == "cls.predictions.bias" else name)
-        a = hf_tensor_to_numpy(p)
-        if n.endswith(".weight") and a.ndim == 2 and "embeddings" not in n:
-            a = a.T
-        sd[n] = Tensor(np.ascontiguousarray(a))
-    own = self.state_dict()
-    for k in own:
-        if k.startswith("bert.pooler.") and k not in sd:
-            sd[k] = own[k]
-    validate_keys(self, sd, "HF BERT")
-    self.set_state_dict(sd)
-    return self
+    from ._hf_import import hf_tensor_to_numpy, load_hf_encoder_state
+    if "cls.predictions.decoder.weight" in hf_state_dict:
+        # our MLM head is always tied to the word embeddings: an
+        # untied/diverged decoder cannot be represented — verify
+        # instead of silently mis-importing
+        dec = hf_tensor_to_numpy(
+            hf_state_dict["cls.predictions.decoder.weight"])
+        emb = hf_tensor_to_numpy(
+            hf_state_dict["bert.embeddings.word_embeddings.weight"])
+        if not np.allclose(dec, emb, atol=1e-6):
+            raise ValueError(
+                "HF BERT checkpoint has an UNTIED mlm decoder weight; "
+                "this model ties the decoder to the word embeddings "
+                "and cannot represent it")
+    renamed = {("cls.decoder_bias" if k == "cls.predictions.bias"
+                else k): v for k, v in hf_state_dict.items()}
+    return load_hf_encoder_state(
+        self, renamed, _bert_hf_key, "HF BERT",
+        skip=lambda n: n.startswith("cls.predictions.decoder."),
+        backfill_prefixes=("bert.pooler.",))
 
 
 BertForMaskedLM.load_hf_state_dict = _load_hf_bert
 BertForSequenceClassification.load_hf_state_dict = _load_hf_bert
+BertForTokenClassification.load_hf_state_dict = _load_hf_bert
+BertForQuestionAnswering.load_hf_state_dict = _load_hf_bert

@@ -155,3 +155,32 @@ class ErnieForQuestionAnswering(Layer):
         logits = self.classifier(h)
         start, end = M.split(logits, 2, axis=-1)
         return M.squeeze(start, axis=-1), M.squeeze(end, axis=-1)
+
+
+def _ernie_hf_key(n):
+    """HF Ernie key → our key (shared BERT-style encoder map plus
+    Ernie specifics: our pooler is a bare Linear where HF nests dense;
+    the QA head is `classifier` where HF uses `qa_outputs`)."""
+    from ._hf_import import ENCODER_KEY_MAP
+    n = n.replace("ernie.embeddings.LayerNorm", "ernie.embeddings.layer_norm")
+    n = n.replace("ernie.pooler.dense.", "ernie.pooler.")
+    n = n.replace("qa_outputs.", "classifier.")
+    for a, b in ENCODER_KEY_MAP:
+        n = n.replace(a, b)
+    return n
+
+
+def _load_hf_ernie(self, hf_state_dict):
+    """Import HuggingFace Ernie weights (logits verified ~1e-5 in
+    tests/test_hf_parity.py). Token-classification / QA checkpoints
+    are built with add_pooling_layer=False upstream — our model's own
+    pooler init is kept in that case (those heads never read it)."""
+    from ._hf_import import load_hf_encoder_state
+    return load_hf_encoder_state(
+        self, hf_state_dict, _ernie_hf_key, "HF Ernie",
+        backfill_prefixes=("ernie.pooler.",))
+
+
+ErnieForSequenceClassification.load_hf_state_dict = _load_hf_ernie
+ErnieForTokenClassification.load_hf_state_dict = _load_hf_ernie
+ErnieForQuestionAnswering.load_hf_state_dict = _load_hf_ernie

@@ -41,7 +41,6 @@ class Optimizer:
             self._regularization_coeff = 0.0 if weight_decay is None else weight_decay
         # accumulators: name -> {param_id -> jax array}
         self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
-        self._accum_meta: Dict[int, str] = {}
 
     # ----------------------------------------------------- regularization --
     def _decayed_grad(self, p, g):
@@ -93,7 +92,6 @@ class Optimizer:
         if pid not in store:
             store[pid] = (jnp.zeros_like(p._value) if init is None
                           else init(p._value))
-            self._accum_meta[pid] = getattr(p, "name", None) or str(pid)
         return store[pid]
 
     def _set_accumulator(self, name, p, value):
@@ -167,16 +165,26 @@ class Optimizer:
         return None, None
 
     # ----------------------------------------------------------- state io --
+    def _state_key(self, p, idx):
+        """Stable per-parameter key for state dicts: the param's name
+        when it has one, else its POSITION in the parameter list —
+        portable across processes, unlike the old id(p) fallback (which
+        made optimizer checkpoint restore a silent no-op for unnamed
+        params — r5 fuzz find)."""
+        return getattr(p, "name", None) or f"param{idx}"
+
     def state_dict(self):
         sync = getattr(self, "_deferred_sync", None)
         if sync is not None:
             # compiled train steps keep authoritative opt state; flush it
             # into the accumulators before reading
             sync()
+        key_of = {id(p): self._state_key(p, i)
+                  for i, p in enumerate(self._parameter_list)}
         out = {}
         for name, store in self._accumulators.items():
             for pid, arr in store.items():
-                out[f"{self._accum_meta.get(pid, pid)}_{name}"] = Tensor(arr)
+                out[f"{key_of.get(pid, pid)}_{name}"] = Tensor(arr)
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
         return out
@@ -192,15 +200,30 @@ class Optimizer:
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
                                                        LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
-        # rebuild accumulators by matching "<pname>_<accum>" keys
-        for p in self._parameter_list:
-            pname = getattr(p, "name", None) or str(id(p))
-            for name in list(self._accumulators.keys()) or []:
-                key = f"{pname}_{name}"
-                if key in state_dict:
-                    v = state_dict[key]
-                    self._accumulators[name][id(p)] = (
-                        v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        # key-driven restore: "<pkey>_<accum>" entries CREATE their
+        # accumulator stores — a fresh optimizer (no step taken) used
+        # to iterate its empty accumulator dict and silently restore
+        # nothing (r5 fuzz find). Keys split at underscores from the
+        # RIGHT so the LONGEST matching param key wins (param names may
+        # themselves contain underscores, e.g. 'w' vs 'w_2'), in one
+        # pass over the entries.
+        pkeys = {self._state_key(p, i): p
+                 for i, p in enumerate(self._parameter_list)}
+        for key, v in state_dict.items():
+            if key == "LR_Scheduler":
+                continue
+            cut = len(key)
+            while True:
+                cut = key.rfind("_", 0, cut)
+                if cut < 0:
+                    break
+                p = pkeys.get(key[:cut])
+                if p is not None:
+                    self._accumulators.setdefault(
+                        key[cut + 1:], {})[id(p)] = (
+                        v._value if isinstance(v, Tensor)
+                        else jnp.asarray(v))
+                    break
         inval = getattr(self, "_deferred_invalidate", None)
         if inval is not None:
             inval()
@@ -563,7 +586,6 @@ def _fn_sync_to_accumulators(self, params, states):
             pid = id(p)
             for k, v in st.items():
                 self._accumulators.setdefault(k, {})[pid] = v
-            self._accum_meta[pid] = getattr(p, "name", None) or str(pid)
 
 
 Optimizer._fn_init_all = _fn_init_all

@@ -183,6 +183,33 @@ class TestBertHFParity:
                           else out).numpy())
         np.testing.assert_allclose(got, want, atol=2e-5)
 
+    def test_question_answering_logits_match(self):
+        # exercises the qa_outputs -> classifier map AND the pooler
+        # backfill (HF builds QA heads with add_pooling_layer=False)
+        from transformers import BertConfig as HFC
+        from transformers import BertForQuestionAnswering as HFQA
+        from paddle_tpu.models.bert import (BertConfig,
+                                            BertForQuestionAnswering)
+        torch.manual_seed(3)
+        kw = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=2, intermediate_size=64,
+                  max_position_embeddings=32)
+        hf = HFQA(HFC(hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      type_vocab_size=2, **kw)).eval()
+        ours = BertForQuestionAnswering(BertConfig(
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            **kw))
+        ours.eval()
+        ours.load_hf_state_dict(hf.state_dict())
+        ids = np.random.RandomState(3).randint(0, 64, (2, 10))
+        with torch.no_grad():
+            out = hf(torch.tensor(ids))
+            ws, we = out.start_logits.numpy(), out.end_logits.numpy()
+        gs, ge = ours(paddle.to_tensor(ids.astype(np.int64)))
+        np.testing.assert_allclose(np.asarray(gs.numpy()), ws, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(ge.numpy()), we, atol=2e-5)
+
     def test_untied_decoder_rejected(self):
         from transformers import BertConfig as HFC
         from transformers import BertForMaskedLM as HFBert
@@ -198,3 +225,59 @@ class TestBertHFParity:
         ours = BertForMaskedLM(BertConfig(**kw))
         with pytest.raises(ValueError, match="UNTIED"):
             ours.load_hf_state_dict(sd)
+
+
+class TestErnieHFParity:
+    def test_sequence_classification_logits_match(self):
+        from transformers import ErnieConfig as HFC
+        from transformers import (
+            ErnieForSequenceClassification as HFErnie)
+        from paddle_tpu.models.ernie import (ErnieConfig,
+                                             ErnieForSequenceClassification)
+        torch.manual_seed(0)
+        kw = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=2, intermediate_size=64,
+                  max_position_embeddings=32)
+        hf = HFErnie(HFC(hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0,
+                         classifier_dropout=0.0, type_vocab_size=4,
+                         num_labels=3, use_task_id=True,
+                         task_type_vocab_size=3, **kw)).eval()
+        ours = ErnieForSequenceClassification(
+            ErnieConfig(hidden_dropout_prob=0.0, **kw), num_classes=3)
+        ours.eval()
+        ours.load_hf_state_dict(hf.state_dict())
+        ids = np.random.RandomState(0).randint(0, 64, (2, 12))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        out = ours(paddle.to_tensor(ids.astype(np.int64)))
+        got = np.asarray((out[0] if isinstance(out, tuple)
+                          else out).numpy())
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+    def test_ernie_question_answering_import(self):
+        # the ERNIE loader's qa_outputs map + pooler backfill
+        from transformers import ErnieConfig as HFC
+        from transformers import ErnieForQuestionAnswering as HFQA
+        from paddle_tpu.models.ernie import (ErnieConfig,
+                                             ErnieForQuestionAnswering)
+        torch.manual_seed(4)
+        kw = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=2, intermediate_size=64,
+                  max_position_embeddings=32)
+        hf = HFQA(HFC(hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0,
+                      type_vocab_size=4, use_task_id=True,
+                      task_type_vocab_size=3, **kw)).eval()
+        ours = ErnieForQuestionAnswering(
+            ErnieConfig(hidden_dropout_prob=0.0, **kw))
+        ours.eval()
+        ours.load_hf_state_dict(hf.state_dict())
+        ids = np.random.RandomState(4).randint(0, 64, (2, 10))
+        with torch.no_grad():
+            out = hf(torch.tensor(ids))
+            ws, we = out.start_logits.numpy(), out.end_logits.numpy()
+        gs, ge = ours(paddle.to_tensor(ids.astype(np.int64)))
+        np.testing.assert_allclose(np.asarray(gs.numpy()), ws, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(ge.numpy()), we, atol=2e-5)

@@ -897,3 +897,43 @@ class TestOneCycleR5:
         with pytest.raises(ValueError, match="non-negative"):
             D.Categorical(paddle.to_tensor(
                 np.array([0.2, -0.5, 1.0], np.float32)))
+
+    def test_opt_state_restore_into_fresh_optimizer(self):
+        """r5 fuzz find: restoring into a FRESH optimizer (no step
+        taken) must rebuild the accumulators — the old code iterated
+        its own empty accumulator dict and silently restored nothing;
+        unnamed params now key by position, portable across
+        instances."""
+        import tempfile
+        rs = np.random.RandomState(11)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(6, 8), nn.LayerNorm(8),
+                            nn.Linear(8, 3))
+        opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+        x = paddle.to_tensor(rs.rand(4, 6).astype("f"))
+        net(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        with tempfile.TemporaryDirectory() as d:
+            paddle.save(net.state_dict(), d + "/m.pdparams")
+            paddle.save(opt.state_dict(), d + "/m.pdopt")
+            net2 = nn.Sequential(nn.Linear(6, 8), nn.LayerNorm(8),
+                                 nn.Linear(8, 3))
+            net2.set_state_dict(paddle.load(d + "/m.pdparams"))
+            opt2 = paddle.optimizer.Adam(1e-3,
+                                         parameters=net2.parameters())
+            opt2.set_state_dict(paddle.load(d + "/m.pdopt"))
+            # restored state must be non-empty and numerically equal
+            sd1, sd2 = opt.state_dict(), opt2.state_dict()
+            assert set(sd1) == set(sd2) and len(sd1) > 0
+            for k in sd1:
+                np.testing.assert_allclose(
+                    np.asarray(sd1[k].numpy()),
+                    np.asarray(sd2[k].numpy()), atol=0, err_msg=k)
+            # and a step after restore matches a step on the original
+            net(x).sum().backward(); opt.step(); opt.clear_grad()
+            net2(x).sum().backward(); opt2.step(); opt2.clear_grad()
+            for (n1, p1), (_, p2) in zip(net.named_parameters(),
+                                         net2.named_parameters()):
+                np.testing.assert_allclose(p1.numpy(), p2.numpy(),
+                                           atol=1e-7, err_msg=n1)

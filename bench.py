@@ -200,7 +200,7 @@ def main():
     except Exception:
         peak_hbm = None
 
-    print(json.dumps({
+    result = {
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tps, 2),
         "unit": "tokens/s",
@@ -228,7 +228,45 @@ def main():
             # the measuring code is the code being scored
             "bench_code_sha": _bench_code_sha(),
         },
-    }))
+    }
+    _emit_telemetry(result, dt / iters, tokens, final_loss)
+    print(json.dumps(result))
+
+
+def _emit_telemetry(result, step_time_s, tokens, final_loss):
+    """Mirror the bench measurement into the runtime telemetry JSONL
+    (observability.JsonlExporter) so BENCH_*.json trajectories and live
+    telemetry share one schema readable by tools/metrics_report.py.
+    Path: $PADDLE_TPU_TELEMETRY_JSONL or output/telemetry_bench.jsonl."""
+    try:
+        import paddle_tpu.observability as obs
+        path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "output",
+            "telemetry_bench.jsonl")
+        aux = result["aux"]
+        reg = obs.MetricRegistry()  # private: don't mix with live series
+        reg.counter("train.steps").inc(aux["iters"])
+        reg.counter("train.tokens").inc(tokens)
+        reg.histogram("train.step_time_seconds", unit="s").observe(
+            step_time_s)
+        reg.gauge("train.tokens_per_sec").set(result["value"])
+        reg.gauge("train.mfu").set(aux.get("mfu_xla") or aux["mfu_est"])
+        reg.gauge("train.loss").set(final_loss)
+        if aux.get("peak_hbm_bytes"):
+            reg.gauge("mem.peak_bytes_in_use", unit="bytes").set(
+                aux["peak_hbm_bytes"])
+        with obs.JsonlExporter(path, registry=reg) as sink:
+            sink.write_record({"kind": "bench", "ts": time.time(),
+                               "metric": result["metric"],
+                               "value": result["value"],
+                               "unit": result["unit"],
+                               "backend": aux["backend"],
+                               "batch": aux["batch"], "seq": aux["seq"],
+                               "bench_code_sha": aux["bench_code_sha"]})
+            sink.export()
+        _log(f"telemetry mirrored to {path}")
+    except Exception as e:  # telemetry must never fail the bench
+        _log(f"telemetry sink skipped: {e!r}")
 
 
 def _bench_code_sha():

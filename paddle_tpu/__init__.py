@@ -123,6 +123,7 @@ from . import version
 from . import onnx
 from . import generation
 from . import diffusion
+from . import observability
 
 
 def is_grad_enabled_():

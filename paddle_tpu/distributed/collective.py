@@ -27,6 +27,35 @@ from jax import lax
 from ..tensor import Tensor
 from ..ops._dispatch import apply
 from ..ops.creation import _coerce
+from ..observability import metrics as _obsm
+
+
+_comm_calls = None
+_comm_bytes = None
+
+
+def _account(op: str, ax: Optional[str], *vals):
+    """Telemetry: per-op/axis call + byte accounting for SPMD-bound
+    collectives. Collectives here are COMPILED, not executed — each
+    count is one appearance in a traced program (a retrace counts
+    again); bytes are the logical per-shard payload. Execution-side
+    timing lives in the profiler's XPlane capture."""
+    global _comm_calls, _comm_bytes
+    if ax is None or not _obsm.enabled():
+        return
+    if _comm_calls is None:
+        _comm_calls = _obsm.counter("comm.calls")
+        _comm_bytes = _obsm.counter("comm.bytes", unit="bytes")
+    nbytes = 0
+    for v in vals:
+        a = v._value if isinstance(v, Tensor) else v
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            continue
+        nbytes += int(np.prod(shape)) * np.dtype(
+            getattr(a, "dtype", np.float32)).itemsize
+    _comm_calls.inc(op=op, axis=ax)
+    _comm_bytes.inc(nbytes, op=op, axis=ax)
 
 
 class ReduceOp:
@@ -125,6 +154,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if ax is None:
         return tensor  # single logical rank
     t = _coerce(tensor)
+    _account("all_reduce", ax, t)
     out = apply(lambda v: _reduce_fn(op)(v, ax), t)
     if isinstance(tensor, Tensor):
         tensor._inplace_update(out)
@@ -140,6 +170,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.append(t)
             return tensor_list
         return t
+    _account("all_gather", ax, t)
     out = apply(lambda v: lax.all_gather(v, ax), t)  # [n, ...]
     if isinstance(tensor_list, list):
         from .mesh import axis_size
@@ -156,6 +187,7 @@ def all_gather_concat(tensor, group=None, axis=0):
     t = _coerce(tensor)
     if ax is None:
         return t
+    _account("all_gather", ax, t)
     return apply(lambda v: lax.all_gather(v, ax, axis=axis, tiled=True), t)
 
 
@@ -173,6 +205,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
         if tensor is not src and isinstance(tensor, Tensor):
             tensor._inplace_update(src)
         return tensor
+    _account("reduce_scatter", ax, src)
     out = apply(lambda v: lax.psum_scatter(v, ax, scatter_dimension=0,
                                            tiled=True), src)
     if isinstance(tensor, Tensor):
@@ -186,6 +219,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     if ax is None:
         return tensor
     t = _coerce(tensor)
+    _account("broadcast", ax, t)
     # broadcast from root = select root's shard on the axis
     def fn(v):
         idx = lax.axis_index(ax)
@@ -218,6 +252,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                 else [in_tensor_list])
             return out_tensor_list
         return src
+    _account("alltoall", ax, src)
     out = apply(lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
                                          tiled=False), src)
     if isinstance(out_tensor_list, list):
@@ -236,6 +271,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
             out_tensor._inplace_update(t)
             return out_tensor
         return t
+    _account("alltoall", ax, t)
     out = apply(lambda v: lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
                                          tiled=True), t)
     if isinstance(out_tensor, Tensor):
@@ -263,6 +299,7 @@ def ppermute(tensor, perm, group=None):
     t = _coerce(tensor)
     if ax is None:
         return t
+    _account("ppermute", ax, t)
     return apply(lambda v: lax.ppermute(v, ax, perm), t)
 
 
@@ -282,6 +319,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         return tensor
     from ..ops.manipulation import stack
     stacked = stack([_coerce(t) for t in tensor_list], axis=0)
+    _account("scatter", ax, stacked)
 
     def fn(v):
         idx = lax.axis_index(ax)

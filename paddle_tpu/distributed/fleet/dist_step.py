@@ -27,6 +27,8 @@ from ...tensor import Tensor
 from ...framework.random import default_generator
 from ..mesh import get_mesh, ensure_mesh, mesh_scope, axis_size
 from ...jit.bridge import _clip_grads_functional
+from ...observability import enabled as _obs_enabled
+from ...observability.train_metrics import StepTelemetry, batch_tokens
 
 
 def _partition_spec_for(p, stage3: bool, mesh: Mesh):
@@ -132,6 +134,52 @@ class DistTrainStep:
 
         self._compiled = {}
 
+        # -- telemetry: analytic per-step accounting of the collectives
+        # XLA inserts for the declared shardings (the facade in
+        # distributed/collective.py accounts explicit SPMD calls; the
+        # grad psum / ZeRO-3 gathers of this step are compiler-inserted,
+        # so they are accounted here from the param set)
+        self._obs = None
+        if _obs_enabled():
+            dsize = mesh_.shape.get("data", 1)
+            comm = []
+            if dsize > 1:
+                grad_b = sum(int(np.prod(p._value.shape))
+                             * p._value.dtype.itemsize for p in self._p)
+                if self._stage >= 3:
+                    # FSDP: params all-gathered at use (fwd + bwd),
+                    # grads reduce-scattered
+                    comm.append(("all_gather", "data",
+                                 2 * len(self._p), 2 * grad_b))
+                    comm.append(("reduce_scatter", "data",
+                                 len(self._p), grad_b))
+                else:
+                    comm.append(("all_reduce", "data",
+                                 len(self._p), grad_b))
+            n_params = sum(int(np.prod(p._value.shape)) for p in self._p)
+            dtype = (str(self._p[0]._value.dtype) if self._p
+                     else "float32")
+            flops_fn = None
+            from ...framework.flags import flag_value
+            try:
+                use_xla_mfu = bool(flag_value("obs_xla_mfu"))
+            except KeyError:
+                use_xla_mfu = False
+            if use_xla_mfu:
+                def flops_fn():
+                    ca = self._last_cost_analysis()
+                    return float((ca or {}).get("flops", 0.0))
+            self._obs_use_xla_mfu = use_xla_mfu
+            self._obs_flops_fn = flops_fn
+            self._obs = StepTelemetry(
+                n_params=n_params, dtype=dtype,
+                n_devices=mesh_.devices.size, comm_per_step=comm,
+                flops_fn=flops_fn)
+
+    def _last_cost_analysis(self):
+        batch = getattr(self, "_obs_last_batch", None)
+        return self.cost_analysis(*batch) if batch else None
+
     # ------------------------------------------------------------------
     def _batch_shardings(self, arrays):
         mesh_ = self._mesh
@@ -159,6 +207,7 @@ class DistTrainStep:
         repl = NamedSharding(mesh_, PartitionSpec())
 
         scaler = self._scaler
+        obs = self._obs if _obs_enabled() else None
 
         def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch,
                     scaler_st):
@@ -187,6 +236,8 @@ class DistTrainStep:
                 from ...amp.grad_scaler import (compiled_unscale,
                                                 compiled_select_and_adapt)
                 grads, found_inf = compiled_unscale(scale, grads)
+            if obs is not None:
+                obs.grad_norm_callback(grads)  # async host record, no sync
             grads = _clip_grads_functional(grads, grad_clip)
             new_p, new_state = opt._fn_apply_all(
                 list(p_vals), grads, opt_state, lr, p_names, p_tensors)
@@ -229,11 +280,15 @@ class DistTrainStep:
         from ...amp.grad_scaler import scaler_state_in
         sc_in = (scaler_state_in(self._scaler)
                  if self._scaler is not None else ())
-        gen = default_generator()
+        # fixed key, NOT default_generator().split(): lowering only needs
+        # the key's type, and advancing the global RNG from an analysis
+        # call (e.g. the telemetry MFU probe) would silently change the
+        # training trajectory (same stance as PipelineTrainStep.
+        # memory_analysis)
         with mesh_scope(self._mesh):
             lowered = self._compiled[sig]._jitted.lower(
                 [p._value for p in self._p], [b._value for b in self._b],
-                self._opt_state, gen.split(),
+                self._opt_state, jax.random.key(0),
                 jnp.asarray(self._opt.get_lr(), jnp.float32), arrays,
                 sc_in)
         ca = lowered.cost_analysis()
@@ -242,11 +297,20 @@ class DistTrainStep:
         return ca
 
     def __call__(self, *batch):
+        obs = self._obs if (self._obs is not None and _obs_enabled()) \
+            else None
+        if obs is not None:
+            obs.step_start()
         arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         if sig not in self._compiled:
             self._compiled[sig] = self._build(self._batch_shardings(arrays))
+            if obs is not None and self._obs_use_xla_mfu:
+                # the batch is pinned ONLY until the one-shot MFU probe
+                # consumes it in this step's step_end (cleared below)
+                self._obs_last_batch = batch
+                obs.reset_flops(self._obs_flops_fn)  # new shape, new MFU
         gen = default_generator()
         key_in = gen.split()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
@@ -264,4 +328,7 @@ class DistTrainStep:
             t._value = v
         self._opt_state = new_state
         self._opt._fn_sync_to_accumulators(self._p, new_state)
+        if obs is not None:
+            obs.step_end(batch_tokens(arrays))  # runs the MFU probe once
+            self._obs_last_batch = None
         return Tensor(loss)

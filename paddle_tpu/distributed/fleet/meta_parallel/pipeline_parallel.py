@@ -44,6 +44,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ....tensor import Tensor
 from ....framework.random import default_generator
 from ....jit.bridge import _clip_grads_functional
+from ....observability import enabled as _obs_enabled
+from ....observability import gauge as _obs_gauge
+from ....observability import histogram as _obs_histogram
+from ....observability.train_metrics import StepTelemetry, batch_tokens
 from ...mesh import ensure_mesh, mesh_scope
 from .pp_layers import PipelineLayer
 
@@ -525,6 +529,35 @@ class PipelineTrainStep:
         self._seed_params = (self._pre_p + [None] * len(self._tmpl_named)
                              + self._post_p)
         self._compiled = {}
+        # -- telemetry: schedule tick accounting. The scanned schedule
+        # runs T ticks per step (fill + steady + drain); host wall time
+        # divides over them since XLA owns the instruction order.
+        self._obs = None
+        if _obs_enabled():
+            S, V, M = self._S, self._V, self._M
+            if V > 1:
+                W = S * V
+                ticks = ((M - 1) // S) * W + ((M - 1) % S) + S * V
+            else:
+                ticks = (M + S - 1) if S > 1 else M
+            self._obs_ticks = int(ticks)
+            n_params = sum(
+                int(np.prod(p._value.shape))
+                for _, p in (self._pre_named + self._post_named)) + sum(
+                int(np.prod(p._value.shape)) * self._C
+                for _, p in self._tmpl_named)
+            dtype = (str(self._tmpl_named[0][1]._value.dtype)
+                     if self._tmpl_named else "float32")
+            self._obs = StepTelemetry(
+                n_params=n_params, dtype=dtype,
+                n_devices=self._mesh.devices.size, prefix="pp")
+            self._obs_h_tick = _obs_histogram(
+                "pp.tick_time_seconds",
+                help="per-schedule-tick wall time (step time / ticks)",
+                unit="s")
+            _obs_gauge("pp.ticks_per_step").set(self._obs_ticks)
+            _obs_gauge("pp.microbatches").set(M)
+            _obs_gauge("pp.stages").set(S * V)
         self._refresh_from_layers()
         # register invalidation now: a set_state_dict BEFORE the first
         # step must also trigger a re-read of the stacked leaves
@@ -667,6 +700,7 @@ class PipelineTrainStep:
         seed_params = self._seed_params
 
         scaler = self._scaler
+        obs = self._obs if _obs_enabled() else None
 
         def step_fn(pre_v, stk_v, post_v, eb_v, opt_state, key, lr, batch,
                     scaler_st):
@@ -719,6 +753,8 @@ class PipelineTrainStep:
                 from ....amp.grad_scaler import (compiled_unscale,
                                                  compiled_select_and_adapt)
                 flat_g, found_inf = compiled_unscale(scale, flat_g)
+            if obs is not None:
+                obs.grad_norm_callback(flat_g)  # async host record
             flat_g = _clip_grads_functional(flat_g, grad_clip)
             new_p, new_state = opt._fn_apply_all(
                 flat_p, flat_g, opt_state, lr, p_names, seed_params)
@@ -773,6 +809,10 @@ class PipelineTrainStep:
         return arrays, sig
 
     def __call__(self, *batch):
+        obs = self._obs if (self._obs is not None and _obs_enabled()) \
+            else None
+        if obs is not None:
+            obs.step_start()
         arrays, sig = self._ensure_compiled(batch)
         gen = default_generator()
         key_in = gen.split()
@@ -804,6 +844,10 @@ class PipelineTrainStep:
         self._opt._deferred_sync = self.sync_state
         self._model._deferred_invalidate = self._mark_stale
         self._opt._deferred_invalidate = self._mark_stale
+        if obs is not None:
+            dt = obs.step_end(batch_tokens(arrays))
+            if dt is not None:
+                self._obs_h_tick.observe(dt / max(self._obs_ticks, 1))
         return Tensor(loss)
 
     def memory_analysis(self, *batch):

@@ -31,6 +31,7 @@ class Context:
     script: str = ""
     script_args: List[str] = field(default_factory=list)
     run_mode: str = "collective"
+    heartbeat_interval: float = 1.0  # seconds; <= 0 disables
 
     @property
     def world_size(self) -> int:
@@ -57,6 +58,11 @@ def parse_args(argv=None) -> Context:
                    help="visible accelerator ids for this pod")
     p.add_argument("--max_restart", type=int, default=3,
                    help="elastic: max pod restarts on failure")
+    p.add_argument("--heartbeat_interval", type=float, default=1.0,
+                   help="seconds between per-rank heartbeat lines in "
+                        "<log_dir>/heartbeat.jsonl (<=0 disables); a "
+                        "wedged rank shows up as a pid that stops "
+                        "growing its log while staying alive")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -67,7 +73,8 @@ def parse_args(argv=None) -> Context:
         nproc_per_node=a.nproc_per_node or 1, master=a.master,
         job_id=a.job_id, log_dir=a.log_dir, devices=a.devices,
         max_restart=a.max_restart, script=a.script,
-        script_args=a.script_args)
+        script_args=a.script_args,
+        heartbeat_interval=a.heartbeat_interval)
 
 
 class PodController:
@@ -144,6 +151,25 @@ class PodController:
         for f in self.logs:
             f.close()
 
+    def rank_states(self) -> List[dict]:
+        """Per-rank liveness snapshot for the heartbeat: a rank whose
+        pid is alive but whose log stopped growing is the wedged-rank
+        signature (five TPU bench rounds died undiagnosable without
+        this; see BENCH_r0*.json)."""
+        out = []
+        for lr, p in enumerate(self.procs):
+            path = os.path.join(self.ctx.log_dir, f"workerlog.{lr}")
+            try:
+                log_bytes = os.path.getsize(path)
+            except OSError:
+                log_bytes = 0
+            rank = self.ctx.node_rank * self.ctx.nproc_per_node + lr
+            rc = p.poll()  # once: alive/returncode must agree
+            out.append({"rank": rank, "local_rank": lr, "pid": p.pid,
+                        "alive": rc is None,
+                        "returncode": rc, "log_bytes": log_bytes})
+        return out
+
     def tail_logs(self, n: int = 20):
         for lr in range(len(self.procs)):
             path = os.path.join(self.ctx.log_dir, f"workerlog.{lr}")
@@ -211,7 +237,10 @@ class ElasticManager:
 
 def launch(ctx: Context) -> int:
     """Run the pod until success, failure, or restart budget exhausted."""
+    from ...observability import RankHeartbeat
     elastic = ElasticManager(ctx)
+    hb = RankHeartbeat(os.path.join(ctx.log_dir, "heartbeat.jsonl"),
+                       interval=ctx.heartbeat_interval)
     rc = 1
     epoch = 0
     restarts = 0
@@ -230,6 +259,10 @@ def launch(ctx: Context) -> int:
                         peer_restart = True
                         break
                     elastic.heartbeat()
+                    if hb.due():  # rank_states stats N files: build it
+                        hb.beat(node=ctx.node_rank, epoch=epoch,  # 1x per
+                                restarts=restarts,                # interval
+                                ranks=pod.rank_states())
                     time.sleep(0.2)
             except KeyboardInterrupt:
                 pod.stop(signal.SIGINT)
@@ -256,6 +289,7 @@ def launch(ctx: Context) -> int:
             epoch += 1
         return rc if rc is not None else 1
     finally:
+        hb.close()
         elastic.close()
 
 

@@ -193,26 +193,38 @@ class Categorical(Distribution):
     draws them from paddle.rand) — NOT softmax'd log-space scores
     (r5 fuzz find: the old softmax reading diverged for the documented
     positional usage). The torch-style `probs=` kwarg is an alias with
-    the same normalization."""
+    the same normalization.
+
+    Negative/zero weights are NOT rejected (upstream normalizes whatever
+    it gets); set FLAGS_check_distribution_args=1 to get a construction-
+    time warning — that debug path reads the weights onto the host,
+    which blocks on device arrays, so it stays off in production."""
 
     def __init__(self, logits=None, probs=None, name=None):
         if (probs is None) == (logits is None):
             raise ValueError("pass exactly one of probs/logits")
         raw = logits if logits is not None else probs
         src = _t(raw)
-        # validate every CONCRETE weight (Tensor or numpy — the guard
-        # exists to catch log-space mistakes, which arrive as Tensors
-        # too); only traced values skip it. The host read is a sync on
-        # device arrays, accepted: construction is not a hot path and a
-        # silently inverted distribution is worse (advisor r5, twice).
-        import jax.core as _jcore
-        if not isinstance(src._value, _jcore.Tracer):
-            w = np.asarray(src._value)
-            if (w < 0).any() or (w.sum(-1) == 0).any():
-                raise ValueError(
-                    "Categorical weights must be non-negative with a "
-                    "positive sum (paddle normalizes by sum; log-space "
-                    "scores belong in e.g. softmax(logits) first)")
+        # Weight validation is DEBUG-ONLY (FLAGS_check_distribution_args):
+        # upstream paddle normalizes whatever it is given, so code ported
+        # from upstream passing raw scores must not hard-fail here, and
+        # np.asarray on a device array is a blocking host transfer we do
+        # not pay at construction by default (ADVICE r5 #2 downgraded the
+        # r5 ValueError; the log-space-mistake guard is now a warning
+        # under the flag). Traced values always skip it.
+        from ..framework.flags import flag_value
+        if flag_value("check_distribution_args"):
+            import jax.core as _jcore
+            if not isinstance(src._value, _jcore.Tracer):
+                w = np.asarray(src._value)  # host sync: debug flag only
+                if (w < 0).any() or (w.sum(-1) == 0).any():
+                    import warnings
+                    warnings.warn(
+                        "Categorical weights should be non-negative with "
+                        "a positive sum (they are normalized by their "
+                        "sum; log-space scores belong in softmax(logits) "
+                        "first). Normalizing anyway for upstream parity.",
+                        UserWarning, stacklevel=2)
         # normalization goes through apply() so log_prob/entropy
         # gradients reach a caller-owned weight tensor (advisor r5)
         self.probs = apply(

@@ -144,3 +144,11 @@ define_flag("max_inplace_grad_add", 0, "Parity stub.")
 define_flag("eager_delete_tensor_gb", 0.0, "Parity stub; XLA GC is automatic.")
 define_flag("shm_channel_capacity_mb", 64,
             "Per-DataLoader shared-memory ring capacity (native worker pool).")
+define_flag("obs_xla_mfu", False,
+            "Telemetry MFU numerator from XLA's cost model (one extra "
+            "lowering per batch signature) instead of the 6*N analytic "
+            "estimate.")
+define_flag("check_distribution_args", False,
+            "Validate distribution constructor arguments (e.g. negative "
+            "Categorical weights) with a warning. Costs a host sync on "
+            "device-resident weights, so it is debug-only.")

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from ..tensor import Tensor
 from .._grad_mode import no_grad
+from ..observability import metrics as _obsm
 
 
 class PrecisionType:
@@ -462,6 +463,7 @@ class ContinuousBatchingPredictor:
         self.pages_per_seq = _m.ceil(max_seq_len / page_size)
         if num_pages is None:
             num_pages = self.B * self.pages_per_seq
+        self.capacity = int(num_pages)  # pages available to requests
         self.pad_token_id = pad_token_id
         self.eos_token_id = eos_token_id
         head_dim = cfg.hidden_size // cfg.num_attention_heads
@@ -474,6 +476,22 @@ class ContinuousBatchingPredictor:
         self._trash = self.pool.alloc(1)[0]
         self.stats = {"prefills": 0, "decode_steps": 0, "evictions": 0,
                       "max_in_flight": 0}
+        self.last_status: List[str] = []
+        # serving telemetry (docs/OBSERVABILITY.md catalog); recording
+        # no-ops when paddle_tpu.observability.enabled(False)
+        self._m_queue = _obsm.gauge("serving.queue_depth")
+        self._m_util = _obsm.gauge("serving.page_utilization")
+        self._m_flight = _obsm.gauge("serving.in_flight")
+        self._m_adm = _obsm.counter("serving.admissions")
+        self._m_evt = _obsm.counter("serving.evictions")
+        self._m_rej = _obsm.counter("serving.rejected_requests")
+        self._m_done = _obsm.counter("serving.completed_requests")
+        self._m_steps = _obsm.counter("serving.decode_steps")
+        self._m_ttft = _obsm.histogram("serving.ttft_seconds", unit="s")
+        self._m_tok = _obsm.histogram("serving.token_latency_seconds",
+                                      unit="s")
+        self._m_prefill = _obsm.histogram("serving.prefill_seconds",
+                                          unit="s")
         # ragged-grid paged attention: only valid (slot, page) pairs
         # enter the decode kernel's grid. "auto" enables it when the
         # kernel's constraints hold (H == Hkv, D % 128 == 0, H % 8 == 0)
@@ -493,7 +511,9 @@ class ContinuousBatchingPredictor:
     def _prefill(self, prompt):
         """Run the prompt through the standard forward; returns (first
         token, per-layer K/V [L, Hkv, D])."""
+        import time as _time
         import numpy as np
+        t0 = _time.perf_counter()
         from ..tensor import Tensor
         from .._grad_mode import no_grad
         L = len(prompt)
@@ -516,6 +536,7 @@ class ContinuousBatchingPredictor:
             kvs.append((np.asarray(k.numpy())[0, bucket - L:],
                         np.asarray(v.numpy())[0, bucket - L:]))
         self.stats["prefills"] += 1
+        self._m_prefill.observe(_time.perf_counter() - t0)
         return first, kvs
 
     def _write_prefill_pages(self, kvs, page_ids, L):
@@ -536,17 +557,54 @@ class ContinuousBatchingPredictor:
                 jnp.asarray(vp).astype(self.pool.v[li].dtype))
 
     # ------------------------------------------------------------ serve --
-    def generate(self, prompts, max_new_tokens=32):
+    def generate(self, prompts, max_new_tokens=32, strict=True):
         """Continuous batching over a stream of prompts: List[List[int]]
         → List[List[int]] (new tokens per prompt, in request order).
-        Sequences join and leave the running batch mid-flight."""
+        Sequences join and leave the running batch mid-flight.
+
+        Requests that can NEVER be served — prompt + max_new_tokens
+        over `max_seq_len`, or a KV-page need exceeding the whole pool —
+        raise ValueError up front (strict=True, default). With
+        strict=False they are rejected per-request instead: their result
+        is [], `self.last_status[r]` records the reason
+        ('rejected_over_max_seq_len' / 'rejected_over_pool_capacity',
+        'ok' for served requests), and the serving.rejected_requests
+        counter increments. Never again the silent [] of ADVICE r5 #1.
+        """
+        import time as _time
         import numpy as np
         from ..tensor import Tensor
         from .._grad_mode import no_grad
         from ..generation.kv_cache import PagedCacheEntry, PagedKVCache
 
-        queue = list(range(len(prompts)))
+        t_gen = _time.perf_counter()
         results = [None] * len(prompts)
+        status = ["queued"] * len(prompts)
+        self.last_status = status
+        queue = []
+        for r, p in enumerate(prompts):
+            need = -(-(len(p) + max_new_tokens) // self.page)
+            if len(p) + max_new_tokens > self.max_seq_len:
+                kind, detail = "over_max_seq_len", (
+                    f"prompt len {len(p)} + max_new_tokens "
+                    f"{max_new_tokens} exceeds max_seq_len "
+                    f"{self.max_seq_len}")
+            elif need > self.capacity:
+                kind, detail = "over_pool_capacity", (
+                    f"needs {need} KV pages but the pool holds "
+                    f"{self.capacity}")
+            else:
+                queue.append(r)
+                continue
+            if strict:
+                raise ValueError(
+                    f"request {r} can never be served: {detail}. Raise "
+                    "max_seq_len/num_pages, shorten the prompt, or pass "
+                    "strict=False to reject it and serve the rest.")
+            results[r] = []
+            status[r] = "rejected_" + kind
+            self._m_rej.inc(reason=kind)
+            self._m_done.inc(status="rejected_" + kind)
         # slot state (host): -1 = free
         slot_req = [-1] * self.B
         slot_pages = [[] for _ in range(self.B)]
@@ -559,20 +617,19 @@ class ContinuousBatchingPredictor:
         def evict(b):
             r = slot_req[b]
             results[r] = slot_new[b]
+            status[r] = "ok"
             self.pool.release(slot_pages[b])
             slot_req[b], slot_pages[b], slot_new[b] = -1, [], []
             tables[b, :] = self._trash
             ctx[b] = 1
             self.stats["evictions"] += 1
+            self._m_evt.inc()
+            self._m_done.inc(status="ok")
 
         def admit(b):
             while queue:
                 r = queue[0]
                 prompt = prompts[r]
-                if len(prompt) + max_new_tokens > self.max_seq_len:
-                    queue.pop(0)
-                    results[r] = []      # over-long request: rejected
-                    continue
                 need = -(-(len(prompt) + max_new_tokens) // self.page)
                 pages = self.pool.alloc(need)
                 if pages is None:
@@ -580,6 +637,9 @@ class ContinuousBatchingPredictor:
                 queue.pop(0)
                 first, kvs = self._prefill(prompt)
                 self._write_prefill_pages(kvs, pages, len(prompt))
+                self._m_adm.inc()
+                self._m_ttft.observe(_time.perf_counter() - t_gen)
+                status[r] = "running"
                 slot_req[b], slot_pages[b] = r, pages
                 slot_new[b] = [first]
                 tables[b, :len(pages)] = pages
@@ -600,10 +660,15 @@ class ContinuousBatchingPredictor:
                 if slot_req[b] < 0:
                     admit(b)
             active = [b for b in range(self.B) if slot_req[b] >= 0]
+            self._m_queue.set(len(queue))
+            self._m_flight.set(len(active))
+            self._m_util.set((self.capacity - self.pool.free_count)
+                             / max(self.capacity, 1))
             if not active:
                 break
             self.stats["max_in_flight"] = max(self.stats["max_in_flight"],
                                               len(active))
+            t_step = _time.perf_counter()
             # ONE compiled step advances every active slot
             meta = None
             if self.use_ragged:
@@ -624,7 +689,11 @@ class ContinuousBatchingPredictor:
                 self.pool.k[li] = getattr(kp, "_value", kp)
                 self.pool.v[li] = getattr(vp, "_value", vp)
             self.stats["decode_steps"] += 1
+            self._m_steps.inc()
             nxt = np.asarray(logits.numpy())[:, -1].argmax(-1)
+            # one token per active slot per step: the step wall time IS
+            # the per-token decode latency (host sync above makes it real)
+            self._m_tok.observe(_time.perf_counter() - t_step)
             ctx[active] += 1
             for b in active:
                 t = int(nxt[b])
@@ -639,6 +708,9 @@ class ContinuousBatchingPredictor:
                         slot_new[b].pop()
                     evict(b)
         for r, res in enumerate(results):
-            if res is None:
-                results[r] = []
+            if res is None:   # defensive: admission validated up front,
+                results[r] = []   # so this should be unreachable
+                if status[r] in ("queued", "running"):
+                    status[r] = "incomplete"
+                    self._m_done.inc(status="incomplete")
         return results

@@ -1,0 +1,46 @@
+"""paddle_tpu.observability — always-on runtime telemetry.
+
+The offline profiler (paddle_tpu.profiler, XPlane capture) answers "why
+was this step slow"; this package answers "what is the system doing
+RIGHT NOW and what did it do over the last million steps" — the metrics
+layer every production trainer/server carries (tokens/s, MFU, comm
+bytes, queue depths, latency quantiles, memory watermarks).
+
+    import paddle_tpu.observability as obs
+
+    obs.configure(jsonl_path="telemetry.jsonl")   # or env
+    reqs = obs.counter("serving.requests")
+    reqs.inc(reason="admitted")                   # labeled series
+    obs.histogram("serving.ttft_seconds").observe(0.031)
+    print(obs.PrometheusExporter().render())
+
+    obs.enabled(False)    # every record becomes an early-return and
+                          # jit_callback emits NOTHING when tracing
+
+Instrumented out of the box: fleet.DistTrainStep / PipelineTrainStep
+(step time, tokens/s, MFU, grad-norm, memory watermarks, per-axis
+collective bytes), distributed.collective (per-op call/byte accounting),
+inference.ContinuousBatchingPredictor (queue depth, page utilization,
+TTFT / per-token latency, admissions/evictions/rejections), the Trainer
+loop, bench.py, and the elastic launcher (per-rank heartbeats). Metric
+catalog: docs/OBSERVABILITY.md.
+"""
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricRegistry, Sample, DEFAULT_BUCKETS,
+    enabled, scoped, get_registry, counter, gauge, histogram,
+)
+from .exporters import (  # noqa: F401
+    JsonlExporter, PrometheusExporter, TensorBoardExporter,
+)
+from .runtime import (  # noqa: F401
+    jit_callback, device_memory_stats, configure, maybe_export,
+    telemetry_path, RankHeartbeat,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricRegistry", "Sample",
+    "DEFAULT_BUCKETS", "enabled", "scoped", "get_registry", "counter",
+    "gauge", "histogram", "JsonlExporter", "PrometheusExporter",
+    "TensorBoardExporter", "jit_callback", "device_memory_stats",
+    "configure", "maybe_export", "telemetry_path", "RankHeartbeat",
+]

@@ -1,0 +1,182 @@
+"""Pluggable exporters over MetricRegistry.collect().
+
+Three sinks, one schema:
+- JsonlExporter      — append-only JSONL file, one sample per line; the
+                       shared schema of runtime telemetry, bench.py
+                       timings and tools/metrics_report.py.
+- PrometheusExporter — text-format snapshot (/metrics style) for pull
+                       scrapers.
+- TensorBoardExporter— scalars through utils/tbwriter.LogWriter (the
+                       repo's zero-dep TensorBoard event writer).
+
+Exporters PULL: recording a metric never touches a file descriptor; the
+training/serving loop (or the auto-sink in __init__) decides when to
+flush a snapshot.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from .metrics import MetricRegistry, Sample, get_registry
+
+__all__ = ["JsonlExporter", "PrometheusExporter", "TensorBoardExporter"]
+
+
+class JsonlExporter:
+    """Append registry snapshots to a JSONL file.
+
+    Line schema (one sample per line):
+        {"ts": <unix s>, "step": <int|None>, "name": "train.step_time",
+         "kind": "histogram", "labels": {...}, "value": <float>,
+         ... histogram extras: count/sum/min/max/p50/p99}
+    """
+
+    def __init__(self, path: str, registry: Optional[MetricRegistry] = None):
+        self.path = path
+        self._registry = registry or get_registry()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def export(self, step: Optional[int] = None, extra: Optional[dict] = None):
+        ts = time.time()
+        for s in self._registry.collect():
+            rec = {"ts": round(ts, 6), "step": step}
+            rec.update(s.as_dict())
+            if extra:
+                rec.update(extra)
+            self._f.write(json.dumps(rec) + "\n")
+
+    def write_record(self, rec: dict):
+        """Escape hatch for one-off records (bench.py run metadata) that
+        share the telemetry file but aren't registry series."""
+        self._f.write(json.dumps(rec) + "\n")
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    return ("_" + s) if s and s[0].isdigit() else s
+
+
+def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (_prom_name(str(k)),
+                     str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+class PrometheusExporter:
+    """Render the registry in the Prometheus text exposition format."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        self._registry = registry or get_registry()
+
+    def render(self) -> str:
+        lines = []
+        for m in self._registry.metrics():
+            pname = _prom_name(m.name)
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            if m.kind == "histogram":
+                for s in m.series():
+                    cum = 0
+                    for b, c in zip(m.buckets, s._counts):
+                        cum += c
+                        lines.append(
+                            f"{pname}_bucket"
+                            f"{_prom_labels(s._labels, {'le': b})} {cum}")
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_prom_labels(s._labels, {'le': '+Inf'})} "
+                        f"{s._count}")
+                    lines.append(
+                        f"{pname}_sum{_prom_labels(s._labels)} {s._sum}")
+                    lines.append(
+                        f"{pname}_count{_prom_labels(s._labels)} "
+                        f"{s._count}")
+            else:
+                for s in m.series():
+                    lines.append(
+                        f"{pname}{_prom_labels(s._labels)} {s._value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.render())
+        os.replace(tmp, path)  # scrape never sees a torn file
+        return path
+
+
+class TensorBoardExporter:
+    """Write registry scalars as TensorBoard events via the repo's
+    zero-dependency utils/tbwriter.LogWriter. Histograms export their
+    mean/p50/p99 as three scalar tags (TB's native histogram proto is
+    out of scope for the wire writer)."""
+
+    def __init__(self, logdir: str,
+                 registry: Optional[MetricRegistry] = None):
+        from ..utils.tbwriter import LogWriter
+        self._registry = registry or get_registry()
+        self._w = LogWriter(logdir=logdir)
+
+    @staticmethod
+    def _tag(s: Sample) -> str:
+        if not s.labels:
+            return s.name
+        lab = ".".join(f"{k}={v}" for k, v in sorted(s.labels.items()))
+        return f"{s.name}/{lab}"
+
+    def export(self, step: int = 0):
+        for s in self._registry.collect():
+            tag = self._tag(s)
+            if s.kind == "histogram":
+                if not s.extra.get("count"):
+                    continue
+                self._w.add_scalar(tag + "/mean", s.value, step)
+                self._w.add_scalar(tag + "/p50", s.extra["p50"], step)
+                self._w.add_scalar(tag + "/p99", s.extra["p99"], step)
+            else:
+                self._w.add_scalar(tag, s.value, step)
+
+    def flush(self):
+        self._w.flush()
+
+    def close(self):
+        self._w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,185 @@
+"""Runtime glue: jit-safe recording, device memory watermarks, the
+process auto-sink, and per-rank heartbeats.
+
+The contract with jitted code: metrics NEVER force a device sync. A
+traced value reaches the registry through `jax.debug.callback` (async,
+host-side, ordered by the runtime) and ONLY when telemetry is enabled at
+trace time — `jit_callback` with telemetry disabled emits nothing into
+the jaxpr, so the disabled mode costs literally zero inside compiled
+programs (asserted by tests/test_observability.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from .metrics import enabled, get_registry
+
+__all__ = ["jit_callback", "device_memory_stats", "configure",
+           "maybe_export", "telemetry_path", "RankHeartbeat"]
+
+
+def jit_callback(fn: Callable, *traced_args):
+    """Record traced values host-side from inside a jitted function.
+
+    `fn(*host_values)` runs on the host with numpy arrays once the
+    device values materialize (jax.debug.callback: async, no sync).
+    When telemetry is disabled AT TRACE TIME this is a literal no-op —
+    nothing enters the program. Callers re-jit (new step object / new
+    signature) to pick up a toggled switch; already-compiled programs
+    keep the behavior they were traced with.
+    """
+    if not enabled():
+        return
+    import jax
+
+    def _guarded(*vals):
+        if not enabled():  # runtime toggle after trace: drop silently
+            return
+        try:
+            fn(*vals)
+        except Exception:
+            pass  # telemetry must never kill a training step
+
+    jax.debug.callback(_guarded, *traced_args)
+
+
+def device_memory_stats() -> dict:
+    """Best-effort device memory watermark, no sync.
+
+    On real accelerators `Device.memory_stats()` reports allocator
+    watermarks; the CPU backend returns None, so we fall back to the
+    bytes of every live jax.Array (an upper bound that tracks leaks the
+    same way).  Returns {"bytes_in_use", "peak_bytes_in_use", "source"}.
+    """
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0))),
+                "source": "memory_stats"}
+    try:
+        live = sum(a.nbytes for a in jax.live_arrays())
+    except Exception:
+        live = 0
+    return {"bytes_in_use": int(live), "peak_bytes_in_use": int(live),
+            "source": "live_arrays"}
+
+
+# --------------------------------------------------------------- sink ------
+class _Sink:
+    lock = threading.Lock()
+    exporter = None          # JsonlExporter
+    every = 1                # export every N maybe_export calls
+    _calls = 0
+
+
+_sink = _Sink()
+
+
+def configure(jsonl_path: Optional[str] = None, every: int = 1):
+    """Attach (or detach, with None) the process JSONL telemetry sink.
+
+    Instrumented hot paths call `maybe_export(step=...)` once per step;
+    with a sink configured that appends one registry snapshot every
+    `every` calls. Env default: PADDLE_TPU_TELEMETRY_JSONL.
+    """
+    from .exporters import JsonlExporter
+    with _Sink.lock:
+        if _sink.exporter is not None:
+            _sink.exporter.close()
+            _sink.exporter = None
+        if jsonl_path:
+            _sink.exporter = JsonlExporter(jsonl_path)
+        _sink.every = max(1, int(every))
+        _sink._calls = 0
+
+
+def telemetry_path() -> Optional[str]:
+    return _sink.exporter.path if _sink.exporter is not None else None
+
+
+_env_checked = False
+
+
+def _ensure_env_sink():
+    global _env_checked
+    if _env_checked or _sink.exporter is not None:
+        return
+    _env_checked = True
+    path = os.environ.get("PADDLE_TPU_TELEMETRY_JSONL")
+    if path:
+        configure(path)
+
+
+def maybe_export(step: Optional[int] = None):
+    """Flush a registry snapshot to the configured JSONL sink (no-op
+    when telemetry is disabled or no sink is configured)."""
+    if not enabled():
+        return
+    _ensure_env_sink()
+    with _Sink.lock:
+        exp = _sink.exporter
+        if exp is None:
+            return
+        _sink._calls += 1
+        if (_sink._calls % _sink.every) != 0:
+            return
+        exp.export(step=step)
+
+
+# ---------------------------------------------------------- heartbeat ------
+class RankHeartbeat:
+    """Per-rank liveness lines so a wedged rank is diagnosable
+    (BENCH_r0* postmortems: five rounds of silently wedged TPU runs).
+
+    Appends JSONL lines {"ts", "kind": "heartbeat", "rank"/"epoch", ...}
+    at most once per `interval` seconds; `beat(**fields)` is safe to
+    call every loop tick. interval <= 0 disables."""
+
+    def __init__(self, path: str, interval: float = 1.0):
+        self.path = path
+        self.interval = float(interval)
+        self._last = 0.0
+        self._f = None
+        if self.interval > 0:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+
+    def due(self) -> bool:
+        """True when the next beat would actually write — check before
+        building an expensive snapshot payload every loop tick."""
+        return (self._f is not None
+                and time.time() - self._last >= self.interval)
+
+    def beat(self, **fields) -> bool:
+        if self._f is None:
+            return False
+        now = time.time()
+        if now - self._last < self.interval:
+            return False
+        self._last = now
+        rec = {"ts": round(now, 3), "kind": "heartbeat"}
+        rec.update(fields)
+        try:
+            self._f.write(json.dumps(rec) + "\n")
+        except Exception:
+            return False
+        return True
+
+    def close(self):
+        if self._f is not None:
+            try:
+                self._f.close()
+            except Exception:
+                pass
+            self._f = None

@@ -20,6 +20,7 @@ import numpy as np
 import jax
 
 from ..tensor import Tensor
+from .. import observability as _obs
 
 __all__ = ["TrainingArguments", "Trainer", "SpeedMeter",
            "device_peak_flops"]
@@ -219,6 +220,16 @@ class Trainer:
                        "mfu": round(meter.mfu, 4)}
                 logs.append(rec)
                 self._log(rec)
+                if _obs.enabled():
+                    # per-step series come from the step object; the
+                    # loop owns loss (synced only at log boundaries)
+                    _obs.gauge("train.loss").set(loss_val)
+                    if getattr(self._step_obj, "_obs", None) is None:
+                        # uninstrumented step (single-device TrainStep):
+                        # the loop is the only flusher. Instrumented
+                        # steps export per step already — a second flush
+                        # here would duplicate snapshots.
+                        _obs.maybe_export(step=step + 1)
             if (step + 1) % args.save_steps == 0 or self._preempted:
                 self._save(step + 1)
             if self._preempted:

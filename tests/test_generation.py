@@ -534,16 +534,45 @@ class TestContinuousBatching:
         assert cb.stats["decode_steps"] < len(prompts) * 8
 
     def test_pool_accounting_and_overlong_rejection(self):
+        import pytest
         from paddle_tpu.inference import ContinuousBatchingPredictor
         model = self._model()
         cb = ContinuousBatchingPredictor(model, max_batch_size=2,
                                          page_size=8, max_seq_len=32)
         free0 = cb.pool.free_count
         prompts = [[3, 4, 5], list(range(2, 60)), [7, 8]]
-        out = cb.generate(prompts, max_new_tokens=4)
+        # strict (default): an unservable request raises up front
+        with pytest.raises(ValueError, match="max_seq_len"):
+            cb.generate(prompts, max_new_tokens=4)
+        assert cb.pool.free_count == free0  # nothing leaked by the raise
+        # strict=False: rejected per-request with a status, rest served
+        out = cb.generate(prompts, max_new_tokens=4, strict=False)
         assert out[1] == []           # over max_seq_len: rejected
+        assert cb.last_status[1] == "rejected_over_max_seq_len"
+        assert cb.last_status[0] == cb.last_status[2] == "ok"
         assert len(out[0]) == 4 and len(out[2]) == 4
         assert cb.pool.free_count == free0  # every page returned
+
+    def test_over_pool_capacity_rejection(self):
+        import pytest
+        from paddle_tpu.inference import ContinuousBatchingPredictor
+        model = self._model()
+        # pool of 2 pages total: a request needing 3 pages can never be
+        # admitted — previously the serve loop broke and EVERY queued
+        # request silently got [] (ADVICE r5 #1)
+        cb = ContinuousBatchingPredictor(model, max_batch_size=2,
+                                         page_size=8, num_pages=2,
+                                         max_seq_len=64)
+        ok, too_big = [3, 4, 5], list(range(2, 20))
+        with pytest.raises(ValueError, match="pool"):
+            cb.generate([ok, too_big], max_new_tokens=8)
+        out = cb.generate([ok, too_big, ok], max_new_tokens=8,
+                          strict=False)
+        assert out[1] == []
+        assert cb.last_status[1] == "rejected_over_pool_capacity"
+        # the servable requests around it still complete
+        assert len(out[0]) == 8 and len(out[2]) == 8
+        assert cb.last_status[0] == cb.last_status[2] == "ok"
 
 
 class TestRaggedPagedAttention:

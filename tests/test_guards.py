@@ -25,6 +25,7 @@ ABSTRACT_METHODS = {
     "forward", "inverse", "forward_log_det_jacobian",  # Transform
     "backward",                                       # PyLayer
     "get_lr",                                         # LRScheduler
+    "_new_series", "samples",                         # observability._Metric
     "_update",                                        # Optimizer subclass hook
     "__call__",
     # dispatch-miss with a registration hook, same behavior as upstream

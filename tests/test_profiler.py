@@ -120,6 +120,37 @@ def test_export_contracts(tmp_path):
         profiler.load_profiler_result(str(tmp_path))
 
 
+def test_chrome_trace_roundtrip_matches_raw_dir(captured, tmp_path):
+    """PR 1 satellite: export_chrome_tracing / to_chrome_trace round-trip
+    — the exported JSON loads, keeps the RecordEvent user scopes, and
+    load_profiler_result on the raw trace dir reproduces the same
+    event set."""
+    if captured.stats is None:
+        pytest.skip("XPlane stats unavailable in this environment "
+                    "(same root cause as the seed's failing profiler "
+                    "tests: the capture produced no parseable trace)")
+    out = str(tmp_path / "rt.json")
+    captured.stats.to_chrome_trace(out)
+    data = json.load(open(out))
+    xev = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    assert any("user_train_scope" in e["name"] for e in xev)
+    # the raw dir re-parse yields the identical event multiset
+    stats2 = profiler.load_profiler_result(captured._dir)
+    assert len(xev) == len(stats2.events)
+    assert (sorted(e["name"] for e in xev)
+            == sorted(name for _, _, name, _, _ in stats2.events))
+    # per-event times survive the round trip (chrome ts/dur are in us)
+    total_json = sum(e["dur"] for e in xev)
+    total_raw = sum(dur for *_, dur in stats2.events) / 1e3
+    assert abs(total_json - total_raw) < 1e-6 * max(total_raw, 1.0)
+    # the on_trace_ready handler writes the same artifact
+    d = str(tmp_path / "handler_out")
+    profiler.export_chrome_tracing(d, "w0")(captured)
+    data2 = json.load(open(os.path.join(d, "w0.json")))
+    assert (sorted(e.get("name") for e in data2["traceEvents"])
+            == sorted(e.get("name") for e in data["traceEvents"]))
+
+
 def test_export_chrome_tracing_handler(tmp_path, captured):
     # the on_trace_ready factory writes into dir_name at trace-ready
     d = str(tmp_path / "chrome_out")

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Summarize a paddle_tpu telemetry JSONL file (PR 1 satellite).
+
+Reads the line schema of observability.JsonlExporter (one sample per
+line: ts/step/name/kind/labels/value, histogram lines add count/sum/
+p50/p99) and prints a step-rate / MFU / comm / serving summary plus a
+generic last-value table for everything else.
+
+    python tools/metrics_report.py telemetry.jsonl
+    python tools/metrics_report.py telemetry.jsonl --follow   # tail -f
+
+No paddle_tpu import needed — this runs anywhere there is a file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
+def _fmt_si(n):
+    for div, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(n) >= div:
+            return f"{n / div:.2f}{suf}"
+    return f"{n:.2f}"
+
+
+def parse(lines, last=None):
+    """Merge samples into {(name, frozen_labels): last_record} —
+    counters/histograms are cumulative, so the last sample per series
+    carries the summary; no history is retained, and --follow feeds only
+    the appended lines, so a huge file stays O(series) per refresh."""
+    last = last if last is not None else {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        name = rec.get("name")
+        if not name:
+            continue
+        key = (name, tuple(sorted((rec.get("labels") or {}).items())))
+        last[key] = rec
+    return last
+
+
+def _series(last, name):
+    return {k[1]: rec for k, rec in last.items() if k[0] == name}
+
+
+def _one(last, name, default=None):
+    s = _series(last, name)
+    if not s:
+        return default
+    return next(iter(s.values()))
+
+
+def render(last) -> str:
+    out = []
+    w = out.append
+
+    step_h = _one(last, "train.step_time_seconds")
+    if step_h and step_h.get("count"):
+        steps = _one(last, "train.steps") or {}
+        tokens = _one(last, "train.tokens") or {}
+        tps = _one(last, "train.tokens_per_sec") or {}
+        mfu = _one(last, "train.mfu") or {}
+        gn = _one(last, "train.grad_norm") or {}
+        loss = _one(last, "train.loss") or {}
+        w("== training ==")
+        w(f"  steps           {int(steps.get('value', 0))}"
+          f"   tokens {_fmt_si(tokens.get('value', 0))}")
+        w(f"  step_time       mean {step_h['value'] * 1e3:.2f}ms"
+          f"   p50 {step_h['p50'] * 1e3:.2f}ms"
+          f"   p99 {step_h['p99'] * 1e3:.2f}ms")
+        if tps.get("value"):
+            w(f"  tokens/sec      {_fmt_si(tps['value'])}")
+        if mfu.get("value") is not None:
+            w(f"  MFU             {100.0 * mfu.get('value', 0):.2f}%")
+        if gn:
+            w(f"  grad_norm       {gn.get('value', 0):.4g}")
+        if loss:
+            w(f"  loss            {loss.get('value', 0):.6g}")
+
+    pp_t = _one(last, "pp.tick_time_seconds")
+    if pp_t and pp_t.get("count"):
+        ticks = _one(last, "pp.ticks_per_step") or {}
+        w("== pipeline ==")
+        w(f"  ticks/step      {int(ticks.get('value', 0))}"
+          f"   tick_time mean {pp_t['value'] * 1e3:.2f}ms"
+          f"   p99 {pp_t['p99'] * 1e3:.2f}ms")
+
+    mem = _one(last, "mem.peak_bytes_in_use")
+    if mem:
+        cur = _one(last, "mem.bytes_in_use") or {}
+        w("== memory ==")
+        w(f"  in_use          {_fmt_bytes(cur.get('value', 0))}"
+          f"   peak {_fmt_bytes(mem.get('value', 0))}")
+
+    comm = _series(last, "comm.bytes")
+    if comm:
+        calls = _series(last, "comm.calls")
+        w("== collectives (cumulative) ==")
+        w(f"  {'op':<16}{'axis':<10}{'calls':>10}{'bytes':>12}")
+        for labels, rec in sorted(comm.items()):
+            lab = dict(labels)
+            n_calls = calls.get(labels, {}).get("value", 0)
+            w(f"  {lab.get('op', '?'):<16}{lab.get('axis', '?'):<10}"
+              f"{int(n_calls):>10}{_fmt_bytes(rec['value']):>12}")
+
+    adm = _one(last, "serving.admissions")
+    if adm:
+        ttft = _one(last, "serving.ttft_seconds") or {}
+        tok = _one(last, "serving.token_latency_seconds") or {}
+        util = _one(last, "serving.page_utilization") or {}
+        q = _one(last, "serving.queue_depth") or {}
+        rej = _series(last, "serving.rejected_requests")
+        w("== serving ==")
+        w(f"  admissions      {int(adm.get('value', 0))}"
+          f"   queue {int(q.get('value', 0))}"
+          f"   page_util {100.0 * util.get('value', 0):.1f}%")
+        if ttft.get("count"):
+            w(f"  TTFT            p50 {ttft['p50'] * 1e3:.1f}ms"
+              f"   p99 {ttft['p99'] * 1e3:.1f}ms")
+        if tok.get("count"):
+            w(f"  token latency   p50 {tok['p50'] * 1e3:.2f}ms"
+              f"   p99 {tok['p99'] * 1e3:.2f}ms")
+        for labels, rec in sorted(rej.items()):
+            w(f"  rejected[{dict(labels).get('reason', '?')}]  "
+              f"{int(rec['value'])}")
+
+    known = {"train.step_time_seconds", "train.steps", "train.tokens",
+             "train.tokens_per_sec", "train.mfu", "train.grad_norm",
+             "train.loss", "pp.tick_time_seconds", "pp.ticks_per_step",
+             "mem.bytes_in_use", "mem.peak_bytes_in_use", "comm.bytes",
+             "comm.calls", "serving.admissions", "serving.ttft_seconds",
+             "serving.token_latency_seconds", "serving.page_utilization",
+             "serving.queue_depth", "serving.rejected_requests"}
+    rest = sorted(k for k in last if k[0] not in known)
+    if rest:
+        w("== other (last value) ==")
+        for key in rest:
+            rec = last[key]
+            lab = dict(key[1])
+            lab_s = ("{" + ",".join(f"{a}={b}" for a, b in
+                                    sorted(lab.items())) + "}") if lab \
+                else ""
+            extra = (f"  n={rec['count']} p99={rec['p99']:.4g}"
+                     if rec.get("kind") == "histogram"
+                     and rec.get("count") else "")
+            w(f"  {key[0]}{lab_s:<24} {rec.get('value', 0):.6g}{extra}")
+
+    return "\n".join(out) if out else "(no telemetry samples)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="telemetry JSONL file")
+    ap.add_argument("--follow", action="store_true",
+                    help="re-render every --interval seconds")
+    ap.add_argument("--interval", type=float, default=2.0)
+    a = ap.parse_args(argv)
+    last, offset = {}, 0
+    while True:
+        try:
+            if os.path.getsize(a.path) < offset:
+                offset, last = 0, {}     # truncated/rotated: start over
+            with open(a.path) as f:
+                f.seek(offset)           # incremental: appended lines only
+                last = parse(f, last)
+                offset = f.tell()
+        except FileNotFoundError:
+            print(f"(waiting for {a.path})" if a.follow
+                  else f"no such file: {a.path}", file=sys.stderr)
+            if not a.follow:
+                return 1
+            time.sleep(a.interval)
+            continue
+        text = render(last)
+        if a.follow:
+            print("\x1b[2J\x1b[H" + text, flush=True)
+            time.sleep(a.interval)
+        else:
+            print(text)
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,29 +244,163 @@ def _emit_telemetry(result, step_time_s, tokens, final_loss):
             os.path.dirname(os.path.abspath(__file__)), "output",
             "telemetry_bench.jsonl")
         aux = result["aux"]
-        reg = obs.MetricRegistry()  # private: don't mix with live series
-        reg.counter("train.steps").inc(aux["iters"])
-        reg.counter("train.tokens").inc(tokens)
-        reg.histogram("train.step_time_seconds", unit="s").observe(
-            step_time_s)
-        reg.gauge("train.tokens_per_sec").set(result["value"])
-        reg.gauge("train.mfu").set(aux.get("mfu_xla") or aux["mfu_est"])
-        reg.gauge("train.loss").set(final_loss)
-        if aux.get("peak_hbm_bytes"):
-            reg.gauge("mem.peak_bytes_in_use", unit="bytes").set(
-                aux["peak_hbm_bytes"])
-        with obs.JsonlExporter(path, registry=reg) as sink:
-            sink.write_record({"kind": "bench", "ts": time.time(),
-                               "metric": result["metric"],
-                               "value": result["value"],
-                               "unit": result["unit"],
-                               "backend": aux["backend"],
-                               "batch": aux["batch"], "seq": aux["seq"],
-                               "bench_code_sha": aux["bench_code_sha"]})
-            sink.export()
+        # recording no-ops under the process-wide disabled switch even
+        # on a private registry — force it on for the mirror, restore
+        # on every path (an exception here must not leak enabled=True)
+        was_enabled = obs.enabled()
+        obs.enabled(True)
+        try:
+            reg = obs.MetricRegistry()  # private: no live-series mixing
+            reg.counter("train.steps").inc(aux["iters"])
+            reg.counter("train.tokens").inc(tokens)
+            reg.histogram("train.step_time_seconds", unit="s").observe(
+                step_time_s)
+            reg.gauge("train.tokens_per_sec").set(result["value"])
+            reg.gauge("train.mfu").set(aux.get("mfu_xla") or aux["mfu_est"])
+            reg.gauge("train.loss").set(final_loss)
+            if aux.get("peak_hbm_bytes"):
+                reg.gauge("mem.peak_bytes_in_use", unit="bytes").set(
+                    aux["peak_hbm_bytes"])
+            with obs.JsonlExporter(path, registry=reg) as sink:
+                sink.write_record({"kind": "bench", "ts": time.time(),
+                                   "metric": result["metric"],
+                                   "value": result["value"],
+                                   "unit": result["unit"],
+                                   "backend": aux["backend"],
+                                   "batch": aux["batch"], "seq": aux["seq"],
+                                   "bench_code_sha": aux["bench_code_sha"]})
+                sink.export()
+        finally:
+            obs.enabled(was_enabled)
         _log(f"telemetry mirrored to {path}")
     except Exception as e:  # telemetry must never fail the bench
         _log(f"telemetry sink skipped: {e!r}")
+
+
+def serve_bench(argv=None):
+    """Serving section: offered-load sweep over the continuous-batching
+    predictor (PR-2 fast path: device-resident prefill, prefix caching,
+    sync-free decode). For each offered load the sweep records decode
+    tokens/s, TTFT and per-token latency quantiles, admission
+    (prefill+scatter) wall time, and prefix-cache effectiveness — all
+    through the observability JSONL sink (one schema with the training
+    bench, readable by tools/metrics_report.py).
+
+        python bench.py --serve [--loads 4,8] [--max-new 16]
+
+    Prints one JSON summary line; CPU smoke shrinks the model/loads so
+    the tier-1 suite can run it in-process (the serving fast path can
+    never silently regress back to the host round-trip without this
+    number moving).
+    """
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--loads", default=None,
+                    help="comma-separated offered loads (requests/sweep)")
+    ap.add_argument("--max-new", type=int, default=None)
+    ap.add_argument("--out", default=None, help="telemetry JSONL path")
+    a = ap.parse_args(argv)
+
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ContinuousBatchingPredictor
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        loads = [int(x) for x in (a.loads or "8,16,32").split(",")]
+        max_new = a.max_new or 64
+        batch, page, max_seq = 8, 16, 1024
+        prompt_lens = (120, 60, 200, 90)
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        loads = [int(x) for x in (a.loads or "2,4").split(",")]
+        max_new = a.max_new or 4
+        batch, page, max_seq = 2, 8, 64
+        prompt_lens = (5, 9, 12, 7)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    rng = np.random.RandomState(0)
+    shared = rng.randint(2, cfg.vocab_size, (page,)).tolist()
+
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "output", "telemetry_serve.jsonl")
+    was_enabled = obs.enabled()
+    obs.enabled(True)
+    levels = []
+    try:
+        with obs.JsonlExporter(path) as sink:
+            for load in loads:
+                # fresh series per level: the serving histograms are
+                # cumulative, and cross-level aggregation would corrupt
+                # the per-load TTFT/latency quantiles
+                obs.get_registry().reset()
+                cb = ContinuousBatchingPredictor(
+                    model, max_batch_size=batch, page_size=page,
+                    max_seq_len=max_seq)
+                # shared system prompt on half the requests: the sweep
+                # exercises the prefix cache the way serving traffic does
+                prompts = []
+                for i in range(load):
+                    body = rng.randint(
+                        2, cfg.vocab_size,
+                        (prompt_lens[i % len(prompt_lens)],)).tolist()
+                    prompts.append(shared + body if i % 2 else body)
+                t0 = time.perf_counter()
+                outs = cb.generate(prompts, max_new_tokens=max_new)
+                dt = time.perf_counter() - t0
+                toks = sum(len(o) for o in outs)
+                lvl = {
+                    "offered_load": load,
+                    "wall_s": round(dt, 4),
+                    "new_tokens": toks,
+                    "tokens_per_s": round(toks / dt, 2),
+                    "decode_steps": cb.stats["decode_steps"],
+                    "steps_per_s": round(
+                        cb.stats["decode_steps"] / dt, 2),
+                    "prefills": cb.stats["prefills"],
+                    "prefill_batches": cb.stats["prefill_batches"],
+                    "prefix_hits": cb.stats["prefix_hits"]
+                    + cb.stats["prefix_partial_hits"],
+                    "pages_reused": cb.stats["pages_reused"],
+                    "hol_skips": cb.stats["hol_skips"],
+                    "max_in_flight": cb.stats["max_in_flight"],
+                }
+                levels.append(lvl)
+                sink.write_record({"kind": "serve_bench_level",
+                                   "ts": time.time(), **lvl})
+                sink.export()   # serving.* histograms: TTFT, token
+                _log(f"load={load}: {lvl['tokens_per_s']} tok/s, "
+                     f"{lvl['prefix_hits']} prefix hits")
+    finally:
+        obs.enabled(was_enabled)
+
+    best = max(levels, key=lambda x: x["tokens_per_s"])
+    result = {
+        "metric": "serve_cb_decode_tokens_per_sec",
+        "value": best["tokens_per_s"],
+        "unit": "tokens/s",
+        "aux": {
+            "backend": jax.default_backend(),
+            "levels": levels,
+            "max_new": max_new,
+            "batch": batch,
+            "telemetry": path,
+            "bench_code_sha": _bench_code_sha(),
+        },
+    }
+    print(json.dumps(result))
+    return 0
 
 
 def _bench_code_sha():
@@ -401,7 +535,9 @@ def _orchestrate():
 
 
 if __name__ == "__main__":
-    if "--worker" in sys.argv:
+    if "--serve" in sys.argv:
+        sys.exit(serve_bench([x for x in sys.argv[1:] if x != "--serve"]))
+    elif "--worker" in sys.argv:
         main()
     else:
         sys.exit(_orchestrate())

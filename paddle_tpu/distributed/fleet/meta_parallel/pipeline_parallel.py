@@ -138,10 +138,13 @@ def _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh, axis,
     'context' axis (nested manual computations cannot be lowered).
 
     check_vma=True is required: this jax version's partial-manual
-    shard_map mis-builds internal specs with check_vma=False.
+    shard_map mis-builds internal specs with check_vma=False. (On old
+    jax without the top-level alias, framework.jax_compat degrades the
+    call to experimental shard_map with auto=/check_rep.)
     """
+    from ....framework.jax_compat import shard_map as _shard_map_compat
     manual = {axis} | {a for a in x_spec if a is not None}
-    run = jax.shard_map(
+    run = _shard_map_compat(
         staged, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
                   x_spec, P()),
@@ -155,9 +158,8 @@ def _ring_shard_map(staged, stacked_params, x_micro, rng_key, mesh, axis,
 def _varying(axes, val):
     """Mark a scan carry stage-varying up front (scan requires carry
     types invariant across iterations)."""
-    if isinstance(axes, str):
-        axes = (axes,)
-    return jax.lax.pcast(val, tuple(axes), to="varying")
+    from ....framework.jax_compat import pcast
+    return pcast(val, axes, to="varying")
 
 
 def _seq_spec(x_micro, mesh, seq_axis):
@@ -787,7 +789,10 @@ class PipelineTrainStep:
             donate_argnums=donate)
 
         def run(*args):
-            with mesh_scope(mesh):
+            from ....framework.jax_compat import (x64_safe_shard_map_trace,
+                                                  narrow_x64_leaves)
+            args = narrow_x64_leaves(args)
+            with mesh_scope(mesh), x64_safe_shard_map_trace():
                 return jitted(*args)
         run._jitted = jitted  # exposed for memory_analysis (no execute)
         return run
@@ -868,12 +873,15 @@ class PipelineTrainStep:
             else ()
         key = jax.random.key(0)
         lr = jnp.asarray(0.0, jnp.float32)
-        with mesh_scope(self._mesh):
-            lowered = jitted.lower(
-                [p._value for p in self._pre_p], list(self._stacked),
-                [p._value for p in self._post_p],
-                [b._value for b in self._edge_b],
-                self._opt_state, key, lr, arrays, sc_in)
+        from ....framework.jax_compat import (x64_safe_shard_map_trace,
+                                              narrow_x64_leaves)
+        args = narrow_x64_leaves((
+            [p._value for p in self._pre_p], list(self._stacked),
+            [p._value for p in self._post_p],
+            [b._value for b in self._edge_b],
+            self._opt_state, key, lr, arrays, sc_in))
+        with mesh_scope(self._mesh), x64_safe_shard_map_trace():
+            lowered = jitted.lower(*args)
             cache[sig] = lowered.compile().memory_analysis()
         return cache[sig]
 

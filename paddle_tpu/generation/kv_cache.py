@@ -10,6 +10,13 @@ TPU-native design: instead of concatenating K/V each step (dynamic shapes
 `lax.dynamic_update_slice` at a traced position. The whole decode loop
 then compiles to ONE XLA program (`lax.scan` over steps) with static
 shapes, which is the canonical TPU serving pattern.
+
+The serving side lives here too: `PagedKVPool` (refcounted page
+allocator over the device-resident paged K/V arrays, with on-device
+copy-on-write) and `PrefixCache` (hash-trie over page-aligned prompt
+prefixes so repeated system prompts skip prefill — cf. vLLM automatic
+prefix caching / SGLang RadixAttention), consumed by
+inference.ContinuousBatchingPredictor (docs/SERVING.md).
 """
 from __future__ import annotations
 
@@ -61,6 +68,274 @@ def static_cache_update(entry: StaticCacheEntry, k, v):
     k_new = apply(upd, entry.k, k, entry.pos, _name="kv_cache_update")
     v_new = apply(upd, entry.v, v, entry.pos, _name="kv_cache_update")
     return k_new, v_new, StaticCacheEntry(k_new, v_new, entry.pos)
+
+
+class PagedKVPool:
+    """Host-side page allocator over the device-resident paged KV arrays
+    (reference parity: the block manager of PaddleNLP's serving /
+    vLLM's BlockSpaceManager). Pages are shared by all slots; the free
+    list and reference counts live on host, the page contents on device.
+
+    Pages are refcounted so prompt prefixes can be shared across
+    requests (PrefixCache): `alloc` hands out pages at refcount 1,
+    `retain`/`release` adjust the count, and a page returns to the free
+    list only when its count reaches zero. `copy_into` implements
+    copy-on-write: a request that must append into a shared page first
+    copies its contents into an exclusively-owned page on device.
+
+    An optional `reclaimer` (the PrefixCache) is consulted when `alloc`
+    runs short: cached-but-unused pages are dropped to satisfy the
+    request, and `free_count` reports them as available.
+    """
+
+    def __init__(self, n_layers, num_pages, page_size, n_kv_heads,
+                 head_dim, dtype="float32"):
+        import jax.numpy as jnp
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        shape = (num_pages, page_size, n_kv_heads, head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        self._free = list(range(num_pages))
+        self._refs = {}
+        self.reclaimer = None
+
+    @property
+    def free_count(self):
+        """Pages obtainable right now: the free list plus cache-held
+        pages the reclaimer would drop on demand."""
+        extra = (self.reclaimer.reclaimable_count(self)
+                 if self.reclaimer is not None else 0)
+        return len(self._free) + extra
+
+    def alloc(self, n):
+        """n page ids (each at refcount 1), or None if the pool can't
+        satisfy the request even after reclaiming cached pages."""
+        if n > len(self._free) and self.reclaimer is not None:
+            self.reclaimer.reclaim(self, n - len(self._free))
+        if n > len(self._free):
+            return None
+        got, self._free = self._free[:n], self._free[n:]
+        for p in got:
+            self._refs[p] = 1
+        return got
+
+    def retain(self, ids):
+        for p in ids:
+            self._refs[p] = self._refs.get(p, 0) + 1
+
+    def release(self, ids):
+        for p in ids:
+            c = self._refs.get(p, 1) - 1
+            if c <= 0:
+                self._refs.pop(p, None)
+                self._free.append(p)
+            else:
+                self._refs[p] = c
+
+    def ref_count(self, pid):
+        return self._refs.get(pid, 0)
+
+    def copy_into(self, src, dst):
+        """Device-side page copy (all layers), no host round-trip —
+        the write half of copy-on-write. One jitted program updates
+        every layer; with buffer donation (non-CPU backends) the cost
+        is one page of traffic, not a pool copy per layer."""
+        import jax
+        import numpy as np
+        if not hasattr(self, "_copy_jit"):
+            def _copy(kl, vl, s, d):
+                return ([k.at[d].set(k[s]) for k in kl],
+                        [v.at[d].set(v[s]) for v in vl])
+            dn = (0, 1) if jax.default_backend() != "cpu" else ()
+            self._copy_jit = jax.jit(_copy, donate_argnums=dn)
+        self.k, self.v = self._copy_jit(self.k, self.v,
+                                        np.int32(src), np.int32(dst))
+        self.k, self.v = list(self.k), list(self.v)
+
+
+class _PrefixNode:
+    __slots__ = ("page", "next_token", "last_use", "children", "partials")
+
+    def __init__(self, page=None, next_token=None, last_use=0):
+        self.page = page
+        self.next_token = next_token
+        self.last_use = last_use
+        self.children = {}   # full page-size token tuple -> _PrefixNode
+        self.partials = {}   # sub-page token tuple -> [page, next_token, use]
+
+
+class PrefixCache:
+    """Hash-trie over page-aligned prompt prefixes (cf. vLLM automatic
+    prefix caching / SGLang RadixAttention): each trie edge is one KV
+    page worth of token ids, each node holds the physical page that
+    caches that prefix's K/V plus the greedy next token after it.
+
+    A node additionally stores *partial* trailing chunks (< page_size
+    tokens) so prompts that are not page-multiples still share their
+    final page; a request extending a partial chunk copies the page
+    first (copy-on-write at the divergence page — the pool refcount
+    stays intact for the cached reader).
+
+    The trie retains one pool reference per cached page; pages whose
+    only reference is the trie are reclaimable on allocation pressure
+    (LRU leaf-first) and are reported as free by the pool.
+    """
+
+    def __init__(self, page_size):
+        self.page = int(page_size)
+        self._root = _PrefixNode()
+        self._clock = 0
+
+    def _bump(self):
+        self._clock += 1
+        return self._clock
+
+    # ------------------------------------------------------------- read --
+    def lookup(self, prompt):
+        """Longest cached page-aligned prefix of `prompt`.
+
+        Returns (pages, covered, partial, next_token): `pages` are the
+        full shared page ids covering `covered - (partial and its len)`
+        ... specifically full pages cover the first len(pages)*page
+        tokens; `partial`, when not None, is (page_id, n_tokens) for a
+        shared sub-page chunk extending the covered span (the caller
+        must copy-on-write that page before appending); `next_token` is
+        the cached greedy continuation when the WHOLE prompt is covered
+        (else None)."""
+        node = self._root
+        pages = []
+        m = 0
+        n = len(prompt)
+        while m + self.page <= n:
+            child = node.children.get(tuple(prompt[m:m + self.page]))
+            if child is None:
+                break
+            child.last_use = self._bump()
+            pages.append(child.page)
+            m += self.page
+            node = child
+        next_token = node.next_token if (m == n and m > 0) else None
+        partial = None
+        if m < n:
+            rem = tuple(prompt[m:])
+            best = None
+            for toks, rec in node.partials.items():
+                if (len(toks) <= len(rem) and rem[:len(toks)] == toks
+                        and (best is None or len(toks) > len(best[0]))):
+                    best = (toks, rec)
+            if best is not None:
+                toks, rec = best
+                rec[2] = self._bump()
+                partial = (rec[0], len(toks))
+                if m + len(toks) == n and rec[1] is not None:
+                    next_token = rec[1]
+        return pages, m, partial, next_token
+
+    # ------------------------------------------------------------ write --
+    def insert(self, prompt, page_ids, next_tokens, pool):
+        """Record a freshly prefilled prompt. `page_ids`: the pages
+        holding the prompt's K/V in order (ceil(len/page) entries, the
+        request's own table prefix). `next_tokens[i]` is the greedy
+        token after prompt position i (None where unknown, e.g. the
+        already-cached prefix of a suffix prefill). Existing nodes are
+        left untouched; new nodes retain their page in the pool."""
+        node = self._root
+        m, i, n = 0, 0, len(prompt)
+        while m + self.page <= n:
+            chunk = tuple(prompt[m:m + self.page])
+            child = node.children.get(chunk)
+            if child is None:
+                nt = next_tokens[m + self.page - 1] if next_tokens else None
+                child = _PrefixNode(page_ids[i], nt, self._bump())
+                pool.retain([page_ids[i]])
+                node.children[chunk] = child
+            m += self.page
+            i += 1
+            node = child
+        if m < n:
+            rem = tuple(prompt[m:])
+            if rem not in node.partials:
+                nt = next_tokens[n - 1] if next_tokens else None
+                node.partials[rem] = [page_ids[i], nt, self._bump()]
+                pool.retain([page_ids[i]])
+
+    # ---------------------------------------------------------- reclaim --
+    def _droppable(self, pool):
+        """Yield (last_use, kind, node, key) for every entry whose page
+        the pool would actually free (trie holds the only reference)."""
+        out = []
+
+        def walk(node):
+            for toks, rec in node.partials.items():
+                if pool.ref_count(rec[0]) == 1:
+                    out.append((rec[2], "partial", node, toks))
+            for chunk, child in node.children.items():
+                if (not child.children and not child.partials
+                        and pool.ref_count(child.page) == 1):
+                    out.append((child.last_use, "leaf", node, chunk))
+                else:
+                    walk(child)
+
+        walk(self._root)
+        return out
+
+    def reclaimable_count(self, pool):
+        """Pages the trie holds that no request is using (one linear
+        walk). Slightly optimistic: a ref-1 interior node above a
+        pinned descendant counts here but cannot actually be freed
+        until the descendant's user evicts — `alloc` handles that by
+        re-checking after `reclaim`, and once the pool is idle the
+        count is exact (the leak-accounting case)."""
+        count = 0
+
+        def walk(node):
+            nonlocal count
+            for rec in node.partials.values():
+                if pool.ref_count(rec[0]) == 1:
+                    count += 1
+            for child in node.children.values():
+                if pool.ref_count(child.page) == 1:
+                    count += 1
+                walk(child)
+
+        walk(self._root)
+        return count
+
+    def reclaim(self, pool, need):
+        """Drop least-recently-used unpinned leaves until `need` pages
+        were freed (or nothing droppable remains). Returns pages freed."""
+        freed = 0
+        while freed < need:
+            cands = self._droppable(pool)
+            if not cands:
+                break
+            cands.sort(key=lambda c: c[0])
+            take = cands[:max(need - freed, 1)]
+            for _, kind, parent, key in take:
+                if kind == "partial":
+                    rec = parent.partials.pop(key)
+                    pool.release([rec[0]])
+                else:
+                    child = parent.children.pop(key)
+                    pool.release([child.page])
+                freed += 1
+                if freed >= need:
+                    break
+        return freed
+
+    def clear(self, pool):
+        """Release every cached page (used by tests and pool teardown)."""
+
+        def walk(node):
+            for rec in node.partials.values():
+                pool.release([rec[0]])
+            for child in node.children.values():
+                walk(child)
+                pool.release([child.page])
+
+        walk(self._root)
+        self._root = _PrefixNode()
 
 
 class PagedCacheEntry(NamedTuple):

@@ -227,6 +227,95 @@ def build_ragged_meta(block_tables, context_lens, page_size, bucket_to=None):
     }
 
 
+class RaggedMetaBuilder:
+    """Incrementally maintained ragged-grid metadata for the serving
+    decode loop.
+
+    `build_ragged_meta` re-flattens every (slot, page) pair from scratch
+    before each decode step — O(B * pages_per_seq) host work per token.
+    The builder instead gives each slot a FIXED row segment
+    [b*pages_per_seq, (b+1)*pages_per_seq) of the flat arrays, so the
+    per-step delta is O(1): a slot acquires at most one new page per
+    step (when its context length crosses a page boundary), and only
+    admission/eviction rewrite a whole segment.
+
+    Segment layout keeps each sequence's pages contiguous and in
+    ordinal order (the kernel's online-softmax accumulation contract);
+    a segment's padding rows alias the slot's last valid page with
+    valid=0, so the kernel's output window never moves off the row
+    between its final flush and the next slot's first page — identical
+    to build_ragged_meta's end-padding trick, applied per segment. The
+    grid size is the constant B*pages_per_seq, so every decode step
+    reuses one compiled kernel.
+    """
+
+    FIELDS = ("seq", "page", "ordinal", "first", "last", "valid")
+
+    def __init__(self, n_slots, pages_per_seq, page_size, trash_page=0):
+        self.B = int(n_slots)
+        self.pps = int(pages_per_seq)
+        self.page = int(page_size)
+        self.trash = int(trash_page)
+        G = self.B * self.pps
+        self.seq = np.repeat(np.arange(self.B), self.pps).astype(np.int32)
+        self.page_ids = np.full(G, trash_page, np.int32)
+        self.ordinal = np.tile(np.arange(self.pps), self.B).astype(np.int32)
+        self.first = np.zeros(G, np.int32)
+        self.last = np.zeros(G, np.int32)
+        self.valid = np.zeros(G, np.int32)
+        self._n = np.zeros(self.B, np.int64)      # valid pages per slot
+        self._tables = np.full((self.B, self.pps), trash_page, np.int32)
+
+    def _npages(self, post_len):
+        return max(1, -(-int(post_len) // self.page))
+
+    def set_slot(self, b, table_row, post_len):
+        """(Re)build slot b's segment: `table_row` is its block-table
+        row (page ids, trash-padded), `post_len` the POST-write context
+        length the next decode step will attend (ctx + 1)."""
+        n = self._npages(post_len)
+        lo = b * self.pps
+        self._tables[b, :] = table_row[:self.pps]
+        seg = slice(lo, lo + self.pps)
+        self.page_ids[seg] = self._tables[b, min(n, self.pps) - 1]
+        self.page_ids[lo:lo + n] = self._tables[b, :n]
+        self.first[seg] = 0
+        self.last[seg] = 0
+        self.valid[seg] = 0
+        self.first[lo] = 1
+        self.last[lo + n - 1] = 1
+        self.valid[lo:lo + n] = 1
+        self._n[b] = n
+
+    def clear_slot(self, b):
+        """Slot went inactive: one valid entry over the trash page (the
+        decode step still writes the slot's dummy token somewhere)."""
+        row = np.full(self.pps, self.trash, np.int32)
+        self.set_slot(b, row, 1)
+
+    def advance_slot(self, b, post_len):
+        """ctx grew by one: extend the segment only when the new length
+        crosses into a fresh page — O(1) host work per decode step."""
+        n = self._npages(post_len)
+        cur = int(self._n[b])
+        if n == cur:
+            return
+        lo = b * self.pps
+        for j in range(cur, min(n, self.pps)):
+            self.page_ids[lo + j] = self._tables[b, j]
+            self.valid[lo + j] = 1
+        self.last[lo + cur - 1] = 0
+        self.last[lo + n - 1] = 1
+        # re-point the segment's padding alias at the new last page
+        self.page_ids[lo + n:lo + self.pps] = self._tables[b, n - 1]
+        self._n[b] = n
+
+    def meta(self):
+        return {"seq": self.seq, "page": self.page_ids,
+                "ordinal": self.ordinal, "first": self.first,
+                "last": self.last, "valid": self.valid}
+
+
 def _ragged_kernel(seq_ref, page_ref, ord_ref, first_ref, last_ref,
                    valid_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, scale, page_size):

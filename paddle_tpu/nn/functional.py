@@ -1359,21 +1359,31 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     def _area_1d(v, axis, out_len):
         """'area' is adaptive average pooling (torch/paddle): cell o
         averages rows floor(o·in/out) .. ceil((o+1)·in/out); separable
-        per axis. The previous linear-resample fallback produced
-        fractional-weighted averages — r5 fuzz find."""
+        per axis. Windowed segment means (gather the ≤wmax taps of each
+        cell and weight them directly) rather than a full-axis float32
+        cumsum difference: the cumsum grows with the axis so for long
+        axes the subtraction cancels most significant bits and each
+        cell's mean loses precision proportionally to its position —
+        ADVICE r5 #3. Window math keeps every cell's error independent
+        of axis length."""
         s = v.shape[axis]
-        o = np.arange(out_len)
-        starts = np.floor(o * s / out_len).astype(np.int32)
-        ends = np.ceil((o + 1) * s / out_len).astype(np.int32)
-        cs = jnp.cumsum(v.astype(jnp.float32), axis=axis)
-        zero = jnp.zeros_like(jnp.take(cs, jnp.asarray([0]), axis=axis))
-        cs = jnp.concatenate([zero, cs], axis=axis)
-        upper = jnp.take(cs, jnp.asarray(ends), axis=axis)
-        lower = jnp.take(cs, jnp.asarray(starts), axis=axis)
-        shape = [1] * v.ndim
-        shape[axis] = out_len
-        cnt = jnp.asarray((ends - starts).astype(np.float32)).reshape(shape)
-        return ((upper - lower) / cnt).astype(v.dtype)
+        o = np.arange(out_len, dtype=np.int64)
+        starts = o * s // out_len
+        ends = -(-(o + 1) * s // out_len)
+        wmax = int((ends - starts).max())
+        idx = starts[:, None] + np.arange(wmax, dtype=np.int64)[None, :]
+        valid = idx < ends[:, None]
+        idx = np.minimum(idx, s - 1)
+        cnt = (ends - starts).astype(np.float32)
+        w = valid.astype(np.float32) / cnt[:, None]
+        taps = jnp.take(v, jnp.asarray(idx.reshape(-1)), axis=axis)
+        new_shape = v.shape[:axis] + (out_len, wmax) + v.shape[axis + 1:]
+        taps = taps.reshape(new_shape)
+        wshape = [1] * len(new_shape)
+        wshape[axis], wshape[axis + 1] = out_len, wmax
+        return jnp.sum(taps.astype(jnp.float32)
+                       * jnp.asarray(w).reshape(wshape),
+                       axis=axis + 1).astype(v.dtype)
 
     def fn(v):
         shape = list(v.shape)

@@ -124,12 +124,19 @@ def render(last) -> str:
     if adm:
         ttft = _one(last, "serving.ttft_seconds") or {}
         tok = _one(last, "serving.token_latency_seconds") or {}
+        pre = _one(last, "serving.prefill_seconds") or {}
         util = _one(last, "serving.page_utilization") or {}
         q = _one(last, "serving.queue_depth") or {}
+        steps = _one(last, "serving.decode_steps") or {}
         rej = _series(last, "serving.rejected_requests")
+        hits = _series(last, "serving.prefix_cache_hits")
+        miss = _one(last, "serving.prefix_cache_misses") or {}
+        reuse = _one(last, "serving.prefix_cache_pages_reused") or {}
+        hol = _one(last, "serving.hol_skips") or {}
         w("== serving ==")
         w(f"  admissions      {int(adm.get('value', 0))}"
           f"   queue {int(q.get('value', 0))}"
+          f"   decode_steps {int(steps.get('value', 0))}"
           f"   page_util {100.0 * util.get('value', 0):.1f}%")
         if ttft.get("count"):
             w(f"  TTFT            p50 {ttft['p50'] * 1e3:.1f}ms"
@@ -137,6 +144,16 @@ def render(last) -> str:
         if tok.get("count"):
             w(f"  token latency   p50 {tok['p50'] * 1e3:.2f}ms"
               f"   p99 {tok['p99'] * 1e3:.2f}ms")
+        if pre.get("count"):
+            w(f"  admission       mean {pre['value'] * 1e3:.2f}ms"
+              f"   p99 {pre['p99'] * 1e3:.2f}ms   n={pre['count']}")
+        if hits or miss or reuse:
+            n_hits = sum(int(r.get("value", 0)) for r in hits.values())
+            w(f"  prefix cache    hits {n_hits}"
+              f"   misses {int(miss.get('value', 0))}"
+              f"   pages_reused {int(reuse.get('value', 0))}")
+        if hol.get("value"):
+            w(f"  hol_skips       {int(hol['value'])}")
         for labels, rec in sorted(rej.items()):
             w(f"  rejected[{dict(labels).get('reason', '?')}]  "
               f"{int(rec['value'])}")
@@ -147,7 +164,10 @@ def render(last) -> str:
              "mem.bytes_in_use", "mem.peak_bytes_in_use", "comm.bytes",
              "comm.calls", "serving.admissions", "serving.ttft_seconds",
              "serving.token_latency_seconds", "serving.page_utilization",
-             "serving.queue_depth", "serving.rejected_requests"}
+             "serving.queue_depth", "serving.rejected_requests",
+             "serving.prefill_seconds", "serving.decode_steps",
+             "serving.prefix_cache_hits", "serving.prefix_cache_misses",
+             "serving.prefix_cache_pages_reused", "serving.hol_skips"}
     rest = sorted(k for k in last if k[0] not in known)
     if rest:
         w("== other (last value) ==")

@@ -63,16 +63,21 @@ class GradScaler:
         if not self._enable:
             return
         self._sync_from_device()
-        inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameter_list:
-            if p.grad is None:
-                continue
-            g = p.grad._value * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
-                found = True
+        # one fused program over ALL grads + ONE host sync for the
+        # found_inf flag — the old per-param loop dispatched a kernel and
+        # forced a device round-trip per parameter (O(#params) syncs)
+        with_grads = [p for p in optimizer._parameter_list
+                      if p.grad is not None]
+        if not with_grads:
+            self._found_inf = False
+            self._unscaled = True
+            return
+        gs, found = _eager_unscale(
+            [p.grad._value for p in with_grads],
+            jnp.asarray(self._scale, jnp.float32))
+        for p, g in zip(with_grads, gs):
             p.grad._value = g
-        self._found_inf = found
+        self._found_inf = bool(found)  # the single sync
         self._unscaled = True
 
     def step(self, optimizer):
@@ -149,6 +154,19 @@ def scaler_state_in(scaler):
 def scaler_state_out(scaler, st):
     """Store the step's output state WITHOUT a host sync (lazy)."""
     scaler._dev_state = st
+
+
+import functools as _functools
+import jax as _jax
+
+
+@_jax.jit
+def _eager_unscale(grads, scale):
+    """Batched eager unscale: same math as compiled_unscale, one
+    dispatch for the whole grad list. NOT donated: eager grads often
+    wrap numpy-backed buffers (to_tensor), which zero-copy on CPU —
+    donating an aliased buffer corrupts the heap."""
+    return compiled_unscale(scale, grads)
 
 
 def compiled_unscale(scale, grads):

@@ -79,7 +79,8 @@ class DistTrainStep:
     def __init__(self, model, optimizer, loss_fn: Callable,
                  n_model_inputs: int = 1, sharding_stage: Optional[int] = None,
                  mesh: Optional[Mesh] = None, batch_specs=None,
-                 donate_state: bool = True, scaler=None):
+                 donate_state: bool = True, scaler=None,
+                 weight_update_sharding: Optional[bool] = None):
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn
@@ -95,6 +96,14 @@ class DistTrainStep:
         self._stage = int(stage)
         self._batch_specs = batch_specs
         self._donate = donate_state
+        wus = weight_update_sharding
+        if wus is None:
+            wus = bool(getattr(optimizer, "_weight_update_sharding", False))
+        dsize = self._mesh.shape.get("data", 1)
+        # ZeRO-3 already shards the params themselves; ZeRO-1-style
+        # weight-update sharding is meaningful for stage <= 2 with a
+        # real data axis
+        self._wus = bool(wus) and dsize > 1 and self._stage < 3
 
         self._named_p = [(n, p) for n, p in model.named_parameters()
                          if not p.stop_gradient]
@@ -108,23 +117,37 @@ class DistTrainStep:
                       for p in self._p]
         self._b_sh = [NamedSharding(mesh_, PartitionSpec()) for _ in self._b]
 
-        # init + place opt state with its shardings
-        raw_state = optimizer._fn_init_all([p._value for p in self._p],
-                                           self._p_names, self._p)
-        self._s_sh = []
+        self._plan_fused_update()
+        rest = self._rest_idx
+
+        # init + place per-param opt state (the non-fused subset) with
+        # its shardings
+        raw_state = optimizer._fn_init_all(
+            [self._p[i]._value for i in rest],
+            [self._p_names[i] for i in rest], [self._p[i] for i in rest])
+        pp_sh = []
         placed_state = []
-        for p, psh, st in zip(self._p, self._p_sh, raw_state):
+        for j, st in zip(rest, raw_state):
+            p, psh = self._p[j], self._p_sh[j]
             leaf_sh = {k: _opt_state_sharding(psh, v.shape, self._stage,
                                               mesh_, p._value.shape)
                        for k, v in (st.items() if isinstance(st, dict) else [])}
             if isinstance(st, dict):
                 placed_state.append({k: jax.device_put(v, leaf_sh[k])
                                      for k, v in st.items()})
-                self._s_sh.append(leaf_sh)
+                pp_sh.append(leaf_sh)
             else:
                 placed_state.append(st)
-                self._s_sh.append(NamedSharding(mesh_, PartitionSpec()))
-        self._opt_state = placed_state
+                pp_sh.append(NamedSharding(mesh_, PartitionSpec()))
+
+        if self._fused is None:
+            self._opt_state = placed_state
+            self._s_sh = pp_sh
+        else:
+            fz_state, fz_sh = self._init_fused_state()
+            self._opt_state = {"per_param": placed_state, "fused": fz_state}
+            self._s_sh = {"per_param": pp_sh, "fused": fz_sh}
+            self._register_fused_sync()
 
         # place params/buffers
         for p, sh in zip(self._p, self._p_sh):
@@ -133,29 +156,47 @@ class DistTrainStep:
             b._value = jax.device_put(b._value, sh)
 
         self._compiled = {}
+        self._record_opt_state_gauges()
 
         # -- telemetry: analytic per-step accounting of the collectives
         # XLA inserts for the declared shardings (the facade in
         # distributed/collective.py accounts explicit SPMD calls; the
-        # grad psum / ZeRO-3 gathers of this step are compiler-inserted,
-        # so they are accounted here from the param set)
+        # grad psum / ZeRO-3 gathers / weight-update-sharding
+        # scatter+gather of this step are compiler-inserted, so they are
+        # accounted here from the param set)
         self._obs = None
         if _obs_enabled():
             dsize = mesh_.shape.get("data", 1)
             comm = []
             if dsize > 1:
+                fused_ids = set(self._fused["idx"]) if self._fused else set()
+                rest_p = [p for i, p in enumerate(self._p)
+                          if i not in fused_ids]
                 grad_b = sum(int(np.prod(p._value.shape))
-                             * p._value.dtype.itemsize for p in self._p)
+                             * p._value.dtype.itemsize for p in rest_p)
                 if self._stage >= 3:
                     # FSDP: params all-gathered at use (fwd + bwd),
                     # grads reduce-scattered
                     comm.append(("all_gather", "data",
-                                 2 * len(self._p), 2 * grad_b))
+                                 2 * len(rest_p), 2 * grad_b))
                     comm.append(("reduce_scatter", "data",
-                                 len(self._p), grad_b))
-                else:
+                                 len(rest_p), grad_b))
+                elif rest_p:
                     comm.append(("all_reduce", "data",
-                                 len(self._p), grad_b))
+                                 len(rest_p), grad_b))
+                if self._fused is not None:
+                    fz = self._fused
+                    fb = sum(b.padded_size * np.dtype(m["cdtype"]).itemsize
+                             for b, m in zip(fz["bucketer"].buckets,
+                                             fz["meta"]))
+                    nb = len(fz["bucketer"].buckets)
+                    if self._wus:
+                        # ZeRO-1: reduce-scatter grads, all-gather the
+                        # updated flat params — per bucket
+                        comm.append(("reduce_scatter", "data", nb, fb))
+                        comm.append(("all_gather", "data", nb, fb))
+                    else:
+                        comm.append(("all_reduce", "data", nb, fb))
             n_params = sum(int(np.prod(p._value.shape)) for p in self._p)
             dtype = (str(self._p[0]._value.dtype) if self._p
                      else "float32")
@@ -175,6 +216,191 @@ class DistTrainStep:
                 n_params=n_params, dtype=dtype,
                 n_devices=mesh_.devices.size, comm_per_step=comm,
                 flops_fn=flops_fn)
+
+    # ---------------------------------------------- fused weight update --
+    def _plan_fused_update(self):
+        """Decide which params take the fused flat-bucket update inside
+        step_fn (and, with weight_update_sharding, the ZeRO-1 sharded
+        variant: reduce-scatter grads over 'data', update only the local
+        flat shard, all-gather updated params — arXiv:2004.13336).
+
+        Only params with a fully-replicated partition spec fuse (TP/FSDP-
+        sharded params keep the per-param path); the optimizer must be
+        one of the fusible kinds with elementwise-expressible
+        hyperparameters."""
+        from ...framework.flags import flag_value
+        from ...optimizer import fused as _fz
+        self._fused = None
+        self._rest_idx = list(range(len(self._p)))
+        try:
+            flag_on = bool(flag_value("fused_optimizer"))
+        except KeyError:
+            flag_on = False
+        if not (self._wus or (flag_on and self._stage == 0)):
+            return
+        if _fz._kind_of(self._opt) is None:
+            return
+        cand = [i for i, sh in enumerate(self._p_sh)
+                if all(s is None for s in (sh.spec or ()))]
+        if not cand:
+            return
+        params = [self._p[i] for i in cand]
+        coeffs = _fz.bucket_coeffs(self._opt, params,
+                                   [self._p_names[i] for i in cand])
+        if coeffs is None or coeffs["wd_dynamic"]:
+            # Tensor-valued AdamW wd would bake a stale constant into
+            # the compiled step; keep the per-param path for that case
+            return
+        if not _fz.steps_consistent(self._opt, params):
+            # per-param step counters disagree (partial restore): one
+            # bucket scalar cannot represent them
+            return
+        from ...distributed.collective import bucketer_for
+        dsize = self._mesh.shape.get("data", 1)
+        bucketer = bucketer_for(
+            [tuple(p._value.shape) for p in params],
+            [np.dtype(p._value.dtype) for p in params],
+            pad_multiple=dsize if self._wus else 1)
+        try:
+            # int8 grad comm only makes sense where the comm pattern is
+            # restructured (wus); applying it to a plain fused stage-0
+            # update would add quantization noise for zero benefit
+            quant = bool(flag_value("quantized_grad_comm")) and self._wus
+        except KeyError:
+            quant = False
+        meta = []
+        for b in bucketer.buckets:
+            mp = self._opt._mp_active(params[b.idx[0]]._value)
+            cdtype = jnp.float32 if mp else params[b.idx[0]]._value.dtype
+            meta.append({
+                "mp": mp, "cdtype": cdtype,
+                "dtype": params[b.idx[0]]._value.dtype,
+                "coeffs": _fz.dist_bucket_coeffs(
+                    coeffs, b.idx, b.sizes, b.padded_size, cdtype),
+            })
+        self._fused = {"kind": coeffs["kind"], "idx": cand,
+                       "bucketer": bucketer, "meta": meta,
+                       "quant": quant,
+                       "wd_dynamic": coeffs["wd_dynamic"]}
+        fused_set = set(cand)
+        self._rest_idx = [i for i in range(len(self._p))
+                          if i not in fused_set]
+
+    def _init_fused_state(self):
+        """Flat per-bucket optimizer state + shardings. With
+        weight_update_sharding the 1-D buffers shard over 'data' — each
+        replica holds 1/dsize of the moments (and f32 master weights),
+        which is where the ZeRO-1 memory saving comes from."""
+        from ...optimizer import fused as _fz
+        fz = self._fused
+        mesh_ = self._mesh
+        params = [self._p[i] for i in fz["idx"]]
+        vec_sh = NamedSharding(mesh_, PartitionSpec("data")) if self._wus \
+            else NamedSharding(mesh_, PartitionSpec())
+        repl = NamedSharding(mesh_, PartitionSpec())
+        states, shardings = [], []
+        for b, m in zip(fz["bucketer"].buckets, fz["meta"]):
+            st = _fz.init_dist_flat_state(
+                self._opt, params, b, fz["kind"], m["mp"], m["cdtype"],
+                quantized=fz["quant"])
+            sh = {k: (repl if getattr(v, "ndim", 0) == 0 else vec_sh)
+                  for k, v in st.items()}
+            states.append({k: jax.device_put(v, sh[k])
+                           for k, v in st.items()})
+            shardings.append(sh)
+        return states, shardings
+
+    def _register_fused_sync(self):
+        """state_dict/checkpoint interop: unflatten the fused flat state
+        into the optimizer's per-param accumulators on demand (the same
+        _deferred_sync protocol the pipeline engine and the eager fused
+        path use)."""
+        opt = self._opt
+        step_ref = self
+
+        def _sync():
+            fz = step_ref._fused
+            if fz is None:
+                return
+            params = [step_ref._p[i] for i in fz["idx"]]
+            fused_states = step_ref._opt_state["fused"]
+            store_root = opt.__dict__.get("_accums")
+            if store_root is None:
+                store_root = opt._accumulators
+            for b, st in zip(fz["bucketer"].buckets, fused_states):
+                for name, flat in st.items():
+                    if name == "ef_residual":
+                        continue
+                    store = store_root.setdefault(name, {})
+                    if getattr(flat, "ndim", 0) == 0:
+                        for i in b.idx:
+                            # copy per param: per-param kernels donate
+                            # their step operand
+                            store[id(params[i])] = jnp.array(flat)
+                        continue
+                    for k, i in enumerate(b.idx):
+                        off = int(b.offsets[k])
+                        store[id(params[i])] = flat[
+                            off:off + b.sizes[k]].reshape(b.shapes[k])
+
+        def _invalidate():
+            # set_state_dict loaded fresh accumulator values: reseed the
+            # fused flat buffers from them, otherwise the next _sync
+            # would clobber the restore with pre-restore flat state
+            # (same protocol as the pipeline engine / eager FusedPlan)
+            if step_ref._fused is None or \
+                    not isinstance(step_ref._opt_state, dict):
+                return
+            states, _ = step_ref._init_fused_state()
+            step_ref._opt_state["fused"] = states
+        opt._deferred_sync = _sync
+        opt._deferred_invalidate = _invalidate
+
+    def _record_opt_state_gauges(self):
+        """mem.opt_state_bytes{scope=global|per_replica}: analytic
+        optimizer-state footprint. per_replica divides 'data'-sharded
+        flat buffers by the axis size — the acceptance signal for
+        weight-update sharding."""
+        if not _obs_enabled():
+            return
+        from ...observability import metrics as _m
+        dsize = self._mesh.shape.get("data", 1)
+
+        def leaf_bytes(leaf, sharded):
+            n = int(np.prod(getattr(leaf, "shape", ()) or (1,)))
+            nb = n * np.dtype(leaf.dtype).itemsize
+            return nb, nb // dsize if sharded else nb
+
+        total = per_replica = 0
+        if isinstance(self._opt_state, dict):
+            pp, fused = self._opt_state["per_param"], \
+                self._opt_state["fused"]
+        else:
+            pp, fused = self._opt_state, []
+        for st in pp:
+            for k, v in (st.items() if isinstance(st, dict) else []):
+                nb = int(np.prod(v.shape or (1,))) * np.dtype(
+                    v.dtype).itemsize
+                total += nb
+                # per-param leaves count sharded when _opt_state_sharding
+                # placed them over 'data' (ZeRO stages)
+                try:
+                    sharded = "data" in str(getattr(v.sharding, "spec", ""))
+                except Exception:
+                    sharded = False
+                per_replica += nb // (dsize if sharded else 1)
+        for st in fused:
+            for k, v in st.items():
+                nb = int(np.prod(v.shape or (1,))) * np.dtype(
+                    v.dtype).itemsize
+                total += nb
+                per_replica += nb // (dsize if (self._wus and v.ndim) else 1)
+        g = _m.gauge("mem.opt_state_bytes", unit="bytes",
+                     help="optimizer state footprint")
+        g.set(total, scope="global")
+        g.set(per_replica, scope="per_replica")
+        self._opt_state_bytes = {"global": total,
+                                 "per_replica": per_replica}
 
     def _last_cost_analysis(self):
         batch = getattr(self, "_obs_last_batch", None)
@@ -208,6 +434,85 @@ class DistTrainStep:
 
         scaler = self._scaler
         obs = self._obs if _obs_enabled() else None
+        fz = self._fused
+        rest = self._rest_idx
+        wus = self._wus
+
+        def apply_update(p_vals, grads, opt_state, lr):
+            """Optimizer update: per-param path for the rest subset,
+            fused flat buckets (optionally 'data'-sharded, ZeRO-1) for
+            the fused subset. Returns (new_p list, new opt_state)."""
+            if fz is None:
+                return opt._fn_apply_all(list(p_vals), grads, opt_state,
+                                         lr, p_names, p_tensors)
+            from ...optimizer.fused import fused_bucket_update
+            from ...distributed.collective import fake_quantized_grad
+            new_p = list(p_vals)
+            rp, rs = opt._fn_apply_all(
+                [p_vals[i] for i in rest], [grads[i] for i in rest],
+                opt_state["per_param"], lr,
+                [p_names[i] for i in rest], [p_tensors[i] for i in rest])
+            for j, i in enumerate(rest):
+                new_p[i] = rp[j]
+            params_idx = fz["idx"]
+            new_fused = []
+            for b, m, st in zip(fz["bucketer"].buckets, fz["meta"],
+                                opt_state["fused"]):
+                cd = m["cdtype"]
+                parts = [jnp.ravel(grads[params_idx[i]]).astype(cd)
+                         for i in b.idx]
+                flat_g = jnp.concatenate(parts) if len(parts) > 1 \
+                    else parts[0]
+                if b.padded_size != b.size:
+                    flat_g = jnp.pad(flat_g, (0, b.padded_size - b.size))
+                # NOTE (wus): no explicit sharding constraint on flat_g /
+                # flat_p. The 'data'-sharded in/out shardings of the flat
+                # optimizer state drive GSPMD to shard the whole update
+                # chain (the arXiv:2004.13336 "automatic" formulation) —
+                # the gradient reduction feeding it lowers as
+                # reduce-scatter (or all-reduce + local slice on backends
+                # without the reduce-scatter-creation pass, e.g. CPU).
+                # Constraining the raw unreduced gradient directly was
+                # observed to corrupt partial-sum accounting on
+                # multi-axis meshes (model-axis grads double-reduced).
+                st2 = dict(st)
+                if fz["quant"]:
+                    # error-feedback quantize-dequantize of the reduced
+                    # gradient (convergence model of the int8 collective;
+                    # the wire-level path is collective.quantized_*)
+                    flat_g, st2["ef_residual"] = fake_quantized_grad(
+                        flat_g, st["ef_residual"])
+                if m["mp"]:
+                    flat_p = st["master_weight"]
+                else:
+                    pparts = [jnp.ravel(p_vals[params_idx[i]]).astype(cd)
+                              for i in b.idx]
+                    flat_p = jnp.concatenate(pparts) if len(pparts) > 1 \
+                        else pparts[0]
+                    if b.padded_size != b.size:
+                        flat_p = jnp.pad(flat_p,
+                                         (0, b.padded_size - b.size))
+                coeffs = dict(m["coeffs"])
+                inner = {k: v for k, v in st2.items()
+                         if k not in ("master_weight", "ef_residual")}
+                p2, st_out = fused_bucket_update(
+                    fz["kind"], flat_p, flat_g, inner, lr.astype(cd),
+                    coeffs, opt)
+                if m["mp"]:
+                    st_out["master_weight"] = p2
+                if fz["quant"]:
+                    st_out["ef_residual"] = st2["ef_residual"]
+                new_fused.append(st_out)
+                if wus:
+                    # updated flat params live sharded; gathering them
+                    # back to replicated is the ZeRO-1 all-gather
+                    p2 = jax.lax.with_sharding_constraint(p2, repl)
+                for k, i in enumerate(b.idx):
+                    off = int(b.offsets[k])
+                    seg = jax.lax.slice_in_dim(p2, off, off + b.sizes[k])
+                    new_p[params_idx[i]] = seg.reshape(
+                        b.shapes[k]).astype(m["dtype"])
+            return new_p, {"per_param": rs, "fused": new_fused}
 
         def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch,
                     scaler_st):
@@ -239,8 +544,8 @@ class DistTrainStep:
             if obs is not None:
                 obs.grad_norm_callback(grads)  # async host record, no sync
             grads = _clip_grads_functional(grads, grad_clip)
-            new_p, new_state = opt._fn_apply_all(
-                list(p_vals), grads, opt_state, lr, p_names, p_tensors)
+            new_p, new_state = apply_update(list(p_vals), grads, opt_state,
+                                            lr)
             if scaler is not None:
                 new_p, new_state, scaler_st = compiled_select_and_adapt(
                     scaler, found_inf, new_p, list(p_vals), new_state,
@@ -289,7 +594,7 @@ class DistTrainStep:
             lowered = self._compiled[sig]._jitted.lower(
                 [p._value for p in self._p], [b._value for b in self._b],
                 self._opt_state, jax.random.key(0),
-                jnp.asarray(self._opt.get_lr(), jnp.float32), arrays,
+                self._opt._lr_operand(), arrays,
                 sc_in)
         ca = lowered.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -313,7 +618,7 @@ class DistTrainStep:
                 obs.reset_flops(self._obs_flops_fn)  # new shape, new MFU
         gen = default_generator()
         key_in = gen.split()
-        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        lr = self._opt._lr_operand()
         from ...amp.grad_scaler import scaler_state_in, scaler_state_out
         sc = self._scaler
         sc_in = scaler_state_in(sc) if sc is not None else ()
@@ -327,7 +632,15 @@ class DistTrainStep:
         for t, v in zip(self._b, new_b):
             t._value = v
         self._opt_state = new_state
-        self._opt._fn_sync_to_accumulators(self._p, new_state)
+        if isinstance(new_state, dict):
+            # per-param subset syncs eagerly (no device work — the state
+            # leaves are handed over as-is); the fused flat buffers sync
+            # lazily via the optimizer's _deferred_sync
+            self._opt._fn_sync_to_accumulators(
+                [self._p[i] for i in self._rest_idx],
+                new_state["per_param"])
+        else:
+            self._opt._fn_sync_to_accumulators(self._p, new_state)
         if obs is not None:
             obs.step_end(batch_tokens(arrays))  # runs the MFU probe once
             self._obs_last_batch = None

@@ -821,7 +821,7 @@ class PipelineTrainStep:
         arrays, sig = self._ensure_compiled(batch)
         gen = default_generator()
         key_in = gen.split()
-        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        lr = self._opt._lr_operand()
         from ....amp.grad_scaler import scaler_state_in, scaler_state_out
         sc = self._scaler
         sc_in = scaler_state_in(sc) if sc is not None else ()

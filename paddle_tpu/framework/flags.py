@@ -148,6 +148,25 @@ define_flag("obs_xla_mfu", False,
             "Telemetry MFU numerator from XLA's cost model (one extra "
             "lowering per batch signature) instead of the 6*N analytic "
             "estimate.")
+define_flag("fused_optimizer", True,
+            "Fused multi-tensor optimizer path: eager Optimizer.step() "
+            "flattens (param, grad, accumulator) leaves into dtype-"
+            "bucketed flat buffers and updates them in ONE jitted, "
+            "donated program (O(#dtype buckets) dispatches instead of "
+            "O(#params)). Per-param math is the fallback for non-fusible "
+            "configs (custom regularizer callables, Lamb, ...).")
+define_flag("quantized_grad_comm", False,
+            "int8 gradient collectives with per-bucket scales and an "
+            "error-feedback residual (EQuARX-style, arXiv:2506.17615). "
+            "Applies to collective.quantized_* and, when "
+            "weight_update_sharding is on, to DistTrainStep's gradient "
+            "reduction. ~4x comm-byte reduction; adds quantization "
+            "noise bounded by the error-feedback loop.")
+define_flag("grad_bucket_bytes", 32 * 1024 * 1024,
+            "Target flat-bucket payload size for gradient collectives "
+            "(collective.GradBucketer). Smaller buckets let XLA overlap "
+            "communication with the optimizer update; larger buckets "
+            "amortize per-collective latency.")
 define_flag("check_distribution_args", False,
             "Validate distribution constructor arguments (e.g. negative "
             "Categorical weights) with a warning. Costs a host sync on "

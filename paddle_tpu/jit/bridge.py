@@ -206,7 +206,7 @@ class TrainStep:
         arrays, sig = self._ensure_compiled(batch)
         gen = default_generator()
         key_in = gen.split()
-        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        lr = self._opt._lr_operand()
         from ..amp.grad_scaler import scaler_state_in, scaler_state_out
         sc_in = (scaler_state_in(self._scaler)
                  if self._scaler is not None else ())

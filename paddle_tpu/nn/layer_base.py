@@ -134,6 +134,14 @@ class Layer:
         init = attr.initializer or g or default_initializer or \
             (Constant(0.0) if is_bias else XavierUniform())
         value = _resolve_initializer(init)(shape, d)
+        if isinstance(value, np.ndarray):
+            # host-init (numpy) samples: force an XLA-OWNED device copy.
+            # jnp.asarray(np) zero-copy-aliases ~half the time on the CPU
+            # backend (alignment-dependent), and compiled train steps /
+            # fused optimizers DONATE param buffers — donating an aliased
+            # buffer frees numpy-allocated memory through XLA's
+            # deallocator (heap corruption; segfaulted the CPU bench).
+            value = jnp.array(jnp.asarray(value), copy=True)
         p = Parameter(value, trainable=attr.trainable, name=attr.name)
         if not attr.trainable:
             p.stop_gradient = True

@@ -1,0 +1,593 @@
+"""Fused multi-tensor optimizer fast path.
+
+Eager ``Optimizer.step()`` used to dispatch one jitted update kernel per
+parameter — hundreds of tiny host-driven dispatches per step on a real
+model. This module flattens every (param, grad, accumulator) leaf into
+dtype-bucketed flat buffers and applies the whole update as ONE jitted,
+donated program per step: O(#dtype buckets) of fused math inside a
+single dispatch, instead of O(#params) dispatches.
+
+Design (the multi-tensor-apply idea of the fused_adam/NVIDIA apex
+kernels, expressed the XLA way — concat/slice inside one program so the
+compiler fuses the bookkeeping away):
+
+- Buckets group trainable params by (dtype, multi_precision) so the
+  update math runs once per bucket on a 1-D flat buffer.
+- Accumulator state (velocity / moment1 / moment2 / master_weight) is
+  kept FLAT between steps and donated back into the program — no
+  per-param state objects are touched on the hot path.
+- Per-param hyperparameters (weight decay, per-param regularizers,
+  AdamW's apply_decay_param_fun / lr_ratio) become flat coefficient
+  vectors built host-side once per layout; uniform values collapse to
+  scalars.
+- The SAME math functions as the per-param kernels (_sgd_math,
+  _momentum_math, _adam_math) run on the flat buffers, so fused and
+  per-param paths are numerically identical (asserted by
+  tests/test_train_fastpath.py).
+- lr enters the program as a scalar OPERAND (jnp.float32), never a
+  python-float trace constant — an LRScheduler stepping every iteration
+  does not retrigger compilation (satellite: optimizer/lr.py contract).
+
+Checkpoint interop: the flat state registers ``_deferred_sync`` /
+``_deferred_invalidate`` on the optimizer (the same protocol the
+pipeline engine uses), so ``state_dict()`` sees per-param accumulators
+and ``set_state_dict()`` reseeds the flat buffers.
+
+The functional twin (`dist_fused_apply` building blocks) is consumed by
+``DistTrainStep`` for the ZeRO-1-style sharded weight update
+(arXiv:2004.13336): the same flat buckets, reduce-scattered over the
+data axis.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.flags import flag_value
+from ..observability import metrics as _obsm
+
+__all__ = ["try_fused_step", "fused_plan", "FusedPlan", "bucket_coeffs",
+           "fused_bucket_update"]
+
+
+_opt_dispatches = None
+
+
+def _count_dispatch(n: int, path: str):
+    """train.opt_dispatches counter: one unit per program dispatched to
+    the device by an eager optimizer step."""
+    global _opt_dispatches
+    if not _obsm.enabled():
+        return
+    if _opt_dispatches is None:
+        _opt_dispatches = _obsm.counter(
+            "train.opt_dispatches",
+            help="eager optimizer update programs dispatched")
+    _opt_dispatches.inc(n, path=path)
+
+
+# ---------------------------------------------------------------------------
+# Eligibility + per-param coefficients
+# ---------------------------------------------------------------------------
+
+def _kind_of(opt) -> Optional[str]:
+    # exact types: subclasses may override _update with math the fused
+    # kernels don't model (AdamW is special-cased; Lamb's trust ratio
+    # needs per-param norms, which don't fuse bucket-wise)
+    from .optimizer import SGD, Momentum, Adam, AdamW
+    t = type(opt)
+    if t is SGD:
+        return "sgd"
+    if t is Momentum:
+        return "momentum"
+    if t is Adam:
+        return "adam"
+    if t is AdamW:
+        return "adamw"
+    return None
+
+
+def _classify_reg(reg) -> Optional[Tuple[float, float]]:
+    """(l2_coeff, l1_coeff) for a regularizer spec, or None if it cannot
+    be expressed as elementwise coefficients (custom callables, tensor
+    coefficients)."""
+    from ..regularizer import L1Decay, L2Decay
+    if reg is None:
+        return (0.0, 0.0)
+    if isinstance(reg, L2Decay):
+        return (float(reg.coeff), 0.0)
+    if isinstance(reg, L1Decay):
+        return (0.0, float(reg.coeff))
+    if isinstance(reg, (int, float)):
+        return (float(reg), 0.0)
+    return None
+
+
+def bucket_coeffs(opt, params, names) -> Optional[dict]:
+    """Host-side per-param coefficient table for a fusible optimizer, or
+    None when any param needs the per-param fallback.
+
+    Keys: kind, l2[i], l1[i] (grad-coupled penalties), wd[i] (AdamW
+    decoupled decay mask * coeff; dynamic Tensor coeff returns wd=None
+    and wd_dynamic=True so the scalar rides in as an operand),
+    lr_scale[i] (AdamW lr_ratio)."""
+    kind = _kind_of(opt)
+    if kind is None:
+        return None
+    n = len(params)
+    l2 = np.zeros(n, np.float64)
+    l1 = np.zeros(n, np.float64)
+    wd = np.zeros(n, np.float64)
+    lr_scale = np.ones(n, np.float64)
+    wd_dynamic = False
+    for i, p in enumerate(params):
+        preg = getattr(p, "regularizer", None)
+        if kind == "adamw":
+            # per-param regularizer folds into the grad; decoupled decay
+            # applies independently (AdamW._update rule)
+            if preg is not None:
+                c = _classify_reg(preg)
+                if c is None:
+                    return None
+                l2[i], l1[i] = c
+            coeff = opt._wd
+            if not isinstance(coeff, (int, float)):
+                wd_dynamic = True
+                coeff = 1.0  # mask only; scalar operand carries the value
+            fn = opt._apply_decay_param_fun
+            if fn is not None and not fn(getattr(p, "name", "") or ""):
+                coeff = 0.0
+            wd[i] = float(coeff)
+            if opt._lr_ratio is not None:
+                try:
+                    lr_scale[i] = float(opt._lr_ratio(p))
+                except Exception:
+                    return None
+        else:
+            reg = preg if preg is not None else opt._regularization_coeff
+            c = _classify_reg(reg)
+            if c is None:
+                return None
+            l2[i], l1[i] = c
+    return {"kind": kind, "l2": l2, "l1": l1, "wd": wd,
+            "lr_scale": lr_scale, "wd_dynamic": wd_dynamic}
+
+
+# ---------------------------------------------------------------------------
+# Flat-buffer math (shared by the eager fused step and DistTrainStep)
+# ---------------------------------------------------------------------------
+
+def _segment_vec(values, sizes, total, dtype, fill=0.0):
+    """Per-param scalars broadcast over their flat segments; collapses
+    to a python scalar when uniform (no operand, no broadcast). `fill`
+    covers the tail when total exceeds sum(sizes) (padded buckets)."""
+    vals = np.asarray(values, np.float64)
+    if vals.size == 0 or (np.all(vals == vals[0])
+                          and (total == int(np.sum(sizes))
+                               or vals[0] == fill)):
+        return float(vals[0]) if vals.size else fill
+    out = np.full(total, fill, np.float64)
+    off = 0
+    for v, s in zip(vals, sizes):
+        out[off:off + s] = v
+        off += s
+    return jnp.asarray(out.astype(np.dtype(dtype)))
+
+
+def fused_bucket_update(kind, flat_p, flat_g, state, lr, coeffs, opt):
+    """One bucket's fused update on flat 1-D buffers.
+
+    flat_p/flat_g are in the COMPUTE dtype (f32 for multi-precision
+    buckets, else the param dtype). `coeffs` carries the segment
+    coefficient vectors (or scalars) for this bucket plus the dynamic
+    AdamW wd scalar when present. Reuses the per-param math functions so
+    parity holds bitwise-modulo-fusion. Returns (new_flat_p, new_state).
+    """
+    from .optimizer import _adam_math, _momentum_math, _sgd_math
+    l2, l1 = coeffs["l2"], coeffs["l1"]
+    if not (isinstance(l2, float) and l2 == 0.0):
+        flat_g = flat_g + (l2 * flat_p).astype(flat_g.dtype)
+    if not (isinstance(l1, float) and l1 == 0.0):
+        flat_g = flat_g + (l1 * jnp.sign(flat_p)).astype(flat_g.dtype)
+    lr_eff = lr * coeffs["lr_scale"]
+    if kind == "sgd":
+        return _sgd_math(flat_p, flat_g, lr_eff), {}
+    if kind == "momentum":
+        p2, v2 = _momentum_math(flat_p, flat_g, state["velocity"], lr_eff,
+                                opt._momentum, opt._use_nesterov)
+        return p2, {"velocity": v2}
+    # adam / adamw share _adam_math; wd is the decoupled coefficient
+    wd = coeffs["wd"] if kind == "adamw" else 0.0
+    dyn = coeffs.get("wd_scalar")
+    if dyn is not None:
+        wd = wd * dyn
+    p2, m2, v2, t2 = _adam_math(
+        flat_p, flat_g, state["moment1"], state["moment2"], state["step"],
+        lr_eff, opt.beta1, opt.beta2, opt.epsilon, wd)
+    return p2, {"moment1": m2, "moment2": v2, "step": t2}
+
+
+def _state_names(kind) -> Tuple[str, ...]:
+    if kind == "sgd":
+        return ()
+    if kind == "momentum":
+        return ("velocity",)
+    return ("moment1", "moment2", "step")
+
+
+def _init_bucket_state(kind, size, dtype):
+    st = {}
+    for name in _state_names(kind):
+        if name == "step":
+            st[name] = jnp.zeros((), jnp.int32)
+        else:
+            st[name] = jnp.zeros((size,), dtype)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Eager fused step
+# ---------------------------------------------------------------------------
+
+class _Bucket:
+    __slots__ = ("key", "idx", "shapes", "sizes", "offsets", "total",
+                 "mp", "dtype", "cdtype", "coeffs")
+
+    def __init__(self, key, idx, shapes, sizes, mp, dtype, cdtype):
+        self.key = key
+        self.idx = idx
+        self.shapes = shapes
+        self.sizes = sizes
+        self.offsets = np.concatenate(
+            [[0], np.cumsum(sizes)]).astype(np.int64)
+        self.total = int(self.offsets[-1])
+        self.mp = mp
+        self.dtype = dtype
+        self.cdtype = cdtype
+        self.coeffs = None
+
+
+class FusedPlan:
+    """Signature-cached fused step for one optimizer instance."""
+
+    SMALL_LEAF_ELEMS = 1 << 14  # flatten-vs-singleton bucket cutoff
+
+    def __init__(self, opt, params, sig):
+        self.opt = opt
+        self.sig = sig
+        self.kind = _kind_of(opt)
+        self.n_params = len(params)
+        coeffs = bucket_coeffs(opt, params,
+                               [getattr(p, "name", None) for p in params])
+        assert coeffs is not None
+        self.wd_dynamic = coeffs["wd_dynamic"]
+        # ---- dtype buckets. Small leaves (biases, norms — the long
+        # tail where per-param dispatch overhead lives) flatten into one
+        # buffer per dtype; large leaves become singleton buckets whose
+        # "flat" view is a free reshape — concatenating megabyte matmul
+        # weights would spend more on copies than the fused dispatch
+        # saves (measured 2x WORSE on CPU). Either way the whole update
+        # is ONE jitted program.
+        groups: Dict[tuple, list] = {}
+        for i, p in enumerate(params):
+            a = p._value
+            mp = opt._mp_active(a)
+            if int(np.prod(a.shape) or 1) > self.SMALL_LEAF_ELEMS:
+                groups[("large", i)] = [i]
+            else:
+                groups.setdefault((str(a.dtype), mp), []).append(i)
+        self.buckets: List[_Bucket] = []
+        for key, idx in sorted(groups.items(), key=str):
+            if key[0] == "large":
+                key = (str(params[idx[0]]._value.dtype),
+                       opt._mp_active(params[idx[0]]._value))
+            dtype = params[idx[0]]._value.dtype
+            cdtype = jnp.float32 if key[1] else dtype
+            b = _Bucket(key, idx,
+                        [tuple(params[i]._value.shape) for i in idx],
+                        [int(np.prod(params[i]._value.shape) or 1)
+                         for i in idx],
+                        key[1], dtype, cdtype)
+            b.coeffs = {
+                "l2": _segment_vec(coeffs["l2"][idx], b.sizes, b.total,
+                                   cdtype),
+                "l1": _segment_vec(coeffs["l1"][idx], b.sizes, b.total,
+                                   cdtype),
+                "wd": _segment_vec(coeffs["wd"][idx], b.sizes, b.total,
+                                   cdtype),
+                "lr_scale": _segment_vec(coeffs["lr_scale"][idx], b.sizes,
+                                         b.total, cdtype),
+            }
+            self.buckets.append(b)
+        self.state = self._init_state(params)
+        # Re-own every param buffer before the first donated call:
+        # jnp.asarray(numpy) on the CPU backend zero-copies ~half the
+        # time (alignment-dependent), and DONATING an aliased buffer
+        # frees numpy-allocated memory through XLA's deallocator — heap
+        # corruption (host_init params, to_tensor(np) set_value's...).
+        # One copy per plan build; every later call donates program
+        # outputs, which XLA owns.
+        for p in params:
+            p._value = jnp.array(p._value, copy=True)
+        # donating p_vals is only safe when every bucket consumes them
+        # (mp buckets read the master instead — donating the unused lp
+        # value would just warn)
+        donate = (2,) if any(b.mp for b in self.buckets) else (0, 2)
+        self.jitted = jax.jit(self._apply, donate_argnums=donate)
+        self.n_calls = 0
+        self.n_traces = 0  # lr must ride as an operand: this must stay 1
+        self.params_ref = list(params)
+        self.dirty = False
+
+    # -- state ----------------------------------------------------------
+    def _init_state(self, params):
+        """Flat per-bucket state, seeded from eager accumulators when
+        they exist (a loaded checkpoint / earlier per-param steps)."""
+        opt = self.opt
+        state = []
+        for b in self.buckets:
+            st = _init_bucket_state(self.kind, b.total, b.cdtype)
+            if b.mp:
+                masters = []
+                for i in b.idx:
+                    p = params[i]
+                    mw = opt._accumulators.get("master_weight", {}).get(id(p))
+                    masters.append((mw if mw is not None
+                                    else p._value.astype(jnp.float32))
+                                   .ravel().astype(jnp.float32))
+                st["master_weight"] = jnp.concatenate(masters) if masters \
+                    else jnp.zeros((0,), jnp.float32)
+            for name in _state_names(self.kind):
+                store = opt._accumulators.get(name, {})
+                have = [store.get(id(params[i])) for i in b.idx]
+                if not any(v is not None for v in have):
+                    continue
+                if name == "step":
+                    # per-param counters must agree to share the bucket
+                    # scalar; read once at build time (host sync is fine
+                    # off the hot path)
+                    ts = {int(v) for v in have if v is not None}
+                    if len(ts) == 1:
+                        st["step"] = jnp.asarray(ts.pop(), jnp.int32)
+                    continue
+                parts = []
+                for v, i in zip(have, b.idx):
+                    parts.append((v.ravel().astype(b.cdtype)
+                                  if v is not None
+                                  else jnp.zeros((int(np.prod(
+                                      params[i]._value.shape) or 1),),
+                                      b.cdtype)))
+                st[name] = jnp.concatenate(parts)
+            state.append(st)
+        return state
+
+    # -- the one program ------------------------------------------------
+    def _apply(self, p_vals, g_vals, state, lr, wd_scalar):
+        from ..jit.bridge import _clip_grads_functional
+        self.n_traces += 1  # python side effect: runs at TRACE time only
+        g_vals = _clip_grads_functional(list(g_vals), self.opt._grad_clip)
+        new_p = list(p_vals)
+        new_state = []
+        for b, st in zip(self.buckets, state):
+            cd = b.cdtype
+            single = len(b.idx) == 1  # reshape-only, no concat/slice
+            g_parts = [g_vals[i].ravel().astype(cd) for i in b.idx]
+            flat_g = g_parts[0] if single else jnp.concatenate(g_parts)
+            if b.mp:
+                flat_p = st["master_weight"]
+            else:
+                p_parts = [p_vals[i].ravel().astype(cd) for i in b.idx]
+                flat_p = p_parts[0] if single else jnp.concatenate(p_parts)
+            coeffs = dict(b.coeffs)
+            if wd_scalar is not None:
+                coeffs["wd_scalar"] = wd_scalar.astype(cd)
+            lr_b = lr.astype(cd)
+            p2, st2 = fused_bucket_update(self.kind, flat_p, flat_g, st,
+                                          lr_b, coeffs, self.opt)
+            if b.mp:
+                st2["master_weight"] = p2
+            new_state.append(st2)
+            if single:
+                new_p[b.idx[0]] = p2.reshape(b.shapes[0]).astype(b.dtype)
+            else:
+                for k, i in enumerate(b.idx):
+                    off = int(b.offsets[k])
+                    seg = jax.lax.slice_in_dim(p2, off, off + b.sizes[k])
+                    new_p[i] = seg.reshape(b.shapes[k]).astype(b.dtype)
+        return new_p, new_state
+
+    def run(self, params, grads, lr, wd_scalar):
+        p_vals = [p._value for p in params]
+        new_p, self.state = self.jitted(p_vals, grads, self.state, lr,
+                                        wd_scalar)
+        self.n_calls += 1
+        self.dirty = True
+        for p, v in zip(params, new_p):
+            p._value = v
+
+    # -- checkpoint interop ---------------------------------------------
+    def sync_to_accumulators(self):
+        """Unflatten the flat state into the per-param accumulator dicts
+        (lazy: state_dict/checkpoint time or a direct accumulator read —
+        NOT on the hot path). Writes the raw store to stay reentrancy-
+        safe under the Optimizer._accumulators lazy-sync property."""
+        opt = self.opt
+        params = self.params_ref
+        store_root = opt.__dict__.get("_accums", opt._accumulators)
+        for b, st in zip(self.buckets, self.state):
+            for name, flat in st.items():
+                store = store_root.setdefault(name, {})
+                if name == "step":
+                    for i in b.idx:
+                        # one COPY per param: the per-param kernels
+                        # donate their step operand, so a shared array
+                        # would be donated once and then dead
+                        store[id(params[i])] = jnp.array(flat)
+                    continue
+                for k, i in enumerate(b.idx):
+                    off = int(b.offsets[k])
+                    seg = flat[off:off + b.sizes[k]].reshape(b.shapes[k])
+                    store[id(params[i])] = seg
+
+
+def _plan_signature(opt, params):
+    clip = opt._grad_clip
+    return (id(type(opt)),
+            (type(clip).__name__, getattr(clip, "clip_norm", None),
+             getattr(clip, "max", None), getattr(clip, "min", None)),
+            tuple((id(p), tuple(p._value.shape), str(p._value.dtype),
+                   str(p.grad._value.dtype)) for p in params))
+
+
+def fused_plan(opt, params) -> Optional[FusedPlan]:
+    """Get-or-build the cached FusedPlan for the optimizer's current
+    (param, grad) signature; None when the config is not fusible."""
+    if _kind_of(opt) is None:
+        return None
+    # cache check FIRST: the eligibility walk below builds numpy
+    # coefficient tables and must not run on the per-step hot path
+    sig = _plan_signature(opt, params)
+    plan = getattr(opt, "_fused_plan", None)
+    if plan is not None and plan.sig == sig:
+        return plan
+    from ..nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                           ClipGradByValue)
+    clip = opt._grad_clip
+    if clip is not None and not isinstance(
+            clip, (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)):
+        return None
+    if clip is not None and not all(getattr(p, "need_clip", True)
+                                    for p in params):
+        return None  # per-param need_clip opt-out: eager fallback
+    if bucket_coeffs(opt, params,
+                     [getattr(p, "name", None) for p in params]) is None:
+        return None
+    if not steps_consistent(opt, params):
+        return None
+    plan = FusedPlan(opt, params, sig)
+    opt._fused_plan = plan
+
+    def _sync():
+        p = getattr(opt, "_fused_plan", None)
+        if p is not None and p.dirty:
+            p.dirty = False
+            p.sync_to_accumulators()
+
+    def _invalidate():
+        # set_state_dict loaded fresh accumulators: rebuild the flat
+        # buffers from them on the next step
+        opt._fused_plan = None
+    opt._deferred_sync = _sync
+    opt._deferred_invalidate = _invalidate
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# DistTrainStep integration (ZeRO-1-style sharded weight update)
+# ---------------------------------------------------------------------------
+
+def dist_bucket_coeffs(c, bucket_idx, sizes, padded, cdtype):
+    """Segment coefficient vectors for one dist bucket (indices into the
+    FUSED param subset), padded to the bucket's padded size. `c` is the
+    bucket_coeffs table computed ONCE for the fused subset — rebuilding
+    it per bucket would re-walk every param (and re-invoke user
+    lr_ratio/apply_decay_param_fun callables) O(buckets) times."""
+    idx = np.asarray(bucket_idx)
+    return {
+        "l2": _segment_vec(c["l2"][idx], sizes, padded, cdtype),
+        "l1": _segment_vec(c["l1"][idx], sizes, padded, cdtype),
+        "wd": _segment_vec(c["wd"][idx], sizes, padded, cdtype),
+        "lr_scale": _segment_vec(c["lr_scale"][idx], sizes, padded, cdtype,
+                                 fill=1.0),
+    }
+
+
+def steps_consistent(opt, params) -> bool:
+    """True when the per-param 'step' accumulators (if any) agree, so a
+    single bucket scalar can represent them. Disagreement (partial
+    restore, param added mid-training) must fall back to the per-param
+    path — silently restarting Adam bias correction at t=0 would spike
+    the effective lr."""
+    store = opt._accumulators.get("step")
+    if not store:
+        return True
+    ts = {int(v) for p in params for v in [store.get(id(p))]
+          if v is not None}
+    return len(ts) <= 1
+
+
+def init_dist_flat_state(opt, params, bucket, kind, mp, cdtype,
+                         quantized=False):
+    """Flat, padded per-bucket state for the dist fused update, seeded
+    from eager accumulators when present (checkpoint restore parity with
+    _fn_init_all)."""
+    padded = bucket.padded_size
+    st = _init_bucket_state(kind, padded, cdtype)
+
+    def _flat_of(name, default_fn):
+        parts, any_seed = [], False
+        for k, i in enumerate(bucket.idx):
+            p = params[i]
+            v = opt._accumulators.get(name, {}).get(id(p))
+            if v is not None:
+                any_seed = True
+                parts.append(jnp.ravel(v).astype(cdtype))
+            else:
+                parts.append(default_fn(p))
+        if padded != bucket.size:
+            parts.append(jnp.zeros((padded - bucket.size,), cdtype))
+        return jnp.concatenate(parts), any_seed
+
+    for name in _state_names(kind):
+        if name == "step":
+            store = opt._accumulators.get("step", {})
+            ts = {int(store[id(params[i])]) for i in bucket.idx
+                  if id(params[i]) in store}
+            if len(ts) == 1:
+                st["step"] = jnp.asarray(ts.pop(), jnp.int32)
+            continue
+        flat, seeded = _flat_of(
+            name, lambda p: jnp.zeros(
+                (int(np.prod(p._value.shape) or 1),), cdtype))
+        if seeded:
+            st[name] = flat
+    if mp:
+        st["master_weight"], _ = _flat_of(
+            "master_weight",
+            lambda p: jnp.ravel(p._value).astype(jnp.float32))
+    if quantized:
+        st["ef_residual"] = jnp.zeros((padded,), cdtype)
+    return st
+
+
+def try_fused_step(opt) -> bool:
+    """Run one fused eager step. Returns False when the optimizer/param
+    configuration needs the per-param fallback (caller runs it)."""
+    try:
+        if not flag_value("fused_optimizer"):
+            return False
+    except KeyError:
+        return False
+    # grad_clip runs INSIDE the fused program (functional twin), so the
+    # eager Tensor-based clip pass of _params_grads is skipped on purpose
+    pg = [(p, p.grad) for p in opt._parameter_list
+          if not p.stop_gradient and p.grad is not None]
+    if not pg:
+        return True  # nothing to update; parity with the eager loop
+    params = [p for p, _ in pg]
+    plan = fused_plan(opt, params)
+    if plan is None:
+        return False
+    lr = opt._lr_operand()
+    wd_scalar = None
+    if plan.wd_dynamic:
+        coeff = opt._wd
+        wd_scalar = jnp.asarray(
+            getattr(coeff, "_value", coeff), jnp.float32)
+    plan.run(params, [g._value for _, g in pg], lr, wd_scalar)
+    _count_dispatch(1, "fused")
+    return True

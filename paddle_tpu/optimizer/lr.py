@@ -1,4 +1,16 @@
-"""LR schedulers (parity: python/paddle/optimizer/lr.py)."""
+"""LR schedulers (parity: python/paddle/optimizer/lr.py).
+
+Fast-path contract (see Optimizer._lr_operand and the fused
+multi-tensor step): the current lr enters every jitted update program
+as a float32 scalar OPERAND, never a trace-time constant — so
+``step()`` / ``get_lr()`` must stay pure host-side float math with no
+device arrays and no forced syncs. Schedulers here satisfy that by
+construction (plain python floats); ``step()`` additionally coerces
+numpy scalars a subclass might return, so a custom ``get_lr`` using
+numpy can't leak a weak-typed np.float64 into the operand path.
+``tests/test_train_fastpath.py`` asserts a scheduler stepping every
+iteration does not retrigger compilation of the fused update.
+"""
 from __future__ import annotations
 
 import math as pymath
@@ -20,7 +32,13 @@ class LRScheduler:
             self.last_epoch += 1
         else:
             self.last_epoch = epoch
-        self.last_lr = self.get_lr()
+        lr = self.get_lr()
+        # keep last_lr a PLAIN float: a numpy scalar from a subclass's
+        # get_lr would ride into jitted updates as a weak-typed f64
+        # operand; a plain float is canonicalized once by _lr_operand.
+        # (Device arrays pass through untouched — float() would sync.)
+        self.last_lr = float(lr) if isinstance(lr, (int, float)) \
+            or type(lr).__module__ == "numpy" else lr
 
     def get_lr(self):
         raise NotImplementedError

@@ -39,8 +39,23 @@ class Optimizer:
             self._regularization_coeff = float(weight_decay)
         else:
             self._regularization_coeff = 0.0 if weight_decay is None else weight_decay
-        # accumulators: name -> {param_id -> jax array}
-        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        # accumulators: name -> {param_id -> jax array}; accessed through
+        # the lazy-sync _accumulators property (the fused multi-tensor
+        # path keeps authoritative state in flat buffers and unflattens
+        # on first read)
+        self._accums: Dict[str, Dict[int, jax.Array]] = {}
+
+    @property
+    def _accumulators(self):
+        plan = self.__dict__.get("_fused_plan")
+        if plan is not None and plan.dirty:
+            plan.dirty = False
+            plan.sync_to_accumulators()
+        return self._accums
+
+    @_accumulators.setter
+    def _accumulators(self, value):
+        self._accums = value
 
     # ----------------------------------------------------- regularization --
     def _decayed_grad(self, p, g):
@@ -74,6 +89,17 @@ class Optimizer:
     # ------------------------------------------------------------ LR API --
     def get_lr(self):
         return _as_float(self._learning_rate)
+
+    def _lr_operand(self):
+        """Current lr as a jnp.float32 scalar OPERAND for jitted update
+        programs — never a python-float trace constant (which would
+        retrigger compilation every time a scheduler steps) and never a
+        float() on a device array (which would force a host sync)."""
+        import jax.numpy as jnp
+        lr = self._learning_rate
+        if isinstance(lr, LRScheduler):
+            lr = lr()
+        return jnp.asarray(getattr(lr, "_value", lr), jnp.float32)
 
     def set_lr(self, value):
         if isinstance(self._learning_rate, LRScheduler):
@@ -127,7 +153,20 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        from .fused import try_fused_step, _count_dispatch
+        if try_fused_step(self):
+            return
+        if self.__dict__.get("_fused_plan") is not None:
+            # dropping to the per-param path (flag flip / config change):
+            # flush the flat state so the accumulators are authoritative
+            # again, then retire the plan
+            plan = self._fused_plan
+            if plan.dirty:
+                plan.dirty = False
+                plan.sync_to_accumulators()
+            self._fused_plan = None
         lr = self.get_lr()
+        n_updates = 0
         for p, g in self._params_grads():
             if g is None:
                 continue
@@ -150,6 +189,9 @@ class Optimizer:
                 if gv.dtype != p._value.dtype:
                     gv = gv.astype(p._value.dtype)
                 p._value = self._update(p, gv, lr)
+            n_updates += 1
+        if n_updates:
+            _count_dispatch(n_updates, "per_param")
 
     def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list:

@@ -102,12 +102,33 @@ def render(last) -> str:
           f"   tick_time mean {pp_t['value'] * 1e3:.2f}ms"
           f"   p99 {pp_t['p99'] * 1e3:.2f}ms")
 
+    opt_t = _series(last, "train.opt_update_seconds")
+    opt_d = _series(last, "train.opt_dispatches")
+    if opt_t or opt_d:
+        w("== optimizer (eager update) ==")
+        for labels, rec in sorted(opt_t.items()):
+            path = dict(labels).get("path", "?")
+            w(f"  update[{path}]   mean {rec.get('value', 0) * 1e3:.2f}ms"
+              f"   p99 {rec.get('p99', 0) * 1e3:.2f}ms"
+              f"   n={rec.get('count', 0)}")
+        for labels, rec in sorted(opt_d.items()):
+            path = dict(labels).get("path", "?")
+            w(f"  dispatches[{path}]  {int(rec.get('value', 0))}")
+
     mem = _one(last, "mem.peak_bytes_in_use")
-    if mem:
+    osb = _series(last, "mem.opt_state_bytes")
+    if mem or osb:
         cur = _one(last, "mem.bytes_in_use") or {}
         w("== memory ==")
-        w(f"  in_use          {_fmt_bytes(cur.get('value', 0))}"
-          f"   peak {_fmt_bytes(mem.get('value', 0))}")
+        if mem:
+            w(f"  in_use          {_fmt_bytes(cur.get('value', 0))}"
+              f"   peak {_fmt_bytes(mem.get('value', 0))}")
+        if osb:
+            parts = []
+            for labels, rec in sorted(osb.items()):
+                parts.append(f"{dict(labels).get('scope', '?')} "
+                             f"{_fmt_bytes(rec.get('value', 0))}")
+            w("  opt_state       " + "   ".join(parts))
 
     comm = _series(last, "comm.bytes")
     if comm:
@@ -160,8 +181,10 @@ def render(last) -> str:
 
     known = {"train.step_time_seconds", "train.steps", "train.tokens",
              "train.tokens_per_sec", "train.mfu", "train.grad_norm",
-             "train.loss", "pp.tick_time_seconds", "pp.ticks_per_step",
-             "mem.bytes_in_use", "mem.peak_bytes_in_use", "comm.bytes",
+             "train.loss", "train.opt_update_seconds",
+             "train.opt_dispatches", "pp.tick_time_seconds",
+             "pp.ticks_per_step", "mem.bytes_in_use",
+             "mem.peak_bytes_in_use", "mem.opt_state_bytes", "comm.bytes",
              "comm.calls", "serving.admissions", "serving.ttft_seconds",
              "serving.token_latency_seconds", "serving.page_utilization",
              "serving.queue_depth", "serving.rejected_requests",

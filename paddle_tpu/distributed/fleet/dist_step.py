@@ -437,6 +437,8 @@ class DistTrainStep:
         fz = self._fused
         rest = self._rest_idx
         wus = self._wus
+        from ...framework.flags import flag_value
+        guard = bool(flag_value("anomaly_guard"))  # read at trace time
 
         def apply_update(p_vals, grads, opt_state, lr):
             """Optimizer update: per-param path for the rest subset,
@@ -550,6 +552,19 @@ class DistTrainStep:
                 new_p, new_state, scaler_st = compiled_select_and_adapt(
                     scaler, found_inf, new_p, list(p_vals), new_state,
                     opt_state, scaler_st)
+            if guard:
+                # anomaly guard (FLAGS_anomaly_guard): a NaN/Inf loss
+                # keeps pre-step params/buffers/opt-state — fused
+                # scalar-predicate selects, no host sync (GSPMD shards
+                # the selects like the state they gate)
+                bad = ~jnp.isfinite(loss_val)
+                new_p = [jnp.where(bad, o, n)
+                         for o, n in zip(p_vals, new_p)]
+                new_b = [jnp.where(bad, o, n)
+                         for o, n in zip(b_vals, new_b)]
+                new_state = jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(bad, o, n), opt_state,
+                    new_state)
             return loss_val, new_p, new_b, new_state, new_key, scaler_st
 
         donate = (0, 1, 2) if self._donate else ()

@@ -32,6 +32,8 @@ class Context:
     script_args: List[str] = field(default_factory=list)
     run_mode: str = "collective"
     heartbeat_interval: float = 1.0  # seconds; <= 0 disables
+    restart_backoff_s: float = 0.5       # base; doubles per restart
+    restart_backoff_max_s: float = 60.0  # cap before jitter
 
     @property
     def world_size(self) -> int:
@@ -63,6 +65,16 @@ def parse_args(argv=None) -> Context:
                         "<log_dir>/heartbeat.jsonl (<=0 disables); a "
                         "wedged rank shows up as a pid that stops "
                         "growing its log while staying alive")
+    p.add_argument("--restart_backoff", type=float, default=0.5,
+                   help="elastic: base seconds of jittered exponential "
+                        "backoff between pod restarts (doubles per "
+                        "restart; <=0 restarts immediately). A crash "
+                        "loop without backoff hammers the coordinator "
+                        "and the checkpoint store in lockstep across "
+                        "pods")
+    p.add_argument("--restart_backoff_max", type=float, default=60.0,
+                   help="elastic: backoff cap in seconds (before the "
+                        "+/-50%% jitter)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -74,7 +86,20 @@ def parse_args(argv=None) -> Context:
         job_id=a.job_id, log_dir=a.log_dir, devices=a.devices,
         max_restart=a.max_restart, script=a.script,
         script_args=a.script_args,
-        heartbeat_interval=a.heartbeat_interval)
+        heartbeat_interval=a.heartbeat_interval,
+        restart_backoff_s=a.restart_backoff,
+        restart_backoff_max_s=a.restart_backoff_max)
+
+
+def restart_delay(restarts: int, base_s: float, cap_s: float) -> float:
+    """Jittered exponential backoff for restart N (1-based): base * 2^(N-1),
+    capped, with +/-50% jitter so a multi-pod job's restarts decorrelate
+    instead of re-stampeding the coordinator in lockstep."""
+    import random
+    if base_s <= 0 or restarts <= 0:
+        return 0.0
+    return min(cap_s, base_s * (2 ** (restarts - 1))) \
+        * (0.5 + random.random())
 
 
 class PodController:
@@ -286,6 +311,13 @@ def launch(ctx: Context) -> int:
             pod.stop()
             if restarts > ctx.max_restart:
                 break
+            delay = restart_delay(restarts, ctx.restart_backoff_s,
+                                  ctx.restart_backoff_max_s)
+            if delay > 0:
+                print(f"[launch] backing off {delay:.2f}s before restart "
+                      f"epoch {epoch + 1} (restart {restarts}/"
+                      f"{ctx.max_restart})", file=sys.stderr)
+                time.sleep(delay)
             epoch += 1
         return rc if rc is not None else 1
     finally:

@@ -171,3 +171,48 @@ define_flag("check_distribution_args", False,
             "Validate distribution constructor arguments (e.g. negative "
             "Categorical weights) with a warning. Costs a host sync on "
             "device-resident weights, so it is debug-only.")
+
+
+def _arm_faults(v):
+    from . import faults
+    faults.arm(v)
+
+
+define_flag("fault_injection", "",
+            "Deterministic fault-injection spec (docs/ROBUSTNESS.md): "
+            "comma-separated 'site[:key=val|mode]...' entries, e.g. "
+            "'ckpt_save:step=3:err,nan_loss:step=5'. Empty disarms. "
+            "Sites: ckpt_save, ckpt_write, nan_loss, slow_step, sigterm, "
+            "decode_wedge, serve_flood.",
+            on_change=_arm_faults)
+define_flag("anomaly_guard", True,
+            "Trainer anomaly guard: a NaN/Inf loss skips the parameter "
+            "update IN-PROGRAM (params/opt-state/buffers keep their "
+            "pre-step values — a handful of fused selects, no host "
+            "sync), the anomalous step is never checkpointed, and the "
+            "loop aborts after FLAGS_max_anomalous_steps consecutive "
+            "bad steps. The Trainer syncs the loss one step late "
+            "(pipelined) to count anomalies; 0 restores the unguarded "
+            "log-boundary-only sync behavior.")
+define_flag("max_anomalous_steps", 10,
+            "Abort training with AnomalousTrainingError after this many "
+            "CONSECUTIVE anomalous (NaN/Inf or loss-spike) steps.")
+define_flag("loss_spike_factor", 10.0,
+            "Loss-spike anomaly threshold: a step whose loss exceeds "
+            "this multiple of the rolling mean of recent good losses "
+            "counts as anomalous (not checkpointed; counts toward the "
+            "abort threshold). 0 disables spike detection; NaN/Inf "
+            "detection is always on while FLAGS_anomaly_guard is set.")
+define_flag("ckpt_save_retries", 3,
+            "VerifiedCheckpointer: retries after a failed checkpoint "
+            "save (transient I/O error), with jittered exponential "
+            "backoff, before the error propagates.")
+define_flag("ckpt_retry_backoff_s", 0.5,
+            "Base delay (seconds) for checkpoint save retry backoff; "
+            "doubles per attempt (capped at 8s), +/-50% jitter.")
+define_flag("serve_decode_watchdog_s", 0.0,
+            "ContinuousBatchingPredictor decode watchdog: if a decode "
+            "step's host sync does not resolve within this many "
+            "seconds, pending requests fail with last_status "
+            "'watchdog' instead of generate() hanging. 0 disables "
+            "(the resolve blocks unconditionally, no polling).")

@@ -18,7 +18,16 @@ import jax.numpy as jnp
 
 from ..tensor import Tensor
 from .._grad_mode import no_grad
+from ..framework import faults as _faults
+from ..framework.flags import flag_value as _fv
 from ..observability import metrics as _obsm
+
+
+class DecodeWedgedError(RuntimeError):
+    """The decode watchdog tripped: a dispatched decode step's host
+    sync did not resolve within the deadline (wedged device/runtime).
+    ContinuousBatchingPredictor fails the pending requests
+    (last_status 'watchdog') instead of hanging generate()."""
 
 
 class PrecisionType:
@@ -444,9 +453,20 @@ class ContinuousBatchingPredictor:
     def __init__(self, model, max_batch_size=4, page_size=16,
                  num_pages=None, max_seq_len=512, pad_token_id=0,
                  eos_token_id=None, kv_dtype=None, use_ragged="auto",
-                 enable_prefix_cache=True):
+                 enable_prefix_cache=True, max_queue=None,
+                 shed_policy="newest", decode_watchdog_s=None):
         import math as _m
         model.eval()
+        if shed_policy not in ("newest", "oldest"):
+            raise ValueError(
+                f"shed_policy must be 'newest' or 'oldest', "
+                f"got {shed_policy!r}")
+        # robustness knobs (docs/ROBUSTNESS.md): bounded admission queue
+        # with load shedding, and a decode-step watchdog (None defers to
+        # FLAGS_serve_decode_watchdog_s at generate time; <=0 disables)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.shed_policy = shed_policy
+        self._watchdog_s = decode_watchdog_s
         if kv_dtype is None:
             # KV pages match the model's compute dtype (a bf16 model
             # must not pay fp32 page bandwidth)
@@ -478,7 +498,9 @@ class ContinuousBatchingPredictor:
                       "decode_steps": 0, "evictions": 0,
                       "max_in_flight": 0, "prefix_hits": 0,
                       "prefix_partial_hits": 0, "prefix_misses": 0,
-                      "pages_reused": 0, "hol_skips": 0}
+                      "pages_reused": 0, "hol_skips": 0,
+                      "deadline_evictions": 0, "shed_requests": 0,
+                      "watchdog_trips": 0}
         self.last_status: List[str] = []
         # serving telemetry (docs/SERVING.md catalog); recording no-ops
         # when paddle_tpu.observability.enabled(False)
@@ -500,6 +522,9 @@ class ContinuousBatchingPredictor:
         self._m_pfx_pages = _obsm.counter(
             "serving.prefix_cache_pages_reused")
         self._m_hol = _obsm.counter("serving.hol_skips")
+        self._m_deadline = _obsm.counter("robustness.deadline_evictions")
+        self._m_shed = _obsm.counter("robustness.shed_requests")
+        self._m_wedge = _obsm.counter("robustness.watchdog_trips")
         # ragged-grid paged attention: only valid (slot, page) pairs
         # enter the decode kernel's grid. "auto" enables it when the
         # kernel's constraints hold (H == Hkv, D % 128 == 0, H % 8 == 0)
@@ -675,7 +700,8 @@ class ContinuousBatchingPredictor:
         return nxt, done, new_k, new_v
 
     # ------------------------------------------------------------ serve --
-    def generate(self, prompts, max_new_tokens=32, strict=True):
+    def generate(self, prompts, max_new_tokens=32, strict=True,
+                 deadline_s=None):
         """Continuous batching over a stream of prompts: List[List[int]]
         → List[List[int]] (new tokens per prompt, in request order).
         Sequences join and leave the running batch mid-flight.
@@ -688,14 +714,44 @@ class ContinuousBatchingPredictor:
         ('rejected_over_max_seq_len' / 'rejected_over_pool_capacity',
         'ok' for served requests), and the serving.rejected_requests
         counter increments.
+
+        Robustness (docs/ROBUSTNESS.md):
+
+        - `deadline_s` (scalar or per-request list, seconds from call
+          entry): an expired request is evicted — from the queue with
+          result [] or mid-decode with its partial tokens — and
+          `last_status[r] == "deadline"`, without blocking the others
+          (robustness.deadline_evictions).
+        - constructor `max_queue` bounds the admission backlog; excess
+          requests are shed at entry per `shed_policy` ('newest' sheds
+          the latest arrivals, 'oldest' the stalest) with
+          `last_status[r] == "shed"` (robustness.shed_requests).
+        - the decode watchdog (constructor `decode_watchdog_s`, else
+          `FLAGS_serve_decode_watchdog_s`) fails pending requests with
+          `last_status "watchdog"` when a decode step wedges, instead
+          of hanging; the KV pool is NOT reclaimed from a wedged step —
+          treat the predictor as poisoned and rebuild it.
         """
         import time as _time
 
         self._ensure_ready()
+        wd = self._watchdog_s if self._watchdog_s is not None \
+            else float(_fv("serve_decode_watchdog_s"))
+        self._wd_cur = wd if wd and wd > 0 else None
         t_gen = _time.perf_counter()
         results = [None] * len(prompts)
         status = ["queued"] * len(prompts)
         self.last_status = status
+        if deadline_s is None:
+            deadlines = None
+        else:
+            per_req = deadline_s if isinstance(deadline_s, (list, tuple)) \
+                else [deadline_s] * len(prompts)
+            if len(per_req) != len(prompts):
+                raise ValueError(
+                    f"deadline_s has {len(per_req)} entries for "
+                    f"{len(prompts)} prompts")
+            deadlines = [t_gen + float(d) for d in per_req]
         queue = []
         for r, p in enumerate(prompts):
             need = -(-(len(p) + max_new_tokens) // self.page)
@@ -721,6 +777,24 @@ class ContinuousBatchingPredictor:
             self._m_rej.inc(reason=kind)
             self._m_done.inc(status="rejected_" + kind)
 
+        # bounded admission queue: shed the overflow instead of letting
+        # the backlog (and every queued request's latency) grow without
+        # bound. The serve_flood fault site inflates the apparent depth
+        # so the shedding path is exercisable without real overload.
+        flood = 0
+        ff = _faults.check("serve_flood")
+        if ff is not None and ff.mode == "flood":
+            flood = int(ff.params.get("n", self.B))
+        if self.max_queue is not None:
+            while queue and len(queue) + flood > self.max_queue:
+                pos = len(queue) - 1 if self.shed_policy == "newest" else 0
+                r = queue.pop(pos)
+                results[r] = []
+                status[r] = "shed"
+                self.stats["shed_requests"] += 1
+                self._m_shed.inc(policy=self.shed_policy)
+                self._m_done.inc(status="shed")
+
         from ..kernels.paged_attention import RaggedMetaBuilder
         # slot state (host): -1 = free
         slot_req = [-1] * self.B
@@ -735,10 +809,10 @@ class ContinuousBatchingPredictor:
                                     self._trash) if self.use_ragged \
             else None
 
-        def evict(b):
+        def evict(b, status_val="ok"):
             r = slot_req[b]
             results[r] = slot_new[b]
-            status[r] = "ok"
+            status[r] = status_val
             self.pool.release(slot_pages[b])
             slot_req[b], slot_pages[b], slot_new[b] = -1, [], []
             tables[b, :] = self._trash
@@ -747,7 +821,31 @@ class ContinuousBatchingPredictor:
                 builder.clear_slot(b)
             self.stats["evictions"] += 1
             self._m_evt.inc()
-            self._m_done.inc(status="ok")
+            self._m_done.inc(status=status_val)
+
+        def expire_deadlines():
+            """Evict every request whose deadline passed: queued ones
+            return [] and running ones their partial tokens, both with
+            last_status 'deadline' — an expired request must not keep
+            holding a slot/pages the live ones need."""
+            if deadlines is None:
+                return
+            now = _time.perf_counter()
+            for pos in range(len(queue) - 1, -1, -1):
+                r = queue[pos]
+                if now >= deadlines[r]:
+                    queue.pop(pos)
+                    results[r] = []
+                    status[r] = "deadline"
+                    self.stats["deadline_evictions"] += 1
+                    self._m_deadline.inc(stage="queued")
+                    self._m_done.inc(status="deadline")
+            for b in range(self.B):
+                r = slot_req[b]
+                if r >= 0 and now >= deadlines[r]:
+                    self.stats["deadline_evictions"] += 1
+                    self._m_deadline.inc(stage="decoding")
+                    evict(b, "deadline")
 
         def reserve(r):
             """Try to reserve pages for request r (prefix-cache lookup +
@@ -895,6 +993,7 @@ class ContinuousBatchingPredictor:
         inflight = None
         evictions_seen = -1
         while True:
+            expire_deadlines()
             admitted = False
             while admission_round():
                 admitted = True
@@ -924,8 +1023,31 @@ class ContinuousBatchingPredictor:
                                               override, builder, inflight)
             prev, inflight = inflight, cur
             if prev is not None:
-                self._resolve_step(prev, slot_req, slot_new,
-                                   last_tok_host, max_new_tokens, evict)
+                try:
+                    self._resolve_step(prev, slot_req, slot_new,
+                                       last_tok_host, max_new_tokens,
+                                       evict)
+                except DecodeWedgedError:
+                    # wedged decode: fail everything still pending
+                    # instead of hanging generate(). Pages of the
+                    # wedged step are NOT reclaimed (the in-flight
+                    # program owns the pool arrays) — the predictor
+                    # should be rebuilt.
+                    self.stats["watchdog_trips"] += 1
+                    self._m_wedge.inc()
+                    for b in range(self.B):
+                        r = slot_req[b]
+                        if r >= 0:
+                            results[r] = slot_new[b]
+                            status[r] = "watchdog"
+                            slot_req[b] = -1
+                            self._m_done.inc(status="watchdog")
+                    for r in queue:
+                        results[r] = []
+                        status[r] = "watchdog"
+                        self._m_done.inc(status="watchdog")
+                    queue.clear()
+                    break
             elif cur is None:
                 break
 
@@ -1055,8 +1177,35 @@ class ContinuousBatchingPredictor:
         """Sync a PREVIOUSLY dispatched step (the next one is already in
         flight) and apply its tokens: append, detect completion, evict.
         Slots that were recycled since the dispatch are skipped — their
-        in-flight token belongs to the evicted request."""
+        in-flight token belongs to the evicted request.
+
+        With the watchdog armed (self._wd_cur), the sync polls the
+        device buffers' is_ready() against a deadline instead of
+        blocking unconditionally — no thread spawn on the hot decode
+        path; a step that never resolves raises DecodeWedgedError.
+        (The decode_wedge fault holds is_ready 'false' for its sleep=
+        duration to drive this path in CI.)"""
         import time as _time
+        wd = getattr(self, "_wd_cur", None)
+        if wd:
+            fa = _faults.check("decode_wedge")
+            wedged_until = (_time.perf_counter()
+                            + float(fa.params.get("sleep", 2 * wd))) \
+                if fa is not None else 0.0
+            deadline = _time.perf_counter() + wd
+
+            def _ready(a):
+                return getattr(a, "is_ready", lambda: True)()
+
+            while True:
+                now = _time.perf_counter()
+                if now >= wedged_until and _ready(step["tok"]) \
+                        and _ready(step["done"]):
+                    break
+                if now >= deadline:
+                    raise DecodeWedgedError(
+                        f"decode step did not resolve within {wd}s")
+                _time.sleep(min(0.002, wd / 100.0))
         nxt = np.asarray(step["tok"])
         done = np.asarray(step["done"])
         self._m_tok.observe(_time.perf_counter() - step["t"])

@@ -155,6 +155,9 @@ class TrainStep:
         grad_clip = opt._grad_clip
         scaler = self._scaler
 
+        from ..framework.flags import flag_value
+        guard = bool(flag_value("anomaly_guard"))  # read at trace time
+
         def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch,
                     scaler_st):
             model_in = batch[:n_in]
@@ -188,6 +191,18 @@ class TrainStep:
                 new_p, new_state, scaler_st = compiled_select_and_adapt(
                     scaler, found_inf, new_p, list(p_vals), new_state,
                     opt_state, scaler_st)
+            if guard:
+                # anomaly guard (FLAGS_anomaly_guard): a NaN/Inf loss
+                # keeps pre-step params/buffers/opt-state — fused
+                # scalar-predicate selects, no host sync
+                bad = ~jnp.isfinite(loss_val)
+                new_p = [jnp.where(bad, o, n)
+                         for o, n in zip(p_vals, new_p)]
+                new_b = [jnp.where(bad, o, n)
+                         for o, n in zip(b_vals, new_b)]
+                new_state = jax.tree_util.tree_map(
+                    lambda o, n: jnp.where(bad, o, n), opt_state,
+                    new_state)
             return (loss_val, new_p, new_b, new_state, new_key,
                     scaler_st)
 

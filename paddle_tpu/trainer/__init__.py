@@ -10,20 +10,32 @@ async-checkpoint every save_steps → on SIGTERM checkpoint and exit 0 so
 """
 from __future__ import annotations
 
+import hashlib
+import math
 import os
 import signal
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from ..tensor import Tensor
 from .. import observability as _obs
+from ..framework import faults as _faults
+from ..framework.flags import flag_value as _fv
 
 __all__ = ["TrainingArguments", "Trainer", "SpeedMeter",
-           "device_peak_flops"]
+           "device_peak_flops", "AnomalousTrainingError"]
+
+
+class AnomalousTrainingError(RuntimeError):
+    """Training aborted: FLAGS_max_anomalous_steps consecutive NaN/Inf
+    or loss-spike steps (docs/ROBUSTNESS.md). The last verified
+    checkpoint is intact — anomalous steps are never checkpointed."""
 
 
 def device_peak_flops(dtype: str = "bfloat16") -> float:
@@ -139,61 +151,165 @@ class Trainer:
     # ------------------------------------------------------- checkpointing --
     def _ckpt_mgr(self):
         if self._ckpt is None:
-            from ..distributed.checkpoint import AsyncCheckpointer
-            self._ckpt = AsyncCheckpointer(
-                os.path.join(self.args.output_dir, "checkpoints"))
+            from ..distributed.checkpoint import VerifiedCheckpointer
+            self._ckpt = VerifiedCheckpointer(
+                os.path.join(self.args.output_dir, "checkpoints"),
+                max_to_keep=self.args.max_checkpoints)
         return self._ckpt
 
     def _full_state(self, step: int):
-        """Model + opt-state + rng as one orbax-friendly tree. The opt state
-        lives in the compiled step object (donated buffers); model params
-        track it after every step, so state_dict() is current."""
+        """Model + opt-state + rng as one checkpoint-friendly tree. The
+        opt state lives in the compiled step object (donated buffers);
+        model params track it after every step, so state_dict() is
+        current."""
         state = {"model": dict(self.model.state_dict()),
                  "step": np.asarray(step, dtype=np.int64)}
         opt_leaves = jax.tree_util.tree_leaves(self._step_obj.opt_state)
         state["opt"] = {str(i): leaf for i, leaf in enumerate(opt_leaves)}
         return state
 
+    def _opt_fingerprint(self) -> str:
+        """Fingerprint of the optimizer state *structure* (treedef plus
+        per-leaf shape/dtype). Persisted in the checkpoint manifest:
+        opt leaves are stored by flat index, so restoring into a
+        different tree would silently mis-restore — the fingerprint
+        turns that into a hard, attributable error."""
+        leaves, treedef = jax.tree_util.tree_flatten(
+            self._step_obj.opt_state)
+        desc = "|".join(
+            [str(treedef)]
+            + [f"{tuple(np.shape(l))}:{getattr(l, 'dtype', type(l))}"
+               for l in leaves])
+        return hashlib.sha256(desc.encode()).hexdigest()[:16]
+
     def _save(self, step: int):
-        self._ckpt_mgr().save(step, self._full_state(step))
+        self._ckpt_mgr().save(step, self._full_state(step),
+                              meta={"opt_treedef": self._opt_fingerprint()})
 
     def _try_resume(self) -> int:
-        mgr = self._ckpt_mgr()
-        template = self._full_state(0)
-        from ..distributed.checkpoint import AsyncCheckpointer  # noqa: F401
-        step = mgr._mgr.latest_step()
-        if step is None:
+        res = self._ckpt_mgr().restore_latest()
+        if res is None:
             return 0
-        import orbax.checkpoint as ocp
-        from ..distributed.checkpoint import _to_arrays
-        restored = mgr._mgr.restore(
-            step, args=ocp.args.StandardRestore(_to_arrays(template)))
-        # write model params back
+        step, restored, meta = res
+        fp, cur = meta.get("opt_treedef"), self._opt_fingerprint()
+        if fp is not None and fp != cur:
+            raise RuntimeError(
+                f"checkpoint step {step} was written with a different "
+                f"optimizer state tree (treedef fingerprint {fp} != "
+                f"current {cur}): restoring by flat leaf index would "
+                "silently mis-restore. Rebuild the Trainer with the "
+                "original optimizer configuration, or start fresh with "
+                "train(resume=False).")
+        # write model params back (jnp.array: force XLA-owned copies —
+        # donated buffers must never alias host numpy memory)
         model_sd = self.model.state_dict()
         for k, v in model_sd.items():
             if k in restored["model"]:
-                v._value = restored["model"][k]
+                v._value = jnp.array(restored["model"][k])
         # rebuild opt state with the original treedef
         leaves, treedef = jax.tree_util.tree_flatten(self._step_obj.opt_state)
-        new_leaves = [restored["opt"][str(i)] for i in range(len(leaves))]
+        if len(restored["opt"]) != len(leaves):
+            raise RuntimeError(
+                f"checkpoint step {step} holds {len(restored['opt'])} "
+                f"optimizer leaves but the current optimizer has "
+                f"{len(leaves)} — the optimizer changed between runs.")
+        new_leaves = [jnp.array(restored["opt"][str(i)])
+                      for i in range(len(leaves))]
         self._step_obj._opt_state = jax.tree_util.tree_unflatten(
             treedef, new_leaves)
-        return int(restored["step"])
+        return int(np.asarray(restored["step"]))
 
     # ------------------------------------------------------------ the loop --
+    _PREEMPT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
     def _install_preemption_hook(self):
+        """SIGTERM/SIGINT -> checkpoint-and-exit at the next step
+        boundary. Chains to any pre-existing handler (so an outer
+        framework's hook still runs) and records the originals for
+        restoration when train() returns — installing a Trainer must
+        not permanently clobber the process's signal handling."""
+        self._prev_handlers = {}
+
         def handler(signum, frame):
             self._preempted = True  # acted on at the next step boundary
-        try:
-            signal.signal(signal.SIGTERM, handler)
-        except ValueError:
-            pass  # not the main thread (e.g. under a test runner)
+            prev = self._prev_handlers.get(signum)
+            if callable(prev) and prev is not signal.default_int_handler:
+                prev(signum, frame)  # chain (but not KeyboardInterrupt)
+
+        for s in self._PREEMPT_SIGNALS:
+            try:
+                self._prev_handlers[s] = signal.signal(s, handler)
+            except ValueError:
+                pass  # not the main thread (e.g. under a test runner)
+
+    def _restore_preemption_hook(self):
+        for s, prev in getattr(self, "_prev_handlers", {}).items():
+            if prev is None:
+                continue  # non-Python handler: leave as-is
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._prev_handlers = {}
+
+    # -------------------------------------------------------- anomaly guard --
+    def _guard_check(self, step: int, loss) -> bool:
+        """Sync one step's loss and classify it. Returns True when the
+        step is anomalous (NaN/Inf, or a spike vs the rolling mean of
+        recent good losses). Consecutive anomalies beyond
+        FLAGS_max_anomalous_steps abort with AnomalousTrainingError.
+        Called at most once per step (the `nan_loss` fault site is
+        consumed here, one check per step)."""
+        lv = float(loss)
+        fa = _faults.check("nan_loss", step=step)
+        if fa is not None:
+            lv = float("inf") if fa.mode == "inf" else float("nan")
+        anomalous, reason = not math.isfinite(lv), "nonfinite"
+        spike = float(_fv("loss_spike_factor"))
+        window = self._good_losses
+        if not anomalous and spike > 0 and len(window) >= 5:
+            mean = sum(window) / len(window)
+            if abs(lv) > spike * max(abs(mean), 1e-12):
+                anomalous, reason = True, "spike"
+        if anomalous:
+            self._anom_consec += 1
+            self._anom_total += 1
+            _obs.counter("robustness.anomalies_skipped").inc(reason=reason)
+            self._log({"anomalous_step": step + 1, "loss": lv,
+                       "reason": reason,
+                       "consecutive": self._anom_consec})
+            limit = int(_fv("max_anomalous_steps"))
+            if self._anom_consec >= limit:
+                last_ok = self._ckpt_mgr().latest_verified()
+                raise AnomalousTrainingError(
+                    f"aborting after {self._anom_consec} consecutive "
+                    f"anomalous steps (last loss {lv!r} at step "
+                    f"{step + 1}, reason {reason}); the newest verified "
+                    f"checkpoint is step {last_ok} — anomalous steps "
+                    "were never checkpointed. Lower the learning rate, "
+                    "inspect the data at this step range, or raise "
+                    "FLAGS_max_anomalous_steps.")
+        else:
+            self._anom_consec = 0
+            window.append(lv)
+        return anomalous
 
     def train(self, resume: bool = True):
         args = self.args
         os.makedirs(args.output_dir, exist_ok=True)
         self._install_preemption_hook()
+        try:
+            return self._train_loop(resume)
+        finally:
+            self._restore_preemption_hook()
+
+    def _train_loop(self, resume: bool):
+        args = self.args
         start_step = self._try_resume() if resume else 0
+        guard = bool(_fv("anomaly_guard"))
+        self._anom_consec = 0
+        self._anom_total = 0
+        self._good_losses = deque(maxlen=20)
 
         meter = SpeedMeter(
             n_params=sum(int(np.prod(p.shape))
@@ -204,16 +320,40 @@ class Trainer:
         step = start_step
         loss = None
         loss_val = float("nan")
+        save_owed = False       # a save boundary fell on an anomalous step
+        pending = None          # (step, loss) awaiting its guard check
         data = self.data_iter_fn(start_step)
         t_start = time.perf_counter()
         for step in range(start_step, args.max_steps):
+            fa = _faults.check("slow_step", step=step)
+            if fa is not None:
+                time.sleep(float(fa.params.get("sleep", 0.05)))
             batch = next(data)
             if not isinstance(batch, (tuple, list)):
                 batch = (batch,)
             loss = self._step_obj(*batch)
+            if _faults.check("sigterm", step=step) is not None:
+                os.kill(os.getpid(), signal.SIGTERM)  # -> preemption hook
             if self.tokens_per_batch:
                 meter.update(self.tokens_per_batch)
-            if (step + 1) % args.logging_steps == 0 or self._preempted:
+            log_b = (step + 1) % args.logging_steps == 0 or self._preempted
+            save_b = (step + 1) % args.save_steps == 0 or self._preempted
+            last_b = step == args.max_steps - 1
+            step_anom = False
+            if guard:
+                # pipelined check: the previous step's loss syncs only
+                # after this step is dispatched, so the guard does not
+                # serialize the dispatch queue; boundaries (log/save/
+                # preempt/last) check the current step immediately
+                if pending is not None:
+                    ps, pl = pending
+                    pending = None
+                    self._guard_check(ps, pl)
+                if log_b or save_b or last_b:
+                    step_anom = self._guard_check(step, loss)
+                else:
+                    pending = (step, loss)
+            if log_b:
                 loss_val = float(loss)  # device sync at log boundary only
                 rec = {"step": step + 1, "loss": round(loss_val, 6),
                        "tokens_per_sec": round(meter.tokens_per_sec, 2),
@@ -223,15 +363,24 @@ class Trainer:
                 if _obs.enabled():
                     # per-step series come from the step object; the
                     # loop owns loss (synced only at log boundaries)
-                    _obs.gauge("train.loss").set(loss_val)
+                    if math.isfinite(loss_val):
+                        _obs.gauge("train.loss").set(loss_val)
                     if getattr(self._step_obj, "_obs", None) is None:
                         # uninstrumented step (single-device TrainStep):
                         # the loop is the only flusher. Instrumented
                         # steps export per step already — a second flush
                         # here would duplicate snapshots.
                         _obs.maybe_export(step=step + 1)
-            if (step + 1) % args.save_steps == 0 or self._preempted:
+            if step_anom and save_b:
+                # never checkpoint an anomalous step: the save is owed
+                # and lands at the next verified-good step
+                save_owed = True
+                self._log({"checkpoint_skipped_at": step + 1,
+                           "reason": "anomalous_step"})
+            elif (save_b or (save_owed and guard and not step_anom
+                             and pending is None)):
                 self._save(step + 1)
+                save_owed = False
             if self._preempted:
                 self._ckpt_mgr().wait()
                 self._log({"preempted_at": step + 1})
@@ -245,6 +394,7 @@ class Trainer:
                 "final_loss": loss_val,
                 "wall_s": time.perf_counter() - t_start,
                 "tokens_per_sec": meter.tokens_per_sec, "mfu": meter.mfu,
+                "anomalous_steps": self._anom_total,
                 "preempted": self._preempted, "logs": logs}
 
     def _log(self, rec: dict):

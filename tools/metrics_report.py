@@ -179,6 +179,19 @@ def render(last) -> str:
             w(f"  rejected[{dict(labels).get('reason', '?')}]  "
               f"{int(rec['value'])}")
 
+    rob = {k: rec for k, rec in last.items()
+           if k[0].startswith("robustness.")}
+    if rob:
+        w("== robustness (cumulative) ==")
+        for key in sorted(rob):
+            rec = rob[key]
+            lab = dict(key[1])
+            lab_s = ("{" + ",".join(f"{a}={b}" for a, b in
+                                    sorted(lab.items())) + "}") if lab \
+                else ""
+            name = key[0][len("robustness."):]
+            w(f"  {name:<22}{lab_s:<28}{int(rec.get('value', 0))}")
+
     known = {"train.step_time_seconds", "train.steps", "train.tokens",
              "train.tokens_per_sec", "train.mfu", "train.grad_norm",
              "train.loss", "train.opt_update_seconds",
@@ -191,7 +204,8 @@ def render(last) -> str:
              "serving.prefill_seconds", "serving.decode_steps",
              "serving.prefix_cache_hits", "serving.prefix_cache_misses",
              "serving.prefix_cache_pages_reused", "serving.hol_skips"}
-    rest = sorted(k for k in last if k[0] not in known)
+    rest = sorted(k for k in last if k[0] not in known
+                  and not k[0].startswith("robustness."))
     if rest:
         w("== other (last value) ==")
         for key in rest:

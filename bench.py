@@ -28,9 +28,25 @@ def _backend_watchdog(timeout_s=None):
     jax.devices() then blocks forever (known environmental failure; see
     round-1/2 bench notes). Probe backend init on a side thread so the
     bench fails FAST with an attributable message instead of timing out
-    silently."""
+    silently. The probe is instrumented (tracing span + RankHeartbeat):
+    a wedged run leaves output/heartbeat_bench.jsonl lines and a
+    flight_<pid>.json naming the stuck phase, instead of only the FATAL
+    log line five BENCH_r0* rounds died with."""
     import threading
     import jax
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_dir = os.path.join(here, "output")
+    obs = hb = None
+    sp = None
+    try:  # forensics must never break the bench
+        import paddle_tpu.observability as obs
+        hb = obs.RankHeartbeat(
+            os.path.join(out_dir, "heartbeat_bench.jsonl"), interval=5.0)
+        sp = obs.start_span("bench.backend_init", parent=None,
+                            timeout_s=timeout_s)
+    except Exception:
+        obs = hb = sp = None
 
     box = {}
 
@@ -42,28 +58,64 @@ def _backend_watchdog(timeout_s=None):
 
     th = threading.Thread(target=probe, daemon=True)
     th.start()
-    th.join(timeout_s)
+    t_end = time.time() + timeout_s
+    while th.is_alive() and time.time() < t_end:
+        th.join(min(1.0, max(0.1, t_end - time.time())))
+        if hb is not None:
+            hb.beat(phase="backend_init", pid=os.getpid(),
+                    elapsed_s=round(timeout_s - (t_end - time.time()), 1))
     if th.is_alive():
+        flight = None
+        if sp is not None:
+            sp.event("wedged", elapsed_s=timeout_s)
+            sp.end(status="wedged")
+            flight = obs.flight_dump(
+                path=os.path.join(out_dir,
+                                  f"flight_{os.getpid()}.json"),
+                reason="backend_init_wedge")
+            hb.close()
         _emit_backend_skip(f"jax backend init did not return within "
                            f"{timeout_s}s — the TPU tunnel/claim is wedged "
                            "(environmental; retry after the relay lease "
-                           "expires). No benchmark was run.")
+                           "expires). No benchmark was run.",
+                           flight=flight)
     if "error" in box:
+        if hb is not None:
+            hb.beat(phase="backend_error", pid=os.getpid())
+            hb.close()
+        if sp is not None:
+            sp.event("error", message=str(box["error"])[:200])
+            sp.end(status="error")
+            obs.flight_dump(
+                path=os.path.join(out_dir,
+                                  f"flight_{os.getpid()}.json"),
+                reason="backend_init_error")
         _emit_backend_skip(f"jax backend init failed: {box['error']!r}")
+    if hb is not None:
+        hb.beat(phase="backend_ready", pid=os.getpid())
+        hb.close()
+    if sp is not None:
+        sp.end(status="ok")
     return box["devices"]
 
 
-def _emit_backend_skip(reason):
+def _emit_backend_skip(reason, flight=None):
     """Backend init failed: print a PARSEABLE skip record on stdout (the
     driver's wrapper parses the last stdout line — a bare FATAL used to
     leave it with parsed: null, see BENCH_r05.json) and exit 3 so the
-    orchestrator still takes its replay path."""
+    orchestrator still takes its replay path. `flight` names the
+    flight-recorder dump holding the wedged run's spans, if one was
+    written."""
     _log(f"FATAL: {reason}")
+    aux = {"reason": reason}
+    if flight:
+        aux["flight_dump"] = flight
+        _log(f"flight-recorder dump: {flight}")
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": None, "unit": "tokens/s",
         "skipped": "backend-init",
-        "aux": {"reason": reason},
+        "aux": aux,
     }), flush=True)
     sys.exit(3)
 

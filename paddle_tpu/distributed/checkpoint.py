@@ -32,6 +32,7 @@ import jax
 from ..tensor import Tensor, Parameter
 from ..framework import faults as _faults
 from ..observability import metrics as _obsm
+from ..observability import tracing as _obstr
 
 _logger = logging.getLogger("paddle_tpu.checkpoint")
 
@@ -237,12 +238,17 @@ class VerifiedCheckpointer:
         base = self._backoff_s if self._backoff_s is not None \
             else float(flag_value("ckpt_retry_backoff_s"))
         flat = _flatten_state(state_dict)
+        sp = _obstr.start_span("ckpt.save", parent=None, step=int(step))
         last_err = None
         for attempt in range(retries + 1):
             try:
-                return self._write(step, flat, meta)
+                path = self._write(step, flat, meta)
+                sp.end(status="ok", attempts=attempt + 1)
+                return path
             except OSError as e:
                 last_err = e
+                sp.event("retry", attempt=attempt + 1,
+                         error=str(e)[:120])
                 if attempt >= retries:
                     break
                 delay = min(self._backoff_max_s, base * (2 ** attempt))
@@ -252,6 +258,7 @@ class VerifiedCheckpointer:
                     "checkpoint save step %s failed (%s); retry %d/%d "
                     "in %.2fs", step, e, attempt + 1, retries, delay)
                 time.sleep(delay)
+        sp.end(status="error")
         raise last_err
 
     def _write(self, step: int, flat: Dict, meta: Optional[Dict]) -> str:
@@ -361,11 +368,16 @@ class VerifiedCheckpointer:
     def restore(self, step: int) -> Tuple[Dict, Dict]:
         """Load one verified checkpoint -> (nested state tree of
         np.ndarrays, meta dict). Raises IOError if it fails to verify."""
+        sp = _obstr.start_span("ckpt.restore", parent=None,
+                               step=int(step))
         ok, why = self.verify(step)
         if not ok:
+            sp.end(status="verify_failed")
             raise IOError(f"checkpoint step {step} failed verification: "
                           f"{why}")
-        return self._load(step)
+        out = self._load(step)
+        sp.end(status="ok")
+        return out
 
     def _load(self, step: int) -> Tuple[Dict, Dict]:
         d = self._step_dir(step)
@@ -384,10 +396,12 @@ class VerifiedCheckpointer:
         """Newest *verified* checkpoint -> (step, tree, meta), walking
         past corrupt/partial ones (each skip logged + counted in
         robustness.ckpt_fallbacks). None when nothing usable exists."""
+        sp = _obstr.start_span("ckpt.restore_latest", parent=None)
         for step in reversed(self.steps()):
             ok, why = self.verify(step)
             if not ok:
                 _obsm.counter("robustness.ckpt_fallbacks").inc()
+                sp.event("fallback", step=step, why=why[:120])
                 _logger.warning(
                     "checkpoint step %s failed verification (%s); "
                     "falling back to the previous checkpoint", step, why)
@@ -396,10 +410,13 @@ class VerifiedCheckpointer:
                 tree, meta = self._load(step)  # already verified above
             except (OSError, ValueError) as e:
                 _obsm.counter("robustness.ckpt_fallbacks").inc()
+                sp.event("fallback", step=step, why=str(e)[:120])
                 _logger.warning("checkpoint step %s unreadable (%s); "
                                 "falling back", step, e)
                 continue
+            sp.end(status="ok", step=step)
             return step, tree, meta
+        sp.end(status="none")
         return None
 
     # ------------------------------------------------- API compatibility --

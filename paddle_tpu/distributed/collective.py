@@ -28,6 +28,7 @@ from ..tensor import Tensor
 from ..ops._dispatch import apply
 from ..ops.creation import _coerce
 from ..observability import metrics as _obsm
+from ..observability import tracing as _obstr
 
 
 _comm_calls = None
@@ -59,6 +60,13 @@ def _account(op: str, ax: Optional[str], *vals, nbytes: Optional[int] = None):
                 getattr(a, "dtype", np.float32)).itemsize
     _comm_calls.inc(op=op, axis=ax)
     _comm_bytes.inc(int(nbytes), op=op, axis=ax)
+    # tracing: inside an active span context (e.g. the Trainer's
+    # dispatch span / dist.compile), each facade collective leaves an
+    # instant child span carrying op+axis+bytes — the trace view of the
+    # same accounting. Outside any span this stays span-spam-free.
+    if _obstr.current_span() is not None:
+        _obstr.start_span(f"comm.{op}", op=op, axis=ax,
+                          bytes=int(nbytes)).end()
 
 
 class ReduceOp:

@@ -28,6 +28,7 @@ from ...framework.random import default_generator
 from ..mesh import get_mesh, ensure_mesh, mesh_scope, axis_size
 from ...jit.bridge import _clip_grads_functional
 from ...observability import enabled as _obs_enabled
+from ...observability import tracing as _tracing
 from ...observability.train_metrics import StepTelemetry, batch_tokens
 
 
@@ -625,7 +626,13 @@ class DistTrainStep:
                   for b in batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         if sig not in self._compiled:
-            self._compiled[sig] = self._build(self._batch_shardings(arrays))
+            # a (re)trace is the load-bearing event worth a span: the
+            # retrace that wedges or thrashes shows up attributed to its
+            # batch signature (nests under the Trainer's dispatch span)
+            with _tracing.span("dist.compile", batch=str(sig),
+                               stage=self._stage, wus=self._wus):
+                self._compiled[sig] = self._build(
+                    self._batch_shardings(arrays))
             if obs is not None and self._obs_use_xla_mfu:
                 # the batch is pinned ONLY until the one-shot MFU probe
                 # consumes it in this step's step_end (cleared below)

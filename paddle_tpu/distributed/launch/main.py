@@ -262,7 +262,7 @@ class ElasticManager:
 
 def launch(ctx: Context) -> int:
     """Run the pod until success, failure, or restart budget exhausted."""
-    from ...observability import RankHeartbeat
+    from ...observability import RankHeartbeat, tracing as _tr
     elastic = ElasticManager(ctx)
     hb = RankHeartbeat(os.path.join(ctx.log_dir, "heartbeat.jsonl"),
                        interval=ctx.heartbeat_interval)
@@ -271,6 +271,11 @@ def launch(ctx: Context) -> int:
     restarts = 0
     try:
         while True:
+            # one span per restart epoch: the elastic trajectory of a
+            # crash-looping job reads straight out of the trace
+            ep_sp = _tr.start_span("launch.epoch", parent=None,
+                                   epoch=epoch, restarts=restarts,
+                                   node=ctx.node_rank)
             elastic.register(epoch)
             pod = PodController(ctx)
             pod.start(restart_epoch=epoch)
@@ -291,26 +296,38 @@ def launch(ctx: Context) -> int:
                     time.sleep(0.2)
             except KeyboardInterrupt:
                 pod.stop(signal.SIGINT)
+                ep_sp.end(status="interrupted")
                 return 130
             if not peer_restart and rc == 0:
                 # success is only final if no peer failed concurrently —
                 # otherwise join the restart so the peers' epoch barrier
                 # (and, on node 0, the store we host) stays alive
                 if not elastic.restart_requested(epoch):
+                    ep_sp.end(status="ok")
                     return 0
                 peer_restart = True
             restarts += 1  # counted identically on every node
             if peer_restart:
+                ep_sp.event("peer_restart")
                 print("[launch] peer pod failed, joining pod-wide restart "
                       f"{restarts}/{ctx.max_restart}", file=sys.stderr)
             else:
+                ep_sp.event("pod_exit", rc=rc)
                 print(f"[launch] pod failed (exit {rc}), restart "
                       f"{restarts}/{ctx.max_restart}", file=sys.stderr)
                 pod.tail_logs()
                 elastic.request_restart(epoch)
             pod.stop()
             if restarts > ctx.max_restart:
+                ep_sp.end(status="failed")
+                # budget exhausted: leave the epoch/restart trajectory
+                # on disk next to the worker logs
+                _tr.flight_dump(
+                    path=os.path.join(ctx.log_dir,
+                                      f"flight_{os.getpid()}.json"),
+                    reason="restart_budget_exhausted")
                 break
+            ep_sp.end(status="restart")
             delay = restart_delay(restarts, ctx.restart_backoff_s,
                                   ctx.restart_backoff_max_s)
             if delay > 0:

@@ -21,6 +21,7 @@ from .._grad_mode import no_grad
 from ..framework import faults as _faults
 from ..framework.flags import flag_value as _fv
 from ..observability import metrics as _obsm
+from ..observability import tracing as _obstr
 
 
 class DecodeWedgedError(RuntimeError):
@@ -540,6 +541,7 @@ class ContinuousBatchingPredictor:
                 and (_use_pallas() or pallas_interpret()))
         self.use_ragged = bool(use_ragged)
         self._ready = False
+        self._req_seq = 0   # process-unique request ids across calls
 
     # ------------------------------------------------------- jitted core --
     def _ensure_ready(self):
@@ -742,6 +744,8 @@ class ContinuousBatchingPredictor:
         results = [None] * len(prompts)
         status = ["queued"] * len(prompts)
         self.last_status = status
+        # deadline validation precedes span creation: raising after
+        # start_span would leak the spans open in the flight recorder
         if deadline_s is None:
             deadlines = None
         else:
@@ -752,6 +756,18 @@ class ContinuousBatchingPredictor:
                     f"deadline_s has {len(per_req)} entries for "
                     f"{len(prompts)} prompts")
             deadlines = [t_gen + float(d) for d in per_req]
+        # tracing: one trace per request — every span/event below is a
+        # no-op NULL_SPAN method when telemetry is disabled
+        gen_sp = _obstr.start_span("serve.generate", parent=None,
+                                   n_prompts=len(prompts),
+                                   max_new_tokens=max_new_tokens)
+        req_sp = []
+        for r, p in enumerate(prompts):
+            self._req_seq += 1
+            req_sp.append(_obstr.start_span(
+                "serve.request", parent=gen_sp,
+                request_id=f"req{self._req_seq}", idx=r,
+                prompt_len=len(p)))
         queue = []
         for r, p in enumerate(prompts):
             need = -(-(len(p) + max_new_tokens) // self.page)
@@ -766,14 +782,21 @@ class ContinuousBatchingPredictor:
                     f"{self.capacity}")
             else:
                 queue.append(r)
+                req_sp[r].event("queued")
                 continue
             if strict:
+                for sp in req_sp:
+                    if not sp.ended:
+                        sp.end(status="error:unservable")
+                gen_sp.end(status="error:unservable")
                 raise ValueError(
                     f"request {r} can never be served: {detail}. Raise "
                     "max_seq_len/num_pages, shorten the prompt, or pass "
                     "strict=False to reject it and serve the rest.")
             results[r] = []
             status[r] = "rejected_" + kind
+            req_sp[r].event("rejected", reason=kind)
+            req_sp[r].end(status="rejected_" + kind)
             self._m_rej.inc(reason=kind)
             self._m_done.inc(status="rejected_" + kind)
 
@@ -791,6 +814,8 @@ class ContinuousBatchingPredictor:
                 r = queue.pop(pos)
                 results[r] = []
                 status[r] = "shed"
+                req_sp[r].event("shed", policy=self.shed_policy)
+                req_sp[r].end(status="shed")
                 self.stats["shed_requests"] += 1
                 self._m_shed.inc(policy=self.shed_policy)
                 self._m_done.inc(status="shed")
@@ -813,6 +838,11 @@ class ContinuousBatchingPredictor:
             r = slot_req[b]
             results[r] = slot_new[b]
             status[r] = status_val
+            if status_val == "ok":
+                req_sp[r].event("finish", tokens=len(slot_new[b]))
+            else:
+                req_sp[r].event(status_val, tokens=len(slot_new[b]))
+            req_sp[r].end(status=status_val)
             self.pool.release(slot_pages[b])
             slot_req[b], slot_pages[b], slot_new[b] = -1, [], []
             tables[b, :] = self._trash
@@ -837,6 +867,8 @@ class ContinuousBatchingPredictor:
                     queue.pop(pos)
                     results[r] = []
                     status[r] = "deadline"
+                    req_sp[r].event("deadline", stage="queued")
+                    req_sp[r].end(status="deadline")
                     self.stats["deadline_evictions"] += 1
                     self._m_deadline.inc(stage="queued")
                     self._m_done.inc(status="deadline")
@@ -910,6 +942,8 @@ class ContinuousBatchingPredictor:
             if builder is not None:
                 builder.set_slot(b, tables[b], L + 1)
             status[r] = "running"
+            req_sp[r].event("admitted", slot=b)
+            req_sp[r].event("first_token")
             self._m_adm.inc()
             self._m_ttft.observe(_time.perf_counter() - t_gen)
             if (self.eos_token_id is not None
@@ -954,6 +988,14 @@ class ContinuousBatchingPredictor:
                         if p["next"] is None and p["covered"] > 0]
             misses = [p for p in plans
                       if p["next"] is None and p["covered"] == 0]
+            pf_sp = _obstr.start_span(
+                "serve.prefill", parent=gen_sp, n=len(plans),
+                hits=len(hits), partial=len(partials),
+                misses=len(misses))
+            for plan in plans:
+                req_sp[plan["r"]].event(
+                    "prefill", covered=plan["covered"],
+                    reused=plan["reused"])
             firsts = {}
 
             for plan in hits:
@@ -982,6 +1024,7 @@ class ContinuousBatchingPredictor:
 
             if plans:
                 self._m_prefill.observe(_time.perf_counter() - t0)
+            pf_sp.end()
             b_i = iter(free)
             for plan in plans:
                 place(next(b_i), plan, firsts[plan["r"]])
@@ -1026,7 +1069,7 @@ class ContinuousBatchingPredictor:
                 try:
                     self._resolve_step(prev, slot_req, slot_new,
                                        last_tok_host, max_new_tokens,
-                                       evict)
+                                       evict, req_sp)
                 except DecodeWedgedError:
                     # wedged decode: fail everything still pending
                     # instead of hanging generate(). Pages of the
@@ -1041,12 +1084,22 @@ class ContinuousBatchingPredictor:
                             results[r] = slot_new[b]
                             status[r] = "watchdog"
                             slot_req[b] = -1
+                            req_sp[r].event("watchdog", stage="decoding",
+                                            tokens=len(slot_new[b]))
+                            req_sp[r].end(status="watchdog")
                             self._m_done.inc(status="watchdog")
                     for r in queue:
                         results[r] = []
                         status[r] = "watchdog"
+                        req_sp[r].event("watchdog", stage="queued")
+                        req_sp[r].end(status="watchdog")
                         self._m_done.inc(status="watchdog")
                     queue.clear()
+                    gen_sp.event("decode_wedged")
+                    gen_sp.end(status="watchdog")
+                    # crash-time forensics: the dump carries the wedged
+                    # requests' spans (which phase each was in)
+                    _obstr.flight_dump(reason="decode_wedged")
                     break
             elif cur is None:
                 break
@@ -1057,6 +1110,10 @@ class ContinuousBatchingPredictor:
                 if status[r] in ("queued", "running"):
                     status[r] = "incomplete"
                     self._m_done.inc(status="incomplete")
+        for r, sp in enumerate(req_sp):
+            if not sp.ended:  # stragglers (defensive path above)
+                sp.end(status=status[r])
+        gen_sp.end()
         return results
 
     # ---------------------------------------------------- admission ops --
@@ -1173,7 +1230,7 @@ class ContinuousBatchingPredictor:
         return {"tok": nxt, "done": done, "snap": snap, "t": t0}
 
     def _resolve_step(self, step, slot_req, slot_new, last_tok_host,
-                      max_new_tokens, evict):
+                      max_new_tokens, evict, req_sp=None):
         """Sync a PREVIOUSLY dispatched step (the next one is already in
         flight) and apply its tokens: append, detect completion, evict.
         Slots that were recycled since the dispatch are skipped — their
@@ -1217,6 +1274,10 @@ class ContinuousBatchingPredictor:
             t = int(nxt[b])
             slot_new[b].append(t)
             last_tok_host[b] = t
+            if req_sp is not None:
+                # decode tick: per-token latency reconstructable from
+                # consecutive event timestamps (capped per span)
+                req_sp[r].event("token", i=len(slot_new[b]))
             if bool(done[b]):        # eos computed on device
                 slot_new[b].pop()    # parity: eos is stripped
                 evict(b)

@@ -14,6 +14,11 @@ bytes, queue depths, latency quantiles, memory watermarks).
     obs.histogram("serving.ttft_seconds").observe(0.031)
     print(obs.PrometheusExporter().render())
 
+    with obs.span("myapp.handle", request_id="r1") as sp:
+        sp.event("admitted")                      # structured tracing:
+        ...                                       # spans + flight
+    obs.flight_dump(reason="debug")               # recorder (tracing.py)
+
     obs.enabled(False)    # every record becomes an early-return and
                           # jit_callback emits NOTHING when tracing
 
@@ -37,7 +42,12 @@ from .exporters import (  # noqa: F401
 )
 from .runtime import (  # noqa: F401
     jit_callback, device_memory_stats, configure, maybe_export,
-    telemetry_path, RankHeartbeat,
+    export_record, telemetry_path, RankHeartbeat,
+)
+from .tracing import (  # noqa: F401
+    Span, NULL_SPAN, span, start_span, traced, current_span,
+    FlightRecorder, flight_recorder, flight_dump, flight_dir,
+    set_flight_dir, to_chrome_trace, write_chrome_trace,
 )
 
 __all__ = [
@@ -45,5 +55,9 @@ __all__ = [
     "DEFAULT_BUCKETS", "enabled", "scoped", "get_registry", "counter",
     "gauge", "histogram", "JsonlExporter", "PrometheusExporter",
     "TensorBoardExporter", "jit_callback", "device_memory_stats",
-    "configure", "maybe_export", "telemetry_path", "RankHeartbeat",
+    "configure", "maybe_export", "export_record", "telemetry_path",
+    "RankHeartbeat", "Span", "NULL_SPAN", "span", "start_span",
+    "traced", "current_span", "FlightRecorder", "flight_recorder",
+    "flight_dump", "flight_dir", "set_flight_dir", "to_chrome_trace",
+    "write_chrome_trace",
 ]

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -37,30 +38,52 @@ class JsonlExporter:
     def __init__(self, path: str, registry: Optional[MetricRegistry] = None):
         self.path = path
         self._registry = registry or get_registry()
+        self._lock = threading.Lock()  # span ends vs step exports race
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", buffering=1)
 
     def export(self, step: Optional[int] = None, extra: Optional[dict] = None):
         ts = time.time()
+        lines = []
         for s in self._registry.collect():
             rec = {"ts": round(ts, 6), "step": step}
             rec.update(s.as_dict())
             if extra:
                 rec.update(extra)
-            self._f.write(json.dumps(rec) + "\n")
+            lines.append(json.dumps(rec))
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write("\n".join(lines) + "\n" if lines else "")
 
     def write_record(self, rec: dict):
-        """Escape hatch for one-off records (bench.py run metadata) that
-        share the telemetry file but aren't registry series."""
-        self._f.write(json.dumps(rec) + "\n")
+        """Escape hatch for one-off records (bench.py run metadata,
+        tracing span lines) that share the telemetry file but aren't
+        registry series. Silent no-op once closed — late writers at
+        interpreter teardown must not explode."""
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line)
 
     def flush(self):
-        self._f.flush()
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
 
     def close(self):
+        """Flush and close the file; idempotent (second close and any
+        subsequent export/write_record are no-ops), so the atexit hook
+        and an explicit configure(None) can both run."""
+        with self._lock:
+            f, self._f = self._f, None
+        if f is None:
+            return
         try:
-            self._f.close()
+            f.flush()
+            f.close()
         except Exception:
             pass
 

@@ -19,7 +19,8 @@ from typing import Callable, Optional
 from .metrics import enabled, get_registry
 
 __all__ = ["jit_callback", "device_memory_stats", "configure",
-           "maybe_export", "telemetry_path", "RankHeartbeat"]
+           "maybe_export", "export_record", "telemetry_path",
+           "RankHeartbeat"]
 
 
 def jit_callback(fn: Callable, *traced_args):
@@ -83,6 +84,18 @@ class _Sink:
 
 
 _sink = _Sink()
+_atexit_registered = False
+
+
+def _close_sink_at_exit():
+    """Interpreter-teardown flush: the last partial snapshot (or span)
+    written just before exit must reach disk even when the owner never
+    called configure(None). JsonlExporter.close() is idempotent, so a
+    sink closed earlier by hand is a no-op here."""
+    with _Sink.lock:
+        exp, _sink.exporter = _sink.exporter, None
+    if exp is not None:
+        exp.close()
 
 
 def configure(jsonl_path: Optional[str] = None, every: int = 1):
@@ -90,8 +103,10 @@ def configure(jsonl_path: Optional[str] = None, every: int = 1):
 
     Instrumented hot paths call `maybe_export(step=...)` once per step;
     with a sink configured that appends one registry snapshot every
-    `every` calls. Env default: PADDLE_TPU_TELEMETRY_JSONL.
+    `every` calls. Env default: PADDLE_TPU_TELEMETRY_JSONL. The sink is
+    flushed and closed at interpreter exit (atexit) if still attached.
     """
+    global _atexit_registered
     from .exporters import JsonlExporter
     with _Sink.lock:
         if _sink.exporter is not None:
@@ -101,6 +116,10 @@ def configure(jsonl_path: Optional[str] = None, every: int = 1):
             _sink.exporter = JsonlExporter(jsonl_path)
         _sink.every = max(1, int(every))
         _sink._calls = 0
+    if not _atexit_registered:
+        _atexit_registered = True
+        import atexit
+        atexit.register(_close_sink_at_exit)
 
 
 def telemetry_path() -> Optional[str]:
@@ -134,6 +153,21 @@ def maybe_export(step: Optional[int] = None):
         if (_sink._calls % _sink.every) != 0:
             return
         exp.export(step=step)
+
+
+def export_record(rec: dict):
+    """Write one raw record (span lines, one-off run metadata) through
+    the process JSONL sink; silent no-op without a sink. This is how
+    tracing.Span.end lands `{"kind": "span"}` lines in the same file as
+    the metric samples."""
+    if not enabled():
+        return
+    _ensure_env_sink()
+    with _Sink.lock:
+        exp = _sink.exporter
+        if exp is None:
+            return
+        exp.write_record(rec)
 
 
 # ---------------------------------------------------------- heartbeat ------

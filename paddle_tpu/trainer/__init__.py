@@ -229,9 +229,15 @@ class Trainer:
         restoration when train() returns — installing a Trainer must
         not permanently clobber the process's signal handling."""
         self._prev_handlers = {}
+        self._flight_reason = None
 
         def handler(signum, frame):
             self._preempted = True  # acted on at the next step boundary
+            # crash-time forensics are deferred to that boundary:
+            # dumping here would take the flight-recorder/registry
+            # locks the interrupted main thread may already hold
+            # (non-reentrant -> self-deadlock inside a signal handler)
+            self._flight_reason = f"signal_{signum}"
             prev = self._prev_handlers.get(signum)
             if callable(prev) and prev is not signal.default_int_handler:
                 prev(signum, frame)  # chain (but not KeyboardInterrupt)
@@ -253,14 +259,15 @@ class Trainer:
         self._prev_handlers = {}
 
     # -------------------------------------------------------- anomaly guard --
-    def _guard_check(self, step: int, loss) -> bool:
+    def _guard_check(self, step: int, loss, parent=None) -> bool:
         """Sync one step's loss and classify it. Returns True when the
         step is anomalous (NaN/Inf, or a spike vs the rolling mean of
         recent good losses). Consecutive anomalies beyond
         FLAGS_max_anomalous_steps abort with AnomalousTrainingError.
         Called at most once per step (the `nan_loss` fault site is
         consumed here, one check per step)."""
-        lv = float(loss)
+        with _obs.span("train.loss_sync", parent=parent, step=step + 1):
+            lv = float(loss)
         fa = _faults.check("nan_loss", step=step)
         if fa is not None:
             lv = float("inf") if fa.mode == "inf" else float("nan")
@@ -275,12 +282,16 @@ class Trainer:
             self._anom_consec += 1
             self._anom_total += 1
             _obs.counter("robustness.anomalies_skipped").inc(reason=reason)
+            _obs.start_span("train.anomaly_skip", parent=None,
+                            step=step + 1, reason=reason,
+                            consecutive=self._anom_consec).end()
             self._log({"anomalous_step": step + 1, "loss": lv,
                        "reason": reason,
                        "consecutive": self._anom_consec})
             limit = int(_fv("max_anomalous_steps"))
             if self._anom_consec >= limit:
                 last_ok = self._ckpt_mgr().latest_verified()
+                _obs.flight_dump(reason="anomalous_training")
                 raise AnomalousTrainingError(
                     f"aborting after {self._anom_consec} consecutive "
                     f"anomalous steps (last loss {lv!r} at step "
@@ -325,13 +336,21 @@ class Trainer:
         data = self.data_iter_fn(start_step)
         t_start = time.perf_counter()
         for step in range(start_step, args.max_steps):
+            # step phase spans (data/dispatch/loss-sync/anomaly-skip):
+            # one trace per step, reconstructable as a waterfall by
+            # tools/trace_report.py. All no-ops when telemetry is off.
+            st_sp = _obs.start_span("train.step", parent=None,
+                                    step=step + 1)
             fa = _faults.check("slow_step", step=step)
             if fa is not None:
                 time.sleep(float(fa.params.get("sleep", 0.05)))
-            batch = next(data)
+            with _obs.span("train.data", parent=st_sp, step=step + 1):
+                batch = next(data)
             if not isinstance(batch, (tuple, list)):
                 batch = (batch,)
-            loss = self._step_obj(*batch)
+            with _obs.span("train.dispatch", parent=st_sp,
+                           step=step + 1):
+                loss = self._step_obj(*batch)
             if _faults.check("sigterm", step=step) is not None:
                 os.kill(os.getpid(), signal.SIGTERM)  # -> preemption hook
             if self.tokens_per_batch:
@@ -348,13 +367,22 @@ class Trainer:
                 if pending is not None:
                     ps, pl = pending
                     pending = None
-                    self._guard_check(ps, pl)
+                    self._guard_check(ps, pl, parent=st_sp)
                 if log_b or save_b or last_b:
-                    step_anom = self._guard_check(step, loss)
+                    step_anom = self._guard_check(step, loss,
+                                                  parent=st_sp)
                 else:
                     pending = (step, loss)
             if log_b:
-                loss_val = float(loss)  # device sync at log boundary only
+                if guard:
+                    # the boundary guard check above already synced this
+                    # step's loss; a second span would double-count the
+                    # site for a free host read
+                    loss_val = float(loss)
+                else:
+                    with _obs.span("train.loss_sync", parent=st_sp,
+                                   step=step + 1):
+                        loss_val = float(loss)  # sync at log boundary only
                 rec = {"step": step + 1, "loss": round(loss_val, 6),
                        "tokens_per_sec": round(meter.tokens_per_sec, 2),
                        "mfu": round(meter.mfu, 4)}
@@ -381,7 +409,11 @@ class Trainer:
                              and pending is None)):
                 self._save(step + 1)
                 save_owed = False
+            st_sp.end(anomalous=step_anom)
             if self._preempted:
+                _obs.flight_dump(
+                    reason=getattr(self, "_flight_reason", None)
+                    or "preempted")
                 self._ckpt_mgr().wait()
                 self._log({"preempted_at": step + 1})
                 break

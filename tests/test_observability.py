@@ -330,3 +330,86 @@ class TestDistStepTelemetry:
         # no instrumentation object, no sink writes
         assert step._obs is None
         assert not os.path.exists(path) or not open(path).read().strip()
+
+
+# ---------------------------------------------------------------------------
+class TestRankHeartbeat:
+    def test_interval_nonpositive_disables(self, tmp_path):
+        p = str(tmp_path / "hb.jsonl")
+        hb = obs.RankHeartbeat(p, interval=0)
+        assert hb.due() is False
+        assert hb.beat(rank=0) is False
+        hb.close()
+        assert not os.path.exists(p)  # disabled: file never created
+        hb2 = obs.RankHeartbeat(p, interval=-1.0)
+        assert hb2.beat() is False and not os.path.exists(p)
+
+    def test_due_gates_and_beat_throttles(self, tmp_path):
+        p = str(tmp_path / "hb.jsonl")
+        hb = obs.RankHeartbeat(p, interval=60.0)
+        assert hb.due() is True           # first beat always due
+        assert hb.beat(rank=3, phase="x") is True
+        assert hb.due() is False          # within the interval
+        assert hb.beat(rank=3) is False   # throttled, nothing written
+        hb.close()
+        recs = [json.loads(line) for line in open(p)]
+        assert len(recs) == 1
+        assert recs[0]["kind"] == "heartbeat"
+        assert recs[0]["rank"] == 3 and recs[0]["phase"] == "x"
+
+    def test_zero_interval_via_close_and_write_failure(self, tmp_path):
+        p = str(tmp_path / "hb.jsonl")
+        hb = obs.RankHeartbeat(p, interval=0.0)
+        assert hb._f is None              # no fd held while disabled
+        hb.close()                        # close on disabled: no-op
+        hb2 = obs.RankHeartbeat(str(tmp_path / "hb2.jsonl"),
+                                interval=1e-9)
+        hb2._f.close()                    # simulate a torn-down fd
+        assert hb2.beat(rank=0) is False  # write failure -> False
+        hb2._f = None                     # avoid double close
+        hb2.close()
+
+
+class TestSinkLifecycle:
+    def test_configure_swap_under_active_sink(self, tmp_path):
+        p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        obs.configure(p1)
+        first = obs.get_registry().counter("sw.x")
+        first.inc()
+        obs.maybe_export(step=1)
+        obs.configure(p2)                 # swap closes the old exporter
+        assert obs.telemetry_path() == p2
+        first.inc()
+        obs.maybe_export(step=2)
+        obs.configure(None)               # detach
+        assert obs.telemetry_path() is None
+        obs.maybe_export(step=3)          # no sink: silent no-op
+        steps1 = {json.loads(l)["step"] for l in open(p1)}
+        steps2 = {json.loads(l)["step"] for l in open(p2)}
+        assert 1 in steps1 and 2 not in steps1
+        assert 2 in steps2 and 1 not in steps2
+
+    def test_jsonl_close_idempotent_and_late_writes_noop(self, tmp_path):
+        p = str(tmp_path / "c.jsonl")
+        e = obs.JsonlExporter(p)
+        e.write_record({"kind": "x", "v": 1})
+        e.close()
+        e.close()                         # second close: no-op
+        e.write_record({"kind": "x", "v": 2})  # after close: dropped
+        e.export(step=9)
+        e.flush()
+        recs = [json.loads(l) for l in open(p)]
+        assert [r["v"] for r in recs] == [1]
+
+    def test_atexit_hook_flushes_pending_sink(self, tmp_path):
+        """The registered atexit hook closes a still-attached sink, so
+        the final partial snapshot reaches disk on teardown."""
+        from paddle_tpu.observability import runtime as rt
+        p = str(tmp_path / "exit.jsonl")
+        obs.configure(p)
+        obs.get_registry().counter("exit.x").inc()
+        obs.maybe_export(step=1)
+        rt._close_sink_at_exit()          # what atexit will run
+        assert rt.telemetry_path() is None
+        assert any(json.loads(l)["name"] == "exit.x" for l in open(p))
+        rt._close_sink_at_exit()          # idempotent on empty state

@@ -1,0 +1,496 @@
+"""Structured tracing + flight recorder (PR 5 tentpole): span API
+semantics, zero-cost disabled mode (nothing enters jitted programs), a
+traced serve-style run round-tripped through the JSONL sink and
+reconstructed by tools/trace_report.py, Chrome-trace export, trainer
+step-phase spans, and the flight dump on an injected decode_wedge
+fault."""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import tracing as tr
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Isolated sink + flight ring per test; faults disarmed after."""
+    obs.configure(None)
+    tr.flight_recorder().clear()
+    tr.set_flight_dir(None)
+    yield
+    obs.configure(None)
+    obs.enabled(True)
+    tr.flight_recorder().clear()
+    tr.set_flight_dir(None)
+    paddle.set_flags({"fault_injection": ""})
+
+
+def _spans(path):
+    out = []
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("kind") == "span":
+            out.append(rec)
+    return out
+
+
+def _tools(name):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import importlib
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+class TestSpanAPI:
+    def test_nesting_propagates_trace_and_parent(self):
+        with tr.span("outer", k="v") as sp:
+            assert tr.current_span() is sp
+            with tr.span("inner") as inner:
+                assert inner.trace_id == sp.trace_id
+                assert inner.parent_id == sp.span_id
+                assert tr.current_span() is inner
+            assert tr.current_span() is sp
+        assert tr.current_span() is None
+        ring = tr.flight_recorder().spans()
+        assert [s["name"] for s in ring] == ["inner", "outer"]
+        assert ring[1]["labels"] == {"k": "v"}
+
+    def test_explicit_spans_interleave(self):
+        a = tr.start_span("req", parent=None, request_id="a")
+        b = tr.start_span("req", parent=None, request_id="b")
+        assert a.trace_id != b.trace_id       # separate traces
+        a.event("tick", i=1)
+        b.event("tick", i=1)
+        a.event("tick", i=2)
+        b.end(status="ok")
+        a.end(status="deadline")
+        by_id = {s["labels"]["request_id"]: s
+                 for s in tr.flight_recorder().spans()}
+        assert len(by_id["a"]["events"]) == 2
+        assert by_id["a"]["status"] == "deadline"
+        assert by_id["b"]["status"] == "ok"
+
+    def test_end_is_idempotent_and_event_after_end_dropped(self):
+        sp = tr.start_span("x", parent=None)
+        sp.end()
+        d0 = sp.dur
+        sp.event("late")
+        sp.end(status="other")
+        assert sp.dur == d0 and sp.status == "ok"
+        assert len(tr.flight_recorder().spans()) == 1
+
+    def test_event_cap_counts_drops(self):
+        sp = tr.start_span("x", parent=None)
+        for i in range(tr._MAX_EVENTS + 10):
+            sp.event("e", i=i)
+        sp.end()
+        rec = tr.flight_recorder().spans()[0]
+        assert len(rec["events"]) == tr._MAX_EVENTS
+        assert rec["dropped_events"] == 10
+
+    def test_exception_in_context_sets_error_status(self):
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("nope")
+        (rec,) = tr.flight_recorder().spans()
+        assert rec["status"] == "error:RuntimeError"
+        assert rec["events"][0]["name"] == "exception"
+
+    def test_traced_decorator(self):
+        @tr.traced
+        def f(x):
+            return x + 1
+
+        @tr.traced("named.op", kind="test")
+        def g(x):
+            return x * 2
+
+        assert f(1) == 2 and g(2) == 4
+        names = [s["name"] for s in tr.flight_recorder().spans()]
+        assert any("f" in n for n in names)
+        assert "named.op" in names
+
+    def test_thread_local_isolation(self):
+        seen = {}
+
+        def worker():
+            seen["inside"] = tr.current_span()
+
+        with tr.span("main-only"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["inside"] is None  # other thread sees no context
+
+
+# ---------------------------------------------------------------------------
+class TestDisabledMode:
+    def test_all_entry_points_return_null_span(self):
+        with obs.scoped(False):
+            assert tr.span("a") is tr.NULL_SPAN
+            assert tr.start_span("b", x=1) is tr.NULL_SPAN
+            with tr.span("c") as sp:
+                sp.event("e").set_label(k=1).end()
+        assert tr.flight_recorder().spans() == []
+        assert tr.flight_recorder().open_spans() == []
+
+    def test_tracing_adds_zero_ops_to_jitted_programs(self):
+        """Spans are pure host-side bookkeeping: the jaxpr of a span-
+        instrumented function is identical to the uninstrumented one —
+        enabled OR disabled (the tentpole acceptance bar)."""
+        import jax
+        import jax.numpy as jnp
+
+        def plain(x):
+            return (x * 2.0).sum()
+
+        def instrumented(x):
+            with tr.span("traced.block", step=1) as sp:
+                sp.event("mid")
+                return (x * 2.0).sum()
+
+        x = jnp.ones((4,))
+        j_plain = jax.make_jaxpr(plain)(x)
+        with obs.scoped(True):
+            j_on = jax.make_jaxpr(instrumented)(x)
+        with obs.scoped(False):
+            j_off = jax.make_jaxpr(instrumented)(x)
+        assert len(j_on.eqns) == len(j_plain.eqns)
+        assert len(j_off.eqns) == len(j_plain.eqns)
+        assert "callback" not in str(j_on)
+
+    def test_disabled_sink_gets_no_span_lines(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        obs.configure(p)
+        with obs.scoped(False):
+            tr.start_span("x", parent=None).end()
+        obs.configure(None)
+        assert not os.path.exists(p) or _spans(p) == []
+
+
+# ---------------------------------------------------------------------------
+def _serve_model():
+    paddle.seed(0)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _prompts(n, lens=(5, 9, 12, 7)):
+    rng = np.random.RandomState(0)
+    return [rng.randint(2, 256, (lens[i % len(lens)],)).tolist()
+            for i in range(n)]
+
+
+class TestServeTraceRoundTrip:
+    def test_request_reconstructable_end_to_end(self, tmp_path):
+        """The acceptance criterion: one serving request reconstructs
+        queued → admitted → prefill → N decode ticks → finish from a
+        single telemetry JSONL via tools/trace_report.py."""
+        from paddle_tpu.inference import ContinuousBatchingPredictor
+        path = str(tmp_path / "telemetry.jsonl")
+        obs.configure(path)
+        cb = ContinuousBatchingPredictor(_serve_model(), max_batch_size=2,
+                                         page_size=8, max_seq_len=64)
+        outs = cb.generate(_prompts(3), max_new_tokens=4)
+        obs.configure(None)
+        assert all(len(o) == 4 for o in outs)
+
+        spans = _spans(path)
+        reqs = [s for s in spans if s["name"] == "serve.request"]
+        assert len(reqs) == 3
+        gen = [s for s in spans if s["name"] == "serve.generate"]
+        assert len(gen) == 1
+        for s in reqs:
+            assert s["status"] == "ok"
+            assert s["parent"] == gen[0]["span"]
+            assert s["trace"] == gen[0]["trace"]
+            names = [e["name"] for e in s["events"]]
+            # full lifecycle, in order
+            for a, b in zip(["queued", "prefill", "admitted",
+                             "first_token", "token", "finish"],
+                            ["prefill", "admitted", "first_token",
+                             "token", "finish", None]):
+                assert a in names
+                if b is not None:
+                    assert names.index(a) < names.index(b)
+            # 4 tokens = first_token + 3 decode ticks
+            assert names.count("token") == 3
+            ts = [e["ts"] for e in s["events"]]
+            assert ts == sorted(ts)
+        assert any(s["name"] == "serve.prefill" for s in spans)
+
+        trace_report = _tools("trace_report")
+        loaded = trace_report.load_spans(path)
+        assert len(loaded) == len(spans)
+        text = trace_report.render(loaded)
+        assert "TTFT" in text and "per-token" in text
+        assert "request e2e" in text
+        rid = reqs[0]["labels"]["request_id"]
+        assert rid in text
+        timeline = trace_report.render(loaded, request_id=rid)
+        assert "first_token" in timeline and "finish" in timeline
+
+    def test_chrome_trace_json_loads(self, tmp_path):
+        from paddle_tpu.inference import ContinuousBatchingPredictor
+        path = str(tmp_path / "telemetry.jsonl")
+        obs.configure(path)
+        cb = ContinuousBatchingPredictor(_serve_model(), max_batch_size=2,
+                                         page_size=8, max_seq_len=64)
+        cb.generate(_prompts(2), max_new_tokens=2)
+        obs.configure(None)
+        out = str(tmp_path / "chrome.json")
+        trace_report = _tools("trace_report")
+        assert trace_report.main([path, "--chrome", out]) == 0
+        doc = json.load(open(out))
+        evs = doc["traceEvents"]
+        assert evs and all(e["ph"] in ("X", "i") for e in evs)
+        x = [e for e in evs if e["ph"] == "X"]
+        assert {"serve.request", "serve.generate"} <= \
+            {e["name"] for e in x}
+        assert all(e["dur"] >= 0 and e["ts"] > 0 for e in x)
+        # in-process exporter agrees on the schema
+        doc2 = obs.to_chrome_trace(_spans(path))
+        assert {e["name"] for e in doc2["traceEvents"]} == \
+            {e["name"] for e in evs}
+
+    def test_outcome_statuses_in_spans(self, tmp_path):
+        """Shed + rejected outcomes land as span events/status."""
+        from paddle_tpu.inference import ContinuousBatchingPredictor
+        path = str(tmp_path / "telemetry.jsonl")
+        obs.configure(path)
+        cb = ContinuousBatchingPredictor(_serve_model(), max_batch_size=2,
+                                         page_size=8, max_seq_len=64,
+                                         max_queue=2)
+        overlong = [2] * 61          # 61 + 4 new > max_seq_len 64
+        cb.generate(_prompts(4) + [overlong], max_new_tokens=4,
+                    strict=False)
+        obs.configure(None)
+        by_status = {}
+        for s in _spans(path):
+            if s["name"] == "serve.request":
+                by_status.setdefault(s["status"], []).append(s)
+        assert "shed" in by_status
+        assert "rejected_over_max_seq_len" in by_status
+        assert "ok" in by_status
+        shed = by_status["shed"][0]
+        assert any(e["name"] == "shed" for e in shed["events"])
+
+    def test_metrics_report_skips_span_lines(self, tmp_path):
+        """Satellite: existing metric views must not be polluted by
+        span lines, and the new spans view renders them."""
+        from paddle_tpu.inference import ContinuousBatchingPredictor
+        path = str(tmp_path / "telemetry.jsonl")
+        obs.configure(path)
+        cb = ContinuousBatchingPredictor(_serve_model(), max_batch_size=2,
+                                         page_size=8, max_seq_len=64)
+        cb.generate(_prompts(2), max_new_tokens=2)
+        obs.maybe_export(step=1)
+        obs.configure(None)
+        metrics_report = _tools("metrics_report")
+        spans_state = {}
+        last = metrics_report.parse(open(path), spans=spans_state)
+        # no metric key was created from a span line
+        assert all(not (k[0] or "").startswith("serve.request")
+                   for k in last)
+        for (name, _), rec in last.items():
+            assert rec.get("kind") != "span"
+        text = metrics_report.render(last, spans_state)
+        assert "== spans ==" in text
+        assert "serve.request" in text
+        assert "slowest requests" in text
+        # spans arg optional: legacy call signature still works
+        assert metrics_report.render(metrics_report.parse(open(path)))
+
+
+# ---------------------------------------------------------------------------
+class TestTrainerStepSpans:
+    def _run(self, tmp_path, path):
+        from paddle_tpu.trainer import Trainer, TrainingArguments
+        obs.configure(path)
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                              nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+
+        def data_fn(start):
+            def gen():
+                s = start
+                while True:
+                    rs = np.random.RandomState(s)
+                    yield (paddle.to_tensor(
+                               rs.randn(4, 8).astype(np.float32)),
+                           paddle.to_tensor(
+                               rs.randn(4, 4).astype(np.float32)))
+                    s += 1
+            return gen()
+
+        args = TrainingArguments(output_dir=str(tmp_path / "out"),
+                                 max_steps=4, logging_steps=2,
+                                 save_steps=2)
+        res = Trainer(model, opt, lambda o, y: F.mse_loss(o, y), args,
+                      data_fn).train(resume=False)
+        obs.configure(None)
+        return res
+
+    def test_step_phase_spans_and_waterfall(self, tmp_path):
+        path = str(tmp_path / "telemetry.jsonl")
+        res = self._run(tmp_path, path)
+        assert res["final_step"] == 4
+        spans = _spans(path)
+        steps = [s for s in spans if s["name"] == "train.step"]
+        assert len(steps) == 4
+        assert [s["labels"]["step"] for s in steps] == [1, 2, 3, 4]
+        for st in steps:
+            kids = [s for s in spans if s.get("parent") == st["span"]]
+            kid_names = {k["name"] for k in kids}
+            assert "train.data" in kid_names
+            assert "train.dispatch" in kid_names
+            assert all(k["trace"] == st["trace"] for k in kids)
+        # loss sync at the guard/log boundaries
+        assert any(s["name"] == "train.loss_sync" for s in spans)
+        # checkpoint saves traced (save_steps=2 -> steps 2 and 4)
+        saves = [s for s in spans if s["name"] == "ckpt.save"]
+        assert [s["labels"]["step"] for s in saves] == [2, 4]
+        assert all(s["status"] == "ok" for s in saves)
+
+        trace_report = _tools("trace_report")
+        text = trace_report.render(trace_report.load_spans(path))
+        assert "waterfall" in text
+        assert "train step" in text  # SLO row
+        assert "dispatch" in text
+
+    def test_ckpt_restore_spans(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import VerifiedCheckpointer
+        path = str(tmp_path / "telemetry.jsonl")
+        self._run(tmp_path, path)
+        obs.configure(path)
+        ckpt = VerifiedCheckpointer(str(tmp_path / "out" / "checkpoints"))
+        assert ckpt.restore_latest() is not None
+        obs.configure(None)
+        spans = _spans(path)
+        rl = [s for s in spans if s["name"] == "ckpt.restore_latest"]
+        assert rl and rl[-1]["status"] == "ok"
+        assert rl[-1]["labels"]["step"] == 4
+
+
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = tr.FlightRecorder(capacity=8)
+        old = tr._recorder
+        tr._recorder = rec
+        try:
+            for i in range(20):
+                tr.start_span("s", parent=None, i=i).end()
+        finally:
+            tr._recorder = old
+        got = rec.spans()
+        assert len(got) == 8
+        assert got[-1]["labels"]["i"] == 19  # newest survive
+
+    def test_dump_includes_open_spans_and_metrics(self, tmp_path):
+        obs.counter("fl.test").inc(3)
+        done = tr.start_span("done", parent=None)
+        done.end()
+        hung = tr.start_span("hung", parent=None, phase="claim")
+        p = str(tmp_path / "flight.json")
+        out = tr.flight_dump(path=p, reason="unit")
+        hung.end()
+        assert out == p
+        doc = json.load(open(p))
+        assert doc["reason"] == "unit"
+        assert any(s["name"] == "done" for s in doc["spans"])
+        (o,) = [s for s in doc["open_spans"] if s["name"] == "hung"]
+        assert o["open"] is True and o["labels"]["phase"] == "claim"
+        assert o["dur"] >= 0
+        assert "fl.test" in doc.get("metrics", {})
+
+    def test_dump_skips_when_empty_unless_forced(self, tmp_path):
+        p = str(tmp_path / "flight.json")
+        assert tr.flight_dump(path=p) is None
+        assert not os.path.exists(p)
+        assert tr.flight_dump(path=p, force=True) == p
+        assert json.load(open(p))["spans"] == []
+
+    def test_decode_wedge_fault_leaves_flight_dump(self, tmp_path):
+        """Acceptance criterion: an injected decode_wedge fault produces
+        a flight-recorder dump containing the wedged request's spans."""
+        from paddle_tpu.inference import ContinuousBatchingPredictor
+        tr.set_flight_dir(str(tmp_path))
+        paddle.set_flags({"fault_injection": "decode_wedge:sleep=5"})
+        cb = ContinuousBatchingPredictor(_serve_model(), max_batch_size=2,
+                                         page_size=8, max_seq_len=64,
+                                         decode_watchdog_s=0.25)
+        outs = cb.generate(_prompts(2), max_new_tokens=8)
+        assert cb.stats["watchdog_trips"] == 1
+        assert all(isinstance(o, list) for o in outs)
+        fpath = os.path.join(str(tmp_path), f"flight_{os.getpid()}.json")
+        assert os.path.exists(fpath)
+        doc = json.load(open(fpath))
+        assert doc["reason"] == "decode_wedged"
+        wedged = [s for s in doc["spans"]
+                  if s["name"] == "serve.request"
+                  and s["status"] == "watchdog"]
+        assert len(wedged) == 2
+        for s in wedged:
+            assert any(e["name"] == "watchdog" for e in s["events"])
+            assert any(e["name"] == "admitted" for e in s["events"])
+        # the injected fault itself is in the forensics
+        assert any(e["site"] == "decode_wedge"
+                   for e in doc.get("fault_events", []))
+        # and trace_report reads a flight dump directly
+        trace_report = _tools("trace_report")
+        text = trace_report.render(trace_report.load_spans(fpath))
+        assert "watchdog" in text
+
+    def test_anomaly_abort_dumps_flight(self, tmp_path):
+        from paddle_tpu.trainer import (Trainer, TrainingArguments,
+                                        AnomalousTrainingError)
+        tr.set_flight_dir(str(tmp_path))
+        paddle.set_flags({"fault_injection": "nan_loss:every=1",
+                          "max_anomalous_steps": 2})
+        try:
+            paddle.seed(0)
+            model = nn.Linear(4, 4)
+            opt = paddle.optimizer.Adam(
+                1e-2, parameters=model.parameters())
+
+            def data_fn(start):
+                def gen():
+                    while True:
+                        rs = np.random.RandomState(0)
+                        yield (paddle.to_tensor(
+                                   rs.randn(2, 4).astype(np.float32)),
+                               paddle.to_tensor(
+                                   rs.randn(2, 4).astype(np.float32)))
+                return gen()
+
+            args = TrainingArguments(output_dir=str(tmp_path / "o"),
+                                     max_steps=8, logging_steps=1,
+                                     save_steps=100)
+            with pytest.raises(AnomalousTrainingError):
+                Trainer(model, opt, lambda o, y: F.mse_loss(o, y),
+                        args, data_fn).train(resume=False)
+        finally:
+            paddle.set_flags({"max_anomalous_steps": 10})
+        fpath = os.path.join(str(tmp_path), f"flight_{os.getpid()}.json")
+        assert os.path.exists(fpath)
+        doc = json.load(open(fpath))
+        assert doc["reason"] == "anomalous_training"
+        assert any(s["name"] == "train.anomaly_skip"
+                   for s in doc["spans"])

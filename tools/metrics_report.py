@@ -34,11 +34,19 @@ def _fmt_si(n):
     return f"{n:.2f}"
 
 
-def parse(lines, last=None):
+_TOP_REQUESTS = 5
+
+
+def parse(lines, last=None, spans=None):
     """Merge samples into {(name, frozen_labels): last_record} —
     counters/histograms are cumulative, so the last sample per series
     carries the summary; no history is retained, and --follow feeds only
-    the appended lines, so a huge file stays O(series) per refresh."""
+    the appended lines, so a huge file stays O(series) per refresh.
+
+    `{"kind": "span"}` lines (tracing) are NOT metric samples — they
+    are skipped here and, when a `spans` state dict is passed, folded
+    into bounded per-site aggregates + a top-N slowest-request list for
+    the spans view (O(sites + N) memory however long the file)."""
     last = last if last is not None else {}
     for line in lines:
         line = line.strip()
@@ -48,12 +56,31 @@ def parse(lines, last=None):
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
+        if rec.get("kind") == "span":
+            if spans is not None:
+                _ingest_span(spans, rec)
+            continue
         name = rec.get("name")
         if not name:
             continue
         key = (name, tuple(sorted((rec.get("labels") or {}).items())))
         last[key] = rec
     return last
+
+
+def _ingest_span(spans, rec):
+    site = spans.setdefault("sites", {}).setdefault(
+        rec.get("name", "?"), {"count": 0, "sum": 0.0, "max": 0.0})
+    dur = float(rec.get("dur") or 0.0)
+    site["count"] += 1
+    site["sum"] += dur
+    site["max"] = max(site["max"], dur)
+    if rec.get("name") == "serve.request":
+        reqs = spans.setdefault("requests", [])
+        reqs.append((dur, (rec.get("labels") or {}).get("request_id", "?"),
+                     rec.get("status", "?")))
+        reqs.sort(key=lambda t: -t[0])
+        del reqs[_TOP_REQUESTS:]
 
 
 def _series(last, name):
@@ -67,7 +94,7 @@ def _one(last, name, default=None):
     return next(iter(s.values()))
 
 
-def render(last) -> str:
+def render(last, spans=None) -> str:
     out = []
     w = out.append
 
@@ -219,6 +246,20 @@ def render(last) -> str:
                      and rec.get("count") else "")
             w(f"  {key[0]}{lab_s:<24} {rec.get('value', 0):.6g}{extra}")
 
+    if spans and spans.get("sites"):
+        w("== spans ==")
+        w(f"  {'site':<24}{'count':>7}{'mean ms':>10}{'max ms':>10}")
+        for name in sorted(spans["sites"]):
+            st = spans["sites"][name]
+            mean = st["sum"] / st["count"] if st["count"] else 0.0
+            w(f"  {name:<24}{st['count']:>7}{mean * 1e3:>10.2f}"
+              f"{st['max'] * 1e3:>10.2f}")
+        if spans.get("requests"):
+            w("  slowest requests:")
+            for dur, rid, status in spans["requests"]:
+                w(f"    {rid:<12}{status:<12}{dur * 1e3:>10.2f}ms")
+        w("  (per-request timelines/waterfalls: tools/trace_report.py)")
+
     return "\n".join(out) if out else "(no telemetry samples)"
 
 
@@ -229,14 +270,14 @@ def main(argv=None) -> int:
                     help="re-render every --interval seconds")
     ap.add_argument("--interval", type=float, default=2.0)
     a = ap.parse_args(argv)
-    last, offset = {}, 0
+    last, spans, offset = {}, {}, 0
     while True:
         try:
             if os.path.getsize(a.path) < offset:
-                offset, last = 0, {}     # truncated/rotated: start over
+                offset, last, spans = 0, {}, {}  # truncated: start over
             with open(a.path) as f:
                 f.seek(offset)           # incremental: appended lines only
-                last = parse(f, last)
+                last = parse(f, last, spans)
                 offset = f.tell()
         except FileNotFoundError:
             print(f"(waiting for {a.path})" if a.follow
@@ -245,7 +286,7 @@ def main(argv=None) -> int:
                 return 1
             time.sleep(a.interval)
             continue
-        text = render(last)
+        text = render(last, spans)
         if a.follow:
             print("\x1b[2J\x1b[H" + text, flush=True)
             time.sleep(a.interval)

@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Reconstruct request/step timelines and SLO percentiles from spans.
+
+Reads the `{"kind": "span"}` lines that paddle_tpu.observability.tracing
+writes into the telemetry JSONL (same file as the metric samples) or a
+flight-recorder dump (flight_<pid>.json), and renders:
+
+- **SLO percentiles** — TTFT, per-token latency, end-to-end request
+  latency (from `serve.request` spans and their events) and train step
+  time (from `train.step` spans): p50 / p90 / p99 / max.
+- **Per-request timelines** — the slowest N requests with queue wait,
+  TTFT, token count, status; `--request ID` prints one request's full
+  event timeline (queued → admitted → prefill → decode ticks → finish).
+- **Per-step waterfalls** — train.step spans with their data / dispatch
+  / loss-sync child phases as aligned bars.
+- **Site table** — duration stats per span name (every instrumented
+  site: serve.*, train.*, ckpt.*, dist.compile, comm.*, launch.epoch,
+  bench.backend_init).
+
+    python tools/trace_report.py telemetry.jsonl
+    python tools/trace_report.py telemetry.jsonl --requests 10
+    python tools/trace_report.py telemetry.jsonl --request req3
+    python tools/trace_report.py flight_1234.json --chrome trace.json
+
+No paddle_tpu import needed — this runs anywhere there is a file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+# ---------------------------------------------------------------- loading --
+def load_spans(path: str) -> List[dict]:
+    """Spans from a telemetry JSONL file (kind == "span" lines) or a
+    flight-recorder dump (one JSON object with spans/open_spans)."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{":
+            try:
+                doc = json.load(f)
+                if isinstance(doc, dict) and "spans" in doc:
+                    return list(doc.get("spans") or []) + \
+                        list(doc.get("open_spans") or [])
+            except json.JSONDecodeError:
+                f.seek(0)
+        out = []
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "span":
+                out.append(rec)
+        return out
+
+
+def percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    pos = q * (len(ys) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ys) - 1)
+    frac = pos - lo
+    return ys[lo] * (1 - frac) + ys[hi] * frac
+
+
+def _pct_row(label: str, xs: List[float], unit_ms: bool = True) -> str:
+    scale = 1e3 if unit_ms else 1.0
+    u = "ms" if unit_ms else "s"
+    return (f"  {label:<18}n={len(xs):<6}"
+            f"p50 {percentile(xs, 0.5) * scale:8.2f}{u}  "
+            f"p90 {percentile(xs, 0.9) * scale:8.2f}{u}  "
+            f"p99 {percentile(xs, 0.99) * scale:8.2f}{u}  "
+            f"max {max(xs) * scale:8.2f}{u}")
+
+
+# ---------------------------------------------------------------- analysis --
+def _event(span: dict, name: str) -> Optional[dict]:
+    for e in span.get("events") or []:
+        if e.get("name") == name:
+            return e
+    return None
+
+
+class Request:
+    """One serve.request span decoded into SLO-relevant timings."""
+
+    def __init__(self, span: dict):
+        self.span = span
+        self.id = (span.get("labels") or {}).get("request_id", "?")
+        self.prompt_len = (span.get("labels") or {}).get("prompt_len")
+        self.status = span.get("status", "?")
+        self.start = float(span.get("start", 0.0))
+        self.e2e = float(span.get("dur") or 0.0)
+        adm = _event(span, "admitted")
+        self.queue_wait = (adm["ts"] - self.start) if adm else None
+        ft = _event(span, "first_token")
+        self.ttft = (ft["ts"] - self.start) if ft else None
+        toks = [e["ts"] for e in span.get("events") or []
+                if e.get("name") == "token"]
+        if ft:
+            toks = [ft["ts"]] + toks
+        self.token_times = toks
+        fin = _event(span, "finish")
+        self.tokens = fin.get("tokens") if fin else (
+            len(toks) if toks else None)
+
+    @property
+    def per_token(self) -> List[float]:
+        ts = self.token_times
+        return [b - a for a, b in zip(ts, ts[1:])]
+
+
+def analyze(spans: List[dict]) -> dict:
+    reqs = [Request(s) for s in spans if s.get("name") == "serve.request"]
+    steps = [s for s in spans if s.get("name") == "train.step"]
+    by_parent: Dict[str, List[dict]] = {}
+    for s in spans:
+        p = s.get("parent")
+        if p:
+            by_parent.setdefault(p, []).append(s)
+    sites: Dict[str, List[float]] = {}
+    for s in spans:
+        sites.setdefault(s.get("name", "?"), []).append(
+            float(s.get("dur") or 0.0))
+    return {"requests": reqs, "steps": steps, "children": by_parent,
+            "sites": sites}
+
+
+# --------------------------------------------------------------- rendering --
+def render(spans: List[dict], top_requests: int = 5,
+           waterfall_steps: int = 8, request_id: Optional[str] = None) \
+        -> str:
+    a = analyze(spans)
+    reqs: List[Request] = a["requests"]
+    out = []
+    w = out.append
+
+    if request_id is not None:
+        match = [r for r in reqs if r.id == request_id]
+        if not match:
+            return f"no serve.request span with request_id={request_id!r}"
+        for r in match:
+            w(f"== request {r.id} ({r.status}, prompt_len="
+              f"{r.prompt_len}, e2e {r.e2e * 1e3:.2f}ms) ==")
+            for e in r.span.get("events") or []:
+                rel = (e["ts"] - r.start) * 1e3
+                attrs = ", ".join(f"{k}={v}" for k, v in e.items()
+                                  if k not in ("ts", "name"))
+                w(f"  +{rel:9.3f}ms  {e['name']}"
+                  + (f"  ({attrs})" if attrs else ""))
+        return "\n".join(out)
+
+    # ---- SLO percentiles -------------------------------------------
+    ttft = [r.ttft for r in reqs if r.ttft is not None]
+    per_tok = [d for r in reqs for d in r.per_token]
+    e2e = [r.e2e for r in reqs if r.status not in ("queued",)]
+    step_t = [float(s.get("dur") or 0.0) for s in a["steps"]]
+    if ttft or per_tok or e2e or step_t:
+        w("== SLO percentiles ==")
+        if ttft:
+            w(_pct_row("TTFT", ttft))
+        if per_tok:
+            w(_pct_row("per-token", per_tok))
+        if e2e:
+            w(_pct_row("request e2e", e2e))
+        if step_t:
+            w(_pct_row("train step", step_t))
+
+    # ---- request outcomes + slowest table --------------------------
+    if reqs:
+        outcomes: Dict[str, int] = {}
+        for r in reqs:
+            outcomes[r.status] = outcomes.get(r.status, 0) + 1
+        w("== requests ==")
+        w("  outcomes        " + "  ".join(
+            f"{k}={v}" for k, v in sorted(outcomes.items())))
+        w(f"  {'request':<10}{'status':<12}{'prompt':>7}{'tokens':>7}"
+          f"{'wait ms':>9}{'ttft ms':>9}{'e2e ms':>10}")
+        for r in sorted(reqs, key=lambda r: -r.e2e)[:top_requests]:
+            w(f"  {r.id:<10}{r.status:<12}"
+              f"{r.prompt_len if r.prompt_len is not None else '?':>7}"
+              f"{r.tokens if r.tokens is not None else '?':>7}"
+              f"{r.queue_wait * 1e3 if r.queue_wait is not None else 0:>9.2f}"
+              f"{r.ttft * 1e3 if r.ttft is not None else 0:>9.2f}"
+              f"{r.e2e * 1e3:>10.2f}")
+
+    # ---- step waterfall --------------------------------------------
+    steps = a["steps"]
+    if steps:
+        w("== train step waterfall (last %d) ==" %
+          min(waterfall_steps, len(steps)))
+        phases = ("train.data", "train.dispatch", "train.loss_sync")
+        w(f"  {'step':>6}  {'total ms':>9}  " + "  ".join(
+            f"{p.split('.')[1]:>11}" for p in phases))
+        for s in steps[-waterfall_steps:]:
+            kids = {c.get("name"): float(c.get("dur") or 0.0)
+                    for c in a["children"].get(s.get("span"), [])}
+            n = (s.get("labels") or {}).get("step", "?")
+            total = float(s.get("dur") or 0.0) * 1e3
+            cols = "  ".join(f"{kids.get(p, 0.0) * 1e3:9.2f}ms"
+                             for p in phases)
+            anom = " ANOMALOUS" if (s.get("labels") or {}).get(
+                "anomalous") else ""
+            w(f"  {n:>6}  {total:>9.2f}  {cols}{anom}")
+
+    # ---- per-site table --------------------------------------------
+    if a["sites"]:
+        w("== span sites ==")
+        w(f"  {'site':<24}{'count':>7}{'mean ms':>10}{'p99 ms':>10}"
+          f"{'max ms':>10}")
+        for name in sorted(a["sites"]):
+            ds = a["sites"][name]
+            w(f"  {name:<24}{len(ds):>7}"
+              f"{(sum(ds) / len(ds)) * 1e3:>10.2f}"
+              f"{percentile(ds, 0.99) * 1e3:>10.2f}"
+              f"{max(ds) * 1e3:>10.2f}")
+
+    return "\n".join(out) if out else "(no spans found)"
+
+
+# ------------------------------------------------------------ chrome trace --
+def to_chrome_trace(spans: List[dict]) -> dict:
+    """Standalone copy of tracing.to_chrome_trace (this tool must run
+    without a paddle_tpu install)."""
+    tids: Dict[str, int] = {}
+    out = []
+    for s in spans:
+        key = s.get("trace") or s.get("span") or s.get("name", "?")
+        tid = tids.setdefault(key, len(tids) + 1)
+        args = dict(s.get("labels") or {})
+        args["status"] = s.get("status", "ok")
+        args["trace"] = s.get("trace")
+        out.append({"ph": "X", "cat": "span", "name": s.get("name", "?"),
+                    "ts": float(s.get("start", 0.0)) * 1e6,
+                    "dur": max(float(s.get("dur") or 0.0), 0.0) * 1e6,
+                    "pid": 1, "tid": tid, "args": args})
+        for e in s.get("events") or []:
+            out.append({"ph": "i", "s": "t",
+                        "name": f"{s.get('name', '?')}:{e.get('name')}",
+                        "ts": float(e.get("ts", 0.0)) * 1e6,
+                        "pid": 1, "tid": tid,
+                        "args": {k: v for k, v in e.items()
+                                 if k not in ("ts", "name")}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="telemetry JSONL or flight_<pid>.json")
+    ap.add_argument("--requests", type=int, default=5,
+                    help="slowest-request table size")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="waterfall rows (last N train steps)")
+    ap.add_argument("--request", default=None,
+                    help="print one request's full event timeline")
+    ap.add_argument("--chrome", default=None,
+                    help="also write Chrome-trace/Perfetto JSON here")
+    a = ap.parse_args(argv)
+    try:
+        spans = load_spans(a.path)
+    except FileNotFoundError:
+        print(f"no such file: {a.path}", file=sys.stderr)
+        return 1
+    print(render(spans, top_requests=a.requests,
+                 waterfall_steps=a.steps, request_id=a.request))
+    if a.chrome:
+        with open(a.chrome, "w") as f:
+            json.dump(to_chrome_trace(spans), f)
+        print(f"chrome trace written: {a.chrome} "
+              "(chrome://tracing or https://ui.perfetto.dev)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

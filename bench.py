@@ -353,11 +353,14 @@ def serve_bench(argv=None):
     bench, readable by tools/metrics_report.py).
 
         python bench.py --serve [--loads 4,8] [--max-new 16]
+        python bench.py --serve --multitenant [--sessions N] [--requests N]
 
-    Prints one JSON summary line; CPU smoke shrinks the model/loads so
-    the tier-1 suite can run it in-process (the serving fast path can
-    never silently regress back to the host round-trip without this
-    number moving).
+    `--multitenant` runs the PR-6 front-end scenario instead (zipf
+    prefix reuse + mixed priority tiers against a 2-replica router —
+    see serve_mt_bench). Prints one JSON summary line; CPU smoke
+    shrinks the model/loads so the tier-1 suite can run it in-process
+    (the serving fast path can never silently regress back to the host
+    round-trip without this number moving).
     """
     import argparse
     ap = argparse.ArgumentParser()
@@ -365,7 +368,17 @@ def serve_bench(argv=None):
                     help="comma-separated offered loads (requests/sweep)")
     ap.add_argument("--max-new", type=int, default=None)
     ap.add_argument("--out", default=None, help="telemetry JSONL path")
+    ap.add_argument("--multitenant", action="store_true",
+                    help="run the multi-tenant router/tier scenario")
+    ap.add_argument("--sessions", type=int, default=None,
+                    help="[mt] distinct prompt-prefix sessions")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="[mt] routed requests in the zipf trace")
+    ap.add_argument("--flood", type=int, default=None,
+                    help="[mt] low-tier flood size for the fairness arm")
     a = ap.parse_args(argv)
+    if a.multitenant:
+        return serve_mt_bench(a)
 
     import jax
     import paddle_tpu as paddle
@@ -461,6 +474,241 @@ def serve_bench(argv=None):
             "levels": levels,
             "max_new": max_new,
             "batch": batch,
+            "telemetry": path,
+            "bench_code_sha": _bench_code_sha(),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+def serve_mt_bench(a):
+    """Multi-tenant serving scenario (PR 6): a 2-replica prefix-affinity
+    router under zipf-distributed session reuse and mixed priority
+    tiers. Two arms, both recorded through the observability JSONL sink
+    so the claims are verifiable from the telemetry file alone
+    (tools/metrics_report.py / trace_report.py render the breakdowns):
+
+    1. **routing** — the same zipf trace through ``policy="affinity"``
+       and ``policy="random"``; per-replica prefix-cache hits compared
+       (affinity must win: sessions land where their pages already
+       live). `{"kind": "serve_mt_routing"}` records.
+    2. **fairness** — a low-tier flood around a handful of interactive
+       requests, served FIFO vs weighted-fair (interactive:batch =
+       8:1), against an unloaded interactive-only baseline. Per-tier
+       TTFT/e2e percentiles from the router histograms land as
+       `{"kind": "serve_mt_tier"}` records; the headline number is
+       hi-tier p99 TTFT under flood over its unloaded value (WFQ must
+       hold ~1x where FIFO blows up).
+
+    The affinity arm also publishes one ``{"kind": "autoscale"}``
+    snapshot (serving/autoscale.py) so the scaler-signal path is
+    exercised end to end.
+    """
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import runtime as obs_rt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Router
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        sessions = a.sessions or 12
+        n_requests = a.requests or 48
+        flood = a.flood
+        max_new = a.max_new or 32
+        batch, page, max_seq = 8, 16, 1024
+        hi_len, lo_len, body_len = 24, 160, 48
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        sessions = a.sessions or 3
+        n_requests = a.requests or 12
+        flood = a.flood
+        max_new = a.max_new or 5
+        batch, page, max_seq = 2, 8, 96
+        hi_len, lo_len, body_len = 6, 12, 4
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    rng = np.random.RandomState(7)
+    vocab = cfg.vocab_size
+    weights = {"interactive": 8, "batch": 1}
+
+    # zipf session trace: session prefixes span >= 2 KV pages so
+    # affinity routing has real pages to chase; rank-r session drawn
+    # with probability ~ 1/(r+1)^1.1
+    prefixes = [rng.randint(2, vocab, (2 * page,)).tolist()
+                for _ in range(sessions)]
+    p = np.array([1.0 / (r + 1) ** 1.1 for r in range(sessions)])
+    p /= p.sum()
+    trace = []
+    for _ in range(n_requests):
+        sid = int(rng.choice(sessions, p=p))
+        prompt = prefixes[sid] + rng.randint(
+            2, vocab, (1 + int(rng.randint(body_len)),)).tolist()
+        trace.append((prompt, "interactive" if sid % 2 == 0 else "batch"))
+
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "output", "telemetry_serve_mt.jsonl")
+    was_enabled = obs.enabled()
+    obs.enabled(True)
+    obs_rt.configure(path)
+    reg = obs.get_registry()
+    kw = dict(max_batch_size=batch, page_size=page, max_seq_len=max_seq)
+    hits, summary = {}, {}
+    try:
+        # ---- arm 1: routing policy comparison, same trace ------------
+        # serialized submission (each request completes before the
+        # next routes): the claim under test is WHERE requests land,
+        # not admission batching — a rapid-fire burst would fold a
+        # session's requests into one prefill batch on either policy
+        # and hide the affinity signal behind timing.
+        for policy in ("affinity", "random"):
+            reg.reset()
+            with Router([model, model], policy=policy, seed=0,
+                        tier_weights=weights, **kw) as router:
+                for pr, t in trace:
+                    router.submit(pr, max_new_tokens=max_new,
+                                  tier=t).result(timeout=600)
+                per_rep, tot, reused = {}, 0, 0
+                for name, st in router.stats().items():
+                    ph = st["prefix_hits"] + st["prefix_partial_hits"]
+                    per_rep[name] = ph
+                    tot += ph
+                    reused += st["pages_reused"]
+                if policy == "affinity":
+                    summary["autoscale"] = router.autoscale()
+            hits[policy] = tot
+            obs_rt.export_record(
+                {"kind": "serve_mt_routing", "ts": time.time(),
+                 "policy": policy, "requests": len(trace),
+                 "sessions": sessions, "prefix_hits": tot,
+                 "pages_reused": reused, "per_replica": per_rep})
+            obs_rt.maybe_export()
+            _log(f"mt routing[{policy}]: {tot} prefix hits "
+                 f"({per_rep})")
+
+        # ---- arm 2: tier fairness under a low-tier flood -------------
+        # The interactive stream is 3x slot capacity on its own, so the
+        # unloaded baseline has real queueing (an unloaded p99 of "the
+        # prefill alone" would make ANY flood look unfair); the flood
+        # then interleaves a burst of heavier batch-tier requests right
+        # behind the first interactive arrival. Weighted-fair must keep
+        # hi-tier p99 TTFT ~at its unloaded value (the flood only gets
+        # the batch tier's 1/9 work share); FIFO makes the trailing
+        # interactive requests wait out the whole flood.
+        slots = 2 * batch
+        # 6x slot capacity: p99 over a dozen samples is just the max
+        # (one noisy tick flips the 2x verdict); a longer hi stream
+        # both stabilizes the quantile and amortizes the flood's
+        # one-time slot-residency cost (los admitted before any hi was
+        # queued hold their slots — WFQ is admission-order fairness,
+        # not preemption)
+        n_hi = 6 * slots
+        flood = flood or 5 * slots
+        lo_max_new = 2 * max_new
+
+        def mk_trace(with_flood):
+            his = [rng.randint(2, vocab, (hi_len,)).tolist()
+                   for _ in range(n_hi)]
+            if not with_flood:
+                return [(pr, "interactive", max_new) for pr in his]
+            los = [rng.randint(2, vocab, (lo_len,)).tolist()
+                   for _ in range(flood)]
+            return [(his[0], "interactive", max_new)] \
+                + [(pr, "batch", lo_max_new) for pr in los] \
+                + [(pr, "interactive", max_new) for pr in his[1:]]
+
+        def warmed_replicas():
+            """Build + pre-warm both replica predictors OUTSIDE the
+            router: every prefill shape the phases can see (n=1 and
+            n=2 batches of both prompt-length buckets) plus the decode
+            program compiles here, so the measured TTFT quantiles are
+            queueing, not jit tracing. (Routing a warm-up through the
+            router can't do this: idle least-loaded ties always pick
+            replica0, leaving replica1 cold.)"""
+            from paddle_tpu.inference import ContinuousBatchingPredictor
+            preds = []
+            for i in range(2):
+                p = ContinuousBatchingPredictor(
+                    model, name=f"replica{i}", **kw)
+                for ln in (hi_len, lo_len):
+                    w = [rng.randint(2, vocab, (ln,)).tolist()
+                         for _ in range(3)]
+                    p.generate([w[0]], max_new_tokens=2)
+                    p.generate([w[1], w[2]], max_new_tokens=2)
+                preds.append(p)
+            return preds
+
+        preds = warmed_replicas()
+
+        def tier_phase(mode, tier_weights, reqs):
+            reg.reset()
+            with Router(preds, tier_weights=tier_weights,
+                        seed=0) as router:
+                hs = [router.submit(pr, max_new_tokens=mn, tier=t)
+                      for pr, t, mn in reqs]
+                for h in hs:
+                    h.result(timeout=600)
+            ttft = reg.get("serving.router.ttft_seconds")
+            e2e = reg.get("serving.router.e2e_seconds")
+            out = {}
+            for tier in {t for _, t, _ in reqs}:
+                n = sum(1 for _, t, _ in reqs if t == tier)
+                rec = {"kind": "serve_mt_tier", "ts": time.time(),
+                       "mode": mode, "tier": tier, "n": n,
+                       "ttft_p50_s": round(ttft.quantile(0.5, tier=tier), 6),
+                       "ttft_p99_s": round(ttft.quantile(0.99, tier=tier), 6),
+                       "e2e_p50_s": round(e2e.quantile(0.5, tier=tier), 6),
+                       "e2e_p99_s": round(e2e.quantile(0.99, tier=tier), 6)}
+                obs_rt.export_record(rec)
+                out[tier] = rec
+            obs_rt.maybe_export()
+            _log(f"mt tier[{mode}]: hi p99 TTFT "
+                 f"{out['interactive']['ttft_p99_s'] * 1e3:.1f}ms")
+            return out
+
+        # distinct prompts per phase (same length buckets): a repeated
+        # prompt would ride the previous phase's prefix cache and bias
+        # its TTFT down
+        unloaded = tier_phase("unloaded", weights, mk_trace(False))
+        wfq = tier_phase("wfq", weights, mk_trace(True))
+        fifo = tier_phase("fifo", None, mk_trace(True))
+        base = max(unloaded["interactive"]["ttft_p99_s"], 1e-9)
+        wfq_ratio = wfq["interactive"]["ttft_p99_s"] / base
+        fifo_ratio = fifo["interactive"]["ttft_p99_s"] / base
+        obs_rt.export_record(
+            {"kind": "serve_mt_summary", "ts": time.time(),
+             "affinity_hits": hits["affinity"],
+             "random_hits": hits["random"],
+             "hi_ttft_p99_unloaded_s":
+                 unloaded["interactive"]["ttft_p99_s"],
+             "wfq_hi_ttft_p99_ratio": round(wfq_ratio, 3),
+             "fifo_hi_ttft_p99_ratio": round(fifo_ratio, 3)})
+    finally:
+        obs_rt.configure(None)
+        obs.enabled(was_enabled)
+
+    result = {
+        "metric": "serve_mt_wfq_hi_ttft_p99_ratio",
+        "value": round(wfq_ratio, 3),
+        "unit": "x_unloaded",
+        "aux": {
+            "backend": jax.default_backend(),
+            "fifo_hi_ttft_p99_ratio": round(fifo_ratio, 3),
+            "affinity_prefix_hits": hits["affinity"],
+            "random_prefix_hits": hits["random"],
+            "requests": n_requests, "sessions": sessions,
+            "flood": flood, "max_new": max_new, "replicas": 2,
             "telemetry": path,
             "bench_code_sha": _bench_code_sha(),
         },

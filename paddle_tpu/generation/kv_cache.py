@@ -154,6 +154,20 @@ class PagedKVPool:
         self.k, self.v = list(self.k), list(self.v)
 
 
+def prefix_page_keys(prompt, page_size):
+    """The page-aligned prefix keys of `prompt`: one hashable key per
+    FULL KV page (``ceil`` is wrong here — a trailing sub-page chunk is
+    a *partial*, not a page key). This is THE shared key function:
+    PrefixCache trie edges use exactly these keys, and the serving
+    router (serving/router.py) hashes prompts the same way to route a
+    session to the replica already holding its cached pages — the two
+    must never diverge, or affinity routing would chase pages that the
+    cache will not recognize."""
+    page = int(page_size)
+    return tuple(tuple(prompt[m:m + page])
+                 for m in range(0, len(prompt) - page + 1, page))
+
+
 class _PrefixNode:
     __slots__ = ("page", "next_token", "last_use", "children", "partials")
 
@@ -207,8 +221,8 @@ class PrefixCache:
         pages = []
         m = 0
         n = len(prompt)
-        while m + self.page <= n:
-            child = node.children.get(tuple(prompt[m:m + self.page]))
+        for key in prefix_page_keys(prompt, self.page):
+            child = node.children.get(key)
             if child is None:
                 break
             child.last_use = self._bump()
@@ -242,8 +256,7 @@ class PrefixCache:
         left untouched; new nodes retain their page in the pool."""
         node = self._root
         m, i, n = 0, 0, len(prompt)
-        while m + self.page <= n:
-            chunk = tuple(prompt[m:m + self.page])
+        for chunk in prefix_page_keys(prompt, self.page):
             child = node.children.get(chunk)
             if child is None:
                 nt = next_tokens[m + self.page - 1] if next_tokens else None

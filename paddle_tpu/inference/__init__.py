@@ -10,6 +10,8 @@ the network per input signature and serves from cache.
 from __future__ import annotations
 
 import os
+import sys
+import threading
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -455,9 +457,26 @@ class ContinuousBatchingPredictor:
                  num_pages=None, max_seq_len=512, pad_token_id=0,
                  eos_token_id=None, kv_dtype=None, use_ragged="auto",
                  enable_prefix_cache=True, max_queue=None,
-                 shed_policy="newest", decode_watchdog_s=None):
+                 shed_policy="newest", decode_watchdog_s=None,
+                 name=None):
         import math as _m
         model.eval()
+        # `name` identifies this predictor as one replica of a pool
+        # (serving/router.py): when set, every serving.* metric and
+        # serve.request span carries a replica=<name> label so
+        # per-replica cache hits/utilization are separable downstream
+        self.name = name
+        self._mlbl = {"replica": name} if name else {}
+        # replicas of one model run in separate threads (serving/
+        # router.py) but TRACE through the same model object: jax
+        # tracing executes the Python forward with jit.bridge
+        # .bound_state swapping the shared Tensor._values for tracers,
+        # so two concurrent first-compiles would leak each other's
+        # tracers. One lock per MODEL serializes tracing only;
+        # already-compiled signatures dispatch without it.
+        self._trace_lock = model.__dict__.setdefault(
+            "_cb_trace_lock", threading.Lock())
+        self._traced_sigs = set()
         if shed_policy not in ("newest", "oldest"):
             raise ValueError(
                 f"shed_policy must be 'newest' or 'oldest', "
@@ -501,7 +520,7 @@ class ContinuousBatchingPredictor:
                       "prefix_partial_hits": 0, "prefix_misses": 0,
                       "pages_reused": 0, "hol_skips": 0,
                       "deadline_evictions": 0, "shed_requests": 0,
-                      "watchdog_trips": 0}
+                      "watchdog_trips": 0, "cancelled_requests": 0}
         self.last_status: List[str] = []
         # serving telemetry (docs/SERVING.md catalog); recording no-ops
         # when paddle_tpu.observability.enabled(False)
@@ -526,6 +545,15 @@ class ContinuousBatchingPredictor:
         self._m_deadline = _obsm.counter("robustness.deadline_evictions")
         self._m_shed = _obsm.counter("robustness.shed_requests")
         self._m_wedge = _obsm.counter("robustness.watchdog_trips")
+        # multi-tenant front end (docs/SERVING.md): per-tier queue/
+        # admission/shed accounting and stream cancellations
+        self._m_tier_q = _obsm.gauge("serving.tier.queue_depth")
+        self._m_tier_adm = _obsm.counter("serving.tier.admissions")
+        self._m_tier_shed = _obsm.counter("serving.tier.shed_requests")
+        self._m_cancel = _obsm.counter("serving.cancelled_requests")
+        # static capacity, exported so a registry-only autoscaler can
+        # normalize serving.in_flight into a utilization (autoscale.py)
+        _obsm.gauge("serving.slots").set(self.B, **self._mlbl)
         # ragged-grid paged attention: only valid (slot, page) pairs
         # enter the decode kernel's grid. "auto" enables it when the
         # kernel's constraints hold (H == Hkv, D % 128 == 0, H % 8 == 0)
@@ -577,6 +605,20 @@ class ContinuousBatchingPredictor:
             self._p_vals, self._b_vals = p_vals, b_vals
             if self.prefix_cache is not None:
                 self.prefix_cache.clear(self.pool)
+
+    def _jit_call(self, sig, fn, *args):
+        """Dispatch a jitted program, holding the shared per-model
+        trace lock iff this (program, shape) signature has not been
+        traced by THIS predictor yet — see _trace_lock above. The set
+        is per-predictor (each has its own jit wrappers/cache), and the
+        serve loop is single-threaded per predictor, so the unlocked
+        fast path never races its own first trace."""
+        if sig in self._traced_sigs:
+            return fn(*args)
+        with self._trace_lock:
+            out = fn(*args)
+        self._traced_sigs.add(sig)
+        return out
 
     def _raw_prefill(self, p_vals, b_vals, kl, vl, ids, pos, lens,
                      page_rows):
@@ -703,7 +745,7 @@ class ContinuousBatchingPredictor:
 
     # ------------------------------------------------------------ serve --
     def generate(self, prompts, max_new_tokens=32, strict=True,
-                 deadline_s=None):
+                 deadline_s=None, tiers=None, tier_weights=None):
         """Continuous batching over a stream of prompts: List[List[int]]
         → List[List[int]] (new tokens per prompt, in request order).
         Sequences join and leave the running batch mid-flight.
@@ -723,104 +765,270 @@ class ContinuousBatchingPredictor:
           entry): an expired request is evicted — from the queue with
           result [] or mid-decode with its partial tokens — and
           `last_status[r] == "deadline"`, without blocking the others
-          (robustness.deadline_evictions).
+          (robustness.deadline_evictions). Expired QUEUED requests are
+          always evicted BEFORE any shed decision, so a backlog of dead
+          entries can never push live ones over `max_queue`.
         - constructor `max_queue` bounds the admission backlog; excess
           requests are shed at entry per `shed_policy` ('newest' sheds
           the latest arrivals, 'oldest' the stalest) with
-          `last_status[r] == "shed"` (robustness.shed_requests).
+          `last_status[r] == "shed"` (robustness.shed_requests). With
+          tiers, shedding is priority-aware: the lowest-weight tier
+          over its weight share of `max_queue` sheds first, and a tier
+          within its share is never shed (serving/scheduler.py).
         - the decode watchdog (constructor `decode_watchdog_s`, else
           `FLAGS_serve_decode_watchdog_s`) fails pending requests with
           `last_status "watchdog"` when a decode step wedges, instead
           of hanging; the KV pool is NOT reclaimed from a wedged step —
           treat the predictor as poisoned and rebuild it.
+
+        Multi-tenancy (docs/SERVING.md): `tiers` (per-request tier
+        names) + `tier_weights` ({tier: weight}) switch the admission
+        queue to weighted deficit-round-robin — each tier's admission
+        share converges to weight/Σweights, so a flood of low-tier
+        requests cannot starve interactive ones. TTFT/admission/shed
+        metrics gain a tier label.
         """
+        return self.generate_stream(
+            prompts, max_new_tokens=max_new_tokens, strict=strict,
+            deadline_s=deadline_s, tiers=tiers,
+            tier_weights=tier_weights).drain()
+
+    def generate_stream(self, prompts, max_new_tokens=32, strict=True,
+                        deadline_s=None, tiers=None, tier_weights=None):
+        """Streaming generate: same admission/fairness/robustness
+        semantics as :meth:`generate`, but returns a
+        ``serving.TokenStream`` that yields ``StreamEvent``s as decode
+        ticks complete — kind "token" per decoded token (timestamps
+        from the request span's token events, the PR-5 timing source)
+        and one terminal kind "end" per request carrying its final
+        status. `results`/`last_status` fill in place as requests
+        finish.
+
+        Cancellation: ``stream.cancel(r)`` evicts request `r` at the
+        next loop iteration (pages released, ``last_status[r] ==
+        "cancelled"``); closing/abandoning the stream cancels every
+        still-pending request the same way — a consumer that stops
+        iterating cannot leak KV pages or batch slots.
+        """
+        from ..serving.streaming import ServeRequest, TokenStream
+        n = len(prompts)
+        if deadline_s is None:
+            per_dl = [None] * n
+        else:
+            per_req = deadline_s if isinstance(deadline_s, (list, tuple)) \
+                else [deadline_s] * n
+            if len(per_req) != n:
+                raise ValueError(
+                    f"deadline_s has {len(per_req)} entries for "
+                    f"{n} prompts")
+            per_dl = [None if d is None else float(d) for d in per_req]
+        if tiers is not None and len(tiers) != n:
+            raise ValueError(
+                f"tiers has {len(tiers)} entries for {n} prompts")
+        if strict:
+            # validation precedes span creation: raising after
+            # start_span would leak the spans open in the recorder
+            for r, p in enumerate(prompts):
+                uns = self._unservable(p, max_new_tokens)
+                if uns is not None:
+                    raise ValueError(
+                        f"request {r} can never be served: {uns[1]}. "
+                        "Raise max_seq_len/num_pages, shorten the "
+                        "prompt, or pass strict=False to reject it and "
+                        "serve the rest.")
+        reqs = [ServeRequest(list(p), int(max_new_tokens),
+                             tiers[r] if tiers is not None else None,
+                             per_dl[r], None)
+                for r, p in enumerate(prompts)]
+        results = [None] * n
+        status = ["queued"] * n
+        cancel = set()
+        gen = self._serve(reqs, None, results, status, cancel,
+                          tier_weights, max_new_tokens)
+        return TokenStream(gen, results, status, cancel)
+
+    def serve_stream(self, intake, tier_weights=None):
+        """Open-ended continuous serving for a replica loop
+        (serving/router.py): instead of a fixed prompt list, `intake()`
+        is polled every loop iteration for new work and requests join
+        the running batch as slots free up — admission granularity is
+        one decode tick, not one generate() call.
+
+        `intake() -> list[ServeRequest] | None`: a list (possibly
+        empty) of new requests, or None to close the stream — the loop
+        then drains what it has and ends. `intake` may block briefly
+        while the loop is idle (the router's does, on a condition
+        variable) so an idle replica doesn't spin.
+
+        Returns a ``serving.TokenStream``; `results`/`last_status`
+        grow as requests arrive, and every StreamEvent carries the
+        originating ``ServeRequest.meta``.
+        """
+        from ..serving.streaming import TokenStream
+        results, status, cancel = [], [], set()
+        gen = self._serve([], intake, results, status, cancel,
+                          tier_weights, None)
+        return TokenStream(gen, results, status, cancel)
+
+    def _unservable(self, prompt, max_new):
+        """(kind, detail) when the request can never be served on this
+        predictor's geometry, else None."""
+        L = len(prompt)
+        need = -(-(L + max_new) // self.page)
+        if L + max_new > self.max_seq_len:
+            return ("over_max_seq_len",
+                    f"prompt len {L} + max_new_tokens {max_new} "
+                    f"exceeds max_seq_len {self.max_seq_len}")
+        if need > self.capacity:
+            return ("over_pool_capacity",
+                    f"needs {need} KV pages but the pool holds "
+                    f"{self.capacity}")
+        return None
+
+    def _serve(self, initial, intake, results, status, cancel,
+               tier_weights, call_max_new):
+        """THE serve loop, as a generator of StreamEvents. Both public
+        entry points wrap it: `generate_stream` seeds `initial` and
+        passes intake=None (the classic bounded call), `serve_stream`
+        starts empty and polls `intake` (the replica loop). All
+        admission, fairness, shedding, deadline, cancellation, decode
+        and watchdog behavior lives here once."""
+        import collections as _coll
         import time as _time
+        from ..serving.scheduler import FifoQueue, WeightedFairScheduler
+        from ..serving.streaming import StreamEvent
+        from ..kernels.paged_attention import RaggedMetaBuilder
 
         self._ensure_ready()
         wd = self._watchdog_s if self._watchdog_s is not None \
             else float(_fv("serve_decode_watchdog_s"))
         self._wd_cur = wd if wd and wd > 0 else None
-        t_gen = _time.perf_counter()
-        results = [None] * len(prompts)
-        status = ["queued"] * len(prompts)
         self.last_status = status
-        # deadline validation precedes span creation: raising after
-        # start_span would leak the spans open in the flight recorder
-        if deadline_s is None:
-            deadlines = None
-        else:
-            per_req = deadline_s if isinstance(deadline_s, (list, tuple)) \
-                else [deadline_s] * len(prompts)
-            if len(per_req) != len(prompts):
-                raise ValueError(
-                    f"deadline_s has {len(per_req)} entries for "
-                    f"{len(prompts)} prompts")
-            deadlines = [t_gen + float(d) for d in per_req]
-        # tracing: one trace per request — every span/event below is a
-        # no-op NULL_SPAN method when telemetry is disabled
+        mlbl = self._mlbl
+        # refreshed every loop start, not just at construction: a
+        # registry reset() between calls would otherwise leave the
+        # registry-only autoscale path with no capacity to normalize by
+        _obsm.gauge("serving.slots").set(self.B, **mlbl)
+        use_tiers = tier_weights is not None or any(
+            r.tier is not None for r in initial)
+        q = WeightedFairScheduler(tier_weights) if use_tiers \
+            else FifoQueue()
+
+        # per-request parallel state (grows under dynamic intake)
+        prompts, max_new, tier_of, metas = [], [], [], []
+        deadlines, arrival, req_sp = [], [], []
+        has_deadlines = False   # no deadlines → expire_queued is a no-op
+        out = _coll.deque()          # StreamEvents awaiting the consumer
+        closed = intake is None
+        tiers_seen = set()
+
         gen_sp = _obstr.start_span("serve.generate", parent=None,
-                                   n_prompts=len(prompts),
-                                   max_new_tokens=max_new_tokens)
-        req_sp = []
-        for r, p in enumerate(prompts):
+                                   n_prompts=len(initial),
+                                   dynamic=bool(intake), **mlbl)
+
+        def _ts(r):
+            # span events are the stream's timing source — but a span
+            # stops recording at its event cap (long generations), and
+            # a frozen evs[-1] would stamp every tail token with the
+            # same stale ts; fall back to the wall clock there
+            evs = getattr(req_sp[r], "events", None)
+            if evs and len(evs) < _obstr._MAX_EVENTS:
+                return evs[-1]["ts"]
+            return _time.time()
+
+        def emit(r, kind, token=None, index=0, st=None):
+            out.append(StreamEvent(r, kind, token, index, _ts(r), st,
+                                   metas[r]))
+
+        def add_request(sreq):
+            nonlocal has_deadlines
+            r = len(prompts)
+            p = list(sreq.prompt)
+            mn = int(sreq.max_new_tokens if sreq.max_new_tokens
+                     is not None else (call_max_new or 32))
+            prompts.append(p)
+            max_new.append(mn)
+            tier_of.append(sreq.tier)
+            metas.append(sreq.meta)
+            now = _time.perf_counter()
+            arrival.append(now)
+            deadlines.append(None if sreq.deadline_s is None
+                             else now + float(sreq.deadline_s))
+            has_deadlines = has_deadlines or sreq.deadline_s is not None
+            if r >= len(results):
+                results.append(None)
+                status.append("queued")
             self._req_seq += 1
+            tl = {"tier": sreq.tier} if sreq.tier is not None else {}
             req_sp.append(_obstr.start_span(
                 "serve.request", parent=gen_sp,
                 request_id=f"req{self._req_seq}", idx=r,
-                prompt_len=len(p)))
-        queue = []
-        for r, p in enumerate(prompts):
-            need = -(-(len(p) + max_new_tokens) // self.page)
-            if len(p) + max_new_tokens > self.max_seq_len:
-                kind, detail = "over_max_seq_len", (
-                    f"prompt len {len(p)} + max_new_tokens "
-                    f"{max_new_tokens} exceeds max_seq_len "
-                    f"{self.max_seq_len}")
-            elif need > self.capacity:
-                kind, detail = "over_pool_capacity", (
-                    f"needs {need} KV pages but the pool holds "
-                    f"{self.capacity}")
-            else:
-                queue.append(r)
-                req_sp[r].event("queued")
-                continue
-            if strict:
-                for sp in req_sp:
-                    if not sp.ended:
-                        sp.end(status="error:unservable")
-                gen_sp.end(status="error:unservable")
-                raise ValueError(
-                    f"request {r} can never be served: {detail}. Raise "
-                    "max_seq_len/num_pages, shorten the prompt, or pass "
-                    "strict=False to reject it and serve the rest.")
-            results[r] = []
-            status[r] = "rejected_" + kind
-            req_sp[r].event("rejected", reason=kind)
-            req_sp[r].end(status="rejected_" + kind)
-            self._m_rej.inc(reason=kind)
-            self._m_done.inc(status="rejected_" + kind)
-
-        # bounded admission queue: shed the overflow instead of letting
-        # the backlog (and every queued request's latency) grow without
-        # bound. The serve_flood fault site inflates the apparent depth
-        # so the shedding path is exercisable without real overload.
-        flood = 0
-        ff = _faults.check("serve_flood")
-        if ff is not None and ff.mode == "flood":
-            flood = int(ff.params.get("n", self.B))
-        if self.max_queue is not None:
-            while queue and len(queue) + flood > self.max_queue:
-                pos = len(queue) - 1 if self.shed_policy == "newest" else 0
-                r = queue.pop(pos)
+                prompt_len=len(p), **tl, **mlbl))
+            uns = self._unservable(p, mn)
+            if uns is not None:
                 results[r] = []
-                status[r] = "shed"
-                req_sp[r].event("shed", policy=self.shed_policy)
-                req_sp[r].end(status="shed")
-                self.stats["shed_requests"] += 1
-                self._m_shed.inc(policy=self.shed_policy)
-                self._m_done.inc(status="shed")
+                status[r] = "rejected_" + uns[0]
+                req_sp[r].event("rejected", reason=uns[0])
+                req_sp[r].end(status=status[r])
+                self._m_rej.inc(reason=uns[0], **mlbl)
+                self._m_done.inc(status=status[r], **mlbl)
+                emit(r, "end", st=status[r])
+                return
+            q.push(r, tier=sreq.tier, cost=len(p) + mn)
+            req_sp[r].event("queued")
 
-        from ..kernels.paged_attention import RaggedMetaBuilder
+        def finish_queued(r, st, span_event_kw=None):
+            """Terminal outcome for a request that never held a slot."""
+            results[r] = []
+            status[r] = st
+            req_sp[r].event(st, **(span_event_kw or {}))
+            req_sp[r].end(status=st)
+            self._m_done.inc(status=st, **mlbl)
+            emit(r, "end", st=st)
+
+        def expire_queued():
+            """Evict deadline-expired QUEUED requests. Runs before any
+            shed decision — expired low-tier entries must never cause a
+            live (high-tier) request to shed — and every iteration.
+            Deadline-free workloads skip the O(queue) scan entirely."""
+            if not has_deadlines:
+                return
+            now = _time.perf_counter()
+            for r in q.ids():
+                dl = deadlines[r]
+                if dl is not None and now >= dl:
+                    q.remove(r)
+                    self.stats["deadline_evictions"] += 1
+                    self._m_deadline.inc(stage="queued", **mlbl)
+                    finish_queued(r, "deadline", {"stage": "queued"})
+
+        def shed_overflow():
+            """Bounded admission queue: shed the overflow instead of
+            letting the backlog grow without bound. Priority-aware
+            under tiers (lowest tier first, weight-share floors); the
+            serve_flood fault site inflates the apparent depth so this
+            path is exercisable without real overload."""
+            if self.max_queue is None:
+                return
+            flood = 0
+            ff = _faults.check("serve_flood")
+            if ff is not None and ff.mode == "flood":
+                flood = int(ff.params.get("n", self.B))
+            while len(q) and len(q) + flood > self.max_queue:
+                r = q.pick_shed(self.shed_policy, self.max_queue)
+                if r is None:
+                    break
+                self.stats["shed_requests"] += 1
+                self._m_shed.inc(policy=self.shed_policy, **mlbl)
+                if tier_of[r] is not None:
+                    self._m_tier_shed.inc(tier=tier_of[r], **mlbl)
+                finish_queued(r, "shed", {"policy": self.shed_policy})
+
+        for sreq in initial:
+            add_request(sreq)
+        expire_queued()      # expired entries never count against
+        shed_overflow()      # max_queue, and never trigger sheds
+
         # slot state (host): -1 = free
         slot_req = [-1] * self.B
         slot_pages = [[] for _ in range(self.B)]
@@ -850,33 +1058,59 @@ class ContinuousBatchingPredictor:
             if builder is not None:
                 builder.clear_slot(b)
             self.stats["evictions"] += 1
-            self._m_evt.inc()
-            self._m_done.inc(status=status_val)
+            self._m_evt.inc(**mlbl)
+            self._m_done.inc(status=status_val, **mlbl)
+            emit(r, "end", st=status_val)
+
+        def apply_cancels():
+            """Consumer-driven cancellation: queued requests leave the
+            queue, running ones are evicted (pages released) with
+            last_status 'cancelled'. '*' cancels everything pending and
+            closes the intake."""
+            nonlocal closed
+            if not cancel:
+                return
+            # snapshot before filtering: TokenStream.cancel adds from
+            # other threads, and set(x) is one atomic C-level copy under
+            # the GIL while a Python-level comprehension over the live
+            # set is not ("Set changed size during iteration")
+            snap = set(cancel)
+            if "*" in snap:
+                closed = True
+                targets = None
+            else:
+                targets = {r for r in snap
+                           if isinstance(r, int) and r < len(prompts)}
+                if not targets:
+                    return
+            for r in list(q.ids()):
+                if targets is None or r in targets:
+                    q.remove(r)
+                    self.stats["cancelled_requests"] += 1
+                    self._m_cancel.inc(stage="queued", **mlbl)
+                    finish_queued(r, "cancelled", {"stage": "queued"})
+            for b in range(self.B):
+                r = slot_req[b]
+                if r >= 0 and (targets is None or r in targets):
+                    self.stats["cancelled_requests"] += 1
+                    self._m_cancel.inc(stage="decoding", **mlbl)
+                    evict(b, "cancelled")
+            if targets is not None:
+                cancel.difference_update(targets)
 
         def expire_deadlines():
             """Evict every request whose deadline passed: queued ones
             return [] and running ones their partial tokens, both with
             last_status 'deadline' — an expired request must not keep
             holding a slot/pages the live ones need."""
-            if deadlines is None:
-                return
+            expire_queued()
             now = _time.perf_counter()
-            for pos in range(len(queue) - 1, -1, -1):
-                r = queue[pos]
-                if now >= deadlines[r]:
-                    queue.pop(pos)
-                    results[r] = []
-                    status[r] = "deadline"
-                    req_sp[r].event("deadline", stage="queued")
-                    req_sp[r].end(status="deadline")
-                    self.stats["deadline_evictions"] += 1
-                    self._m_deadline.inc(stage="queued")
-                    self._m_done.inc(status="deadline")
             for b in range(self.B):
                 r = slot_req[b]
-                if r >= 0 and now >= deadlines[r]:
+                if r >= 0 and deadlines[r] is not None \
+                        and now >= deadlines[r]:
                     self.stats["deadline_evictions"] += 1
-                    self._m_deadline.inc(stage="decoding")
+                    self._m_deadline.inc(stage="decoding", **mlbl)
                     evict(b, "deadline")
 
         def reserve(r):
@@ -885,7 +1119,7 @@ class ContinuousBatchingPredictor:
             or None when the pool can't satisfy it right now."""
             prompt = prompts[r]
             L = len(prompt)
-            need = -(-(L + max_new_tokens) // self.page)
+            need = -(-(L + max_new[r]) // self.page)
             full_pages, covered, partial, cached_next = [], 0, None, None
             if self.prefix_cache is not None:
                 full_pages, covered, partial, cached_next = \
@@ -944,41 +1178,56 @@ class ContinuousBatchingPredictor:
             status[r] = "running"
             req_sp[r].event("admitted", slot=b)
             req_sp[r].event("first_token")
-            self._m_adm.inc()
-            self._m_ttft.observe(_time.perf_counter() - t_gen)
+            tl = {"tier": tier_of[r]} if tier_of[r] is not None else {}
+            self._m_adm.inc(**mlbl)
+            if tl:
+                self._m_tier_adm.inc(**tl, **mlbl)
+            self._m_ttft.observe(_time.perf_counter() - arrival[r],
+                                 **tl, **mlbl)
             if (self.eos_token_id is not None
                     and first == self.eos_token_id):
                 slot_new[b] = []     # parity: eos is stripped
                 evict(b)
-            elif max_new_tokens <= 1:
+            elif max_new[r] <= 1:
+                emit(r, "token", token=first, index=1)
                 evict(b)             # budget met at admission
+            else:
+                emit(r, "token", token=first, index=1)
 
         def admission_round():
-            """One scan over the queue: fill every free slot with the
-            first admissible requests (HOL fix: a stuck large request
-            no longer blocks later small ones), then run the round's
-            prefills — full misses batched per length bucket."""
+            """One pass over the queue in discipline order (FIFO, or
+            weighted deficit-round-robin under tiers): fill every free
+            slot with the first admissible requests (HOL fix: a stuck
+            large request no longer blocks later small ones), then run
+            the round's prefills — full misses batched per length
+            bucket."""
             free = [b for b in range(self.B) if slot_req[b] < 0]
-            if not free or not queue:
+            if not free or not len(q):
                 return False
-            plans, skipped_pos, picked_pos, remaining = [], [], [], []
-            for pos, r in enumerate(queue):
-                if not free or len(plans) >= len(free):
-                    remaining.extend(queue[pos:])
+            plans, skipped, seq = [], [], []
+            budget = len(q)
+            while len(plans) < len(free) and budget > 0:
+                r = q.pop()
+                if r is None:
                     break
+                budget -= 1
                 plan = reserve(r)
                 if plan is None:
-                    skipped_pos.append(pos)
-                    remaining.append(r)
-                    continue
-                picked_pos.append(pos)
-                plans.append(plan)
-            queue[:] = remaining
-            if picked_pos and skipped_pos:
-                n_hol = sum(1 for s in skipped_pos if s < max(picked_pos))
+                    skipped.append(r)
+                    seq.append(False)
+                else:
+                    q.consume(r)
+                    plans.append(plan)
+                    seq.append(True)
+            for r in reversed(skipped):
+                q.push_front(r)
+            if plans and skipped:
+                last_pick = max(i for i, s in enumerate(seq) if s)
+                n_hol = sum(1 for i, s in enumerate(seq)
+                            if not s and i < last_pick)
                 if n_hol:
                     self.stats["hol_skips"] += n_hol
-                    self._m_hol.inc(n_hol)
+                    self._m_hol.inc(n_hol, **mlbl)
             if not plans:
                 return False
 
@@ -1002,15 +1251,15 @@ class ContinuousBatchingPredictor:
                 firsts[plan["r"]] = int(plan["next"])
                 self.stats["prefix_hits"] += 1
                 self.stats["pages_reused"] += plan["reused"]
-                self._m_pfx_hit.inc()
-                self._m_pfx_pages.inc(plan["reused"])
+                self._m_pfx_hit.inc(**mlbl)
+                self._m_pfx_pages.inc(plan["reused"], **mlbl)
 
             for plan in partials:
                 firsts[plan["r"]] = self._suffix_prefill(plan)
                 self.stats["prefix_partial_hits"] += 1
                 self.stats["pages_reused"] += plan["reused"]
-                self._m_pfx_hit.inc(kind="partial")
-                self._m_pfx_pages.inc(plan["reused"])
+                self._m_pfx_hit.inc(kind="partial", **mlbl)
+                self._m_pfx_pages.inc(plan["reused"], **mlbl)
 
             by_bucket = {}
             for plan in misses:
@@ -1018,12 +1267,13 @@ class ContinuousBatchingPredictor:
                     LLMPredictor._bucket(len(plan["prompt"])),
                     []).append(plan)
                 self.stats["prefix_misses"] += 1
-                self._m_pfx_miss.inc()
+                self._m_pfx_miss.inc(**mlbl)
             for bucket, group in sorted(by_bucket.items()):
                 firsts.update(self._batch_prefill(bucket, group))
 
             if plans:
-                self._m_prefill.observe(_time.perf_counter() - t0)
+                self._m_prefill.observe(_time.perf_counter() - t0,
+                                        **mlbl)
             pf_sp.end()
             b_i = iter(free)
             for plan in plans:
@@ -1035,86 +1285,166 @@ class ContinuousBatchingPredictor:
 
         inflight = None
         evictions_seen = -1
-        while True:
-            expire_deadlines()
-            admitted = False
-            while admission_round():
-                admitted = True
-            active = _active()
-            self._m_queue.set(len(queue))
-            self._m_flight.set(len(active))
-            if admitted or self.stats["evictions"] != evictions_seen:
-                # free_count walks the prefix trie — refresh the gauge
-                # only when pages actually moved, not per decode step
-                evictions_seen = self.stats["evictions"]
-                self._m_util.set((self.capacity - self.pool.free_count)
-                                 / max(self.capacity, 1))
-            cur = None
-            if active:
-                self.stats["max_in_flight"] = max(
-                    self.stats["max_in_flight"], len(active))
-                # a dispatch is useless if every active slot's budget is
-                # already met once the in-flight step resolves — resolve
-                # first instead of burning a junk step
-                pend = {b for b, _ in inflight["snap"]} if inflight else set()
-                useful = any(
-                    len(slot_new[b]) + (1 if b in pend else 0)
-                    < max_new_tokens for b in active)
-                if useful:
-                    cur = self._dispatch_step(active, slot_req, tables,
-                                              ctx, last_tok_host,
-                                              override, builder, inflight)
-            prev, inflight = inflight, cur
-            if prev is not None:
-                try:
-                    self._resolve_step(prev, slot_req, slot_new,
-                                       last_tok_host, max_new_tokens,
-                                       evict, req_sp)
-                except DecodeWedgedError:
-                    # wedged decode: fail everything still pending
-                    # instead of hanging generate(). Pages of the
-                    # wedged step are NOT reclaimed (the in-flight
-                    # program owns the pool arrays) — the predictor
-                    # should be rebuilt.
-                    self.stats["watchdog_trips"] += 1
-                    self._m_wedge.inc()
-                    for b in range(self.B):
-                        r = slot_req[b]
-                        if r >= 0:
-                            results[r] = slot_new[b]
-                            status[r] = "watchdog"
-                            slot_req[b] = -1
-                            req_sp[r].event("watchdog", stage="decoding",
-                                            tokens=len(slot_new[b]))
-                            req_sp[r].end(status="watchdog")
-                            self._m_done.inc(status="watchdog")
-                    for r in queue:
-                        results[r] = []
-                        status[r] = "watchdog"
-                        req_sp[r].event("watchdog", stage="queued")
-                        req_sp[r].end(status="watchdog")
-                        self._m_done.inc(status="watchdog")
-                    queue.clear()
-                    gen_sp.event("decode_wedged")
-                    gen_sp.end(status="watchdog")
-                    # crash-time forensics: the dump carries the wedged
-                    # requests' spans (which phase each was in)
-                    _obstr.flight_dump(reason="decode_wedged")
-                    break
-            elif cur is None:
-                break
+        finished = False
+        try:
+            while True:
+                apply_cancels()
+                expire_deadlines()
+                if not closed:
+                    batch = intake()
+                    if batch is None:
+                        closed = True
+                    elif batch:
+                        for sreq in batch:
+                            add_request(sreq)
+                        expire_queued()
+                        shed_overflow()
+                admitted = False
+                while admission_round():
+                    admitted = True
+                active = _active()
+                self._m_queue.set(len(q), **mlbl)
+                self._m_flight.set(len(active), **mlbl)
+                if use_tiers:
+                    depths = q.depths()
+                    for t_name in tiers_seen - set(depths):
+                        self._m_tier_q.set(0, tier=t_name, **mlbl)
+                    for t_name, d in depths.items():
+                        tiers_seen.add(t_name)
+                        self._m_tier_q.set(d, tier=t_name, **mlbl)
+                if admitted or self.stats["evictions"] != evictions_seen:
+                    # free_count walks the prefix trie — refresh the
+                    # gauge only when pages actually moved, not per
+                    # decode step
+                    evictions_seen = self.stats["evictions"]
+                    self._m_util.set((self.capacity
+                                      - self.pool.free_count)
+                                     / max(self.capacity, 1), **mlbl)
+                cur = None
+                if active:
+                    self.stats["max_in_flight"] = max(
+                        self.stats["max_in_flight"], len(active))
+                    # a dispatch is useless if every active slot's
+                    # budget is already met once the in-flight step
+                    # resolves — resolve first instead of burning a
+                    # junk step
+                    pend = {b for b, _ in inflight["snap"]} if inflight \
+                        else set()
+                    useful = any(
+                        len(slot_new[b]) + (1 if b in pend else 0)
+                        < max_new[slot_req[b]] for b in active)
+                    if useful:
+                        cur = self._dispatch_step(active, slot_req,
+                                                  tables, ctx,
+                                                  last_tok_host,
+                                                  override, builder,
+                                                  inflight)
+                prev, inflight = inflight, cur
+                if prev is not None:
+                    try:
+                        self._resolve_step(prev, slot_req, slot_new,
+                                           last_tok_host, max_new,
+                                           evict, req_sp, emit)
+                    except DecodeWedgedError:
+                        # wedged decode: fail everything still pending
+                        # instead of hanging. Pages of the wedged step
+                        # are NOT reclaimed (the in-flight program owns
+                        # the pool arrays) — the predictor should be
+                        # rebuilt.
+                        self.stats["watchdog_trips"] += 1
+                        self._m_wedge.inc(**mlbl)
+                        for b in range(self.B):
+                            r = slot_req[b]
+                            if r >= 0:
+                                results[r] = slot_new[b]
+                                status[r] = "watchdog"
+                                slot_req[b] = -1
+                                req_sp[r].event("watchdog",
+                                                stage="decoding",
+                                                tokens=len(slot_new[b]))
+                                req_sp[r].end(status="watchdog")
+                                self._m_done.inc(status="watchdog",
+                                                 **mlbl)
+                                emit(r, "end", st="watchdog")
+                        for r in list(q.ids()):
+                            q.remove(r)
+                            finish_queued(r, "watchdog",
+                                          {"stage": "queued"})
+                        gen_sp.event("decode_wedged")
+                        gen_sp.end(status="watchdog")
+                        # crash-time forensics: the dump carries the
+                        # wedged requests' spans
+                        _obstr.flight_dump(reason="decode_wedged")
+                        break
+                elif cur is None:
+                    if closed:
+                        break
+                    # idle dynamic loop: intake() is expected to block
+                    # briefly itself; this is only spin insurance
+                    if not out:
+                        _time.sleep(0.0002)
+                while out:
+                    yield out.popleft()
 
-        for r, res in enumerate(results):
-            if res is None:   # defensive: admission validated up front,
-                results[r] = []   # so this should be unreachable
-                if status[r] in ("queued", "running"):
-                    status[r] = "incomplete"
-                    self._m_done.inc(status="incomplete")
-        for r, sp in enumerate(req_sp):
-            if not sp.ended:  # stragglers (defensive path above)
-                sp.end(status=status[r])
-        gen_sp.end()
-        return results
+            for r, res in enumerate(results):
+                if res is None:   # queue leftovers the loop could not
+                    results[r] = []   # place (defensive path)
+                    if status[r] in ("queued", "running"):
+                        status[r] = "incomplete"
+                        self._m_done.inc(status="incomplete", **mlbl)
+                        emit(r, "end", st="incomplete")
+            for r, sp in enumerate(req_sp):
+                if not sp.ended:  # stragglers (defensive path above)
+                    sp.end(status=status[r])
+            gen_sp.end()
+            while out:
+                yield out.popleft()
+            finished = True
+        finally:
+            if not finished:
+                # Two ways here: the consumer abandoned the raw
+                # generator (GeneratorExit; TokenStream.close drains
+                # instead, so normally unreachable) → "cancelled", or
+                # an exception unwound out of the serve loop → "error".
+                # A crash must NOT masquerade as consumer cancellation:
+                # the router readmits these requests as replica
+                # failures, and forensics need the terminal status on
+                # this replica to say so. Either way: free pages + end
+                # spans; pending StreamEvents are lost.
+                exc = sys.exc_info()[1]
+                aborted = exc is not None and not isinstance(
+                    exc, GeneratorExit)
+                st = "error" if aborted else "cancelled"
+                for b in range(self.B):
+                    if slot_req[b] >= 0:
+                        if not aborted:
+                            self.stats["cancelled_requests"] += 1
+                            self._m_cancel.inc(stage="decoding", **mlbl)
+                        evict(b, st)
+                for r in list(q.ids()):
+                    q.remove(r)
+                    if not aborted:
+                        self.stats["cancelled_requests"] += 1
+                        self._m_cancel.inc(stage="queued", **mlbl)
+                    finish_queued(r, st, {"stage": "queued"})
+                for r, s in enumerate(status):
+                    # popped from the queue for an admission round but
+                    # not yet slotted when the loop died: neither sweep
+                    # above saw it — same terminal label
+                    if s in ("queued", "running"):
+                        status[r] = st
+                        if not aborted:
+                            self.stats["cancelled_requests"] += 1
+                            self._m_cancel.inc(stage="queued", **mlbl)
+                        self._m_done.inc(status=st, **mlbl)
+                for r, res in enumerate(results):
+                    if res is None:
+                        results[r] = []
+                for r, sp in enumerate(req_sp):
+                    if not sp.ended:
+                        sp.end(status=status[r])
+                if not gen_sp.ended:
+                    gen_sp.end(status=st)
 
     # ---------------------------------------------------- admission ops --
     def _batch_prefill(self, bucket, group):
@@ -1138,7 +1468,8 @@ class ContinuousBatchingPredictor:
             lens[i] = L
             rows[i, :min(W, len(plan["pages"]))] = \
                 plan["pages"][:W]
-        nexts, new_k, new_v = self._prefill_jit(
+        nexts, new_k, new_v = self._jit_call(
+            ("prefill", ids.shape, rows.shape), self._prefill_jit,
             self._p_vals, self._b_vals, self.pool.k, self.pool.v,
             ids, pos, lens, rows)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
@@ -1178,7 +1509,8 @@ class ContinuousBatchingPredictor:
         past_rows[:wp] = plan["pages"][:wp]
         row = np.full((self.pages_per_seq,), self._trash, np.int32)
         row[:len(plan["pages"])] = plan["pages"]
-        nexts, new_k, new_v = self._suffix_jit(
+        nexts, new_k, new_v = self._jit_call(
+            ("suffix", ids.shape, past_rows.shape), self._suffix_jit,
             self._p_vals, self._b_vals, self.pool.k, self.pool.v,
             ids, pos, np.int32(covered), np.int32(sl), past_rows, row)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
@@ -1219,22 +1551,26 @@ class ContinuousBatchingPredictor:
         # the device buffer, and the host mutates tables/ctx/meta in
         # place while this step is still in flight (double buffering) —
         # snapshot them at dispatch
-        nxt, done, new_k, new_v = self._decode_jit(
+        nxt, done, new_k, new_v = self._jit_call(
+            ("decode", tables.shape,
+             tuple(np.shape(m) for m in meta_args)), self._decode_jit,
             self._p_vals, self._b_vals, self.pool.k, self.pool.v,
             tables.copy(), ctx.copy(), tok_in, *meta_args)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
         snap = [(b, slot_req[b]) for b in active]
         ctx[active] += 1
         self.stats["decode_steps"] += 1
-        self._m_steps.inc()
+        self._m_steps.inc(**self._mlbl)
         return {"tok": nxt, "done": done, "snap": snap, "t": t0}
 
     def _resolve_step(self, step, slot_req, slot_new, last_tok_host,
-                      max_new_tokens, evict, req_sp=None):
+                      max_new, evict, req_sp=None, emit=None):
         """Sync a PREVIOUSLY dispatched step (the next one is already in
-        flight) and apply its tokens: append, detect completion, evict.
-        Slots that were recycled since the dispatch are skipped — their
-        in-flight token belongs to the evicted request.
+        flight) and apply its tokens: append, detect completion, evict,
+        and stream each applied token through `emit` (request-indexed
+        per-request budgets come in as the `max_new` list). Slots that
+        were recycled since the dispatch are skipped — their in-flight
+        token belongs to the evicted request.
 
         With the watchdog armed (self._wd_cur), the sync polls the
         device buffers' is_ready() against a deadline instead of
@@ -1265,21 +1601,26 @@ class ContinuousBatchingPredictor:
                 _time.sleep(min(0.002, wd / 100.0))
         nxt = np.asarray(step["tok"])
         done = np.asarray(step["done"])
-        self._m_tok.observe(_time.perf_counter() - step["t"])
+        self._m_tok.observe(_time.perf_counter() - step["t"],
+                            **self._mlbl)
         for b, r in step["snap"]:
             if slot_req[b] != r:
                 continue             # evicted (and maybe re-admitted)
-            if len(slot_new[b]) >= max_new_tokens:
+            if len(slot_new[b]) >= max_new[r]:
                 continue             # token from a post-budget junk step
             t = int(nxt[b])
             slot_new[b].append(t)
             last_tok_host[b] = t
             if req_sp is not None:
                 # decode tick: per-token latency reconstructable from
-                # consecutive event timestamps (capped per span)
+                # consecutive event timestamps (capped per span) — the
+                # stream event below reads THIS timestamp
                 req_sp[r].event("token", i=len(slot_new[b]))
             if bool(done[b]):        # eos computed on device
                 slot_new[b].pop()    # parity: eos is stripped
                 evict(b)
-            elif len(slot_new[b]) >= max_new_tokens:
-                evict(b)
+            else:
+                if emit is not None:
+                    emit(r, "token", token=t, index=len(slot_new[b]))
+                if len(slot_new[b]) >= max_new[r]:
+                    evict(b)

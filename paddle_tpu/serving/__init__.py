@@ -1,0 +1,56 @@
+"""paddle_tpu.serving — the multi-tenant serving front end.
+
+The continuous-batching predictor (inference.ContinuousBatchingPredictor)
+is ONE model replica's serve loop; "heavy traffic from millions of
+users" (ROADMAP.md) needs the layer above it, which lives here:
+
+- :mod:`scheduler` — priority tiers with weighted deficit-round-robin
+  fair queueing on top of the PR-4 bounded admission queue, plus the
+  priority-aware shed policy (expired entries evicted before any shed,
+  lowest tier shed first, no tier shed below its weight share).
+- :mod:`streaming` — token streaming: ``generate_stream()`` yields
+  tokens as decode ticks complete instead of return-at-end, with
+  consumer-driven cancellation (stop iterating → request evicted,
+  KV pages freed, ``last_status == "cancelled"``).
+- :mod:`router` — a replica pool fronting N predictors
+  (thread-per-replica on CPU tier-1; same API shape for real
+  multi-host later) routing each request by prefix-cache affinity —
+  prompts hash the same page-aligned keys as generation.kv_cache
+  .PrefixCache — with least-loaded fallback and per-replica health
+  (consecutive failures → drain + eject + re-admit elsewhere).
+- :mod:`autoscale` — the ``serving.autoscale.*`` signal view (queue
+  depth per tier, TTFT-SLO burn, page-pool pressure, per-replica
+  utilization) computed from the observability registry and exported
+  through the JSONL/Prometheus sinks for an external scaler.
+
+Quickstart (docs/SERVING.md has the full walkthrough)::
+
+    from paddle_tpu.serving import Router
+
+    router = Router([model_a, model_b], max_batch_size=4, page_size=16,
+                    max_seq_len=512,
+                    tier_weights={"interactive": 8, "batch": 1})
+    h = router.submit(prompt, max_new_tokens=64, tier="interactive")
+    for ev in h.stream():          # tokens as they decode
+        print(ev.token)
+    router.autoscale()             # -> signal dict + gauges
+    router.shutdown()
+"""
+from .scheduler import (  # noqa: F401
+    FifoQueue, WeightedFairScheduler,
+)
+from .streaming import (  # noqa: F401
+    ServeRequest, StreamEvent, TokenStream,
+)
+from .router import (  # noqa: F401
+    Replica, Router, RequestHandle,
+)
+from .autoscale import (  # noqa: F401
+    autoscale_signals, publish_autoscale,
+)
+
+__all__ = [
+    "FifoQueue", "WeightedFairScheduler", "ServeRequest", "StreamEvent",
+    "TokenStream", "Replica", "Router", "RequestHandle",
+    "autoscale_signals", "publish_autoscale",
+]

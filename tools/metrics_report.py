@@ -206,6 +206,106 @@ def render(last, spans=None) -> str:
             w(f"  rejected[{dict(labels).get('reason', '?')}]  "
               f"{int(rec['value'])}")
 
+    routed = _series(last, "serving.router.routed")
+    if routed:
+        readm = _series(last, "serving.router.readmissions")
+        eject = _series(last, "serving.router.ejections")
+        fails = _series(last, "serving.router.replica_failures")
+        done = _series(last, "serving.router.completed")
+        cancelled = _series(last, "serving.cancelled_requests")
+        w("== serving front end (router) ==")
+        n_routed = sum(int(r.get("value", 0)) for r in routed.values())
+        w(f"  routed          {n_routed}"
+          f"   readmissions {sum(int(r.get('value', 0)) for r in readm.values())}"
+          f"   ejections {sum(int(r.get('value', 0)) for r in eject.values())}"
+          f"   replica_failures {sum(int(r.get('value', 0)) for r in fails.values())}"
+          f"   cancelled {sum(int(r.get('value', 0)) for r in cancelled.values())}")
+        outcomes = {}
+        for labels, rec in done.items():
+            st = dict(labels).get("status", "?")
+            outcomes[st] = outcomes.get(st, 0) + int(rec.get("value", 0))
+        if outcomes:
+            w("  outcomes        " + "  ".join(
+                f"{k}={v}" for k, v in sorted(outcomes.items())))
+        # --- per-replica: where requests landed and why ---------------
+        per_rep = {}
+        for labels, rec in routed.items():
+            lab = dict(labels)
+            rep = lab.get("replica", "?")
+            d = per_rep.setdefault(rep, {"routed": 0, "affinity": 0})
+            d["routed"] += int(rec.get("value", 0))
+            if lab.get("reason") == "affinity":
+                d["affinity"] += int(rec.get("value", 0))
+        depth = _series(last, "serving.router.queue_depth")
+        load = _series(last, "serving.router.replica_load")
+        util = _series(last, "serving.autoscale.replica_utilization")
+        pfx = _series(last, "serving.prefix_cache_hits")
+        if per_rep:
+            w(f"  {'replica':<12}{'routed':>8}{'affinity':>9}"
+              f"{'pfx hits':>9}{'depth':>7}{'load':>8}{'util':>7}")
+            for rep in sorted(per_rep):
+                d = per_rep[rep]
+                n_hits = sum(
+                    int(r.get("value", 0)) for labels, r in pfx.items()
+                    if dict(labels).get("replica") == rep)
+                dep = sum(r.get("value", 0) for labels, r in depth.items()
+                          if dict(labels).get("replica") == rep)
+                ld = sum(r.get("value", 0) for labels, r in load.items()
+                         if dict(labels).get("replica") == rep)
+                ut = sum(r.get("value", 0) for labels, r in util.items()
+                         if dict(labels).get("replica") == rep)
+                w(f"  {rep:<12}{d['routed']:>8}{d['affinity']:>9}"
+                  f"{n_hits:>9}{int(dep):>7}{ld:>8.0f}"
+                  f"{100.0 * ut:>6.1f}%")
+        # --- per-tier: the fairness claim, from telemetry alone -------
+        r_ttft = _series(last, "serving.router.ttft_seconds")
+        r_e2e = _series(last, "serving.router.e2e_seconds")
+        t_adm = _series(last, "serving.tier.admissions")
+        t_shed = _series(last, "serving.tier.shed_requests")
+        tiers = {dict(lb).get("tier") for lb in
+                 list(r_ttft) + list(t_adm) + list(t_shed)}
+        tiers.discard(None)
+        if tiers:
+            w(f"  {'tier':<12}{'admitted':>9}{'shed':>6}"
+              f"{'ttft p50':>10}{'ttft p99':>10}{'e2e p99':>10}")
+            for tier in sorted(tiers):
+                adm_n = sum(
+                    int(r.get("value", 0)) for lb, r in t_adm.items()
+                    if dict(lb).get("tier") == tier)
+                shed_n = sum(
+                    int(r.get("value", 0)) for lb, r in t_shed.items()
+                    if dict(lb).get("tier") == tier)
+                tt = next((r for lb, r in r_ttft.items()
+                           if dict(lb).get("tier") == tier), {})
+                ee = next((r for lb, r in r_e2e.items()
+                           if dict(lb).get("tier") == tier), {})
+                w(f"  {tier:<12}{adm_n:>9}{shed_n:>6}"
+                  f"{tt.get('p50', 0) * 1e3:>8.1f}ms"
+                  f"{tt.get('p99', 0) * 1e3:>8.1f}ms"
+                  f"{ee.get('p99', 0) * 1e3:>8.1f}ms")
+
+    asc = {k: rec for k, rec in last.items()
+           if k[0].startswith("serving.autoscale.")}
+    if asc:
+        w("== autoscale signals ==")
+        des = _one(last, "serving.autoscale.desired_replicas") or {}
+        heal = _one(last, "serving.autoscale.healthy_replicas") or {}
+        burn = _one(last, "serving.autoscale.ttft_burn") or {}
+        w(f"  replicas        healthy {int(heal.get('value', 0))}"
+          f" -> desired {int(des.get('value', 0))}"
+          f"   ttft_burn {burn.get('value', 0):.3f}")
+        qd = _series(last, "serving.autoscale.queue_depth")
+        if qd:
+            w("  queue_depth     " + "   ".join(
+                f"{dict(lb).get('tier', '?')}={int(r.get('value', 0))}"
+                for lb, r in sorted(qd.items())))
+        pp = _series(last, "serving.autoscale.page_pressure")
+        if pp:
+            w("  page_pressure   " + "   ".join(
+                f"{dict(lb).get('replica', '?')}="
+                f"{100.0 * r.get('value', 0):.1f}%"
+                for lb, r in sorted(pp.items())))
+
     rob = {k: rec for k, rec in last.items()
            if k[0].startswith("robustness.")}
     if rob:
@@ -230,9 +330,17 @@ def render(last, spans=None) -> str:
              "serving.queue_depth", "serving.rejected_requests",
              "serving.prefill_seconds", "serving.decode_steps",
              "serving.prefix_cache_hits", "serving.prefix_cache_misses",
-             "serving.prefix_cache_pages_reused", "serving.hol_skips"}
+             "serving.prefix_cache_pages_reused", "serving.hol_skips",
+             "serving.router.routed", "serving.router.readmissions",
+             "serving.router.ejections", "serving.router.replica_failures",
+             "serving.router.completed", "serving.router.queue_depth",
+             "serving.router.replica_load", "serving.router.ttft_seconds",
+             "serving.router.e2e_seconds", "serving.tier.queue_depth",
+             "serving.tier.admissions", "serving.tier.shed_requests",
+             "serving.cancelled_requests", "serving.in_flight"}
+    known_prefixes = ("robustness.", "serving.autoscale.")
     rest = sorted(k for k in last if k[0] not in known
-                  and not k[0].startswith("robustness."))
+                  and not k[0].startswith(known_prefixes))
     if rest:
         w("== other (last value) ==")
         for key in rest:

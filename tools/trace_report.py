@@ -95,8 +95,11 @@ class Request:
 
     def __init__(self, span: dict):
         self.span = span
-        self.id = (span.get("labels") or {}).get("request_id", "?")
-        self.prompt_len = (span.get("labels") or {}).get("prompt_len")
+        labels = span.get("labels") or {}
+        self.id = labels.get("request_id", "?")
+        self.prompt_len = labels.get("prompt_len")
+        self.tier = labels.get("tier")
+        self.replica = labels.get("replica")
         self.status = span.get("status", "?")
         self.start = float(span.get("start", 0.0))
         self.e2e = float(span.get("dur") or 0.0)
@@ -174,6 +177,34 @@ def render(spans: List[dict], top_requests: int = 5,
             w(_pct_row("request e2e", e2e))
         if step_t:
             w(_pct_row("train step", step_t))
+
+    # ---- per-tier SLO split (multi-tenant front end) ----------------
+    tiers = sorted({r.tier for r in reqs if r.tier is not None})
+    if tiers:
+        w("== per-tier SLO ==")
+        for tier in tiers:
+            sub = [r for r in reqs if r.tier == tier]
+            t_ttft = [r.ttft for r in sub if r.ttft is not None]
+            t_e2e = [r.e2e for r in sub]
+            if t_ttft:
+                w(_pct_row(f"{tier} TTFT", t_ttft))
+            if t_e2e:
+                w(_pct_row(f"{tier} e2e", t_e2e))
+
+    # ---- per-replica utilization (replica pool) ---------------------
+    replicas = sorted({r.replica for r in reqs if r.replica is not None})
+    if replicas:
+        w("== per-replica ==")
+        w(f"  {'replica':<12}{'requests':>9}{'tokens':>8}{'busy ms':>10}"
+          f"{'ttft p99':>11}{'e2e p99':>11}")
+        for rep in replicas:
+            sub = [r for r in reqs if r.replica == rep]
+            toks = sum(r.tokens or 0 for r in sub)
+            busy = sum(r.e2e for r in sub)
+            r_ttft = [r.ttft for r in sub if r.ttft is not None]
+            w(f"  {rep:<12}{len(sub):>9}{toks:>8}{busy * 1e3:>10.1f}"
+              f"{percentile(r_ttft, 0.99) * 1e3:>9.2f}ms"
+              f"{percentile([r.e2e for r in sub], 0.99) * 1e3:>9.2f}ms")
 
     # ---- request outcomes + slowest table --------------------------
     if reqs:

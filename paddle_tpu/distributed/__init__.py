@@ -12,7 +12,7 @@ from .collective import (
     ReduceOp, Group, all_reduce, all_gather, all_gather_concat,
     reduce_scatter, broadcast, reduce, alltoall, alltoall_single, send, recv,
     barrier, scatter, new_group, get_group, is_initialized, ppermute, stream,
-    spmd_region, in_spmd_region,
+    spmd_region, in_spmd_region, CollectiveTimeoutError, sync_with_deadline,
     isend, irecv, wait, gather, all_gather_object, broadcast_object_list,
     scatter_object_list, destroy_process_group, P2POp, batch_isend_irecv,
 )

@@ -17,12 +17,14 @@ the on-disk-corruption paths are exercisable in CI via the
 """
 from __future__ import annotations
 
+import collections
 import hashlib
 import json
 import logging
 import os
 import random
 import shutil
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -49,15 +51,69 @@ def _to_arrays(state_dict):
     return out
 
 
+# outstanding async save_state_dict drains: (thread, error box, path)
+_ASYNC_SAVES: list = []
+
+
 def save_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, async_save: bool = False):
-    """paddle.distributed.save_state_dict → orbax StandardSave."""
+    """paddle.distributed.save_state_dict → orbax StandardSave.
+
+    ``async_save=True`` takes the device→host snapshot synchronously
+    and drains the orbax serialization on a background thread (the same
+    split as ``VerifiedCheckpointer(async_save=True)``); call
+    :func:`wait_for_async_saves` before reading the checkpoint back or
+    exiting — it re-raises the first drain failure."""
+    import numpy as _np
+    import jax as _jax
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
-    ckptr = ocp.StandardCheckpointer()
     arrays = _to_arrays(state_dict)
+    if async_save:
+        # owned host copies, not np.asarray views: the caller may
+        # mutate (or donate) these arrays while the drain serializes
+        snap = _jax.tree_util.tree_map(
+            lambda a: _np.array(_np.asarray(a)), arrays)
+        box: Dict = {}
+
+        def _drain():
+            try:
+                ckptr = ocp.StandardCheckpointer()
+                ckptr.save(path, snap, force=True)
+                ckptr.wait_until_finished()
+            except BaseException as e:  # surfaced by wait_for_async_saves
+                box["error"] = e
+
+        th = threading.Thread(target=_drain, daemon=True,
+                              name="ckpt-async-save")
+        th.start()
+        _ASYNC_SAVES.append((th, box, path))
+        return
+    ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, arrays, force=True)
     ckptr.wait_until_finished()
+
+
+def wait_for_async_saves(timeout_s: Optional[float] = None) -> bool:
+    """Join all outstanding ``save_state_dict(async_save=True)`` drains.
+    Re-raises the first drain failure; returns False if the timeout
+    expired with drains still in flight (they keep draining)."""
+    deadline = None if timeout_s is None \
+        else time.monotonic() + float(timeout_s)
+    still = []
+    err = None
+    while _ASYNC_SAVES:
+        th, box, path = _ASYNC_SAVES.pop()
+        th.join(None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+        if th.is_alive():
+            still.append((th, box, path))
+        elif "error" in box and err is None:
+            err = box["error"]
+    _ASYNC_SAVES.extend(still)
+    if err is not None:
+        raise err
+    return not still
 
 
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
@@ -129,17 +185,25 @@ _MANIFEST = "manifest.json"
 _KEY_SEP = "/"
 
 
-def _flatten_state(tree: Dict, prefix: str = "", out=None) -> Dict:
-    """Nested {str: array|Tensor|dict} -> {'a/b/c': np.ndarray}."""
+def _flatten_state(tree: Dict, prefix: str = "", out=None,
+                   copy: bool = False) -> Dict:
+    """Nested {str: array|Tensor|dict} -> {'a/b/c': np.ndarray}.
+
+    ``copy=True`` forces owned snapshots: np.asarray is a no-copy
+    identity for numpy leaves and can zero-copy-alias CPU jax buffers —
+    an async drain serializing a view would record post-mutation values
+    (or read a donated-and-freed buffer) instead of the step-boundary
+    snapshot."""
     if out is None:
         out = {}
     for k, v in tree.items():
         key = f"{prefix}{_KEY_SEP}{k}" if prefix else str(k)
         if isinstance(v, dict):
-            _flatten_state(v, key, out)
+            _flatten_state(v, key, out, copy=copy)
         else:
             a = v._value if isinstance(v, Tensor) else v
-            out[key] = np.asarray(a)
+            arr = np.asarray(a)
+            out[key] = np.array(arr) if copy else arr
     return out
 
 
@@ -193,23 +257,42 @@ class VerifiedCheckpointer:
       exponential backoff (``FLAGS_ckpt_save_retries`` /
       ``FLAGS_ckpt_retry_backoff_s``), counting
       ``robustness.ckpt_retries``.
+    - **Async drain.** With ``async_save=True`` the train step pays only
+      the device→host snapshot: the write/digest/manifest/``os.replace``
+      pipeline (with all the guarantees above, retries included) runs on
+      a background drain thread. ``wait()`` blocks until every queued
+      save has landed (optionally with a deadline) and re-raises a drain
+      failure; ``restore_latest`` only ever sees fully-landed
+      checkpoints (atomic rename — a crash mid-drain leaves the previous
+      verified step intact); ``_gc`` never collects a step whose drain
+      is still in flight. The per-save stall the caller actually paid is
+      the ``robustness.ckpt_stall_seconds`` gauge.
 
     Fault sites: ``ckpt_save`` (mode ``err``: the attempt raises — the
     retry path), ``ckpt_write`` (modes ``truncate`` / ``corrupt`` /
     ``drop_manifest``: the finalized checkpoint is damaged on disk —
-    the verify/fallback path).
+    the verify/fallback path), ``ckpt_slow`` (``sleep=S``: the write
+    pipeline stalls — the async-drain/non-blocking-save path).
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  retries: Optional[int] = None,
                  backoff_s: Optional[float] = None,
-                 backoff_max_s: float = 8.0):
+                 backoff_max_s: float = 8.0,
+                 async_save: bool = False):
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         self.max_to_keep = int(max_to_keep)
         self._retries = retries
         self._backoff_s = backoff_s
         self._backoff_max_s = float(backoff_max_s)
+        self._async = bool(async_save)
+        self._cv = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._pending: set = set()   # snapshotted, not yet landed
+        self._drain_err: Optional[BaseException] = None
+        self._drain_thread: Optional[threading.Thread] = None
+        self._closed = False
 
     # ------------------------------------------------------------ paths --
     def _step_dir(self, step: int) -> str:
@@ -229,16 +312,51 @@ class VerifiedCheckpointer:
 
     # ------------------------------------------------------------- save --
     def save(self, step: int, state_dict: Dict, meta: Optional[Dict] = None):
-        """Atomically persist `state_dict`; returns the finalized path.
-        Transient failures retry with backoff; the final error (retries
-        exhausted) propagates to the caller."""
+        """Persist `state_dict`; returns the (eventual) finalized path.
+
+        Synchronous mode blocks through the full atomic pipeline;
+        transient failures retry with backoff and the final error
+        propagates. Async mode returns after the device→host snapshot —
+        the pipeline drains in the background, and a drain failure
+        (retries exhausted) surfaces at the next ``save()`` or
+        ``wait()``."""
+        t0 = time.perf_counter()
+        step = int(step)
+        # device→host snapshot; owned copies when draining async (the
+        # caller mutates/donates these buffers while the drain writes)
+        flat = _flatten_state(state_dict, copy=self._async)
+        try:
+            if not self._async:
+                return self._save_with_retry(step, flat, meta)
+            with self._cv:
+                err, self._drain_err = self._drain_err, None
+                if err is not None:
+                    raise err
+                self._pending.add(step)
+                self._queue.append((step, flat, meta))
+                if self._drain_thread is None \
+                        or not self._drain_thread.is_alive():
+                    self._drain_thread = threading.Thread(
+                        target=self._drain_loop, daemon=True,
+                        name="ckpt-drain")
+                    self._drain_thread.start()
+                self._cv.notify_all()
+            return self._step_dir(step)
+        finally:
+            # what the train step actually paid for this save: the whole
+            # pipeline when synchronous, snapshot+enqueue when async
+            _obsm.gauge("robustness.ckpt_stall_seconds", unit="s").set(
+                time.perf_counter() - t0)
+
+    def _save_with_retry(self, step: int, flat: Dict,
+                         meta: Optional[Dict]) -> str:
         from ..framework.flags import flag_value
         retries = self._retries if self._retries is not None \
             else int(flag_value("ckpt_save_retries"))
         base = self._backoff_s if self._backoff_s is not None \
             else float(flag_value("ckpt_retry_backoff_s"))
-        flat = _flatten_state(state_dict)
-        sp = _obstr.start_span("ckpt.save", parent=None, step=int(step))
+        sp = _obstr.start_span("ckpt.save", parent=None, step=int(step),
+                               drain=self._async)
         last_err = None
         for attempt in range(retries + 1):
             try:
@@ -261,7 +379,37 @@ class VerifiedCheckpointer:
         sp.end(status="error")
         raise last_err
 
+    def _drain_loop(self):
+        """Background writer: pops snapshots FIFO and runs each through
+        the full retry/atomic/verify pipeline. A failed drain parks its
+        error for the next save()/wait() and keeps the thread alive for
+        later saves — one bad disk window must not wedge the queue."""
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue:
+                    return  # closed and drained
+                step, flat, meta = self._queue.popleft()
+            err = None
+            try:
+                self._save_with_retry(step, flat, meta)
+            except BaseException as e:
+                err = e
+                _logger.error("background checkpoint drain for step %s "
+                              "failed: %s", step, e)
+            with self._cv:
+                if err is not None and self._drain_err is None:
+                    self._drain_err = err
+                self._pending.discard(step)
+                self._cv.notify_all()
+
     def _write(self, step: int, flat: Dict, meta: Optional[Dict]) -> str:
+        sl = _faults.check("ckpt_slow", step=step)
+        if sl is not None:
+            # a slow store (cold blobstore, contended NFS): the event the
+            # async drain exists to hide from the train step
+            time.sleep(float(sl.params.get("sleep", 0.5)))
         fa = _faults.check("ckpt_save", step=step)
         if fa is not None and fa.mode == "err":
             raise IOError(f"injected ckpt_save fault at step {step}")
@@ -336,7 +484,15 @@ class VerifiedCheckpointer:
                 f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
 
     def _gc(self):
+        # never collect a step whose background drain is still in
+        # flight: it may not be on disk yet (or is mid-replace), and the
+        # about-to-land checkpoint must not be deleted by an older
+        # save's gc pass racing it
+        with self._cv:
+            pending = set(self._pending)
         for step in self.steps()[:-self.max_to_keep or None]:
+            if step in pending:
+                continue
             shutil.rmtree(self._step_dir(step), ignore_errors=True)
 
     # ----------------------------------------------------------- verify --
@@ -419,9 +575,38 @@ class VerifiedCheckpointer:
         sp.end(status="none")
         return None
 
-    # ------------------------------------------------- API compatibility --
-    def wait(self):   # synchronous store: save() returns durably
-        pass
+    # ----------------------------------------------------------- draining --
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until every queued save has landed durably (the
+        just-in-time preemption path passes a deadline). Returns False
+        when the deadline expired with drains still in flight (counted
+        in ``robustness.ckpt_drain_timeouts``; the daemon thread keeps
+        draining). Re-raises a parked drain failure once drained.
+        Synchronous stores return True immediately — save() was
+        already durable."""
+        deadline = None if timeout_s is None \
+            else time.monotonic() + float(timeout_s)
+        with self._cv:
+            while self._queue or self._pending:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    _obsm.counter("robustness.ckpt_drain_timeouts").inc()
+                    _logger.warning(
+                        "checkpoint drain deadline (%.2fs) expired with "
+                        "%d save(s) still in flight", timeout_s,
+                        len(self._pending) + len(self._queue))
+                    return False
+                self._cv.wait(remaining)
+            err, self._drain_err = self._drain_err, None
+        if err is not None:
+            raise err
+        return True
 
     def close(self):
-        pass
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        th = self._drain_thread
+        if th is not None and th.is_alive():
+            th.join(timeout=30.0)

@@ -17,6 +17,7 @@ jax.distributed.initialize (the coordination service).
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -27,8 +28,66 @@ from jax import lax
 from ..tensor import Tensor
 from ..ops._dispatch import apply
 from ..ops.creation import _coerce
+from ..framework import faults as _faults
 from ..observability import metrics as _obsm
 from ..observability import tracing as _obstr
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective's host-side sync did not resolve within the
+    deadline: a peer likely never reached the collective (wedged rank,
+    dead host, stuck backend init). Raised by :func:`wait` /
+    :func:`barrier` instead of hanging forever, after writing a flight
+    dump naming the stuck site (docs/ROBUSTNESS.md)."""
+
+
+def sync_with_deadline(value, timeout_s: Optional[float] = None,
+                       what: str = "collective"):
+    """Block until ``value``'s device buffers are ready, or raise
+    :class:`CollectiveTimeoutError` after ``timeout_s`` seconds
+    (default ``FLAGS_collective_timeout_s``; <=0 blocks
+    unconditionally, no polling on the hot path).
+
+    Collectives here are *compiled*: a peer that never reaches the
+    program manifests as a host sync that never resolves. Like the
+    serving decode watchdog (PR 4), the sync polls ``is_ready()``
+    against the deadline instead of blocking — no thread spawn. The
+    ``collective_stall`` fault site holds readiness false for its
+    ``sleep=`` duration so the timeout path is exercisable in CI."""
+    arr = value._value if isinstance(value, Tensor) else value
+    if timeout_s is None:
+        from ..framework.flags import flag_value
+        timeout_s = float(flag_value("collective_timeout_s"))
+    block = getattr(arr, "block_until_ready", None)
+    if timeout_s <= 0:
+        if block is not None:
+            block()
+        return value
+    fa = _faults.check("collective_stall")
+    wedged_until = (time.perf_counter()
+                    + float(fa.params.get("sleep", 2 * timeout_s))) \
+        if fa is not None else 0.0
+    deadline = time.perf_counter() + timeout_s
+    ready = getattr(arr, "is_ready", lambda: True)
+    while True:
+        now = time.perf_counter()
+        if now >= wedged_until and ready():
+            if block is not None:
+                block()
+            return value
+        if now >= deadline:
+            _obsm.counter("robustness.collective_timeouts").inc(site=what)
+            dump = None
+            if _obsm.enabled():  # forensics only when telemetry is on
+                dump = _obstr.flight_dump(reason="collective_timeout")
+            raise CollectiveTimeoutError(
+                f"{what} did not resolve within {timeout_s}s — a peer "
+                "never reached the collective (wedged rank or dead "
+                "host). The elastic launcher treats the raising rank's "
+                "exit as a pod failure and restarts from the last "
+                "verified checkpoint."
+                + (f" Flight dump: {dump}" if dump else ""))
+        time.sleep(min(0.002, timeout_s / 100.0))
 
 
 _comm_calls = None
@@ -314,10 +373,10 @@ def ppermute(tensor, perm, group=None):
     return apply(lambda v: lax.ppermute(v, ax, perm), t)
 
 
-def barrier(group=None):
+def barrier(group=None, timeout_s=None):
     ax = _bound_axis(group)
     if ax is None:
-        jnp.zeros(()).block_until_ready()
+        sync_with_deadline(jnp.zeros(()), timeout_s, what="barrier")
         return
     return None
 
@@ -598,12 +657,13 @@ def irecv(tensor, src=0, group=None):
     recv(tensor, src, group)
 
 
-def wait(tensor, group=None, use_calc_stream=True):
+def wait(tensor, group=None, use_calc_stream=True, timeout_s=None):
     """Parity: paddle.distributed.wait — block until `tensor`'s producing
-    work is done (XLA: block_until_ready)."""
+    work is done (XLA: block_until_ready). With a deadline (explicit
+    ``timeout_s`` or ``FLAGS_collective_timeout_s``) a sync that never
+    resolves raises CollectiveTimeoutError instead of hanging."""
     t = _coerce(tensor)
-    if hasattr(t._value, "block_until_ready"):
-        t._value.block_until_ready()
+    sync_with_deadline(t, timeout_s, what="wait")
     return t
 
 

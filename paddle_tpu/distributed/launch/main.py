@@ -34,6 +34,7 @@ class Context:
     heartbeat_interval: float = 1.0  # seconds; <= 0 disables
     restart_backoff_s: float = 0.5       # base; doubles per restart
     restart_backoff_max_s: float = 60.0  # cap before jitter
+    hang_timeout_s: float = 0.0          # stale-rank detector; <=0 off
 
     @property
     def world_size(self) -> int:
@@ -75,6 +76,17 @@ def parse_args(argv=None) -> Context:
     p.add_argument("--restart_backoff_max", type=float, default=60.0,
                    help="elastic: backoff cap in seconds (before the "
                         "+/-50%% jitter)")
+    p.add_argument("--hang_timeout", type=float, default=0.0,
+                   help="stale-heartbeat detector: a rank whose pid is "
+                        "alive but whose worker log AND per-rank "
+                        "heartbeat file (PADDLE_RANK_HEARTBEAT) stop "
+                        "growing for this many seconds is declared "
+                        "wedged, SIGKILLed, and recovered through the "
+                        "normal elastic restart — hangs become "
+                        "restarts. Must exceed the longest legitimate "
+                        "silent phase (backend init, compile, restore). "
+                        "<=0 disables (an external operator must notice "
+                        "the hang)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -88,7 +100,8 @@ def parse_args(argv=None) -> Context:
         script_args=a.script_args,
         heartbeat_interval=a.heartbeat_interval,
         restart_backoff_s=a.restart_backoff,
-        restart_backoff_max_s=a.restart_backoff_max)
+        restart_backoff_max_s=a.restart_backoff_max,
+        hang_timeout_s=a.hang_timeout)
 
 
 def restart_delay(restarts: int, base_s: float, cap_s: float) -> float:
@@ -123,6 +136,13 @@ class PodController:
             "LOCAL_RANK": str(local_rank),
             "PADDLE_JOB_ID": ctx.job_id,
             "PADDLE_RESTART_EPOCH": str(restart_epoch),
+            # per-rank worker heartbeat: instrumented workers (Trainer,
+            # bench) beat phase/step lines here; silence while the pid
+            # stays alive is what the stale-heartbeat detector reads
+            "PADDLE_RANK_HEARTBEAT": self._hb_path(rank),
+            "PADDLE_RANK_HEARTBEAT_INTERVAL": str(
+                ctx.heartbeat_interval if ctx.heartbeat_interval > 0
+                else 1.0),
         })
         if ctx.master:
             env["PADDLE_MASTER"] = ctx.master
@@ -189,11 +209,52 @@ class PodController:
             except OSError:
                 log_bytes = 0
             rank = self.ctx.node_rank * self.ctx.nproc_per_node + lr
+            try:
+                hb_bytes = os.path.getsize(self._hb_path(rank))
+            except OSError:
+                hb_bytes = 0
             rc = p.poll()  # once: alive/returncode must agree
             out.append({"rank": rank, "local_rank": lr, "pid": p.pid,
-                        "alive": rc is None,
-                        "returncode": rc, "log_bytes": log_bytes})
+                        "alive": rc is None, "returncode": rc,
+                        "log_bytes": log_bytes, "hb_bytes": hb_bytes})
         return out
+
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(os.path.abspath(self.ctx.log_dir),
+                            f"heartbeat_rank{rank}.jsonl")
+
+    def kill_rank(self, local_rank: int):
+        """SIGKILL one wedged worker (SIGTERM would be swallowed by a
+        rank stuck inside a native call); poll() then reports the pod
+        failed and the normal elastic restart path takes over."""
+        p = self.procs[local_rank]
+        if p.poll() is None:
+            try:
+                p.kill()
+            except ProcessLookupError:
+                pass
+
+    def last_phase(self, rank: int) -> Optional[dict]:
+        """The wedged rank's last self-reported heartbeat record (phase/
+        step/ts) from its per-rank heartbeat file — names WHERE it hung
+        in the restart log instead of just 'it stopped'."""
+        try:
+            path = self._hb_path(rank)
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - 4096))
+                lines = f.read().decode(errors="replace").splitlines()
+        except OSError:
+            return None
+        import json
+        for line in reversed(lines):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "heartbeat":
+                return rec
+        return None
 
     def tail_logs(self, n: int = 20):
         for lr in range(len(self.procs)):
@@ -205,6 +266,56 @@ class PodController:
                     print(f"[rank {lr}] {line}", file=sys.stderr)
             except OSError:
                 pass
+
+
+class HangDetector:
+    """Stale-heartbeat detection over PodController.rank_states snapshots.
+
+    A wedged rank — stuck collective, stalled data loader, hung backend
+    init (the failure that killed bench rounds r01–r05) — keeps its pid
+    alive, so exit-code babysitting never fires. Its *signature* is
+    silence: the worker log and the per-rank heartbeat file both stop
+    growing. Feed ``observe()`` liveness snapshots; a rank whose
+    progress fingerprint (log_bytes, hb_bytes) is unchanged for
+    ``timeout_s`` while alive is returned as wedged. Any fingerprint
+    change (or restart of the rank's pid) resets its clock. Pure state
+    machine with an injectable clock — tests drive it with fake
+    snapshots and fake time, no real sleeps."""
+
+    def __init__(self, timeout_s: float, now_fn=time.time):
+        self.timeout_s = float(timeout_s)
+        self._now = now_fn
+        # rank -> (fingerprint, last_change_ts); the fingerprint is
+        # (pid, log_bytes, hb_bytes)
+        self._seen: dict = {}
+
+    def observe(self, rank_states: List[dict], now: Optional[float] = None) \
+            -> List[dict]:
+        """One snapshot in, currently-wedged rank states out."""
+        now = self._now() if now is None else now
+        wedged = []
+        for st in rank_states:
+            rank = st.get("rank")
+            if not st.get("alive"):
+                self._seen.pop(rank, None)
+                continue
+            fp = (st.get("pid"), st.get("log_bytes", 0),
+                  st.get("hb_bytes", 0))
+            prev = self._seen.get(rank)
+            if prev is None or prev[0] != fp:
+                self._seen[rank] = (fp, now)
+            elif self.timeout_s > 0 and now - prev[1] >= self.timeout_s:
+                wedged.append(st)
+        return wedged
+
+    def silence_s(self, rank, now: Optional[float] = None) -> float:
+        """How long this rank has been silent (0 if unseen)."""
+        now = self._now() if now is None else now
+        prev = self._seen.get(rank)
+        return (now - prev[1]) if prev else 0.0
+
+    def forget(self, rank):
+        self._seen.pop(rank, None)
 
 
 class ElasticManager:
@@ -263,12 +374,35 @@ class ElasticManager:
 def launch(ctx: Context) -> int:
     """Run the pod until success, failure, or restart budget exhausted."""
     from ...observability import RankHeartbeat, tracing as _tr
+    from ...observability import metrics as _obsm
     elastic = ElasticManager(ctx)
     hb = RankHeartbeat(os.path.join(ctx.log_dir, "heartbeat.jsonl"),
                        interval=ctx.heartbeat_interval)
+    det = HangDetector(ctx.hang_timeout_s) if ctx.hang_timeout_s > 0 \
+        else None
+    det_interval = max(0.2, min(1.0, ctx.hang_timeout_s / 4.0)) \
+        if det is not None else 0.0
+    next_det = 0.0
+    recovery = None   # open incident: {"t": detect_ts, "span": ...}
     rc = 1
     epoch = 0
     restarts = 0
+
+    def finish_recovery(status: str, via=None):
+        nonlocal recovery
+        if recovery is None:
+            return
+        mttr = time.time() - recovery["t"]
+        if status == "ok":
+            # the recovery-time SLO: hang declared -> restarted rank
+            # observably making progress again
+            _obsm.gauge("robustness.mttr_seconds", unit="s").set(mttr)
+            print(f"[launch] recovered {mttr:.2f}s after hang detection "
+                  f"(MTTR; first progress from rank {via})",
+                  file=sys.stderr)
+        recovery["span"].end(status=status, mttr_s=round(mttr, 3))
+        recovery = None
+
     try:
         while True:
             # one span per restart epoch: the elastic trajectory of a
@@ -279,6 +413,12 @@ def launch(ctx: Context) -> int:
             elastic.register(epoch)
             pod = PodController(ctx)
             pod.start(restart_epoch=epoch)
+            # post-restart progress baseline: logs/heartbeats append
+            # across epochs, so "recovered" = any alive rank's files
+            # growing past their size at this epoch's start
+            baseline = {st["rank"]: (st["log_bytes"], st["hb_bytes"])
+                        for st in pod.rank_states()} \
+                if recovery is not None else None
             peer_restart = False
             try:
                 while True:
@@ -289,14 +429,60 @@ def launch(ctx: Context) -> int:
                         peer_restart = True
                         break
                     elastic.heartbeat()
+                    states = None
                     if hb.due():  # rank_states stats N files: build it
+                        states = pod.rank_states()
                         hb.beat(node=ctx.node_rank, epoch=epoch,  # 1x per
                                 restarts=restarts,                # interval
-                                ranks=pod.rank_states())
+                                ranks=states)
+                    if (det is not None
+                            and time.time() >= next_det):
+                        next_det = time.time() + det_interval
+                        if states is None:
+                            states = pod.rank_states()
+                        if baseline is not None:
+                            for st in states:
+                                base = baseline.get(st["rank"], (0, 0))
+                                if st["alive"] and (
+                                        st["log_bytes"] > base[0]
+                                        or st["hb_bytes"] > base[1]):
+                                    finish_recovery("ok", via=st["rank"])
+                                    baseline = None
+                                    break
+                        for st in det.observe(states):
+                            phase = pod.last_phase(st["rank"]) or {}
+                            silent = det.silence_s(st["rank"])
+                            print(
+                                f"[launch] rank {st['rank']} wedged: pid "
+                                f"{st['pid']} alive but no log/heartbeat "
+                                f"progress for {silent:.1f}s (last phase "
+                                f"{phase.get('phase')!r}"
+                                + (f", step {phase.get('step')}"
+                                   if phase.get("step") is not None
+                                   else "")
+                                + "); SIGKILL — the hang becomes a "
+                                  "restart", file=sys.stderr)
+                            _obsm.counter(
+                                "robustness.hangs_detected").inc()
+                            ep_sp.event("hang_detected",
+                                        rank=st["rank"], pid=st["pid"],
+                                        silent_s=round(silent, 2),
+                                        phase=phase.get("phase"),
+                                        step=phase.get("step"))
+                            if recovery is None:
+                                recovery = {
+                                    "t": time.time(),
+                                    "span": _tr.start_span(
+                                        "launch.recovery", parent=None,
+                                        rank=st["rank"],
+                                        phase=phase.get("phase"))}
+                            det.forget(st["rank"])
+                            pod.kill_rank(st["local_rank"])
                     time.sleep(0.2)
             except KeyboardInterrupt:
                 pod.stop(signal.SIGINT)
                 ep_sp.end(status="interrupted")
+                finish_recovery("interrupted")
                 return 130
             if not peer_restart and rc == 0:
                 # success is only final if no peer failed concurrently —
@@ -304,6 +490,9 @@ def launch(ctx: Context) -> int:
                 # (and, on node 0, the store we host) stays alive
                 if not elastic.restart_requested(epoch):
                     ep_sp.end(status="ok")
+                    # a silent worker can run to completion between
+                    # detector ticks: success IS recovery
+                    finish_recovery("ok", via="pod_exit")
                     return 0
                 peer_restart = True
             restarts += 1  # counted identically on every node
@@ -320,6 +509,7 @@ def launch(ctx: Context) -> int:
             pod.stop()
             if restarts > ctx.max_restart:
                 ep_sp.end(status="failed")
+                finish_recovery("failed")
                 # budget exhausted: leave the epoch/restart trajectory
                 # on disk next to the worker logs
                 _tr.flight_dump(

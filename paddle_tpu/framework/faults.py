@@ -41,10 +41,17 @@ Action modes (bare words; sites interpret them)
     ``sleep=S``   stall the site for S seconds (slow step / wedged decode)
     ``flood``     serving: inflate the apparent queue depth by ``n=K``
 
-Sites instrumented in-tree: ``ckpt_save``, ``ckpt_write`` (in
-``distributed.checkpoint.VerifiedCheckpointer``), ``nan_loss``,
-``slow_step``, ``sigterm`` (in ``trainer.Trainer``), ``decode_wedge``,
-``serve_flood`` (in ``inference.ContinuousBatchingPredictor``). Sites
+Sites instrumented in-tree: ``ckpt_save``, ``ckpt_write``, ``ckpt_slow``
+(in ``distributed.checkpoint.VerifiedCheckpointer`` — ``ckpt_slow``
+stalls the write pipeline to exercise the async drain), ``nan_loss``,
+``slow_step``, ``rank_hang`` (the trainer loop wedges: an alive pid
+that stops making progress — the launcher's stale-heartbeat detector's
+prey), ``sigterm`` (in ``trainer.Trainer``), ``decode_wedge``,
+``serve_flood`` (in ``inference.ContinuousBatchingPredictor``),
+``collective_stall`` (``distributed.collective`` sync deadline — holds
+buffer readiness false so the collective watchdog trips), and
+``heartbeat_stall`` (``observability.RankHeartbeat`` stops writing
+while the process stays alive — the silent-rank signature). Sites
 are free-form strings — new subsystems add theirs without touching this
 module.
 
@@ -69,7 +76,9 @@ _MODES = ("err", "truncate", "corrupt", "drop_manifest", "nan", "inf",
 _DEFAULT_MODES = {
     "ckpt_save": "err", "ckpt_write": "truncate", "nan_loss": "nan",
     "slow_step": "sleep", "sigterm": "sigterm", "decode_wedge": "sleep",
-    "serve_flood": "flood",
+    "serve_flood": "flood", "rank_hang": "sleep",
+    "collective_stall": "sleep", "ckpt_slow": "sleep",
+    "heartbeat_stall": "sleep",
 }
 
 

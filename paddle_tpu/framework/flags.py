@@ -182,8 +182,9 @@ define_flag("fault_injection", "",
             "Deterministic fault-injection spec (docs/ROBUSTNESS.md): "
             "comma-separated 'site[:key=val|mode]...' entries, e.g. "
             "'ckpt_save:step=3:err,nan_loss:step=5'. Empty disarms. "
-            "Sites: ckpt_save, ckpt_write, nan_loss, slow_step, sigterm, "
-            "decode_wedge, serve_flood.",
+            "Sites: ckpt_save, ckpt_write, ckpt_slow, nan_loss, "
+            "slow_step, rank_hang, sigterm, decode_wedge, serve_flood, "
+            "collective_stall, heartbeat_stall.",
             on_change=_arm_faults)
 define_flag("anomaly_guard", True,
             "Trainer anomaly guard: a NaN/Inf loss skips the parameter "
@@ -216,3 +217,23 @@ define_flag("serve_decode_watchdog_s", 0.0,
             "seconds, pending requests fail with last_status "
             "'watchdog' instead of generate() hanging. 0 disables "
             "(the resolve blocks unconditionally, no polling).")
+define_flag("collective_timeout_s", 0.0,
+            "Collective deadline: if a collective's host-side sync "
+            "(distributed.wait / barrier) does not resolve within this "
+            "many seconds, raise CollectiveTimeoutError (with a flight "
+            "dump) instead of hanging forever on a peer that never "
+            "reached the collective. 0 disables (block "
+            "unconditionally).")
+define_flag("ckpt_async_save", True,
+            "Trainer checkpointing drains in the background: save() "
+            "takes only the device->host snapshot at the step boundary "
+            "and a drain thread runs the write/digest/manifest/rename "
+            "pipeline (all atomicity/verification/retry guarantees "
+            "kept; wait() blocks on the drain). Off restores the "
+            "fully synchronous save.")
+define_flag("ckpt_drain_deadline_s", 30.0,
+            "Preemption drain deadline: on SIGTERM/SIGINT the Trainer "
+            "blocks at most this many seconds for in-flight background "
+            "checkpoint drains before exiting (a drain that misses the "
+            "deadline counts robustness.ckpt_drain_timeouts and keeps "
+            "draining on its daemon thread). <=0 waits forever.")

@@ -195,11 +195,23 @@ class RankHeartbeat:
         return (self._f is not None
                 and time.time() - self._last >= self.interval)
 
-    def beat(self, **fields) -> bool:
+    def beat(self, force: bool = False, **fields) -> bool:
         if self._f is None:
             return False
         now = time.time()
-        if now - self._last < self.interval:
+        if not force and now - self._last < self.interval:
+            return False
+        try:  # heartbeat_stall fault: the process stays alive but its
+            # heartbeat goes silent — the wedged-rank signature the
+            # launcher's stale-heartbeat detector exists to catch
+            from ..framework import faults as _faults
+            fa = _faults.check("heartbeat_stall")
+            if fa is not None:
+                self._stalled_until = now + float(
+                    fa.params.get("sleep", 3600.0))
+        except Exception:
+            pass
+        if now < getattr(self, "_stalled_until", 0.0):
             return False
         self._last = now
         rec = {"ts": round(now, 3), "kind": "heartbeat"}

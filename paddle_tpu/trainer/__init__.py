@@ -154,7 +154,8 @@ class Trainer:
             from ..distributed.checkpoint import VerifiedCheckpointer
             self._ckpt = VerifiedCheckpointer(
                 os.path.join(self.args.output_dir, "checkpoints"),
-                max_to_keep=self.args.max_checkpoints)
+                max_to_keep=self.args.max_checkpoints,
+                async_save=bool(_fv("ckpt_async_save")))
         return self._ckpt
 
     def _full_state(self, step: int):
@@ -290,6 +291,13 @@ class Trainer:
                        "consecutive": self._anom_consec})
             limit = int(_fv("max_anomalous_steps"))
             if self._anom_consec >= limit:
+                try:  # drain in-flight saves so the cited fallback step
+                    # is accurate (bounded, best-effort: this path is
+                    # already fatal and a parked drain error of ANY kind
+                    # must not replace the AnomalousTrainingError)
+                    self._ckpt_mgr().wait(timeout_s=5.0)
+                except Exception:
+                    pass
                 last_ok = self._ckpt_mgr().latest_verified()
                 _obs.flight_dump(reason="anomalous_training")
                 raise AnomalousTrainingError(
@@ -309,14 +317,33 @@ class Trainer:
         args = self.args
         os.makedirs(args.output_dir, exist_ok=True)
         self._install_preemption_hook()
+        # per-rank liveness: under the elastic launcher every worker
+        # beats into its own PADDLE_RANK_HEARTBEAT file; the launcher's
+        # stale-heartbeat detector reads silence there as a wedged rank
+        self._hb = None
+        hb_path = os.environ.get("PADDLE_RANK_HEARTBEAT")
+        if hb_path:
+            from ..observability import RankHeartbeat
+            self._hb = RankHeartbeat(hb_path, interval=float(
+                os.environ.get("PADDLE_RANK_HEARTBEAT_INTERVAL", "1.0")))
+            self._hb_rank = os.environ.get(
+                "RANK", os.environ.get("PADDLE_TRAINER_ID", "0"))
+            self._hb.beat(phase="init", rank=self._hb_rank)
         try:
             return self._train_loop(resume)
         finally:
+            if self._hb is not None:
+                self._hb.close()
             self._restore_preemption_hook()
 
     def _train_loop(self, resume: bool):
         args = self.args
         start_step = self._try_resume() if resume else 0
+        if self._hb is not None:
+            # the resume marker: tools/trace_report.py --recovery ends
+            # the incident timeline at this beat
+            self._hb.beat(force=True, phase="resumed", step=start_step,
+                          rank=self._hb_rank)
         guard = bool(_fv("anomaly_guard"))
         self._anom_consec = 0
         self._anom_total = 0
@@ -341,9 +368,18 @@ class Trainer:
             # tools/trace_report.py. All no-ops when telemetry is off.
             st_sp = _obs.start_span("train.step", parent=None,
                                     step=step + 1)
+            if self._hb is not None:
+                self._hb.beat(phase="step", step=step + 1,
+                              rank=self._hb_rank)
             fa = _faults.check("slow_step", step=step)
             if fa is not None:
                 time.sleep(float(fa.params.get("sleep", 0.05)))
+            fa = _faults.check("rank_hang", step=step)
+            if fa is not None:
+                # deliberately wedge: an alive pid whose heartbeat/log
+                # go silent — the launcher's stale-heartbeat detector
+                # must notice and SIGKILL this rank into a restart
+                time.sleep(float(fa.params.get("sleep", 600.0)))
             with _obs.span("train.data", parent=st_sp, step=step + 1):
                 batch = next(data)
             if not isinstance(batch, (tuple, list)):
@@ -393,6 +429,10 @@ class Trainer:
                     # loop owns loss (synced only at log boundaries)
                     if math.isfinite(loss_val):
                         _obs.gauge("train.loss").set(loss_val)
+                    executed = step + 1 - start_step
+                    _obs.gauge("robustness.goodput").set(
+                        (executed - self._anom_total)
+                        / max(executed, 1))
                     if getattr(self._step_obj, "_obs", None) is None:
                         # uninstrumented step (single-device TrainStep):
                         # the loop is the only flusher. Instrumented
@@ -414,19 +454,29 @@ class Trainer:
                 _obs.flight_dump(
                     reason=getattr(self, "_flight_reason", None)
                     or "preempted")
-                self._ckpt_mgr().wait()
-                self._log({"preempted_at": step + 1})
+                # just-in-time preemption checkpoint: drain in-flight
+                # background saves, but bounded — the scheduler's grace
+                # window is finite and a wedged store must not turn a
+                # clean preemption into a SIGKILL mid-write
+                ddl = float(_fv("ckpt_drain_deadline_s"))
+                drained = self._ckpt_mgr().wait(
+                    timeout_s=ddl if ddl > 0 else None)
+                self._log({"preempted_at": step + 1,
+                           "ckpt_drained": drained})
                 break
         else:
             step = args.max_steps - 1
             if loss is not None:
                 loss_val = float(loss)
-        self._ckpt_mgr().wait()
+        if not self._preempted:   # the preemption path already drained
+            self._ckpt_mgr().wait()   # (bounded); don't re-block here
+        executed = max(step + 1 - start_step, 1)
         return {"start_step": start_step, "final_step": step + 1,
                 "final_loss": loss_val,
                 "wall_s": time.perf_counter() - t_start,
                 "tokens_per_sec": meter.tokens_per_sec, "mfu": meter.mfu,
                 "anomalous_steps": self._anom_total,
+                "goodput": (executed - self._anom_total) / executed,
                 "preempted": self._preempted, "logs": logs}
 
     def _log(self, rec: dict):

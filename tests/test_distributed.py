@@ -248,6 +248,24 @@ class TestDistCheckpoint:
         np.testing.assert_allclose(m2.fc1.weight.numpy(),
                                    m.fc1.weight.numpy())
 
+    def test_save_state_dict_async_is_honored(self, tmp_path):
+        """Regression: async_save used to be accepted and silently
+        ignored (a fully synchronous save). It now snapshots
+        immediately, drains in background, and wait_for_async_saves()
+        makes the write durable + re-raises drain failures."""
+        m = MLP()
+        sd = m.state_dict()
+        path = str(tmp_path / "ckpt_async")
+        dist.checkpoint.save_state_dict(sd, path, async_save=True)
+        assert dist.checkpoint.wait_for_async_saves(timeout_s=60)
+        m2 = MLP()
+        sd2 = m2.state_dict()
+        dist.checkpoint.load_state_dict(sd2, path)
+        np.testing.assert_allclose(m2.fc1.weight.numpy(),
+                                   m.fc1.weight.numpy())
+        # idempotent when nothing is outstanding
+        assert dist.checkpoint.wait_for_async_saves()
+
 
 class TestSequenceParallel:
     """Megatron SP (parity: fleet/utils/sequence_parallel_utils.py):

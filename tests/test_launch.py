@@ -79,6 +79,101 @@ class TestLaunch:
         assert launch(ctx) == 0
 
 
+class TestHangDetector:
+    """Pure state machine: fake snapshots + fake clock, no sleeps."""
+
+    def _st(self, rank=0, alive=True, pid=100, log=0, hb=0):
+        return {"rank": rank, "local_rank": rank, "pid": pid,
+                "alive": alive, "log_bytes": log, "hb_bytes": hb}
+
+    def test_silent_alive_rank_declared_wedged(self):
+        from paddle_tpu.distributed.launch.main import HangDetector
+        clock = {"t": 0.0}
+        det = HangDetector(10.0, now_fn=lambda: clock["t"])
+        assert det.observe([self._st(log=100)]) == []   # first sight
+        clock["t"] = 5.0
+        assert det.observe([self._st(log=100)]) == []   # silent < timeout
+        clock["t"] = 11.0
+        wedged = det.observe([self._st(log=100)])
+        assert [w["rank"] for w in wedged] == [0]
+        assert det.silence_s(0) == 11.0
+
+    def test_any_progress_resets_the_clock(self):
+        from paddle_tpu.distributed.launch.main import HangDetector
+        clock = {"t": 0.0}
+        det = HangDetector(10.0, now_fn=lambda: clock["t"])
+        det.observe([self._st(log=100, hb=10)])
+        clock["t"] = 9.0
+        det.observe([self._st(log=100, hb=11)])   # heartbeat file grew
+        clock["t"] = 18.0
+        assert det.observe([self._st(log=100, hb=11)]) == []  # 9s silent
+        clock["t"] = 19.5
+        assert [w["rank"] for w in
+                det.observe([self._st(log=100, hb=11)])] == [0]
+
+    def test_dead_rank_never_wedged_and_new_pid_resets(self):
+        from paddle_tpu.distributed.launch.main import HangDetector
+        clock = {"t": 0.0}
+        det = HangDetector(10.0, now_fn=lambda: clock["t"])
+        det.observe([self._st(pid=100)])
+        clock["t"] = 20.0
+        # the rank exited: exit-code babysitting owns it, not the
+        # hang detector
+        assert det.observe([self._st(pid=100, alive=False)]) == []
+        # restarted under a new pid: fresh clock
+        assert det.observe([self._st(pid=200)]) == []
+        clock["t"] = 25.0
+        assert det.observe([self._st(pid=200)]) == []
+
+    def test_stale_heartbeat_kill_restart(self, tmp_path, capfd):
+        """The integration path: a worker beats once then wedges
+        (alive, silent) -> detector SIGKILLs it -> normal elastic
+        restart -> the epoch-1 worker completes. Wall-clock bounded by
+        the sub-second hang timeout, not the 600s wedge."""
+        import time
+        import paddle_tpu.observability as obs
+        script = _write(tmp_path, "wedge.py", """
+            import json, os, sys, time
+            hb = os.environ["PADDLE_RANK_HEARTBEAT"]
+            epoch = os.environ["PADDLE_RESTART_EPOCH"]
+            with open(hb, "a") as f:
+                f.write(json.dumps({"ts": time.time(),
+                                    "kind": "heartbeat",
+                                    "phase": "boot",
+                                    "epoch": epoch}) + "\\n")
+            if epoch == "0":
+                time.sleep(600)      # the wedge: alive pid, silence
+            print("done", flush=True)
+        """)
+        ctx = parse_args(["--nproc_per_node", "1", "--max_restart", "2",
+                          "--hang_timeout", "0.6",
+                          "--heartbeat_interval", "0.1",
+                          "--restart_backoff", "0.01",
+                          "--log_dir", str(tmp_path / "log"), script])
+        before = _hang_count()
+        t0 = time.time()
+        assert launch(ctx) == 0
+        assert time.time() - t0 < 60          # not the 600s wedge
+        assert _hang_count() >= before + 1
+        err = capfd.readouterr().err
+        assert "wedged" in err and "'boot'" in err   # last phase named
+        assert "MTTR" in err
+        g = obs.get_registry().get("robustness.mttr_seconds")
+        assert g is not None and [s.value for s in g.samples()]
+
+    def test_hang_timeout_disabled_by_default(self):
+        ctx = parse_args(["train.py"])
+        assert ctx.hang_timeout_s == 0.0
+        ctx = parse_args(["--hang_timeout", "12.5", "train.py"])
+        assert ctx.hang_timeout_s == 12.5
+
+
+def _hang_count():
+    import paddle_tpu.observability as obs
+    m = obs.get_registry().get("robustness.hangs_detected")
+    return sum(s.value for s in m.samples()) if m else 0.0
+
+
 class TestElasticCoordination:
     def test_peer_restart_broadcast(self):
         """A failed node's restart request must be visible to healthy

@@ -200,6 +200,179 @@ class TestVerifiedCheckpointer:
         assert ckpt.steps() == [3, 4]
 
 
+class TestAsyncVerifiedCheckpointer:
+    """The async drain (PR 7): save() pays only the device->host
+    snapshot; the atomic/verified/retry pipeline runs in background;
+    wait() blocks on the drain (optionally with a deadline); restore
+    only ever sees fully-landed checkpoints."""
+
+    def _mk(self, tmp_path, **kw):
+        from paddle_tpu.distributed.checkpoint import VerifiedCheckpointer
+        kw.setdefault("backoff_s", 0.01)
+        kw.setdefault("async_save", True)
+        return VerifiedCheckpointer(str(tmp_path / "ck"), **kw)
+
+    def test_save_does_not_block_on_slow_store(self, tmp_path):
+        import time
+        ckpt = self._mk(tmp_path)
+        paddle.set_flags(
+            {"fault_injection": "ckpt_slow:times=0:sleep=0.4"})
+        t0 = time.perf_counter()
+        ckpt.save(1, _tree(1))
+        dt = time.perf_counter() - t0
+        assert dt < 0.2, f"async save blocked {dt:.3f}s"
+        g = obs.get_registry().get("robustness.ckpt_stall_seconds")
+        assert g is not None
+        assert [s.value for s in g.samples()][-1] < 0.2
+        assert ckpt.wait(timeout_s=10)
+        assert ckpt.verify(1)[0]
+        paddle.set_flags({"fault_injection": ""})
+        # contrast: the synchronous store pays the stall in save()
+        from paddle_tpu.distributed.checkpoint import VerifiedCheckpointer
+        sync = VerifiedCheckpointer(str(tmp_path / "sync"))
+        paddle.set_flags(
+            {"fault_injection": "ckpt_slow:times=0:sleep=0.4"})
+        t0 = time.perf_counter()
+        sync.save(1, _tree(1))
+        assert time.perf_counter() - t0 >= 0.4
+
+    def test_wait_deadline_expires_then_drains(self, tmp_path):
+        ckpt = self._mk(tmp_path)
+        paddle.set_flags(
+            {"fault_injection": "ckpt_slow:times=0:sleep=0.5"})
+        before = _counter_total("robustness.ckpt_drain_timeouts")
+        ckpt.save(1, _tree(1))
+        assert ckpt.wait(timeout_s=0.05) is False
+        assert _counter_total("robustness.ckpt_drain_timeouts") \
+            >= before + 1
+        assert ckpt.wait(timeout_s=10) is True   # daemon kept draining
+        assert ckpt.verify(1)[0]
+
+    def test_async_retry_recovers_in_background(self, tmp_path):
+        ckpt = self._mk(tmp_path)
+        paddle.set_flags({"fault_injection": "ckpt_save:hit=1:err"})
+        before = _counter_total("robustness.ckpt_retries")
+        ckpt.save(1, _tree(1))
+        assert ckpt.wait(timeout_s=10)
+        assert ckpt.verify(1)[0]
+        assert _counter_total("robustness.ckpt_retries") >= before + 1
+
+    def test_drain_failure_surfaces_at_wait(self, tmp_path):
+        ckpt = self._mk(tmp_path, retries=1)
+        paddle.set_flags({"fault_injection": "ckpt_save:times=0:err"})
+        ckpt.save(1, _tree(1))   # returns immediately
+        with pytest.raises(OSError):
+            ckpt.wait(timeout_s=10)
+        assert ckpt.restore_latest() is None
+
+    def test_crash_mid_drain_falls_back_to_last_verified(self, tmp_path):
+        """The elastic-restart contract: a process killed while a drain
+        is mid-write leaves only fully-landed checkpoints — the
+        restarted process restores the last VERIFIED step."""
+        import threading
+        from paddle_tpu.distributed.checkpoint import VerifiedCheckpointer
+        ckpt = self._mk(tmp_path)
+        ckpt.save(2, _tree(2))
+        assert ckpt.wait(timeout_s=10)
+        # the step-4 drain wedges inside the store; the "crash" is
+        # simply never waiting (a killed process's daemon dies mid-write
+        # — atomic rename means nothing partial lands under a step name)
+        gate = threading.Event()
+        ckpt._save_with_retry = lambda *a, **kw: gate.wait()
+        ckpt.save(4, _tree(4))
+        fresh = VerifiedCheckpointer(str(tmp_path / "ck"))  # restarted
+        step, tree, _ = fresh.restore_latest()
+        assert step == 2
+        assert int(np.asarray(tree["step"])) == 2
+        gate.set()   # unwedge the daemon before teardown
+
+    def test_gc_never_collects_inflight_drain(self, tmp_path):
+        """Keep-list race: a step whose drain has not landed must
+        survive every other save's gc pass."""
+        ckpt = self._mk(tmp_path, max_to_keep=1, async_save=False)
+        ckpt.save(3, _tree(3))
+        with ckpt._cv:
+            ckpt._pending.add(3)   # a re-drain of 3 still in flight
+        ckpt.save(4, _tree(4))     # gc would normally collect 3
+        assert set(ckpt.steps()) == {3, 4}
+        with ckpt._cv:
+            ckpt._pending.discard(3)
+        ckpt.save(5, _tree(5))     # landed -> collectable again
+        assert ckpt.steps() == [5]
+
+    def test_snapshot_is_owned_not_a_view(self, tmp_path):
+        """The step-boundary contract: mutating a numpy-backed leaf
+        AFTER save() returns must not change what the drain writes
+        (np.asarray is a no-copy identity for ndarrays)."""
+        import threading
+        ckpt = self._mk(tmp_path)
+        tree = _tree(1)
+        want = tree["model"]["w"].copy()
+        gate = threading.Event()
+        orig = ckpt._save_with_retry
+
+        def gated(step, flat, meta):
+            gate.wait(timeout=10)    # hold the drain past the mutation
+            return orig(step, flat, meta)
+
+        ckpt._save_with_retry = gated
+        ckpt.save(1, tree)
+        tree["model"]["w"][:] = -999.0   # caller reuses its buffer
+        gate.set()
+        assert ckpt.wait(timeout_s=10)
+        _, restored, _ = ckpt.restore_latest()
+        np.testing.assert_array_equal(restored["model"]["w"], want)
+
+    def test_fifo_drain_ordering_and_close(self, tmp_path):
+        ckpt = self._mk(tmp_path, max_to_keep=2)
+        for s in (1, 2, 3, 4):
+            ckpt.save(s, _tree(s))
+        assert ckpt.wait(timeout_s=10)
+        assert ckpt.steps() == [3, 4]
+        ckpt.close()
+
+
+class TestCollectiveTimeout:
+    """The collective deadline (PR 7): a peer that never shows up
+    raises CollectiveTimeoutError instead of hanging forever."""
+
+    def teardown_method(self, method):
+        paddle.set_flags({"collective_timeout_s": 0.0,
+                          "fault_injection": ""})
+
+    def test_wait_times_out_on_stall(self):
+        import paddle_tpu.distributed as dist
+        paddle.set_flags({"collective_timeout_s": 0.2,
+                          "fault_injection": "collective_stall:sleep=5"})
+        t = paddle.to_tensor(np.zeros(4, np.float32))
+        before = _counter_total("robustness.collective_timeouts")
+        with pytest.raises(dist.CollectiveTimeoutError, match="0.2s"):
+            dist.wait(t)
+        assert _counter_total("robustness.collective_timeouts") \
+            >= before + 1
+
+    def test_wait_resolves_within_deadline(self):
+        import paddle_tpu.distributed as dist
+        paddle.set_flags({"collective_timeout_s": 5.0})
+        t = paddle.to_tensor(np.ones(4, np.float32)) * 2
+        out = dist.wait(t)
+        np.testing.assert_allclose(out.numpy(), np.full(4, 2.0))
+
+    def test_barrier_timeout_and_explicit_override(self):
+        import paddle_tpu.distributed as dist
+        paddle.set_flags({"fault_injection": "collective_stall:sleep=5"})
+        with pytest.raises(dist.CollectiveTimeoutError):
+            dist.barrier(timeout_s=0.2)     # explicit beats the flag
+        paddle.set_flags({"fault_injection": ""})
+        dist.barrier(timeout_s=0.5)         # healthy: no trip
+
+    def test_disabled_deadline_blocks_normally(self):
+        import paddle_tpu.distributed as dist
+        t = paddle.to_tensor(np.zeros(2, np.float32))
+        dist.wait(t)          # FLAGS_collective_timeout_s=0: plain sync
+        dist.barrier()
+
+
 # ---------------------------------------------------------------------------
 # trainer: anomaly guard, preemption, fingerprint
 # ---------------------------------------------------------------------------
@@ -342,6 +515,58 @@ class TestTrainerPreemption:
         tr.train(resume=False)
         assert _t.perf_counter() - t0 >= 0.2
         assert any(e["site"] == "slow_step" for e in faults.events())
+
+    def test_rank_hang_fault_wedges_the_loop(self, tmp_path):
+        import time as _t
+        paddle.set_flags(
+            {"fault_injection": "rank_hang:step=1:sleep=0.3"})
+        tr = _trainer(tmp_path, max_steps=2, save_steps=100)
+        t0 = _t.perf_counter()
+        tr.train(resume=False)
+        assert _t.perf_counter() - t0 >= 0.3
+        assert any(e["site"] == "rank_hang" for e in faults.events())
+
+    def test_sigterm_drain_deadline_bounds_exit(self, tmp_path):
+        """Just-in-time preemption checkpoint: the SIGTERM path drains
+        the async checkpoint queue but gives up at
+        FLAGS_ckpt_drain_deadline_s instead of hanging the grace window
+        on a wedged store (the save keeps draining on its daemon)."""
+        import time as _t
+        paddle.set_flags({
+            "fault_injection":
+                "sigterm:step=2,ckpt_slow:times=0:sleep=3",
+            "ckpt_drain_deadline_s": 0.2})
+        before = _counter_total("robustness.ckpt_drain_timeouts")
+        try:
+            tr = _trainer(tmp_path, max_steps=10, save_steps=2)
+            t0 = _t.perf_counter()
+            res = tr.train(resume=False)
+            dt = _t.perf_counter() - t0
+            assert res["preempted"]
+            # two 3s-stalled saves (step 2 + the preemption save) must
+            # NOT be paid synchronously before exit
+            assert dt < 3.0, f"drain deadline did not bound exit ({dt:.1f}s)"
+            assert _counter_total("robustness.ckpt_drain_timeouts") \
+                >= before + 1
+            # the drain finishes in background: the preemption ckpt lands
+            assert tr._ckpt_mgr().wait(timeout_s=30)
+            assert tr._ckpt_mgr().latest_verified() is not None
+        finally:
+            paddle.set_flags({"ckpt_drain_deadline_s": 30.0})
+
+    def test_trainer_heartbeat_env_wires_rank_file(self, tmp_path,
+                                                   monkeypatch):
+        hb_path = str(tmp_path / "hb" / "heartbeat_rank0.jsonl")
+        monkeypatch.setenv("PADDLE_RANK_HEARTBEAT", hb_path)
+        monkeypatch.setenv("PADDLE_RANK_HEARTBEAT_INTERVAL", "0.01")
+        res = _trainer(tmp_path, max_steps=3, save_steps=100
+                       ).train(resume=False)
+        assert res["final_step"] == 3
+        import json as _json
+        recs = [_json.loads(line) for line in open(hb_path)]
+        phases = [r.get("phase") for r in recs]
+        assert "init" in phases and "resumed" in phases
+        assert res["goodput"] == 1.0
 
 
 class TestTreedefFingerprint:

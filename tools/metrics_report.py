@@ -306,8 +306,26 @@ def render(last, spans=None) -> str:
                 f"{100.0 * r.get('value', 0):.1f}%"
                 for lb, r in sorted(pp.items())))
 
+    # recovery SLOs: gauges, not counters — formatted as measurements
+    _SLO = ("robustness.mttr_seconds", "robustness.goodput",
+            "robustness.ckpt_stall_seconds")
+    mttr = _one(last, "robustness.mttr_seconds")
+    goodput = _one(last, "robustness.goodput")
+    stall = _one(last, "robustness.ckpt_stall_seconds")
+    if mttr or goodput or stall:
+        w("== recovery SLOs ==")
+        if mttr:
+            w(f"  MTTR            {mttr.get('value', 0):.2f}s"
+              "   (hang detection -> restarted rank progressing)")
+        if goodput:
+            w(f"  goodput         {100.0 * goodput.get('value', 0):.1f}%"
+              "   (useful-step fraction)")
+        if stall:
+            w(f"  ckpt_stall      {stall.get('value', 0) * 1e3:.1f}ms"
+              "   (train-step time paid by the last save)")
+
     rob = {k: rec for k, rec in last.items()
-           if k[0].startswith("robustness.")}
+           if k[0].startswith("robustness.") and k[0] not in _SLO}
     if rob:
         w("== robustness (cumulative) ==")
         for key in sorted(rob):

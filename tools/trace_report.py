@@ -15,12 +15,19 @@ flight-recorder dump (flight_<pid>.json), and renders:
   / loss-sync child phases as aligned bars.
 - **Site table** — duration stats per span name (every instrumented
   site: serve.*, train.*, ckpt.*, dist.compile, comm.*, launch.epoch,
-  bench.backend_init).
+  launch.recovery, bench.backend_init).
+- **Recovery timeline** (`--recovery`) — the hang→kill→restart→resume
+  incident reconstruction: the wedged rank's last heartbeat, the
+  stale-heartbeat detector's kill, the restart epoch, the resume step,
+  and the measured MTTR, from launch.* spans plus heartbeat JSONL
+  (`--heartbeat <log_dir>/heartbeat_rank0.jsonl`, repeatable).
 
     python tools/trace_report.py telemetry.jsonl
     python tools/trace_report.py telemetry.jsonl --requests 10
     python tools/trace_report.py telemetry.jsonl --request req3
     python tools/trace_report.py flight_1234.json --chrome trace.json
+    python tools/trace_report.py telemetry.jsonl --recovery \
+        --heartbeat log/heartbeat_rank0.jsonl
 
 No paddle_tpu import needed — this runs anywhere there is a file.
 """
@@ -59,6 +66,120 @@ def load_spans(path: str) -> List[dict]:
             if rec.get("kind") == "span":
                 out.append(rec)
         return out
+
+
+def load_heartbeats(paths: List[str]) -> List[dict]:
+    """`{"kind": "heartbeat"}` lines from heartbeat.jsonl /
+    heartbeat_rank*.jsonl / telemetry files (missing files skipped)."""
+    out = []
+    for path in paths:
+        try:
+            f = open(path)
+        except (FileNotFoundError, TypeError):
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") == "heartbeat" and "ts" in rec:
+                    out.append(rec)
+    out.sort(key=lambda r: r["ts"])
+    return out
+
+
+def render_recovery(spans: List[dict], beats: List[dict]) -> str:
+    """Incident timeline for a hang→kill→restart→resume episode: the
+    wedged rank's last heartbeat, the detector's kill, the restart
+    epoch, and the resume step — one chronological view over the
+    launcher spans (launch.epoch / launch.recovery) and the per-rank
+    worker heartbeats, ending with the measured MTTR."""
+    ev = []  # (ts, text)
+    mttrs = []
+    for s in spans:
+        name = s.get("name")
+        lab = s.get("labels") or {}
+        start = float(s.get("start", 0.0))
+        dur = float(s.get("dur") or 0.0)
+        if name == "launch.epoch":
+            ev.append((start, f"epoch {lab.get('epoch', '?')} start "
+                              f"(restarts={lab.get('restarts', '?')})"))
+            for e in s.get("events") or []:
+                en = e.get("name")
+                at = {k: v for k, v in e.items()
+                      if k not in ("ts", "name")}
+                if en == "hang_detected":
+                    ev.append((e["ts"],
+                               f"HANG DETECTED rank={at.get('rank')} "
+                               f"pid={at.get('pid')} silent "
+                               f"{at.get('silent_s')}s, last phase "
+                               f"{at.get('phase')!r}"
+                               + (f" step {at.get('step')}"
+                                  if at.get("step") is not None else "")
+                               + " -> SIGKILL"))
+                elif en == "pod_exit":
+                    ev.append((e["ts"],
+                               f"pod exit rc={at.get('rc')} -> restart"))
+                else:
+                    ev.append((e["ts"], f"{en} {at}"))
+            if s.get("status") is not None:
+                ev.append((start + dur,
+                           f"epoch {lab.get('epoch', '?')} end "
+                           f"({s.get('status')})"))
+        elif name == "launch.recovery":
+            ev.append((start, f"recovery window opened (rank "
+                              f"{lab.get('rank')}, wedged in phase "
+                              f"{lab.get('phase')!r})"))
+            m = lab.get("mttr_s")
+            ev.append((start + dur,
+                       f"recovery {s.get('status', '?')}"
+                       + (f": MTTR {m}s" if m is not None else "")))
+            if m is not None and s.get("status") == "ok":
+                mttrs.append(float(m))
+    # worker heartbeats: phase transitions + the silence gaps between
+    # beats (a wedged rank reads as one long gap ending in the kill)
+    by_rank: Dict[str, List[dict]] = {}
+    for b in beats:
+        if "ranks" in b:    # launcher pod snapshots: skip, too chatty
+            continue
+        by_rank.setdefault(str(b.get("rank", "?")), []).append(b)
+    for rank, bs in sorted(by_rank.items()):
+        prev = None
+        for b in bs:
+            gap = (b["ts"] - prev["ts"]) if prev else 0.0
+            if prev is not None and gap > 2.0:
+                ev.append((prev["ts"],
+                           f"rank {rank} last beat before {gap:.1f}s "
+                           f"gap: phase {prev.get('phase')!r}"
+                           + (f" step {prev.get('step')}"
+                              if prev.get("step") is not None else "")))
+            if prev is None or b.get("phase") != prev.get("phase") \
+                    or gap > 2.0:
+                ev.append((b["ts"],
+                           f"rank {rank} beat: phase {b.get('phase')!r}"
+                           + (f" step {b.get('step')}"
+                              if b.get("step") is not None else "")))
+            prev = b
+    if not ev:
+        return ("(no recovery timeline: need launch.epoch/"
+                "launch.recovery spans and/or heartbeat lines — pass "
+                "the telemetry JSONL and --heartbeat "
+                "<log_dir>/heartbeat_rank*.jsonl)")
+    ev.sort(key=lambda t: t[0])
+    t0 = ev[0][0]
+    out = ["== recovery timeline =="]
+    for ts, text in ev:
+        out.append(f"  +{ts - t0:9.3f}s  {text}")
+    if mttrs:
+        out.append(f"  MTTR (detection -> restarted rank progressing): "
+                   f"{mttrs[-1]:.3f}s"
+                   + (f" (episodes: {len(mttrs)})"
+                      if len(mttrs) > 1 else ""))
+    return "\n".join(out)
 
 
 def percentile(xs: List[float], q: float) -> float:
@@ -295,14 +416,26 @@ def main(argv=None) -> int:
                     help="print one request's full event timeline")
     ap.add_argument("--chrome", default=None,
                     help="also write Chrome-trace/Perfetto JSON here")
+    ap.add_argument("--recovery", action="store_true",
+                    help="incident-timeline view: last heartbeat -> "
+                         "hang detection -> kill -> restart epoch -> "
+                         "resume, from launch.* spans + heartbeats")
+    ap.add_argument("--heartbeat", action="append", default=[],
+                    help="additional heartbeat JSONL file(s) for "
+                         "--recovery (e.g. <log_dir>/"
+                         "heartbeat_rank0.jsonl); repeatable")
     a = ap.parse_args(argv)
     try:
         spans = load_spans(a.path)
     except FileNotFoundError:
         print(f"no such file: {a.path}", file=sys.stderr)
         return 1
-    print(render(spans, top_requests=a.requests,
-                 waterfall_steps=a.steps, request_id=a.request))
+    if a.recovery:
+        beats = load_heartbeats([a.path] + list(a.heartbeat))
+        print(render_recovery(spans, beats))
+    else:
+        print(render(spans, top_requests=a.requests,
+                     waterfall_steps=a.steps, request_id=a.request))
     if a.chrome:
         with open(a.chrome, "w") as f:
             json.dump(to_chrome_trace(spans), f)

@@ -402,6 +402,14 @@ def serve_bench(argv=None):
     ap.add_argument("--out", default=None, help="telemetry JSONL path")
     ap.add_argument("--multitenant", action="store_true",
                     help="run the multi-tenant router/tier scenario")
+    ap.add_argument("--coldstart", action="store_true",
+                    help="run the AOT cold-start scenario instead: "
+                         "cold vs engine-warm-started "
+                         "cold-start-to-first-token")
+    ap.add_argument("--engine-dir", default=None,
+                    help="[coldstart] engine bundle directory (default: "
+                         "a temp dir; pass a persistent path to measure "
+                         "cross-process warm starts)")
     ap.add_argument("--sessions", type=int, default=None,
                     help="[mt] distinct prompt-prefix sessions")
     ap.add_argument("--requests", type=int, default=None,
@@ -411,6 +419,8 @@ def serve_bench(argv=None):
     a = ap.parse_args(argv)
     if a.multitenant:
         return serve_mt_bench(a)
+    if a.coldstart:
+        return serve_coldstart_bench(a)
 
     import jax
     import paddle_tpu as paddle
@@ -512,6 +522,181 @@ def serve_bench(argv=None):
     }
     print(json.dumps(result))
     return 0
+
+
+def serve_coldstart_bench(a):
+    """AOT cold-start scenario (`bench.py --serve --coldstart`):
+    measures **cold-start-to-first-token** — the restart SLO the PR-7
+    elastic path pays and serving-on-TPU comparisons treat as
+    first-class (PAPERS.md, arxiv 2605.25645) — cold (live JIT: every
+    program traces + compiles before the first token) vs warm-started
+    from a serialized AOT engine bundle (paddle_tpu.inference.aot:
+    file loads, zero compilation).
+
+    Everything flows through the observability JSONL sink and the
+    claims are asserted FROM the telemetry:
+
+    - `serve.cold_start_seconds{mode="cold"|"warm"}` gauge samples for
+      both arms (recorded by the predictor at its first token);
+    - the warm arm served its first token **without compiling**: zero
+      `aot.compile_fallback` spans and zero `dist.compile` spans after
+      the warm-arm start marker, and `aot.bucket_misses` did not move;
+    - every warm-arm program came from the bundle (`aot.bundle_hits`
+      > 0 and `warm_hit_programs == cold compiled programs`).
+
+    Exit 0 = warm start compiled nothing; 1 = an assertion failed.
+    """
+    import tempfile
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import runtime as obs_rt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ContinuousBatchingPredictor, aot
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        buckets, batch, page, max_seq = (128, 256), 4, 16, 1024
+        max_new = a.max_new or 16
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        buckets, batch, page, max_seq = (8, 16), 2, 8, 64
+        max_new = a.max_new or 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    rng = np.random.RandomState(0)
+
+    # one prompt per bucket, length == bucket so admission compiles
+    # (cold) / dispatches (warm) exactly the calibrated signatures; the
+    # SAME prompts in both arms (greedy parity check) with the prefix
+    # cache off — the number under test is compilation, not KV reuse
+    prompts = [rng.randint(2, cfg.vocab_size, (b,)).tolist()
+               for b in buckets]
+
+    engine_dir = a.engine_dir or os.path.join(
+        tempfile.mkdtemp(prefix="aot_coldstart_"), "engine")
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "output", "telemetry_coldstart.jsonl")
+    was_enabled = obs.enabled()
+    obs.enabled(True)
+    obs_rt.configure(path)
+    reg = obs.get_registry()
+    reg.reset()
+
+    def gauge_mode(mode):
+        m = reg.get("serve.cold_start_seconds")
+        if not m:
+            return None
+        vals = [s.value for s in m.samples()
+                if s.labels.get("mode") == mode]
+        return vals[-1] if vals else None
+
+    def ctr(name):
+        m = reg.get(name)
+        return sum(s.value for s in m.samples()) if m else 0.0
+
+    try:
+        # ---- arm 1: cold — live JIT from a fresh predictor ----------
+        t0 = time.perf_counter()
+        cb = ContinuousBatchingPredictor(
+            model, max_batch_size=batch, page_size=page,
+            max_seq_len=max_seq, enable_prefix_cache=False)
+        cold_out = cb.generate(prompts, max_new_tokens=max_new)
+        cold_wall = time.perf_counter() - t0
+        cold_s = gauge_mode("cold")
+
+        # ---- build the bundle (the offline half; spans -> sink) -----
+        t0 = time.perf_counter()
+        manifest = aot.build_engine(
+            model, engine_dir, prompt_buckets=buckets,
+            batch_sizes=(1, batch), max_batch_size=batch,
+            page_size=page, max_seq_len=max_seq,
+            enable_prefix_cache=False)
+        build_s = time.perf_counter() - t0
+        _log(f"engine built: {len(manifest['artifacts'])} artifacts "
+             f"in {build_s:.1f}s -> {engine_dir}")
+
+        # ---- arm 2: warm — loaded bundle, zero compilation ----------
+        misses_before = ctr("aot.bucket_misses")
+        t_warm = time.time()     # telemetry marker (span ts are wall)
+        t0 = time.perf_counter()
+        warm_cb, engine = aot.warm_start(model, engine_dir)
+        warm_out = warm_cb.generate(prompts,
+                                    max_new_tokens=max_new)
+        warm_wall = time.perf_counter() - t0
+        warm_s = gauge_mode("warm")
+        obs_rt.maybe_export()   # metric snapshot + spans into the sink
+
+        # ---- assertions, FROM the telemetry file --------------------
+        compile_spans = []
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") == "span" \
+                        and rec.get("name") in ("aot.compile_fallback",
+                                                "dist.compile") \
+                        and float(rec.get("start", 0)) >= t_warm - 0.5:
+                    compile_spans.append(rec["name"])
+        sunk_modes = set()
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("name") == "serve.cold_start_seconds":
+                    sunk_modes.add(
+                        (rec.get("labels") or {}).get("mode"))
+        checks = {
+            "cold_recorded": cold_s is not None,
+            "warm_recorded": warm_s is not None,
+            "sink_has_both_modes": {"cold", "warm"} <= sunk_modes,
+            "warm_served": warm_out == cold_out,
+            "warm_zero_compile_spans": not compile_spans,
+            "warm_zero_bucket_misses":
+                ctr("aot.bucket_misses") == misses_before,
+            "warm_hit_bundle": engine.stats["hits"] > 0
+            and engine.stats["misses"] == 0,
+        }
+        ok = all(checks.values())
+    finally:
+        obs_rt.configure(None)
+        obs.enabled(was_enabled)
+
+    result = {
+        "metric": "serve_cold_start_seconds_warm",
+        "value": round(warm_s, 4) if warm_s is not None else None,
+        "unit": "s",
+        "aux": {
+            "backend": jax.default_backend(),
+            "cold_start_s": round(cold_s, 4) if cold_s else None,
+            "cold_wall_s": round(cold_wall, 4),
+            "warm_wall_s": round(warm_wall, 4),
+            "speedup": round(cold_s / warm_s, 2)
+            if cold_s and warm_s else None,
+            "build_s": round(build_s, 2),
+            "artifacts": len(manifest["artifacts"]),
+            "engine_dir": engine_dir,
+            "buckets": list(buckets), "max_new": max_new,
+            "checks": checks,
+            "telemetry": path,
+            "bench_code_sha": _bench_code_sha(),
+        },
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
 
 
 def serve_mt_bench(a):

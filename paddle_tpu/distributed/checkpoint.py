@@ -18,7 +18,6 @@ the on-disk-corruption paths are exercisable in CI via the
 from __future__ import annotations
 
 import collections
-import hashlib
 import json
 import logging
 import os
@@ -33,6 +32,7 @@ import jax
 
 from ..tensor import Tensor, Parameter
 from ..framework import faults as _faults
+from ..framework import integrity as _integrity
 from ..observability import metrics as _obsm
 from ..observability import tracing as _obstr
 
@@ -228,12 +228,11 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def _sha256_file(path: str) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
+# digest/atomic-write primitives live in framework.integrity (shared
+# with the inference.aot engine bundle — one implementation of the
+# durability contract); kept as a module-level alias for existing
+# callers/tests
+_sha256_file = _integrity.sha256_file
 
 
 class VerifiedCheckpointer:
@@ -414,19 +413,13 @@ class VerifiedCheckpointer:
         if fa is not None and fa.mode == "err":
             raise IOError(f"injected ckpt_save fault at step {step}")
         wf = _faults.check("ckpt_write", step=step)
-        tmp = os.path.join(self._dir, f".tmp-{int(step)}-{os.getpid()}")
+        tmp = _integrity.tmp_name(self._step_dir(step))
         shutil.rmtree(tmp, ignore_errors=True)
         # sweep THIS process's orphan temp dirs from earlier failed
-        # attempts only — another rank sharing the directory may have a
-        # save in flight under its own pid, and deleting it would turn
-        # one transient fault into a cross-rank failure. Foreign
-        # orphans are dot-dirs steps() ignores; they cost disk, not
-        # correctness.
-        suffix = f"-{os.getpid()}"
-        for n in os.listdir(self._dir):
-            if n.startswith(".tmp-") and n.endswith(suffix):
-                shutil.rmtree(os.path.join(self._dir, n),
-                              ignore_errors=True)
+        # attempts only (integrity.sweep_tmp never touches another
+        # pid's in-flight save). Foreign orphans are dot-dirs steps()
+        # ignores; they cost disk, not correctness.
+        _integrity.sweep_tmp(self._dir)
         os.makedirs(tmp)
         try:
             manifest = {"format": 1, "step": int(step), "meta": meta or {},
@@ -440,16 +433,14 @@ class VerifiedCheckpointer:
                 with open(fpath, "wb") as f:
                     f.write(np.ascontiguousarray(arr).tobytes())
                 manifest["arrays"][key] = {
-                    "file": fname, "sha256": _sha256_file(fpath),
+                    "file": fname,
+                    "sha256": _integrity.sha256_file(fpath),
                     "shape": list(arr.shape), "dtype": str(arr.dtype)}
             if wf is not None and wf.mode == "err":
                 raise IOError(f"injected ckpt_write fault at step {step}")
             with open(os.path.join(tmp, _MANIFEST), "w") as f:
                 json.dump(manifest, f)
-            final = self._step_dir(step)
-            if os.path.exists(final):
-                shutil.rmtree(final)
-            os.replace(tmp, final)
+            final = _integrity.replace_dir(tmp, self._step_dir(step))
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
@@ -510,7 +501,7 @@ class VerifiedCheckpointer:
             fpath = os.path.join(d, rec["file"])
             if not os.path.exists(fpath):
                 return False, f"missing array file for {key!r}"
-            if _sha256_file(fpath) != rec["sha256"]:
+            if _integrity.sha256_file(fpath) != rec["sha256"]:
                 return False, f"digest mismatch for {key!r}"
         return True, "ok"
 

@@ -35,6 +35,7 @@ class Context:
     restart_backoff_s: float = 0.5       # base; doubles per restart
     restart_backoff_max_s: float = 60.0  # cap before jitter
     hang_timeout_s: float = 0.0          # stale-rank detector; <=0 off
+    engine_dir: Optional[str] = None     # AOT engine bundle for workers
 
     @property
     def world_size(self) -> int:
@@ -87,6 +88,15 @@ def parse_args(argv=None) -> Context:
                         "silent phase (backend init, compile, restore). "
                         "<=0 disables (an external operator must notice "
                         "the hang)")
+    p.add_argument("--engine_dir", type=str,
+                   default=os.environ.get("PADDLE_TPU_ENGINE_DIR"),
+                   help="AOT engine bundle directory "
+                        "(paddle_tpu.inference.aot), exported to every "
+                        "rank as PADDLE_TPU_ENGINE_DIR across ALL "
+                        "restart epochs — a restarted serving worker "
+                        "warm-starts from the bundle (file loads) "
+                        "instead of recompiling its programs, which is "
+                        "most of the restart MTTR (docs/DEPLOYMENT.md)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -101,7 +111,7 @@ def parse_args(argv=None) -> Context:
         heartbeat_interval=a.heartbeat_interval,
         restart_backoff_s=a.restart_backoff,
         restart_backoff_max_s=a.restart_backoff_max,
-        hang_timeout_s=a.hang_timeout)
+        hang_timeout_s=a.hang_timeout, engine_dir=a.engine_dir)
 
 
 def restart_delay(restarts: int, base_s: float, cap_s: float) -> float:
@@ -144,6 +154,12 @@ class PodController:
                 ctx.heartbeat_interval if ctx.heartbeat_interval > 0
                 else 1.0),
         })
+        if ctx.engine_dir:
+            # every restart epoch warm-starts from the same AOT bundle
+            # (inference.aot.warm_start reads this by default): restart
+            # cost is file loads, not recompiles
+            env["PADDLE_TPU_ENGINE_DIR"] = os.path.abspath(
+                ctx.engine_dir)
         if ctx.master:
             env["PADDLE_MASTER"] = ctx.master
             host, port = ctx.master.rsplit(":", 1)

@@ -458,9 +458,19 @@ class ContinuousBatchingPredictor:
                  eos_token_id=None, kv_dtype=None, use_ragged="auto",
                  enable_prefix_cache=True, max_queue=None,
                  shed_policy="newest", decode_watchdog_s=None,
-                 name=None):
+                 name=None, engine=None):
         import math as _m
+        import time as _time
         model.eval()
+        # AOT warm start (inference.aot): when an engine is attached,
+        # _jit_call consults its serialized-executable table first — a
+        # bucket hit dispatches with ZERO trace/compile; a miss falls
+        # back to live JIT and writes the new executable back into the
+        # bundle. serve.cold_start_seconds (construction → first token)
+        # is recorded either way, labeled cold/warm.
+        self._engine = engine
+        self._t_ctor = _time.perf_counter()
+        self._cold_start_pending = True
         # `name` identifies this predictor as one replica of a pool
         # (serving/router.py): when set, every serving.* metric and
         # serve.request span carries a replica=<name> label so
@@ -612,7 +622,20 @@ class ContinuousBatchingPredictor:
         traced by THIS predictor yet — see _trace_lock above. The set
         is per-predictor (each has its own jit wrappers/cache), and the
         serve loop is single-threaded per predictor, so the unlocked
-        fast path never races its own first trace."""
+        fast path never races its own first trace.
+
+        With an AOT engine attached (inference.aot), the engine's
+        serialized-executable table is consulted first: a hit executes
+        the deserialized program directly (no trace, no compile —
+        aot.bundle_hits); a miss AOT-compiles live under the trace
+        lock, serves the result, and writes the executable back into
+        the bundle (aot.bucket_misses + aot.compile_fallback span)."""
+        if self._engine is not None:
+            hit = self._engine.get(sig)
+            if hit is not None:
+                return hit(*args)
+            return self._engine.compile_fallback(sig, fn, args,
+                                                 self._trace_lock)
         if sig in self._traced_sigs:
             return fn(*args)
         with self._trace_lock:
@@ -1178,6 +1201,22 @@ class ContinuousBatchingPredictor:
             status[r] = "running"
             req_sp[r].event("admitted", slot=b)
             req_sp[r].event("first_token")
+            if self._cold_start_pending:
+                # cold-start-to-first-token SLO (docs/DEPLOYMENT.md):
+                # construction → first token, once per predictor. A
+                # warm AOT engine turns this from minutes of compile
+                # into file loads — mode labels the two regimes. The
+                # builder's calibration predictor (recording engine)
+                # is not serving and records nothing.
+                self._cold_start_pending = False
+                if not (self._engine is not None
+                        and getattr(self._engine, "recording", False)):
+                    _obsm.gauge("serve.cold_start_seconds",
+                                unit="s").set(
+                        _time.perf_counter() - self._t_ctor,
+                        mode=("warm" if self._engine is not None
+                              and self._engine.warm else "cold"),
+                        **self._mlbl)
             tl = {"tier": tier_of[r]} if tier_of[r] is not None else {}
             self._m_adm.inc(**mlbl)
             if tl:
@@ -1624,3 +1663,8 @@ class ContinuousBatchingPredictor:
                     emit(r, "token", token=t, index=len(slot_new[b]))
                 if len(slot_new[b]) >= max_new[r]:
                     evict(b)
+
+
+# AOT engine (bundle build/load/warm-start) — imported last: its
+# entry points construct ContinuousBatchingPredictor lazily.
+from . import aot  # noqa: E402,F401

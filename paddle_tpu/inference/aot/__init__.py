@@ -1,0 +1,41 @@
+"""paddle_tpu.inference.aot — AOT inference engine.
+
+The deployment pipeline the paper's AnalysisPredictor serves (PAPER.md
+§0/§1), TPU-native:
+
+    dy2static capture → per-bucket AOT compile → serialized engine
+    bundle → warm-start serving with zero compilation on the hot path
+
+    from paddle_tpu.inference import aot
+
+    # offline (once per model/geometry/jaxlib):
+    aot.build_engine(model, "engine/", prompt_buckets=(16, 32),
+                     max_batch_size=4, page_size=16, max_seq_len=512)
+
+    # at serving startup (every restart):
+    predictor, engine = aot.warm_start(model, "engine/")
+    predictor.generate(prompts)     # first token without compiling
+
+Bucket misses fall back to live JIT (tier 2: the XLA persistent
+compilation cache underneath) and write the new executable back into
+the bundle; corrupted or fingerprint-mismatched bundles are rejected
+and rebuilt clean (``aot.invalidations``). Format and invalidation
+rules: docs/DEPLOYMENT.md. Inspect a bundle without importing jax:
+``python tools/aot_report.py <bundle>``.
+"""
+from .bundle import (  # noqa: F401
+    EngineBundle, BundleInvalid, runtime_fingerprint, model_fingerprint,
+    sig_key, MANIFEST, FORMAT,
+)
+from .engine import (  # noqa: F401
+    InferenceEngine, load_engine, warm_start, wire_xla_cache,
+    default_engine_dir,
+)
+from .builder import EngineBuilder, build_engine  # noqa: F401
+
+__all__ = [
+    "EngineBundle", "BundleInvalid", "runtime_fingerprint",
+    "model_fingerprint", "sig_key", "MANIFEST", "FORMAT",
+    "InferenceEngine", "load_engine", "warm_start", "wire_xla_cache",
+    "default_engine_dir", "EngineBuilder", "build_engine",
+]

@@ -1,0 +1,179 @@
+"""Engine builder: dy2static capture → AOT compile → serialized bundle.
+
+The builder is the AnalysisPredictor-analog's offline half (PAPER.md
+§0/§1: dynamic-to-static capture feeding a static-graph executor): it
+captures the model through the existing ``jit``/dy2static front door,
+lowers and AOT-compiles the serving programs for an explicit set of
+shape buckets (``jit(...).lower(...).compile()``), serializes each
+executable, and packages everything into a versioned on-disk bundle
+(bundle.py) that the loader (engine.py) warm-starts from with zero
+tracing or compilation on the hot path.
+
+What gets captured, per the bucket table:
+
+- **prefill** — one program per (batch-bucket, prompt-bucket): the
+  predictor's device-resident admission program (forward + on-device
+  argmax + paged K/V scatter).
+- **decode** — THE decode step (geometry-constant signature): paged
+  cache write + paged attention + argmax + eos, one program for every
+  step of every request.
+- **forward** — the plain captured model forward (logits) per bucket:
+  the dy2static capture surface itself, used for captured-vs-eager
+  parity checks and Predictor-style batch scoring. The model's
+  ``forward`` may be a ``to_static``-wrapped StaticFunction — capture
+  goes through ``jit.bridge.functionalize``, so the dy2static AST
+  transforms (data-dependent if/while → lax.cond/while_loop) are in
+  effect during tracing.
+- **custom programs** — ``add_program(name, fn, *args)`` AOT-compiles
+  any extra jittable function into the bundle (e.g. an eager Trainer
+  step for train-then-serve restarts).
+
+Calibration is exact-by-construction: the builder drives a real
+``ContinuousBatchingPredictor`` (with the engine in recording mode)
+over synthetic prompts shaped to each bucket, so the signatures in the
+bundle are literally the signatures the serve loop will dispatch.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ...observability import metrics as _obsm
+from ...observability import tracing as _obstr
+from .bundle import EngineBundle, model_fingerprint
+from .engine import InferenceEngine, wire_xla_cache
+
+__all__ = ["EngineBuilder", "build_engine"]
+
+
+class EngineBuilder:
+    """Collects capture targets, then :meth:`build` writes the bundle.
+
+    `prompt_buckets` are prompt-length buckets (powers of two ≥ 8 —
+    the predictor's admission bucketing); `batch_sizes` the admission
+    batch sizes to pre-compile per bucket (each ≤ ``max_batch_size``).
+    """
+
+    def __init__(self, model, prompt_buckets: Sequence[int] = (8, 16),
+                 batch_sizes: Optional[Sequence[int]] = None,
+                 max_new_tokens: int = 2, capture_forward: bool = True,
+                 **cb_kwargs):
+        self.model = model
+        self.prompt_buckets = sorted(set(int(b) for b in prompt_buckets))
+        self.cb_kwargs = dict(cb_kwargs)
+        self.max_new_tokens = int(max_new_tokens)
+        self.capture_forward = bool(capture_forward)
+        bmax = int(self.cb_kwargs.get("max_batch_size", 4))
+        if batch_sizes is None:
+            batch_sizes, n = [], 1
+            while n <= bmax:
+                batch_sizes.append(n)
+                n *= 2
+        self.batch_sizes = sorted(set(
+            int(n) for n in batch_sizes if 1 <= int(n) <= bmax))
+        self._extra = []   # (name, fn, args)
+
+    def add_program(self, name: str, fn, *example_args):
+        """Queue an arbitrary jittable function for AOT capture under
+        signature ``("custom", name)`` (e.g. an eager Trainer step)."""
+        self._extra.append((str(name), fn, example_args))
+        return self
+
+    # ------------------------------------------------------------ build --
+    def _geometry(self) -> Dict:
+        g = dict(self.cb_kwargs)
+        g.setdefault("max_batch_size", 4)
+        g.setdefault("page_size", 16)
+        g.setdefault("max_seq_len", 512)
+        g.setdefault("pad_token_id", 0)
+        g.setdefault("eos_token_id", None)
+        return g
+
+    def build(self, path: str, wire_cache: bool = True,
+              seed: int = 0) -> Dict:
+        """Capture, compile, serialize; returns the bundle manifest."""
+        from .. import ContinuousBatchingPredictor
+        geometry = self._geometry()
+        buckets = {"prompt_buckets": self.prompt_buckets,
+                   "batch_sizes": self.batch_sizes,
+                   "max_new_tokens": self.max_new_tokens}
+        t0 = time.perf_counter()
+        with _obstr.span("aot.build", parent=None, path=path,
+                         prompt_buckets=str(self.prompt_buckets),
+                         batch_sizes=str(self.batch_sizes)) as sp:
+            bundle = EngineBundle.create(
+                path, model_fingerprint(self.model), geometry, buckets)
+            if wire_cache:
+                wire_xla_cache(bundle.xla_cache_dir)
+            engine = InferenceEngine(bundle, write_back=True,
+                                     recording=True)
+            cb = ContinuousBatchingPredictor(self.model, engine=engine,
+                                             **geometry)
+            rng = np.random.RandomState(seed)
+            vocab = int(getattr(getattr(self.model, "config", None),
+                                "vocab_size", 0) or 256)
+            for pb in self.prompt_buckets:
+                for n in self.batch_sizes:
+                    # length == bucket: LLMPredictor._bucket(pb) == pb
+                    # for the power-of-two buckets, so the admission
+                    # round compiles exactly the (n→pow2, pb) program
+                    prompts = [rng.randint(2, vocab, (pb,)).tolist()
+                               for _ in range(n)]
+                    cb.generate(prompts,
+                                max_new_tokens=self.max_new_tokens)
+                    sp.event("bucket", prompt_bucket=pb, batch=n)
+            if self.capture_forward:
+                self._capture_forward(engine, rng, vocab, sp)
+            for name, fn, args in self._extra:
+                self._capture_custom(engine, name, fn, args, sp)
+            manifest = bundle.manifest(refresh=True)
+            sp.set_label(artifacts=len(manifest.get("artifacts", {})),
+                         build_s=round(time.perf_counter() - t0, 3))
+        _obsm.gauge("aot.build_seconds", unit="s").set(
+            time.perf_counter() - t0)
+        return manifest
+
+    # ---------------------------------------------------------- capture --
+    def _capture_forward(self, engine, rng, vocab, sp):
+        """AOT-capture the model's plain forward (logits) per bucket
+        through the jit/dy2static front door: ``functionalize`` swaps
+        params/buffers for traced arrays and runs the (possibly
+        to_static-transformed) python forward under jax tracing."""
+        import jax
+        import jax.numpy as jnp
+        from ...jit.bridge import functionalize
+        from ...tensor import Tensor
+
+        pure_fn, p_vals, b_vals, _, _ = functionalize(
+            self.model, training=False)
+
+        def logits_fn(p, b, ids):
+            out, _, _ = pure_fn(list(p), list(b), jax.random.key(0),
+                                Tensor(ids))
+            first = out[0] if isinstance(out, (list, tuple)) else out
+            return first._value if isinstance(first, Tensor) else first
+
+        jf = jax.jit(logits_fn)
+        for pb in self.prompt_buckets:
+            ids = rng.randint(2, vocab, (1, pb)).astype(np.int32)
+            sig = ("forward", (1, pb))
+            engine.compile_fallback(sig, jf, (p_vals, b_vals, ids))
+            sp.event("forward", prompt_bucket=pb)
+
+    def _capture_custom(self, engine, name, fn, args, sp):
+        import jax
+        jf = fn if hasattr(fn, "lower") else jax.jit(fn)
+        engine.compile_fallback(("custom", name), jf, args)
+        sp.event("custom", name=name)
+
+
+def build_engine(model, path: str, prompt_buckets=(8, 16),
+                 batch_sizes=None, max_new_tokens: int = 2,
+                 wire_cache: bool = True, **cb_kwargs) -> Dict:
+    """One-call builder (see :class:`EngineBuilder`)."""
+    return EngineBuilder(model, prompt_buckets=prompt_buckets,
+                         batch_sizes=batch_sizes,
+                         max_new_tokens=max_new_tokens,
+                         **cb_kwargs).build(path, wire_cache=wire_cache)

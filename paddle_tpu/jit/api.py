@@ -44,6 +44,10 @@ def _flatten_tensors(obj, acc):
     if isinstance(obj, Tensor):
         acc.append(obj)
         return "*"
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        # NamedTuple (e.g. generation.kv_cache.PagedCacheEntry): the
+        # constructor takes positional fields, not an iterable
+        return type(obj)(*(_flatten_tensors(o, acc) for o in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(_flatten_tensors(o, acc) for o in obj)
     if isinstance(obj, dict):
@@ -68,6 +72,8 @@ def _freeze(obj):
 def _rebuild(struct, it, wrap):
     if struct == "*":
         return wrap(next(it))
+    if isinstance(struct, tuple) and hasattr(struct, "_fields"):
+        return type(struct)(*(_rebuild(s, it, wrap) for s in struct))
     if isinstance(struct, (list, tuple)):
         return type(struct)(_rebuild(s, it, wrap) for s in struct)
     if isinstance(struct, dict):
@@ -109,6 +115,16 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         global _IN_TO_STATIC
         if not _TO_STATIC_ENABLED:
+            return self._fn(*args, **kwargs)
+        import jax.core as _jcore
+        if not _jcore.trace_state_clean():
+            # already under an outer jax trace (another to_static, a
+            # jitted serving program, the AOT engine builder): nesting
+            # a second jax.jit here would pin trace-time constants
+            # (the rng key) as hoisted executable inputs — which the
+            # AOT lower().compile() path cannot re-supply — and buys
+            # nothing, since the outer trace is already compiling.
+            # Run the dy2static-transformed python directly under it.
             return self._fn(*args, **kwargs)
         named_p, named_b = self._state()
         p_tensors = [p for _, p in named_p]

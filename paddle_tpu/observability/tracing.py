@@ -388,7 +388,11 @@ def set_flight_dir(path: Optional[str]):
 
 def flight_dir() -> str:
     """Dump directory resolution: set_flight_dir > env
-    PADDLE_TPU_FLIGHT_DIR > the telemetry sink's directory > cwd."""
+    PADDLE_TPU_FLIGHT_DIR > the telemetry sink's directory >
+    ``output/`` under the cwd. The final fallback is deliberately NOT
+    the cwd itself — crash dumps from ad-hoc runs used to litter the
+    repository root; they now land in an output directory (created on
+    demand by dump())."""
     if _flight_dir:
         return _flight_dir
     env = os.environ.get("PADDLE_TPU_FLIGHT_DIR")
@@ -398,7 +402,7 @@ def flight_dir() -> str:
     tp = telemetry_path()
     if tp:
         return os.path.dirname(os.path.abspath(tp))
-    return os.getcwd()
+    return os.path.join(os.getcwd(), "output")
 
 
 # ------------------------------------------------- uncaught-exception hook --

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Inspect an AOT engine bundle (paddle_tpu.inference.aot) WITHOUT
+importing jax (or paddle_tpu): pure stdlib, safe to run on a box with
+no accelerator stack — a deploy pipeline can gate on it before
+shipping a bundle.
+
+    python tools/aot_report.py <bundle_dir>            # manifest view
+    python tools/aot_report.py <bundle_dir> --verify   # re-hash digests
+    python tools/aot_report.py <bundle_dir> --json     # machine-readable
+
+Prints the runtime fingerprint (format/jax/jaxlib/platform — a loader
+on a different jaxlib will reject the bundle), the model hash, the
+compiled geometry, the shape-bucket table, and per-artifact
+kind/signature/size/digest. ``--verify`` re-hashes every artifact file
+against the manifest (exit 1 on any mismatch — the same check the
+loader's tier-1 makes lazily).
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+MANIFEST = "manifest.json"
+
+
+def load_manifest(bundle: str) -> dict:
+    path = os.path.join(bundle, MANIFEST)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        sys.exit(f"error: unreadable bundle manifest {path}: {e}")
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def human(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def verify(bundle: str, manifest: dict) -> list:
+    """Re-hash every artifact; returns [(key, problem)] mismatches."""
+    bad = []
+    for key, rec in sorted(manifest.get("artifacts", {}).items()):
+        path = os.path.join(bundle, rec["file"])
+        if not os.path.exists(path):
+            bad.append((key, "missing file"))
+            continue
+        if sha256_file(path) != rec["sha256"]:
+            bad.append((key, "digest mismatch"))
+    return bad
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="print an AOT engine bundle's manifest "
+                    "(no jax import)")
+    ap.add_argument("bundle", help="bundle directory (contains "
+                                   "manifest.json)")
+    ap.add_argument("--verify", action="store_true",
+                    help="re-hash every artifact against the manifest")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    a = ap.parse_args(argv)
+    m = load_manifest(a.bundle)
+    arts = m.get("artifacts", {})
+    sizes = {}
+    for key, rec in arts.items():
+        p = os.path.join(a.bundle, rec["file"])
+        try:
+            sizes[key] = os.path.getsize(p)
+        except OSError:
+            sizes[key] = None
+
+    if a.json:
+        out = {"bundle": os.path.abspath(a.bundle),
+               "fingerprint": m.get("fingerprint"),
+               "model": m.get("model"), "geometry": m.get("geometry"),
+               "buckets": m.get("buckets"),
+               "artifacts": {k: {**rec, "disk_bytes": sizes[k]}
+                             for k, rec in arts.items()}}
+        if a.verify:
+            out["verify_failures"] = verify(a.bundle, m)
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 1 if a.verify and out.get("verify_failures") else 0
+
+    fp = m.get("fingerprint") or {}
+    print(f"bundle    {os.path.abspath(a.bundle)}")
+    print(f"format    {fp.get('format')}   jax {fp.get('jax')}   "
+          f"jaxlib {fp.get('jaxlib')}   platform {fp.get('platform')}")
+    print(f"model     {str(m.get('model'))[:16]}...")
+    geo = m.get("geometry") or {}
+    if geo:
+        print("geometry  " + "  ".join(f"{k}={v}"
+                                       for k, v in sorted(geo.items())))
+    bk = m.get("buckets") or {}
+    if bk:
+        print("buckets   " + "  ".join(f"{k}={v}"
+                                       for k, v in sorted(bk.items())))
+    total = sum(s or 0 for s in sizes.values())
+    print(f"artifacts {len(arts)}   total {human(total)}")
+    for key, rec in sorted(arts.items()):
+        sz = sizes[key]
+        print(f"  {rec.get('kind', '?'):8s} {human(sz) if sz is not None else 'MISSING':>9s}"
+              f"  {rec['sha256'][:12]}  {key}")
+    if a.verify:
+        bad = verify(a.bundle, m)
+        if bad:
+            for key, why in bad:
+                print(f"VERIFY FAIL {why}: {key}", file=sys.stderr)
+            return 1
+        print(f"verify    OK ({len(arts)} artifacts re-hashed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

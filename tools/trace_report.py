@@ -3,7 +3,9 @@
 
 Reads the `{"kind": "span"}` lines that paddle_tpu.observability.tracing
 writes into the telemetry JSONL (same file as the metric samples) or a
-flight-recorder dump (flight_<pid>.json), and renders:
+flight-recorder dump (`flight_<pid>.json`, written to
+`$PADDLE_TPU_FLIGHT_DIR`, default `output/` — see docs/OBSERVABILITY.md
+"Flight recorder"), and renders:
 
 - **SLO percentiles** — TTFT, per-token latency, end-to-end request
   latency (from `serve.request` spans and their events) and train step
@@ -25,7 +27,7 @@ flight-recorder dump (flight_<pid>.json), and renders:
     python tools/trace_report.py telemetry.jsonl
     python tools/trace_report.py telemetry.jsonl --requests 10
     python tools/trace_report.py telemetry.jsonl --request req3
-    python tools/trace_report.py flight_1234.json --chrome trace.json
+    python tools/trace_report.py output/flight_1234.json --chrome trace.json
     python tools/trace_report.py telemetry.jsonl --recovery \
         --heartbeat log/heartbeat_rank0.jsonl
 
@@ -407,7 +409,8 @@ def to_chrome_trace(spans: List[dict]) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="telemetry JSONL or flight_<pid>.json")
+    ap.add_argument("path", help="telemetry JSONL or a flight dump "
+                                 "(output/flight_<pid>.json)")
     ap.add_argument("--requests", type=int, default=5,
                     help="slowest-request table size")
     ap.add_argument("--steps", type=int, default=8,

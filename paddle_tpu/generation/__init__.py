@@ -341,6 +341,8 @@ class GenerationMixin:
 
         greedy = cfg.decode_strategy in ("greedy_search", "greedy")
         B = ids.shape[0]
+        # graft-lint: ok[GL102] — ids is the caller's host array
+        # (numpy->numpy normalization, not a device download)
         cur = np.asarray(ids)
         finished = np.zeros((B,), bool)
         outs, logps = [], []
@@ -352,9 +354,12 @@ class GenerationMixin:
         with no_grad():
             for step in range(cfg.max_new_tokens):
                 out = self.forward(Tensor(jnp.asarray(cur, jnp.int32)))
-                logits = np.asarray((out[0] if isinstance(out, tuple)
-                                     else out)._value)[:, -1, :]
-                lg = jnp.asarray(logits, jnp.float32)
+                # last position sliced ON DEVICE: downloading the full
+                # [B, S, V] logits and re-uploading the slice cost two
+                # transfers of the largest tensor in the loop per token
+                # (caught by graft-lint GL102)
+                lg = (out[0] if isinstance(out, tuple)
+                      else out)._value[:, -1, :].astype(jnp.float32)
                 lg = LP.min_length_mask(lg, step, cfg.min_new_tokens,
                                         cfg.eos_token_id)
                 lg = LP.process_logits(
@@ -365,8 +370,11 @@ class GenerationMixin:
                     rep_penalty=cfg.repetition_penalty)
                 key, sub = jax.random.split(key)
                 tok, logp = LP.sample_token(lg, sub, greedy=greedy)
+                # graft-lint: ok[GL102] — THE designed per-token sync
+                # of the eager path: two [B] vectors drive the
+                # host-side eos/penalty bookkeeping
                 tok = np.asarray(tok)
-                logp = np.asarray(logp)
+                logp = np.asarray(logp)  # graft-lint: ok[GL102] (ditto)
                 emit = np.where(finished, cfg.pad_token_id, tok)
                 logps.append(np.where(finished, 0.0, logp))
                 outs.append(emit)

@@ -1512,8 +1512,11 @@ class ContinuousBatchingPredictor:
             self._p_vals, self._b_vals, self.pool.k, self.pool.v,
             ids, pos, lens, rows)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
-        nexts = np.asarray(nexts)          # [nb, bucket] small ints —
-        firsts = {}                        # the ONLY admission download
+        # graft-lint: ok[GL102] — the ONLY admission download: [nb,
+        # bucket] small ints (every position's argmax, for the prefix
+        # cache's cached-continuation tokens)
+        nexts = np.asarray(nexts)
+        firsts = {}
         for i, plan in enumerate(group):
             prompt = plan["prompt"]
             L = len(prompt)
@@ -1553,6 +1556,8 @@ class ContinuousBatchingPredictor:
             self._p_vals, self._b_vals, self.pool.k, self.pool.v,
             ids, pos, np.int32(covered), np.int32(sl), past_rows, row)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
+        # graft-lint: ok[GL102] — the suffix-prefill admission
+        # download, same contract as _batch_prefill's
         nexts = np.asarray(nexts)
         first = int(nexts[-1])
         if self.prefix_cache is not None:
@@ -1638,8 +1643,11 @@ class ContinuousBatchingPredictor:
                     raise DecodeWedgedError(
                         f"decode step did not resolve within {wd}s")
                 _time.sleep(min(0.002, wd / 100.0))
+        # graft-lint: ok[GL102] — THE decode-loop sync point (and the
+        # only one): two [B] vectors of a step whose successor is
+        # already dispatched (double buffering)
         nxt = np.asarray(step["tok"])
-        done = np.asarray(step["done"])
+        done = np.asarray(step["done"])  # graft-lint: ok[GL102] (ditto)
         self._m_tok.observe(_time.perf_counter() - step["t"],
                             **self._mlbl)
         for b, r in step["snap"]:

@@ -80,9 +80,15 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
     for t in range(int(max_step_num)):
         inp = decoder._embed(ids)
         logits, states = decoder.step(inp, states)
-        lv = np.asarray(_coerce(logits)._value, np.float32)
-        vocab = lv.shape[-1]
-        logp = np.array(jax.nn.log_softmax(jnp.asarray(lv), axis=-1))
+        # log_softmax ON DEVICE, ONE download: the old path downloaded
+        # the raw logits, re-uploaded them for log_softmax, then
+        # downloaded again — three [B*K, V] transfers per step for one
+        # (caught by graft-lint GL102)
+        lv = _coerce(logits)._value.astype(jnp.float32)
+        vocab = int(lv.shape[-1])
+        # graft-lint: ok[GL102] — the designed per-step sync: beam
+        # bookkeeping (top-k over K*V, parent gather) runs on host
+        logp = np.asarray(jax.nn.log_softmax(lv, axis=-1))
         logp = logp.reshape(B, K, vocab)
         cont = scores[:, :, None] + logp
         frozen = np.full((B, K, vocab), NEG, np.float32)

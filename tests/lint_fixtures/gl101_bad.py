@@ -1,0 +1,23 @@
+"""GL101 positive fixture: every pattern here must fire.
+
+NOT imported by anything — parsed by tests/test_lint.py only.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+
+
+def train_step(g):
+    params = jnp.asarray(np.ones(4))       # zero-copy numpy alias...
+    return step(params, g)                 # ...donated: GL101
+
+
+def set_weight(t):
+    arr = np.load("w.npy")
+    t._value = jnp.asarray(arr)            # donated Tensor slot: GL101
+
+
+def explicit_zero_copy():
+    return jnp.array(np.ones(3), copy=False)   # GL101

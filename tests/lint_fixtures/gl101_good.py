@@ -1,0 +1,28 @@
+"""GL101 negative fixture: the same shapes with forced ownership
+transfers — zero findings expected."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+
+
+def train_step(g):
+    params = jnp.array(np.ones(4), copy=True)   # XLA-owned copy
+    return step(params, g)
+
+
+def train_step_device_put(g):
+    params = jax.device_put(np.ones(4))         # ownership transfer
+    return step(params, g)
+
+
+def non_donated_position(p):
+    # position 1 is NOT in donate_argnums=(0,): uploading host data
+    # there is safe
+    return step(p, jnp.asarray(np.ones(4)))
+
+
+def set_weight(t):
+    arr = np.load("w.npy")
+    t._value = jnp.array(arr, copy=True)

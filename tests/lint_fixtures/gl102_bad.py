@@ -1,0 +1,30 @@
+"""GL102 positive fixture (inside-jit scope): each marked line fires."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_jit(x, y):
+    if x > 0:                  # implicit tracer __bool__: GL102
+        y = y + 1
+    v = float(x)               # host sync: GL102
+    arr = np.asarray(y)        # host materialization: GL102
+    t = x.item()               # host sync: GL102
+    return y + v + arr.sum() + t
+
+
+def _raw_step(p, g):
+    g.block_until_ready()      # GL102 (jitted via the call below)
+    return p - g
+
+
+step = jax.jit(_raw_step)
+
+
+@jax.jit
+def derived_branch(x):
+    y = x * 2
+    while y > 0:               # derived traced local: GL102
+        y = y - 1
+    return y

@@ -1,0 +1,29 @@
+"""GL102 negative fixture: trace-time-static idioms that must NOT
+fire."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_jit(x, y):
+    z = jnp.where(x > 0, y + 1, y)      # branch expressed in-graph
+    if x.shape[0] > 2:                  # shapes are static
+        z = z * 2
+    if y is not None:                   # pytree structure is static
+        z = z + y
+    n = len(x.shape)                    # len() of static
+    return z * n
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def good_static(x, mode):
+    if mode:                            # static arg: python branch ok
+        return x + 1
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def good_static_pos(x, scale):
+    return x * float(scale)             # float() of a STATIC arg

@@ -1,0 +1,10 @@
+"""GL102 positive fixture (registered-hot-path scope): the test
+registers this file in config.HOT_PATH_FUNCTIONS."""
+import numpy as np
+
+
+def serve_tick(step):
+    tok = np.asarray(step["tok"])       # unsanctioned sync: GL102
+    val = step["loss"].item()           # unsanctioned sync: GL102
+    host = step["done"].numpy()         # unsanctioned sync: GL102
+    return tok, val, host

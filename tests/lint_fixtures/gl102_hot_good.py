@@ -1,0 +1,11 @@
+"""GL102 negative fixture (registered-hot-path scope): the designed
+sync point carries a sanction comment; host-only numpy work is not
+flagged."""
+import numpy as np
+
+
+def serve_tick(step, pad):
+    ids = np.full((4, 8), pad, np.int32)       # host staging: fine
+    # graft-lint: ok[GL102] — THE designed per-tick sync point
+    tok = np.asarray(step["tok"])
+    return ids, tok

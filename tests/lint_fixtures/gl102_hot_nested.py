@@ -1,0 +1,11 @@
+"""GL102 fixture: a sync inside a closure of a hot-path function must
+be reported exactly ONCE (the nested def matches a wildcard hot-path
+glob itself — regression for the double-report)."""
+import numpy as np
+
+
+def outer(step):
+    def inner():
+        return np.asarray(step["tok"])     # GL102, once
+
+    return inner()

@@ -1,0 +1,29 @@
+"""GL103 positive fixture."""
+import jax
+
+
+def _inc(a):
+    return a + 1
+
+
+def per_call_wrapper(x):
+    return jax.jit(_inc)(x)             # fresh wrapper per call: GL103
+
+
+def lambda_in_function(x):
+    f = jax.jit(lambda a: a * 2)        # new lambda per call: GL103
+    return f(x)
+
+
+def jit_in_loop(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(_inc)(x))    # GL103 (immediate, in loop)
+    return out
+
+
+def unhashable_static(x, opts=[1, 2]):  # noqa: B006 (on purpose)
+    return x
+
+
+stat_jit = jax.jit(unhashable_static, static_argnums=(1,))  # GL103

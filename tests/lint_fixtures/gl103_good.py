@@ -1,0 +1,27 @@
+"""GL103 negative fixture: wrapper built once, hashable statics."""
+import functools
+
+import jax
+
+inc = jax.jit(lambda a: a + 1)          # module-level: built once
+
+
+def _raw(x, mode):
+    return x + 1 if mode else x
+
+
+good_static = jax.jit(_raw, static_argnums=(1,))
+
+
+class Stepper:
+    def __init__(self):
+        self._jit = jax.jit(self._raw_step)   # cached on the instance
+
+    def _raw_step(self, x):
+        return x * 2
+
+    def step(self, x):
+        return self._jit(x)                   # cached wrapper per call
+
+
+kernel = functools.partial(jax.jit, donate_argnums=(0,))(_raw)

@@ -1,0 +1,34 @@
+"""GL104 positive fixture: locks + locky telemetry calls reached from
+handler contexts."""
+import atexit
+import signal
+import sys
+import threading
+
+_lock = threading.Lock()
+
+
+def flight_dump(reason=""):
+    pass  # stand-in for observability.tracing.flight_dump
+
+
+def _dump():
+    flight_dump(reason="sig")          # locky, one level deep
+
+
+def handler(signum, frame):
+    with _lock:                        # direct lock in handler: GL104
+        pass
+    _dump()                            # reaches flight_dump: GL104
+
+
+signal.signal(signal.SIGTERM, handler)
+
+
+def hook(exc_type, exc, tb):
+    flight_dump(reason="crash")        # GL104
+
+
+sys.excepthook = hook
+
+atexit.register(_dump)                 # GL104 (warning)

@@ -1,0 +1,22 @@
+"""GL104 negative fixture: the deferred-flag pattern (PR-5 Trainer
+preemption fix) — the handler only sets state; the step boundary does
+the lock-taking work."""
+import signal
+
+
+class Loop:
+    def __init__(self):
+        self._preempted = False
+        self._reason = None
+
+    def install(self):
+        def handler(signum, frame):
+            self._preempted = True            # flag only: safe
+            self._reason = f"signal_{signum}"
+
+        signal.signal(signal.SIGTERM, handler)
+
+    def step_boundary(self):
+        if self._preempted:
+            from paddle_tpu.observability.tracing import flight_dump
+            flight_dump(reason=self._reason)  # outside the handler: ok

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""graft-lint launcher — the project static-analysis suite.
+
+    python tools/graft_lint.py [--format json|text]
+                               [--baseline lint_baseline.json] paths...
+
+Rule catalog + baseline workflow: docs/STATIC_ANALYSIS.md.
+No paddle_tpu / jax import: safe to run anywhere, fast enough for CI.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from graft_lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""graft-lint: project-specific static analysis for paddle_tpu.
+
+Five AST-based passes encode this repo's shipped (or nearly shipped)
+bug classes as rules instead of tribal knowledge:
+
+- GL101 donation-aliasing   — zero-copy numpy->jax conversions flowing
+                              into donated buffers (the PR-3 heap
+                              corruption).
+- GL102 host-sync-hot-path  — host syncs inside jitted programs and in
+                              the registered serving/training hot-path
+                              functions.
+- GL103 retrace-hazard      — jit wrappers rebuilt per call, jit-of-
+                              lambda, unhashable static args.
+- GL104 lock-in-handler     — non-reentrant recorder/registry/exporter
+                              locks acquired inside signal handlers,
+                              sys.excepthook chains, or atexit
+                              callbacks (the PR-5 self-deadlock).
+- GL105 catalog-drift       — metric/span/flag names emitted in code
+                              must match the docs/OBSERVABILITY.md +
+                              docs/ROBUSTNESS.md catalogs, both ways.
+
+See docs/STATIC_ANALYSIS.md for the rule catalog, the baseline
+workflow, and how to add a pass.
+"""
+from .core import Finding, SourceFile, run_passes  # noqa: F401
+from .baseline import Baseline                     # noqa: F401
+
+__version__ = "1.0"

@@ -1,0 +1,103 @@
+"""Baseline: grandfather accepted findings so CI fails only on NEW
+violations.
+
+Fingerprints are line-number-free — (rule, path, stripped source line,
+n-th occurrence of that triple) — so unrelated edits above a baselined
+site don't churn the file. Regenerate with `--write-baseline` after an
+intentional acceptance; each entry keeps an optional human `note`
+explaining WHY the finding is accepted (reviewed in the diff like any
+other code change).
+
+Twin-line caveat: when a NEW violation with the *identical source
+line* appears in a file that already baselines that line, occurrence
+indices shift — CI still fails (the counts no longer match, so one
+finding surfaces), but the reported site may be the previously
+reviewed one rather than the new twin. Review every textual twin of
+the line before re-baselining; never --write-baseline to silence a
+finding you haven't traced.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding
+
+_VERSION = 1
+
+
+class Baseline:
+    def __init__(self, entries: Optional[List[dict]] = None,
+                 path: Optional[str] = None):
+        self.path = path
+        self.entries = entries or []
+        self._keys: Dict[Tuple[str, str, str, int], dict] = {
+            (e["rule"], e["path"], e["code"], int(e.get("occ", 0))): e
+            for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != _VERSION:
+            raise ValueError(
+                f"unsupported baseline version {data.get('version')!r} "
+                f"in {path} (expected {_VERSION})")
+        return cls(data.get("findings", []), path=path)
+
+    @classmethod
+    def from_findings(cls, findings: List[Finding],
+                      previous: Optional["Baseline"] = None,
+                      in_scope=None) -> "Baseline":
+        """Baseline accepting `findings`. With `previous`, existing
+        review notes are carried over for entries that still match,
+        and previous entries OUTSIDE this run's scope (`in_scope`
+        predicate over entry dicts; e.g. a --rules subset or a path
+        subset) are preserved rather than silently deleted."""
+        prev_keys = previous._keys if previous is not None else {}
+        entries = []
+        for f in findings:
+            old = prev_keys.get(f.key())
+            entries.append({"rule": f.rule, "path": f.path,
+                            "code": f.code, "occ": f.occ,
+                            "note": old.get("note", "") if old else ""})
+        if previous is not None and in_scope is not None:
+            current = {f.key() for f in findings}
+            for k, e in previous._keys.items():
+                if k not in current and not in_scope(e):
+                    entries.append(e)
+        return cls(entries)
+
+    def matches(self, f: Finding) -> bool:
+        return f.key() in self._keys
+
+    def split(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, baselined)."""
+        new, old = [], []
+        for f in findings:
+            (old if self.matches(f) else new).append(f)
+        return new, old
+
+    def stale_entries(self, findings: List[Finding]) -> List[dict]:
+        """Baseline entries whose finding no longer exists (fixed code
+        — the entry should be deleted)."""
+        live = {f.key() for f in findings}
+        return [e for k, e in self._keys.items() if k not in live]
+
+    def save(self, path: str):
+        payload = {
+            "version": _VERSION,
+            "comment": ("graft-lint accepted findings. Entries match "
+                        "(rule, path, source line, occurrence) — "
+                        "regenerate with tools/graft_lint.py "
+                        "--write-baseline; keep `note` explaining each "
+                        "acceptance. See docs/STATIC_ANALYSIS.md."),
+            "findings": self.entries,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)

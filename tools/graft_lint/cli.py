@@ -1,0 +1,156 @@
+"""graft-lint CLI.
+
+    python tools/graft_lint.py [--format text|json]
+                               [--baseline lint_baseline.json]
+                               [--write-baseline] [--rules GL101,GL105]
+                               paths...
+
+Exit codes: 0 = no unbaselined findings, 1 = unbaselined findings,
+2 = usage/config error. The baseline defaults to <repo>/lint_baseline.
+json when it exists, so CI (`python tools/graft_lint.py paddle_tpu/`)
+fails only on NEW violations.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import Baseline
+from .core import Finding, iter_py_files, run_passes
+from .passes import RULE_DOCS
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def repo_root_of(start: Optional[str] = None) -> str:
+    """The repo root this tool belongs to: nearest ancestor OF THE
+    graft_lint PACKAGE holding pyproject.toml. Anchoring on the package
+    (not the CWD) keeps finding paths, the default baseline, and the
+    GL105 emission/doc roots stable no matter where the CLI is invoked
+    from — a CWD inside some other project must not re-root the scan."""
+    d = os.path.abspath(start or os.path.dirname(__file__))
+    while True:
+        if os.path.isfile(os.path.join(d, "pyproject.toml")):
+            return d
+        nxt = os.path.dirname(d)
+        if nxt == d:
+            break
+        d = nxt
+    # no pyproject.toml anywhere above: <repo>/tools/graft_lint/cli.py
+    # -> three levels up is the repo
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graft_lint",
+        description="paddle_tpu project lint: donation aliasing, "
+                    "hot-path host syncs, retrace hazards, lock "
+                    "discipline, telemetry-catalog consistency.")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files/directories to lint (default: "
+                         "paddle_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         f"at the repo root, when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the "
+                         "baseline file and exit 0")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: "
+                         "all)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in sorted(RULE_DOCS.items()):
+            print(f"{rule}  {doc}")
+        return 0
+
+    root = repo_root_of()
+    paths = args.paths or ["paddle_tpu"]
+    rules = {r.strip() for r in args.rules.split(",") if r.strip()} \
+        or None
+    try:
+        findings = run_passes(paths, root, rules=rules)
+    except (OSError, ValueError) as e:
+        print(f"graft-lint: {e}", file=sys.stderr)
+        return 2
+
+    # the scope of THIS run: which baseline entries the findings can
+    # legitimately confirm or invalidate. GL105 anchors findings in
+    # the configured emission/doc roots regardless of CLI paths, so it
+    # is in scope whenever it ran.
+    scanned = {os.path.relpath(p, root).replace(os.sep, "/")
+               for p in iter_py_files(paths, root)}
+
+    def in_scope(entry: dict) -> bool:
+        if rules is not None and entry.get("rule") not in rules:
+            return False
+        if entry.get("rule") == "GL105":
+            return rules is None or "GL105" in rules
+        return entry.get("path") in scanned
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    baseline = None
+    if not args.no_baseline and os.path.isfile(baseline_path):
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graft-lint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        merged = Baseline.from_findings(findings, previous=baseline,
+                                        in_scope=in_scope)
+        merged.save(baseline_path)
+        print(f"graft-lint: wrote {len(merged.entries)} finding(s) to "
+              f"{baseline_path} (notes preserved; out-of-scope entries "
+              f"kept)")
+        return 0
+
+    if args.no_baseline:
+        baseline = None
+    new, old = (baseline.split(findings) if baseline
+                else (findings, []))
+    stale = ([e for e in baseline.stale_entries(findings)
+              if in_scope(e)] if baseline else [])
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": len(old),
+            "stale_baseline_entries": stale,
+            "counts": _counts(new),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"\nnote: {len(stale)} baseline entr"
+                  f"{'y is' if len(stale) == 1 else 'ies are'} stale "
+                  f"(finding fixed — regenerate with "
+                  f"--write-baseline):")
+            for e in stale:
+                print(f"    {e['rule']} {e['path']}: {e['code']}")
+        c = _counts(new)
+        print(f"\ngraft-lint: {c['error']} error(s), "
+              f"{c['warning']} warning(s)"
+              + (f", {len(old)} baselined" if old else ""))
+    return 1 if new else 0
+
+
+def _counts(findings: List[Finding]) -> dict:
+    out = {"error": 0, "warning": 0}
+    for f in findings:
+        out[f.severity] += 1
+    return out

@@ -1,0 +1,102 @@
+"""Project-specific configuration for the graft-lint passes.
+
+This file IS the repo's tribal knowledge, machine-readable: which
+functions are hot paths, which locks are non-reentrant, where the
+telemetry catalogs live. New subsystems extend these tables instead of
+re-teaching every reviewer (docs/STATIC_ANALYSIS.md explains each).
+"""
+import fnmatch
+
+# --------------------------------------------------------------- GL102 --
+# Registered hot-path functions: (relpath glob, function name glob).
+# Inside these, explicit host transfers (np.asarray / .numpy() /
+# .item() / block_until_ready / device_get) are findings unless the
+# site carries a `# graft-lint: ok[GL102] <why>` sanction — the decode
+# loop's single designed sync point is sanctioned, a stray second one
+# is a bug. (Functions jitted with jax.jit are checked everywhere,
+# with a stricter rule set, regardless of this table.)
+HOT_PATH_FUNCTIONS = (
+    # the continuous-batching serve loop (generation decode fast path)
+    ("paddle_tpu/inference/__init__.py", "ContinuousBatchingPredictor._serve"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._dispatch_step"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._resolve_step"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._batch_prefill"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._suffix_prefill"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._jit_call"),
+    # serving front end: router / scheduler / streaming are host-side
+    # by design — ANY device sync there stalls every tenant
+    ("paddle_tpu/serving/*.py", "*"),
+    # paged KV bookkeeping runs once per decode tick
+    ("paddle_tpu/generation/kv_cache.py", "RaggedMetaBuilder.*"),
+    ("paddle_tpu/generation/kv_cache.py", "PagedKVPool.*"),
+    # eager (dygraph) generation decode loop + seq2seq beam decode
+    ("paddle_tpu/generation/__init__.py",
+     "GenerationMixin._generate_eager_batch"),
+    ("paddle_tpu/nn/decode.py", "dynamic_decode"),
+    # eager fused-optimizer step (one dispatch per step, no syncs)
+    ("paddle_tpu/optimizer/fused.py", "FusedPlan.run"),
+    ("paddle_tpu/optimizer/fused.py", "try_fused_step"),
+    # hybrid-parallel per-step entry (loss sync is deferred by design)
+    ("paddle_tpu/distributed/fleet/dist_step.py", "DistTrainStep.__call__"),
+)
+
+
+def is_hot_path(relpath: str, qualname: str) -> bool:
+    for pat, fn in HOT_PATH_FUNCTIONS:
+        if fnmatch.fnmatch(relpath, pat) and fnmatch.fnmatch(qualname, fn):
+            return True
+    return False
+
+
+# --------------------------------------------------------------- GL104 --
+# Known non-reentrant-lock-acquiring callables (the PR-5 deadlock
+# registry). Bare function names match any call; method names also
+# require the receiver hint regex to match the receiver expression
+# (None = any receiver). All of these take a plain threading.Lock a
+# signal handler interrupting the lock holder can never acquire.
+LOCKY_FUNCTIONS = {
+    # observability.tracing: flight ring + registry snapshot + sink
+    "flight_dump": None,
+    # observability.metrics: MetricRegistry._lock via create-or-get
+    "counter": None,
+    "gauge": None,
+    "histogram": None,
+}
+LOCKY_METHODS = {
+    # FlightRecorder ring lock
+    "dump": r"(flight|recorder)",
+    # JsonlExporter / process sink locks
+    "export": None,
+    "write_record": None,
+    "flush": r"(exporter|sink|jsonl)",
+    "close": r"(exporter|sink|jsonl)",
+    # MetricRegistry + series locks
+    "collect": r"(registry|_reg)",
+    "snapshot": r"(registry|_reg)",
+    "inc": r"(^_m_|counter|gauge|metric)",
+    "observe": r"(^_m_|hist|metric)",
+    "set": r"(^_m_|gauge)",
+}
+# receiver/name regex for "this expression is a lock object"
+LOCK_NAME_RE = r"(?i)(^|[._])lock$"
+
+
+# --------------------------------------------------------------- GL105 --
+# Where telemetry is emitted (scanned for counter/gauge/histogram/span/
+# start_span/traced/define_flag call sites) — independent of the CLI
+# paths so `graft_lint.py paddle_tpu/` still audits bench.py's spans.
+EMISSION_ROOTS = ("paddle_tpu", "bench.py")
+# The catalogs every metric/span name must appear in (and vice versa).
+CATALOG_DOCS = ("docs/OBSERVABILITY.md", "docs/ROBUSTNESS.md")
+# Flags may be documented in any of these.
+FLAG_DOC_ROOTS = ("docs", "README.md")
+# Only names under these domains are catalog-checked; quickstart
+# examples (myapp.*) and module paths in backticks stay out of scope.
+CATALOG_PREFIXES = ("train", "serve", "serving", "comm", "mem", "pp",
+                    "robustness", "aot", "ckpt", "dist", "launch",
+                    "bench", "router")

@@ -1,0 +1,32 @@
+"""Pass registry. A file pass runs per SourceFile; a project pass runs
+once per invocation (GL105 scans its own configured roots + docs)."""
+from .donation import check as _donation
+from .hostsync import check as _hostsync
+from .retrace import check as _retrace
+from .locks import check as _locks
+from .catalog import check as _catalog
+
+FILE_PASSES = (
+    ("GL101", _donation),
+    ("GL102", _hostsync),
+    ("GL103", _retrace),
+    ("GL104", _locks),
+)
+
+PROJECT_PASSES = (
+    ("GL105", _catalog),
+)
+
+RULE_DOCS = {
+    "GL001": "file does not parse (syntax error)",
+    "GL101": "zero-copy numpy->jax conversion can flow into a donated "
+             "buffer (heap corruption: XLA frees numpy-owned memory)",
+    "GL102": "host sync / device transfer inside a jitted program or a "
+             "registered hot-path function",
+    "GL103": "retrace hazard: jit wrapper rebuilt per call, jit of a "
+             "lambda, or unhashable static argument",
+    "GL104": "non-reentrant lock acquired inside a signal handler, "
+             "sys.excepthook chain, or atexit callback",
+    "GL105": "telemetry catalog drift: emitted metric/span/flag names "
+             "and the docs catalogs disagree",
+}

@@ -1,0 +1,237 @@
+"""GL101 — donation-aliasing (the PR-3 heap-corruption class).
+
+`jnp.asarray(numpy_value)` on the CPU backend zero-copies roughly half
+the time (alignment-dependent). If that array is then DONATED to a
+jitted program (`donate_argnums`), XLA's deallocator frees memory that
+numpy owns — heap corruption, crashing far from the cause. The fix is
+a forced XLA-owned copy at the donation boundary: `jnp.array(x,
+copy=True)` or `jax.device_put(x)`.
+
+The pass flags, per module:
+
+1. host-sourced `jnp.asarray(...)` / `jnp.array(...)` (no `copy=True`)
+   whose result reaches a call of a *donating callable* — a name bound
+   from `jax.jit(..., donate_argnums=...)` (assignment, attribute, or
+   decorator) — directly or through one local variable. When the
+   donation positions are a visible literal, only those argument
+   positions count.
+2. `<x>._value = jnp.asarray(host)` — Tensor buffer slots; compiled
+   train steps donate param/buffer values, so an aliased `_value` is
+   the exact PR-3 bug (host_init / set_value).
+3. any `jnp.array(..., copy=False)` of a host source (an explicit
+   zero-copy request on numpy-owned memory).
+
+"Host-sourced" = the expression contains a `np.*` / `numpy.*` call, a
+`.numpy()` call, a `.copy()` of a host source, or a local name assigned
+from one in the same function.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import (Finding, SourceFile, call_target, dotted, is_jax_jit,
+                    kwarg, partial_of_jit, terminal_name)
+
+_HINT = ("force an XLA-owned copy at the donation boundary: "
+         "jnp.array(x, copy=True) or jax.device_put(x)")
+
+
+def _is_jnp_convert(call: ast.Call) -> Optional[str]:
+    """'asarray' / 'array' for jnp.asarray(...) / jnp.array(...)."""
+    d = call_target(call)
+    if d in ("jnp.asarray", "jax.numpy.asarray"):
+        return "asarray"
+    if d in ("jnp.array", "jax.numpy.array"):
+        return "array"
+    return None
+
+
+def _copy_forced(call: ast.Call) -> bool:
+    kw = kwarg(call, "copy")
+    return isinstance(kw, ast.Constant) and kw.value is True
+
+
+def _copy_false(call: ast.Call) -> bool:
+    kw = kwarg(call, "copy")
+    return isinstance(kw, ast.Constant) and kw.value is False
+
+
+def _is_owned(node: ast.AST) -> bool:
+    """Expression whose result is XLA-owned regardless of its inputs:
+    jax.device_put(...) or a forced-copy jnp.array(..., copy=True)."""
+    if not isinstance(node, ast.Call):
+        return False
+    if call_target(node) in ("jax.device_put", "device_put"):
+        return True
+    return _is_jnp_convert(node) is not None and _copy_forced(node)
+
+
+class _FnState:
+    """Per-function host-source name tracking (single forward pass)."""
+
+    def __init__(self):
+        self.host_names: Set[str] = set()
+
+
+def _expr_is_host(node: ast.AST, host_names: Set[str]) -> bool:
+    """Does this expression carry host (numpy-owned) memory?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            d = call_target(n)
+            root = d.split(".", 1)[0]
+            if root in ("np", "numpy"):
+                return True
+            if terminal_name(n.func) in ("numpy", "copy") and \
+                    isinstance(n.func, ast.Attribute):
+                # t.numpy() downloads to numpy; host.copy() stays host
+                if terminal_name(n.func) == "numpy" or \
+                        _expr_is_host(n.func.value, host_names):
+                    return True
+        elif isinstance(n, ast.Name) and n.id in host_names:
+            return True
+    return False
+
+
+def _collect_donating(sf: SourceFile) -> Dict[str, Optional[Set[int]]]:
+    """{callable name (bare or attr terminal): donated positions or
+    None when unknown} for jax.jit(..., donate_argnums=...) bindings."""
+    out: Dict[str, Optional[Set[int]]] = {}
+
+    def _positions(call: ast.Call) -> Optional[Set[int]]:
+        dn = kwarg(call, "donate_argnums")
+        if dn is None:
+            return None
+        if isinstance(dn, ast.Constant) and isinstance(dn.value, int):
+            return {dn.value}
+        if isinstance(dn, (ast.Tuple, ast.List)):
+            vals = set()
+            for e in dn.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return None  # computed tuple: positions unknown
+                vals.add(e.value)
+            return vals
+        return None  # a variable — donated, positions unknown
+
+    def _donating_jit_call(call: ast.Call) -> bool:
+        return (is_jax_jit(call.func) or partial_of_jit(call)) and \
+            kwarg(call, "donate_argnums") is not None
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            call = node.value
+            # name = functools.partial(jax.jit, donate...)(f) shape:
+            # the outer call's func is the partial
+            if isinstance(call.func, ast.Call) and \
+                    _donating_jit_call(call.func):
+                call = call.func
+            elif not _donating_jit_call(call):
+                continue
+            pos = _positions(call)
+            for tgt in node.targets:
+                name = terminal_name(tgt)
+                if name:
+                    out[name] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _donating_jit_call(dec):
+                    out[node.name] = _positions(dec)
+    return out
+
+
+def check(sf: SourceFile, repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    donating = _collect_donating(sf)
+
+    # rule 3: explicit copy=False of a host source, anywhere
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _is_jnp_convert(node) and \
+                _copy_false(node) and node.args and \
+                _expr_is_host(node.args[0], set()):
+            findings.append(sf.finding(
+                "GL101", "error", node,
+                "explicit zero-copy (copy=False) of numpy-owned memory "
+                "— aliases host heap into a jax buffer",
+                _HINT))
+
+    # rules 1-2 walk per function so local host-name tracking is scoped
+    class _V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: List[_FnState] = [_FnState()]
+
+        @property
+        def st(self) -> _FnState:
+            return self.stack[-1]
+
+        def visit_FunctionDef(self, node):
+            self.stack.append(_FnState())
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def _unsafe_convert(self, expr: ast.AST) -> Optional[ast.Call]:
+            """The jnp.asarray/array(host) call inside `expr` that is
+            not a forced copy, if any."""
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call) and _is_jnp_convert(n) and \
+                        not _copy_forced(n) and n.args and \
+                        _expr_is_host(n.args[0], self.st.host_names):
+                    return n
+            return None
+
+        def visit_Assign(self, node):
+            # track host-source and unsafe-converted locals; an
+            # ownership transfer (device_put / forced copy) launders
+            # the host source
+            if isinstance(node.value, ast.expr):
+                is_host = not _is_owned(node.value) and \
+                    _expr_is_host(node.value, self.st.host_names)
+                conv = self._unsafe_convert(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and (is_host or conv):
+                        self.st.host_names.add(tgt.id)
+                    # rule 2: <x>._value = jnp.asarray(host)
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "_value" and conv is not None:
+                        findings.append(sf.finding(
+                            "GL101", "error", conv,
+                            "Tensor._value assigned a possibly "
+                            "zero-copy view of numpy memory — compiled "
+                            "train steps donate param/buffer values, "
+                            "which would free the numpy heap through "
+                            "XLA's deallocator",
+                            _HINT))
+            self.generic_visit(node)
+
+        def visit_Call(self, node):
+            # rule 1: host-source conversion flowing into a donating
+            # callable's donated argument positions
+            name = terminal_name(node.func)
+            if name in donating and dotted(node.func) not in (
+                    "jax.jit", "jit"):
+                pos = donating[name]
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Starred):
+                        pos = None  # positions shift: check everything
+                        arg = arg.value
+                    if pos is not None and i not in pos:
+                        continue
+                    conv = self._unsafe_convert(arg)
+                    if conv is None and isinstance(arg, ast.Name) and \
+                            arg.id in self.st.host_names:
+                        conv = node
+                    if conv is not None:
+                        findings.append(sf.finding(
+                            "GL101", "error", conv,
+                            f"possibly zero-copy numpy->jax conversion "
+                            f"flows into donated program "
+                            f"{name!r} — donation frees numpy-owned "
+                            f"memory through XLA's deallocator",
+                            _HINT))
+            self.generic_visit(node)
+
+    _V().visit(sf.tree)
+    return findings

@@ -1,0 +1,292 @@
+"""GL102 — host syncs in jitted programs and registered hot paths.
+
+Two scopes, one rule id:
+
+**Inside jit** (functions decorated with / passed to `jax.jit` in the
+same module): `.item()`, `.tolist()`, `.numpy()`, `block_until_ready`,
+`jax.device_get`, `np.<fn>(traced)`, `float/int/bool(traced)`, and
+implicit `__bool__` branches (`if traced:` / `while traced:`) are
+errors — they either crash at trace time (TracerBoolConversionError)
+or silently bake a host round-trip into every step. Static parameters
+(literal `static_argnums` / `static_argnames` visible at the jit site)
+are excluded; `.shape` / `.ndim` / `.dtype` / `len()` expressions are
+static at trace time and never flagged.
+
+**Registered hot paths** (config.HOT_PATH_FUNCTIONS — the serve loop,
+the fused optimizer step, DistTrainStep.__call__, the serving front
+end): explicit device transfers (`np.asarray` / `np.array` /
+`.numpy()` / `.item()` / `block_until_ready` / `jax.device_get`) are
+warnings. Designed sync points (the decode loop's ONE token download)
+carry `# graft-lint: ok[GL102] <why>` sanctions; anything else is a
+stray sync serializing the dispatch pipeline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .. import config
+from ..core import (Finding, SourceFile, call_target, is_jax_jit,
+                    kwarg, partial_of_jit, terminal_name, walk_functions)
+
+_SYNC_METHODS = ("item", "tolist", "numpy", "block_until_ready")
+_HOT_HINT = ("hot-path host syncs serialize the dispatch pipeline; move "
+             "the transfer off the per-step path or sanction a designed "
+             "sync point with `# graft-lint: ok[GL102] <why>`")
+_JIT_HINT = ("host values don't exist at trace time: keep the "
+             "computation in jnp/lax (jnp.where instead of if, "
+             "lax.cond/scan for control flow), or hoist the host work "
+             "out of the jitted function")
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "aval",
+                 "sharding"}
+
+
+def _literal_ints(node: Optional[ast.expr]) -> Optional[Set[int]]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.add(e.value)
+        return out
+    return None
+
+
+def _literal_strs(node: Optional[ast.expr]) -> Optional[Set[str]]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.add(e.value)
+        return out
+    return None
+
+
+def _collect_jitted(sf: SourceFile) -> Dict[str, ast.Call]:
+    """{function name: the jit call site} for functions that get jitted
+    in this module — decorated, or passed by name/attr to jax.jit."""
+    out: Dict[str, ast.Call] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jax_jit(dec):
+                    out[node.name] = ast.Call(func=dec, args=[],
+                                              keywords=[])
+                elif isinstance(dec, ast.Call) and (
+                        is_jax_jit(dec.func) or partial_of_jit(dec)):
+                    out[node.name] = dec
+        elif isinstance(node, ast.Call) and is_jax_jit(node.func) \
+                and node.args:
+            target = node.args[0]
+            name = terminal_name(target)
+            if name:
+                out.setdefault(name, node)
+    return out
+
+
+def _static_params(fn: ast.AST, jit_call: Optional[ast.Call]
+                   ) -> Optional[Set[str]]:
+    """Names of the function's static parameters; None when they can't
+    be resolved (conservatively treat all params as traced... except
+    that unresolvable statics would cause false positives, so None
+    means 'unknown -> treat every param as possibly static' for the
+    branch check and 'traced' for explicit sync calls)."""
+    if jit_call is None:
+        return set()
+    nums = _literal_ints(kwarg(jit_call, "static_argnums"))
+    names = _literal_strs(kwarg(jit_call, "static_argnames"))
+    if nums is None or names is None:
+        return None
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static = set(names)
+    for i in nums:
+        if 0 <= i < len(params):
+            static.add(params[i])
+    return static
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Subtrees that are static at trace time (shape/dtype reads,
+    len(), `is None` structure checks)."""
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return True
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        # `x is None` asks about the pytree STRUCTURE (an optional
+        # operand), which is fixed at trace time — never a tracer bool
+        return True
+    if isinstance(node, ast.Call):
+        d = call_target(node)
+        if d in ("len", "isinstance", "getattr", "hasattr", "type",
+                 "range", "enumerate", "zip"):
+            return True
+    return False
+
+
+def _traced_names_in(node: ast.AST, traced: Set[str]) -> bool:
+    """True when `node` references a traced name outside any
+    trace-time-static subexpression."""
+
+    def _walk(n) -> bool:
+        if _is_static_expr(n):
+            # still descend into call args of len() etc? len(x) is
+            # static regardless of x — prune entirely
+            return False
+        if isinstance(n, ast.Name) and n.id in traced:
+            return True
+        return any(_walk(c) for c in ast.iter_child_nodes(n))
+
+    return _walk(node)
+
+
+def _check_jit_body(sf: SourceFile, fn: ast.AST,
+                    jit_call: Optional[ast.Call],
+                    findings: List[Finding]):
+    static = _static_params(fn, jit_call)
+    # varargs arrive as TUPLES (truthiness/len are static) and self/cls
+    # are closed over, not traced — neither joins the traced set
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                              + fn.args.kwonlyargs)
+              if a.arg not in ("self", "cls")]
+    if static is None:
+        traced: Set[str] = set()      # statics unknown: only flag
+        explicit_only = True          # explicit sync calls
+    else:
+        traced = {p for p in params if p not in static}
+        explicit_only = False
+
+    def _note(node, msg):
+        findings.append(sf.finding("GL102", "error", node, msg,
+                                   _JIT_HINT))
+
+    class _V(ast.NodeVisitor):
+        def __init__(self):
+            self.traced = set(traced)
+
+        def visit_FunctionDef(self, node):
+            if node is fn:
+                self.generic_visit(node)
+                return
+            # nested def: its params are traced too (traced closure)
+            inner = _V()
+            inner.traced = self.traced | {
+                a.arg for a in node.args.posonlyargs + node.args.args
+                if a.arg not in ("self", "cls")}
+            for stmt in node.body:
+                inner.visit(stmt)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node):
+            if not explicit_only and isinstance(node.value, ast.expr) \
+                    and _traced_names_in(node.value, self.traced):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.traced.add(tgt.id)
+            self.generic_visit(node)
+
+        def visit_Call(self, node):
+            d = call_target(node)
+            tname = terminal_name(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    tname in _SYNC_METHODS:
+                _note(node, f".{tname}() inside a jitted function "
+                            f"forces a host sync (or fails on a tracer)")
+            elif d in ("jax.device_get", "device_get"):
+                _note(node, "jax.device_get inside a jitted function "
+                            "forces a host transfer")
+            elif d.split(".", 1)[0] in ("np", "numpy") and node.args \
+                    and any(_traced_names_in(a, self.traced)
+                            for a in node.args):
+                _note(node, f"numpy call {d}() on a traced value "
+                            f"inside a jitted function materializes "
+                            f"the tracer on the host")
+            elif d in ("float", "int", "bool", "complex") and node.args \
+                    and _traced_names_in(node.args[0], self.traced):
+                _note(node, f"{d}() on a traced value inside a jitted "
+                            f"function forces a host sync "
+                            f"(ConcretizationTypeError on abstract "
+                            f"tracers)")
+            self.generic_visit(node)
+
+        def _check_branch(self, node, kw):
+            if not explicit_only and \
+                    _traced_names_in(node.test, self.traced):
+                _note(node, f"`{kw} <traced value>` inside a jitted "
+                            f"function: implicit __bool__ on a tracer "
+                            f"(TracerBoolConversionError; "
+                            f"value-dependent control flow retraces or "
+                            f"crashes)")
+
+        def visit_If(self, node):
+            self._check_branch(node, "if")
+            self.generic_visit(node)
+
+        def visit_While(self, node):
+            self._check_branch(node, "while")
+            self.generic_visit(node)
+
+    _V().visit(fn)
+
+
+def _check_hot_body(sf: SourceFile, fn: ast.AST, findings: List[Finding]):
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = call_target(node)
+        tname = terminal_name(node.func)
+        if isinstance(node.func, ast.Attribute) and \
+                tname in ("item", "numpy", "block_until_ready"):
+            findings.append(sf.finding(
+                "GL102", "warning", node,
+                f".{tname}() in registered hot path "
+                f"{getattr(fn, 'name', '?')!r} is a device->host sync",
+                _HOT_HINT))
+        elif d in ("jax.device_get", "device_get"):
+            findings.append(sf.finding(
+                "GL102", "warning", node,
+                f"jax.device_get in registered hot path "
+                f"{getattr(fn, 'name', '?')!r}", _HOT_HINT))
+        elif d in ("np.asarray", "numpy.asarray", "np.array",
+                   "numpy.array"):
+            findings.append(sf.finding(
+                "GL102", "warning", node,
+                f"{d}() in registered hot path "
+                f"{getattr(fn, 'name', '?')!r} downloads a device "
+                f"array (or is a redundant host copy)", _HOT_HINT))
+
+
+def check(sf: SourceFile, repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted = _collect_jitted(sf)
+    seen_jit: Set[ast.AST] = set()
+    hot_covered: Set[ast.AST] = set()  # nested defs a hot ancestor's
+    #                                    full-body walk already scanned
+    #                                    (a wildcard glob would match
+    #                                    them again: double report)
+    for qualname, fn in walk_functions(sf.tree):
+        bare = fn.name
+        if bare in jitted and fn not in seen_jit:
+            seen_jit.add(fn)
+            _check_jit_body(sf, fn, jitted[bare], findings)
+        elif fn not in hot_covered and \
+                config.is_hot_path(sf.relpath, qualname):
+            _check_hot_body(sf, fn, findings)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not fn:
+                    hot_covered.add(node)
+    return findings

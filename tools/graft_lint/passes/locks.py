@@ -1,0 +1,177 @@
+"""GL104 — non-reentrant locks in signal handlers / excepthook / atexit.
+
+The PR-5 near-miss: a SIGTERM handler that called `flight_dump()`
+could interrupt the main thread WHILE it held the flight-recorder or
+registry lock — `threading.Lock` is not reentrant, so the handler
+deadlocks the process it was meant to checkpoint. The fix pattern is
+to defer the work to a safe boundary (set a flag, act at the next
+step) — encoded here as a rule.
+
+Detection: find handler registrations —
+
+    signal.signal(sig, fn)        sys.excepthook = fn
+    atexit.register(fn)           signal.setitimer/sigaction variants
+
+— resolve `fn` to same-module function defs (bare names, `self._meth`,
+lambdas), then walk each handler body plus same-module callees to a
+small depth, flagging:
+
+- `with <lock>` / `<lock>.acquire()` where the name matches
+  config.LOCK_NAME_RE,
+- calls into the known lock-acquiring telemetry surface
+  (config.LOCKY_FUNCTIONS / LOCKY_METHODS: flight_dump, registry
+  create-or-get, exporter export/write_record, metric inc/observe...).
+
+atexit findings are warnings (teardown on the main thread is usually
+safe but still serializes against live threads holding the lock);
+signal-handler and excepthook findings are errors.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import config
+from ..core import (Finding, SourceFile, call_target, dotted,
+                    terminal_name)
+
+_MAX_DEPTH = 3
+_LOCK_RE = re.compile(config.LOCK_NAME_RE)
+
+_HINT = ("defer the work out of the handler: set a flag and act at the "
+         "next step boundary (Trainer preemption pattern), or make the "
+         "path lock-free; non-reentrant locks self-deadlock when the "
+         "handler interrupts their holder")
+
+
+def _collect_defs(sf: SourceFile) -> Dict[str, ast.AST]:
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _handler_registrations(sf: SourceFile
+                           ) -> List[Tuple[str, ast.AST, str]]:
+    """[(context, handler node-or-name, where)] — handler is an AST
+    node (Lambda / FunctionDef) or a bare/terminal name to resolve."""
+    out: List[Tuple[str, object, ast.AST]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            d = call_target(node)
+            if d in ("signal.signal", "signal.sigaction") and \
+                    len(node.args) >= 2:
+                out.append(("signal handler", node.args[1], node))
+            elif d == "atexit.register" and node.args:
+                out.append(("atexit callback", node.args[0], node))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if dotted(tgt) == "sys.excepthook":
+                    out.append(("sys.excepthook chain", node.value,
+                                node))
+    return out
+
+
+def _lockish(expr: ast.AST) -> bool:
+    name = dotted(expr) or terminal_name(expr)
+    return bool(name) and bool(_LOCK_RE.search(name))
+
+
+def _receiver_text(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return dotted(func.value) or terminal_name(func.value)
+    return ""
+
+
+def _locky_call(node: ast.Call) -> Optional[str]:
+    """Reason string when this call enters the known non-reentrant
+    lock surface."""
+    tname = terminal_name(node.func)
+    if tname in config.LOCKY_FUNCTIONS:
+        return (f"{tname}() acquires the "
+                f"flight-recorder/registry/exporter locks")
+    hint = config.LOCKY_METHODS.get(tname)
+    if hint is not None or tname in config.LOCKY_METHODS:
+        recv = _receiver_text(node.func)
+        if hint is None or re.search(hint, recv, re.IGNORECASE):
+            return (f".{tname}() on {recv or 'the telemetry surface'} "
+                    f"takes a non-reentrant lock")
+    return None
+
+
+def _scan_body(sf: SourceFile, fn_node: ast.AST, context: str,
+               severity: str, defs: Dict[str, ast.AST],
+               visited: Set[ast.AST], depth: int,
+               findings: List[Finding], origin: str):
+    if depth > _MAX_DEPTH or fn_node in visited:
+        return
+    visited.add(fn_node)
+    body = fn_node.body if isinstance(
+        fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)) else [fn_node]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if _lockish(item.context_expr):
+                        findings.append(sf.finding(
+                            "GL104", severity, node,
+                            f"lock acquired inside {context} "
+                            f"({origin}): `with "
+                            f"{dotted(item.context_expr)}`", _HINT))
+            elif isinstance(node, ast.Call):
+                if terminal_name(node.func) == "acquire" and \
+                        isinstance(node.func, ast.Attribute) and \
+                        _lockish(node.func.value):
+                    findings.append(sf.finding(
+                        "GL104", severity, node,
+                        f"lock .acquire() inside {context} ({origin})",
+                        _HINT))
+                    continue
+                reason = _locky_call(node)
+                if reason is not None:
+                    findings.append(sf.finding(
+                        "GL104", severity, node,
+                        f"{reason} inside {context} ({origin})",
+                        _HINT))
+                    continue
+                # recurse into same-module callees (bare f() or
+                # self._meth())
+                callee = terminal_name(node.func)
+                nxt = defs.get(callee)
+                if nxt is not None:
+                    _scan_body(sf, nxt, context, severity, defs,
+                               visited, depth + 1, findings,
+                               f"{origin} -> {callee}")
+
+
+def check(sf: SourceFile, repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    defs = _collect_defs(sf)
+    for context, handler, reg_node in _handler_registrations(sf):
+        severity = "warning" if context == "atexit callback" else "error"
+        if isinstance(handler, ast.Lambda):
+            _scan_body(sf, ast.Module(body=[ast.Expr(handler.body)],
+                                      type_ignores=[]),
+                       context, severity, defs, set(), 0, findings,
+                       "<lambda>")
+            continue
+        name = terminal_name(handler) if isinstance(
+            handler, (ast.Name, ast.Attribute)) else ""
+        fn = defs.get(name)
+        if fn is None:
+            # registering a known-locky callable directly:
+            # atexit.register(exporter.close) etc.
+            if isinstance(handler, (ast.Name, ast.Attribute)):
+                fake = ast.Call(func=handler, args=[], keywords=[])
+                ast.copy_location(fake, reg_node)
+                reason = _locky_call(fake)
+                if reason is not None:
+                    findings.append(sf.finding(
+                        "GL104", severity, reg_node,
+                        f"{reason} registered as {context}", _HINT))
+            continue
+        _scan_body(sf, fn, context, severity, defs, set(), 0, findings,
+                   name)
+    return findings

@@ -1,0 +1,138 @@
+"""GL103 — retrace hazards at jit construction and call sites.
+
+XLA programs are cached per (wrapper identity, signature). Three
+statically detectable ways this repo has (nearly) broken that:
+
+1. `jax.jit(f)(args)` — immediate invocation inside a function body:
+   every call builds a FRESH wrapper, so the compile cache is thrown
+   away and the program retraces (and often recompiles) per call.
+2. `jax.jit(lambda ...)` anywhere but a module-level assignment: the
+   lambda is a new function object per evaluation — same failure as
+   (1) but hidden behind a name.
+3. unhashable static arguments: a literal `static_argnums` /
+   `static_argnames` pointing at a parameter whose default (or visible
+   call-site value) is a list/dict/set — `jit` raises
+   `ValueError: unhashable type` at the first call, or silently
+   retraces per value when wrapped in tuple(...) conversions upstream.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import (Finding, SourceFile, is_jax_jit, kwarg,
+                    partial_of_jit, terminal_name)
+
+_HINT = ("build the jit wrapper ONCE (module scope or cached on the "
+         "instance) and call the cached wrapper per step; static args "
+         "must be hashable (tuples, not lists)")
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and (
+        is_jax_jit(node.func) or partial_of_jit(node))
+
+
+def _literal_ints(node) -> Optional[Set[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.add(e.value)
+        return out
+    return None
+
+
+def check(sf: SourceFile, repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    tree = sf.tree
+
+    # parent map for "is this jit call a module-level assignment RHS /
+    # inside a function body" questions
+    parent: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+
+    def _enclosing_function(node) -> Optional[ast.AST]:
+        n = parent.get(node)
+        while n is not None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return n
+            n = parent.get(n)
+        return None
+
+    def _in_loop(node) -> bool:
+        n = parent.get(node)
+        while n is not None and not isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(n, (ast.For, ast.While)):
+                return True
+            n = parent.get(n)
+        return False
+
+    # local function defs, for static-default resolution
+    local_defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs.setdefault(node.name, node)
+
+    for node in ast.walk(tree):
+        if not _is_jit_call(node):
+            continue
+
+        # (1) immediate invocation: jit(...) is itself the func of a
+        # surrounding Call, inside a function body or a loop
+        p = parent.get(node)
+        if isinstance(p, ast.Call) and p.func is node and (
+                _enclosing_function(node) is not None or _in_loop(node)):
+            findings.append(sf.finding(
+                "GL103", "error", node,
+                "jax.jit(...)(...) immediate invocation builds a fresh "
+                "wrapper per call — the compile cache is discarded and "
+                "every call retraces", _HINT))
+
+        # (2) jit of a lambda outside a module-level assignment
+        target = node.args[0] if node.args else None
+        if partial_of_jit(node):
+            target = None  # partial(jax.jit, ...) has no fn yet
+        if isinstance(target, ast.Lambda):
+            p = parent.get(node)
+            module_level_assign = (
+                isinstance(p, ast.Assign) and parent.get(p) is tree)
+            if not module_level_assign:
+                findings.append(sf.finding(
+                    "GL103", "error", node,
+                    "jax.jit(lambda ...) outside a module-level "
+                    "assignment: a new lambda object per evaluation "
+                    "defeats the compile cache (retrace per call)",
+                    _HINT))
+
+        # (3) unhashable static defaults on a locally visible function
+        nums = _literal_ints(kwarg(node, "static_argnums") or
+                             ast.Constant(value=None))
+        fn_name = terminal_name(target) if target is not None else ""
+        fn_def = local_defs.get(fn_name)
+        if nums and fn_def is not None:
+            args = fn_def.args
+            params = args.posonlyargs + args.args
+            # defaults align to the tail of params
+            defaults = args.defaults
+            off = len(params) - len(defaults)
+            for i in nums:
+                if off <= i < len(params):
+                    d = defaults[i - off]
+                    if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                        findings.append(sf.finding(
+                            "GL103", "error", d,
+                            f"static_argnums position {i} "
+                            f"({params[i].arg!r}) defaults to an "
+                            f"unhashable {type(d).__name__.lower()} — "
+                            f"jit static args must be hashable",
+                            _HINT))
+    return findings

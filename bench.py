@@ -386,8 +386,13 @@ def serve_bench(argv=None):
 
         python bench.py --serve [--loads 4,8] [--max-new 16]
         python bench.py --serve --multitenant [--sessions N] [--requests N]
+        python bench.py --serve --mixed
+        python bench.py --serve --coldstart
 
-    `--multitenant` runs the PR-6 front-end scenario instead (zipf
+    `--mixed` runs the chunked-prefill mixed-load scenario instead
+    (long-prompt ingest while short requests arrive and a background
+    request decodes — see serve_mixed_bench). `--multitenant` runs the
+    PR-6 front-end scenario (zipf
     prefix reuse + mixed priority tiers against a 2-replica router —
     see serve_mt_bench). Prints one JSON summary line; CPU smoke
     shrinks the model/loads so the tier-1 suite can run it in-process
@@ -402,6 +407,10 @@ def serve_bench(argv=None):
     ap.add_argument("--out", default=None, help="telemetry JSONL path")
     ap.add_argument("--multitenant", action="store_true",
                     help="run the multi-tenant router/tier scenario")
+    ap.add_argument("--mixed", action="store_true",
+                    help="run the chunked-prefill mixed-load scenario "
+                         "instead: long-prompt ingest interleaved with "
+                         "decode, chunked vs unchunked arms")
     ap.add_argument("--coldstart", action="store_true",
                     help="run the AOT cold-start scenario instead: "
                          "cold vs engine-warm-started "
@@ -421,6 +430,8 @@ def serve_bench(argv=None):
         return serve_mt_bench(a)
     if a.coldstart:
         return serve_coldstart_bench(a)
+    if a.mixed:
+        return serve_mixed_bench(a)
 
     import jax
     import paddle_tpu as paddle
@@ -563,10 +574,12 @@ def serve_coldstart_bench(a):
                           tensor_parallel=False)
         buckets, batch, page, max_seq = (128, 256), 4, 16, 1024
         max_new = a.max_new or 16
+        chunk, long_len = 128, 300
     else:
         cfg = LlamaConfig.tiny(tensor_parallel=False)
         buckets, batch, page, max_seq = (8, 16), 2, 8, 64
         max_new = a.max_new or 3
+        chunk, long_len = 16, 33
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
@@ -575,11 +588,14 @@ def serve_coldstart_bench(a):
     rng = np.random.RandomState(0)
 
     # one prompt per bucket, length == bucket so admission compiles
-    # (cold) / dispatches (warm) exactly the calibrated signatures; the
-    # SAME prompts in both arms (greedy parity check) with the prefix
-    # cache off — the number under test is compilation, not KV reuse
+    # (cold) / dispatches (warm) exactly the calibrated signatures,
+    # plus one CHUNKED long prompt (> prefill_chunk_tokens) whose
+    # mixed-step buckets the builder pre-captures; the SAME prompts in
+    # both arms (greedy parity check) with the prefix cache off — the
+    # number under test is compilation, not KV reuse
     prompts = [rng.randint(2, cfg.vocab_size, (b,)).tolist()
                for b in buckets]
+    prompts.append(rng.randint(2, cfg.vocab_size, (long_len,)).tolist())
 
     engine_dir = a.engine_dir or os.path.join(
         tempfile.mkdtemp(prefix="aot_coldstart_"), "engine")
@@ -609,7 +625,8 @@ def serve_coldstart_bench(a):
         t0 = time.perf_counter()
         cb = ContinuousBatchingPredictor(
             model, max_batch_size=batch, page_size=page,
-            max_seq_len=max_seq, enable_prefix_cache=False)
+            max_seq_len=max_seq, enable_prefix_cache=False,
+            prefill_chunk_tokens=chunk)
         cold_out = cb.generate(prompts, max_new_tokens=max_new)
         cold_wall = time.perf_counter() - t0
         cold_s = gauge_mode("cold")
@@ -620,7 +637,7 @@ def serve_coldstart_bench(a):
             model, engine_dir, prompt_buckets=buckets,
             batch_sizes=(1, batch), max_batch_size=batch,
             page_size=page, max_seq_len=max_seq,
-            enable_prefix_cache=False)
+            enable_prefix_cache=False, prefill_chunk_tokens=chunk)
         build_s = time.perf_counter() - t0
         _log(f"engine built: {len(manifest['artifacts'])} artifacts "
              f"in {build_s:.1f}s -> {engine_dir}")
@@ -690,6 +707,216 @@ def serve_coldstart_bench(a):
             "artifacts": len(manifest["artifacts"]),
             "engine_dir": engine_dir,
             "buckets": list(buckets), "max_new": max_new,
+            "checks": checks,
+            "telemetry": path,
+            "bench_code_sha": _bench_code_sha(),
+        },
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def serve_mixed_bench(a):
+    """Chunked-prefill mixed-load scenario (`bench.py --serve --mixed`):
+    a background request is mid-decode when a LONG prompt and several
+    short prompts arrive together. Two arms over the same trace, both
+    recorded through the observability JSONL sink so the claims are
+    asserted FROM the telemetry file (PR-6 pattern):
+
+    - **unchunked** — the long prompt prefills monolithically at
+      admission: every in-flight decode stalls behind it and the short
+      requests' first tokens wait for the big prefill;
+    - **chunked** — `prefill_chunk_tokens` splits the long prompt into
+      page-aligned chunks served by the MIXED prefill+decode program,
+      one chunk per tick, interleaved with the decode steps.
+
+    Claims (from `serve.request` spans, per arm via the replica label):
+
+    1. **short-request p99 TTFT improves** — chunked < unchunked (the
+       shorts no longer queue behind the monolithic prefill);
+    2. **decode p99 inter-token latency stays flat while the long
+       prompt ingests** — the background request's p99 token gap in
+       the chunked arm < the unchunked arm's (whose p99 swallows the
+       full prefill stall);
+
+    plus greedy parity: both arms emit identical tokens. Exit 0 = all
+    checks hold; 1 = an assertion failed.
+    """
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import runtime as obs_rt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ContinuousBatchingPredictor
+    from paddle_tpu.serving.streaming import ServeRequest
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        batch, page, max_seq, chunk = 6, 16, 2048, 128
+        bg_len, long_len, short_lens = 48, 900, (40, 56, 48)
+        bg_new, tail_new = 96, 8
+    else:
+        # the long prompt must be expensive RELATIVE to one chunk tick
+        # for the stall contrast to clear CPU timing noise: a 120-token
+        # prompt → one 128-bucket monolithic prefill (vs ~8-token mixed
+        # ticks), on a model wide enough that forward cost is compute,
+        # not python dispatch overhead
+        cfg = LlamaConfig.tiny(hidden_size=256, intermediate_size=512,
+                               tensor_parallel=False)
+        batch, page, max_seq, chunk = 4, 8, 256, 16
+        bg_len, long_len, short_lens = 6, 120, (5, 7)
+        bg_new, tail_new = 30, 4
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    rng = np.random.RandomState(0)
+    bg_prompt = rng.randint(2, cfg.vocab_size, (bg_len,)).tolist()
+    long_prompt = rng.randint(2, cfg.vocab_size, (long_len,)).tolist()
+    shorts = [rng.randint(2, cfg.vocab_size, (n,)).tolist()
+              for n in short_lens]
+    n_short = len(shorts)
+
+    def pct(xs, q):
+        if not xs:
+            return 0.0
+        ys = sorted(xs)
+        pos = q * (len(ys) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(ys) - 1)
+        return ys[lo] * (1 - (pos - lo)) + ys[hi] * (pos - lo)
+
+    def run_scenario(cb):
+        """Background decodes first; once it has streamed 3 tokens the
+        long prompt + shorts arrive in one burst; intake then closes
+        and the loop drains."""
+        state = {"phase": 0}
+
+        def intake():
+            if state["phase"] == 0:
+                state["phase"] = 1
+                return [ServeRequest(bg_prompt, bg_new)]
+            if state["phase"] == 1:
+                return []          # waiting for the bg to get going
+            if state["phase"] == 2:
+                state["phase"] = 3
+                return [ServeRequest(long_prompt, tail_new)] + \
+                    [ServeRequest(p, tail_new) for p in shorts]
+            return None            # phase 3: close + drain
+
+        stream = cb.serve_stream(intake)
+        bg_tokens = 0
+        for ev in stream:
+            if ev.kind == "token" and ev.request == 0:
+                bg_tokens += 1
+                if bg_tokens >= 3 and state["phase"] == 1:
+                    state["phase"] = 2
+        return list(stream.results)
+
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "output", "telemetry_mixed.jsonl")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    open(path, "w").close()  # the assertions parse the WHOLE file:
+    was_enabled = obs.enabled()  # stale arms from a prior run must not
+    results = {}                 # satisfy (or fail) this run's claims
+    try:
+        # arm_chunk=0 is EXPLICIT off (None would defer the control arm
+        # to FLAGS_serve_prefill_chunk_tokens — a host with the flag
+        # set would chunk both arms and fail a healthy run)
+        for arm, arm_chunk in (("unchunked", 0), ("chunked", chunk)):
+            cb = ContinuousBatchingPredictor(
+                model, max_batch_size=batch, page_size=page,
+                max_seq_len=max_seq, enable_prefix_cache=False,
+                prefill_chunk_tokens=arm_chunk, name=arm)
+            # warmup: compile every signature the measured pass can
+            # dispatch, with telemetry DISABLED — export_record would
+            # otherwise auto-attach the PADDLE_TPU_TELEMETRY_JSONL env
+            # sink and leak warmup spans into the asserted file. The
+            # extra long-prompt-alone run covers the zero-decode-load
+            # chunk buckets the timed trace may or may not hit.
+            obs.enabled(False)
+            run_scenario(cb)
+            if arm_chunk:
+                cb.generate([long_prompt], max_new_tokens=2)
+            obs.enabled(True)
+            obs_rt.configure(path)
+            results[arm] = run_scenario(cb)
+            obs_rt.maybe_export()
+            obs_rt.configure(None)
+    finally:
+        obs_rt.configure(None)
+        obs.enabled(was_enabled)
+
+    # ---- assertions, FROM the telemetry file ------------------------
+    by_arm = {"unchunked": [], "chunked": []}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "span" \
+                    and rec.get("name") == "serve.request":
+                lab = rec.get("labels") or {}
+                if lab.get("replica") in by_arm:
+                    by_arm[lab.get("replica")].append(rec)
+
+    def arm_stats(spans):
+        ttft_short, bg_gaps, chunk_events = [], [], 0
+        for s in spans:
+            lab = s.get("labels") or {}
+            idx = lab.get("idx")
+            evs = s.get("events") or []
+            ft = [e["ts"] for e in evs if e.get("name") == "first_token"]
+            if idx is not None and int(idx) >= 2 and ft:
+                ttft_short.append(ft[0] - float(s.get("start", 0.0)))
+            if idx == 0 and ft:
+                toks = ft + [e["ts"] for e in evs
+                             if e.get("name") == "token"]
+                bg_gaps.extend(b - a2 for a2, b in zip(toks, toks[1:]))
+            chunk_events += sum(1 for e in evs
+                                if e.get("name") == "prefill_chunk")
+        return {"ttft_short_p99": pct(ttft_short, 0.99),
+                "n_short": len(ttft_short),
+                "bg_gap_p99": pct(bg_gaps, 0.99),
+                "bg_gap_max": max(bg_gaps) if bg_gaps else 0.0,
+                "n_gaps": len(bg_gaps),
+                "prefill_chunk_events": chunk_events}
+
+    u = arm_stats(by_arm["unchunked"])
+    c = arm_stats(by_arm["chunked"])
+    checks = {
+        "both_arms_measured": u["n_short"] == n_short
+        and c["n_short"] == n_short and u["n_gaps"] > 4
+        and c["n_gaps"] > 4,
+        "chunked_arm_chunked": c["prefill_chunk_events"] >= 2
+        and u["prefill_chunk_events"] == 0,
+        "greedy_parity": results["chunked"] == results["unchunked"],
+        "short_ttft_p99_improves":
+            c["ttft_short_p99"] < u["ttft_short_p99"],
+        "decode_intertoken_p99_flat":
+            c["bg_gap_p99"] < u["bg_gap_p99"],
+    }
+    ok = all(checks.values())
+    result = {
+        "metric": "serve_mixed_short_ttft_p99_ratio",
+        "value": round(c["ttft_short_p99"]
+                       / max(u["ttft_short_p99"], 1e-9), 4),
+        "unit": "ratio (chunked/unchunked, lower is better)",
+        "aux": {
+            "backend": jax.default_backend(),
+            "unchunked": {k: round(v, 6) if isinstance(v, float) else v
+                          for k, v in u.items()},
+            "chunked": {k: round(v, 6) if isinstance(v, float) else v
+                        for k, v in c.items()},
+            "long_len": long_len, "chunk_tokens": chunk,
             "checks": checks,
             "telemetry": path,
             "bench_code_sha": _bench_code_sha(),

@@ -211,6 +211,16 @@ define_flag("ckpt_save_retries", 3,
 define_flag("ckpt_retry_backoff_s", 0.5,
             "Base delay (seconds) for checkpoint save retry backoff; "
             "doubles per attempt (capped at 8s), +/-50% jitter.")
+define_flag("serve_prefill_chunk_tokens", 0,
+            "ContinuousBatchingPredictor chunked prefill: prompts "
+            "longer than this many tokens are ingested as page-aligned "
+            "chunks interleaved with decode ticks (one mixed "
+            "prefill+decode program per tick) instead of one "
+            "monolithic prefill that stalls every in-flight decode. "
+            "Rounded DOWN to a power-of-two multiple of page_size (a "
+            "latency bound; min one page); the per-tick chunk shrinks "
+            "under decode load. 0 disables (constructor "
+            "prefill_chunk_tokens overrides).")
 define_flag("serve_decode_watchdog_s", 0.0,
             "ContinuousBatchingPredictor decode watchdog: if a decode "
             "step's host sync does not resolve within this many "

@@ -362,12 +362,21 @@ class PagedCacheEntry(NamedTuple):
     from kernels.paged_attention.build_ragged_meta for the POST-write
     lengths (context_lens + 1) — when present, attention runs the
     ragged-grid kernel (only valid (seq, page) pairs enter the grid).
+
+    `q_lens` (optional, [B] int32): per-slot QUERY SPAN lengths for the
+    MIXED prefill+decode step — slot b's forward carries q_lens[b]
+    tokens (a prefill chunk, or 1 for a decode tick) starting at
+    absolute position context_lens[b]. When set, attention dispatches
+    to `paged_cache_mixed_update_attend` (span K/V scatter + the
+    variable-query ragged kernel) and `ragged_meta`, if present, must
+    be built for the post-write lengths context_lens + q_lens.
     """
     k_pages: object
     v_pages: object
     block_table: object
     context_lens: object
     ragged_meta: object = None
+    q_lens: object = None
 
 
 class PagedKVCache:
@@ -398,6 +407,10 @@ def paged_cache_update_attend(entry: PagedCacheEntry, q, k, v, scale=None):
     from ..kernels.paged_attention import (paged_attention,
                                            paged_attention_ragged)
 
+    if entry.q_lens is not None:
+        # mixed prefill+decode step: variable-length query spans
+        return paged_cache_mixed_update_attend(entry, q, k, v, scale)
+
     meta = entry.ragged_meta
 
     def fn(kp, vp, bt, cl, qv, kv, vv, *meta_arrs):
@@ -425,4 +438,70 @@ def paged_cache_update_attend(entry: PagedCacheEntry, q, k, v, scale=None):
                           *extra, _name="paged_attention_decode")
     new_entry = PagedCacheEntry(kp2, vp2, entry.block_table,
                                 entry.context_lens, entry.ragged_meta)
+    return out, new_entry
+
+
+def paged_cache_mixed_update_attend(entry: PagedCacheEntry, q, k, v,
+                                    scale=None):
+    """MIXED-step contract for the paged cache: each slot carries a
+    query span of entry.q_lens[b] tokens (a prefill chunk, or 1 for a
+    decode tick) starting at absolute position entry.context_lens[b].
+    The span's K/V is scattered into the slot's pages IN-GRAPH, then
+    the span attends causally over the pages with the variable-query
+    ragged kernel (kernels.paged_attention.paged_attention_ragged_varq)
+    — one compiled step serves a batch mixing mid-prefill and
+    mid-decode requests. q: [B, Qb, H, D]; k/v: [B, Qb, Hkv, D] →
+    (out [B, Qb, H, D], updated entry). Padding span positions (i >=
+    q_lens[b]) write nothing (the scatter keeps the old page contents)
+    and read back zeros. Gradients are not defined (serving path)."""
+    import jax.numpy as jnp
+    from ..ops._dispatch import apply
+    from ..kernels.paged_attention import (paged_attention_varq,
+                                           paged_attention_ragged_varq)
+
+    meta = entry.ragged_meta
+
+    def fn(kp, vp, bt, cl, ql, qv, kv, vv, *meta_arrs):
+        qb = qv.shape[1]
+        page = kp.shape[1]
+        i = jnp.arange(qb, dtype=jnp.int32)[None, :]
+        pos = cl[:, None].astype(jnp.int32) + i            # [B, Qb]
+        writing = i < ql[:, None].astype(jnp.int32)        # [B, Qb]
+        pslot = jnp.clip(pos // page, 0, bt.shape[1] - 1)
+        # padding span positions write NOTHING: their destination page
+        # is forced out of bounds and the scatter drops them. (Writing
+        # their own gathered contents back instead would race: a
+        # padding position past the END of a fully-allocated table
+        # clips into the slot's last real page, and duplicate scatter
+        # indices carrying different values — stale gather vs this
+        # step's real K/V — have an unspecified winner.)
+        dst_page = jnp.where(writing,
+                             jnp.take_along_axis(bt, pslot, axis=1),
+                             jnp.int32(kp.shape[0]))       # [B, Qb]
+        dst_off = (pos % page).astype(jnp.int32)
+        kp2 = kp.at[dst_page, dst_off].set(kv.astype(kp.dtype),
+                                           mode="drop")
+        vp2 = vp.at[dst_page, dst_off].set(vv.astype(vp.dtype),
+                                           mode="drop")
+        kv_lens = cl.astype(jnp.int32) + ql.astype(jnp.int32)
+        if meta_arrs:
+            mk = dict(zip(("seq", "page", "ordinal", "first", "last",
+                           "valid"), meta_arrs))
+            out = paged_attention_ragged_varq(qv, kp2, vp2, kv_lens, ql,
+                                              mk, scale, block_tables=bt)
+        else:
+            out = paged_attention_varq(qv, kp2, vp2, bt, kv_lens, ql,
+                                       scale)
+        return out.astype(qv.dtype), kp2, vp2
+
+    extra = () if meta is None else tuple(
+        meta[k] for k in ("seq", "page", "ordinal", "first", "last",
+                          "valid"))
+    out, kp2, vp2 = apply(fn, entry.k_pages, entry.v_pages,
+                          entry.block_table, entry.context_lens,
+                          entry.q_lens, q, k, v, *extra,
+                          _name="paged_attention_mixed")
+    new_entry = PagedCacheEntry(kp2, vp2, entry.block_table,
+                                entry.context_lens, entry.ragged_meta,
+                                entry.q_lens)
     return out, new_entry

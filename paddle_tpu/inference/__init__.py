@@ -449,6 +449,16 @@ class ContinuousBatchingPredictor:
       admissible requests instead of only the head; a large request
       waiting for pages no longer starves small ones behind it
       (serving.hol_skips counts the pass-overs).
+    - **Chunked prefill (mixed steps).** With `prefill_chunk_tokens`
+      set (or FLAGS_serve_prefill_chunk_tokens), prompts over the
+      threshold ingest as page-aligned chunks through ONE mixed
+      prefill+decode program per tick (the variable-query ragged
+      kernel): a long prompt no longer monopolizes the device — the
+      in-flight decodes take their normal token step in the SAME
+      dispatch, and the chunk size adapts to the decode load
+      (docs/SERVING.md "Chunked prefill"; serving.chunked_prefill.*
+      and serve.mixed_step_seconds in the catalog). Greedy output is
+      token-identical to the unchunked path.
 
     Greedy decoding (argmax), matching model.generate's default.
     """
@@ -458,7 +468,7 @@ class ContinuousBatchingPredictor:
                  eos_token_id=None, kv_dtype=None, use_ragged="auto",
                  enable_prefix_cache=True, max_queue=None,
                  shed_policy="newest", decode_watchdog_s=None,
-                 name=None, engine=None):
+                 name=None, engine=None, prefill_chunk_tokens=None):
         import math as _m
         import time as _time
         model.eval()
@@ -578,6 +588,36 @@ class ContinuousBatchingPredictor:
                 and cfg.num_attention_heads % 8 == 0
                 and (_use_pallas() or pallas_interpret()))
         self.use_ragged = bool(use_ragged)
+        # chunked prefill (docs/SERVING.md "Chunked prefill"): prompts
+        # longer than the threshold are ingested as page-aligned chunks
+        # through the MIXED prefill+decode program — one tick at a time,
+        # interleaved with decode — instead of one monolithic prefill
+        # that stalls every in-flight decode until it finishes. The
+        # threshold is a latency bound, so it normalizes DOWN to a
+        # power-of-two multiple of page_size (min one page): chunk
+        # buckets (compile signatures) form the fixed set
+        # {page * 2^k <= chunk_max} that the AOT builder pre-captures,
+        # and a tick never exceeds what the operator asked for.
+        # 0/None disables (defers to FLAGS_serve_prefill_chunk_tokens).
+        if prefill_chunk_tokens is None:
+            prefill_chunk_tokens = int(_fv("serve_prefill_chunk_tokens"))
+        chunk = int(prefill_chunk_tokens or 0)
+        if chunk > 0:
+            b = self.page
+            while b * 2 <= chunk:
+                b *= 2
+            chunk = b
+        self._chunk_max = chunk
+        self._m_chunks = _obsm.counter("serving.chunked_prefill.chunks")
+        self._m_chunk_reqs = _obsm.counter(
+            "serving.chunked_prefill.requests")
+        self._m_chunk_tok = _obsm.counter(
+            "serving.chunked_prefill.tokens")
+        self._m_mixed = _obsm.histogram("serve.mixed_step_seconds",
+                                        unit="s")
+        self.stats["prefill_chunks"] = 0
+        self.stats["chunked_requests"] = 0
+        self.stats["mixed_steps"] = 0
         self._ready = False
         self._req_seq = 0   # process-unique request ids across calls
 
@@ -603,6 +643,8 @@ class ContinuousBatchingPredictor:
                                        donate_argnums=dn)
             self._decode_jit = jax.jit(self._raw_decode_step,
                                        donate_argnums=dn)
+            self._mixed_jit = jax.jit(self._raw_mixed_step,
+                                      donate_argnums=dn)
             self._p_vals = [t._value for t in self._p_tensors]
             self._b_vals = [t._value for t in self._b_tensors]
             self._ready = True
@@ -758,6 +800,59 @@ class ContinuousBatchingPredictor:
                 position_ids=Tensor(ctx[:, None]),
                 past_key_values=PagedKVCache(entries), use_cache=True)
         nxt = jnp.argmax(logits._value[:, -1], axis=-1).astype(jnp.int32)
+        if self.eos_token_id is not None:
+            done = nxt == jnp.int32(self.eos_token_id)
+        else:
+            done = jnp.zeros(nxt.shape, jnp.bool_)
+        new_k = [getattr(e.k_pages, "_value", e.k_pages) for e in caches]
+        new_v = [getattr(e.v_pages, "_value", e.v_pages) for e in caches]
+        return nxt, done, new_k, new_v
+
+    def _raw_mixed_step(self, p_vals, b_vals, kl, vl, tables, ctx,
+                        span_ids, q_lens, tok_in, *meta_flat):
+        """ONE compiled MIXED prefill+decode step: every slot carries a
+        query span — a prefill chunk of q_lens[b] prompt tokens, or a
+        single decode token (q_lens[b] == 1) — starting at absolute
+        position ctx[b]. Per layer the span's K/V scatters into the
+        slot's pages and the span attends causally over them via the
+        variable-query ragged kernel (generation/kv_cache.
+        paged_cache_mixed_update_attend), so a long prompt ingests
+        chunk-by-chunk WHILE the other slots keep decoding — in the
+        same dispatch.
+
+        span_ids: [B, Qb] span tokens (host-built; column 0 of decode
+        slots is a placeholder); tok_in: [B] the decode-chained token
+        (device-resident from the in-flight step, or the host override
+        already selected by the dispatcher) — it replaces column 0 for
+        EVERY slot: a chunk slot's dispatcher routes its first chunk
+        token through the same override mechanism decode uses, so the
+        program needs no is-chunk operand. Returns (next_token [B]
+        int32 — argmax at each slot's LAST span position, done [B]
+        bool, new_k, new_v): for a slot finishing its prompt this tick
+        that argmax IS its first generated token; mid-prompt slots'
+        outputs are ignored by the resolver."""
+        from ..jit.bridge import bound_state
+        from ..generation.kv_cache import PagedCacheEntry, PagedKVCache
+        meta = None
+        if meta_flat:
+            from ..kernels.paged_attention import RaggedMetaBuilder
+            meta = dict(zip(RaggedMetaBuilder.FIELDS, meta_flat))
+        qb = span_ids.shape[1]
+        ids = span_ids.at[:, 0].set(tok_in.astype(span_ids.dtype))
+        pos = ctx[:, None].astype(jnp.int32) \
+            + jnp.arange(qb, dtype=jnp.int32)[None, :]
+        entries = [PagedCacheEntry(kl[i], vl[i], Tensor(tables),
+                                   Tensor(ctx), meta, Tensor(q_lens))
+                   for i in range(len(kl))]
+        with no_grad(), bound_state(self._p_tensors, p_vals,
+                                    self._b_tensors, b_vals):
+            logits, caches = self.model(
+                Tensor(ids), position_ids=Tensor(pos),
+                past_key_values=PagedKVCache(entries), use_cache=True)
+        last = jnp.clip(q_lens.astype(jnp.int32) - 1, 0, qb - 1)
+        lg = jnp.take_along_axis(logits._value,
+                                 last[:, None, None], axis=1)[:, 0]
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
         if self.eos_token_id is not None:
             done = nxt == jnp.int32(self.eos_token_id)
         else:
@@ -1056,6 +1151,10 @@ class ContinuousBatchingPredictor:
         slot_req = [-1] * self.B
         slot_pages = [[] for _ in range(self.B)]
         slot_new = [[] for _ in range(self.B)]
+        # chunked prefill: un-ingested prompt tails + ingested counts
+        # (a non-empty tail turns the next dispatch into a MIXED step)
+        slot_pending = [[] for _ in range(self.B)]
+        slot_ingested = [0] * self.B
         tables = np.full((self.B, self.pages_per_seq), self._trash,
                          np.int32)
         ctx = np.ones((self.B,), np.int32)   # inactive slots: 1 dummy tok
@@ -1076,6 +1175,7 @@ class ContinuousBatchingPredictor:
             req_sp[r].end(status=status_val)
             self.pool.release(slot_pages[b])
             slot_req[b], slot_pages[b], slot_new[b] = -1, [], []
+            slot_pending[b], slot_ingested[b] = [], 0
             tables[b, :] = self._trash
             ctx[b] = 1
             if builder is not None:
@@ -1143,8 +1243,13 @@ class ContinuousBatchingPredictor:
             prompt = prompts[r]
             L = len(prompt)
             need = -(-(L + max_new[r]) // self.page)
+            # chunked prefill: prompts over the threshold ingest
+            # chunk-by-chunk through the mixed step; they bypass the
+            # prefix cache (no monolithic prefill computes the
+            # per-position continuation tokens the trie stores)
+            chunked = bool(self._chunk_max) and L > self._chunk_max
             full_pages, covered, partial, cached_next = [], 0, None, None
-            if self.prefix_cache is not None:
+            if self.prefix_cache is not None and not chunked:
                 full_pages, covered, partial, cached_next = \
                     self.prefix_cache.lookup(prompt)
                 if covered + (partial[1] if partial else 0) == L \
@@ -1171,7 +1276,8 @@ class ContinuousBatchingPredictor:
                 if fresh is None:
                     return None
                 return {"r": r, "prompt": prompt, "covered": 0,
-                        "pages": fresh, "reused": 0, "next": None}
+                        "pages": fresh, "reused": 0, "next": None,
+                        "chunked": False}
             if partial is not None:
                 # copy-on-write at the divergence page: the request
                 # appends into this page, the trie keeps reading the
@@ -1182,7 +1288,63 @@ class ContinuousBatchingPredictor:
             return {"r": r, "prompt": prompt, "covered": covered,
                     "pages": full_pages + fresh,
                     "reused": len(full_pages) + (1 if partial else 0),
-                    "next": cached_next if covered == L else None}
+                    "next": cached_next if covered == L else None,
+                    "chunked": chunked}
+
+        def note_cold_start():
+            # cold-start-to-first-token SLO (docs/DEPLOYMENT.md):
+            # construction → first token, once per predictor. A warm
+            # AOT engine turns this from minutes of compile into file
+            # loads — mode labels the two regimes. The builder's
+            # calibration predictor (recording engine) is not serving
+            # and records nothing.
+            if not self._cold_start_pending:
+                return
+            self._cold_start_pending = False
+            if not (self._engine is not None
+                    and getattr(self._engine, "recording", False)):
+                _obsm.gauge("serve.cold_start_seconds", unit="s").set(
+                    _time.perf_counter() - self._t_ctor,
+                    mode=("warm" if self._engine is not None
+                          and self._engine.warm else "cold"),
+                    **self._mlbl)
+
+        def place_chunked(b, plan):
+            """Install a chunk-prefill admission into slot b: pages
+            reserved, NO forward pass yet — the prompt ingests chunk-
+            by-chunk through the mixed step at subsequent decode ticks
+            (docs/SERVING.md "Chunked prefill"). TTFT is recorded when
+            the FINAL chunk's first generated token resolves, not
+            here."""
+            r = plan["r"]
+            pages = plan["pages"]
+            slot_req[b], slot_pages[b] = r, pages
+            slot_new[b] = []
+            tables[b, :] = self._trash
+            tables[b, :len(pages)] = pages
+            ctx[b] = 0
+            slot_pending[b] = list(plan["prompt"])
+            slot_ingested[b] = 0
+            override[b] = False
+            if builder is not None:
+                builder.set_slot(b, tables[b], 1)
+            status[r] = "running"
+            req_sp[r].event("admitted", slot=b, chunked=True)
+            self.stats["chunked_requests"] += 1
+            self._m_chunk_reqs.inc(**mlbl)
+            self._m_adm.inc(**mlbl)
+            if tier_of[r] is not None:
+                self._m_tier_adm.inc(tier=tier_of[r], **mlbl)
+
+        def chunk_first_token(b, r):
+            """The final chunk resolved: its last-position argmax is
+            the request's FIRST generated token — the TTFT sample and
+            first_token span event land here."""
+            req_sp[r].event("first_token")
+            note_cold_start()
+            tl = {"tier": tier_of[r]} if tier_of[r] is not None else {}
+            self._m_ttft.observe(_time.perf_counter() - arrival[r],
+                                 **tl, **mlbl)
 
         def place(b, plan, first):
             """Install an admitted request into slot b."""
@@ -1201,22 +1363,7 @@ class ContinuousBatchingPredictor:
             status[r] = "running"
             req_sp[r].event("admitted", slot=b)
             req_sp[r].event("first_token")
-            if self._cold_start_pending:
-                # cold-start-to-first-token SLO (docs/DEPLOYMENT.md):
-                # construction → first token, once per predictor. A
-                # warm AOT engine turns this from minutes of compile
-                # into file loads — mode labels the two regimes. The
-                # builder's calibration predictor (recording engine)
-                # is not serving and records nothing.
-                self._cold_start_pending = False
-                if not (self._engine is not None
-                        and getattr(self._engine, "recording", False)):
-                    _obsm.gauge("serve.cold_start_seconds",
-                                unit="s").set(
-                        _time.perf_counter() - self._t_ctor,
-                        mode=("warm" if self._engine is not None
-                              and self._engine.warm else "cold"),
-                        **self._mlbl)
+            note_cold_start()
             tl = {"tier": tier_of[r]} if tier_of[r] is not None else {}
             self._m_adm.inc(**mlbl)
             if tl:
@@ -1271,16 +1418,18 @@ class ContinuousBatchingPredictor:
                 return False
 
             t0 = _time.perf_counter()
-            hits = [p for p in plans if p["next"] is not None]
-            partials = [p for p in plans
+            chunked_plans = [p for p in plans if p.get("chunked")]
+            now_plans = [p for p in plans if not p.get("chunked")]
+            hits = [p for p in now_plans if p["next"] is not None]
+            partials = [p for p in now_plans
                         if p["next"] is None and p["covered"] > 0]
-            misses = [p for p in plans
+            misses = [p for p in now_plans
                       if p["next"] is None and p["covered"] == 0]
             pf_sp = _obstr.start_span(
                 "serve.prefill", parent=gen_sp, n=len(plans),
                 hits=len(hits), partial=len(partials),
-                misses=len(misses))
-            for plan in plans:
+                misses=len(misses), chunked=len(chunked_plans))
+            for plan in now_plans:
                 req_sp[plan["r"]].event(
                     "prefill", covered=plan["covered"],
                     reused=plan["reused"])
@@ -1310,13 +1459,16 @@ class ContinuousBatchingPredictor:
             for bucket, group in sorted(by_bucket.items()):
                 firsts.update(self._batch_prefill(bucket, group))
 
-            if plans:
+            if now_plans:
                 self._m_prefill.observe(_time.perf_counter() - t0,
                                         **mlbl)
             pf_sp.end()
             b_i = iter(free)
             for plan in plans:
-                place(next(b_i), plan, firsts[plan["r"]])
+                if plan.get("chunked"):
+                    place_chunked(next(b_i), plan)
+                else:
+                    place(next(b_i), plan, firsts[plan["r"]])
             return True
 
         def _active():
@@ -1372,7 +1524,15 @@ class ContinuousBatchingPredictor:
                     useful = any(
                         len(slot_new[b]) + (1 if b in pend else 0)
                         < max_new[slot_req[b]] for b in active)
-                    if useful:
+                    if any(slot_pending[b] for b in active):
+                        # a prompt is mid-ingest: this tick runs the
+                        # MIXED program — its chunk advances WHILE the
+                        # decode slots take their normal token step
+                        cur = self._dispatch_mixed_step(
+                            active, slot_req, slot_pending,
+                            slot_ingested, tables, ctx, last_tok_host,
+                            override, builder, inflight, req_sp)
+                    elif useful:
                         cur = self._dispatch_step(active, slot_req,
                                                   tables, ctx,
                                                   last_tok_host,
@@ -1383,7 +1543,8 @@ class ContinuousBatchingPredictor:
                     try:
                         self._resolve_step(prev, slot_req, slot_new,
                                            last_tok_host, max_new,
-                                           evict, req_sp, emit)
+                                           evict, req_sp, emit,
+                                           chunk_first_token)
                     except DecodeWedgedError:
                         # wedged decode: fail everything still pending
                         # instead of hanging. Pages of the wedged step
@@ -1607,14 +1768,106 @@ class ContinuousBatchingPredictor:
         self._m_steps.inc(**self._mlbl)
         return {"tok": nxt, "done": done, "snap": snap, "t": t0}
 
+    def _chunk_bucket(self, remaining, n_decode):
+        """Adaptive page-aligned chunk bucket for one mixed tick:
+        target ~chunk_max / (1 + in-flight decode load) so a long
+        prompt's ingest never holds the decode slots hostage for more
+        than a bounded slice, bucketed to {page * 2^k} for compile
+        reuse, shrunk to the smallest bucket covering what is left of
+        the prompt (late chunks re-use the small programs)."""
+        tgt = max(self.page, self._chunk_max // (1 + max(0, n_decode)))
+        b = self.page
+        while b * 2 <= tgt:
+            b *= 2
+        while b > self.page and b // 2 >= remaining:
+            b //= 2
+        return b
+
+    def _dispatch_mixed_step(self, active, slot_req, slot_pending,
+                             slot_ingested, tables, ctx, last_tok_host,
+                             override, builder, inflight, req_sp):
+        """Dispatch one MIXED prefill+decode step: every slot with a
+        pending prompt tail ingests its next chunk (page-aligned, up to
+        this tick's adaptive bucket) while the decode slots take their
+        normal single-token step — ONE compiled program, chained off
+        the in-flight step exactly like `_dispatch_step` (the chunk
+        tokens are host-known, so chunk ticks pipeline sync-free too).
+        """
+        import time as _time
+        t0 = _time.perf_counter()
+        mlbl = self._mlbl
+        chunk_slots = [b for b in active if slot_pending[b]]
+        n_dec = len(active) - len(chunk_slots)
+        qb = self._chunk_bucket(
+            max(len(slot_pending[b]) for b in chunk_slots), n_dec)
+        span_ids = np.full((self.B, qb), self.pad_token_id, np.int32)
+        q_lens = np.ones((self.B,), np.int32)
+        mid, final = set(), set()
+        for b in chunk_slots:
+            take = min(len(slot_pending[b]), qb)
+            chunk = slot_pending[b][:take]
+            span_ids[b, :take] = chunk
+            q_lens[b] = take
+            # the chunk's first token rides the same host-override
+            # path a newly admitted decode slot uses (column 0 of the
+            # program's ids comes from tok_in)
+            last_tok_host[b] = chunk[0]
+            override[b] = True
+            del slot_pending[b][:take]
+            slot_ingested[b] += take
+            (final if not slot_pending[b] else mid).add(b)
+            self.stats["prefill_chunks"] += 1
+            self._m_chunks.inc(**mlbl)
+            self._m_chunk_tok.inc(take, **mlbl)
+            req_sp[slot_req[b]].event("prefill_chunk", tokens=take,
+                                      covered=slot_ingested[b])
+        meta_args = ()
+        if builder is not None:
+            for b in active:
+                builder.advance_slot(b, int(ctx[b]) + int(q_lens[b]))
+            m = builder.meta()
+            from ..kernels.paged_attention import RaggedMetaBuilder
+            meta_args = tuple(m[k].copy()
+                              for k in RaggedMetaBuilder.FIELDS)
+        if inflight is None:
+            tok_in = jnp.asarray(last_tok_host.copy())
+        else:
+            tok_in = jnp.where(jnp.asarray(override.copy()),
+                               jnp.asarray(last_tok_host.copy()),
+                               inflight["tok"])
+        override[:] = False
+        # .copy() on every host operand: double buffering mutates them
+        # while this step is still in flight (see _dispatch_step)
+        nxt, done, new_k, new_v = self._jit_call(
+            ("mixed", qb, tables.shape,
+             tuple(np.shape(m) for m in meta_args)), self._mixed_jit,
+            self._p_vals, self._b_vals, self.pool.k, self.pool.v,
+            tables.copy(), ctx.copy(), span_ids, q_lens.copy(), tok_in,
+            *meta_args)
+        self.pool.k, self.pool.v = list(new_k), list(new_v)
+        snap = [(b, slot_req[b]) for b in active]
+        ctx[active] += q_lens[active]
+        self.stats["decode_steps"] += 1
+        self.stats["mixed_steps"] += 1
+        self._m_steps.inc(**mlbl)
+        return {"tok": nxt, "done": done, "snap": snap, "t": t0,
+                "chunk_mid": mid, "chunk_final": final}
+
     def _resolve_step(self, step, slot_req, slot_new, last_tok_host,
-                      max_new, evict, req_sp=None, emit=None):
+                      max_new, evict, req_sp=None, emit=None,
+                      first_cb=None):
         """Sync a PREVIOUSLY dispatched step (the next one is already in
         flight) and apply its tokens: append, detect completion, evict,
         and stream each applied token through `emit` (request-indexed
         per-request budgets come in as the `max_new` list). Slots that
         were recycled since the dispatch are skipped — their in-flight
         token belongs to the evicted request.
+
+        Mixed steps (`_dispatch_mixed_step`) carry chunk roles:
+        mid-prompt chunk slots produce no token this tick; a slot whose
+        FINAL chunk just resolved treats the step's argmax as its first
+        generated token (`first_cb(b, r)` records TTFT/first_token
+        before the append/eos/budget handling).
 
         With the watchdog armed (self._wd_cur), the sync polls the
         device buffers' is_ready() against a deadline instead of
@@ -1650,9 +1903,34 @@ class ContinuousBatchingPredictor:
         done = np.asarray(step["done"])  # graft-lint: ok[GL102] (ditto)
         self._m_tok.observe(_time.perf_counter() - step["t"],
                             **self._mlbl)
+        chunk_mid = step.get("chunk_mid") or ()
+        chunk_final = step.get("chunk_final") or ()
+        if "chunk_mid" in step:
+            self._m_mixed.observe(_time.perf_counter() - step["t"],
+                                  **self._mlbl)
         for b, r in step["snap"]:
             if slot_req[b] != r:
                 continue             # evicted (and maybe re-admitted)
+            if b in chunk_mid:
+                continue             # mid-prompt chunk: no token yet
+            if b in chunk_final:
+                # the prompt just finished ingesting: this step's
+                # argmax is the request's FIRST generated token
+                t = int(nxt[b])
+                if first_cb is not None:
+                    first_cb(b, r)
+                if bool(done[b]):    # first token is eos: stripped,
+                    evict(b)         # parity with place()
+                    continue
+                slot_new[b].append(t)
+                last_tok_host[b] = t
+                if req_sp is not None:
+                    req_sp[r].event("token", i=1)
+                if emit is not None:
+                    emit(r, "token", token=t, index=1)
+                if len(slot_new[b]) >= max_new[r]:
+                    evict(b)
+                continue
             if len(slot_new[b]) >= max_new[r]:
                 continue             # token from a post-budget junk step
             t = int(nxt[b])

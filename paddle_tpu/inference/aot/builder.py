@@ -17,6 +17,11 @@ What gets captured, per the bucket table:
 - **decode** — THE decode step (geometry-constant signature): paged
   cache write + paged attention + argmax + eos, one program for every
   step of every request.
+- **mixed** — with chunked prefill in the geometry
+  (``prefill_chunk_tokens``): the mixed prefill+decode step, one
+  program per chunk bucket ``{page_size * 2^k <= chunk_max}`` — long
+  prompts then ingest chunk-by-chunk at warm start with zero
+  compilation, exactly like decode.
 - **forward** — the plain captured model forward (logits) per bucket:
   the dy2static capture surface itself, used for captured-vs-eager
   parity checks and Predictor-style batch scoring. The model's
@@ -89,6 +94,13 @@ class EngineBuilder:
         g.setdefault("max_seq_len", 512)
         g.setdefault("pad_token_id", 0)
         g.setdefault("eos_token_id", None)
+        # pinned explicitly (0 = off): the predictor ctor otherwise
+        # falls back to FLAGS_serve_prefill_chunk_tokens, and a flag
+        # set on the BUILD host would silently chunk the calibration
+        # prompts while the manifest records no threshold — the
+        # serving replica would then miss the monolithic-prefill
+        # programs the bundle claims to carry
+        g.setdefault("prefill_chunk_tokens", 0)
         return g
 
     def build(self, path: str, wire_cache: bool = True,
@@ -124,6 +136,8 @@ class EngineBuilder:
                     cb.generate(prompts,
                                 max_new_tokens=self.max_new_tokens)
                     sp.event("bucket", prompt_bucket=pb, batch=n)
+            if geometry.get("prefill_chunk_tokens"):
+                self._capture_mixed(cb, rng, vocab, sp)
             if self.capture_forward:
                 self._capture_forward(engine, rng, vocab, sp)
             for name, fn, args in self._extra:
@@ -136,6 +150,75 @@ class EngineBuilder:
         return manifest
 
     # ---------------------------------------------------------- capture --
+    def _capture_mixed(self, cb, rng, vocab, sp):
+        """Chunked prefill is part of the geometry: pre-capture every
+        ("mixed", Qb, ...) signature the serve loop can dispatch, one
+        long synthetic prompt per chunk bucket Qb in
+        {page * 2^k <= chunk_max}. The scheduler picks the largest
+        bucket while a prompt's remainder exceeds it and the smallest
+        covering bucket for the final chunk, so a prompt of length
+        chunk_max + Qb/2 + 1 exercises exactly {chunk_max, Qb} (and
+        chunk_max + 1 exercises {chunk_max, page}) without steering
+        the adaptive policy. A bucket whose steering prompt cannot fit
+        max_seq_len is still REACHABLE at serve time (any prompt over
+        the threshold dispatches the chunk_max program; decode load
+        and final chunks shrink the tick bucket arbitrarily), so it is
+        compiled directly with dispatch-shaped operands instead of
+        skipped — warm start must stay zero-compile for every
+        dispatchable signature."""
+        cm = cb._chunk_max
+        qb, buckets = cb.page, []
+        while qb <= cm:
+            buckets.append(qb)
+            qb *= 2
+        driven = set()
+        for qb in buckets:
+            tail = 1 if qb in (cb.page, cm) else qb // 2 + 1
+            length = cm + tail
+            if length + self.max_new_tokens > cb.max_seq_len:
+                self._compile_mixed_bucket(cb, qb)
+                sp.event("mixed_bucket", q_bucket=qb, direct=True)
+            elif length not in driven:   # page and cm share a prompt
+                driven.add(length)
+                prompt = rng.randint(2, vocab, (length,)).tolist()
+                cb.generate([prompt],
+                            max_new_tokens=self.max_new_tokens)
+                sp.event("mixed_bucket", q_bucket=qb,
+                         prompt_len=length)
+
+    def _compile_mixed_bucket(self, cb, qb):
+        """Compile one ("mixed", qb, ...) signature with operands
+        shaped exactly like `_dispatch_mixed_step`'s (every slot idle
+        over the trash page, single-token spans) — the fallback when
+        the steering prompt for this bucket cannot fit max_seq_len.
+        Keep the signature tuple and operand dtypes in lockstep with
+        the dispatcher; the coldstart bench's zero-compile assertion
+        guards the pairing."""
+        import jax.numpy as jnp
+        cb._ensure_ready()
+        tables = np.full((cb.B, cb.pages_per_seq), cb._trash, np.int32)
+        ctx = np.ones((cb.B,), np.int32)
+        span_ids = np.full((cb.B, qb), cb.pad_token_id, np.int32)
+        q_lens = np.ones((cb.B,), np.int32)
+        tok_in = jnp.asarray(np.zeros((cb.B,), np.int32))
+        meta_args = ()
+        if cb.use_ragged:
+            from ...kernels.paged_attention import RaggedMetaBuilder
+            mb = RaggedMetaBuilder(cb.B, cb.pages_per_seq, cb.page,
+                                   cb._trash)
+            for b in range(cb.B):
+                mb.clear_slot(b)
+            m = mb.meta()
+            meta_args = tuple(m[k].copy()
+                              for k in RaggedMetaBuilder.FIELDS)
+        sig = ("mixed", qb, tables.shape,
+               tuple(np.shape(x) for x in meta_args))
+        _, _, new_k, new_v = cb._jit_call(
+            sig, cb._mixed_jit, cb._p_vals, cb._b_vals, cb.pool.k,
+            cb.pool.v, tables, ctx, span_ids, q_lens, tok_in,
+            *meta_args)
+        cb.pool.k, cb.pool.v = list(new_k), list(new_v)
+
     def _capture_forward(self, engine, rng, vocab, sp):
         """AOT-capture the model's plain forward (logits) per bucket
         through the jit/dy2static front door: ``functionalize`` swaps

@@ -58,6 +58,9 @@ MIN_COMPILE_TIME_FLOOR_S = 0.5
 COMPILED_GEOMETRY_KEYS = frozenset({
     "max_batch_size", "page_size", "max_seq_len", "num_pages",
     "pad_token_id", "eos_token_id", "kv_dtype", "use_ragged",
+    # chunked prefill: the mixed-step programs' span buckets derive
+    # from it, so a different threshold means different executables
+    "prefill_chunk_tokens",
 })
 
 

@@ -1,10 +1,14 @@
 """Shared helpers for the Pallas kernel modules."""
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 import jax
 
 from ..framework.flags import flag_value
+
+_logger = logging.getLogger("paddle_tpu.kernels")
 
 # Pallas index maps must return a uniform int type: with jax_enable_x64
 # on (Paddle int64 parity), a bare `0` literal traces as i64 next to the
@@ -41,6 +45,35 @@ def pallas_dtype_ok(*arrays) -> bool:
         if a.dtype in (jnp.float64,):
             return False
     return True
+
+
+# one log line per (kernel, reason) per process — production losing the
+# fast path must be visible without drowning the log at trace frequency
+_fallbacks_noted = set()
+
+
+def note_fallback(kernel: str, reason: str) -> None:
+    """Record a wanted-but-lost Pallas fast path: the caller asked for
+    the kernel (FLAGS_use_pallas_kernels on a non-CPU backend, or
+    interpret mode) but a gate (dtype, GQA ratio, tiling constraint)
+    forced the plain-XLA route. Counts
+    ``kernels.pallas_fallbacks{kernel,reason}`` and logs ONCE per
+    (kernel, reason) — a silent perf cliff becomes an observable one.
+    Called at trace time only (the gate is static), so it adds nothing
+    to the compiled program."""
+    from ..observability import metrics as _obsm
+    _obsm.counter("kernels.pallas_fallbacks").inc(kernel=kernel,
+                                                  reason=reason)
+    key = (kernel, reason)
+    if key not in _fallbacks_noted:
+        _fallbacks_noted.add(key)
+        _logger.warning(
+            "Pallas kernel %r fell back to XLA (%s); serving/training "
+            "runs without the fast path for this shape/dtype — and "
+            "keeps paying it on every execution of the compiled "
+            "program (kernels.pallas_fallbacks counts trace-time gate "
+            "decisions, one per compiled signature)",
+            kernel, reason)
 
 
 def mxu_precision(*operands):

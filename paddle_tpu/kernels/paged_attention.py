@@ -27,7 +27,34 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
-                      pallas_dtype_ok, pallas_interpret)
+                      pallas_dtype_ok, pallas_interpret, note_fallback)
+
+
+def _paged_gate(kernel, q, k_pages, v_pages, interpret):
+    """Shared Pallas-vs-XLA gate for the paged kernels: returns True
+    when the Pallas path runs; a wanted-but-lost fast path is recorded
+    via ``kernels.pallas_fallbacks{kernel,reason}`` (docs/
+    OBSERVABILITY.md) so production silently dropping to plain XLA is
+    observable."""
+    h = q.shape[-2]
+    hkv = k_pages.shape[2]
+    d = q.shape[-1]
+    wanted = interpret or _use_pallas()
+    if not wanted:
+        return False
+    if h != hkv:
+        note_fallback(kernel, "gqa_ratio")
+        return False
+    if d % 128 != 0:
+        note_fallback(kernel, "head_dim_tiling")
+        return False
+    if h % 8 != 0:
+        note_fallback(kernel, "head_count_tiling")
+        return False
+    if not interpret and not pallas_dtype_ok(q, k_pages, v_pages):
+        note_fallback(kernel, "dtype")
+        return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -156,15 +183,11 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     context_lens: [B] int32 valid token counts
     Returns [B, H, D].
     """
-    h = q.shape[1]
-    hkv = k_pages.shape[2]
     d = q.shape[-1]
     sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
     interpret = interpret or pallas_interpret()
-    use_kernel = ((interpret or (_use_pallas()
-                                 and pallas_dtype_ok(q, k_pages, v_pages)))
-                  and h == hkv and d % 128 == 0 and h % 8 == 0)
-    if use_kernel:
+    if _paged_gate("paged_attention", q, k_pages, v_pages,
+                   interpret):
         return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
                                        context_lens, sc, interpret=interpret)
     return _paged_attention_xla(q, k_pages, v_pages, block_tables,
@@ -404,3 +427,196 @@ def paged_attention_ragged(q, k_pages, v_pages, context_lens, meta,
     # sequences with no pages never write their output row
     has = jnp.asarray(context_lens, jnp.int32) > 0
     return jnp.where(has[:, None, None], out, 0)
+
+
+# ---------------------------------------------------------------------------
+# Variable-query-length ("varq") variant — the MIXED prefill+decode
+# kernel (cf. PAPERS.md "Ragged Paged Attention"): each batch slot
+# carries a query span of length q_lens[b] >= 1 — a prefill CHUNK or a
+# single decode token — attending causally over its paged KV pool
+# pages. One compiled step therefore serves a batch mixing mid-prefill
+# and mid-decode requests; chunked prefill (inference.
+# ContinuousBatchingPredictor) and speculative verify both ride it.
+#
+# Span geometry: query i of slot b sits at absolute position
+# kv_lens[b] - q_lens[b] + i (its K/V is already written at that
+# position — the caller scatters the span's K/V into the pages first,
+# see generation/kv_cache.paged_cache_mixed_update_attend). Queries
+# are padded to the compile-time span bucket Qb; padding rows (i >=
+# q_lens[b]) are zeroed in the output. For q_lens == 1 everywhere the
+# math degenerates to exactly the decode kernels above.
+# ---------------------------------------------------------------------------
+
+def _paged_attention_varq_xla(q, k_pages, v_pages, block_tables, kv_lens,
+                              q_lens, scale):
+    """XLA reference (any GQA ratio). q: [B, Qb, H, D]; pages
+    [P, page, Hkv, D]; block_tables [B, pages_per_seq]; kv_lens [B]
+    total keys per slot (span included); q_lens [B] span lengths.
+    Returns [B, Qb, H, D] with padding query rows zeroed."""
+    h = q.shape[2]
+    hkv = k_pages.shape[2]
+    qb = q.shape[1]
+
+    def one(qs, bt, kl, ql):
+        k = k_pages[bt].reshape(-1, hkv, k_pages.shape[-1])  # [L, Hkv, D]
+        v = v_pages[bt].reshape(-1, hkv, v_pages.shape[-1])
+        if hkv != h:
+            rep = h // hkv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        s = jnp.einsum("qhd,khd->qhk", qs, k,
+                       preferred_element_type=jnp.float32) * np.float32(scale)
+        tok = jnp.arange(k.shape[0], dtype=jnp.int32)
+        qpos = (kl - ql) + jnp.arange(qb, dtype=jnp.int32)
+        ok = (tok[None, :] <= qpos[:, None]) & (tok[None, :] < kl)
+        s = jnp.where(ok[:, None, :], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("qhk,khd->qhd", p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32).astype(qs.dtype)
+        qvalid = jnp.arange(qb, dtype=jnp.int32) < ql
+        return jnp.where(qvalid[:, None, None], out, 0)
+
+    return jax.vmap(one)(q, block_tables,
+                         jnp.asarray(kv_lens, jnp.int32),
+                         jnp.asarray(q_lens, jnp.int32))
+
+
+def paged_attention_varq(q, k_pages, v_pages, block_tables, kv_lens,
+                         q_lens, scale=None):
+    """Mixed-step attention via block tables (XLA path — the numeric
+    oracle and the route for geometries the Pallas kernel rejects).
+    See `paged_attention_ragged_varq` for the ragged-grid kernel."""
+    d = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    return _paged_attention_varq_xla(q, k_pages, v_pages, block_tables,
+                                     kv_lens, q_lens, sc)
+
+
+def _ragged_varq_kernel(seq_ref, page_ref, ord_ref, first_ref, last_ref,
+                        valid_ref, kvlen_ref, qlen_ref, q_ref, k_ref,
+                        v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
+                        page_size):
+    g = pl.program_id(0)
+
+    @pl.when(first_ref[g] == 1)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(valid_ref[g] == 1)
+    def _compute():
+        b = seq_ref[g]
+        kl = kvlen_ref[b]
+        ql = qlen_ref[b]
+        q = q_ref[0].astype(jnp.float32)   # (Qb, H, D)
+        k = k_ref[0].astype(jnp.float32)   # (page, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.sum(q[None, :, :, :] * k[:, None, :, :],
+                    axis=-1) * np.float32(scale)          # (page, Qb, H)
+        tok = ord_ref[g] * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        qpos = (kl - ql) + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # keys causal to each span query AND inside the written context;
+        # a span's ordinal-0 page always holds key 0, so every real
+        # query row sees >= 1 valid key on its first page (no exp(0)
+        # pollution of the online softmax)
+        s = jnp.where((tok <= qpos) & (tok < kl), s, _NEG_INF)
+        m_prev = m_scr[:, :, 0]                           # (Qb, H)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))
+        p = jnp.exp(s - m_new[None, :, :])                # (page, Qb, H)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :, 0] * alpha + jnp.sum(p, axis=0)
+        pv = jnp.sum(p[:, :, :, None] * v[:, None, :, :],
+                     axis=0)                              # (Qb, H, D)
+        acc_scr[:] = acc_scr[:] * alpha[:, :, None] + pv
+        m_scr[:] = jnp.broadcast_to(m_new[:, :, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, :, None], l_scr.shape)
+
+    @pl.when(last_ref[g] == 1)
+    def _finalize():
+        l = l_scr[:, :, 0]
+        safe_l = jnp.where(l == np.float32(0.0), np.float32(1.0), l)
+        o_ref[0] = (acc_scr[:] / safe_l[:, :, None]).astype(o_ref.dtype)
+
+
+def _paged_attention_ragged_varq_pallas(q, k_pages, v_pages, kv_lens,
+                                        q_lens, meta, scale,
+                                        interpret=False):
+    b, qb, h, d = q.shape
+    page = k_pages.shape[1]
+    G = int(meta["seq"].shape[0])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec((1, qb, h, d),
+                         lambda g, sq, pg, od, fr, ls, va, kn, qn:
+                         (sq[g], _Z, _Z, _Z)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda g, sq, pg, od, fr, ls, va, kn, qn:
+                         (pg[g], _Z, _Z, _Z)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda g, sq, pg, od, fr, ls, va, kn, qn:
+                         (pg[g], _Z, _Z, _Z)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, qb, h, d),
+            lambda g, sq, pg, od, fr, ls, va, kn, qn: (sq[g], _Z, _Z, _Z)),
+        scratch_shapes=[
+            pltpu.VMEM((qb, h, 128), jnp.float32),
+            pltpu.VMEM((qb, h, 128), jnp.float32),
+            pltpu.VMEM((qb, h, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_ragged_varq_kernel, scale=scale,
+                               page_size=page)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, qb, h, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(meta["seq"], jnp.int32),
+      jnp.asarray(meta["page"], jnp.int32),
+      jnp.asarray(meta["ordinal"], jnp.int32),
+      jnp.asarray(meta["first"], jnp.int32),
+      jnp.asarray(meta["last"], jnp.int32),
+      jnp.asarray(meta["valid"], jnp.int32),
+      jnp.asarray(kv_lens, jnp.int32),
+      jnp.asarray(q_lens, jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention_ragged_varq(q, k_pages, v_pages, kv_lens, q_lens,
+                                meta, scale=None, interpret=False,
+                                block_tables=None):
+    """Ragged-grid mixed prefill+decode attention. q: [B, Qb, H, D];
+    `meta` is the same 6-array ragged metadata the decode kernel uses
+    (build_ragged_meta / RaggedMetaBuilder) built for the POST-write
+    kv_lens; kv_lens [B] = q_start + q_lens. Padding query rows and
+    kv_lens == 0 slots produce zeros.
+
+    Runs the Pallas kernel under the shared `_paged_gate` (H == Hkv,
+    D % 128 == 0, H % 8 == 0, Mosaic dtype); a lost fast path falls
+    back to the XLA reference — which needs `block_tables` — and is
+    counted in ``kernels.pallas_fallbacks``."""
+    d = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / pymath.sqrt(d)
+    interpret = interpret or pallas_interpret()
+    if _paged_gate("paged_attention_ragged_varq", q, k_pages,
+                   v_pages, interpret):
+        out = _paged_attention_ragged_varq_pallas(
+            q, k_pages, v_pages, kv_lens, q_lens, meta, sc,
+            interpret=interpret)
+        qb = q.shape[1]
+        qvalid = jnp.arange(qb, dtype=jnp.int32)[None, :] \
+            < jnp.asarray(q_lens, jnp.int32)[:, None]
+        has = jnp.asarray(kv_lens, jnp.int32) > 0
+        return jnp.where((qvalid & has[:, None])[:, :, None, None], out, 0)
+    if block_tables is None:
+        raise ValueError(
+            "paged_attention_ragged_varq needs block_tables for the XLA "
+            "fallback path (Pallas gate rejected this geometry)")
+    return _paged_attention_varq_xla(q, k_pages, v_pages, block_tables,
+                                     kv_lens, q_lens, sc)

@@ -28,6 +28,13 @@ HOT_PATH_FUNCTIONS = (
      "ContinuousBatchingPredictor._suffix_prefill"),
     ("paddle_tpu/inference/__init__.py",
      "ContinuousBatchingPredictor._jit_call"),
+    # mixed prefill+decode step: chunk scheduling + dispatch run once
+    # per tick while a long prompt ingests — a stray host sync there
+    # stalls the interleaved decode slots too
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._dispatch_mixed_step"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._chunk_bucket"),
     # serving front end: router / scheduler / streaming are host-side
     # by design — ANY device sync there stalls every tenant
     ("paddle_tpu/serving/*.py", "*"),
@@ -99,4 +106,4 @@ FLAG_DOC_ROOTS = ("docs", "README.md")
 # examples (myapp.*) and module paths in backticks stay out of scope.
 CATALOG_PREFIXES = ("train", "serve", "serving", "comm", "mem", "pp",
                     "robustness", "aot", "ckpt", "dist", "launch",
-                    "bench", "router")
+                    "bench", "router", "kernels")

@@ -238,6 +238,13 @@ class Request:
         fin = _event(span, "finish")
         self.tokens = fin.get("tokens") if fin else (
             len(toks) if toks else None)
+        # chunked prefill (docs/SERVING.md): one prefill_chunk event
+        # per ingested chunk; ingest = first chunk -> first token (the
+        # TTFT decomposition for a chunked request)
+        self.chunks = [e for e in span.get("events") or []
+                       if e.get("name") == "prefill_chunk"]
+        self.ingest = (ft["ts"] - self.chunks[0]["ts"]) \
+            if ft and self.chunks else None
 
     @property
     def per_token(self) -> List[float]:
@@ -276,12 +283,21 @@ def render(spans: List[dict], top_requests: int = 5,
             return f"no serve.request span with request_id={request_id!r}"
         for r in match:
             w(f"== request {r.id} ({r.status}, prompt_len="
-              f"{r.prompt_len}, e2e {r.e2e * 1e3:.2f}ms) ==")
+              f"{r.prompt_len}, e2e {r.e2e * 1e3:.2f}ms"
+              + (f", {len(r.chunks)} prefill chunks" if r.chunks
+                 else "") + ") ==")
+            chunk_i = 0
             for e in r.span.get("events") or []:
                 rel = (e["ts"] - r.start) * 1e3
+                name = e["name"]
+                if name == "prefill_chunk":
+                    # number the chunk spans so the TTFT decomposition
+                    # of a chunked request reads chunk-by-chunk
+                    name = f"prefill_chunk[{chunk_i}]"
+                    chunk_i += 1
                 attrs = ", ".join(f"{k}={v}" for k, v in e.items()
                                   if k not in ("ts", "name"))
-                w(f"  +{rel:9.3f}ms  {e['name']}"
+                w(f"  +{rel:9.3f}ms  {name}"
                   + (f"  ({attrs})" if attrs else ""))
         return "\n".join(out)
 
@@ -294,6 +310,9 @@ def render(spans: List[dict], top_requests: int = 5,
         w("== SLO percentiles ==")
         if ttft:
             w(_pct_row("TTFT", ttft))
+        ingest = [r.ingest for r in reqs if r.ingest is not None]
+        if ingest:
+            w(_pct_row("chunk ingest", ingest))
         if per_tok:
             w(_pct_row("per-token", per_tok))
         if e2e:
@@ -338,11 +357,12 @@ def render(spans: List[dict], top_requests: int = 5,
         w("  outcomes        " + "  ".join(
             f"{k}={v}" for k, v in sorted(outcomes.items())))
         w(f"  {'request':<10}{'status':<12}{'prompt':>7}{'tokens':>7}"
-          f"{'wait ms':>9}{'ttft ms':>9}{'e2e ms':>10}")
+          f"{'chunks':>7}{'wait ms':>9}{'ttft ms':>9}{'e2e ms':>10}")
         for r in sorted(reqs, key=lambda r: -r.e2e)[:top_requests]:
             w(f"  {r.id:<10}{r.status:<12}"
               f"{r.prompt_len if r.prompt_len is not None else '?':>7}"
               f"{r.tokens if r.tokens is not None else '?':>7}"
+              f"{len(r.chunks) if r.chunks else '-':>7}"
               f"{r.queue_wait * 1e3 if r.queue_wait is not None else 0:>9.2f}"
               f"{r.ttft * 1e3 if r.ttft is not None else 0:>9.2f}"
               f"{r.e2e * 1e3:>10.2f}")

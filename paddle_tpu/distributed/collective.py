@@ -443,11 +443,11 @@ class GradBucketer:
 
     def __init__(self, shapes, dtypes, bucket_bytes=None, pad_multiple=1):
         if bucket_bytes is None:
-            from ..framework.flags import flag_value
-            try:
-                bucket_bytes = int(flag_value("grad_bucket_bytes"))
-            except KeyError:
-                bucket_bytes = 32 << 20
+            # default flows through RuntimeConfig (its FLAGS-sourced
+            # snapshot reads grad_bucket_bytes — the one sanctioned
+            # reader of that flag, graft-lint GL106)
+            from ..framework.runtime_config import RuntimeConfig
+            bucket_bytes = RuntimeConfig.from_flags().grad_bucket_bytes
         self.bucket_bytes = int(bucket_bytes)
         self.pad_multiple = int(pad_multiple)
         self.n_arrays = len(shapes)

@@ -81,7 +81,14 @@ class DistTrainStep:
                  n_model_inputs: int = 1, sharding_stage: Optional[int] = None,
                  mesh: Optional[Mesh] = None, batch_specs=None,
                  donate_state: bool = True, scaler=None,
-                 weight_update_sharding: Optional[bool] = None):
+                 weight_update_sharding: Optional[bool] = None,
+                 runtime_config=None):
+        from ...framework.runtime_config import RuntimeConfig
+        # gradient-comm knobs (bucket bytes, int8 comm) come from the
+        # typed RuntimeConfig; absent one, the FLAGS-sourced default
+        # preserves the flag-driven behavior (framework/runtime_config)
+        self._rc = runtime_config if runtime_config is not None \
+            else RuntimeConfig.from_flags()
         self._model = model
         self._opt = optimizer
         self._loss_fn = loss_fn
@@ -261,14 +268,12 @@ class DistTrainStep:
         bucketer = bucketer_for(
             [tuple(p._value.shape) for p in params],
             [np.dtype(p._value.dtype) for p in params],
+            bucket_bytes=int(self._rc.grad_bucket_bytes),
             pad_multiple=dsize if self._wus else 1)
-        try:
-            # int8 grad comm only makes sense where the comm pattern is
-            # restructured (wus); applying it to a plain fused stage-0
-            # update would add quantization noise for zero benefit
-            quant = bool(flag_value("quantized_grad_comm")) and self._wus
-        except KeyError:
-            quant = False
+        # int8 grad comm only makes sense where the comm pattern is
+        # restructured (wus); applying it to a plain fused stage-0
+        # update would add quantization noise for zero benefit
+        quant = bool(self._rc.quantized_grad_comm) and self._wus
         meta = []
         for b in bucketer.buckets:
             mp = self._opt._mp_active(params[b.idx[0]]._value)

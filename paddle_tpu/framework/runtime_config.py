@@ -1,0 +1,198 @@
+"""RuntimeConfig — the typed, versioned performance-knob surface.
+
+Before this module every tunable lived somewhere different: chunked
+prefill in ``FLAGS_serve_prefill_chunk_tokens``, the decode watchdog in
+``FLAGS_serve_decode_watchdog_s``, gradient bucketing in
+``FLAGS_grad_bucket_bytes`` / ``FLAGS_quantized_grad_comm``, pool and
+queue sizing in ``ContinuousBatchingPredictor`` ctor args, the WFS
+quantum hardcoded in ``serving/scheduler.py``. Nothing could version,
+hash, diff, or ship that state as one artifact — which is exactly what
+telemetry-driven auto-tuning (``tools/autotune.py``) and per-bundle
+deployment (``inference/aot``) need.
+
+One object now owns them:
+
+- ``RuntimeConfig`` is a frozen dataclass with a schema ``version``;
+  ``to_dict``/``from_dict`` round-trip it as plain JSON and
+  ``config_hash()`` is a stable SHA-256 over the canonical form — the
+  hash joins the AOT bundle fingerprint so a tuning proposal ships as a
+  versioned deploy artifact (docs/DEPLOYMENT.md).
+- ``from_flags()`` is the LEGACY bridge: a config whose migrated knobs
+  come from the FLAGS registry, so every existing call site keeps its
+  exact behavior when no config is passed. This module is the ONLY
+  place allowed to read those flags directly — graft-lint GL106
+  enforces it (docs/STATIC_ANALYSIS.md).
+- ``diff(other)`` names the fields two configs disagree on; the AOT
+  warm-start path uses it to emit ``aot.config_drift`` telemetry when
+  the bundle's baked config and the ambient (FLAGS/env) config diverge.
+
+Consumers: ``ContinuousBatchingPredictor`` (geometry, buckets, chunked
+prefill, queue/shed, watchdog, WFS quantum), ``DistTrainStep`` /
+``collective.GradBucketer`` (gradient comm), ``inference.aot``
+(manifest + invalidation). Per-tenant and per-role (disaggregated
+prefill/decode) configs layer on top of this object.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = ["RuntimeConfig", "CONFIG_VERSION", "config_hash",
+           "MIGRATED_FLAG_KNOBS", "COMPILED_FIELDS"]
+
+CONFIG_VERSION = 1
+
+# Fields that shape what an AOT bundle actually compiles/calibrates
+# (program shapes, paged-pool layout, the admission bucket table, the
+# chunk buckets). Only a disagreement HERE invalidates a bundle at
+# warm start; the remaining fields are runtime-only knobs that may
+# differ per replica/deployment without destroying the shared bundle
+# (docs/DEPLOYMENT.md "Runtime config").
+COMPILED_FIELDS = frozenset({
+    "max_batch_size", "page_size", "num_pages", "max_seq_len",
+    "prompt_buckets", "prefill_chunk_tokens",
+})
+
+# FLAGS_* knobs that migrated INTO RuntimeConfig: reading any of these
+# via flag_value()/get_flags outside this module is a graft-lint GL106
+# finding — the knob must flow through a RuntimeConfig instead, or the
+# bundle-baked config and the running config silently diverge.
+MIGRATED_FLAG_KNOBS = {
+    "serve_prefill_chunk_tokens": "prefill_chunk_tokens",
+    "serve_decode_watchdog_s": "decode_watchdog_s",
+    "grad_bucket_bytes": "grad_bucket_bytes",
+    "quantized_grad_comm": "quantized_grad_comm",
+}
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Every field is a plain JSON-able scalar/tuple so the config can
+    live in a bundle manifest byte-for-byte. Field defaults equal the
+    historical ctor/flag defaults — ``RuntimeConfig()`` reproduces the
+    pre-migration behavior exactly (``from_flags()`` additionally folds
+    in FLAGS overrides)."""
+
+    version: int = CONFIG_VERSION
+
+    # -- serving geometry (compiled into AOT executables) ---------------
+    max_batch_size: int = 4
+    page_size: int = 16
+    num_pages: Optional[int] = None        # None: B * pages_per_seq
+    max_seq_len: int = 512
+    # admission prompt-length buckets; () = power-of-two auto bucketing
+    # (the historical LLMPredictor._bucket behavior)
+    prompt_buckets: Tuple[int, ...] = ()
+    prefill_chunk_tokens: int = 0          # 0 = monolithic prefill
+
+    # -- serving robustness / fairness (runtime-only) --------------------
+    max_queue: Optional[int] = None        # None = unbounded backlog
+    shed_policy: str = "newest"
+    decode_watchdog_s: float = 0.0         # 0 = disabled
+    wfs_quantum: float = 64.0              # WeightedFairScheduler grant
+
+    # -- training comm ---------------------------------------------------
+    grad_bucket_bytes: int = 32 * 1024 * 1024
+    quantized_grad_comm: bool = False
+
+    def __post_init__(self):
+        if self.version != CONFIG_VERSION:
+            raise ValueError(
+                f"RuntimeConfig schema version {self.version} is not "
+                f"supported (this build speaks version {CONFIG_VERSION})")
+        if self.shed_policy not in ("newest", "oldest"):
+            raise ValueError(
+                f"shed_policy must be 'newest' or 'oldest', got "
+                f"{self.shed_policy!r}")
+        if self.page_size <= 0 or self.max_batch_size <= 0 \
+                or self.max_seq_len <= 0:
+            raise ValueError("geometry fields must be positive")
+        # normalize buckets: sorted unique ints (hash stability)
+        object.__setattr__(
+            self, "prompt_buckets",
+            tuple(sorted({int(b) for b in self.prompt_buckets})))
+
+    # ------------------------------------------------------------ flags --
+    @classmethod
+    def from_flags(cls) -> "RuntimeConfig":
+        """The FLAGS-sourced default config — the legacy bridge every
+        consumer falls back to when no explicit config is passed, so
+        flag-driven deployments keep working unchanged. The only
+        sanctioned direct read of the migrated knobs (GL106)."""
+        from .flags import flag_value
+
+        def _fv(name, default):
+            try:
+                return flag_value(name)
+            except KeyError:
+                return default
+
+        return cls(
+            prefill_chunk_tokens=int(
+                _fv("serve_prefill_chunk_tokens", 0)),
+            decode_watchdog_s=float(_fv("serve_decode_watchdog_s", 0.0)),
+            grad_bucket_bytes=int(_fv("grad_bucket_bytes", 32 << 20)),
+            quantized_grad_comm=bool(_fv("quantized_grad_comm", False)),
+        )
+
+    # -------------------------------------------------------- serialize --
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["prompt_buckets"] = list(self.prompt_buckets)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "RuntimeConfig":
+        """Inverse of ``to_dict``. Unknown keys are rejected — a manifest
+        written by a NEWER schema must not silently load with half its
+        knobs dropped (the version gate catches the honest case; this
+        catches a hand-edited manifest)."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown RuntimeConfig field(s): {sorted(unknown)}")
+        kw = dict(d)
+        if "prompt_buckets" in kw and kw["prompt_buckets"] is not None:
+            kw["prompt_buckets"] = tuple(kw["prompt_buckets"])
+        return cls(**kw)
+
+    def replace(self, **kw) -> "RuntimeConfig":
+        return dataclasses.replace(self, **kw)
+
+    # ------------------------------------------------------------- hash --
+    def config_hash(self) -> str:
+        return config_hash(self.to_dict())
+
+    def diff(self, other: "RuntimeConfig") -> Dict[str, tuple]:
+        """{field: (self_value, other_value)} for every disagreement —
+        the drift surface ``aot.config_drift`` telemetry reports."""
+        a, b = self.to_dict(), other.to_dict()
+        return {k: (a[k], b[k]) for k in a if a[k] != b[k]}
+
+    # ---------------------------------------------------------- buckets --
+    def prompt_bucket(self, n: int) -> int:
+        """Admission bucket for a prompt of length ``n``: the smallest
+        configured bucket covering it, else the historical power-of-two
+        fallback (also used past the end of a configured table, so a
+        table tuned on observed traffic never rejects an outlier)."""
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+
+def config_hash(d: Dict) -> str:
+    """SHA-256 of the canonical JSON form. Stable across processes and
+    import orders; ``tools/autotune.py`` and ``tools/aot_report.py``
+    reimplement this byte-for-byte (they must run without importing
+    paddle_tpu/jax — parity is pinned by tests/test_autotune.py)."""
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, separators=(",", ":"),
+                   default=str).encode()).hexdigest()

@@ -335,6 +335,13 @@ class PrefixCache:
                 freed += 1
                 if freed >= need:
                     break
+        if freed:
+            # page-eviction telemetry: cached-but-idle pages dropped
+            # under allocation pressure. A sustained rate means the
+            # pool is undersized for the working set — the signal
+            # tools/autotune.py turns into a num_pages proposal.
+            from ..observability import metrics as _obsm
+            _obsm.counter("serving.page_evictions").inc(freed)
         return freed
 
     def clear(self, pool):

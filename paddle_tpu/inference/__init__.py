@@ -21,7 +21,6 @@ import jax.numpy as jnp
 from ..tensor import Tensor
 from .._grad_mode import no_grad
 from ..framework import faults as _faults
-from ..framework.flags import flag_value as _fv
 from ..observability import metrics as _obsm
 from ..observability import tracing as _obstr
 
@@ -463,15 +462,40 @@ class ContinuousBatchingPredictor:
     Greedy decoding (argmax), matching model.generate's default.
     """
 
-    def __init__(self, model, max_batch_size=4, page_size=16,
-                 num_pages=None, max_seq_len=512, pad_token_id=0,
+    def __init__(self, model, max_batch_size=None, page_size=None,
+                 num_pages=None, max_seq_len=None, pad_token_id=0,
                  eos_token_id=None, kv_dtype=None, use_ragged="auto",
                  enable_prefix_cache=True, max_queue=None,
-                 shed_policy="newest", decode_watchdog_s=None,
-                 name=None, engine=None, prefill_chunk_tokens=None):
+                 shed_policy=None, decode_watchdog_s=None,
+                 name=None, engine=None, prefill_chunk_tokens=None,
+                 runtime_config=None):
         import math as _m
         import time as _time
+        from ..framework.runtime_config import RuntimeConfig
         model.eval()
+        # RuntimeConfig (framework/runtime_config.py): the typed knob
+        # bag. Explicit ctor args override it; unset args fall back to
+        # the config; a missing config falls back to the FLAGS-sourced
+        # default (the pre-migration behavior, bit for bit). The
+        # config rides into AOT bundle manifests so an autotune
+        # proposal ships as a versioned artifact (docs/DEPLOYMENT.md).
+        self._rc = runtime_config
+        rc = runtime_config if runtime_config is not None \
+            else RuntimeConfig.from_flags()
+        if max_batch_size is None:
+            max_batch_size = rc.max_batch_size
+        if page_size is None:
+            page_size = rc.page_size
+        if num_pages is None:
+            num_pages = rc.num_pages      # may stay None: derived below
+        if max_seq_len is None:
+            max_seq_len = rc.max_seq_len
+        if max_queue is None:
+            max_queue = rc.max_queue
+        if shed_policy is None:
+            shed_policy = rc.shed_policy
+        # tuned admission bucket table; () = power-of-two auto
+        self._rc_buckets = tuple(rc.prompt_buckets)
         # AOT warm start (inference.aot): when an engine is attached,
         # _jit_call consults its serialized-executable table first — a
         # bucket hit dispatches with ZERO trace/compile; a miss falls
@@ -598,9 +622,10 @@ class ContinuousBatchingPredictor:
         # buckets (compile signatures) form the fixed set
         # {page * 2^k <= chunk_max} that the AOT builder pre-captures,
         # and a tick never exceeds what the operator asked for.
-        # 0/None disables (defers to FLAGS_serve_prefill_chunk_tokens).
+        # 0/None disables (None defers to the RuntimeConfig, whose
+        # FLAGS-sourced default reads serve_prefill_chunk_tokens).
         if prefill_chunk_tokens is None:
-            prefill_chunk_tokens = int(_fv("serve_prefill_chunk_tokens"))
+            prefill_chunk_tokens = int(rc.prefill_chunk_tokens)
         chunk = int(prefill_chunk_tokens or 0)
         if chunk > 0:
             b = self.page
@@ -620,6 +645,27 @@ class ContinuousBatchingPredictor:
         self.stats["mixed_steps"] = 0
         self._ready = False
         self._req_seq = 0   # process-unique request ids across calls
+
+    @property
+    def runtime_config(self):
+        """The effective RuntimeConfig: the explicit ctor config, else
+        a fresh FLAGS-sourced snapshot (fresh per read so runtime-only
+        knobs like the watchdog keep their historical read-at-serve-
+        time flag semantics)."""
+        if self._rc is not None:
+            return self._rc
+        from ..framework.runtime_config import RuntimeConfig
+        return RuntimeConfig.from_flags()
+
+    def _bucket_len(self, n):
+        """Admission prompt bucket: smallest tuned-table entry covering
+        n (RuntimeConfig.prompt_buckets), else the historical
+        power-of-two bucketing — a table tuned on observed traffic
+        never rejects an outlier, it just compiles one more program."""
+        for b in self._rc_buckets:
+            if b >= n:
+                return b
+        return LLMPredictor._bucket(n)
 
     # ------------------------------------------------------- jitted core --
     def _ensure_ready(self):
@@ -1018,8 +1064,18 @@ class ContinuousBatchingPredictor:
         from ..kernels.paged_attention import RaggedMetaBuilder
 
         self._ensure_ready()
-        wd = self._watchdog_s if self._watchdog_s is not None \
-            else float(_fv("serve_decode_watchdog_s"))
+        rc = self.runtime_config
+        wd = self._watchdog_s
+        if wd is None:
+            wd = float(rc.decode_watchdog_s)
+            if not wd and self._rc is not None:
+                # an explicit (e.g. bundle-baked) config that never
+                # armed the watchdog must not disable the host's
+                # FLAGS_serve_decode_watchdog_s safety net: 0 in a
+                # config means "unset", not "off" (pass the ctor arg
+                # decode_watchdog_s=0 to force it off)
+                from ..framework.runtime_config import RuntimeConfig
+                wd = float(RuntimeConfig.from_flags().decode_watchdog_s)
         self._wd_cur = wd if wd and wd > 0 else None
         self.last_status = status
         mlbl = self._mlbl
@@ -1029,8 +1085,9 @@ class ContinuousBatchingPredictor:
         _obsm.gauge("serving.slots").set(self.B, **mlbl)
         use_tiers = tier_weights is not None or any(
             r.tier is not None for r in initial)
-        q = WeightedFairScheduler(tier_weights) if use_tiers \
-            else FifoQueue()
+        q = WeightedFairScheduler(tier_weights,
+                                  quantum=float(rc.wfs_quantum)) \
+            if use_tiers else FifoQueue()
 
         # per-request parallel state (grows under dynamic intake)
         prompts, max_new, tier_of, metas = [], [], [], []
@@ -1452,7 +1509,7 @@ class ContinuousBatchingPredictor:
             by_bucket = {}
             for plan in misses:
                 by_bucket.setdefault(
-                    LLMPredictor._bucket(len(plan["prompt"])),
+                    self._bucket_len(len(plan["prompt"])),
                     []).append(plan)
                 self.stats["prefix_misses"] += 1
                 self._m_pfx_miss.inc(**mlbl)
@@ -1699,7 +1756,7 @@ class ContinuousBatchingPredictor:
         L = len(prompt)
         suffix = prompt[covered:]
         sl = len(suffix)
-        sb = LLMPredictor._bucket(sl)
+        sb = self._bucket_len(sl)
         wp = -(-covered // self.page)
         wpb = 1
         while wpb < wp:

@@ -61,16 +61,29 @@ class EngineBuilder:
     batch sizes to pre-compile per bucket (each ≤ ``max_batch_size``).
     """
 
-    def __init__(self, model, prompt_buckets: Sequence[int] = (8, 16),
+    def __init__(self, model, prompt_buckets: Optional[Sequence[int]] = None,
                  batch_sizes: Optional[Sequence[int]] = None,
                  max_new_tokens: int = 2, capture_forward: bool = True,
-                 **cb_kwargs):
+                 runtime_config=None, **cb_kwargs):
+        from ...framework.runtime_config import RuntimeConfig
         self.model = model
+        # runtime_config is the tuned-knob payload (tools/autotune.py
+        # output): it supplies geometry + bucket-table defaults here
+        # and is recorded — with its hash — in the bundle manifest so
+        # the tuning proposal ships as part of the versioned artifact.
+        # The default is the PURE-DEFAULT config, NOT from_flags():
+        # the builder has always pinned chunked prefill explicitly (a
+        # build-host flag must not silently reshape calibration).
+        self._rc = runtime_config if runtime_config is not None \
+            else RuntimeConfig()
+        if prompt_buckets is None:
+            prompt_buckets = self._rc.prompt_buckets or (8, 16)
         self.prompt_buckets = sorted(set(int(b) for b in prompt_buckets))
         self.cb_kwargs = dict(cb_kwargs)
         self.max_new_tokens = int(max_new_tokens)
         self.capture_forward = bool(capture_forward)
-        bmax = int(self.cb_kwargs.get("max_batch_size", 4))
+        bmax = int(self.cb_kwargs.get("max_batch_size",
+                                      self._rc.max_batch_size))
         if batch_sizes is None:
             batch_sizes, n = [], 1
             while n <= bmax:
@@ -89,39 +102,67 @@ class EngineBuilder:
     # ------------------------------------------------------------ build --
     def _geometry(self) -> Dict:
         g = dict(self.cb_kwargs)
-        g.setdefault("max_batch_size", 4)
-        g.setdefault("page_size", 16)
-        g.setdefault("max_seq_len", 512)
+        rc = self._rc
+        g.setdefault("max_batch_size", rc.max_batch_size)
+        g.setdefault("page_size", rc.page_size)
+        g.setdefault("max_seq_len", rc.max_seq_len)
         g.setdefault("pad_token_id", 0)
         g.setdefault("eos_token_id", None)
-        # pinned explicitly (0 = off): the predictor ctor otherwise
-        # falls back to FLAGS_serve_prefill_chunk_tokens, and a flag
-        # set on the BUILD host would silently chunk the calibration
-        # prompts while the manifest records no threshold — the
-        # serving replica would then miss the monolithic-prefill
-        # programs the bundle claims to carry
-        g.setdefault("prefill_chunk_tokens", 0)
+        if rc.num_pages is not None:
+            g.setdefault("num_pages", rc.num_pages)
+        # pinned explicitly (0 = off unless the RuntimeConfig says
+        # otherwise): the predictor ctor otherwise falls back to
+        # FLAGS_serve_prefill_chunk_tokens, and a flag set on the
+        # BUILD host would silently chunk the calibration prompts
+        # while the manifest records no threshold — the serving
+        # replica would then miss the monolithic-prefill programs the
+        # bundle claims to carry. (The default self._rc is the
+        # pure-default config, so this stays 0 without an explicit
+        # runtime_config.)
+        g.setdefault("prefill_chunk_tokens", rc.prefill_chunk_tokens)
         return g
+
+    def effective_runtime_config(self):
+        """The config the bundle actually encodes: the input
+        RuntimeConfig with the builder's resolved geometry and bucket
+        table folded in — what gets hashed into the manifest and what
+        a warm-started predictor reconstructs."""
+        g = self._geometry()
+        return self._rc.replace(
+            max_batch_size=int(g["max_batch_size"]),
+            page_size=int(g["page_size"]),
+            max_seq_len=int(g["max_seq_len"]),
+            num_pages=g.get("num_pages"),
+            prefill_chunk_tokens=int(g["prefill_chunk_tokens"]),
+            prompt_buckets=tuple(self.prompt_buckets))
 
     def build(self, path: str, wire_cache: bool = True,
               seed: int = 0) -> Dict:
         """Capture, compile, serialize; returns the bundle manifest."""
         from .. import ContinuousBatchingPredictor
         geometry = self._geometry()
+        eff_rc = self.effective_runtime_config()
         buckets = {"prompt_buckets": self.prompt_buckets,
                    "batch_sizes": self.batch_sizes,
                    "max_new_tokens": self.max_new_tokens}
         t0 = time.perf_counter()
         with _obstr.span("aot.build", parent=None, path=path,
                          prompt_buckets=str(self.prompt_buckets),
-                         batch_sizes=str(self.batch_sizes)) as sp:
+                         batch_sizes=str(self.batch_sizes),
+                         config_hash=eff_rc.config_hash()[:12]) as sp:
             bundle = EngineBundle.create(
-                path, model_fingerprint(self.model), geometry, buckets)
+                path, model_fingerprint(self.model), geometry, buckets,
+                runtime_config=eff_rc.to_dict())
             if wire_cache:
                 wire_xla_cache(bundle.xla_cache_dir)
             engine = InferenceEngine(bundle, write_back=True,
                                      recording=True)
+            # the calibration predictor runs the SAME config the
+            # manifest records (bucket table included), so every
+            # signature it dispatches is a signature a warm-started
+            # replica of this bundle will dispatch
             cb = ContinuousBatchingPredictor(self.model, engine=engine,
+                                             runtime_config=eff_rc,
                                              **geometry)
             rng = np.random.RandomState(seed)
             vocab = int(getattr(getattr(self.model, "config", None),
@@ -252,11 +293,13 @@ class EngineBuilder:
         sp.event("custom", name=name)
 
 
-def build_engine(model, path: str, prompt_buckets=(8, 16),
+def build_engine(model, path: str, prompt_buckets=None,
                  batch_sizes=None, max_new_tokens: int = 2,
-                 wire_cache: bool = True, **cb_kwargs) -> Dict:
+                 wire_cache: bool = True, runtime_config=None,
+                 **cb_kwargs) -> Dict:
     """One-call builder (see :class:`EngineBuilder`)."""
     return EngineBuilder(model, prompt_buckets=prompt_buckets,
                          batch_sizes=batch_sizes,
                          max_new_tokens=max_new_tokens,
+                         runtime_config=runtime_config,
                          **cb_kwargs).build(path, wire_cache=wire_cache)

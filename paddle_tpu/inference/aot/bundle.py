@@ -156,10 +156,18 @@ class EngineBundle:
 
     @classmethod
     def create(cls, directory: str, model_hash: str, geometry: Dict,
-               buckets: Optional[Dict] = None) -> "EngineBundle":
+               buckets: Optional[Dict] = None,
+               runtime_config: Optional[Dict] = None) -> "EngineBundle":
         """Initialize (or RESET) a bundle: fresh manifest, stale
         executables removed. This is the 'clean rebuild' entry point —
-        an invalidated bundle is re-created, never patched."""
+        an invalidated bundle is re-created, never patched.
+
+        ``runtime_config`` (a ``RuntimeConfig.to_dict()`` payload) is
+        recorded verbatim plus its canonical hash: the hash joins the
+        bundle identity the same way geometry does — ``warm_start``
+        with a different config invalidates, and ``aot_report --verify``
+        re-derives the hash from the recorded dict so a hand-edited
+        manifest cannot ship a config its hash does not vouch for."""
         b = cls(directory)
         os.makedirs(b.dir, exist_ok=True)
         _integrity.sweep_tmp(b.dir)
@@ -169,12 +177,18 @@ class EngineBundle:
                     os.unlink(os.path.join(b.dir, n))
                 except OSError:
                     pass
-        b._write_manifest({
+        manifest = {
             "format": FORMAT, "created": round(time.time(), 3),
             "fingerprint": runtime_fingerprint(),
             "model": model_hash, "geometry": dict(geometry),
             "buckets": dict(buckets or {}), "artifacts": {},
-        })
+        }
+        if runtime_config is not None:
+            from ...framework.runtime_config import config_hash
+            manifest["runtime_config"] = dict(runtime_config)
+            manifest["runtime_config_hash"] = config_hash(
+                dict(runtime_config))
+        b._write_manifest(manifest)
         return b
 
     # -------------------------------------------------------- validate --

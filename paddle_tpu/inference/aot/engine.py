@@ -301,7 +301,8 @@ def load_engine(path: str, model=None, write_back: bool = True,
 
 
 def warm_start(model, path: Optional[str] = None, strict: bool = False,
-               wire_cache: bool = True, **cb_kwargs):
+               wire_cache: bool = True, runtime_config=None,
+               **cb_kwargs):
     """Build a ``ContinuousBatchingPredictor`` warm-started from the
     engine bundle at `path` (default: ``$PADDLE_TPU_ENGINE_DIR``).
 
@@ -309,24 +310,47 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
     compiled against it); explicit ``cb_kwargs`` override it, but an
     override that CHANGES the compiled-in geometry (batch/page/seq/eos/
     pad) invalidates the bundle — mixed-geometry artifacts would be
-    silently wrong — and triggers a clean reset.
+    silently wrong — and triggers a clean reset. The manifest's
+    ``runtime_config`` participates the same way on its COMPILED
+    fields (``runtime_config.COMPILED_FIELDS``: geometry, bucket
+    table, chunk threshold): passing a ``runtime_config`` that
+    disagrees there — or passing one against a legacy bundle that
+    recorded no config at all — invalidates (reason
+    ``runtime_config``); a tuned config deploys by REBUILDING the
+    bundle (``tools/autotune.py`` → ``EngineBuilder``), never by
+    silently serving mismatched artifacts. Runtime-only fields
+    (queue/shed/watchdog/WFS/grad-comm) may differ freely — the
+    explicit config serves, the shared bundle survives. Without an
+    explicit config the bundle's own baked config drives the
+    predictor.
+
+    Config-vs-observed drift: whichever config ends up serving is
+    compared against the ambient FLAGS-derived config on the migrated
+    knobs, and every disagreement is counted in
+    ``aot.config_drift{key}`` — the operator signal that this host's
+    flags no longer match what the deploy artifact encodes.
 
     On ANY invalidation (corrupt manifest, fingerprint or model-hash
-    mismatch, geometry change) the bundle is rejected, counted in
-    ``aot.invalidations``, re-created empty, and the predictor starts
-    as a clean live-JIT build whose compiles write back into the fresh
-    bundle — the engine self-heals instead of serving stale programs.
-    With ``strict=True`` the invalidation raises instead.
+    mismatch, geometry change, runtime-config change) the bundle is
+    rejected, counted in ``aot.invalidations``, re-created empty, and
+    the predictor starts as a clean live-JIT build whose compiles
+    write back into the fresh bundle — the engine self-heals instead
+    of serving stale programs. With ``strict=True`` the invalidation
+    raises instead.
 
     Returns ``(predictor, engine)``.
     """
     from .. import ContinuousBatchingPredictor
+    from ...framework.runtime_config import (RuntimeConfig,
+                                             MIGRATED_FLAG_KNOBS,
+                                             COMPILED_FIELDS)
     path = path or default_engine_dir()
     if not path:
         raise ValueError("warm_start needs an engine path (argument or "
                          "PADDLE_TPU_ENGINE_DIR)")
     mh = model_fingerprint(model)
     geometry: Dict = {}
+    eff_rc: Optional[RuntimeConfig] = runtime_config
     engine: Optional[InferenceEngine] = None
     try:
         engine = load_engine(path, model=model, wire_cache=wire_cache)
@@ -343,19 +367,88 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
             raise BundleInvalid(
                 "geometry", f"overrides change compiled-in geometry: "
                             f"{sorted(changed)}")
+        m = engine.bundle.manifest()
+        bundle_rc_d = m.get("runtime_config")
+        if bundle_rc_d is not None:
+            try:
+                bundle_rc = RuntimeConfig.from_dict(bundle_rc_d)
+            except ValueError as e:
+                # hand-edited or newer-schema config: reject and
+                # self-heal like any other corrupt manifest field
+                raise BundleInvalid("runtime_config",
+                                    f"unreadable baked config: {e}")
+            if runtime_config is not None:
+                # invalidate only on COMPILED disagreement: a tuned
+                # bucket table / pool layout means different
+                # executables, but runtime-only knobs (queue, shed,
+                # watchdog, WFS quantum, grad comm) are free to differ
+                # per replica — destroying the shared bundle for a
+                # max_queue tweak would cost a full recompile for
+                # nothing. A requested "auto" value (num_pages=None,
+                # prompt_buckets=()) expresses no opinion and accepts
+                # whatever the builder resolved and baked.
+                rq = runtime_config.to_dict()
+                changed = sorted(
+                    k for k in set(bundle_rc.diff(runtime_config))
+                    & COMPILED_FIELDS
+                    if not (k in ("num_pages", "prompt_buckets")
+                            and rq[k] in (None, [])))
+                if changed:
+                    raise BundleInvalid(
+                        "runtime_config",
+                        f"bundle config "
+                        f"{str(m.get('runtime_config_hash'))[:12]}... "
+                        f"vs requested "
+                        f"{runtime_config.config_hash()[:12]}... "
+                        f"(compiled fields: {changed})")
+                # adopt the builder-resolved values for the auto
+                # fields: the predictor must bucket/pool exactly as
+                # the artifacts were compiled
+                fills = {}
+                if runtime_config.num_pages is None:
+                    fills["num_pages"] = bundle_rc.num_pages
+                if not runtime_config.prompt_buckets:
+                    fills["prompt_buckets"] = bundle_rc.prompt_buckets
+                if fills:
+                    eff_rc = runtime_config.replace(**fills)
+            if eff_rc is None:
+                eff_rc = bundle_rc   # the baked config serves
+        elif runtime_config is not None:
+            # a legacy bundle (no recorded config) cannot vouch that
+            # its artifacts match the requested config — serving the
+            # old geometry while telemetry reports the tuned knobs
+            # would be exactly the silent split this field prevents
+            raise BundleInvalid(
+                "runtime_config",
+                "bundle predates runtime_config; rebuild to deploy an "
+                "explicit config")
     except BundleInvalid as e:
         if strict:
             raise
-        if e.reason == "geometry":   # load_engine counted the others
-            _invalidate(e.reason, e.detail)
+        if e.reason in ("geometry", "runtime_config"):
+            _invalidate(e.reason, e.detail)  # load_engine counted others
         geometry = {}
         bundle = EngineBundle.create(
-            path, mh, {**cb_kwargs}, buckets={})
+            path, mh, {**cb_kwargs}, buckets={},
+            runtime_config=(runtime_config.to_dict()
+                            if runtime_config is not None else None))
         if wire_cache:
             wire_xla_cache(bundle.xla_cache_dir)
         engine = InferenceEngine(bundle, write_back=True)
+        eff_rc = runtime_config
+    if eff_rc is not None:
+        # drift telemetry: the serving config vs what this host's
+        # FLAGS would have produced, on the knobs flags can express —
+        # a deploy whose artifact disagrees with the fleet's flag
+        # state should light a dashboard, not be discovered in a
+        # perf regression
+        ambient = RuntimeConfig.from_flags()
+        drift = eff_rc.diff(ambient)
+        for field in sorted(set(drift) & set(MIGRATED_FLAG_KNOBS.values())):
+            _obsm.counter("aot.config_drift").inc(key=field)
     kw = {**geometry, **cb_kwargs}
-    predictor = ContinuousBatchingPredictor(model, engine=engine, **kw)
+    predictor = ContinuousBatchingPredictor(model, engine=engine,
+                                            runtime_config=eff_rc, **kw)
     if not geometry:
         # reset path: persist the EFFECTIVE geometry (ctor defaults
         # resolved) so the next warm_start reconstructs an identical

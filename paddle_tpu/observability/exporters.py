@@ -33,15 +33,53 @@ class JsonlExporter:
         {"ts": <unix s>, "step": <int|None>, "name": "train.step_time",
          "kind": "histogram", "labels": {...}, "value": <float>,
          ... histogram extras: count/sum/min/max/p50/p99}
+
+    Size-based rotation: with ``max_bytes`` set (ctor arg, env default
+    ``PADDLE_TPU_TELEMETRY_MAX_BYTES``; 0/unset disables), a file that
+    reaches the bound is atomically renamed to ``<path>.1`` (one
+    os.replace — a concurrent reader sees the old file or the new one,
+    never a torn mix) and a fresh file continues at ``path``. Long
+    serve runs stop growing the telemetry file unbounded; the readers
+    (tools/{trace_report,metrics_report,autotune}.py) fold the rotated
+    sibling back in. Rotation happens on whole-line boundaries only —
+    every write here is a complete line.
     """
 
-    def __init__(self, path: str, registry: Optional[MetricRegistry] = None):
+    def __init__(self, path: str, registry: Optional[MetricRegistry] = None,
+                 max_bytes: Optional[int] = None):
         self.path = path
         self._registry = registry or get_registry()
         self._lock = threading.Lock()  # span ends vs step exports race
+        if max_bytes is None:
+            max_bytes = int(os.environ.get(
+                "PADDLE_TPU_TELEMETRY_MAX_BYTES") or 0)
+        self.max_bytes = max(int(max_bytes), 0)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", buffering=1)
+
+    def _maybe_rotate_locked(self):
+        """Rotate when the live file crossed the bound (caller holds
+        the lock). Best-effort: a failed rename keeps appending to the
+        current file rather than dropping telemetry."""
+        if not self.max_bytes or self._f is None:
+            return
+        try:
+            if self._f.tell() < self.max_bytes:
+                return
+            f, self._f = self._f, None
+            f.flush()
+            f.close()
+            try:
+                os.replace(self.path, self.path + ".1")
+            finally:
+                self._f = open(self.path, "a", buffering=1)
+        except OSError:
+            if self._f is None:
+                try:
+                    self._f = open(self.path, "a", buffering=1)
+                except OSError:
+                    pass
 
     def export(self, step: Optional[int] = None, extra: Optional[dict] = None):
         ts = time.time()
@@ -56,6 +94,7 @@ class JsonlExporter:
             if self._f is None:
                 return
             self._f.write("\n".join(lines) + "\n" if lines else "")
+            self._maybe_rotate_locked()
 
     def write_record(self, rec: dict):
         """Escape hatch for one-off records (bench.py run metadata,
@@ -67,6 +106,7 @@ class JsonlExporter:
             if self._f is None:
                 return
             self._f.write(line)
+            self._maybe_rotate_locked()
 
     def flush(self):
         with self._lock:

@@ -1,0 +1,18 @@
+"""GL106 positive fixture: bare FLAGS reads of RuntimeConfig-migrated
+knobs outside framework/runtime_config.py — each reader shape fires."""
+from paddle_tpu.framework.flags import flag_value, get_flags
+from paddle_tpu.framework.flags import flag_value as _fv
+
+
+def uses_flag_value():
+    return flag_value("grad_bucket_bytes")
+
+
+def uses_underscore_alias():
+    return _fv("serve_prefill_chunk_tokens")
+
+
+def uses_get_flags_list():
+    # the migrated knob fires; the unmigrated one rides along silently
+    return get_flags(["FLAGS_quantized_grad_comm",
+                      "FLAGS_use_pallas_kernels"])

@@ -1,0 +1,20 @@
+"""GL106 negative fixture: unmigrated flags, config-mediated reads,
+and an inline sanction — none may fire."""
+from paddle_tpu.framework.flags import flag_value
+from paddle_tpu.framework.runtime_config import RuntimeConfig
+
+
+def unmigrated_knob_is_fine():
+    return flag_value("use_pallas_kernels")
+
+
+def config_mediated_read():
+    return RuntimeConfig.from_flags().grad_bucket_bytes
+
+
+def injected_config(rc):
+    return rc.prefill_chunk_tokens
+
+
+def sanctioned():
+    return flag_value("grad_bucket_bytes")  # graft-lint: ok[GL106] fixture

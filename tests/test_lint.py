@@ -122,6 +122,48 @@ def test_gl104_good_deferred_flag_pattern_clean():
     assert got == [], [f.render() for f in got]
 
 
+# ---------------------------------------------------------------- GL106 --
+
+def test_gl106_bad_fires_per_pattern():
+    got = findings_for("gl106_bad.py", {"GL106"})
+    assert len(got) == 3, [f.render() for f in got]
+    msgs = " | ".join(f.message for f in got)
+    assert "grad_bucket_bytes" in msgs          # flag_value literal
+    assert "serve_prefill_chunk_tokens" in msgs  # _fv alias
+    assert "quantized_grad_comm" in msgs        # get_flags list
+    assert "use_pallas_kernels" not in msgs     # unmigrated: silent
+
+
+def test_gl106_good_is_clean():
+    got = findings_for("gl106_good.py", {"GL106"})
+    assert got == [], [f.render() for f in got]
+
+
+def test_gl106_home_module_exempt():
+    """from_flags() in framework/runtime_config.py is THE sanctioned
+    reader of the migrated knobs."""
+    home = os.path.join(REPO, lint_config.RUNTIME_CONFIG_HOME)
+    got = run_passes([home], REPO, rules={"GL106"})
+    assert got == [], [f.render() for f in got]
+
+
+def test_gl106_knob_table_matches_runtime_config():
+    """The lint table and the dataclass's own migrated-knob map must
+    name the same flags (read from source, no paddle_tpu import)."""
+    import ast
+    src = open(os.path.join(REPO,
+                            lint_config.RUNTIME_CONFIG_HOME)).read()
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                getattr(t, "id", None) == "MIGRATED_FLAG_KNOBS"
+                for t in node.targets):
+            keys = {k.value for k in node.value.keys}
+            assert keys == set(lint_config.RUNTIME_CONFIG_KNOBS)
+            return
+    raise AssertionError("MIGRATED_FLAG_KNOBS not found")
+
+
 # ---------------------------------------------------------------- GL105 --
 
 def _write(path, text):

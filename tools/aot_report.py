@@ -51,9 +51,26 @@ def human(n: float) -> str:
     return f"{n:.1f}GiB"
 
 
+def config_hash(d: dict) -> str:
+    """Canonical RuntimeConfig hash — byte-for-byte the same algorithm
+    as paddle_tpu.framework.runtime_config.config_hash (this tool must
+    run without importing paddle_tpu; parity is pinned by
+    tests/test_autotune.py)."""
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, separators=(",", ":"),
+                   default=str).encode()).hexdigest()
+
+
 def verify(bundle: str, manifest: dict) -> list:
-    """Re-hash every artifact; returns [(key, problem)] mismatches."""
+    """Re-hash every artifact (and the recorded runtime_config against
+    its manifest hash); returns [(key, problem)] mismatches."""
     bad = []
+    rc = manifest.get("runtime_config")
+    if rc is not None:
+        if config_hash(rc) != manifest.get("runtime_config_hash"):
+            bad.append(("runtime_config", "config hash mismatch"))
+    elif manifest.get("runtime_config_hash") is not None:
+        bad.append(("runtime_config", "hash present but config missing"))
     for key, rec in sorted(manifest.get("artifacts", {}).items()):
         path = os.path.join(bundle, rec["file"])
         if not os.path.exists(path):
@@ -90,6 +107,8 @@ def main(argv=None) -> int:
                "fingerprint": m.get("fingerprint"),
                "model": m.get("model"), "geometry": m.get("geometry"),
                "buckets": m.get("buckets"),
+               "runtime_config": m.get("runtime_config"),
+               "runtime_config_hash": m.get("runtime_config_hash"),
                "artifacts": {k: {**rec, "disk_bytes": sizes[k]}
                              for k, rec in arts.items()}}
         if a.verify:
@@ -110,6 +129,13 @@ def main(argv=None) -> int:
     if bk:
         print("buckets   " + "  ".join(f"{k}={v}"
                                        for k, v in sorted(bk.items())))
+    rc = m.get("runtime_config")
+    if rc:
+        h = m.get("runtime_config_hash") or "?"
+        print(f"config    {str(h)[:16]}...  "
+              + "  ".join(f"{k}={v}" for k, v in sorted(rc.items())
+                          if k not in ("version",) and v not in
+                          (None, [], ())))
     total = sum(s or 0 for s in sizes.values())
     print(f"artifacts {len(arts)}   total {human(total)}")
     for key, rec in sorted(arts.items()):

@@ -18,6 +18,7 @@ import os
 import sys
 from typing import List, Optional
 
+from . import config
 from .baseline import Baseline
 from .core import Finding, iter_py_files, run_passes
 from .passes import RULE_DOCS
@@ -76,7 +77,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     root = repo_root_of()
-    paths = args.paths or ["paddle_tpu"]
+    # default scope: the package plus the standalone tool entry points
+    # (autotune and the other telemetry readers are part of the
+    # observability loop's trusted surface)
+    paths = args.paths or ["paddle_tpu", *config.TOOL_ENTRY_POINTS]
     rules = {r.strip() for r in args.rules.split(",") if r.strip()} \
         or None
     try:

@@ -93,6 +93,30 @@ LOCKY_METHODS = {
 LOCK_NAME_RE = r"(?i)(^|[._])lock$"
 
 
+# --------------------------------------------------------------- GL106 --
+# Knobs migrated into the typed RuntimeConfig
+# (paddle_tpu/framework/runtime_config.py). Reading one via the bare
+# FLAGS registry (flag_value / get_flags) anywhere else bypasses the
+# config object — the bundle-baked value and the running value then
+# silently diverge, which is exactly the drift aot.config_drift exists
+# to surface. Only RUNTIME_CONFIG_HOME (the from_flags() bridge) may
+# read them directly.
+RUNTIME_CONFIG_HOME = "paddle_tpu/framework/runtime_config.py"
+RUNTIME_CONFIG_KNOBS = frozenset({
+    "serve_prefill_chunk_tokens",
+    "serve_decode_watchdog_s",
+    "grad_bucket_bytes",
+    "quantized_grad_comm",
+})
+
+# Standalone tool entry points linted by the default CLI run alongside
+# paddle_tpu/ (the autotune replay engine and the other telemetry
+# readers ship code too — the closing-the-loop pipeline is only as
+# trustworthy as its tools).
+TOOL_ENTRY_POINTS = ("tools/autotune.py", "tools/trace_report.py",
+                     "tools/metrics_report.py", "tools/aot_report.py",
+                     "bench.py")
+
 # --------------------------------------------------------------- GL105 --
 # Where telemetry is emitted (scanned for counter/gauge/histogram/span/
 # start_span/traced/define_flag call sites) — independent of the CLI
@@ -106,4 +130,4 @@ FLAG_DOC_ROOTS = ("docs", "README.md")
 # examples (myapp.*) and module paths in backticks stay out of scope.
 CATALOG_PREFIXES = ("train", "serve", "serving", "comm", "mem", "pp",
                     "robustness", "aot", "ckpt", "dist", "launch",
-                    "bench", "router", "kernels")
+                    "bench", "router", "kernels", "autotune")

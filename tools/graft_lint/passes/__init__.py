@@ -5,12 +5,14 @@ from .hostsync import check as _hostsync
 from .retrace import check as _retrace
 from .locks import check as _locks
 from .catalog import check as _catalog
+from .rtconfig import check as _rtconfig
 
 FILE_PASSES = (
     ("GL101", _donation),
     ("GL102", _hostsync),
     ("GL103", _retrace),
     ("GL104", _locks),
+    ("GL106", _rtconfig),
 )
 
 PROJECT_PASSES = (
@@ -29,4 +31,7 @@ RULE_DOCS = {
              "sys.excepthook chain, or atexit callback",
     "GL105": "telemetry catalog drift: emitted metric/span/flag names "
              "and the docs catalogs disagree",
+    "GL106": "config drift: a knob migrated into RuntimeConfig is read "
+             "via the bare FLAGS registry outside "
+             "framework/runtime_config.py",
 }

@@ -1,0 +1,74 @@
+"""GL106 — RuntimeConfig knob drift.
+
+Performance knobs that migrated into the typed RuntimeConfig
+(``paddle_tpu/framework/runtime_config.py``; table:
+``config.RUNTIME_CONFIG_KNOBS``) may no longer be read via the bare
+FLAGS registry (``flag_value`` / its ``_fv`` aliases / ``get_flags``)
+anywhere else. A direct flag read bypasses the config object, so a
+deployment that ships a tuned config in its AOT bundle would run one
+value while the bypassing call site runs another — the silent split
+``aot.config_drift`` telemetry exists to surface, reintroduced one
+convenience read at a time. Defaults must flow through
+``RuntimeConfig.from_flags()`` (the one sanctioned reader, in
+``config.RUNTIME_CONFIG_HOME``).
+
+Matched call shapes (first argument a string literal, or a literal
+list/tuple for ``get_flags``):
+
+    flag_value("grad_bucket_bytes")
+    _fv("serve_prefill_chunk_tokens")
+    get_flags(["FLAGS_quantized_grad_comm"])
+
+Suppress with ``# graft-lint: ok[GL106] why`` at a call site that
+genuinely cannot take a config (none are known today).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import config
+from ..core import Finding, SourceFile, terminal_name
+
+_READER_NAMES = {"flag_value", "fv", "get_flags"}
+
+_HINT = ("read the knob from a RuntimeConfig "
+         "(framework/runtime_config.py) — ctor-injected, or "
+         "RuntimeConfig.from_flags() for the legacy default — so the "
+         "value stays consistent with what a deploy bundle bakes")
+
+
+def _literal_names(arg: ast.expr) -> List[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, (ast.List, ast.Tuple, ast.Set)):
+        return [e.value for e in arg.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def check(sf: SourceFile, repo_root: str) -> List[Finding]:
+    if sf.tree is None or sf.relpath == config.RUNTIME_CONFIG_HOME:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = terminal_name(node.func).lstrip("_")
+        if fn not in _READER_NAMES:
+            continue
+        hits = sorted({
+            name.removeprefix("FLAGS_")
+            for name in _literal_names(node.args[0])
+            if name.removeprefix("FLAGS_")
+            in config.RUNTIME_CONFIG_KNOBS})
+        if hits:
+            findings.append(sf.finding(
+                "GL106", "error", node,
+                f"flag knob{'s' if len(hits) > 1 else ''} "
+                f"{', '.join(hits)} migrated into RuntimeConfig: bare "
+                f"FLAGS reads outside "
+                f"{config.RUNTIME_CONFIG_HOME} reintroduce config "
+                f"drift", _HINT))
+    return findings

@@ -389,6 +389,36 @@ def render(last, spans=None) -> str:
     return "\n".join(out) if out else "(no telemetry samples)"
 
 
+def _read_complete(path, offset):
+    """Read from byte `offset`, consuming WHOLE lines only: returns
+    (complete-line list, new offset, unterminated tail). Holding the
+    tail back fixes two failure modes at once — a line being appended
+    right now is re-read complete on the next refresh instead of being
+    half-consumed, and a torn final line (crash-time telemetry) is
+    surfaced to the caller instead of silently swallowed. Binary mode
+    keeps offsets byte-exact whatever the file's encoding
+    (json.loads accepts bytes lines directly)."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        data = f.read()
+    cut = data.rfind(b"\n") + 1
+    return data[:cut].splitlines(), offset + cut, data[cut:]
+
+
+def _ingest_rotated(path, last, spans):
+    """Fold in the size-rotation sibling (`<path>.1`, JsonlExporter
+    PADDLE_TPU_TELEMETRY_MAX_BYTES) so a rotated run still reads as
+    one logical file."""
+    rot = path + ".1"
+    if not os.path.exists(rot):
+        return last
+    lines, _, tail = _read_complete(rot, 0)
+    if tail.strip():
+        print(f"warning: {rot}: skipping torn final line — truncated "
+              "mid-record (crash-time telemetry)", file=sys.stderr)
+    return parse(lines, last, spans)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="telemetry JSONL file")
@@ -397,14 +427,34 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=2.0)
     a = ap.parse_args(argv)
     last, spans, offset = {}, {}, 0
+    rotated_seen = False
+    ino = None
     while True:
         try:
-            if os.path.getsize(a.path) < offset:
-                offset, last, spans = 0, {}, {}  # truncated: start over
-            with open(a.path) as f:
-                f.seek(offset)           # incremental: appended lines only
-                last = parse(f, last, spans)
-                offset = f.tell()
+            st = os.stat(a.path)
+            if st.st_size < offset or (ino is not None
+                                       and st.st_ino != ino):
+                # truncated OR rotated under us — the inode check
+                # catches a rotation where the fresh file already grew
+                # past the old offset within one poll interval. Start
+                # over; the rotated sibling re-ingests below, so no
+                # samples from a mid-follow rotation are lost.
+                offset, last, spans = 0, {}, {}
+                rotated_seen = False
+            ino = st.st_ino
+            if not rotated_seen:
+                rotated_seen = True
+                last = _ingest_rotated(a.path, last, spans)
+            lines, offset, tail = _read_complete(a.path, offset)
+            last = parse(lines, last, spans)
+            if tail.strip() and not a.follow:
+                # one-shot read at EOF: the unterminated tail can only
+                # be a torn final line (crash-time write) — warn and
+                # move on; in --follow mode it may still be completed
+                # by the writer, so it is simply re-read next refresh
+                print(f"warning: {a.path}: skipping torn final line "
+                      f"({len(tail)} bytes) — truncated mid-record "
+                      "(crash-time telemetry)", file=sys.stderr)
         except FileNotFoundError:
             print(f"(waiting for {a.path})" if a.follow
                   else f"no such file: {a.path}", file=sys.stderr)

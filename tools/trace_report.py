@@ -37,14 +37,45 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
 
 # ---------------------------------------------------------------- loading --
+def _warn_torn(path: str, line: str):
+    """Crash-time telemetry ends mid-record (the process died between
+    write() and the line's newline): skip it loudly instead of
+    raising — everything before the torn line is intact."""
+    print(f"warning: {path}: skipping torn final line "
+          f"({len(line)} bytes) — truncated mid-record "
+          "(crash-time telemetry)", file=sys.stderr)
+
+
+def _jsonl_records(path: str) -> List[dict]:
+    """Parsed records of one JSONL file; a torn final line warns and
+    is skipped, interior garbage is skipped silently."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    out = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                _warn_torn(path, line)
+    return out
+
+
 def load_spans(path: str) -> List[dict]:
     """Spans from a telemetry JSONL file (kind == "span" lines) or a
-    flight-recorder dump (one JSON object with spans/open_spans)."""
+    flight-recorder dump (one JSON object with spans/open_spans). A
+    size-rotated sibling (``<path>.1``, JsonlExporter rotation) is
+    folded in first so long-run history reads as one logical file; a
+    torn final line (crash-time write) is skipped with a warning."""
     with open(path) as f:
         head = f.read(1)
         f.seek(0)
@@ -55,41 +86,28 @@ def load_spans(path: str) -> List[dict]:
                     return list(doc.get("spans") or []) + \
                         list(doc.get("open_spans") or [])
             except json.JSONDecodeError:
-                f.seek(0)
-        out = []
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
+                pass   # torn flight dump: the line path below warns
+    out = []
+    paths = ([path + ".1"] if os.path.exists(path + ".1") else []) \
+        + [path]
+    for p in paths:
+        for rec in _jsonl_records(p):
             if rec.get("kind") == "span":
                 out.append(rec)
-        return out
+    return out
 
 
 def load_heartbeats(paths: List[str]) -> List[dict]:
     """`{"kind": "heartbeat"}` lines from heartbeat.jsonl /
-    heartbeat_rank*.jsonl / telemetry files (missing files skipped)."""
+    heartbeat_rank*.jsonl / telemetry files (missing files skipped;
+    torn final lines skipped with a warning)."""
     out = []
     for path in paths:
-        try:
-            f = open(path)
-        except (FileNotFoundError, TypeError):
+        if not isinstance(path, str) or not os.path.exists(path):
             continue
-        with f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if rec.get("kind") == "heartbeat" and "ts" in rec:
-                    out.append(rec)
+        for rec in _jsonl_records(path):
+            if rec.get("kind") == "heartbeat" and "ts" in rec:
+                out.append(rec)
     out.sort(key=lambda r: r["ts"])
     return out
 

@@ -1422,10 +1422,169 @@ def serve_mt_bench(a):
     return 0
 
 
+def _hybrid_train_bench(a):
+    """Hybrid-parallel section (`--train --mesh data=4,model=2`): a
+    2-axis ZeRO-3 + TP + 1F1B-scheduled train smoke on the 8 XLA CPU
+    devices, asserted FROM the JSONL sink:
+
+    1. loss parity: the hybrid step's loss curve matches a
+       single-replica reference within tolerance — sharding is a
+       layout decision, not a math change;
+    2. per-axis comm split: `comm.bytes` carries BOTH a data-axis
+       (grad reduction) and a model-axis (TP activation all-reduce)
+       component;
+    3. footprint: `mem.params_bytes`/`mem.opt_state_bytes`
+       per_replica < global (what ZeRO-3 buys);
+    4. deployment: the compiled sharded step round-trips through an
+       AOT bundle whose fingerprint includes the mesh topology, and
+       the warm-started step reproduces the losses bit-for-bit.
+
+    Exit 0 = every check held.
+    """
+    import tempfile
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.distributed.mesh import set_mesh
+    from paddle_tpu.distributed.fleet.hybrid import (HybridParallelPlan,
+                                                     HybridTrainStep)
+    from paddle_tpu.jit import TrainStep
+
+    steps = a.steps or 3
+    batch, seq = 8, 32
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "output", "telemetry_train.jsonl")
+    from paddle_tpu.framework.flags import flag_value as _fv
+    was_host_init = bool(_fv("host_init"))
+    paddle.set_flags({"host_init": True})
+    was_enabled = obs.enabled()
+    obs.enabled(True)
+    try:
+        reg = obs.get_registry()
+        plan = HybridParallelPlan.from_spec(a.mesh, zero_stage=a.zero,
+                                            schedule="1F1B")
+        _log(f"hybrid plan: {plan.describe()}")
+        crit = LlamaPretrainingCriterion(LlamaConfig.tiny())
+        loss_fn = lambda lg, lb: crit(lg, lb)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(1, 256, (batch, seq))
+
+        # single-replica reference, same seed/init/batch
+        paddle.seed(0)
+        ref = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False))
+        ropt = paddle.optimizer.AdamW(1e-3, parameters=ref.parameters())
+        rstep = TrainStep(ref, ropt, loss_fn)
+        ref_losses = [float(rstep(paddle.to_tensor(ids),
+                                  paddle.to_tensor(ids)))
+                      for _ in range(steps)]
+
+        def _ax_bytes():
+            out = {}
+            for s in reg.counter("comm.bytes").samples():
+                ax = s.labels.get("axis", "?")
+                out[ax] = out.get(ax, 0) + s.value
+            return out
+
+        ax0 = _ax_bytes()
+        mesh = plan.build_mesh()
+        set_mesh(mesh)
+        try:
+            paddle.seed(0)
+            model = LlamaForCausalLM(
+                LlamaConfig.tiny(tensor_parallel=plan.mp > 1))
+            opt = paddle.optimizer.AdamW(1e-3,
+                                         parameters=model.parameters())
+            step = HybridTrainStep(model, opt, loss_fn, plan=plan,
+                                   mesh=mesh)
+            losses = [float(step(paddle.to_tensor(ids),
+                                 paddle.to_tensor(ids)))
+                      for _ in range(steps)]
+            fp = step.footprint()
+            ax1 = _ax_bytes()
+            comm_axis = {k: ax1.get(k, 0) - ax0.get(k, 0) for k in ax1}
+
+            # AOT round trip: fresh step, warm-started from the bundle
+            bundle_dir = tempfile.mkdtemp(prefix="hybrid_bundle_")
+            manifest = step.save_bundle(bundle_dir, paddle.to_tensor(ids),
+                                        paddle.to_tensor(ids))
+            paddle.seed(0)
+            m2 = LlamaForCausalLM(
+                LlamaConfig.tiny(tensor_parallel=plan.mp > 1))
+            o2 = paddle.optimizer.AdamW(1e-3,
+                                        parameters=m2.parameters())
+            s2 = HybridTrainStep(
+                m2, o2, loss_fn, mesh=mesh,
+                plan=HybridParallelPlan.from_spec(
+                    a.mesh, zero_stage=a.zero, schedule="1F1B"))
+            s2.load_bundle(bundle_dir, paddle.to_tensor(ids),
+                           paddle.to_tensor(ids))
+            warm_losses = [float(s2(paddle.to_tensor(ids),
+                                    paddle.to_tensor(ids)))
+                           for _ in range(steps)]
+        finally:
+            set_mesh(None)
+
+        tol = np.abs(np.asarray(ref_losses)) * 2e-3 + 2e-4
+        checks = {
+            "loss_parity": bool(np.all(np.abs(
+                np.asarray(losses) - np.asarray(ref_losses)) <= tol)),
+            "comm_axis_split": comm_axis.get("data", 0) > 0
+            and (plan.mp <= 1 or comm_axis.get("model", 0) > 0),
+            "params_sharded": fp["params_bytes"]["per_replica"]
+            < fp["params_bytes"]["global"] if plan.zero_stage >= 3
+            else True,
+            "opt_state_sharded": fp["opt_state_bytes"]["per_replica"]
+            < fp["opt_state_bytes"]["global"] if plan.zero_stage >= 1
+            else True,
+            "aot_round_trip": bool(np.allclose(warm_losses, losses,
+                                               rtol=1e-5, atol=1e-6)),
+            "topology_in_fingerprint":
+                manifest["geometry"]["mesh_topology"] == plan.topology(),
+        }
+        with obs.JsonlExporter(path) as sink:
+            sink.write_record({
+                "kind": "hybrid_train_bench", "ts": time.time(),
+                "mesh": plan.topology(), "zero_stage": plan.zero_stage,
+                "schedule": plan.schedule, "checks": checks,
+                "losses": [round(x, 6) for x in losses],
+                "ref_losses": [round(x, 6) for x in ref_losses],
+                "warm_losses": [round(x, 6) for x in warm_losses],
+                "comm_bytes_axis": {k: int(v)
+                                    for k, v in comm_axis.items()},
+                "footprint": fp,
+                "bundle_dir": bundle_dir,
+                "backend": jax.default_backend(),
+            })
+            sink.export()
+    finally:
+        obs.enabled(was_enabled)
+        paddle.set_flags({"host_init": was_host_init})
+
+    ok = all(checks.values())
+    result = {
+        "metric": "hybrid_train_smoke",
+        "value": 1 if ok else 0,
+        "unit": "pass",
+        "aux": {
+            "mesh": plan.topology(), "zero_stage": plan.zero_stage,
+            "schedule": plan.schedule, "checks": checks,
+            "comm_bytes_axis": {k: int(v) for k, v in comm_axis.items()},
+            "footprint": fp, "telemetry": path,
+            "bench_code_sha": _bench_code_sha(),
+        },
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def train_bench(argv=None):
     """Training section: the PR-3 fast-path microbench.
 
         python bench.py --train [--steps N] [--out telemetry.jsonl]
+        python bench.py --train --mesh data=4,model=2 [--zero 3]
 
     Measures, through the observability JSONL sink (one schema with the
     other bench sections, readable by tools/metrics_report.py):
@@ -1444,7 +1603,15 @@ def train_bench(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--out", default=None, help="telemetry JSONL path")
+    ap.add_argument("--mesh", default=None,
+                    help="hybrid mesh spec (e.g. data=4,model=2): run "
+                         "the ZeRO+TP+1F1B hybrid smoke instead of the "
+                         "fast-path microbench")
+    ap.add_argument("--zero", type=int, default=3,
+                    help="ZeRO stage for --mesh (default 3)")
     a = ap.parse_args(argv)
+    if a.mesh:
+        return _hybrid_train_bench(a)
 
     import jax
     import jax.numpy as jnp

@@ -23,6 +23,7 @@ from .meta_parallel import (
 )
 from .sharding import group_sharded_parallel
 from .recompute import recompute
+from .hybrid import HybridParallelPlan, HybridTrainStep
 from . import utils
 
 

@@ -82,11 +82,12 @@ class DistTrainStep:
                  mesh: Optional[Mesh] = None, batch_specs=None,
                  donate_state: bool = True, scaler=None,
                  weight_update_sharding: Optional[bool] = None,
-                 runtime_config=None):
+                 runtime_config=None, grad_accum_steps: int = 1):
         from ...framework.runtime_config import RuntimeConfig
-        # gradient-comm knobs (bucket bytes, int8 comm) come from the
-        # typed RuntimeConfig; absent one, the FLAGS-sourced default
-        # preserves the flag-driven behavior (framework/runtime_config)
+        # gradient-comm knobs (bucket bytes, int8 comm, default ZeRO
+        # stage) come from the typed RuntimeConfig; absent one, the
+        # FLAGS-sourced default preserves the flag-driven behavior
+        # (framework/runtime_config)
         self._rc = runtime_config if runtime_config is not None \
             else RuntimeConfig.from_flags()
         self._model = model
@@ -100,18 +101,35 @@ class DistTrainStep:
         if stage is None:
             stage = getattr(model, "_sharding_stage", None)
         if stage is None:
-            stage = getattr(optimizer, "_sharding_stage", 0) or 0
-        self._stage = int(stage)
+            stage = getattr(optimizer, "_sharding_stage", None)
+        if stage is None:
+            # the RuntimeConfig knob (tools/autotune.py proposes it from
+            # mem.opt_state_bytes pressure) is the default of last resort
+            stage = int(getattr(self._rc, "zero_stage", 0) or 0)
+        self._stage = int(stage or 0)
         self._batch_specs = batch_specs
         self._donate = donate_state
         wus = weight_update_sharding
         if wus is None:
-            wus = bool(getattr(optimizer, "_weight_update_sharding", False))
+            # ZeRO stages 1 and 2 ARE weight-update sharding (opt state
+            # over 'data'); stage 2 additionally keeps persistent grad
+            # shards (grad_accum_steps > 1)
+            wus = bool(getattr(optimizer, "_weight_update_sharding",
+                               False)) or self._stage in (1, 2)
         dsize = self._mesh.shape.get("data", 1)
-        # ZeRO-3 already shards the params themselves; ZeRO-1-style
+        # ZeRO-3 already shards the params themselves; ZeRO-1/2-style
         # weight-update sharding is meaningful for stage <= 2 with a
         # real data axis
         self._wus = bool(wus) and dsize > 1 and self._stage < 3
+        self._accum_n = max(1, int(grad_accum_steps))
+        self._micro = 0
+        if self._accum_n > 1 and self._scaler is not None:
+            raise NotImplementedError(
+                "grad_accum_steps > 1 with a GradScaler is not "
+                "supported: loss-scale adaptation is per-update while "
+                "the accumulated grads span several micro-steps — use "
+                "grad_accum_steps=1 with the scaler, or drop the "
+                "scaler (bf16 training needs none) to accumulate")
 
         self._named_p = [(n, p) for n, p in model.named_parameters()
                          if not p.stop_gradient]
@@ -164,7 +182,14 @@ class DistTrainStep:
             b._value = jax.device_put(b._value, sh)
 
         self._compiled = {}
+        self._analysis = {}     # cost_analysis programs for AOT-loaded
+        self._comm_by_sig = {}  # per-sig comm accounting (data+model)
+        self._apply_compiled = None
+        self._grad_state = None
+        if self._accum_n > 1:
+            self._init_grad_accum()
         self._record_opt_state_gauges()
+        self._record_param_gauges()
 
         # -- telemetry: analytic per-step accounting of the collectives
         # XLA inserts for the declared shardings (the facade in
@@ -173,6 +198,7 @@ class DistTrainStep:
         # scatter+gather of this step are compiler-inserted, so they are
         # accounted here from the param set)
         self._obs = None
+        self._obs_boundary_comm = []
         if _obs_enabled():
             dsize = mesh_.shape.get("data", 1)
             comm = []
@@ -199,10 +225,16 @@ class DistTrainStep:
                                              fz["meta"]))
                     nb = len(fz["bucketer"].buckets)
                     if self._wus:
-                        # ZeRO-1: reduce-scatter grads, all-gather the
-                        # updated flat params — per bucket
+                        # ZeRO-1/2: reduce-scatter grads, all-gather
+                        # the updated flat params — per bucket. Under
+                        # grad accumulation the param all-gather runs
+                        # ONLY in the boundary apply program, so it is
+                        # tagged boundary-only (micro-steps must not
+                        # charge phantom gather traffic)
                         comm.append(("reduce_scatter", "data", nb, fb))
-                        comm.append(("all_gather", "data", nb, fb))
+                        ag = ("all_gather", "data", nb, fb)
+                        comm.append(ag)
+                        self._obs_boundary_comm.append(ag)
                     else:
                         comm.append(("all_reduce", "data", nb, fb))
             n_params = sum(int(np.prod(p._value.shape)) for p in self._p)
@@ -220,6 +252,10 @@ class DistTrainStep:
                     return float((ca or {}).get("flops", 0.0))
             self._obs_use_xla_mfu = use_xla_mfu
             self._obs_flops_fn = flops_fn
+            # data-axis entries are batch-independent; the model-axis
+            # (TP activation) entries are appended per batch signature
+            # in __call__ (_model_axis_comm needs the token count)
+            self._obs_base_comm = list(comm)
             self._obs = StepTelemetry(
                 n_params=n_params, dtype=dtype,
                 n_devices=mesh_.devices.size, comm_per_step=comm,
@@ -366,10 +402,8 @@ class DistTrainStep:
         """mem.opt_state_bytes{scope=global|per_replica}: analytic
         optimizer-state footprint. per_replica divides 'data'-sharded
         flat buffers by the axis size — the acceptance signal for
-        weight-update sharding."""
-        if not _obs_enabled():
-            return
-        from ...observability import metrics as _m
+        weight-update sharding. Always computed (footprint()
+        consumers); gauge emission gated on the telemetry switch."""
         dsize = self._mesh.shape.get("data", 1)
 
         def leaf_bytes(leaf, sharded):
@@ -401,55 +435,168 @@ class DistTrainStep:
                     v.dtype).itemsize
                 total += nb
                 per_replica += nb // (dsize if (self._wus and v.ndim) else 1)
+        self._opt_state_bytes = {"global": total,
+                                 "per_replica": per_replica}
+        if not _obs_enabled():
+            return
+        from ...observability import metrics as _m
         g = _m.gauge("mem.opt_state_bytes", unit="bytes",
                      help="optimizer state footprint")
         g.set(total, scope="global")
         g.set(per_replica, scope="per_replica")
-        self._opt_state_bytes = {"global": total,
-                                 "per_replica": per_replica}
+
+    def _record_param_gauges(self):
+        """mem.params_bytes{scope=global|per_replica}: analytic
+        parameter footprint from the placed shardings. Under ZeRO-3 the
+        'data'-sharded leaves divide per_replica by the data-axis size;
+        TP-tagged leaves divide by the model-axis size — the acceptance
+        signal for param sharding. The analytic numbers are always
+        computed (footprint() consumers don't depend on the telemetry
+        switch); only the gauge emission is gated."""
+        from ...observability.train_metrics import sharded_bytes
+        tot, per = sharded_bytes([p._value for p in self._p]
+                                 + [b._value for b in self._b])
+        self._params_bytes = {"global": tot, "per_replica": per}
+        if not _obs_enabled():
+            return
+        from ...observability import metrics as _m
+        g = _m.gauge("mem.params_bytes", unit="bytes",
+                     help="parameter/buffer footprint from placed "
+                          "shardings")
+        g.set(tot, scope="global")
+        g.set(per, scope="per_replica")
+
+    # ------------------------------------------- ZeRO-2 grad shards --
+    def _init_grad_accum(self):
+        """ZeRO-2: persistent gradient-accumulation state
+        (arXiv:2004.13336 stage 2 — grads live reduce-SCATTERED, never
+        fully materialized between micro-steps). Fused flat buckets
+        shard over 'data' when sharding_stage >= 2: the out-sharding of
+        the accumulation sum drives GSPMD to lower the gradient
+        reduction as reduce-scatter straight into the per-replica
+        shard, the same state-driven formulation the ZeRO-1 update uses
+        (see the wus NOTE in apply_update). The per-param rest subset
+        accumulates with the param's own sharding (ZeRO-3 params keep
+        their 'data' shard; TP/replicated params accumulate in full —
+        only the bucketed subset earns the shard)."""
+        mesh_ = self._mesh
+        repl = NamedSharding(mesh_, PartitionSpec())
+        vec = NamedSharding(mesh_, PartitionSpec("data")) \
+            if (self._stage >= 2 and self._wus) else repl
+        gb, gsh = [], []
+        if self._fused is not None:
+            for b, m in zip(self._fused["bucketer"].buckets,
+                            self._fused["meta"]):
+                z = jnp.zeros((b.padded_size,), m["cdtype"])
+                gb.append(jax.device_put(z, vec))
+                gsh.append(vec)
+        rb, rsh = [], []
+        for i in self._rest_idx:
+            z = jnp.zeros(self._p[i]._value.shape, self._p[i]._value.dtype)
+            rb.append(jax.device_put(z, self._p_sh[i]))
+            rsh.append(self._p_sh[i])
+        self._grad_state = {"fused": gb, "rest": rb}
+        self._g_sh = {"fused": gsh, "rest": rsh}
+        self._record_grad_gauges()
+
+    def _record_grad_gauges(self):
+        """mem.grad_bytes{scope}: footprint of the persistent grad
+        accumulators (only exists with grad_accum_steps > 1); ZeRO-2
+        divides the bucketed share by the data-axis size."""
+        if self._grad_state is None:
+            return
+        from ...observability.train_metrics import sharded_bytes
+        tot, per = sharded_bytes(self._grad_state["fused"]
+                                 + self._grad_state["rest"])
+        self._grad_bytes = {"global": tot, "per_replica": per}
+        if not _obs_enabled():
+            return
+        from ...observability import metrics as _m
+        g = _m.gauge("mem.grad_bytes", unit="bytes",
+                     help="persistent grad-accumulator footprint")
+        g.set(tot, scope="global")
+        g.set(per, scope="per_replica")
+
+    def _model_axis_comm(self, arrays):
+        """Analytic per-step model-axis collectives for the TP-tagged
+        params (the activation all-reduces GSPMD inserts for the
+        mp_layers sharding constraints): one fwd all-reduce per
+        row-parallel weight (output constrained replicated after a
+        'model'-contracted matmul) and one bwd all-reduce per
+        column-parallel weight (dgrad of a replicated input). Bytes
+        are activation payloads at this batch signature."""
+        msize = self._mesh.shape.get("model", 1)
+        if msize <= 1:
+            return []
+        toks = batch_tokens(arrays)
+        fwd_c = fwd_b = bwd_c = bwd_b = 0
+        for p in self._p:
+            spec = tuple(getattr(p, "_partition_spec", ()) or ())
+            v = p._value
+            if "model" not in spec or v.ndim < 2:
+                continue
+            item = v.dtype.itemsize
+            if spec[0] == "model":
+                # row-parallel / vocab-parallel weight [in(model), out]:
+                # fwd output all-reduce of [toks, out]
+                fwd_c += 1
+                fwd_b += toks * int(v.shape[-1]) * item
+            elif "model" in spec[1:]:
+                # column-parallel weight [in, out(model)]: bwd dgrad
+                # all-reduce of [toks, in]
+                bwd_c += 1
+                bwd_b += toks * int(v.shape[0]) * item
+        out = []
+        if fwd_c:
+            out.append(("all_reduce", "model", fwd_c, fwd_b))
+        if bwd_c:
+            out.append(("all_reduce", "model", bwd_c, bwd_b))
+        return out
+
+    def _refresh_comm_accounting(self, obs, sig, arrays,
+                                 boundary=True):
+        """Point the telemetry at THIS signature's comm entries (base
+        data-axis list + token-count-dependent model-axis activation
+        all-reduces) on EVERY call — alternating batch shapes, and
+        warm-started steps that never enter the compile branch, must
+        each charge their own per-axis bytes. ``boundary=False`` is
+        the accum micro-step view: boundary-only entries (the ZeRO-1/2
+        param all-gather, which lives in the apply program) are
+        excluded so micro-steps don't charge phantom gather bytes."""
+        key = (sig, boundary)
+        entries = self._comm_by_sig.get(key)
+        if entries is None:
+            base = list(getattr(self, "_obs_base_comm", []))
+            if not boundary:
+                skip = {id(e) for e in self._obs_boundary_comm}
+                base = [e for e in base if id(e) not in skip]
+            entries = self._comm_by_sig[key] = (
+                base + self._model_axis_comm(arrays))
+        obs.comm_per_step = entries
 
     def _last_cost_analysis(self):
         batch = getattr(self, "_obs_last_batch", None)
         return self.cost_analysis(*batch) if batch else None
 
-    # ------------------------------------------------------------------
-    def _batch_shardings(self, arrays):
-        mesh_ = self._mesh
-        if self._batch_specs is not None:
-            return [NamedSharding(mesh_, s) for s in self._batch_specs]
-        out = []
-        for a in arrays:
-            spec = [None] * a.ndim
-            if a.ndim >= 1 and mesh_.shape["data"] > 1 \
-                    and a.shape[0] % mesh_.shape["data"] == 0:
-                spec[0] = "data"
-            out.append(NamedSharding(mesh_, PartitionSpec(*spec)))
-        return out
+    def _apply_update_closure(self):
+        """The optimizer-update trace shared by the one-shot step
+        (_build) and the ZeRO-2 apply program (_build_apply):
+        per-param path for the rest subset, fused flat buckets
+        (optionally 'data'-sharded, ZeRO-1/2) for the fused subset.
 
-    def _build(self, batch_sh):
-        model = self._model
-        loss_fn = self._loss_fn
+        ``flat_grads``: pre-flattened per-bucket gradients (the ZeRO-2
+        persistent shards, already averaged) — when given, the
+        concatenate-from-per-param step is skipped and ``grads`` is
+        only consulted for the rest subset."""
         opt = self._opt
-        p_tensors = self._p
-        b_tensors = self._b
-        p_names = self._p_names
-        n_in = self._n_in
-        grad_clip = opt._grad_clip
-        mesh_ = self._mesh
-        repl = NamedSharding(mesh_, PartitionSpec())
-
-        scaler = self._scaler
-        obs = self._obs if _obs_enabled() else None
         fz = self._fused
         rest = self._rest_idx
+        p_names = self._p_names
+        p_tensors = self._p
         wus = self._wus
-        from ...framework.flags import flag_value
-        guard = bool(flag_value("anomaly_guard"))  # read at trace time
+        repl = NamedSharding(self._mesh, PartitionSpec())
 
-        def apply_update(p_vals, grads, opt_state, lr):
-            """Optimizer update: per-param path for the rest subset,
-            fused flat buckets (optionally 'data'-sharded, ZeRO-1) for
-            the fused subset. Returns (new_p list, new opt_state)."""
+        def apply_update(p_vals, grads, opt_state, lr, flat_grads=None):
             if fz is None:
                 return opt._fn_apply_all(list(p_vals), grads, opt_state,
                                          lr, p_names, p_tensors)
@@ -464,15 +611,20 @@ class DistTrainStep:
                 new_p[i] = rp[j]
             params_idx = fz["idx"]
             new_fused = []
-            for b, m, st in zip(fz["bucketer"].buckets, fz["meta"],
-                                opt_state["fused"]):
+            for bi, (b, m, st) in enumerate(zip(fz["bucketer"].buckets,
+                                                fz["meta"],
+                                                opt_state["fused"])):
                 cd = m["cdtype"]
-                parts = [jnp.ravel(grads[params_idx[i]]).astype(cd)
-                         for i in b.idx]
-                flat_g = jnp.concatenate(parts) if len(parts) > 1 \
-                    else parts[0]
-                if b.padded_size != b.size:
-                    flat_g = jnp.pad(flat_g, (0, b.padded_size - b.size))
+                if flat_grads is not None:
+                    flat_g = flat_grads[bi].astype(cd)
+                else:
+                    parts = [jnp.ravel(grads[params_idx[i]]).astype(cd)
+                             for i in b.idx]
+                    flat_g = jnp.concatenate(parts) if len(parts) > 1 \
+                        else parts[0]
+                    if b.padded_size != b.size:
+                        flat_g = jnp.pad(flat_g,
+                                         (0, b.padded_size - b.size))
                 # NOTE (wus): no explicit sharding constraint on flat_g /
                 # flat_p. The 'data'-sharded in/out shardings of the flat
                 # optimizer state drive GSPMD to shard the whole update
@@ -521,6 +673,179 @@ class DistTrainStep:
                     new_p[params_idx[i]] = seg.reshape(
                         b.shapes[k]).astype(m["dtype"])
             return new_p, {"per_param": rs, "fused": new_fused}
+        return apply_update
+
+    def _grad_closure(self):
+        """Forward+backward trace (no scaler) shared by the ZeRO-2
+        accumulation program: returns (loss, new_buffers, new_key,
+        grads) for one micro-batch."""
+        model = self._model
+        loss_fn = self._loss_fn
+        p_tensors = self._p
+        b_tensors = self._b
+        n_in = self._n_in
+
+        def compute(p_vals, b_vals, rng_key, batch):
+            from ...jit.bridge import bound_state
+            model_in = batch[:n_in]
+            labels = batch[n_in:]
+
+            def loss_of(pv):
+                with bound_state(p_tensors, pv, b_tensors, b_vals,
+                                 rng_key) as gen:
+                    outs = model(*[Tensor(a) for a in model_in])
+                    outs = outs if isinstance(outs, tuple) else (outs,)
+                    loss = loss_fn(*outs, *[Tensor(a) for a in labels])
+                    new_b = [t._value for t in b_tensors]
+                    return loss._value, (loss._value, new_b, gen._key)
+
+            (_, (loss_val, new_b, new_key)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(p_vals))
+            return loss_val, new_b, new_key, grads
+        return compute
+
+    def _build_accum(self, batch_sh):
+        """ZeRO-2 micro-step program: fwd+bwd, then ADD the gradients
+        into the persistent accumulators (flat buckets 'data'-sharded —
+        GSPMD lowers the reduction feeding a sharded accumulator as
+        reduce-scatter, so the full gradient never materializes).
+        Params/opt-state untouched; buffers advance per micro-batch."""
+        mesh_ = self._mesh
+        repl = NamedSharding(mesh_, PartitionSpec())
+        compute = self._grad_closure()
+        fz = self._fused
+        rest = self._rest_idx
+        obs = self._obs if _obs_enabled() else None
+        from ...framework.flags import flag_value
+        guard = bool(flag_value("anomaly_guard"))  # read at trace time
+
+        def accum_fn(p_vals, b_vals, gbufs, rbufs, rng_key, batch):
+            loss_val, new_b, _, grads = compute(p_vals, b_vals, rng_key,
+                                                batch)
+            if obs is not None:
+                obs.grad_norm_callback(grads)  # async host record
+            ok = jnp.isfinite(loss_val) if guard else None
+
+            def gate(g):
+                # anomaly guard under accumulation: a NaN/Inf micro-loss
+                # contributes ZERO gradient (the update still runs at the
+                # accumulation boundary on the healthy micro-steps)
+                return g if ok is None else jnp.where(ok, g,
+                                                      jnp.zeros_like(g))
+
+            new_g = []
+            if fz is not None:
+                for b, m, acc in zip(fz["bucketer"].buckets, fz["meta"],
+                                     gbufs):
+                    parts = [jnp.ravel(grads[fz["idx"][i]]).astype(
+                        m["cdtype"]) for i in b.idx]
+                    flat_g = jnp.concatenate(parts) if len(parts) > 1 \
+                        else parts[0]
+                    if b.padded_size != b.size:
+                        flat_g = jnp.pad(flat_g,
+                                         (0, b.padded_size - b.size))
+                    new_g.append(acc + gate(flat_g))
+            new_r = [acc + gate(grads[i]) for acc, i in zip(rbufs, rest)]
+            if guard:
+                new_b = [jnp.where(ok, n, o)
+                         for o, n in zip(b_vals, new_b)]
+            return loss_val, new_b, new_g, new_r
+
+        donate = (1, 2, 3) if self._donate else ()
+        jitted = jax.jit(
+            accum_fn,
+            in_shardings=(self._p_sh, self._b_sh, self._g_sh["fused"],
+                          self._g_sh["rest"], None, batch_sh),
+            out_shardings=(repl, self._b_sh, self._g_sh["fused"],
+                           self._g_sh["rest"]),
+            donate_argnums=donate)
+
+        def run(*args):
+            with mesh_scope(mesh_):
+                return jitted(*args)
+        run._jitted = jitted
+        return run
+
+    def _build_apply(self):
+        """ZeRO-2 boundary program: consume the accumulated grad shards
+        (averaged over grad_accum_steps, clipped jointly), run the
+        optimizer update, return ZEROED accumulators. Batch-shape
+        independent — compiled once per step object."""
+        mesh_ = self._mesh
+        grad_clip = self._opt._grad_clip
+        fz = self._fused
+        rest = self._rest_idx
+        n_p = len(self._p)
+        inv_n = 1.0 / float(self._accum_n)
+        apply_update = self._apply_update_closure()
+
+        def apply_fn(p_vals, opt_state, lr, gbufs, rbufs):
+            flats = [g * inv_n for g in gbufs]
+            rgrads = [g * inv_n for g in rbufs]
+            # joint global-norm clip across the flat buckets + the rest
+            # subset (bucket padding is zero, so the norm is exact)
+            clipped = _clip_grads_functional(flats + rgrads, grad_clip)
+            flats, rgrads = clipped[:len(flats)], clipped[len(flats):]
+            grads = [None] * n_p
+            for j, i in enumerate(rest):
+                grads[i] = rgrads[j]
+            new_p, new_state = apply_update(
+                list(p_vals), grads, opt_state, lr,
+                flat_grads=flats if fz is not None else None)
+            return (new_p, new_state,
+                    [jnp.zeros_like(g) for g in gbufs],
+                    [jnp.zeros_like(g) for g in rbufs])
+
+        donate = (0, 1, 3, 4) if self._donate else ()
+        jitted = jax.jit(
+            apply_fn,
+            in_shardings=(self._p_sh, self._s_sh, None,
+                          self._g_sh["fused"], self._g_sh["rest"]),
+            out_shardings=(self._p_sh, self._s_sh,
+                           self._g_sh["fused"], self._g_sh["rest"]),
+            donate_argnums=donate)
+
+        def run(*args):
+            with mesh_scope(mesh_):
+                return jitted(*args)
+        run._jitted = jitted
+        return run
+
+    # ------------------------------------------------------------------
+    def _batch_shardings(self, arrays):
+        mesh_ = self._mesh
+        if self._batch_specs is not None:
+            return [NamedSharding(mesh_, s) for s in self._batch_specs]
+        out = []
+        for a in arrays:
+            spec = [None] * a.ndim
+            if a.ndim >= 1 and mesh_.shape["data"] > 1 \
+                    and a.shape[0] % mesh_.shape["data"] == 0:
+                spec[0] = "data"
+            out.append(NamedSharding(mesh_, PartitionSpec(*spec)))
+        return out
+
+    def _build(self, batch_sh):
+        model = self._model
+        loss_fn = self._loss_fn
+        opt = self._opt
+        p_tensors = self._p
+        b_tensors = self._b
+        p_names = self._p_names
+        n_in = self._n_in
+        grad_clip = opt._grad_clip
+        mesh_ = self._mesh
+        repl = NamedSharding(mesh_, PartitionSpec())
+
+        scaler = self._scaler
+        obs = self._obs if _obs_enabled() else None
+        fz = self._fused
+        rest = self._rest_idx
+        wus = self._wus
+        from ...framework.flags import flag_value
+        guard = bool(flag_value("anomaly_guard"))  # read at trace time
+
+        apply_update = self._apply_update_closure()
 
         def step_fn(p_vals, b_vals, opt_state, rng_key, lr, batch,
                     scaler_st):
@@ -603,6 +928,16 @@ class DistTrainStep:
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         if sig not in self._compiled:
             self._compiled[sig] = self._build(self._batch_shardings(arrays))
+        run = self._compiled[sig]
+        if getattr(run, "_jitted", None) is None:
+            # AOT-loaded executable (hybrid/aot.load_step_bundle): no
+            # lowering attached. Trace an analysis-only twin — never
+            # installed into _compiled, so the warm-started executable
+            # keeps serving the hot path
+            if sig not in self._analysis:
+                self._analysis[sig] = self._build(
+                    self._batch_shardings(arrays))
+            run = self._analysis[sig]
         from ...amp.grad_scaler import scaler_state_in
         sc_in = (scaler_state_in(self._scaler)
                  if self._scaler is not None else ())
@@ -612,7 +947,7 @@ class DistTrainStep:
         # training trajectory (same stance as PipelineTrainStep.
         # memory_analysis)
         with mesh_scope(self._mesh):
-            lowered = self._compiled[sig]._jitted.lower(
+            lowered = run._jitted.lower(
                 [p._value for p in self._p], [b._value for b in self._b],
                 self._opt_state, jax.random.key(0),
                 self._opt._lr_operand(), arrays,
@@ -623,6 +958,8 @@ class DistTrainStep:
         return ca
 
     def __call__(self, *batch):
+        if self._accum_n > 1:
+            return self._call_accum(*batch)
         obs = self._obs if (self._obs is not None and _obs_enabled()) \
             else None
         if obs is not None:
@@ -630,6 +967,8 @@ class DistTrainStep:
         arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        if obs is not None:
+            self._refresh_comm_accounting(obs, sig, arrays)
         if sig not in self._compiled:
             # a (re)trace is the load-bearing event worth a span: the
             # retrace that wedges or thrashes shows up attributed to its
@@ -671,4 +1010,64 @@ class DistTrainStep:
         if obs is not None:
             obs.step_end(batch_tokens(arrays))  # runs the MFU probe once
             self._obs_last_batch = None
+        return Tensor(loss)
+
+    def _call_accum(self, *batch):
+        """ZeRO-2 stepping: every call runs the accumulation micro-step
+        (grads ADDED into the persistent 'data'-sharded accumulators);
+        every ``grad_accum_steps``-th call also runs the apply program
+        (optimizer update from the accumulated shards, accumulators
+        zeroed). Returns the micro-batch loss."""
+        obs = self._obs if (self._obs is not None and _obs_enabled()) \
+            else None
+        if obs is not None:
+            obs.step_start()
+        arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        sig = ("accum",) + tuple((tuple(a.shape), str(a.dtype))
+                                 for a in arrays)
+        if obs is not None:
+            # the apply program (and its param all-gather) runs only on
+            # the accumulation-boundary call
+            self._refresh_comm_accounting(
+                obs, sig, arrays,
+                boundary=self._micro + 1 >= self._accum_n)
+        if sig not in self._compiled:
+            with _tracing.span("dist.compile", batch=str(sig),
+                               stage=self._stage, wus=self._wus,
+                               mode="accum"):
+                self._compiled[sig] = self._build_accum(
+                    self._batch_shardings(arrays))
+        gen = default_generator()
+        key_in = gen.split()
+        gs = self._grad_state
+        loss, new_b, gf, gr = self._compiled[sig](
+            [p._value for p in self._p], [b._value for b in self._b],
+            gs["fused"], gs["rest"], key_in, arrays)
+        for t, v in zip(self._b, new_b):
+            t._value = v
+        gs["fused"], gs["rest"] = list(gf), list(gr)
+        self._micro += 1
+        if self._micro >= self._accum_n:
+            self._micro = 0
+            if self._apply_compiled is None:
+                with _tracing.span("dist.compile", stage=self._stage,
+                                   wus=self._wus, mode="apply"):
+                    self._apply_compiled = self._build_apply()
+            lr = self._opt._lr_operand()
+            new_p, new_state, zg, zr = self._apply_compiled(
+                [p._value for p in self._p], self._opt_state, lr,
+                gs["fused"], gs["rest"])
+            for t, v in zip(self._p, new_p):
+                t._value = v
+            self._opt_state = new_state
+            gs["fused"], gs["rest"] = list(zg), list(zr)
+            if isinstance(new_state, dict):
+                self._opt._fn_sync_to_accumulators(
+                    [self._p[i] for i in self._rest_idx],
+                    new_state["per_param"])
+            else:
+                self._opt._fn_sync_to_accumulators(self._p, new_state)
+        if obs is not None:
+            obs.step_end(batch_tokens(arrays))
         return Tensor(loss)

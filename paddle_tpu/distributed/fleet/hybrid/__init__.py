@@ -1,0 +1,21 @@
+"""Hybrid-parallel engine: plan → mesh → executing step → deployment.
+
+    from paddle_tpu.distributed.fleet.hybrid import (
+        HybridParallelPlan, HybridTrainStep)
+
+    plan = HybridParallelPlan.from_spec("data=4,model=2", zero_stage=3)
+    step = HybridTrainStep(model, opt, loss_fn, plan=plan,
+                           install_mesh=True)
+    loss = step(ids, labels)
+    step.save_bundle("engine/", ids, labels)   # topology-fingerprinted
+
+See docs/TRAINING.md "Hybrid parallelism".
+"""
+from .plan import HybridParallelPlan, parse_mesh_spec
+from .engine import HybridTrainStep
+from .overlap import (overlapped_all_reduce, overlapped_reduce_scatter,
+                      prefetch_all_gather)
+
+__all__ = ["HybridParallelPlan", "parse_mesh_spec", "HybridTrainStep",
+           "overlapped_all_reduce", "overlapped_reduce_scatter",
+           "prefetch_all_gather"]

@@ -1,0 +1,174 @@
+"""AOT deployment of the hybrid train step (PR-8 bundle format).
+
+A compiled hybrid step is partitioned for ONE mesh topology: the SPMD
+partitioner bakes the axis sizes into every sharded op, so an
+executable built for ``data=4,model=2`` is garbage on ``data=8`` even
+though the model and jaxlib match. The bundle identity therefore joins
+THREE fingerprints:
+
+- the PR-8 runtime fingerprint (jax/jaxlib/platform/format) — checked
+  by ``EngineBundle.validate`` exactly like serving bundles;
+- the model fingerprint (class/config/param tree, weight values
+  excluded — a newer checkpoint warm-starts);
+- the plan fingerprint (``HybridParallelPlan.fingerprint()``:
+  topology, zero stage, schedule, microbatching) — hashed INTO the
+  recorded model hash and ALSO stored readable in the manifest
+  geometry, so ``aot_report`` shows the topology and the loader can
+  name ``topology`` as the invalidation reason instead of a generic
+  hash mismatch.
+
+Scope: the GSPMD step (``DistTrainStep`` — any data x model topology,
+all ZeRO stages). The pipeline step's scanned shard_map program also
+serializes, but its warm-start path is not wired yet and raises.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+
+from ....inference.aot.bundle import (EngineBundle, BundleInvalid,
+                                      model_fingerprint)
+from ....observability import metrics as _obsm
+from ....observability import tracing as _obstr
+from ....observability import enabled as _obs_enabled
+from ...mesh import mesh_scope
+
+__all__ = ["save_step_bundle", "load_step_bundle", "hybrid_model_hash"]
+
+
+def hybrid_model_hash(model, plan) -> str:
+    """Model fingerprint with the plan fingerprint joined in — the
+    bundle-identity hash topology drift invalidates."""
+    return hashlib.sha256(json.dumps(
+        {"model": model_fingerprint(model), "plan": plan.fingerprint()},
+        sort_keys=True).encode()).hexdigest()
+
+
+def _dist_example_args(inner, arrays):
+    """The exact argument tuple DistTrainStep.__call__ feeds its
+    compiled fn at this signature (keys/lr as fresh exemplars: lowering
+    needs types, not the live RNG — same stance as cost_analysis)."""
+    from ....amp.grad_scaler import scaler_state_in
+    sc_in = (scaler_state_in(inner._scaler)
+             if inner._scaler is not None else ())
+    return ([p._value for p in inner._p],
+            [b._value for b in inner._b],
+            inner._opt_state, jax.random.key(0),
+            inner._opt._lr_operand(), arrays, sc_in)
+
+
+def _dist_inner(step):
+    from ..dist_step import DistTrainStep
+    inner = getattr(step, "inner", step)
+    if not isinstance(inner, DistTrainStep):
+        raise NotImplementedError(
+            "hybrid AOT bundles currently serialize the GSPMD step "
+            "(DistTrainStep) only; for pp > 1 keep the live-JIT path "
+            "(the pipeline step's warm-start wiring is future work)")
+    if inner._accum_n > 1:
+        raise NotImplementedError(
+            "hybrid AOT bundles serialize the one-shot step; the "
+            "ZeRO-2 accum/apply program pair is not wired yet")
+    return inner
+
+
+def _coerce_arrays(batch):
+    from ....tensor import Tensor
+    return [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            for b in batch]
+
+
+def save_step_bundle(step, path: str, *batch):
+    """AOT-compile the step at ``batch``'s signature and write a bundle
+    (fresh manifest — bundles are re-created, never patched). Returns
+    the manifest dict."""
+    inner = _dist_inner(step)
+    plan = step.plan
+    arrays = _coerce_arrays(batch)
+    sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+    with _obstr.span("aot.build", kind="hybrid_train_step",
+                     topology=plan.topology(), path=path):
+        # serialization-grade trace: the live step's in-program
+        # grad-norm telemetry is a jax.debug.callback, which pickles as
+        # a PyCapsule and kills serialize_executable. The bundle gets a
+        # program traced with telemetry OFF — host-side step telemetry
+        # (step time, comm accounting, footprint gauges) is unaffected
+        # on warm start; only train.grad_norm goes quiet (documented in
+        # docs/DEPLOYMENT.md).
+        # persistent-cache fence (the PR-8 sharp edge): an executable
+        # the backend handed back from a persistent-cache HIT
+        # re-serializes into a blob missing object code ("Symbols not
+        # found"); compile the to-be-serialized program with the cache
+        # off, exactly like InferenceEngine.compile_fallback. The
+        # grad-norm callback is suppressed STEP-LOCALLY (inner._obs),
+        # never via the process-global telemetry switch — other
+        # threads' spans/metrics keep flowing during the compile.
+        from ....inference.aot.engine import _no_persistent_cache
+        prev_obs = inner._obs
+        inner._obs = None
+        try:
+            ser_run = inner._build(inner._batch_shardings(arrays))
+            args = _dist_example_args(inner, arrays)
+            with _no_persistent_cache(), mesh_scope(inner._mesh):
+                compiled = ser_run._jitted.lower(*args).compile()
+        finally:
+            inner._obs = prev_obs
+        bundle = EngineBundle.create(
+            path, hybrid_model_hash(inner._model, plan),
+            geometry={"kind": "hybrid_train_step",
+                      "mesh_topology": plan.topology(),
+                      "plan": plan.fingerprint(),
+                      "n_devices": int(inner._mesh.devices.size),
+                      "batch_sig": repr(sig)})
+        bundle.add_artifact(("train_step", plan.topology(), repr(sig)),
+                            compiled)
+        return bundle.manifest(refresh=True)
+
+
+def load_step_bundle(step, path: str, *batch):
+    """Warm-start the step from a bundle: validate runtime + model +
+    TOPOLOGY fingerprints, deserialize the executable, and install it
+    as the compiled fn for ``batch``'s signature (no trace, no
+    compile). Raises :class:`BundleInvalid` (reason ``topology`` /
+    ``fingerprint`` / ``model`` / ``digest``) on any mismatch —
+    counted in ``aot.invalidations`` like serving bundles."""
+    inner = _dist_inner(step)
+    plan = step.plan
+    arrays = _coerce_arrays(batch)
+    sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+    bundle = EngineBundle(path)
+    try:
+        m = bundle.validate()
+        geo = m.get("geometry") or {}
+        if geo.get("mesh_topology") != plan.topology() \
+                or (geo.get("plan") or {}) != plan.fingerprint():
+            raise BundleInvalid(
+                "topology", f"bundle partitioned for "
+                f"{geo.get('mesh_topology')!r} "
+                f"(plan {geo.get('plan')}), this step runs "
+                f"{plan.topology()!r} ({plan.fingerprint()})")
+        if m.get("model") != hybrid_model_hash(inner._model, plan):
+            raise BundleInvalid("model", "model/plan hash mismatch")
+        key = repr(("train_step", plan.topology(), repr(sig)))
+        fn = bundle.load_artifact(key)
+        if fn is None:
+            raise BundleInvalid(
+                "digest", f"no artifact for signature {sig}")
+    except BundleInvalid as e:
+        if _obs_enabled():
+            _obsm.counter("aot.invalidations").inc(
+                reason=e.reason, tier="train_step")
+        raise
+    mesh_ = inner._mesh
+
+    def run(*args):
+        with mesh_scope(mesh_):
+            return fn(*args)
+    run._jitted = None   # AOT-loaded: no lowering available
+    inner._compiled[sig] = run
+    if _obs_enabled():
+        _obsm.counter("aot.bundle_hits").inc(kind="hybrid_train_step")
+    return bundle.manifest()

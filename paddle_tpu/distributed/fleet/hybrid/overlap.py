@@ -1,0 +1,86 @@
+"""Compute/communication overlap building blocks (T3,
+arXiv:2401.16677): gradient collectives issued PER BUCKET, in
+production order, so the compiler can run bucket k's collective while
+bucket k+1's gradients are still being produced — instead of one
+monolithic barrier after the whole backward.
+
+Two execution regimes share these helpers:
+
+- **GSPMD (DistTrainStep)**: the fused update already consumes flat
+  buckets through independent dataflow chains — each bucket's
+  reduce-scatter depends only on its own grads, which is exactly the
+  structural freedom XLA's latency-hiding scheduler needs. Nothing to
+  call here; the per-bucket accounting in dist_step's analytic
+  ``comm.*`` entries is the measurement.
+- **Manual SPMD (shard_map regions — the explicit 1F1B schedule, ring
+  tests, future real-TPU paths)**: collectives are explicit calls on
+  the :mod:`paddle_tpu.distributed.collective` facade. These helpers
+  issue them bucket-by-bucket with the int8 error-feedback variants
+  folded in, and every call leaves its own ``comm.calls``/
+  ``comm.bytes`` sample and instant span — the per-bucket span
+  waterfall IS the overlap evidence (docs/TRAINING.md).
+
+All functions are trace-safe and identity outside an SPMD region, like
+the facade they wrap.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ....tensor import Tensor
+from ...collective import (all_gather_concat, all_reduce, reduce_scatter,
+                           quantized_all_reduce, ReduceOp)
+
+__all__ = ["overlapped_all_reduce", "overlapped_reduce_scatter",
+           "prefetch_all_gather"]
+
+
+def _raw(v):
+    """Helpers return RAW jax arrays (the norm inside manual shard_map
+    regions) regardless of the facade's Tensor wrapping."""
+    return v._value if isinstance(v, Tensor) else v
+
+
+def overlapped_all_reduce(flats: Sequence, *, group=None,
+                          op=ReduceOp.SUM, quantized: bool = False,
+                          residuals: Optional[Sequence] = None
+                          ) -> Tuple[List, List]:
+    """All-reduce each flat bucket as a SEPARATE collective, in order.
+    With ``quantized=True`` each bucket goes through the int8
+    error-feedback all-reduce (``residuals``: previous-step feedback
+    buffers, one per bucket; new residuals returned). Returns
+    ``(reduced, new_residuals)``."""
+    out, new_res = [], []
+    for i, f in enumerate(flats):
+        if quantized:
+            r = residuals[i] if residuals is not None else None
+            if r is None:
+                import jax.numpy as jnp
+                r = jnp.zeros_like(f)
+            o, nr = quantized_all_reduce(f, group=group, op=op,
+                                         residual=r)
+            new_res.append(_raw(nr))
+        else:
+            o = all_reduce(f, op=op, group=group)
+        out.append(_raw(o))
+    return out, new_res
+
+
+def overlapped_reduce_scatter(flats: Sequence, *, group=None,
+                              op=ReduceOp.SUM) -> List:
+    """Reduce-scatter each flat bucket separately: each rank keeps its
+    1/world shard (ZeRO-2's wire pattern — the bucket must be padded
+    to the axis size, ``GradBucketer(pad_multiple=world)``). Launched
+    per bucket as grads are produced, the scatter of bucket k overlaps
+    the backward of bucket k+1."""
+    return [_raw(reduce_scatter(f, f, op=op, group=group))
+            for f in flats]
+
+
+def prefetch_all_gather(shards: Sequence, *, group=None) -> List:
+    """The ZeRO-3 gather half: all-gather each parameter-bucket shard
+    as a separate collective so the gather of layer k+1's bucket can
+    run under layer k's compute (the T3 prefetch). Inverse of
+    :func:`overlapped_reduce_scatter` bucket-for-bucket."""
+    return [_raw(all_gather_concat(s, group=group, axis=0))
+            for s in shards]

@@ -332,6 +332,216 @@ def _ring_order(S: int, V: int):
 
 
 # ---------------------------------------------------------------------------
+# the explicit 1F1B schedule (in-schedule backward)
+# ---------------------------------------------------------------------------
+
+def one_f_one_b_ticks(num_stages: int, num_microbatches: int) -> int:
+    """Tick count of the explicit 1F1B clock: T = M + 2(S-1). Each tick
+    every stage runs (at most) one forward AND one backward, so the
+    steady state is exactly 1F1B; the 2(S-1) extra ticks are the
+    fill+drain bubble."""
+    return int(num_microbatches) + 2 * (int(num_stages) - 1)
+
+
+def one_f_one_b_bubble_fraction(num_stages: int,
+                                num_microbatches: int) -> float:
+    """Analytic bubble fraction of the explicit schedule: the share of
+    tick-slots a stage spends idle, 2(S-1) / (M + 2(S-1)). Emitted as
+    ``train.pp.bubble_fraction`` and asserted from telemetry by
+    tests/test_hybrid.py."""
+    T = one_f_one_b_ticks(num_stages, num_microbatches)
+    return (2 * (int(num_stages) - 1)) / float(T) if T else 0.0
+
+
+def pipeline_1f1b(body_fn: Callable, stacked_params, x_micro,
+                  head_fn: Callable, head_args, post_params, *,
+                  num_stages: int, mesh: Mesh, rng_key=None,
+                  head_key=None, axis: str = "stage"):
+    """Explicit 1F1B: forward AND backward interleave inside ONE scanned
+    schedule, with the backward pass computed in-schedule via ``jax.vjp``
+    (NOT by differentiating through the scan — this function returns the
+    gradients itself).
+
+    The reference's rank-local 1F1B interpreter
+    (fleet/meta_parallel/pipeline_parallel.py _forward_step/
+    _backward_step over p2p) maps onto a single-controller clock:
+
+    - tick ``t``, stage ``s`` runs the FORWARD of microbatch
+      ``m_f = t - s`` (the GPipe wavefront) and the BACKWARD of
+      ``m_b = t - 2(S-1) + s`` (the reverse wavefront) — at the last
+      stage ``m_f == m_b``: a microbatch's loss gradient is computed
+      the same tick its forward completes, the defining 1F1B handoff.
+    - activations ride the forward ``ppermute`` ring, cotangents ride
+      the inverse ring; a per-stage stash of ``min(M, 2S-1)`` boundary
+      inputs (the 1F1B in-flight bound) feeds each backward, which
+      REcomputes its stage body under ``jax.vjp`` (activation memory
+      stays at boundaries only, like the remat scan).
+    - the loss head (postamble + loss_fn) runs masked at the last
+      stage per completing microbatch; its vjp yields both the
+      cotangent entering the backward ring and the postamble param
+      grads. Cotangent seed is 1/M: the step loss is the microbatch
+      MEAN, matching the GPipe path's full-batch mean loss for
+      batch-mean loss_fns.
+
+    body_fn(p_one_stage, x, key) -> y with y.shape == x.shape.
+    head_fn(post_params, y, head_args_slice, key) -> scalar loss.
+    head_args: pytree with leading [M] dim (per-microbatch labels).
+    Returns (losses [M], out [M, Bm, ...], dx_micro [M, Bm, ...],
+    grad_stacked (tree like stacked_params), grad_post (tree like
+    post_params)).
+    """
+    S = int(num_stages)
+    M = int(x_micro.shape[0])
+    inv_m = jnp.asarray(1.0 / M, jnp.float32)
+    if rng_key is None:
+        rng_key = jax.random.key(0)
+    if head_key is None:
+        head_key = jax.random.key(1)
+
+    if S == 1:
+        # degenerate pipeline: 1F1B == the naive per-microbatch loop
+        p0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        losses, outs, dxs = [], [], []
+        g_stk = jax.tree_util.tree_map(jnp.zeros_like, p0)
+        g_post = jax.tree_util.tree_map(jnp.zeros_like, list(post_params))
+        for m in range(M):
+            km = jax.random.fold_in(rng_key, m)
+            y, vjp_b = jax.vjp(lambda p, xx: body_fn(p, xx, km),
+                               p0, x_micro[m])
+            lbl = jax.tree_util.tree_map(lambda a: a[m], head_args)
+            kh = jax.random.fold_in(head_key, m)
+            loss_m, vjp_h = jax.vjp(
+                lambda pv, yv: head_fn(pv, yv, lbl, kh),
+                list(post_params), y)
+            gp_m, gy = vjp_h(inv_m.astype(loss_m.dtype))
+            dp, dx = vjp_b(gy)
+            g_stk = jax.tree_util.tree_map(jnp.add, g_stk, dp)
+            g_post = jax.tree_util.tree_map(jnp.add, g_post, gp_m)
+            losses.append(loss_m)
+            outs.append(y)
+            dxs.append(dx)
+        return (jnp.stack(losses), jnp.stack(outs), jnp.stack(dxs),
+                jax.tree_util.tree_map(lambda a: a[None], g_stk),
+                g_post)
+
+    T = one_f_one_b_ticks(S, M)
+    K = min(M, 2 * S - 1)   # stash slots: the 1F1B in-flight bound
+    perm_f = [(i, (i + 1) % S) for i in range(S)]
+    perm_b = [(i, (i - 1) % S) for i in range(S)]
+    vary = (axis,)
+
+    def staged(p_local, xm, hargs, post_v, keys):
+        k_body, k_head = keys
+        sid = jax.lax.axis_index(axis)
+        p_mine = jax.tree_util.tree_map(lambda a: a[0], p_local)
+        xshape = tuple(xm.shape[1:])
+        act0 = _varying(vary, jnp.zeros(xshape, xm.dtype))
+        gin0 = _varying(vary, jnp.zeros(xshape, xm.dtype))
+        stash0 = _varying(vary, jnp.zeros((K,) + xshape, xm.dtype))
+        gacc0 = jax.tree_util.tree_map(
+            lambda a: _varying(vary, jnp.zeros_like(a)), p_mine)
+        pacc0 = jax.tree_util.tree_map(
+            lambda a: _varying(vary, jnp.zeros_like(a)), list(post_v))
+        loss0 = _varying(vary, jnp.zeros((M,), jnp.float32))
+        out0 = _varying(vary, jnp.zeros((M,) + xshape, xm.dtype))
+        dx0 = _varying(vary, jnp.zeros((M,) + xshape, xm.dtype))
+
+        def tick_1f1b(carry, t):
+            act, gin, stash, gacc, pacc, lbuf, obuf, dxbuf = carry
+            # ---- forward wavefront: microbatch t - s ----------------
+            m_f = t - sid
+            valid_f = jnp.logical_and(m_f >= 0, m_f < M)
+            mf_c = jnp.clip(m_f, 0, M - 1)
+            x_in = jnp.where(sid == 0, xm[mf_c], act)
+            k_f = jax.random.fold_in(jax.random.fold_in(k_body, mf_c),
+                                     sid)
+            out = body_fn(p_mine, x_in, k_f)
+            # stash the boundary INPUT for this microbatch's backward
+            # (write before the backward read: at the last stage the
+            # same microbatch's backward runs THIS tick)
+            slot_f = jnp.mod(mf_c, K)
+            cur = jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
+                                               keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_f, x_in, cur), slot_f, 0)
+            # ---- loss head at the last stage ------------------------
+            lbl = jax.tree_util.tree_map(lambda a: a[mf_c], hargs)
+            k_h = jax.random.fold_in(k_head, mf_c)
+            loss_m, vjp_h = jax.vjp(
+                lambda pv, yv: head_fn(pv, yv, lbl, k_h),
+                list(post_v), out)
+            gp_m, g_out = vjp_h(inv_m.astype(loss_m.dtype))
+            last = sid == S - 1
+            take_h = jnp.logical_and(last, valid_f)
+            pacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(take_h, g, jnp.zeros_like(g)),
+                pacc, gp_m)
+            curl = jax.lax.dynamic_index_in_dim(lbuf, mf_c, 0,
+                                                keepdims=False)
+            lbuf = jax.lax.dynamic_update_index_in_dim(
+                lbuf, jnp.where(take_h, loss_m.astype(jnp.float32),
+                                curl), mf_c, 0)
+            curo = jax.lax.dynamic_index_in_dim(obuf, mf_c, 0,
+                                                keepdims=False)
+            obuf = jax.lax.dynamic_update_index_in_dim(
+                obuf, jnp.where(take_h, out, curo), mf_c, 0)
+            # ---- backward wavefront: microbatch t - 2(S-1) + s ------
+            m_b = t - 2 * (S - 1) + sid
+            valid_b = jnp.logical_and(m_b >= 0, m_b < M)
+            mb_c = jnp.clip(m_b, 0, M - 1)
+            slot_b = jnp.mod(mb_c, K)
+            x_b = jax.lax.dynamic_index_in_dim(stash, slot_b, 0,
+                                               keepdims=False)
+            k_b = jax.random.fold_in(jax.random.fold_in(k_body, mb_c),
+                                     sid)
+            g_in = jnp.where(last, g_out, gin)
+            _, vjp_b = jax.vjp(lambda p, xx: body_fn(p, xx, k_b),
+                               p_mine, x_b)
+            dp, dx = vjp_b(g_in)
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(valid_b, g, jnp.zeros_like(g)),
+                gacc, dp)
+            take_dx = jnp.logical_and(sid == 0, valid_b)
+            curdx = jax.lax.dynamic_index_in_dim(dxbuf, mb_c, 0,
+                                                 keepdims=False)
+            dxbuf = jax.lax.dynamic_update_index_in_dim(
+                dxbuf, jnp.where(take_dx, dx, curdx), mb_c, 0)
+            # ---- the two rings --------------------------------------
+            act = jax.lax.ppermute(out, axis, perm_f)
+            gin = jax.lax.ppermute(dx, axis, perm_b)
+            return (act, gin, stash, gacc, pacc, lbuf, obuf, dxbuf), None
+
+        carry0 = (act0, gin0, stash0, gacc0, pacc0, loss0, out0, dx0)
+        (_, _, _, gacc, pacc, lbuf, obuf, dxbuf), _ = jax.lax.scan(
+            tick_1f1b, carry0, jnp.arange(T))
+        return (lbuf[None], obuf[None], dxbuf[None],
+                jax.tree_util.tree_map(lambda a: a[None], gacc),
+                jax.tree_util.tree_map(lambda a: a[None], pacc))
+
+    from ....framework.jax_compat import shard_map as _shard_map_compat
+    run = _shard_map_compat(
+        staged, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis),
+                                         stacked_params),
+                  P(), P(), P(), P()),
+        out_specs=(P(axis), P(axis), P(axis),
+                   jax.tree_util.tree_map(lambda _: P(axis),
+                                          stacked_params),
+                   jax.tree_util.tree_map(lambda _: P(axis),
+                                          list(post_params))),
+        axis_names={axis}, check_vma=True)
+    lbuf, obuf, dxbuf, g_stk, g_post = run(
+        stacked_params, x_micro, head_args, list(post_params),
+        (rng_key, head_key))
+    # stage-stacked selection: loss/out are authoritative at the LAST
+    # stage, dx_micro at stage 0; each stage's grad slice concatenates
+    # into exactly the stacked-param gradient; post grads accumulated
+    # at the last stage
+    return (lbuf[-1], obuf[-1], dxbuf[0], g_stk,
+            jax.tree_util.tree_map(lambda a: a[-1], g_post))
+
+
+# ---------------------------------------------------------------------------
 # the user-facing compiled train step
 # ---------------------------------------------------------------------------
 
@@ -372,16 +582,24 @@ class PipelineTrainStep:
         #               live (the reference's F-then-B memory profile)
         # Explicitly passed use_remat/num_virtual_stages that CONFLICT
         # with the named mode raise rather than being silently reset.
+        self._explicit = False
         if schedule_mode is not None:
             mode = schedule_mode.replace("-", "").replace("_", "").lower()
+            # "1F1B-explicit" is the REAL interleaved schedule
+            # (pipeline_1f1b: backward computed in-schedule, cotangents
+            # on the inverse ppermute ring); plain "1F1B" keeps the
+            # remat-scan configuration whose per-stage memory BOUND
+            # matches 1F1B (test_pp_memory.py pins that contract)
             want = {"1f1b": (True, 1),
+                    "1f1bexplicit": (True, 1),
                     "vpp": (True, num_virtual_stages
                             if (num_virtual_stages or 0) > 1 else 2),
                     "fthenb": (False, num_virtual_stages or 1)}.get(mode)
             if want is None:
                 raise ValueError(
                     f"unknown schedule_mode {schedule_mode!r}; expected "
-                    "'1F1B', 'VPP' or 'F-then-B'")
+                    "'1F1B', '1F1B-explicit', 'VPP' or 'F-then-B'")
+            self._explicit = mode == "1f1bexplicit"
             for name, given, w in (("use_remat", use_remat, want[0]),
                                    ("num_virtual_stages",
                                     num_virtual_stages, want[1])):
@@ -478,6 +696,29 @@ class PipelineTrainStep:
                 self._post_named.append((n, p))
         self._pre_p = [p for _, p in self._pre_named]
         self._post_p = [p for _, p in self._post_named]
+        if self._explicit:
+            if self._V != 1:
+                raise ValueError(
+                    "1F1B-explicit runs V=1 (virtual stages belong to "
+                    "the interleaved VPP schedule)")
+            if self._scaler is not None:
+                raise NotImplementedError(
+                    "1F1B-explicit does not compose with GradScaler "
+                    "yet; use schedule_mode='1F1B' (remat scan) for "
+                    "scaled training")
+            if self._shared_post:
+                raise NotImplementedError(
+                    "1F1B-explicit does not support parameters shared "
+                    "between pre and post (tied embeddings): the loss "
+                    "head's vjp runs inside the schedule, where the "
+                    "pre-side traced value is out of scope — use "
+                    "schedule_mode='1F1B' (remat scan) for tied-"
+                    "embedding models, or untie the lm head")
+            if _named_buffers(self._post):
+                raise ValueError(
+                    "1F1B-explicit requires a buffer-free postamble "
+                    "(the loss head replays per microbatch inside the "
+                    "schedule)")
 
         def _edge_sh(named):
             psh, zsh = [], []
@@ -537,12 +778,20 @@ class PipelineTrainStep:
         self._obs = None
         if _obs_enabled():
             S, V, M = self._S, self._V, self._M
-            if V > 1:
+            if self._explicit:
+                ticks = one_f_one_b_ticks(S, M)
+            elif V > 1:
                 W = S * V
                 ticks = ((M - 1) // S) * W + ((M - 1) % S) + S * V
             else:
                 ticks = (M + S - 1) if S > 1 else M
             self._obs_ticks = int(ticks)
+            if self._explicit:
+                # analytic fill+drain share of the explicit schedule —
+                # asserted from the JSONL sink by tests/test_hybrid.py
+                _obs_gauge("train.pp.bubble_fraction").set(
+                    one_f_one_b_bubble_fraction(S, M),
+                    schedule="1F1B-explicit")
             n_params = sum(
                 int(np.prod(p._value.shape))
                 for _, p in (self._pre_named + self._post_named)) + sum(
@@ -664,6 +913,21 @@ class PipelineTrainStep:
                 placed.append(st)
                 self._s_sh.append(repl)
         self._opt_state = placed
+        # mem.params_bytes{scope}: stage-stacked leaves divide by the
+        # 'stage' axis (each device holds its chunk) and any ZeRO-3
+        # 'data' sharding on top (same helper as dist_step). Computed
+        # always (footprint() consumers); gauges gated on telemetry
+        from ....observability.train_metrics import sharded_bytes
+        tot, per = sharded_bytes(
+            self._stacked + [p._value for p in self._pre_p]
+            + [p._value for p in self._post_p])
+        self._params_bytes = {"global": tot, "per_replica": per}
+        if _obs_enabled():
+            g = _obs_gauge("mem.params_bytes", unit="bytes",
+                           help="parameter footprint from placed "
+                                "shardings")
+            g.set(tot, scope="global")
+            g.set(per, scope="per_replica")
         self._stale = False
         self._dirty = False
 
@@ -684,6 +948,8 @@ class PipelineTrainStep:
         return body
 
     def _build(self, sig):
+        if self._explicit:
+            return self._build_explicit(sig)
         S, M = self._S, self._M
         V = self._V
         mesh = self._mesh
@@ -795,6 +1061,95 @@ class PipelineTrainStep:
             with mesh_scope(mesh), x64_safe_shard_map_trace():
                 return jitted(*args)
         run._jitted = jitted  # exposed for memory_analysis (no execute)
+        return run
+
+    def _build_explicit(self, sig):
+        """Compiled step around the EXPLICIT 1F1B schedule: preamble
+        runs once full-batch under jax.vjp, the schedule interleaves
+        per-microbatch forward/backward (loss head included) and
+        returns the gradients itself, the preamble vjp closes the
+        chain. Numerically the microbatch-mean loss — identical to the
+        GPipe path for batch-mean loss_fns."""
+        S, M = self._S, self._M
+        mesh = self._mesh
+        loss_fn = self._loss_fn
+        opt = self._opt
+        grad_clip = opt._grad_clip
+        body = self._body_fn()
+        pre_layers, post_layers = self._pre, self._post
+        pre_p_t, post_p_t = self._pre_p, self._post_p
+        edge_b_t = self._edge_b
+        n_pre = len(self._pre_p)
+        n_stk = len(self._stacked)
+        p_names = self._p_names
+        seed_params = self._seed_params
+        obs = self._obs if _obs_enabled() else None
+
+        def step_fn(pre_v, stk_v, post_v, eb_v, opt_state, key, lr,
+                    batch, scaler_st):
+            x, labels = batch[0], batch[1:]
+            k_pre, k_body, k_head = jax.random.split(key, 3)
+
+            def pre_fn(pv):
+                h, new_b = _run_layers(pre_layers, pre_p_t, pv,
+                                       edge_b_t, eb_v, x, rng_key=k_pre)
+                return h, new_b
+
+            h, vjp_pre, new_eb = jax.vjp(pre_fn, list(pre_v),
+                                         has_aux=True)
+            B = h.shape[0]
+            hm = h.reshape((M, B // M) + tuple(h.shape[1:]))
+            lbl_m = [l.reshape((M, B // M) + tuple(l.shape[1:]))
+                     for l in labels]
+
+            def head_fn(pv, y, lbl, kk):
+                out2, _ = _run_layers(post_layers, post_p_t, pv, [], [],
+                                      y, rng_key=kk)
+                loss = loss_fn(Tensor(out2), *[Tensor(z) for z in lbl])
+                return loss._value if isinstance(loss, Tensor) else loss
+
+            losses, _out, g_h, g_stk, g_post = pipeline_1f1b(
+                body, list(stk_v), hm, head_fn, lbl_m, list(post_v),
+                num_stages=S, mesh=mesh, rng_key=k_body, head_key=k_head)
+            loss_val = jnp.mean(losses)
+            (g_pre,) = vjp_pre(g_h.reshape(h.shape))
+            flat_g = list(g_pre) + list(g_stk) + list(g_post)
+            flat_p = list(pre_v) + list(stk_v) + list(post_v)
+            if obs is not None:
+                obs.grad_norm_callback(flat_g)  # async host record
+            flat_g = _clip_grads_functional(flat_g, grad_clip)
+            new_p, new_state = opt._fn_apply_all(
+                flat_p, flat_g, opt_state, lr, p_names, seed_params)
+            return (loss_val, new_p[:n_pre], new_p[n_pre:n_pre + n_stk],
+                    new_p[n_pre + n_stk:], new_eb, new_state, scaler_st)
+
+        repl = NamedSharding(mesh, P())
+        donate = (0, 1, 2, 3, 4) if self._donate else ()
+        pre_sh = list(self._pre_sh)
+        post_sh = list(self._post_sh)
+        eb_sh = [repl] * len(self._edge_b)
+        dsize = mesh.shape.get("data", 1)
+        batch_sh = []
+        for shape, _ in sig:
+            spec = [None] * len(shape)
+            if shape and dsize > 1 and shape[0] % (dsize * self._M) == 0:
+                spec[0] = "data"
+            batch_sh.append(NamedSharding(mesh, P(*spec)))
+        jitted = jax.jit(
+            step_fn,
+            in_shardings=(pre_sh, self._stacked_sh, post_sh, eb_sh,
+                          self._s_sh, None, None, batch_sh, None),
+            out_shardings=(repl, pre_sh, self._stacked_sh, post_sh,
+                           eb_sh, self._s_sh, None),
+            donate_argnums=donate)
+
+        def run(*args):
+            from ....framework.jax_compat import (x64_safe_shard_map_trace,
+                                                  narrow_x64_leaves)
+            args = narrow_x64_leaves(args)
+            with mesh_scope(mesh), x64_safe_shard_map_trace():
+                return jitted(*args)
+        run._jitted = jitted
         return run
 
     def _ensure_compiled(self, batch):

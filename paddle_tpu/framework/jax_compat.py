@@ -12,6 +12,17 @@ from __future__ import annotations
 import jax
 
 
+class ShardMapUnsupported(NotImplementedError):
+    """The requested shard_map lowering does not exist on this jax
+    release. Raised ONLY by :func:`shard_map` for the partial-manual
+    case (manual over a subset of the >1-sized mesh axes) on jax
+    without the top-level ``jax.shard_map``. Callers/tests that want
+    to degrade gracefully must catch exactly this type — catching bare
+    ``NotImplementedError`` would also swallow unrelated missing
+    features and mask real regressions (tests/test_pipeline.py
+    ``_partial_manual_or_skip``)."""
+
+
 def _modern_shard_map():
     """jax >= 0.8 top-level alias, or None on older releases."""
     sm = getattr(jax, "shard_map", None)
@@ -52,7 +63,7 @@ def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
         # scan+ppermute schedules); fail like an ordinary python error
         # so callers/tests see a diagnosable exception instead of a
         # crashed interpreter
-        raise NotImplementedError(
+        raise ShardMapUnsupported(
             "partial-manual shard_map (manual "
             f"{sorted(manual)} / auto {sorted(auto)}) is unsupported on "
             "this jax: use jax >= 0.8 (jax.shard_map), or keep the "

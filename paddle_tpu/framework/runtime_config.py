@@ -97,6 +97,13 @@ class RuntimeConfig:
     # -- training comm ---------------------------------------------------
     grad_bucket_bytes: int = 32 * 1024 * 1024
     quantized_grad_comm: bool = False
+    # ZeRO sharding stage for DistTrainStep when the caller does not pin
+    # sharding_stage explicitly: 0 = plain DP, 1 = opt-state sharding
+    # (weight-update sharding), 2 = + persistent grad shards, 3 = params
+    # sharded (FSDP). Runtime-only: training-step bundles record it in
+    # their own topology fingerprint (hybrid/aot.py), so it does not
+    # join COMPILED_FIELDS and never invalidates a SERVING bundle.
+    zero_stage: int = 0
 
     def __post_init__(self):
         if self.version != CONFIG_VERSION:
@@ -110,6 +117,9 @@ class RuntimeConfig:
         if self.page_size <= 0 or self.max_batch_size <= 0 \
                 or self.max_seq_len <= 0:
             raise ValueError("geometry fields must be positive")
+        if self.zero_stage not in (0, 1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 0..3, got {self.zero_stage!r}")
         # normalize buckets: sorted unique ints (hash stability)
         object.__setattr__(
             self, "prompt_buckets",

@@ -34,7 +34,37 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .metrics import counter, enabled, gauge, histogram
 from .runtime import device_memory_stats, jit_callback, maybe_export
 
-__all__ = ["StepTelemetry", "peak_flops", "batch_tokens"]
+__all__ = ["StepTelemetry", "peak_flops", "batch_tokens",
+           "sharded_bytes"]
+
+
+def sharded_bytes(leaves):
+    """(global_bytes, per_replica_bytes) for a list of PLACED jax
+    arrays: global is the full logical footprint, per_replica divides
+    each leaf by the product of the mesh-axis sizes its NamedSharding
+    spec names (the analytic per-device share — what ZeRO/TP sharding
+    buys). Leaves without a NamedSharding count replicated."""
+    import numpy as np
+    tot = per = 0
+    for v in leaves:
+        shape = getattr(v, "shape", None)
+        if shape is None:
+            continue
+        nb = int(np.prod(shape or (1,))) * np.dtype(v.dtype).itemsize
+        tot += nb
+        div = 1
+        sh = getattr(v, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        mesh = getattr(sh, "mesh", None)
+        if spec is not None and mesh is not None:
+            sizes = dict(getattr(mesh, "shape", {}) or {})
+            for ax in spec:
+                axes = ax if isinstance(ax, (tuple, list)) else (ax,)
+                for a in axes:
+                    if a is not None:
+                        div *= int(sizes.get(a, 1))
+        per += nb // max(div, 1)
+    return tot, per
 
 
 def peak_flops(dtype: str = "bfloat16") -> float:

@@ -109,5 +109,9 @@ def test_every_guard_is_abstract_or_guidance():
         f"(add 'use X instead' guidance): {bad}")
     # burn-down pin: adding a new option guard must be a conscious
     # decision — bump ONLY with a guidance message and a matching test
-    assert len(guidance) < 15, (
-        f"{len(guidance)} guidance guards (pin is <15): {guidance}")
+    # (PR 12 added 6: ZeRO-2 accum x scaler, 1F1B-explicit scaler/tied,
+    # hybrid engine accum-under-pp, hybrid AOT pipeline/accum bundles —
+    # each exercised by tests/test_hybrid.py::TestGuardedLimits and
+    # TestZeroStages/TestExplicit1F1B)
+    assert len(guidance) < 21, (
+        f"{len(guidance)} guidance guards (pin is <21): {guidance}")

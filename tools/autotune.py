@@ -72,6 +72,7 @@ CONFIG_DEFAULTS: Dict = {
     "wfs_quantum": 64.0,
     "grad_bucket_bytes": 32 * 1024 * 1024,
     "quantized_grad_comm": False,
+    "zero_stage": 0,
 }
 
 # minimum samples before a distribution-shaped proposal may fire —
@@ -432,18 +433,40 @@ _GRAD_OPS = ("all_reduce", "reduce_scatter", "all_reduce_q8",
              "reduce_scatter_q8")
 
 
+def _comm_by_axis(rep: Replay, name: str) -> Dict[str, float]:
+    """Sum the grad-op ``comm.*`` series per mesh-axis label (the
+    facade and the analytic step accounting both label every sample
+    with op= and axis=)."""
+    out: Dict[str, float] = {}
+    for (n, labels), v in rep.counters.items():
+        if n != name:
+            continue
+        lab = dict(labels)
+        if lab.get("op") not in _GRAD_OPS:
+            continue
+        ax = lab.get("axis") or "?"
+        out[ax] = out.get(ax, 0.0) + v
+    return out
+
+
 def propose_comm(rep: Replay, base: Dict) -> List[dict]:
     """Gradient-comm knobs from the per-op byte/call accounting the
-    collective facade exports (comm.bytes / comm.calls, PR 1)."""
+    collective facade exports (comm.bytes / comm.calls, PR 1), split
+    PER MESH AXIS: gradient reduction rides 'data', so the bucket-size
+    target is computed from the data-axis traffic alone — on a hybrid
+    mesh the model-axis activation all-reduces would otherwise inflate
+    the target (they are not bucketed, their size is set by the layer
+    widths, not by grad_bucket_bytes)."""
     steps = rep.counter_total("train.steps")
-    grad_bytes = sum(rep.counter_total("comm.bytes", op=op)
-                     for op in _GRAD_OPS)
-    grad_calls = sum(rep.counter_total("comm.calls", op=op)
-                     for op in _GRAD_OPS)
+    ax_bytes = _comm_by_axis(rep, "comm.bytes")
+    ax_calls = _comm_by_axis(rep, "comm.calls")
+    grad_bytes = ax_bytes.get("data", sum(ax_bytes.values()))
+    grad_calls = ax_calls.get("data", sum(ax_calls.values()))
     if steps <= 0 or grad_bytes <= 0 or grad_calls <= 0:
         return []
     out = []
     window = rep.window_s()
+    per_axis = {ax: int(v / steps) for ax, v in sorted(ax_bytes.items())}
     bytes_per_step = grad_bytes / steps
     calls_per_step = grad_calls / steps
     cur = int(base.get("grad_bucket_bytes") or (32 << 20))
@@ -455,23 +478,69 @@ def propose_comm(rep: Replay, base: Dict) -> List[dict]:
     if not (0.5 <= proposed / cur <= 2.0):
         out.append(_proposal(
             "grad_bucket_bytes", cur, proposed,
-            "bucket the measured per-step gradient payload into ~8 "
-            "collectives: enough pipelining for comm/compute overlap, "
-            "few enough launches to amortize latency",
+            "bucket the measured per-step data-axis gradient payload "
+            "into ~8 collectives: enough pipelining for comm/compute "
+            "overlap, few enough launches to amortize latency",
             series="comm.bytes", n=int(grad_calls), window_s=window,
-            value=int(bytes_per_step), steps=int(steps),
+            value=int(bytes_per_step), steps=int(steps), axis="data",
+            per_axis_bytes_per_step=per_axis,
             calls_per_step=round(calls_per_step, 2)))
     if bytes_per_step > (64 << 20) and not base.get(
             "quantized_grad_comm"):
         out.append(_proposal(
             "quantized_grad_comm", False, True,
-            "gradient traffic dominates the step (>64MiB/step on the "
-            "wire): int8 error-feedback collectives cut it ~4x for "
-            "bounded, feedback-corrected noise (EQuARX, arXiv:"
-            "2506.17615)",
+            "data-axis gradient traffic dominates the step "
+            "(>64MiB/step on the wire): int8 error-feedback "
+            "collectives cut it ~4x for bounded, feedback-corrected "
+            "noise (EQuARX, arXiv:2506.17615)",
             series="comm.bytes", n=int(grad_calls), window_s=window,
-            value=int(bytes_per_step), threshold=64 << 20))
+            value=int(bytes_per_step), threshold=64 << 20,
+            axis="data", per_axis_bytes_per_step=per_axis))
     return out
+
+
+# memory-pressure thresholds for the zero_stage proposal: below these
+# the sharding's extra collectives buy nothing worth their latency
+_ZERO1_OPT_BYTES = 64 << 20
+_ZERO3_PARAM_BYTES = 256 << 20
+
+
+def propose_zero(rep: Replay, base: Dict) -> List[dict]:
+    """ZeRO stage from the footprint gauges the train steps export
+    (``mem.opt_state_bytes{scope}`` / ``mem.params_bytes{scope}``):
+    unsharded optimizer state under pressure → stage 1 (weight-update
+    sharding divides it by the data-axis size); a per-replica param
+    footprint still past the threshold after that → stage 3."""
+    steps = rep.counter_total("train.steps")
+    if steps <= 0:
+        return []
+    opt_g = rep.counter_total("mem.opt_state_bytes", scope="global")
+    opt_r = rep.counter_total("mem.opt_state_bytes", scope="per_replica")
+    par_r = rep.counter_total("mem.params_bytes", scope="per_replica")
+    cur = int(base.get("zero_stage") or 0)
+    window = rep.window_s()
+    if cur == 0 and opt_g > _ZERO1_OPT_BYTES and opt_r >= opt_g:
+        return [_proposal(
+            "zero_stage", cur, 1,
+            "optimizer state dominates replica memory and is "
+            "unsharded (per_replica == global): ZeRO-1 weight-update "
+            "sharding divides it by the data-axis size for one "
+            "reduce-scatter + all-gather per grad bucket "
+            "(arXiv:2004.13336)",
+            series="mem.opt_state_bytes", n=int(steps),
+            window_s=window, value=int(opt_g),
+            threshold=_ZERO1_OPT_BYTES, scope="global")]
+    if 0 < cur < 3 and par_r > _ZERO3_PARAM_BYTES:
+        return [_proposal(
+            "zero_stage", cur, 3,
+            "per-replica parameter footprint still exceeds the ZeRO-3 "
+            "threshold after opt-state sharding: shard the params over "
+            "'data' too (GSPMD all-gathers at use, grads "
+            "reduce-scatter)",
+            series="mem.params_bytes", n=int(steps), window_s=window,
+            value=int(par_r), threshold=_ZERO3_PARAM_BYTES,
+            scope="per_replica")]
+    return []
 
 
 # ----------------------------------------------------------------- driver --
@@ -489,6 +558,7 @@ def analyze(paths: List[str], base: Optional[Dict] = None,
     proposals += propose_queue(rep, cfg, slo_ttft_s)
     proposals += propose_quantum(rep, cfg)
     proposals += propose_comm(rep, cfg)
+    proposals += propose_zero(rep, cfg)
     tuned = dict(cfg)
     for p in proposals:
         tuned[p["field"]] = p["proposed"]

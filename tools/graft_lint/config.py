@@ -50,6 +50,20 @@ HOT_PATH_FUNCTIONS = (
     ("paddle_tpu/optimizer/fused.py", "try_fused_step"),
     # hybrid-parallel per-step entry (loss sync is deferred by design)
     ("paddle_tpu/distributed/fleet/dist_step.py", "DistTrainStep.__call__"),
+    # ZeRO-2 micro-step entry: runs once per accumulation micro-batch
+    ("paddle_tpu/distributed/fleet/dist_step.py",
+     "DistTrainStep._call_accum"),
+    # hybrid engine front door: one dispatch per step, zero host syncs
+    ("paddle_tpu/distributed/fleet/hybrid/engine.py",
+     "HybridTrainStep.__call__"),
+    # explicit 1F1B tick loop: traced per schedule tick — a host sync
+    # here would serialize the whole pipeline clock
+    ("paddle_tpu/distributed/fleet/meta_parallel/pipeline_parallel.py",
+     "pipeline_1f1b.staged.tick_1f1b"),
+    # TP layer forwards: traced inside every hybrid step; implicit
+    # tracer bools / host transfers here poison every compile
+    ("paddle_tpu/distributed/fleet/meta_parallel/mp_layers.py",
+     "*.forward"),
 )
 
 

@@ -1422,6 +1422,207 @@ def serve_mt_bench(a):
     return 0
 
 
+def _fleet_smoke(a, plan):
+    """Fleet-observability arm of the hybrid section: a REAL
+    launcher-driven multi-rank run (one worker process per data-axis
+    rank, each driving a dp=2 DistTrainStep over 2 virtual CPU
+    devices) with a `slow_rank` fault injected on one rank, asserted
+    FROM the per-rank JSONL files (docs/OBSERVABILITY.md "Fleet
+    view"):
+
+    1. the straggler rank is identified by the launcher-side
+       persistent-skew detector (`robustness.stragglers_detected`
+       carries its rank label) — and ONLY that rank;
+    2. `fleet.step_skew_seconds` reflects the injected per-step delay;
+    3. comm-wait share is reported per rank in the `{"kind":"fleet"}`
+       step records;
+    4. every telemetry line carries the rank/world_size/topology
+       identity, and each rank file carries its own per-axis
+       `comm.bytes`;
+    5. `tools/fleet_report.py` renders the straggler table from the
+       same files under `python -I` (zero paddle_tpu/jax imports —
+       the import is impossible in isolated mode, so a nonzero rc
+       would fail the check).
+
+    Returns (checks, details).
+    """
+    import tempfile
+    import textwrap
+    import subprocess
+    import paddle_tpu.observability as obs
+    from paddle_tpu.distributed.launch.main import parse_args, launch
+
+    nranks = int(a.fleet_ranks or plan.degrees.get("data", 4))
+    steps = int(a.fleet_steps)
+    sleep_s = float(a.fleet_sleep)
+    straggler = min(2, nranks - 1)
+    out_dir = tempfile.mkdtemp(prefix="fleet_smoke_")
+    log_dir = os.path.join(out_dir, "log")
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(out_dir, "worker.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(f"""
+            import json, os, time
+            hb_path = os.environ.get("PADDLE_RANK_HEARTBEAT")
+
+            def boot_beat(phase):
+                if hb_path:
+                    with open(hb_path, "a") as f:
+                        f.write(json.dumps(
+                            {{"ts": time.time(), "kind": "heartbeat",
+                              "phase": phase, "pid": os.getpid(),
+                              "rank": os.environ.get("RANK", "0")}})
+                            + chr(10))
+
+            boot_beat("boot")
+            import sys
+            sys.path.insert(0, {repo_root!r})
+            # each rank gets its own 2-device virtual mesh (dp=2) so
+            # per-rank comm telemetry is real, not synthesized
+            os.environ["XLA_FLAGS"] = \\
+                "--xla_force_host_platform_device_count=2"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import paddle_tpu as paddle
+            import paddle_tpu.nn.functional as F
+            from paddle_tpu import nn
+            from paddle_tpu.trainer import Trainer, TrainingArguments
+            boot_beat("imports_done")
+            rank = int(os.environ.get("RANK", "0"))
+            if rank == {straggler}:
+                # the straggler: a per-step sleep, NOT a hang — its
+                # heartbeat keeps beating, so only the fleet skew
+                # detector (never the stale-heartbeat detector) can
+                # see it
+                paddle.set_flags({{"fault_injection":
+                    "slow_rank:times=0:sleep={sleep_s}:"
+                    "rank={straggler}"}})
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(),
+                                  nn.Linear(32, 4))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=model.parameters())
+            boot_beat("model_built")
+
+            def data_fn(start):
+                def gen():
+                    s = start
+                    while True:
+                        rs = np.random.RandomState(s)
+                        yield (paddle.to_tensor(
+                                   rs.randn(16, 8).astype(np.float32)),
+                               paddle.to_tensor(
+                                   rs.randn(16, 4).astype(np.float32)))
+                        s += 1
+                return gen()
+
+            args = TrainingArguments(
+                output_dir=os.path.join({out_dir!r}, "rank%d" % rank),
+                max_steps={steps}, logging_steps=1, save_steps=1000,
+                dp_degree=2)
+            res = Trainer(model, opt, lambda o, y: F.mse_loss(o, y),
+                          args, data_fn, tokens_per_batch=16
+                          ).train(resume=False)
+            with open(os.path.join({out_dir!r},
+                                   "result_rank%d.json" % rank),
+                      "w") as f:
+                json.dump({{"final_step": res["final_step"]}}, f)
+        """))
+
+    ctx = parse_args(["--nproc_per_node", str(nranks),
+                      "--max_restart", "0",
+                      "--heartbeat_interval", "0.25",
+                      "--straggler_factor", "2.0",
+                      "--straggler_steps", "3",
+                      "--topology", plan.topology(),
+                      "--log_dir", log_dir, script])
+    t0 = time.time()
+    rc = launch(ctx)
+    wall = time.time() - t0
+
+    reg = obs.get_registry()
+    m = reg.get("robustness.stragglers_detected")
+    flagged = {s.labels.get("rank") for s in m.samples()
+               if s.value > 0} if m else set()
+    skew = reg.gauge("fleet.step_skew_seconds").value()
+
+    # --- the same evidence, FROM the JSONL files -----------------------
+    def _lines(path):
+        out = []
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        return out
+
+    fleet_recs = _lines(os.path.join(log_dir, "fleet.jsonl"))
+    step_recs = [r for r in fleet_recs if r.get("event") == "step"]
+    strag_recs = [r for r in fleet_recs
+                  if r.get("event") == "straggler"]
+    max_skew = max((float(r.get("skew_s", 0)) for r in step_recs),
+                   default=0.0)
+    shares_full = [r for r in step_recs
+                   if len(r.get("comm_wait_share") or {}) == nranks]
+    rank_comm_axis = {}
+    ident_ok = bool(step_recs)
+    for k in range(nranks):
+        recs = _lines(os.path.join(log_dir, f"telemetry_rank{k}.jsonl"))
+        rank_comm_axis[k] = sum(
+            r.get("value", 0) for r in recs
+            if r.get("name") == "comm.bytes"
+            and (r.get("labels") or {}).get("axis") == "data")
+        with_ident = [r for r in recs
+                      if r.get("rank") == k
+                      and r.get("world_size") == nranks
+                      and r.get("topology") == plan.topology()]
+        ident_ok = ident_ok and bool(with_ident)
+
+    # --- fleet_report renders the straggler table, zero imports -------
+    rep = subprocess.run(
+        [sys.executable, "-I",
+         os.path.join(repo_root, "tools", "fleet_report.py"), log_dir],
+        capture_output=True, text=True, timeout=120)
+
+    checks = {
+        "fleet_rc0": rc == 0,
+        "fleet_straggler_detected": flagged == {str(straggler)},
+        "fleet_straggler_in_jsonl": bool(strag_recs) and all(
+            str(r.get("rank")) == str(straggler) for r in strag_recs),
+        # both views must reflect the injected delay: the JSONL step
+        # records' worst skew, and the launcher-registry gauge (last
+        # completed step — the straggler is still slow at the end, so
+        # a fraction of the sleep is the right bar; an unset gauge
+        # reads 0.0 and fails)
+        "fleet_skew_reflects_delay": max_skew >= 0.5 * sleep_s
+        and skew >= 0.25 * sleep_s,
+        "fleet_comm_wait_per_rank": bool(shares_full),
+        "fleet_rank_identity_on_lines": ident_ok,
+        "fleet_comm_axis_per_rank": all(
+            v > 0 for v in rank_comm_axis.values()),
+        "fleet_report_renders": rep.returncode == 0
+        and "straggler" in rep.stdout
+        and f"rank {straggler} flagged" in rep.stdout,
+    }
+    details = {
+        "rc": rc, "wall_s": round(wall, 2), "nranks": nranks,
+        "steps": steps, "straggler_rank": straggler,
+        "injected_sleep_s": sleep_s,
+        "max_step_skew_s": round(max_skew, 4),
+        "skew_gauge_s": round(float(skew), 4),
+        "flagged_ranks": sorted(flagged),
+        "comm_bytes_data_axis": {str(k): int(v)
+                                 for k, v in rank_comm_axis.items()},
+        "comm_wait_share_last": (step_recs[-1]["comm_wait_share"]
+                                 if step_recs else None),
+        "log_dir": log_dir,
+    }
+    return checks, details
+
+
 def _hybrid_train_bench(a):
     """Hybrid-parallel section (`--train --mesh data=4,model=2`): a
     2-axis ZeRO-3 + TP + 1F1B-scheduled train smoke on the 8 XLA CPU
@@ -1437,7 +1638,11 @@ def _hybrid_train_bench(a):
        per_replica < global (what ZeRO-3 buys);
     4. deployment: the compiled sharded step round-trips through an
        AOT bundle whose fingerprint includes the mesh topology, and
-       the warm-started step reproduces the losses bit-for-bit.
+       the warm-started step reproduces the losses bit-for-bit;
+    5. fleet observability (unless --no-fleet): a real launcher-driven
+       multi-rank run with an injected `slow_rank` straggler —
+       skew detection, comm-wait attribution, and per-rank identity
+       asserted from the per-rank JSONL files (see _fleet_smoke).
 
     Exit 0 = every check held.
     """
@@ -1544,11 +1749,20 @@ def _hybrid_train_bench(a):
             "topology_in_fingerprint":
                 manifest["geometry"]["mesh_topology"] == plan.topology(),
         }
+        fleet_details = None
+        if not a.no_fleet:
+            # fleet observability arm: real launcher, one worker per
+            # data-axis rank, slow_rank fault on one of them — skew
+            # detection + comm-wait attribution asserted from the
+            # per-rank JSONL (docs/OBSERVABILITY.md "Fleet view")
+            fleet_checks, fleet_details = _fleet_smoke(a, plan)
+            checks.update(fleet_checks)
         with obs.JsonlExporter(path) as sink:
             sink.write_record({
                 "kind": "hybrid_train_bench", "ts": time.time(),
                 "mesh": plan.topology(), "zero_stage": plan.zero_stage,
                 "schedule": plan.schedule, "checks": checks,
+                "fleet": fleet_details,
                 "losses": [round(x, 6) for x in losses],
                 "ref_losses": [round(x, 6) for x in ref_losses],
                 "warm_losses": [round(x, 6) for x in warm_losses],
@@ -1572,7 +1786,7 @@ def _hybrid_train_bench(a):
             "mesh": plan.topology(), "zero_stage": plan.zero_stage,
             "schedule": plan.schedule, "checks": checks,
             "comm_bytes_axis": {k: int(v) for k, v in comm_axis.items()},
-            "footprint": fp, "telemetry": path,
+            "footprint": fp, "fleet": fleet_details, "telemetry": path,
             "bench_code_sha": _bench_code_sha(),
         },
     }
@@ -1609,6 +1823,17 @@ def train_bench(argv=None):
                          "fast-path microbench")
     ap.add_argument("--zero", type=int, default=3,
                     help="ZeRO stage for --mesh (default 3)")
+    ap.add_argument("--no-fleet", action="store_true",
+                    help="skip the fleet-observability arm of --mesh "
+                         "(launcher-driven multi-rank straggler/"
+                         "comm-wait smoke; ~1-2 min on a 2-core box)")
+    ap.add_argument("--fleet-ranks", type=int, default=None,
+                    help="worker processes for the fleet arm (default: "
+                         "the mesh's data-axis degree)")
+    ap.add_argument("--fleet-steps", type=int, default=8,
+                    help="train steps per rank in the fleet arm")
+    ap.add_argument("--fleet-sleep", type=float, default=0.4,
+                    help="slow_rank injected per-step sleep (seconds)")
     a = ap.parse_args(argv)
     if a.mesh:
         return _hybrid_train_bench(a)
@@ -1881,6 +2106,14 @@ def _chaos_hang_scenario(hang_timeout_s, max_steps=8, hang_step=5):
             resumed = json.load(open(p))
             break
     mttr = _gauge_last(reg, "robustness.mttr_seconds")
+    # fleet view of the same incident: the launcher's aggregator tails
+    # heartbeat_rank*.jsonl across epochs, so the hang reads as one
+    # huge inter-beat gap on the wedged rank (detection silence +
+    # restart), in fleet.heartbeat_gap_seconds and the fleet.jsonl
+    # heartbeat_gap records
+    hbm = reg.get("fleet.heartbeat_gap_seconds")
+    hb_gap = max((s.value for s in hbm.samples()), default=0.0) \
+        if hbm else 0.0
     ckpt = VerifiedCheckpointer(os.path.join(out_dir, "checkpoints"))
     last_save = (max_steps // 2) * 2
     checks = {
@@ -1890,6 +2123,7 @@ def _chaos_hang_scenario(hang_timeout_s, max_steps=8, hang_step=5):
         and resumed.get("final_step") == max_steps,
         "hang_ckpt_verifies": ckpt.latest_verified() == last_save,
         "mttr_recorded": mttr is not None,
+        "fleet_hb_gap_timeline": hb_gap >= hang_timeout_s * 0.8,
     }
     # end-to-end goodput under the hang: useful steps over executed
     # steps across both epochs (epoch 0 re-ran from the last verified
@@ -1900,6 +2134,7 @@ def _chaos_hang_scenario(hang_timeout_s, max_steps=8, hang_step=5):
     details = {"rc": rc, "wall_s": round(wall, 2),
                "mttr_s": round(mttr, 3) if mttr is not None else None,
                "resumed": resumed, "output_dir": out_dir,
+               "fleet_hb_gap_s": round(hb_gap, 2),
                "hang_timeout_s": hang_timeout_s, "hang_step": hang_step}
     return checks, details
 

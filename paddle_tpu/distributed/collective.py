@@ -59,9 +59,17 @@ def sync_with_deadline(value, timeout_s: Optional[float] = None,
         from ..framework.flags import flag_value
         timeout_s = float(flag_value("collective_timeout_s"))
     block = getattr(arr, "block_until_ready", None)
+    # comm-wait attribution (docs/OBSERVABILITY.md "Fleet view"): the
+    # host-side blocked time is THE collective wait a fleet view can
+    # see, so it gets a real timed span — but only inside an active
+    # span context (a step/request trace), like the _account instant
+    # spans, so ad-hoc host syncs stay span-spam-free
+    wait_sp = _obstr.span("comm.wait", site=what) \
+        if _obstr.current_span() is not None else _obstr.NULL_SPAN
     if timeout_s <= 0:
-        if block is not None:
-            block()
+        with wait_sp:
+            if block is not None:
+                block()
         return value
     fa = _faults.check("collective_stall")
     wedged_until = (time.perf_counter()
@@ -69,25 +77,28 @@ def sync_with_deadline(value, timeout_s: Optional[float] = None,
         if fa is not None else 0.0
     deadline = time.perf_counter() + timeout_s
     ready = getattr(arr, "is_ready", lambda: True)
-    while True:
-        now = time.perf_counter()
-        if now >= wedged_until and ready():
-            if block is not None:
-                block()
-            return value
-        if now >= deadline:
-            _obsm.counter("robustness.collective_timeouts").inc(site=what)
-            dump = None
-            if _obsm.enabled():  # forensics only when telemetry is on
-                dump = _obstr.flight_dump(reason="collective_timeout")
-            raise CollectiveTimeoutError(
-                f"{what} did not resolve within {timeout_s}s — a peer "
-                "never reached the collective (wedged rank or dead "
-                "host). The elastic launcher treats the raising rank's "
-                "exit as a pod failure and restarts from the last "
-                "verified checkpoint."
-                + (f" Flight dump: {dump}" if dump else ""))
-        time.sleep(min(0.002, timeout_s / 100.0))
+    with wait_sp:
+        while True:
+            now = time.perf_counter()
+            if now >= wedged_until and ready():
+                if block is not None:
+                    block()
+                return value
+            if now >= deadline:
+                _obsm.counter("robustness.collective_timeouts").inc(
+                    site=what)
+                dump = None
+                if _obsm.enabled():  # forensics only when telemetry on
+                    dump = _obstr.flight_dump(
+                        reason="collective_timeout")
+                raise CollectiveTimeoutError(
+                    f"{what} did not resolve within {timeout_s}s — a "
+                    "peer never reached the collective (wedged rank or "
+                    "dead host). The elastic launcher treats the "
+                    "raising rank's exit as a pod failure and restarts "
+                    "from the last verified checkpoint."
+                    + (f" Flight dump: {dump}" if dump else ""))
+            time.sleep(min(0.002, timeout_s / 100.0))
 
 
 _comm_calls = None

@@ -99,8 +99,12 @@ class HybridTrainStep:
         self._model = model
         if _obs_enabled():
             from ....observability import metrics as _m
+            from ....observability.runtime import set_identity
             _m.gauge("train.hybrid.zero_stage").set(plan.zero_stage)
             _m.gauge("train.hybrid.world_size").set(plan.world_size())
+            # fleet identity: rank files record the mesh layout they
+            # ran under (docs/OBSERVABILITY.md "Fleet view")
+            set_identity(topology=plan.topology())
 
     # ------------------------------------------------------------------
     @property

@@ -36,6 +36,9 @@ class Context:
     restart_backoff_max_s: float = 60.0  # cap before jitter
     hang_timeout_s: float = 0.0          # stale-rank detector; <=0 off
     engine_dir: Optional[str] = None     # AOT engine bundle for workers
+    topology: Optional[str] = None       # mesh spec stamped on telemetry
+    straggler_factor: float = 2.0        # fleet skew detector; <=0 off
+    straggler_steps: int = 3             # consecutive slow steps to flag
 
     @property
     def world_size(self) -> int:
@@ -97,6 +100,27 @@ def parse_args(argv=None) -> Context:
                         "warm-starts from the bundle (file loads) "
                         "instead of recompiling its programs, which is "
                         "most of the restart MTTR (docs/DEPLOYMENT.md)")
+    p.add_argument("--topology", type=str,
+                   default=os.environ.get("PADDLE_TPU_TOPOLOGY"),
+                   help="mesh spec (e.g. data=4,model=2) exported to "
+                        "every rank as PADDLE_TPU_TOPOLOGY: it becomes "
+                        "the 'topology' field on every telemetry line "
+                        "(docs/OBSERVABILITY.md 'Fleet view'), so a "
+                        "directory of rank files names the layout it "
+                        "was recorded under")
+    p.add_argument("--straggler_factor", type=float, default=2.0,
+                   help="fleet straggler detector: flag a rank whose "
+                        "step wall time exceeds this multiple of the "
+                        "cross-rank median (<=0 disables). Unlike "
+                        "--hang_timeout this catches ranks that are "
+                        "SLOW but alive — their heartbeat never goes "
+                        "silent, so the stale-heartbeat detector is "
+                        "structurally blind to them")
+    p.add_argument("--straggler_steps", type=int, default=3,
+                   help="fleet straggler detector: consecutive "
+                        "over-threshold steps before a rank is flagged "
+                        "(counted in robustness.stragglers_detected "
+                        "and logged with its dominant span)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -111,7 +135,9 @@ def parse_args(argv=None) -> Context:
         heartbeat_interval=a.heartbeat_interval,
         restart_backoff_s=a.restart_backoff,
         restart_backoff_max_s=a.restart_backoff_max,
-        hang_timeout_s=a.hang_timeout, engine_dir=a.engine_dir)
+        hang_timeout_s=a.hang_timeout, engine_dir=a.engine_dir,
+        topology=a.topology, straggler_factor=a.straggler_factor,
+        straggler_steps=a.straggler_steps)
 
 
 def restart_delay(restarts: int, base_s: float, cap_s: float) -> float:
@@ -153,7 +179,18 @@ class PodController:
             "PADDLE_RANK_HEARTBEAT_INTERVAL": str(
                 ctx.heartbeat_interval if ctx.heartbeat_interval > 0
                 else 1.0),
+            # per-rank telemetry: every worker gets its OWN JSONL sink
+            # beside the heartbeat files — deterministic names the
+            # fleet aggregator and tools/fleet_report.py glob. This
+            # deliberately overrides a launcher-level
+            # PADDLE_TPU_TELEMETRY_JSONL: N ranks appending to one
+            # shared file is interleaved corruption, which the fleet
+            # view exists to replace (docs/OBSERVABILITY.md)
+            "PADDLE_TPU_TELEMETRY_JSONL": self._telemetry_path(rank),
         })
+        if ctx.topology:
+            # stamped onto every telemetry line via rank_identity()
+            env["PADDLE_TPU_TOPOLOGY"] = ctx.topology
         if ctx.engine_dir:
             # every restart epoch warm-starts from the same AOT bundle
             # (inference.aot.warm_start reads this by default): restart
@@ -238,6 +275,10 @@ class PodController:
     def _hb_path(self, rank: int) -> str:
         return os.path.join(os.path.abspath(self.ctx.log_dir),
                             f"heartbeat_rank{rank}.jsonl")
+
+    def _telemetry_path(self, rank: int) -> str:
+        return os.path.join(os.path.abspath(self.ctx.log_dir),
+                            f"telemetry_rank{rank}.jsonl")
 
     def kill_rank(self, local_rank: int):
         """SIGKILL one wedged worker (SIGTERM would be swallowed by a
@@ -391,9 +432,24 @@ def launch(ctx: Context) -> int:
     """Run the pod until success, failure, or restart budget exhausted."""
     from ...observability import RankHeartbeat, tracing as _tr
     from ...observability import metrics as _obsm
+    from ...observability.fleet import FleetAggregator
     elastic = ElasticManager(ctx)
     hb = RankHeartbeat(os.path.join(ctx.log_dir, "heartbeat.jsonl"),
                        interval=ctx.heartbeat_interval)
+    os.makedirs(ctx.log_dir, exist_ok=True)
+    # fleet view: tail every rank's telemetry/heartbeat file, join
+    # train.step spans on the global step index, flag persistent
+    # stragglers (slow-but-alive ranks the stale-heartbeat detector
+    # cannot see) — docs/OBSERVABILITY.md "Fleet view"
+    # expected_ranks is the LOCAL worker count: this node's log_dir
+    # only ever holds this pod's rank files (multi-node jobs get one
+    # aggregator per node, each joining its own pod's ranks)
+    fleet = FleetAggregator(ctx.log_dir,
+                            straggler_factor=ctx.straggler_factor,
+                            straggler_steps=ctx.straggler_steps,
+                            expected_ranks=ctx.nproc_per_node)
+    fleet_interval = max(0.25, min(1.0, ctx.heartbeat_interval))
+    next_fleet = 0.0
     det = HangDetector(ctx.hang_timeout_s) if ctx.hang_timeout_s > 0 \
         else None
     det_interval = max(0.2, min(1.0, ctx.hang_timeout_s / 4.0)) \
@@ -445,6 +501,14 @@ def launch(ctx: Context) -> int:
                         peer_restart = True
                         break
                     elastic.heartbeat()
+                    if time.time() >= next_fleet:
+                        next_fleet = time.time() + fleet_interval
+                        try:
+                            fleet.poll()
+                        except Exception:
+                            # observability must never kill the pod
+                            # supervision that hosts it
+                            pass
                     states = None
                     if hb.due():  # rank_states stats N files: build it
                         states = pod.rank_states()
@@ -544,6 +608,11 @@ def launch(ctx: Context) -> int:
             epoch += 1
         return rc if rc is not None else 1
     finally:
+        try:
+            fleet.poll()    # drain what workers wrote just before exit
+        except Exception:
+            pass
+        fleet.close()
         hb.close()
         elastic.close()
 

@@ -46,7 +46,12 @@ Sites instrumented in-tree: ``ckpt_save``, ``ckpt_write``, ``ckpt_slow``
 stalls the write pipeline to exercise the async drain), ``nan_loss``,
 ``slow_step``, ``rank_hang`` (the trainer loop wedges: an alive pid
 that stops making progress — the launcher's stale-heartbeat detector's
-prey), ``sigterm`` (in ``trainer.Trainer``), ``decode_wedge``,
+prey), ``slow_rank`` (a per-step injected sleep on ONE rank of a
+multi-rank job: pass ``rank=K`` and the Trainer applies the sleep only
+on that rank — the persistent-skew straggler the launcher's
+``FleetAggregator`` exists to flag, invisible to the stale-heartbeat
+detector because the rank keeps beating), ``sigterm`` (in
+``trainer.Trainer``), ``decode_wedge``,
 ``serve_flood`` (in ``inference.ContinuousBatchingPredictor``),
 ``collective_stall`` (``distributed.collective`` sync deadline — holds
 buffer readiness false so the collective watchdog trips), and
@@ -76,7 +81,7 @@ _MODES = ("err", "truncate", "corrupt", "drop_manifest", "nan", "inf",
 _DEFAULT_MODES = {
     "ckpt_save": "err", "ckpt_write": "truncate", "nan_loss": "nan",
     "slow_step": "sleep", "sigterm": "sigterm", "decode_wedge": "sleep",
-    "serve_flood": "flood", "rank_hang": "sleep",
+    "serve_flood": "flood", "rank_hang": "sleep", "slow_rank": "sleep",
     "collective_stall": "sleep", "ckpt_slow": "sleep",
     "heartbeat_stall": "sleep",
 }

@@ -30,8 +30,10 @@ TTFT / per-token latency, admissions/evictions/rejections), the Trainer
 loop, bench.py, the elastic launcher (per-rank heartbeats), and the
 fault-tolerance layer (robustness.* counters: anomalies skipped,
 checkpoint retries/fallbacks, deadline evictions, shed requests,
-watchdog trips, injected faults — docs/ROBUSTNESS.md). Metric catalog:
-docs/OBSERVABILITY.md.
+watchdog trips, injected faults — docs/ROBUSTNESS.md). The fleet layer
+(fleet.py) joins the per-rank files cross-rank: step skew, straggler
+detection, comm-wait attribution (docs/OBSERVABILITY.md "Fleet view").
+Metric catalog: docs/OBSERVABILITY.md.
 """
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricRegistry, Sample, DEFAULT_BUCKETS,
@@ -42,7 +44,11 @@ from .exporters import (  # noqa: F401
 )
 from .runtime import (  # noqa: F401
     jit_callback, device_memory_stats, configure, maybe_export,
-    export_record, telemetry_path, RankHeartbeat,
+    export_record, telemetry_path, RankHeartbeat, rank_identity,
+    set_identity, export_identity,
+)
+from .fleet import (  # noqa: F401
+    FleetAggregator, StragglerDetector, RankFileTailer,
 )
 from .tracing import (  # noqa: F401
     Span, NULL_SPAN, span, start_span, traced, current_span,
@@ -56,7 +62,10 @@ __all__ = [
     "gauge", "histogram", "JsonlExporter", "PrometheusExporter",
     "TensorBoardExporter", "jit_callback", "device_memory_stats",
     "configure", "maybe_export", "export_record", "telemetry_path",
-    "RankHeartbeat", "Span", "NULL_SPAN", "span", "start_span",
+    "RankHeartbeat", "rank_identity", "set_identity", "export_identity",
+    "FleetAggregator",
+    "StragglerDetector", "RankFileTailer",
+    "Span", "NULL_SPAN", "span", "start_span",
     "traced", "current_span", "FlightRecorder", "flight_recorder",
     "flight_dump", "flight_dir", "set_flight_dir", "to_chrome_trace",
     "write_chrome_trace",

@@ -43,12 +43,24 @@ class JsonlExporter:
     (tools/{trace_report,metrics_report,autotune}.py) fold the rotated
     sibling back in. Rotation happens on whole-line boundaries only —
     every write here is a complete line.
+
+    Fleet identity: every line additionally carries the process's
+    ``rank`` / ``world_size`` / ``topology`` (``runtime.rank_identity``,
+    sourced from the launcher env; override per-exporter with the
+    ``identity`` ctor arg). Outside a launcher the identity is empty and
+    the line schema is unchanged. Identity fields never overwrite keys a
+    record already carries.
     """
 
     def __init__(self, path: str, registry: Optional[MetricRegistry] = None,
-                 max_bytes: Optional[int] = None):
+                 max_bytes: Optional[int] = None,
+                 identity: Optional[dict] = None):
         self.path = path
         self._registry = registry or get_registry()
+        if identity is None:
+            from .runtime import export_identity
+            identity = export_identity()
+        self.identity = dict(identity)
         self._lock = threading.Lock()  # span ends vs step exports race
         if max_bytes is None:
             max_bytes = int(os.environ.get(
@@ -83,9 +95,12 @@ class JsonlExporter:
 
     def export(self, step: Optional[int] = None, extra: Optional[dict] = None):
         ts = time.time()
+        ident = self.identity
         lines = []
         for s in self._registry.collect():
             rec = {"ts": round(ts, 6), "step": step}
+            if ident:
+                rec.update(ident)
             rec.update(s.as_dict())
             if extra:
                 rec.update(extra)
@@ -101,6 +116,10 @@ class JsonlExporter:
         tracing span lines) that share the telemetry file but aren't
         registry series. Silent no-op once closed — late writers at
         interpreter teardown must not explode."""
+        ident = self.identity
+        if ident:
+            rec = {**{k: v for k, v in ident.items() if k not in rec},
+                   **rec}
         line = json.dumps(rec) + "\n"
         with self._lock:
             if self._f is None:
@@ -142,24 +161,50 @@ def _prom_name(name: str) -> str:
     return ("_" + s) if s and s[0].isdigit() else s
 
 
+def _prom_escape(value) -> str:
+    """Escape one label VALUE for the exposition format: backslash,
+    double-quote, and newline (a raw newline inside the quotes tears the
+    exposition line in half — topology/rank strings from env must not be
+    able to corrupt a scrape)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
     items = dict(labels)
     if extra:
         items.update(extra)
     if not items:
         return ""
-    body = ",".join(
-        '%s="%s"' % (_prom_name(str(k)),
-                     str(v).replace("\\", "\\\\").replace('"', '\\"'))
-        for k, v in sorted(items.items()))
+    body = ",".join('%s="%s"' % (_prom_name(str(k)), _prom_escape(v))
+                    for k, v in sorted(items.items()))
     return "{" + body + "}"
 
 
 class PrometheusExporter:
-    """Render the registry in the Prometheus text exposition format."""
+    """Render the registry in the Prometheus text exposition format.
 
-    def __init__(self, registry: Optional[MetricRegistry] = None):
+    Under a launcher every sample line carries the process's fleet
+    identity as `rank` / `world_size` / `topology` labels
+    (`runtime.rank_identity`; override with ``const_labels``), so a
+    fleet-wide scrape can tell the ranks apart. Label values are escaped
+    per the exposition spec — a topology like ``data=4,model=2`` (or a
+    value with quotes/newlines) renders as one well-formed line."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 const_labels: Optional[dict] = None):
         self._registry = registry or get_registry()
+        if const_labels is None:
+            from .runtime import export_identity
+            const_labels = export_identity()
+        self._const = {str(k): v for k, v in (const_labels or {}).items()}
+
+    def _labels(self, labels: dict, extra: Optional[dict] = None) -> str:
+        items = dict(self._const)
+        items.update(labels)
+        if extra:
+            items.update(extra)
+        return _prom_labels(items)
 
     def render(self) -> str:
         lines = []
@@ -175,20 +220,20 @@ class PrometheusExporter:
                         cum += c
                         lines.append(
                             f"{pname}_bucket"
-                            f"{_prom_labels(s._labels, {'le': b})} {cum}")
+                            f"{self._labels(s._labels, {'le': b})} {cum}")
                     lines.append(
                         f"{pname}_bucket"
-                        f"{_prom_labels(s._labels, {'le': '+Inf'})} "
+                        f"{self._labels(s._labels, {'le': '+Inf'})} "
                         f"{s._count}")
                     lines.append(
-                        f"{pname}_sum{_prom_labels(s._labels)} {s._sum}")
+                        f"{pname}_sum{self._labels(s._labels)} {s._sum}")
                     lines.append(
-                        f"{pname}_count{_prom_labels(s._labels)} "
+                        f"{pname}_count{self._labels(s._labels)} "
                         f"{s._count}")
             else:
                 for s in m.series():
                     lines.append(
-                        f"{pname}{_prom_labels(s._labels)} {s._value}")
+                        f"{pname}{self._labels(s._labels)} {s._value}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write(self, path: str) -> str:

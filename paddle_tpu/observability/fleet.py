@@ -1,0 +1,563 @@
+"""Fleet observability: cross-rank telemetry aggregation.
+
+PR 12 made the runtime genuinely multi-rank (ZeRO-2/3, TP, 1F1B under
+the elastic launcher) but telemetry stayed process-local: every rank
+writes its own JSONL and nothing ever joins them, so the questions that
+matter at fleet scale — *which rank is slow*, *is step time compute or
+comm-wait*, *are the per-axis comm bytes balanced* — were unanswerable.
+This module is the join:
+
+- :class:`RankFileTailer` — incremental reader of ONE growing JSONL
+  file: consumes whole lines only (a torn final line stays pending and
+  is re-read complete on the next poll), survives mid-read rotation
+  (the ``<path>.1`` sibling from ``JsonlExporter`` size rotation is
+  drained before the fresh file), and folds a pre-existing ``.1``
+  sibling in on first open. The PR-11 single-file tolerance,
+  generalized to many concurrently-growing files.
+- :class:`StragglerDetector` — persistent-skew state machine: a rank
+  whose step time exceeds ``factor`` x the cross-rank median for
+  ``min_steps`` CONSECUTIVE completed steps is flagged once per
+  episode. This fires long before the PR-7 ``HangDetector`` ever could:
+  a straggler still makes progress (its heartbeat keeps beating), it is
+  just slow — silence-based detection is structurally blind to it.
+- :class:`FleetAggregator` — the launcher-side consumer: tails every
+  ``telemetry_rank<k>.jsonl`` / ``heartbeat_rank<k>.jsonl`` in a log
+  directory, joins ``train.step`` spans across ranks on the global step
+  index (the Trainer stamps it into the span's ``step`` label, which
+  survives restarts — resumed runs continue the same step numbering),
+  and computes per completed step: cross-rank skew (slowest minus
+  median), per-rank comm-wait share (time inside ``comm.*`` spans vs
+  step wall), plus per-axis comm-byte balance and heartbeat-gap
+  timelines. Results export two ways at once: ``fleet.*`` gauges in the
+  aggregating process's registry, and ``{"kind": "fleet"}`` JSONL
+  records (same schema family as spans/heartbeats) for offline readers
+  (``tools/fleet_report.py`` renders the same views file-side).
+
+Everything here is pure stdlib + the metrics registry: no jax, no
+device work — it runs in the launcher process (docs/OBSERVABILITY.md
+"Fleet view").
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+import time
+from typing import Dict, List, Optional
+
+from . import metrics as _obsm
+
+__all__ = ["RankFileTailer", "StragglerDetector", "FleetAggregator"]
+
+# bound on buffered per-rank state (steps awaiting the other ranks,
+# trace->step maps, comm spans whose step isn't known yet): the
+# aggregator must stay O(ranks * window) however long the run
+_MAX_PENDING_STEPS = 512
+_MAX_PENDING_TRACES = 2048
+
+
+class RankFileTailer:
+    """Incrementally read complete JSONL lines from one growing file.
+
+    ``poll()`` returns the records appended since the last call.
+    Guarantees, in the presence of a concurrent writer:
+
+    - whole lines only: an unterminated tail (a line being appended
+      RIGHT NOW, or a crash-time torn write) is held back and re-read
+      on the next poll once the newline lands — never half-consumed,
+      never lost;
+    - interior garbage lines are skipped (counted in ``dropped``);
+    - rotation-safe: when the writer rotates (``os.replace`` to
+      ``<path>.1`` + fresh file — ``JsonlExporter`` semantics), the
+      next poll drains the remainder of the OLD file from ``.1``
+      before starting the new one, so no record is lost or doubled
+      even when the fresh file grows past the old offset within one
+      poll interval (the inode check catches that case);
+    - a ``.1`` sibling that already exists at first open is folded in
+      first, so a tailer attached mid-run still sees rotated history.
+    """
+
+    def __init__(self, path: str, ingest_existing_rotation: bool = True):
+        self.path = path
+        self.offset = 0
+        self.dropped = 0          # undecodable interior lines
+        self._ino: Optional[int] = None
+        self._rot_done = not ingest_existing_rotation
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _read_complete(path: str, offset: int):
+        """(complete lines, new offset) from byte ``offset``; the
+        unterminated tail is NOT consumed. Binary mode keeps offsets
+        byte-exact; json.loads accepts bytes."""
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+        cut = data.rfind(b"\n") + 1
+        return data[:cut].splitlines(), offset + cut
+
+    def _parse(self, lines) -> List[dict]:
+        out = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                self.dropped += 1
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    def poll(self) -> List[dict]:
+        recs: List[dict] = []
+        try:
+            f = open(self.path, "rb")
+        except OSError:
+            return recs
+        with f:
+            # fstat the OPENED fd, not the path: a rotation landing
+            # between a path-stat and the open would otherwise apply
+            # the old file's byte offset to the new inode (losing the
+            # old tail and double-counting the new file)
+            st = os.fstat(f.fileno())
+            if not self._rot_done:
+                self._rot_done = True
+                rot = self.path + ".1"
+                if os.path.exists(rot):
+                    try:
+                        lines, _ = self._read_complete(rot, 0)
+                        recs.extend(self._parse(lines))
+                    except OSError:
+                        pass
+            if self._ino is not None and st.st_ino != self._ino:
+                # rotated under us: drain the remainder of the old
+                # file, which now lives at <path>.1 (one atomic
+                # os.replace)
+                rot = self.path + ".1"
+                try:
+                    if os.stat(rot).st_ino == self._ino:
+                        lines, _ = self._read_complete(rot, self.offset)
+                        recs.extend(self._parse(lines))
+                except OSError:
+                    pass
+                self.offset = 0
+            elif st.st_size < self.offset:
+                self.offset = 0      # truncated: start over
+            self._ino = st.st_ino
+            f.seek(self.offset)
+            data = f.read()
+        cut = data.rfind(b"\n") + 1
+        self.offset += cut
+        recs.extend(self._parse(data[:cut].splitlines()))
+        return recs
+
+
+class StragglerDetector:
+    """Persistent-skew detection over completed-step duration maps.
+
+    Feed ``observe(step, durs)`` one ``{rank: seconds}`` map per
+    completed step (every tracked rank reported). A rank above
+    ``factor`` x the cross-rank median for ``min_steps`` consecutive
+    steps is returned ONCE per episode (it re-arms after the rank
+    returns under the threshold). Needs ``min_ranks`` ranks for the
+    median to mean anything. Pure state machine — tests drive it with
+    synthetic maps, no files, no clock."""
+
+    def __init__(self, factor: float = 2.0, min_steps: int = 3,
+                 min_ranks: int = 2):
+        self.factor = float(factor)
+        self.min_steps = max(1, int(min_steps))
+        self.min_ranks = max(2, int(min_ranks))
+        self._consec: Dict[str, int] = {}
+        self._active: set = set()
+
+    def observe(self, step: int, durs: Dict[str, float]) -> List[dict]:
+        out = []
+        if self.factor <= 0 or len(durs) < self.min_ranks:
+            return out   # factor <= 0 disables detection
+        med = statistics.median(durs.values())
+        for rank, d in durs.items():
+            if med > 0 and d > self.factor * med:
+                c = self._consec.get(rank, 0) + 1
+                self._consec[rank] = c
+                if c >= self.min_steps and rank not in self._active:
+                    self._active.add(rank)
+                    out.append({"rank": rank, "step": int(step),
+                                "dur_s": round(d, 6),
+                                "median_s": round(med, 6),
+                                "ratio": round(d / med, 3),
+                                "consecutive": c})
+            else:
+                self._consec[rank] = 0
+                self._active.discard(rank)
+        return out
+
+
+def _rank_of(path: str) -> str:
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    return m.group(1) if m else os.path.basename(path)
+
+
+class FleetAggregator:
+    """Tail a directory of per-rank telemetry/heartbeat JSONL files and
+    compute the fleet view (module docstring). Drive it by calling
+    :meth:`poll` periodically (the launcher does, at heartbeat cadence);
+    each poll ingests whatever every rank appended and emits:
+
+    gauges (aggregating process's registry)
+        ``fleet.step_skew_seconds``          slowest - median, last
+                                             completed step
+        ``fleet.step_time_seconds``          per-rank last step wall
+                                             (label ``rank``)
+        ``fleet.comm_wait_share``            per-rank comm-wait / step
+                                             wall (label ``rank``)
+        ``fleet.comm_bytes_imbalance``       per-axis max/mean of
+                                             cumulative comm bytes
+                                             across ranks (label
+                                             ``axis``; 1.0 = balanced)
+        ``fleet.heartbeat_gap_seconds``      per-rank worst observed
+                                             inter-beat gap (label
+                                             ``rank``)
+        ``robustness.stragglers_detected``   counter, label ``rank``
+
+    JSONL records (``out_path``, one object per line)
+        ``{"kind": "fleet", "event": "step", "step", "durs",
+        "skew_s", "median_s", "slowest_rank", "comm_wait_share"}``
+        per completed step;
+        ``{"kind": "fleet", "event": "straggler", ...,
+        "dominant_span"}`` per detector firing;
+        ``{"kind": "fleet", "event": "comm_balance", "axis",
+        "bytes", "imbalance"}`` and ``{"kind": "fleet", "event":
+        "heartbeat_gap", "rank", "gap_s"}`` when those move.
+    """
+
+    TELEMETRY_GLOB = "telemetry_rank*.jsonl"
+    HEARTBEAT_GLOB = "heartbeat_rank*.jsonl"
+
+    def __init__(self, log_dir: str, out_path: Optional[str] = None,
+                 straggler_factor: float = 2.0,
+                 straggler_steps: int = 3,
+                 expected_ranks: Optional[int] = None,
+                 registry: Optional[_obsm.MetricRegistry] = None,
+                 now_fn=time.time, log=None):
+        self.log_dir = os.path.abspath(log_dir)
+        # known world size: steps join only once every expected rank's
+        # telemetry file is visible — without it, ranks that boot a few
+        # seconds late (the import/compile window) would be left out of
+        # the early joins and their prefix steps never re-joined
+        self.expected_ranks = int(expected_ranks) if expected_ranks \
+            else None
+        self.out_path = out_path if out_path is not None else \
+            os.path.join(self.log_dir, "fleet.jsonl")
+        self._reg = registry or _obsm.get_registry()
+        self._now = now_fn
+        self._log = log or (lambda msg: print(msg, file=sys.stderr))
+        self.detector = StragglerDetector(factor=straggler_factor,
+                                          min_steps=straggler_steps)
+        self._tailers: Dict[str, RankFileTailer] = {}
+        self._hb_tailers: Dict[str, RankFileTailer] = {}
+        # per-rank join state
+        self._steps: Dict[str, Dict[int, dict]] = {}   # rank -> step ->
+        #   {"dur", "start", "children": {name: dur}, "comm_s"}
+        self._trace_step: Dict[str, Dict[str, int]] = {}
+        self._orphan_comm: Dict[str, Dict[str, float]] = {}
+        self._comm_bytes: Dict[str, Dict[str, float]] = {}  # rank->axis
+        self._last_beat: Dict[str, float] = {}
+        self._worst_gap: Dict[str, float] = {}
+        self._completed_through = -1    # last step joined + emitted
+        self.stragglers: List[dict] = []
+        self._out = None
+        self._warned: set = set()
+
+    # --------------------------------------------------------- output --
+    def _emit(self, rec: dict):
+        rec = {"ts": round(self._now(), 6), "kind": "fleet", **rec}
+        if self._out is None:
+            d = os.path.dirname(os.path.abspath(self.out_path))
+            try:
+                os.makedirs(d, exist_ok=True)
+                self._out = open(self.out_path, "a", buffering=1)
+            except OSError:
+                return
+        try:
+            self._out.write(json.dumps(rec) + "\n")
+        except (OSError, ValueError):
+            pass
+
+    def close(self):
+        if self._out is not None:
+            try:
+                self._out.close()
+            except OSError:
+                pass
+            self._out = None
+
+    # --------------------------------------------------------- ingest --
+    def _discover(self):
+        for path in glob.glob(os.path.join(self.log_dir,
+                                           self.TELEMETRY_GLOB)):
+            if path.endswith(".jsonl") and path not in self._tailers:
+                self._tailers[path] = RankFileTailer(path)
+        for path in glob.glob(os.path.join(self.log_dir,
+                                           self.HEARTBEAT_GLOB)):
+            if path not in self._hb_tailers:
+                self._hb_tailers[path] = RankFileTailer(path)
+
+    def _rank_state(self, rank: str) -> Dict[int, dict]:
+        return self._steps.setdefault(rank, {})
+
+    def _prune(self, rank: str):
+        steps = self._steps.get(rank) or {}
+        while len(steps) > _MAX_PENDING_STEPS:
+            steps.pop(min(steps))
+        traces = self._trace_step.get(rank) or {}
+        while len(traces) > _MAX_PENDING_TRACES:
+            traces.pop(next(iter(traces)))
+        orphans = self._orphan_comm.get(rank) or {}
+        while len(orphans) > _MAX_PENDING_TRACES:
+            orphans.pop(next(iter(orphans)))
+
+    def _ingest_span(self, rank: str, rec: dict):
+        name = rec.get("name") or ""
+        labels = rec.get("labels") or {}
+        trace = rec.get("trace")
+        dur = float(rec.get("dur") or 0.0)
+        if name == "train.step":
+            step = labels.get("step")
+            if step is None:
+                return
+            step = int(step)
+            st = self._rank_state(rank).setdefault(step, {
+                "children": {}, "comm_s": 0.0})
+            st["dur"] = dur
+            st["start"] = float(rec.get("start") or 0.0)
+            if trace:
+                self._trace_step.setdefault(rank, {})[trace] = step
+                # comm spans that arrived before their step span
+                pend = self._orphan_comm.get(rank, {}).pop(trace, None)
+                if pend:
+                    st["comm_s"] += pend
+            self._prune(rank)
+        elif name.startswith("train."):
+            # phase spans (data/dispatch/loss_sync/...): keep per-step
+            # child durations so a straggler's dominant phase is
+            # nameable; they also bind the trace id to the step index
+            # for comm spans, which carry no step label themselves
+            step = labels.get("step")
+            if step is not None and trace:
+                self._trace_step.setdefault(rank, {})[trace] = int(step)
+                st = self._rank_state(rank).setdefault(int(step), {
+                    "children": {}, "comm_s": 0.0})
+                ch = st["children"]
+                ch[name] = ch.get(name, 0.0) + dur
+                pend = self._orphan_comm.get(rank, {}).pop(trace, None)
+                if pend:
+                    st["comm_s"] += pend
+                self._prune(rank)
+        elif name.startswith("comm."):
+            step = self._trace_step.get(rank, {}).get(trace) \
+                if trace else None
+            if step is not None:
+                st = self._rank_state(rank).setdefault(step, {
+                    "children": {}, "comm_s": 0.0})
+                st["comm_s"] += dur
+                ch = st["children"]
+                ch[name] = ch.get(name, 0.0) + dur
+            elif trace:
+                orphans = self._orphan_comm.setdefault(rank, {})
+                orphans[trace] = orphans.get(trace, 0.0) + dur
+                self._prune(rank)
+
+    def _ingest_sample(self, rank: str, rec: dict):
+        if rec.get("name") != "comm.bytes":
+            return
+        ax = (rec.get("labels") or {}).get("axis")
+        if ax is None:
+            return
+        per_axis = self._comm_bytes.setdefault(rank, {})
+        # cumulative counter, one series per (op, axis): last snapshot
+        # per op wins; fold ops into the axis total at compute time
+        op = (rec.get("labels") or {}).get("op", "?")
+        per_axis[(ax, op)] = float(rec.get("value") or 0.0)
+
+    def _ingest_beat(self, rank: str, rec: dict):
+        ts = rec.get("ts")
+        if ts is None:
+            return
+        ts = float(ts)
+        prev = self._last_beat.get(rank)
+        if prev is not None and ts > prev:
+            gap = ts - prev
+            if gap > self._worst_gap.get(rank, 0.0):
+                self._worst_gap[rank] = gap
+        if prev is None or ts > prev:
+            self._last_beat[rank] = ts
+
+    # -------------------------------------------------------- compute --
+    def _join_steps(self):
+        """Emit every step all tracked ranks have reported, in order."""
+        if len(self._steps) < max(2, self.expected_ranks or 2):
+            return
+        ranks = sorted(self._steps, key=lambda r: (len(r), r))
+        while True:
+            candidate = self._completed_through + 1
+            have = [r for r in ranks
+                    if (self._steps[r].get(candidate) or {}).get("dur")
+                    is not None]
+            if len(have) < len(ranks):
+                # steps are consecutive per rank; if every rank is
+                # already past the candidate (resume gap), skip forward
+                nxt = [min((s for s in self._steps[r]
+                            if s > candidate
+                            and self._steps[r][s].get("dur") is not None),
+                           default=None) for r in ranks]
+                if all(n is not None for n in nxt) \
+                        and min(nxt) > candidate:
+                    self._completed_through = min(nxt) - 1
+                    continue
+                return
+            durs = {r: float(self._steps[r][candidate]["dur"])
+                    for r in ranks}
+            comm = {r: float(self._steps[r][candidate].get("comm_s", 0.0))
+                    for r in ranks}
+            self._emit_step(candidate, durs, comm)
+            for r in ranks:
+                self._steps[r].pop(candidate, None)
+            self._completed_through = candidate
+
+    def _emit_step(self, step: int, durs: Dict[str, float],
+                   comm: Dict[str, float]):
+        med = statistics.median(durs.values())
+        slowest = max(durs, key=durs.get)
+        skew = durs[slowest] - med
+        share = {r: (comm[r] / durs[r] if durs[r] > 0 else 0.0)
+                 for r in durs}
+        g_skew = self._reg.gauge(
+            "fleet.step_skew_seconds", unit="s",
+            help="slowest minus median rank wall time, last completed "
+                 "step")
+        g_skew.set(skew)
+        g_time = self._reg.gauge("fleet.step_time_seconds", unit="s")
+        g_share = self._reg.gauge("fleet.comm_wait_share")
+        for r in durs:
+            g_time.set(durs[r], rank=r)
+            g_share.set(share[r], rank=r)
+        self._emit({"event": "step", "step": step,
+                    "durs": {r: round(d, 6) for r, d in durs.items()},
+                    "median_s": round(med, 6),
+                    "skew_s": round(skew, 6),
+                    "slowest_rank": slowest,
+                    "comm_wait_share": {r: round(s, 4)
+                                        for r, s in share.items()}})
+        for hit in self.detector.observe(step, durs):
+            dominant = self._dominant_span(hit["rank"], step)
+            hit["dominant_span"] = dominant
+            self.stragglers.append(hit)
+            self._reg.counter(
+                "robustness.stragglers_detected",
+                help="ranks flagged by the fleet persistent-skew "
+                     "detector").inc(rank=str(hit["rank"]))
+            self._emit({"event": "straggler", **hit})
+            self._log(
+                f"[fleet] straggler: rank {hit['rank']} at step {step} "
+                f"— {hit['dur_s'] * 1e3:.1f}ms vs median "
+                f"{hit['median_s'] * 1e3:.1f}ms "
+                f"({hit['ratio']:.1f}x, {hit['consecutive']} "
+                f"consecutive steps; dominant span "
+                f"{dominant or 'unknown'!r})")
+
+    def _dominant_span(self, rank: str, step: int) -> Optional[str]:
+        # called from _emit_step BEFORE the step entry is popped
+        st = (self._steps.get(rank) or {}).get(step) or {}
+        children = st.get("children") or {}
+        if not children:
+            return None
+        return max(children, key=children.get)
+
+    def _comm_balance(self):
+        if len(self._comm_bytes) < 2:
+            return
+        axes: Dict[str, Dict[str, float]] = {}
+        for rank, per in self._comm_bytes.items():
+            for (ax, _op), v in per.items():
+                axes.setdefault(ax, {}).setdefault(rank, 0.0)
+                axes[ax][rank] += v
+        g = self._reg.gauge(
+            "fleet.comm_bytes_imbalance",
+            help="per-axis max/mean cumulative comm bytes across "
+                 "ranks; 1.0 = balanced")
+        for ax, by_rank in axes.items():
+            vals = list(by_rank.values())
+            mean = sum(vals) / len(vals)
+            imb = (max(vals) / mean) if mean > 0 else 1.0
+            g.set(imb, axis=ax)
+            # one record per 1% imbalance move, not one per poll —
+            # cumulative byte counters grow every step
+            key = ("comm", ax, round(imb, 2))
+            if key not in self._warned:
+                self._warned.add(key)
+                self._emit({"event": "comm_balance", "axis": ax,
+                            "bytes": {r: int(v)
+                                      for r, v in by_rank.items()},
+                            "imbalance": round(imb, 4)})
+
+    def _heartbeat_gaps(self):
+        g = self._reg.gauge("fleet.heartbeat_gap_seconds", unit="s")
+        for rank, gap in self._worst_gap.items():
+            g.set(gap, rank=rank)
+            key = ("hb", rank, int(gap))   # one record per whole second
+            if gap >= 2.0 and key not in self._warned:
+                self._warned.add(key)
+                self._emit({"event": "heartbeat_gap", "rank": rank,
+                            "gap_s": round(gap, 3)})
+
+    # ----------------------------------------------------------- poll --
+    def poll(self) -> int:
+        """Ingest everything appended since the last poll; returns the
+        number of records consumed. This is the aggregator tail loop —
+        registered hot path in tools/graft_lint/config.py: it runs at
+        heartbeat cadence inside the launcher babysit loop, so it must
+        stay file-I/O-only (no device work, no blocking syncs)."""
+        self._discover()
+        n = 0
+        for path, tailer in self._tailers.items():
+            rank = _rank_of(path)
+            for rec in tailer.poll():
+                n += 1
+                # per-record guard: a line that parses as JSON but has
+                # a wrong-typed field (hand-written heartbeats,
+                # interleaved garbage — the corruption this layer
+                # exists to diagnose) must not take down the launcher
+                # babysit loop that hosts this aggregator
+                try:
+                    kind = rec.get("kind")
+                    if kind == "span":
+                        self._ingest_span(rank, rec)
+                    elif kind == "heartbeat":
+                        self._ingest_beat(rank, rec)
+                    elif rec.get("name"):
+                        # registry sample lines carry the METRIC kind
+                        # (counter/gauge/histogram) in "kind"
+                        self._ingest_sample(rank, rec)
+                except (TypeError, ValueError, KeyError):
+                    tailer.dropped += 1
+        for path, tailer in self._hb_tailers.items():
+            rank = _rank_of(path)
+            for rec in tailer.poll():
+                n += 1
+                try:
+                    if rec.get("kind") == "heartbeat":
+                        self._ingest_beat(rank, rec)
+                except (TypeError, ValueError, KeyError):
+                    tailer.dropped += 1
+        if n:
+            self._join_steps()
+            self._comm_balance()
+            self._heartbeat_gaps()
+        return n

@@ -20,7 +20,79 @@ from .metrics import enabled, get_registry
 
 __all__ = ["jit_callback", "device_memory_stats", "configure",
            "maybe_export", "export_record", "telemetry_path",
-           "RankHeartbeat"]
+           "RankHeartbeat", "rank_identity", "set_identity",
+           "export_identity"]
+
+
+# ------------------------------------------------------- rank identity ------
+# Fleet observability (docs/OBSERVABILITY.md "Fleet view") joins telemetry
+# across ranks, which only works if every exported line says which rank
+# wrote it. The identity is sourced once from the launcher env
+# (PADDLE_TRAINER_ID/RANK, PADDLE_TRAINERS_NUM/WORLD_SIZE,
+# PADDLE_TPU_TOPOLOGY) and merged into every JSONL record by the sink;
+# single-process runs (no rank env) keep their line schema unchanged.
+_identity: Optional[dict] = None
+
+
+def _env_identity() -> dict:
+    rank = os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK"))
+    if rank is None:
+        return {}
+    out = {"rank": int(rank)}
+    ws = os.environ.get("PADDLE_TRAINERS_NUM",
+                        os.environ.get("WORLD_SIZE"))
+    if ws is not None:
+        out["world_size"] = int(ws)
+    topo = os.environ.get("PADDLE_TPU_TOPOLOGY")
+    if topo:
+        out["topology"] = topo
+    return out
+
+
+def rank_identity() -> dict:
+    """This process's fleet identity: `{"rank", "world_size",
+    "topology"}` (any subset; `{}` outside a launcher). Cached on first
+    read; `set_identity` overrides."""
+    global _identity
+    if _identity is None:
+        try:
+            _identity = _env_identity()
+        except (TypeError, ValueError):
+            _identity = {}
+    return dict(_identity)
+
+
+def export_identity() -> dict:
+    """The identity exporters stamp on every record: the full
+    rank_identity() under a launcher, `{}` otherwise. Gated on a
+    ``rank`` being present so a process-local topology stamp
+    (`HybridTrainStep` in a single-process run) cannot change the
+    single-process line schema — outside a launcher, telemetry lines
+    and Prometheus labels stay exactly as they always were."""
+    ident = rank_identity()
+    return ident if "rank" in ident else {}
+
+
+def set_identity(rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 topology: Optional[str] = None) -> dict:
+    """Override/extend the cached identity (the hybrid engine names its
+    mesh topology here so rank files record the layout they ran under).
+    Only the given fields change; returns the resulting identity. An
+    already-attached process sink picks the change up immediately."""
+    global _identity
+    ident = rank_identity()
+    if rank is not None:
+        ident["rank"] = int(rank)
+    if world_size is not None:
+        ident["world_size"] = int(world_size)
+    if topology is not None:
+        ident["topology"] = str(topology)
+    _identity = ident
+    with _Sink.lock:
+        if _sink.exporter is not None:
+            _sink.exporter.identity = export_identity()
+    return dict(ident)
 
 
 def jit_callback(fn: Callable, *traced_args):

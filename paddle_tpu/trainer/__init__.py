@@ -374,6 +374,18 @@ class Trainer:
             fa = _faults.check("slow_step", step=step)
             if fa is not None:
                 time.sleep(float(fa.params.get("sleep", 0.05)))
+            fa = _faults.check("slow_rank", step=step)
+            if fa is not None:
+                # per-step straggler injection on ONE rank: with a
+                # rank=K param only that rank pays the sleep (the spec
+                # is armed fleet-wide through one shared env). The
+                # sleep runs inside its own child span so the fleet
+                # aggregator's dominant-span diagnosis names it.
+                target = fa.params.get("rank")
+                if target is None or int(target) == self._env_rank():
+                    with _obs.span("train.straggle", parent=st_sp,
+                                   step=step + 1):
+                        time.sleep(float(fa.params.get("sleep", 0.25)))
             fa = _faults.check("rank_hang", step=step)
             if fa is not None:
                 # deliberately wedge: an alive pid whose heartbeat/log
@@ -478,6 +490,15 @@ class Trainer:
                 "anomalous_steps": self._anom_total,
                 "goodput": (executed - self._anom_total) / executed,
                 "preempted": self._preempted, "logs": logs}
+
+    @staticmethod
+    def _env_rank() -> int:
+        """This worker's global rank under the launcher (0 standalone)."""
+        try:
+            return int(os.environ.get(
+                "RANK", os.environ.get("PADDLE_TRAINER_ID", "0")))
+        except ValueError:
+            return 0
 
     def _log(self, rec: dict):
         import logging

@@ -41,7 +41,8 @@ _SHARDS = {
     "kernels": {"test_pallas_train.py", "test_long_context.py"},
     "distributed": {"test_distributed.py", "test_pipeline.py",
                     "test_moe.py", "test_multiprocess.py",
-                    "test_launch.py", "test_trainer.py"},
+                    "test_launch.py", "test_trainer.py",
+                    "test_fleet.py"},
     "surface": {"test_ops.py", "test_tensor.py", "test_api_surface.py",
                 "test_functional_extra.py", "test_guards.py"},
 }
@@ -108,6 +109,21 @@ _SLOW_TESTS = (
     # keep full unit/integration coverage in the default run, plus the
     # 4-10s parity tail — each area retains at least one smoke
     "test_robustness.py::TestChaosBench::test_chaos_recovery",
+    "test_fleet.py::test_bench_fleet_smoke",
+    # third tier (PR 13: the canonical window tightened back to ~835s
+    # body + ~35s interpreter teardown vs the 870s budget): the five
+    # heaviest remaining tests, each leaving fast siblings in its
+    # subsystem (pallas keeps flash_mask_fast_path_parity +
+    # grad_parity_interpret; hybrid TP keeps model_axis_comm + the
+    # bench smoke; diffusion pipeline keeps text_encoder_shapes +
+    # ddim_step; continuous batching and MoE keep their many others)
+    "test_pallas_train.py::test_flash_mask_dropout_bf16_gqa_train",
+    "test_hybrid.py::TestTensorParallel::"
+    "test_tp_llama_logits_and_loss_parity",
+    "test_diffusion.py::TestPipeline::test_no_cfg_path",
+    "test_generation.py::TestContinuousBatching::"
+    "test_streaming_mixed_lengths_matches_static_greedy",
+    "test_moe.py::test_moe_dense_equivalence_single_expert",
     "test_robustness.py::TestTrainerPreemption::"
     "test_sigterm_drain_deadline_bounds_exit",
     "test_serving_frontend.py::TestMultiTenantBenchSection::"

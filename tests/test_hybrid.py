@@ -758,8 +758,12 @@ class TestHybridBench:
         JSONL sink."""
         import bench
         out = str(tmp_path / "hybrid.jsonl")
+        # --no-fleet: the launcher-driven fleet-observability arm is a
+        # multi-process ~1-2 min scenario — covered by the slow-marked
+        # tests/test_fleet.py::test_bench_fleet_smoke
         rc = bench.train_bench(["--steps", "2", "--mesh",
-                                "data=4,model=2", "--out", out])
+                                "data=4,model=2", "--out", out,
+                                "--no-fleet"])
         assert rc == 0
         recs = [json.loads(l) for l in open(out) if l.strip()]
         hb = [r for r in recs if r.get("kind") == "hybrid_train_bench"]

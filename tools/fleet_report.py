@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Fleet view over a directory of per-rank telemetry JSONL files.
+
+Reads the per-rank files the elastic launcher lays out in its log dir
+(``telemetry_rank<k>.jsonl``, ``heartbeat_rank<k>.jsonl``, and the
+aggregator's ``fleet.jsonl`` when present — rotated ``.1`` siblings
+folded in), joins ``train.step`` spans across ranks on the global step
+index, and renders:
+
+- **per-rank step waterfall** — one row per step, one column per rank,
+  wall time aligned on the step index; the slowest rank per step is
+  marked, with the cross-rank skew (slowest - median) alongside;
+- **straggler ranking** — per-rank step stats (mean / p99 / worst
+  ratio vs the per-step median) sorted by how much fleet time the rank
+  cost, plus the aggregator's recorded straggler incidents
+  (``{"kind": "fleet", "event": "straggler"}``) or, without a
+  ``fleet.jsonl``, incidents recomputed here with the same
+  persistent-skew rule;
+- **comm-wait share** — per-rank time inside ``comm.*`` spans vs step
+  wall (the compute-or-comm-wait split of a slow step);
+- **comm balance** — per-axis cumulative ``comm.bytes`` across ranks
+  with the max/mean imbalance;
+- **heartbeat gaps** — each rank's worst inter-beat gap (a wedge reads
+  as one huge gap; a straggler as a normal cadence with slow steps).
+
+    python tools/fleet_report.py log/                 # launcher log dir
+    python tools/fleet_report.py log/ --steps 12
+    python tools/fleet_report.py a.jsonl b.jsonl      # explicit files
+
+No paddle_tpu/jax import — this runs anywhere there is a directory of
+files (the same contract as trace_report/metrics_report).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+from typing import Dict, List, Optional
+
+
+# ---------------------------------------------------------------- loading --
+def _jsonl_records(path: str) -> List[dict]:
+    """Parsed records of one JSONL file (rotated ``.1`` sibling first);
+    a torn final line warns and is skipped, interior garbage is skipped
+    silently."""
+    out = []
+    paths = ([path + ".1"] if os.path.exists(path + ".1") else []) + [path]
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                if i == len(lines) - 1:
+                    print(f"warning: {p}: skipping torn final line "
+                          f"({len(line)} bytes) — truncated mid-record "
+                          "(crash-time telemetry)", file=sys.stderr)
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _rank_of(path: str, rec: dict) -> str:
+    if rec.get("rank") is not None:
+        return str(rec["rank"])
+    import re
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    return m.group(1) if m else os.path.basename(path)
+
+
+def gather(paths: List[str]) -> List[str]:
+    """Expand directories into their per-rank file sets."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for pat in ("telemetry_rank*.jsonl", "heartbeat_rank*.jsonl",
+                        "fleet.jsonl"):
+                files.extend(sorted(glob.glob(os.path.join(p, pat))))
+        else:
+            files.append(p)
+    return files
+
+
+class Fleet:
+    """The joined cross-rank state, file-side (mirrors what the
+    launcher's FleetAggregator computes live)."""
+
+    def __init__(self):
+        self.steps: Dict[str, Dict[int, float]] = {}      # rank->step->s
+        self.children: Dict[str, Dict[int, Dict[str, float]]] = {}
+        self.comm_s: Dict[str, Dict[int, float]] = {}
+        self.comm_bytes: Dict[str, Dict[tuple, float]] = {}
+        self.beats: Dict[str, List[float]] = {}
+        self.fleet_events: List[dict] = []
+        self.topology: Optional[str] = None
+        self._trace_step: Dict[str, Dict[str, int]] = {}
+        self._orphan_comm: Dict[str, Dict[str, float]] = {}
+
+    def ingest(self, path: str):
+        for rec in _jsonl_records(path):
+            rank = _rank_of(path, rec)
+            if self.topology is None and rec.get("topology"):
+                self.topology = str(rec["topology"])
+            kind = rec.get("kind")
+            if kind == "span":
+                self._span(rank, rec)
+            elif kind == "heartbeat":
+                ts = rec.get("ts")
+                if ts is not None:
+                    self.beats.setdefault(rank, []).append(float(ts))
+            elif kind == "fleet":
+                self.fleet_events.append(rec)
+            elif rec.get("name") == "comm.bytes":
+                lab = rec.get("labels") or {}
+                ax = lab.get("axis")
+                if ax is not None:
+                    per = self.comm_bytes.setdefault(rank, {})
+                    per[(ax, lab.get("op", "?"))] = \
+                        float(rec.get("value") or 0.0)
+
+    def _span(self, rank: str, rec: dict):
+        name = rec.get("name") or ""
+        labels = rec.get("labels") or {}
+        trace = rec.get("trace")
+        dur = float(rec.get("dur") or 0.0)
+        if name == "train.step" and labels.get("step") is not None:
+            step = int(labels["step"])
+            self.steps.setdefault(rank, {})[step] = dur
+            if trace:
+                self._trace_step.setdefault(rank, {})[trace] = step
+                pend = self._orphan_comm.get(rank, {}).pop(trace, None)
+                if pend:
+                    c = self.comm_s.setdefault(rank, {})
+                    c[step] = c.get(step, 0.0) + pend
+        elif name.startswith("train.") and labels.get("step") is not None:
+            step = int(labels["step"])
+            if trace:
+                self._trace_step.setdefault(rank, {})[trace] = step
+                pend = self._orphan_comm.get(rank, {}).pop(trace, None)
+                if pend:
+                    c = self.comm_s.setdefault(rank, {})
+                    c[step] = c.get(step, 0.0) + pend
+            ch = self.children.setdefault(rank, {}).setdefault(step, {})
+            ch[name] = ch.get(name, 0.0) + dur
+        elif name.startswith("comm."):
+            step = self._trace_step.get(rank, {}).get(trace) \
+                if trace else None
+            if step is not None:
+                c = self.comm_s.setdefault(rank, {})
+                c[step] = c.get(step, 0.0) + dur
+            elif trace:
+                o = self._orphan_comm.setdefault(rank, {})
+                o[trace] = o.get(trace, 0.0) + dur
+
+    # ------------------------------------------------------- analysis --
+    def joined_steps(self) -> List[int]:
+        """Steps every rank reported, ascending."""
+        if not self.steps:
+            return []
+        common = None
+        for per in self.steps.values():
+            common = set(per) if common is None else common & set(per)
+        return sorted(common or [])
+
+    def stragglers(self, factor: float, min_steps: int) -> List[dict]:
+        """Recorded aggregator incidents, else recomputed with the
+        same persistent-skew rule."""
+        recorded = [e for e in self.fleet_events
+                    if e.get("event") == "straggler"]
+        if recorded:
+            return recorded
+        out, consec, active = [], {}, set()
+        ranks = sorted(self.steps)
+        if len(ranks) < 2 or factor <= 0:
+            return out
+        for step in self.joined_steps():
+            durs = {r: self.steps[r][step] for r in ranks}
+            med = statistics.median(durs.values())
+            for r, d in durs.items():
+                if med > 0 and d > factor * med:
+                    consec[r] = consec.get(r, 0) + 1
+                    if consec[r] >= min_steps and r not in active:
+                        active.add(r)
+                        ch = (self.children.get(r) or {}).get(step) or {}
+                        out.append({
+                            "rank": r, "step": step,
+                            "dur_s": round(d, 6),
+                            "median_s": round(med, 6),
+                            "ratio": round(d / med, 3),
+                            "consecutive": consec[r],
+                            "dominant_span":
+                                max(ch, key=ch.get) if ch else None})
+                else:
+                    consec[r] = 0
+                    active.discard(r)
+        return out
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
+def percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    pos = q * (len(ys) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ys) - 1)
+    frac = pos - lo
+    return ys[lo] * (1 - frac) + ys[hi] * frac
+
+
+# --------------------------------------------------------------- rendering --
+def render(fleet: Fleet, waterfall_steps: int = 10,
+           straggler_factor: float = 2.0,
+           straggler_min_steps: int = 3) -> str:
+    out = []
+    w = out.append
+    ranks = sorted(fleet.steps, key=lambda r: (len(r), r))
+    joined = fleet.joined_steps()
+    if fleet.topology:
+        w(f"topology: {fleet.topology}   ranks: {len(ranks)}")
+
+    # ---- per-rank step waterfall (aligned on the step index) --------
+    if joined and len(ranks) >= 1:
+        w("== per-rank step waterfall (last %d joined steps, ms; * = "
+          "slowest) ==" % min(waterfall_steps, len(joined)))
+        w("  " + f"{'step':>6}  "
+          + "".join(f"{'r' + r:>10}" for r in ranks)
+          + f"{'skew ms':>10}")
+        for step in joined[-waterfall_steps:]:
+            durs = {r: fleet.steps[r][step] for r in ranks}
+            med = statistics.median(durs.values())
+            slowest = max(durs, key=durs.get)
+            cols = ""
+            for r in ranks:
+                mark = "*" if r == slowest and len(ranks) > 1 else " "
+                cols += f"{durs[r] * 1e3:>9.1f}{mark}"
+            w(f"  {step:>6}  {cols}"
+              f"{(durs[slowest] - med) * 1e3:>10.1f}")
+
+    # ---- straggler ranking ------------------------------------------
+    if joined and len(ranks) >= 2:
+        per_rank: Dict[str, List[float]] = {r: [] for r in ranks}
+        ratios: Dict[str, List[float]] = {r: [] for r in ranks}
+        excess: Dict[str, float] = {r: 0.0 for r in ranks}
+        for step in joined:
+            durs = {r: fleet.steps[r][step] for r in ranks}
+            med = statistics.median(durs.values())
+            for r in ranks:
+                per_rank[r].append(durs[r])
+                ratios[r].append(durs[r] / med if med > 0 else 1.0)
+                excess[r] += max(0.0, durs[r] - med)
+        w("== straggler ranking (by fleet time cost: Σ max(0, rank - "
+          "median)) ==")
+        w(f"  {'rank':<6}{'steps':>6}{'mean ms':>9}{'p99 ms':>9}"
+          f"{'worst x':>9}{'excess ms':>11}")
+        for r in sorted(ranks, key=lambda r: -excess[r]):
+            xs = per_rank[r]
+            w(f"  {r:<6}{len(xs):>6}"
+              f"{(sum(xs) / len(xs)) * 1e3:>9.1f}"
+              f"{percentile(xs, 0.99) * 1e3:>9.1f}"
+              f"{max(ratios[r]):>9.2f}"
+              f"{excess[r] * 1e3:>11.1f}")
+        incidents = fleet.stragglers(straggler_factor,
+                                     straggler_min_steps)
+        if incidents:
+            w("  detected stragglers:")
+            for e in incidents:
+                w(f"    rank {e.get('rank')} flagged at step "
+                  f"{e.get('step')}: "
+                  f"{float(e.get('dur_s', 0)) * 1e3:.1f}ms vs median "
+                  f"{float(e.get('median_s', 0)) * 1e3:.1f}ms "
+                  f"({e.get('ratio')}x, {e.get('consecutive')} "
+                  f"consecutive; dominant span "
+                  f"{e.get('dominant_span') or 'unknown'})")
+
+    # ---- comm-wait share --------------------------------------------
+    if joined and any(fleet.comm_s.values()):
+        w("== comm-wait share (time inside comm.* spans / step wall) ==")
+        w(f"  {'rank':<6}{'comm ms':>10}{'step ms':>10}{'share':>8}")
+        for r in ranks:
+            comm = sum((fleet.comm_s.get(r) or {}).get(s, 0.0)
+                       for s in joined)
+            wall = sum(fleet.steps[r][s] for s in joined)
+            share = comm / wall if wall > 0 else 0.0
+            w(f"  {r:<6}{comm * 1e3:>10.1f}{wall * 1e3:>10.1f}"
+              f"{100.0 * share:>7.1f}%")
+
+    # ---- comm balance ------------------------------------------------
+    if fleet.comm_bytes:
+        axes: Dict[str, Dict[str, float]] = {}
+        for r, per in fleet.comm_bytes.items():
+            for (ax, _op), v in per.items():
+                axes.setdefault(ax, {}).setdefault(r, 0.0)
+                axes[ax][r] += v
+        w("== comm balance (cumulative bytes per axis) ==")
+        for ax in sorted(axes):
+            by_rank = axes[ax]
+            vals = list(by_rank.values())
+            mean = sum(vals) / len(vals)
+            imb = (max(vals) / mean) if mean > 0 else 1.0
+            cols = "   ".join(f"r{r}={_fmt_bytes(by_rank[r])}"
+                              for r in sorted(by_rank))
+            w(f"  {ax:<8}{cols}   (max/mean {imb:.2f})")
+
+    # ---- heartbeat gaps ---------------------------------------------
+    gaps = {}
+    for r, ts in fleet.beats.items():
+        ts = sorted(ts)
+        worst = max((b - a for a, b in zip(ts, ts[1:])), default=0.0)
+        gaps[r] = (worst, len(ts))
+    if gaps:
+        w("== heartbeat gaps (worst inter-beat silence per rank) ==")
+        w(f"  {'rank':<6}{'beats':>7}{'worst gap s':>13}")
+        for r in sorted(gaps):
+            worst, n = gaps[r]
+            flag = "   << silent window" if worst >= 5.0 else ""
+            w(f"  {r:<6}{n:>7}{worst:>13.2f}{flag}")
+
+    return "\n".join(out) if out else \
+        ("(no fleet telemetry found — need telemetry_rank<k>.jsonl "
+         "files with train.step spans; run under "
+         "paddle_tpu.distributed.launch)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="launcher log dir(s) and/or per-rank JSONL "
+                         "files")
+    ap.add_argument("--steps", type=int, default=10,
+                    help="waterfall rows (last N joined steps)")
+    ap.add_argument("--straggler-factor", type=float, default=2.0,
+                    help="persistent-skew threshold (x median) when "
+                         "recomputing incidents without a fleet.jsonl")
+    ap.add_argument("--straggler-steps", type=int, default=3,
+                    help="consecutive slow steps before flagging")
+    a = ap.parse_args(argv)
+    files = gather(a.paths)
+    if not files:
+        print("no telemetry files found under: " + ", ".join(a.paths),
+              file=sys.stderr)
+        return 1
+    fleet = Fleet()
+    for f in files:
+        fleet.ingest(f)
+    print(render(fleet, waterfall_steps=a.steps,
+                 straggler_factor=a.straggler_factor,
+                 straggler_min_steps=a.straggler_steps))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,12 @@ HOT_PATH_FUNCTIONS = (
     # tracer bools / host transfers here poison every compile
     ("paddle_tpu/distributed/fleet/meta_parallel/mp_layers.py",
      "*.forward"),
+    # fleet aggregator tail loop: runs at heartbeat cadence inside the
+    # launcher babysit loop — must stay file-I/O-only (no device work,
+    # no blocking syncs); a host sync here stalls hang/straggler
+    # detection for the whole pod
+    ("paddle_tpu/observability/fleet.py", "FleetAggregator.*"),
+    ("paddle_tpu/observability/fleet.py", "RankFileTailer.*"),
 )
 
 
@@ -128,8 +134,8 @@ RUNTIME_CONFIG_KNOBS = frozenset({
 # readers ship code too — the closing-the-loop pipeline is only as
 # trustworthy as its tools).
 TOOL_ENTRY_POINTS = ("tools/autotune.py", "tools/trace_report.py",
-                     "tools/metrics_report.py", "tools/aot_report.py",
-                     "bench.py")
+                     "tools/metrics_report.py", "tools/fleet_report.py",
+                     "tools/aot_report.py", "bench.py")
 
 # --------------------------------------------------------------- GL105 --
 # Where telemetry is emitted (scanned for counter/gauge/histogram/span/
@@ -144,4 +150,4 @@ FLAG_DOC_ROOTS = ("docs", "README.md")
 # examples (myapp.*) and module paths in backticks stay out of scope.
 CATALOG_PREFIXES = ("train", "serve", "serving", "comm", "mem", "pp",
                     "robustness", "aot", "ckpt", "dist", "launch",
-                    "bench", "router", "kernels", "autotune")
+                    "bench", "router", "kernels", "autotune", "fleet")

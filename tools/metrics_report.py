@@ -8,6 +8,16 @@ generic last-value table for everything else.
 
     python tools/metrics_report.py telemetry.jsonl
     python tools/metrics_report.py telemetry.jsonl --follow   # tail -f
+    # fleet output: several per-rank files, or a launcher log dir
+    python tools/metrics_report.py log/telemetry_rank*.jsonl --follow
+    python tools/metrics_report.py --dir log/
+
+Multiple files (or ``--dir`` with a launcher log directory of
+``telemetry_rank<k>.jsonl``) merge into one view; lines carrying a
+fleet ``rank`` field keep their series distinct (the rank joins the
+label set), and ``--follow`` tails every file at once. Rotated ``.1``
+siblings fold in per file. Cross-rank skew/straggler/comm-balance
+views: ``tools/fleet_report.py``.
 
 No paddle_tpu import needed — this runs anywhere there is a file.
 """
@@ -60,10 +70,18 @@ def parse(lines, last=None, spans=None):
             if spans is not None:
                 _ingest_span(spans, rec)
             continue
+        if rec.get("kind") == "fleet":
+            continue   # aggregator records: fleet_report's domain
         name = rec.get("name")
         if not name:
             continue
-        key = (name, tuple(sorted((rec.get("labels") or {}).items())))
+        labels = dict(rec.get("labels") or {})
+        if rec.get("rank") is not None and "rank" not in labels:
+            # fleet identity: per-rank files merge into one view, so
+            # the writing rank joins the label set to keep each rank's
+            # series distinct (same join key fleet_report uses)
+            labels["rank"] = rec["rank"]
+        key = (name, tuple(sorted(labels.items())))
         last[key] = rec
     return last
 
@@ -160,13 +178,17 @@ def render(last, spans=None) -> str:
     comm = _series(last, "comm.bytes")
     if comm:
         calls = _series(last, "comm.calls")
+        fleet = any("rank" in dict(lb) for lb in comm)
         w("== collectives (cumulative) ==")
-        w(f"  {'op':<16}{'axis':<10}{'calls':>10}{'bytes':>12}")
+        w(f"  {'op':<16}{'axis':<10}"
+          + (f"{'rank':<6}" if fleet else "")
+          + f"{'calls':>10}{'bytes':>12}")
         for labels, rec in sorted(comm.items()):
             lab = dict(labels)
             n_calls = calls.get(labels, {}).get("value", 0)
             w(f"  {lab.get('op', '?'):<16}{lab.get('axis', '?'):<10}"
-              f"{int(n_calls):>10}{_fmt_bytes(rec['value']):>12}")
+              + (f"{str(lab.get('rank', '?')):<6}" if fleet else "")
+              + f"{int(n_calls):>10}{_fmt_bytes(rec['value']):>12}")
 
     adm = _one(last, "serving.admissions")
     if adm:
@@ -419,45 +441,91 @@ def _ingest_rotated(path, last, spans):
     return parse(lines, last, spans)
 
 
+def expand_inputs(paths, dirs):
+    """Positional files plus each directory's telemetry*.jsonl
+    (per-rank fleet layout); order-preserving de-dup."""
+    import glob as _glob
+    files, extra_dirs = [], list(dirs)
+    for p in paths:
+        (extra_dirs if os.path.isdir(p) else files).append(p)
+    for d in extra_dirs:
+        files.extend(sorted(_glob.glob(os.path.join(d,
+                                                    "telemetry*.jsonl"))))
+    seen, out = set(), []
+    for f in files:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="telemetry JSONL file")
+    ap.add_argument("paths", nargs="*",
+                    help="telemetry JSONL file(s) and/or launcher log "
+                         "directories (per-rank telemetry_rank<k> "
+                         "files merge into one view)")
+    ap.add_argument("--dir", action="append", default=[],
+                    help="a launcher log directory: every "
+                         "telemetry*.jsonl in it joins the view; "
+                         "repeatable")
     ap.add_argument("--follow", action="store_true",
                     help="re-render every --interval seconds")
     ap.add_argument("--interval", type=float, default=2.0)
     a = ap.parse_args(argv)
-    last, spans, offset = {}, {}, 0
-    rotated_seen = False
-    ino = None
+    files = expand_inputs(a.paths, list(a.dir))
+    if not files:
+        print("no input files (pass telemetry JSONL paths and/or "
+              "--dir <log_dir>)", file=sys.stderr)
+        return 1
+    last, spans = {}, {}
+    state = {f: {"offset": 0, "ino": None, "rotated_seen": False}
+             for f in files}
+
+    def _reset_all():
+        nonlocal last, spans
+        last, spans = {}, {}
+        for st in state.values():
+            st.update(offset=0, ino=None, rotated_seen=False)
+
     while True:
-        try:
-            st = os.stat(a.path)
-            if st.st_size < offset or (ino is not None
-                                       and st.st_ino != ino):
+        found = 0
+        for path in files:
+            fs = state[path]
+            try:
+                st = os.stat(path)
+            except FileNotFoundError:
+                continue
+            found += 1
+            if st.st_size < fs["offset"] or (fs["ino"] is not None
+                                             and st.st_ino != fs["ino"]):
                 # truncated OR rotated under us — the inode check
                 # catches a rotation where the fresh file already grew
-                # past the old offset within one poll interval. Start
-                # over; the rotated sibling re-ingests below, so no
-                # samples from a mid-follow rotation are lost.
-                offset, last, spans = 0, {}, {}
-                rotated_seen = False
-            ino = st.st_ino
-            if not rotated_seen:
-                rotated_seen = True
-                last = _ingest_rotated(a.path, last, spans)
-            lines, offset, tail = _read_complete(a.path, offset)
+                # past the old offset within one poll interval. With a
+                # shared merged view the only safe recovery is a full
+                # re-ingest of every file (rotated siblings included),
+                # so no samples from a mid-follow rotation are lost.
+                _reset_all()
+                fs = state[path]
+            fs["ino"] = st.st_ino
+            if not fs["rotated_seen"]:
+                fs["rotated_seen"] = True
+                last = _ingest_rotated(path, last, spans)
+            lines, fs["offset"], tail = _read_complete(path, fs["offset"])
             last = parse(lines, last, spans)
             if tail.strip() and not a.follow:
                 # one-shot read at EOF: the unterminated tail can only
                 # be a torn final line (crash-time write) — warn and
                 # move on; in --follow mode it may still be completed
                 # by the writer, so it is simply re-read next refresh
-                print(f"warning: {a.path}: skipping torn final line "
+                print(f"warning: {path}: skipping torn final line "
                       f"({len(tail)} bytes) — truncated mid-record "
                       "(crash-time telemetry)", file=sys.stderr)
-        except FileNotFoundError:
-            print(f"(waiting for {a.path})" if a.follow
-                  else f"no such file: {a.path}", file=sys.stderr)
+        if not found:
+            names = ", ".join(files)
+            print(f"(waiting for {names})" if a.follow
+                  else f"no such file: {names}", file=sys.stderr)
             if not a.follow:
                 return 1
             time.sleep(a.interval)

@@ -30,6 +30,16 @@ flight-recorder dump (`flight_<pid>.json`, written to
     python tools/trace_report.py output/flight_1234.json --chrome trace.json
     python tools/trace_report.py telemetry.jsonl --recovery \
         --heartbeat log/heartbeat_rank0.jsonl
+    # fleet output: several per-rank files, or a whole launcher log dir
+    python tools/trace_report.py log/telemetry_rank*.jsonl
+    python tools/trace_report.py --dir log/
+
+Multiple inputs (or ``--dir`` with a launcher log directory of
+``telemetry_rank<k>.jsonl`` files) merge into one span pool — rotated
+``.1`` siblings are folded in per file; with ``--recovery`` a
+directory's ``heartbeat_rank*.jsonl`` files join automatically. For
+the cross-rank views (step skew, stragglers, comm balance) see
+``tools/fleet_report.py``.
 
 No paddle_tpu import needed — this runs anywhere there is a file.
 """
@@ -397,6 +407,8 @@ def render(spans: List[dict], top_requests: int = 5,
             kids = {c.get("name"): float(c.get("dur") or 0.0)
                     for c in a["children"].get(s.get("span"), [])}
             n = (s.get("labels") or {}).get("step", "?")
+            if s.get("rank") is not None:   # merged fleet pool: name
+                n = f"{n}:r{s['rank']}"     # the writing rank
             total = float(s.get("dur") or 0.0) * 1e3
             cols = "  ".join(f"{kids.get(p, 0.0) * 1e3:9.2f}ms"
                              for p in phases)
@@ -445,10 +457,42 @@ def to_chrome_trace(spans: List[dict]) -> dict:
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
+def expand_inputs(paths: List[str], dirs: List[str]) -> List[str]:
+    """Positional files plus each --dir's telemetry files (a directory
+    given positionally works too). ``.1`` rotation siblings are NOT
+    listed — load_spans folds them in per file."""
+    import glob as _glob
+    files: List[str] = []
+    for p in list(paths):
+        if os.path.isdir(p):
+            dirs = dirs + [p]
+        else:
+            files.append(p)
+    for d in dirs:
+        files.extend(sorted(_glob.glob(os.path.join(d,
+                                                    "telemetry*.jsonl"))))
+    # de-dup, order-preserving (a file named positionally AND via --dir)
+    seen = set()
+    out = []
+    for f in files:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="telemetry JSONL or a flight dump "
-                                 "(output/flight_<pid>.json)")
+    ap.add_argument("paths", nargs="*",
+                    help="telemetry JSONL file(s), flight dump(s) "
+                         "(output/flight_<pid>.json), and/or "
+                         "directories of per-rank files")
+    ap.add_argument("--dir", action="append", default=[],
+                    help="a launcher log directory: every "
+                         "telemetry*.jsonl in it joins the span pool "
+                         "(telemetry_rank<k>.jsonl fleet layout); "
+                         "repeatable")
     ap.add_argument("--requests", type=int, default=5,
                     help="slowest-request table size")
     ap.add_argument("--steps", type=int, default=8,
@@ -466,13 +510,32 @@ def main(argv=None) -> int:
                          "--recovery (e.g. <log_dir>/"
                          "heartbeat_rank0.jsonl); repeatable")
     a = ap.parse_args(argv)
-    try:
-        spans = load_spans(a.path)
-    except FileNotFoundError:
-        print(f"no such file: {a.path}", file=sys.stderr)
+    files = expand_inputs(a.paths, list(a.dir))
+    if not files:
+        print("no input files (pass telemetry JSONL paths and/or "
+              "--dir <log_dir>)", file=sys.stderr)
         return 1
+    spans = []
+    missing = 0
+    for path in files:
+        try:
+            spans.extend(load_spans(path))
+        except FileNotFoundError:
+            print(f"no such file: {path}", file=sys.stderr)
+            missing += 1
+    if missing == len(files):
+        return 1
+    if len(files) > 1:
+        # merged multi-rank pools interleave chronologically, so the
+        # "last N steps" views mean the same thing they do for one file
+        spans.sort(key=lambda s: float(s.get("start") or 0.0))
     if a.recovery:
-        beats = load_heartbeats([a.path] + list(a.heartbeat))
+        hb_files = list(files) + list(a.heartbeat)
+        for d in list(a.dir) + [p for p in a.paths if os.path.isdir(p)]:
+            import glob as _glob
+            hb_files.extend(sorted(_glob.glob(
+                os.path.join(d, "heartbeat*.jsonl"))))
+        beats = load_heartbeats(hb_files)
         print(render_recovery(spans, beats))
     else:
         print(render(spans, top_requests=a.requests,

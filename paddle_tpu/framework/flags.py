@@ -221,6 +221,24 @@ define_flag("serve_prefill_chunk_tokens", 0,
             "latency bound; min one page); the per-tick chunk shrinks "
             "under decode load. 0 disables (constructor "
             "prefill_chunk_tokens overrides).")
+define_flag("serve_spec_draft_tokens", 0,
+            "Speculative decoding: up to this many prompt-lookup "
+            "drafted tokens are verified per compiled decode step "
+            "(the verify span is draft_tokens + 1 wide; greedy output "
+            "is bitwise-identical to plain greedy decode, sampled "
+            "output rejection-sampling-correct). 0 disables "
+            "(constructor spec_draft_tokens overrides; "
+            "docs/SERVING.md 'Speculative decoding & sampling').")
+define_flag("serve_spec_ngram_max", 3,
+            "Prompt-lookup drafting: longest suffix n-gram matched "
+            "against the request's own prompt+generation history when "
+            "proposing draft tokens (host-side, no second model).")
+define_flag("serve_sampling", False,
+            "Serve-loop on-device sampling: compile the decode step "
+            "with per-request temperature/top-k/top-p/seed as batched "
+            "operands (requests without SamplingParams stay greedy — "
+            "temperature 0 reduces to the argmax bitwise). Off keeps "
+            "the plain argmax decode program.")
 define_flag("serve_decode_watchdog_s", 0.0,
             "ContinuousBatchingPredictor decode watchdog: if a decode "
             "step's host sync does not resolve within this many "

@@ -54,6 +54,12 @@ CONFIG_VERSION = 1
 COMPILED_FIELDS = frozenset({
     "max_batch_size", "page_size", "num_pages", "max_seq_len",
     "prompt_buckets", "prefill_chunk_tokens",
+    # speculative decoding + on-device sampling are PROGRAM VARIANTS:
+    # the spec-verify span bucket derives from spec_draft_tokens and
+    # sampling_enabled switches the decode program to the
+    # batched-operand sampling variant — different executables either
+    # way (spec_ngram_max is host-side drafting policy: runtime-only)
+    "spec_draft_tokens", "sampling_enabled",
 })
 
 # FLAGS_* knobs that migrated INTO RuntimeConfig: reading any of these
@@ -63,6 +69,9 @@ COMPILED_FIELDS = frozenset({
 MIGRATED_FLAG_KNOBS = {
     "serve_prefill_chunk_tokens": "prefill_chunk_tokens",
     "serve_decode_watchdog_s": "decode_watchdog_s",
+    "serve_spec_draft_tokens": "spec_draft_tokens",
+    "serve_spec_ngram_max": "spec_ngram_max",
+    "serve_sampling": "sampling_enabled",
     "grad_bucket_bytes": "grad_bucket_bytes",
     "quantized_grad_comm": "quantized_grad_comm",
 }
@@ -87,6 +96,17 @@ class RuntimeConfig:
     # (the historical LLMPredictor._bucket behavior)
     prompt_buckets: Tuple[int, ...] = ()
     prefill_chunk_tokens: int = 0          # 0 = monolithic prefill
+    # speculative decoding: max drafted tokens per verify step (the
+    # compiled verify span is spec_draft_tokens + 1 wide); 0 = off.
+    # sampling_enabled switches decode to the batched-operand sampling
+    # program (per-request temperature/top-k/top-p/seed; temperature 0
+    # is greedy, token-identical to the argmax program). Both are
+    # COMPILED_FIELDS — program variants, not runtime knobs.
+    spec_draft_tokens: int = 0
+    # prompt-lookup drafting: longest suffix n-gram matched against the
+    # request's own prompt+generation history (runtime-only policy)
+    spec_ngram_max: int = 3
+    sampling_enabled: bool = False
 
     # -- serving robustness / fairness (runtime-only) --------------------
     max_queue: Optional[int] = None        # None = unbounded backlog
@@ -120,6 +140,11 @@ class RuntimeConfig:
         if self.zero_stage not in (0, 1, 2, 3):
             raise ValueError(
                 f"zero_stage must be 0..3, got {self.zero_stage!r}")
+        if self.spec_draft_tokens < 0 or self.spec_ngram_max < 1:
+            raise ValueError(
+                "spec_draft_tokens must be >= 0 and spec_ngram_max "
+                f">= 1, got {self.spec_draft_tokens!r}/"
+                f"{self.spec_ngram_max!r}")
         # normalize buckets: sorted unique ints (hash stability)
         object.__setattr__(
             self, "prompt_buckets",
@@ -144,6 +169,9 @@ class RuntimeConfig:
             prefill_chunk_tokens=int(
                 _fv("serve_prefill_chunk_tokens", 0)),
             decode_watchdog_s=float(_fv("serve_decode_watchdog_s", 0.0)),
+            spec_draft_tokens=int(_fv("serve_spec_draft_tokens", 0)),
+            spec_ngram_max=int(_fv("serve_spec_ngram_max", 3)),
+            sampling_enabled=bool(_fv("serve_sampling", False)),
             grad_bucket_bytes=int(_fv("grad_bucket_bytes", 32 << 20)),
             quantized_grad_comm=bool(_fv("quantized_grad_comm", False)),
         )

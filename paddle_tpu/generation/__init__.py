@@ -119,11 +119,17 @@ class GenerationMixin:
                 if isinstance(attention_mask, Tensor) else attention_mask
             ).astype(np.int32)
         if cfg.seed is not None:
-            key = jax.random.key(cfg.seed)
+            base_seed = int(cfg.seed)
         else:
-            # fresh randomness from the global generator (paddle.seed)
+            # fresh randomness from the global generator (paddle.seed):
+            # one host draw anchors the whole call's counter-based key
+            # streams (generation/sampling.py) — row r of the batch
+            # seeds at base_seed + r, token t draws with counter t, so
+            # the SAME seed replayed through the eager, static, or
+            # serve-loop path yields the same sampled tokens
             from ..framework.random import next_key
-            key = next_key()
+            base_seed = int(jax.random.randint(
+                next_key(), (), 0, np.int32(2 ** 31 - 1)))
 
         beam = cfg.decode_strategy == "beam_search"
         if not beam and (cfg.num_beams or 1) > 1:
@@ -144,13 +150,15 @@ class GenerationMixin:
             # row's last prompt token shares one slot
             if (mask == 0).any():
                 ids, mask = _left_pad(ids, mask, cfg.pad_token_id)
-            out, scores = self._generate_static(ids, mask, key, cfg)
+            out, scores = self._generate_static(ids, mask, base_seed,
+                                                cfg)
         else:
-            out, scores = self._generate_eager(ids, mask, key, cfg)
+            out, scores = self._generate_eager(ids, mask, base_seed,
+                                               cfg)
         return Tensor(out), Tensor(scores)
 
     # -- jitted static-cache path ----------------------------------------
-    def _generate_static(self, ids, mask, key, cfg):
+    def _generate_static(self, ids, mask, base_seed, cfg):
         from ..jit.bridge import functionalize
         from ..autograd.grad_mode import no_grad
 
@@ -175,9 +183,10 @@ class GenerationMixin:
         # are picked up without retracing
         p_vals = [p._value for _, p in self.named_parameters()]
         b_vals = [b._value for _, b in self.named_buffers()]
+        seeds = jnp.asarray(base_seed + np.arange(B), jnp.int32)
         with no_grad():
             out, scores = fn(p_vals, b_vals, jnp.asarray(ids, jnp.int32),
-                             jnp.asarray(mask, jnp.int32), key)
+                             jnp.asarray(mask, jnp.int32), seeds)
         return np.asarray(out), np.asarray(scores)
 
     def _make_cache_runner(self, n_layers):
@@ -251,18 +260,30 @@ class GenerationMixin:
         track_counts = rep_pen != 1.0
         run_model = self._make_cache_runner(n_layers)
 
-        def sample_step(logits, k, counts, cur_len):
-            lg = logits.astype(jnp.float32)
-            lg = LP.min_length_mask(lg, cur_len, min_new, eos)
-            lg = LP.process_logits(
-                lg, temperature=temperature, top_k=top_k, top_p=top_p,
-                token_counts=counts if track_counts else None,
-                rep_penalty=rep_pen)
-            k, sub = jax.random.split(k)
-            tok, logp = LP.sample_token(lg, sub, greedy=greedy)
-            return tok, logp, k
+        from . import sampling as SK
 
-        def raw(p, b, ids, mask, key):
+        def sample_step(logits, seeds, counts, step_idx):
+            # the SHARED on-device sampling kernel (generation/
+            # sampling.py): temperature/top-k/top-p as operands, keys
+            # from fold_in(key(seed), token_index) — the serve loop
+            # runs the identical kernel with per-request operands, so
+            # a fixed seed yields the same stream on either path
+            lg = logits.astype(jnp.float32)
+            lg = LP.min_length_mask(lg, step_idx, min_new, eos)
+            if track_counts and rep_pen != 1.0:
+                lg = LP.repetition_penalty(lg, counts, rep_pen)
+            tok, logp = SK.sample_tokens(
+                lg,
+                jnp.full((B,), 0.0 if greedy else float(temperature),
+                         jnp.float32),
+                jnp.full((B,), int(top_k), jnp.int32),
+                jnp.full((B,), float(top_p), jnp.float32),
+                seeds,
+                jnp.broadcast_to(jnp.asarray(step_idx, jnp.int32),
+                                 (B,)))
+            return tok, logp
+
+        def raw(p, b, ids, mask, seeds):
             real_len = jnp.sum(mask, axis=1)  # [B]
             logits, kv, kmask, _ = self._cache_prefill(
                 run_model, p, b, ids, mask, n_layers, n_kv, head_dim,
@@ -271,15 +292,15 @@ class GenerationMixin:
                       .at[jnp.arange(B)[:, None], ids].add(
                           mask.astype(jnp.int32))
                       if track_counts else jnp.zeros((B, 1), jnp.int32))
-            tok0, logp0, key2 = sample_step(
-                logits[:, -1, :], key, counts, jnp.int32(0))
+            tok0, logp0 = sample_step(
+                logits[:, -1, :], seeds, counts, jnp.int32(0))
             finished0 = (tok0 == eos) if eos is not None \
                 else jnp.zeros((B,), bool)
             if track_counts:
                 counts = counts.at[jnp.arange(B), tok0].add(1)
 
             def body(carry, step):
-                tok, kvs, km, k, fin, cnt = carry
+                tok, kvs, km, fin, cnt = carry
                 slot = S + step
                 km = jax.lax.dynamic_update_slice(
                     km, jnp.ones((B, 1), bool),
@@ -287,7 +308,8 @@ class GenerationMixin:
                 am = km[:, None, None, :]
                 pid = (real_len + step)[:, None]
                 lg, kvs = run_model(p, b, tok[:, None], am, pid, slot, kvs)
-                ntok, nlogp, k = sample_step(lg[:, -1, :], k, cnt, step + 1)
+                ntok, nlogp = sample_step(lg[:, -1, :], seeds, cnt,
+                                          step + 1)
                 if eos is not None:
                     newly_fin = fin | (ntok == eos)
                 else:
@@ -297,10 +319,10 @@ class GenerationMixin:
                 if track_counts:
                     cnt = cnt.at[jnp.arange(B), emit].add(
                         (~fin).astype(jnp.int32))
-                return (emit, kvs, km, k, newly_fin, cnt), (emit, elogp)
+                return (emit, kvs, km, newly_fin, cnt), (emit, elogp)
 
             if N > 1:
-                init = (tok0, kv, kmask, key2, finished0, counts)
+                init = (tok0, kv, kmask, finished0, counts)
                 _, (toks, logps) = jax.lax.scan(
                     body, init, jnp.arange(N - 1, dtype=jnp.int32))
                 all_toks = jnp.concatenate(
@@ -318,29 +340,37 @@ class GenerationMixin:
         return jax.jit(raw)
 
     # -- eager fallback (no cache protocol needed) -----------------------
-    def _generate_eager(self, ids, mask, key, cfg):
+    def _generate_eager(self, ids, mask, base_seed, cfg):
         # plain `forward(input_ids)` has no mask/position inputs, so a
         # padded batch would attend pad tokens at shifted positions —
         # run each ragged row on its own (correctness over speed; the
-        # static-cache path is the fast ragged-batch route)
+        # static-cache path is the fast ragged-batch route). Row b
+        # seeds at base_seed + b, matching the batched path's
+        # per-row seed layout.
         if (mask == 0).any():
             outs, scores = [], []
             for b in range(ids.shape[0]):
                 row = ids[b][mask[b].astype(bool)][None, :]
-                key, sub = jax.random.split(key)
                 o, s = self._generate_eager(
-                    row, np.ones_like(row, dtype=np.int32), sub, cfg)
+                    row, np.ones_like(row, dtype=np.int32),
+                    base_seed + b, cfg)
                 outs.append(o[0])
                 scores.append(s[0])
             return np.stack(outs), np.asarray(scores, np.float32)
-        return self._generate_eager_batch(ids, mask, key, cfg)
+        return self._generate_eager_batch(ids, mask, base_seed, cfg)
 
-    def _generate_eager_batch(self, ids, mask, key, cfg):
+    def _generate_eager_batch(self, ids, mask, base_seed, cfg):
         from ..tensor import Tensor
         from ..autograd.grad_mode import no_grad
+        from . import sampling as SK
 
         greedy = cfg.decode_strategy in ("greedy_search", "greedy")
         B = ids.shape[0]
+        s_temp = np.full((B,), 0.0 if greedy else float(cfg.temperature),
+                         np.float32)
+        s_topk = np.full((B,), int(cfg.top_k), np.int32)
+        s_topp = np.full((B,), float(cfg.top_p), np.float32)
+        s_seed = (int(base_seed) + np.arange(B)).astype(np.int32)
         # graft-lint: ok[GL102] — ids is the caller's host array
         # (numpy->numpy normalization, not a device download)
         cur = np.asarray(ids)
@@ -362,14 +392,16 @@ class GenerationMixin:
                       else out)._value[:, -1, :].astype(jnp.float32)
                 lg = LP.min_length_mask(lg, step, cfg.min_new_tokens,
                                         cfg.eos_token_id)
-                lg = LP.process_logits(
-                    lg, temperature=cfg.temperature, top_k=cfg.top_k,
-                    top_p=cfg.top_p,
-                    token_counts=(jnp.asarray(counts)
-                                  if counts is not None else None),
-                    rep_penalty=cfg.repetition_penalty)
-                key, sub = jax.random.split(key)
-                tok, logp = LP.sample_token(lg, sub, greedy=greedy)
+                if counts is not None and cfg.repetition_penalty != 1.0:
+                    lg = LP.repetition_penalty(
+                        lg, jnp.asarray(counts), cfg.repetition_penalty)
+                # the SHARED sampling kernel (generation/sampling.py):
+                # counter-based keys — token `step` of row b draws with
+                # fold_in(key(base_seed + b), step), the same stream
+                # the static path and the serve loop use
+                tok, logp = SK.sample_tokens(
+                    lg, s_temp, s_topk, s_topp, s_seed,
+                    np.full((B,), step, np.int32))
                 # graft-lint: ok[GL102] — THE designed per-token sync
                 # of the eager path: two [B] vectors drive the
                 # host-side eos/penalty bookkeeping

@@ -7,7 +7,6 @@ so they compose inside a jitted decode loop.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
@@ -19,25 +18,24 @@ def apply_temperature(logits, temperature):
 
 
 def top_k_filter(logits, k: int):
-    """Keep the top-k logits per row, mask the rest. k is static."""
+    """Keep the top-k logits per row, mask the rest. k is static here;
+    the math is the shared batched-operand kernel
+    (generation.sampling.topk_mask) — the serve loop runs the same
+    filter with k as a per-request operand, so eager and serve-loop
+    filtering can never drift apart."""
     if k <= 0 or k >= logits.shape[-1]:
         return logits
-    kth = jax.lax.top_k(logits, k)[0][..., -1:]
-    return jnp.where(logits < kth, _NEG_INF, logits)
+    from .sampling import topk_mask
+    return topk_mask(logits, k)
 
 
 def top_p_filter(logits, p):
     """Nucleus filtering: keep the smallest prefix of the sorted
-    distribution with cumulative prob >= p (always keeps the argmax)."""
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    # token ranks to cut: those strictly after the prefix reaching p
-    cutoff_mask = cum - sorted_probs > p  # True => drop
-    # threshold value = smallest kept sorted logit
-    kept = jnp.where(cutoff_mask, jnp.inf, sorted_logits)
-    threshold = jnp.min(kept, axis=-1, keepdims=True)
-    return jnp.where(logits < threshold, _NEG_INF, logits)
+    distribution with cumulative prob >= p (always keeps the argmax).
+    Shared batched-operand kernel (generation.sampling.topp_mask) —
+    see top_k_filter."""
+    from .sampling import topp_mask
+    return topp_mask(logits, p)
 
 
 def repetition_penalty(logits, token_counts, penalty):
@@ -57,28 +55,3 @@ def min_length_mask(logits, cur_len, min_length: int, eos_token_id):
         return logits
     blocked = logits.at[..., eos_token_id].set(_NEG_INF)
     return jnp.where(cur_len < min_length, blocked, logits)
-
-
-def process_logits(logits, *, temperature=1.0, top_k=0, top_p=1.0,
-                   token_counts=None, rep_penalty=1.0):
-    """Standard processor pipeline used by GenerationMixin."""
-    if token_counts is not None and rep_penalty != 1.0:
-        logits = repetition_penalty(logits, token_counts, rep_penalty)
-    if temperature != 1.0:
-        logits = apply_temperature(logits, temperature)
-    if top_k and top_k > 0:
-        logits = top_k_filter(logits, top_k)
-    if top_p is not None and top_p < 1.0:
-        logits = top_p_filter(logits, top_p)
-    return logits
-
-
-def sample_token(logits, key, *, greedy: bool):
-    """Returns (token [B], logprob [B]). logits: [B, V] post-processing."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    if greedy:
-        tok = jnp.argmax(logits, axis=-1)
-    else:
-        tok = jax.random.categorical(key, logits.astype(jnp.float32), axis=-1)
-    chosen = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
-    return tok.astype(jnp.int32), chosen

@@ -1,0 +1,334 @@
+"""On-device sampling and speculative-verify math — batched operands.
+
+Reference parity: PaddleNLP sampling (paddlenlp/generation/logits_process
+TopKProcess/TopPProcess + categorical sampling) and the fused speculative
+decoding acceptance rule (Leviathan et al. / PaddleNLP speculate_method),
+restructured for TPU serving:
+
+- **Per-request knobs are OPERANDS, not trace constants.** Temperature /
+  top-k / top-p / seed enter the compiled decode program as ``[B]``
+  vectors, so a batch mixing greedy and sampled tenants — or two tenants
+  with different temperatures — runs ONE program and a config change
+  never retraces (the retrace-per-config hazard graft-lint GL103 exists
+  for). Disabled knobs are in-band: ``temperature <= 0`` means greedy,
+  ``top_k <= 0`` and ``top_p >= 1`` mean unfiltered.
+- **Counter-based keys.** Every draw derives from
+  ``fold_in(key(seed), counter)`` where ``counter`` is the index of the
+  token being generated. No key state threads through the loop, so the
+  serve loop (whose program order is admission-dependent) and the eager/
+  static ``generate`` paths produce the SAME sampled stream for a fixed
+  seed — the cross-path parity tests/test_spec_decode.py pins.
+- **Greedy is bitwise.** ``temperature <= 0`` rows take
+  ``argmax(raw_logits)`` — the exact argmax today's decode program
+  computes — selected by ``where``, so a sampling-enabled program serving
+  an all-greedy batch emits bit-identical tokens.
+- **Speculative verify** (`verify_spans`): given the verify span's
+  logits, the drafted tokens, and the per-slot sampling operands, the
+  longest accepted draft prefix and the bonus/correction token are
+  computed ON DEVICE. Greedy rows accept while ``argmax == draft``
+  (lossless: output equals plain greedy decode); sampled rows use the
+  rejection-sampling rule specialized to a DETERMINISTIC drafter
+  (prompt-lookup proposes one token, i.e. q = δ_draft): accept draft d
+  with probability p(d), and on rejection resample from the residual
+  norm(max(p − q, 0)) = p with d removed — the emitted stream is then
+  distributed exactly as sampling from the target model token by token.
+
+Host-side `propose_ngram_drafts` is the prompt-lookup drafter (cf.
+"prompt lookup decoding"): match the request's recent token suffix
+against its own prompt+generation history and propose the continuation
+of the most recent earlier occurrence — no second model, no device work.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingParams", "sampling_operands", "topk_mask",
+           "topp_mask", "processed_logits", "sample_tokens",
+           "verify_spans", "propose_ngram_drafts"]
+
+_NEG = jnp.float32(-1e30)
+
+
+class SamplingParams(NamedTuple):
+    """Per-request sampling knobs, carried as batched operands.
+
+    ``temperature <= 0`` selects greedy argmax (``top_k``/``top_p`` are
+    then irrelevant — argmax is filter-invariant); ``top_k <= 0``
+    disables the k filter; ``top_p >= 1`` disables the nucleus filter.
+    ``seed`` anchors the request's counter-based key stream: token t of
+    the request draws with ``fold_in(key(seed), t)``, so the same
+    request replayed through the eager, static, or serve-loop path
+    yields the same tokens.
+    """
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+
+def sampling_operands(params: Sequence[Optional[SamplingParams]]):
+    """Stack per-slot SamplingParams (None = greedy) into the operand
+    vectors the compiled programs take: dict of np arrays
+    ``temperature`` f32, ``top_k`` i32, ``top_p`` f32, ``seed`` i32."""
+    n = len(params)
+    temp = np.zeros((n,), np.float32)
+    topk = np.zeros((n,), np.int32)
+    topp = np.ones((n,), np.float32)
+    seed = np.zeros((n,), np.int32)
+    for i, sp in enumerate(params):
+        if sp is None:
+            continue
+        temp[i] = float(sp.temperature)
+        topk[i] = int(sp.top_k)
+        topp[i] = float(sp.top_p)
+        seed[i] = int(sp.seed)
+    return {"temperature": temp, "top_k": topk, "top_p": topp,
+            "seed": seed}
+
+
+# ------------------------------------------------------------- filtering --
+def topk_mask(logits, k):
+    """Keep each row's top-k logits, mask the rest to -1e30. `k` may be
+    a python int or a traced array broadcastable to the row shape;
+    ``k <= 0`` (or >= vocab) disables per row — so the filter composes
+    into one program for a batch mixing filtered and unfiltered
+    requests."""
+    v = logits.shape[-1]
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    kk = jnp.where(jnp.asarray(k) <= 0, v,
+                   jnp.clip(jnp.asarray(k), 1, v)).astype(jnp.int32)
+    kk = jnp.broadcast_to(kk, logits.shape[:-1])
+    kth = jnp.take_along_axis(sorted_desc, (kk - 1)[..., None], axis=-1)
+    return jnp.where(logits < kth, _NEG, logits)
+
+
+def topp_mask(logits, p):
+    """Nucleus filtering with `p` as a (possibly per-row traced)
+    operand: keep the smallest prefix of the sorted distribution with
+    cumulative probability >= p (the argmax always survives);
+    ``p >= 1`` disables per row."""
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    pp = jnp.broadcast_to(jnp.asarray(p, logits.dtype),
+                          logits.shape[:-1])[..., None]
+    drop = (cum - probs) > pp          # True => outside the nucleus
+    kept = jnp.where(drop, jnp.inf, sorted_desc)
+    thr = jnp.min(kept, axis=-1, keepdims=True)
+    return jnp.where(logits < thr, _NEG, logits)
+
+
+def processed_logits(logits, temperature, top_k, top_p):
+    """The serving logits pipeline (temperature → top-k → top-p) with
+    every knob a batched operand. `logits` [..., V] float32; params
+    broadcastable to the row shape. Rows with ``temperature <= 0`` are
+    scaled by 1 (their sample is replaced by argmax downstream — the
+    scale must stay finite, not meaningful).
+
+    One shared descending sort feeds BOTH filters (this runs on every
+    sampled decode tick and every verify-span position — two
+    independent O(V·log V) sorts would double the kernel's dominant
+    cost at real vocab sizes): the post-top-k sorted logits are just
+    the sort's first k entries with the tail masked, so the nucleus
+    cutoff is computed from the same array, and the two filters
+    collapse into one combined per-row threshold. Equivalent to
+    ``topp_mask(topk_mask(lg, k), p)`` (pinned by test; exact ties AT
+    the k-th logit may shift the nucleus cutoff by a tied duplicate —
+    measure-zero for float logits, and the kept set still honors
+    ties like the sequential form)."""
+    t = jnp.asarray(temperature, jnp.float32)
+    safe_t = jnp.where(t <= 0, jnp.float32(1.0),
+                       jnp.maximum(t, jnp.float32(1e-6)))
+    lg = logits / jnp.broadcast_to(safe_t, logits.shape[:-1])[..., None]
+    v = lg.shape[-1]
+    sorted_desc = -jnp.sort(-lg, axis=-1)
+    kk = jnp.where(jnp.asarray(top_k) <= 0, v,
+                   jnp.clip(jnp.asarray(top_k), 1, v)).astype(jnp.int32)
+    kk = jnp.broadcast_to(kk, lg.shape[:-1])
+    kth = jnp.take_along_axis(sorted_desc, (kk - 1)[..., None], axis=-1)
+    rank = jnp.arange(v, dtype=jnp.int32)
+    sl = jnp.where(rank < kk[..., None], sorted_desc, _NEG)
+    probs = jax.nn.softmax(sl, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32),
+                          lg.shape[:-1])[..., None]
+    drop = (cum - probs) > pp
+    kept = jnp.where(drop, jnp.inf, sl)
+    thr_p = jnp.min(kept, axis=-1, keepdims=True)
+    thr = jnp.maximum(thr_p, kth)    # keep iff inside BOTH filters
+    return jnp.where(lg < thr, _NEG, lg)
+
+
+# -------------------------------------------------------------- sampling --
+def _row_keys(seed, counter):
+    """[N] typed keys: fold_in(key(seed_i), counter_i) — the
+    counter-based stream every sampling path shares."""
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.key(s), c)
+    )(jnp.asarray(seed, jnp.uint32), jnp.asarray(counter, jnp.uint32))
+
+
+def sample_tokens(logits, temperature, top_k, top_p, seed, counter):
+    """One sampled (or greedy) token per row. logits [B, V] (model
+    dtype — argmax runs on the RAW logits so greedy rows are bitwise
+    the plain decode argmax); all params [B] operands; `counter` [B] is
+    the per-request generated-token index. Returns (tok [B] int32,
+    logp [B] float32 — the chosen token's log-probability under the
+    distribution it was drawn from: processed for sampled rows, raw
+    for greedy rows)."""
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg32 = logits.astype(jnp.float32)
+    proc = processed_logits(lg32, temperature, top_k, top_p)
+    keys = _row_keys(seed, counter)
+    sampled = jax.vmap(
+        lambda l, k: jax.random.categorical(k, l))(proc, keys)
+    t = jnp.asarray(temperature, jnp.float32)
+    tok = jnp.where(t <= 0, greedy_tok, sampled.astype(jnp.int32))
+    base = jnp.where((t <= 0)[:, None], lg32, proc)
+    logp = jnp.take_along_axis(jax.nn.log_softmax(base, axis=-1),
+                               tok[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    return tok, logp
+
+
+# ----------------------------------------------------- speculative verify --
+def verify_spans(logits, span_ids, q_lens, temperature, top_k, top_p,
+                 seed, counter, sampled_mode=True):
+    """On-device speculative verification of drafted token spans.
+
+    One verify step ran a span of ``q_lens[b]`` tokens per slot through
+    the model: position 0 is the slot's committed last token, positions
+    1..q_lens-1 the drafted tokens. ``logits[b, i]`` is the target
+    model's next-token distribution AFTER span position i, so position
+    i judges draft ``span_ids[b, i+1]``.
+
+    Returns ``(accepted [B] int32, bonus [B] int32)``: `accepted` is
+    the longest accepted draft prefix (0..q_lens-1), `bonus` the
+    correction/continuation token the target model emits at position
+    `accepted` — together the slot commits ``accepted + 1`` new tokens.
+
+    Greedy rows (``temperature <= 0``) accept while the raw argmax
+    equals the draft and take the argmax as bonus — the emitted stream
+    is exactly plain greedy decode. Sampled rows apply rejection
+    sampling against the deterministic drafter (q = δ_draft): accept
+    draft d with probability p(d) (u < p(d), u from the position's
+    counter-keyed stream); on rejection the bonus is drawn from the
+    residual p with d removed (renormalized — norm(max(p − q, 0)));
+    when every draft is accepted the bonus is an ordinary sample from
+    the final position. Slots with ``q_lens == 1`` carried no drafts:
+    accepted = 0 and bonus is exactly a normal decode sample/argmax.
+
+    `counter` [B] is the per-request generated-token index of the
+    span's FIRST emitted token; the three per-position draw families
+    (accept uniforms, normal samples, residual samples) fold disjoint
+    offsets so streams never collide.
+
+    `sampled_mode` is a STATIC (trace-time) switch: a predictor built
+    without sampling serves only greedy requests, and the entire
+    stochastic half (keys, uniforms, categorical draws, residual
+    distributions) compiles away — the greedy verify is argmax-compare
+    and nothing else.
+    """
+    b, qb, v = logits.shape
+    t = jnp.asarray(temperature, jnp.float32)
+    q_lens = jnp.asarray(q_lens, jnp.int32)
+    span_ids = jnp.asarray(span_ids, jnp.int32)
+
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, Qb]
+    if qb > 1:
+        drafts = span_ids[:, 1:]                                # [B, Qb-1]
+        valid = jnp.arange(1, qb, dtype=jnp.int32)[None, :] \
+            < q_lens[:, None]
+        g_acc = greedy_tok[:, :-1] == drafts
+
+    sel = lambda a: jnp.take_along_axis(  # noqa: E731
+        a, accepted[:, None], axis=1)[:, 0]
+
+    if not sampled_mode:
+        if qb > 1:
+            lead = jnp.cumprod((g_acc & valid).astype(jnp.int32),
+                               axis=-1)
+            accepted = jnp.sum(lead, axis=-1).astype(jnp.int32)
+        else:
+            accepted = jnp.zeros((b,), jnp.int32)
+        return accepted, sel(greedy_tok)
+
+    lg32 = logits.astype(jnp.float32)
+    proc = processed_logits(
+        lg32, t[:, None], jnp.asarray(top_k, jnp.int32)[:, None],
+        jnp.asarray(top_p, jnp.float32)[:, None])
+    probs = jax.nn.softmax(proc, axis=-1)                       # [B, Qb, V]
+
+    base = _row_keys(seed, counter)                             # [B] keys
+    offs = jnp.arange(3 * qb, dtype=jnp.uint32)
+    keys = jax.vmap(lambda k: jax.vmap(
+        lambda i: jax.random.fold_in(k, i))(offs))(base)  # [B, 3*Qb] keys
+
+    # -- acceptance of drafts (positions 0..qb-2 judge span col 1..) --
+    if qb > 1:
+        p_draft = jnp.take_along_axis(
+            probs[:, :-1], drafts[..., None], axis=-1)[..., 0]
+        u = jax.vmap(jax.vmap(jax.random.uniform))(keys[:, :qb - 1])
+        s_acc = u < p_draft
+        acc = jnp.where((t <= 0)[:, None], g_acc, s_acc) & valid
+        lead = jnp.cumprod(acc.astype(jnp.int32), axis=-1)
+        accepted = jnp.sum(lead, axis=-1).astype(jnp.int32)
+    else:
+        accepted = jnp.zeros((b,), jnp.int32)
+
+    # -- bonus token at position `accepted` --
+    normal = jax.vmap(jax.vmap(
+        lambda k, l: jax.random.categorical(k, l)))(
+            keys[:, qb:2 * qb], proc)                           # [B, Qb]
+    if qb > 1:
+        # residual at position i: p_i with the judged draft removed.
+        # log(probs) reintroduces -inf on filtered tokens; positions
+        # past the drafts keep a dummy (never selected).
+        dr = jnp.concatenate(
+            [span_ids[:, 1:], span_ids[:, -1:]], axis=1)        # [B, Qb]
+        onehot = jax.nn.one_hot(dr, v, dtype=jnp.bool_)
+        res_lg = jnp.where(
+            onehot | (probs <= 0), _NEG,
+            jnp.log(jnp.maximum(probs, jnp.float32(1e-30))))
+        residual = jax.vmap(jax.vmap(
+            lambda k, l: jax.random.categorical(k, l)))(
+                keys[:, 2 * qb:], res_lg)                       # [B, Qb]
+        # degenerate residual (all target mass on the rejected draft —
+        # a measure-zero event): fall back to the argmax
+        res_dead = jnp.max(res_lg, axis=-1) <= _NEG / 2
+        residual = jnp.where(res_dead, greedy_tok, residual)
+    else:
+        residual = normal
+
+    all_acc = accepted >= q_lens - 1
+    s_bonus = jnp.where(all_acc, sel(normal), sel(residual))
+    bonus = jnp.where(t <= 0, sel(greedy_tok),
+                      s_bonus).astype(jnp.int32)
+    return accepted, bonus
+
+
+# ------------------------------------------------------ prompt-lookup draft --
+def propose_ngram_drafts(history: List[int], k: int,
+                         ngram_max: int = 3,
+                         window: int = 4096) -> List[int]:
+    """Prompt-lookup drafting (host-side, no second model): match the
+    longest suffix n-gram of `history` (n = ngram_max down to 1)
+    against an earlier occurrence in the SAME history (prompt +
+    generation) and propose up to `k` tokens that followed the most
+    recent match. Returns [] when nothing matches — the tick then runs
+    as a plain decode step. `window` bounds the backward scan so a very
+    long history costs O(window) per tick, not O(n^2)."""
+    n = len(history)
+    if k <= 0 or n < 2:
+        return []
+    lo = max(0, n - window)
+    for m in range(min(ngram_max, n - 1), 0, -1):
+        pat = history[n - m:]
+        for j in range(n - m - 1, lo - 1, -1):
+            if history[j:j + m] == pat:
+                return list(history[j + m:j + m + k])
+    return []

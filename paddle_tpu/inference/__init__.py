@@ -468,7 +468,8 @@ class ContinuousBatchingPredictor:
                  enable_prefix_cache=True, max_queue=None,
                  shed_policy=None, decode_watchdog_s=None,
                  name=None, engine=None, prefill_chunk_tokens=None,
-                 runtime_config=None):
+                 runtime_config=None, spec_draft_tokens=None,
+                 spec_ngram_max=None, sampling_enabled=None):
         import math as _m
         import time as _time
         from ..framework.runtime_config import RuntimeConfig
@@ -633,6 +634,33 @@ class ContinuousBatchingPredictor:
                 b *= 2
             chunk = b
         self._chunk_max = chunk
+        # speculative decoding + on-device sampling (docs/SERVING.md
+        # "Speculative decoding & sampling"): spec_draft_tokens > 0
+        # turns decode ticks into multi-token verify steps — up to k
+        # prompt-lookup drafted tokens enter as a q_lens = k+1 span
+        # through the variable-query ragged kernel, the longest
+        # accepted prefix is computed ON DEVICE, and rejected
+        # positions' K/V roll back in-graph. sampling_enabled compiles
+        # the sampling decode variant (per-request temperature/top-k/
+        # top-p/seed as batched operands — one program for any mix of
+        # greedy and sampled tenants, no retrace per config). Both are
+        # compiled-in geometry (program variants); the AOT builder
+        # pre-captures them so warm start stays zero-compile.
+        if spec_draft_tokens is None:
+            spec_draft_tokens = int(rc.spec_draft_tokens)
+        if spec_ngram_max is None:
+            spec_ngram_max = int(rc.spec_ngram_max)
+        if sampling_enabled is None:
+            sampling_enabled = bool(rc.sampling_enabled)
+        self._spec_k = max(0, int(spec_draft_tokens))
+        self._ngram_max = max(1, int(spec_ngram_max))
+        self.sampling_enabled = bool(sampling_enabled)
+        self._m_spec_prop = _obsm.counter("serving.spec.proposed_tokens")
+        self._m_spec_acc = _obsm.counter("serving.spec.accepted_tokens")
+        self._m_spec_rate = _obsm.gauge("serve.spec.accept_rate")
+        self.stats["spec_ticks"] = 0
+        self.stats["spec_proposed"] = 0
+        self.stats["spec_accepted"] = 0
         self._m_chunks = _obsm.counter("serving.chunked_prefill.chunks")
         self._m_chunk_reqs = _obsm.counter(
             "serving.chunked_prefill.requests")
@@ -691,6 +719,10 @@ class ContinuousBatchingPredictor:
                                        donate_argnums=dn)
             self._mixed_jit = jax.jit(self._raw_mixed_step,
                                       donate_argnums=dn)
+            self._decode_sample_jit = jax.jit(
+                self._raw_decode_sample_step, donate_argnums=dn)
+            self._spec_jit = jax.jit(self._raw_spec_step,
+                                     donate_argnums=dn)
             self._p_vals = [t._value for t in self._p_tensors]
             self._b_vals = [t._value for t in self._b_tensors]
             self._ready = True
@@ -907,9 +939,122 @@ class ContinuousBatchingPredictor:
         new_v = [getattr(e.v_pages, "_value", e.v_pages) for e in caches]
         return nxt, done, new_k, new_v
 
+    def _raw_decode_sample_step(self, p_vals, b_vals, kl, vl, tables,
+                                ctx, last_tok, s_temp, s_topk, s_topp,
+                                s_seed, s_ctr, *meta_flat):
+        """The sampling variant of THE decode step: identical cache
+        write + paged attention, but the next token comes from the
+        on-device sampling kernel (generation.sampling.sample_tokens)
+        with per-slot temperature/top-k/top-p/seed as batched operands
+        and the per-request generated-token counter driving the key
+        stream. Slots with temperature <= 0 take the raw argmax —
+        bitwise the greedy program's token — selected in-graph, so one
+        compiled program serves any greedy/sampled tenant mix."""
+        from ..jit.bridge import bound_state
+        from ..generation.kv_cache import PagedCacheEntry, PagedKVCache
+        from ..generation import sampling as _samp
+        meta = None
+        if meta_flat:
+            from ..kernels.paged_attention import RaggedMetaBuilder
+            meta = dict(zip(RaggedMetaBuilder.FIELDS, meta_flat))
+        entries = [PagedCacheEntry(kl[i], vl[i], Tensor(tables),
+                                   Tensor(ctx), meta)
+                   for i in range(len(kl))]
+        with no_grad(), bound_state(self._p_tensors, p_vals,
+                                    self._b_tensors, b_vals):
+            logits, caches = self.model(
+                Tensor(last_tok[:, None]),
+                position_ids=Tensor(ctx[:, None]),
+                past_key_values=PagedKVCache(entries), use_cache=True)
+        nxt, _ = _samp.sample_tokens(logits._value[:, -1], s_temp,
+                                     s_topk, s_topp, s_seed, s_ctr)
+        if self.eos_token_id is not None:
+            done = nxt == jnp.int32(self.eos_token_id)
+        else:
+            done = jnp.zeros(nxt.shape, jnp.bool_)
+        new_k = [getattr(e.k_pages, "_value", e.k_pages) for e in caches]
+        new_v = [getattr(e.v_pages, "_value", e.v_pages) for e in caches]
+        return nxt, done, new_k, new_v
+
+    def _raw_spec_step(self, p_vals, b_vals, kl, vl, tables, ctx,
+                       span_ids, q_lens, tok_in, s_temp, s_topk, s_topp,
+                       s_seed, s_ctr, *meta_flat):
+        """ONE compiled speculative verify step: every slot carries a
+        span of q_lens[b] tokens — its committed last token (column 0,
+        via the same tok_in override mechanism decode uses) followed by
+        q_lens[b]-1 prompt-lookup DRAFTED tokens — through the mixed
+        update+attend path (span K/V scatter + the variable-query
+        ragged kernel). The longest accepted draft prefix and the
+        bonus/correction token are computed ON DEVICE
+        (generation.sampling.verify_spans: greedy rows compare against
+        the raw argmax — lossless; sampled rows apply the
+        rejection-sampling accept rule), and the REJECTED positions'
+        K/V is rolled back in-graph: their pre-write page contents were
+        gathered before the forward and are scattered back, so the
+        pages hold exactly the kept prefix. Returns (bonus [B] int32,
+        accepted [B] int32, done [B] bool, new_k, new_v) — the host
+        commits drafts[:accepted] + bonus, rewinds ctx/ragged meta to
+        the kept length (RaggedMetaBuilder.rollback_slot), and syncs
+        only the three small vectors. Slots with q_lens == 1 carried no
+        drafts: the step degenerates to a plain decode/sampling tick."""
+        from ..jit.bridge import bound_state
+        from ..generation.kv_cache import PagedCacheEntry, PagedKVCache
+        from ..generation import sampling as _samp
+        meta = None
+        if meta_flat:
+            from ..kernels.paged_attention import RaggedMetaBuilder
+            meta = dict(zip(RaggedMetaBuilder.FIELDS, meta_flat))
+        qb = span_ids.shape[1]
+        ids = span_ids.at[:, 0].set(tok_in.astype(span_ids.dtype))
+        pos = ctx[:, None].astype(jnp.int32) \
+            + jnp.arange(qb, dtype=jnp.int32)[None, :]
+        # pre-write snapshot of the span's K/V destinations: the
+        # rollback source. Same destination math as the mixed-step
+        # scatter (generation/kv_cache.paged_cache_mixed_update_attend)
+        pslot = jnp.clip(pos // self.page, 0,
+                         tables.shape[1] - 1).astype(jnp.int32)
+        pg = jnp.take_along_axis(tables, pslot, axis=1)       # [B, Qb]
+        off = (pos % self.page).astype(jnp.int32)
+        old_k = [k[pg, off] for k in kl]        # [B, Qb, Hkv, D] each
+        old_v = [v[pg, off] for v in vl]
+        entries = [PagedCacheEntry(kl[i], vl[i], Tensor(tables),
+                                   Tensor(ctx), meta,
+                                   Tensor(q_lens))
+                   for i in range(len(kl))]
+        with no_grad(), bound_state(self._p_tensors, p_vals,
+                                    self._b_tensors, b_vals):
+            logits, caches = self.model(
+                Tensor(ids), position_ids=Tensor(pos),
+                past_key_values=PagedKVCache(entries), use_cache=True)
+        accepted, bonus = _samp.verify_spans(
+            logits._value, ids, q_lens, s_temp, s_topk, s_topp,
+            s_seed, s_ctr, sampled_mode=self.sampling_enabled)
+        # in-graph rollback: positions past the accepted prefix (span
+        # index i in (accepted, q_lens)) restore their pre-write page
+        # contents; kept and padding positions are dropped via an
+        # out-of-bounds destination (the mixed-scatter idiom)
+        i = jnp.arange(qb, dtype=jnp.int32)[None, :]
+        rej = (i > accepted[:, None]) \
+            & (i < q_lens[:, None].astype(jnp.int32))
+        dst_page = jnp.where(rej, pg, jnp.int32(kl[0].shape[0]))
+        new_k, new_v = [], []
+        for li, e in enumerate(caches):
+            ka = getattr(e.k_pages, "_value", e.k_pages)
+            va = getattr(e.v_pages, "_value", e.v_pages)
+            new_k.append(ka.at[dst_page, off].set(
+                old_k[li], mode="drop"))
+            new_v.append(va.at[dst_page, off].set(
+                old_v[li], mode="drop"))
+        if self.eos_token_id is not None:
+            done = bonus == jnp.int32(self.eos_token_id)
+        else:
+            done = jnp.zeros(bonus.shape, jnp.bool_)
+        return bonus, accepted, done, new_k, new_v
+
     # ------------------------------------------------------------ serve --
     def generate(self, prompts, max_new_tokens=32, strict=True,
-                 deadline_s=None, tiers=None, tier_weights=None):
+                 deadline_s=None, tiers=None, tier_weights=None,
+                 sampling=None):
         """Continuous batching over a stream of prompts: List[List[int]]
         → List[List[int]] (new tokens per prompt, in request order).
         Sequences join and leave the running batch mid-flight.
@@ -955,10 +1100,11 @@ class ContinuousBatchingPredictor:
         return self.generate_stream(
             prompts, max_new_tokens=max_new_tokens, strict=strict,
             deadline_s=deadline_s, tiers=tiers,
-            tier_weights=tier_weights).drain()
+            tier_weights=tier_weights, sampling=sampling).drain()
 
     def generate_stream(self, prompts, max_new_tokens=32, strict=True,
-                        deadline_s=None, tiers=None, tier_weights=None):
+                        deadline_s=None, tiers=None, tier_weights=None,
+                        sampling=None):
         """Streaming generate: same admission/fairness/robustness
         semantics as :meth:`generate`, but returns a
         ``serving.TokenStream`` that yields ``StreamEvent``s as decode
@@ -976,6 +1122,32 @@ class ContinuousBatchingPredictor:
         """
         from ..serving.streaming import ServeRequest, TokenStream
         n = len(prompts)
+        # per-request sampling (docs/SERVING.md "Speculative decoding &
+        # sampling"): a SamplingParams (scalar = every request) whose
+        # temperature > 0 requests the on-device sampling decode
+        # program — a program VARIANT this predictor must have been
+        # constructed for (sampling_enabled=True); silently falling
+        # back to greedy would misreport what was served
+        if sampling is None:
+            per_sp = [None] * n
+        else:
+            from ..generation.sampling import SamplingParams
+            per_sp = list(sampling) \
+                if isinstance(sampling, (list, tuple)) \
+                and not isinstance(sampling, SamplingParams) \
+                else [sampling] * n
+            if len(per_sp) != n:
+                raise ValueError(
+                    f"sampling has {len(per_sp)} entries for "
+                    f"{n} prompts")
+            if not self.sampling_enabled and any(
+                    self._wants_sampling(sp) for sp in per_sp):
+                raise ValueError(
+                    "sampling requested but this predictor was built "
+                    "with sampling_enabled=False — the sampling decode "
+                    "program variant is compiled-in geometry (pass "
+                    "sampling_enabled=True, or bake it into the "
+                    "RuntimeConfig/engine bundle)")
         if deadline_s is None:
             per_dl = [None] * n
         else:
@@ -1002,7 +1174,7 @@ class ContinuousBatchingPredictor:
                         "serve the rest.")
         reqs = [ServeRequest(list(p), int(max_new_tokens),
                              tiers[r] if tiers is not None else None,
-                             per_dl[r], None)
+                             per_dl[r], None, per_sp[r])
                 for r, p in enumerate(prompts)]
         results = [None] * n
         status = ["queued"] * n
@@ -1033,6 +1205,14 @@ class ContinuousBatchingPredictor:
         gen = self._serve([], intake, results, status, cancel,
                           tier_weights, None)
         return TokenStream(gen, results, status, cancel)
+
+    @staticmethod
+    def _wants_sampling(sp):
+        """True when the request needs the sampling program: an
+        explicit SamplingParams with temperature > 0 (temperature <= 0
+        is greedy — argmax is filter-invariant, so top_k/top_p are
+        moot and the plain path serves it bit-identically)."""
+        return sp is not None and float(sp.temperature) > 0
 
     def _unservable(self, prompt, max_new):
         """(kind, detail) when the request can never be served on this
@@ -1091,7 +1271,7 @@ class ContinuousBatchingPredictor:
 
         # per-request parallel state (grows under dynamic intake)
         prompts, max_new, tier_of, metas = [], [], [], []
-        deadlines, arrival, req_sp = [], [], []
+        deadlines, arrival, req_sp, samp_of = [], [], [], []
         has_deadlines = False   # no deadlines → expire_queued is a no-op
         out = _coll.deque()          # StreamEvents awaiting the consumer
         closed = intake is None
@@ -1111,9 +1291,15 @@ class ContinuousBatchingPredictor:
                 return evs[-1]["ts"]
             return _time.time()
 
-        def emit(r, kind, token=None, index=0, st=None):
+        def emit(r, kind, token=None, index=0, st=None, span=None):
+            # one "token" event per TICK: `span` carries every token
+            # the tick committed (speculative ticks commit several),
+            # `token`/`index` stay the last one for single-token
+            # consumers (serving/streaming.py StreamEvent)
+            if span is None and token is not None:
+                span = (token,)
             out.append(StreamEvent(r, kind, token, index, _ts(r), st,
-                                   metas[r]))
+                                   metas[r], tuple(span or ())))
 
         def add_request(sreq):
             nonlocal has_deadlines
@@ -1125,6 +1311,7 @@ class ContinuousBatchingPredictor:
             max_new.append(mn)
             tier_of.append(sreq.tier)
             metas.append(sreq.meta)
+            samp_of.append(getattr(sreq, "sampling", None))
             now = _time.perf_counter()
             arrival.append(now)
             deadlines.append(None if sreq.deadline_s is None
@@ -1140,6 +1327,14 @@ class ContinuousBatchingPredictor:
                 request_id=f"req{self._req_seq}", idx=r,
                 prompt_len=len(p), **tl, **mlbl))
             uns = self._unservable(p, mn)
+            if uns is None and not self.sampling_enabled \
+                    and self._wants_sampling(samp_of[r]):
+                # dynamic-intake requests can't raise at the API edge
+                # (generate_stream does); reject per-request instead
+                # of silently serving greedy under a sampled label
+                uns = ("sampling_disabled",
+                       "sampling requested but the predictor was built "
+                       "with sampling_enabled=False")
             if uns is not None:
                 results[r] = []
                 status[r] = "rejected_" + uns[0]
@@ -1220,6 +1415,45 @@ class ContinuousBatchingPredictor:
         builder = RaggedMetaBuilder(self.B, self.pages_per_seq, self.page,
                                     self._trash) if self.use_ragged \
             else None
+        # speculative decoding + sampling slot state: per-slot sampling
+        # operand rows (greedy zeros), the host token history the
+        # prompt-lookup drafter matches against (prompt + committed
+        # generation, maintained off already-resolved tokens only), and
+        # the awaiting-first-sampled-token flag (a sampled request's
+        # first token cannot come from the admission argmax — it is
+        # drawn by replaying the last prompt token through the decode
+        # program, which rewrites that position's K/V byte-identically)
+        s_temp = np.zeros((self.B,), np.float32)
+        s_topk = np.zeros((self.B,), np.int32)
+        s_topp = np.ones((self.B,), np.float32)
+        s_seed = np.zeros((self.B,), np.int32)
+        slot_hist = [[] for _ in range(self.B)]
+        slot_await_first = [False] * self.B
+        spec_mode = self._spec_k > 0
+
+        def set_samp(b, sp):
+            if sp is None:
+                s_temp[b], s_topk[b], s_topp[b], s_seed[b] = 0, 0, 1, 0
+            else:
+                s_temp[b] = float(sp.temperature)
+                s_topk[b] = int(sp.top_k)
+                s_topp[b] = float(sp.top_p)
+                s_seed[b] = int(sp.seed)
+
+        def samp_vec(pend):
+            """Sampling operand bundle for one dispatch: the per-slot
+            param rows plus the generated-token counter that anchors
+            each request's key stream — exact even under double
+            buffering: a slot with a step in flight counts its pending
+            token, and an in-flight step that commits NO token for a
+            slot (a mixed tick's chunk/paused slots) is never in
+            flight here — mixed steps resolve before the next dispatch
+            on a sampling-enabled predictor (see the loop head)."""
+            ctr = np.fromiter(
+                (len(slot_new[b]) + (1 if b in pend else 0)
+                 for b in range(self.B)), np.int32, self.B)
+            return (s_temp.copy(), s_topk.copy(), s_topp.copy(),
+                    s_seed.copy(), ctr)
 
         def evict(b, status_val="ok"):
             r = slot_req[b]
@@ -1233,6 +1467,8 @@ class ContinuousBatchingPredictor:
             self.pool.release(slot_pages[b])
             slot_req[b], slot_pages[b], slot_new[b] = -1, [], []
             slot_pending[b], slot_ingested[b] = [], 0
+            slot_hist[b], slot_await_first[b] = [], False
+            set_samp(b, None)
             tables[b, :] = self._trash
             ctx[b] = 1
             if builder is not None:
@@ -1303,10 +1539,19 @@ class ContinuousBatchingPredictor:
             # chunked prefill: prompts over the threshold ingest
             # chunk-by-chunk through the mixed step; they bypass the
             # prefix cache (no monolithic prefill computes the
-            # per-position continuation tokens the trie stores)
+            # per-position continuation tokens the trie stores).
+            # SAMPLED requests bypass it too: their first-token replay
+            # rewrites position L-1's K/V, and that write must land in
+            # an exclusively-owned page (a cache-shared page is read
+            # by other requests; the recomputed values are numerically
+            # equal but not guaranteed bit-exact across program
+            # shapes) — nor may their prompts be INSERTED, or the trie
+            # would pin the page the replay rewrites.
+            sampled = self._wants_sampling(samp_of[r])
             chunked = bool(self._chunk_max) and L > self._chunk_max
             full_pages, covered, partial, cached_next = [], 0, None, None
-            if self.prefix_cache is not None and not chunked:
+            if self.prefix_cache is not None and not chunked \
+                    and not sampled:
                 full_pages, covered, partial, cached_next = \
                     self.prefix_cache.lookup(prompt)
                 if covered + (partial[1] if partial else 0) == L \
@@ -1334,7 +1579,7 @@ class ContinuousBatchingPredictor:
                     return None
                 return {"r": r, "prompt": prompt, "covered": 0,
                         "pages": fresh, "reused": 0, "next": None,
-                        "chunked": False}
+                        "chunked": False, "no_cache": sampled}
             if partial is not None:
                 # copy-on-write at the divergence page: the request
                 # appends into this page, the trie keeps reading the
@@ -1346,7 +1591,7 @@ class ContinuousBatchingPredictor:
                     "pages": full_pages + fresh,
                     "reused": len(full_pages) + (1 if partial else 0),
                     "next": cached_next if covered == L else None,
-                    "chunked": chunked}
+                    "chunked": chunked, "no_cache": sampled}
 
         def note_cold_start():
             # cold-start-to-first-token SLO (docs/DEPLOYMENT.md):
@@ -1382,6 +1627,8 @@ class ContinuousBatchingPredictor:
             ctx[b] = 0
             slot_pending[b] = list(plan["prompt"])
             slot_ingested[b] = 0
+            slot_hist[b] = list(plan["prompt"])
+            set_samp(b, samp_of[r])
             override[b] = False
             if builder is not None:
                 builder.set_slot(b, tables[b], 1)
@@ -1404,24 +1651,47 @@ class ContinuousBatchingPredictor:
                                  **tl, **mlbl)
 
         def place(b, plan, first):
-            """Install an admitted request into slot b."""
+            """Install an admitted request into slot b. `first` is the
+            admission argmax — a SAMPLED request discards it and waits
+            for its first token to be DRAWN: the slot replays the last
+            prompt token through the decode program (ctx backs up one
+            position; the rewrite recomputes byte-identical K/V, so a
+            prefix-shared page is unharmed) and the next resolve treats
+            the program's sample as the first token (TTFT lands
+            there)."""
             r = plan["r"]
             L = len(plan["prompt"])
             pages = plan["pages"]
             slot_req[b], slot_pages[b] = r, pages
-            slot_new[b] = [first]
             tables[b, :] = self._trash
             tables[b, :len(pages)] = pages
+            slot_hist[b] = list(plan["prompt"])
+            set_samp(b, samp_of[r])
+            status[r] = "running"
+            tl = {"tier": tier_of[r]} if tier_of[r] is not None else {}
+            if self._wants_sampling(samp_of[r]):
+                slot_new[b] = []
+                ctx[b] = L - 1
+                last_tok_host[b] = plan["prompt"][-1]
+                override[b] = True
+                slot_await_first[b] = True
+                if builder is not None:
+                    builder.set_slot(b, tables[b], L)
+                req_sp[r].event("admitted", slot=b, sampled=True)
+                self._m_adm.inc(**mlbl)
+                if tl:
+                    self._m_tier_adm.inc(**tl, **mlbl)
+                return
+            slot_new[b] = [first]
+            slot_hist[b].append(first)
             ctx[b] = L
             last_tok_host[b] = first
             override[b] = True
             if builder is not None:
                 builder.set_slot(b, tables[b], L + 1)
-            status[r] = "running"
             req_sp[r].event("admitted", slot=b)
             req_sp[r].event("first_token")
             note_cold_start()
-            tl = {"tier": tier_of[r]} if tier_of[r] is not None else {}
             self._m_adm.inc(**mlbl)
             if tl:
                 self._m_tier_adm.inc(**tl, **mlbl)
@@ -1534,10 +1804,90 @@ class ContinuousBatchingPredictor:
         inflight = None
         evictions_seen = -1
         finished = False
+
+        def sampled_chunk_first(b, r):
+            """A sampled request's FINAL chunk resolved: the mixed
+            step's argmax is discarded and the slot switches to
+            first-token replay (see place()) — the next decode tick
+            DRAWS the first token with the request's own operands."""
+            ctx[b] -= 1
+            last_tok_host[b] = prompts[r][-1]
+            override[b] = True
+            slot_await_first[b] = True
+
+        def on_wedged():
+            """Watchdog tripped mid-resolve: fail everything still
+            pending instead of hanging. Pages of the wedged step are
+            NOT reclaimed (the in-flight program owns the pool arrays)
+            — the predictor should be rebuilt."""
+            self.stats["watchdog_trips"] += 1
+            self._m_wedge.inc(**mlbl)
+            for b in range(self.B):
+                r = slot_req[b]
+                if r >= 0:
+                    results[r] = slot_new[b]
+                    status[r] = "watchdog"
+                    slot_req[b] = -1
+                    req_sp[r].event("watchdog", stage="decoding",
+                                    tokens=len(slot_new[b]))
+                    req_sp[r].end(status="watchdog")
+                    self._m_done.inc(status="watchdog", **mlbl)
+                    emit(r, "end", st="watchdog")
+            for r in list(q.ids()):
+                q.remove(r)
+                finish_queued(r, "watchdog", {"stage": "queued"})
+            gen_sp.event("decode_wedged")
+            gen_sp.end(status="watchdog")
+            # crash-time forensics: the dump carries the wedged
+            # requests' spans
+            _obstr.flight_dump(reason="decode_wedged")
+
+        def resolve(prev):
+            """Resolve a dispatched step, routing speculative steps to
+            the spec resolver. False = the watchdog tripped (cleanup
+            done) — the caller terminates the loop."""
+            try:
+                if prev.get("spec"):
+                    self._resolve_spec_step(
+                        prev, slot_req, slot_new, slot_hist,
+                        last_tok_host, max_new, ctx, override, builder,
+                        evict, req_sp, emit, chunk_first_token)
+                else:
+                    self._resolve_step(
+                        prev, slot_req, slot_new, last_tok_host,
+                        max_new, evict, req_sp, emit, chunk_first_token,
+                        sampled_first=sampled_chunk_first,
+                        hist=slot_hist)
+                return True
+            except DecodeWedgedError:
+                on_wedged()
+                return False
+
         try:
             while True:
                 apply_cancels()
                 expire_deadlines()
+                if inflight is not None and (
+                        spec_mode or (self.sampling_enabled
+                                      and "chunk_mid" in inflight)):
+                    # resolve BEFORE dispatching when the next dispatch
+                    # depends on this step's host-state transitions:
+                    # (a) speculative mode — the drafter needs the
+                    # freshly committed tokens in the slot histories
+                    # and ctx/ragged meta rewound to the accepted
+                    # prefix (the multi-token step replaces the
+                    # one-step pipeline at the same single sync per
+                    # tick); (b) a MIXED step on a sampling-enabled
+                    # predictor — its resolve flips sampled slots into
+                    # first-token replay (sampled_chunk_first) and
+                    # un-pauses sampled decode slots, and a
+                    # double-buffered dispatch in between would chain
+                    # the discarded argmax / advance ctx past the
+                    # replay position. Greedy predictors keep the
+                    # fully pipelined mixed path.
+                    prev, inflight = inflight, None
+                    if not resolve(prev):
+                        break
                 if not closed:
                     batch = intake()
                     if batch is None:
@@ -1576,62 +1926,75 @@ class ContinuousBatchingPredictor:
                     # budget is already met once the in-flight step
                     # resolves — resolve first instead of burning a
                     # junk step
-                    pend = {b for b, _ in inflight["snap"]} if inflight \
-                        else set()
+                    # keyed (slot, request): a slot recycled while its
+                    # old step is in flight commits NOTHING at resolve
+                    # (snap guard) — counting it would start the new
+                    # request's sampling-key counter at 1 and shift its
+                    # whole fixed-seed stream
+                    pend = {b for b, r in inflight["snap"]
+                            if slot_req[b] == r} if inflight else set()
                     useful = any(
                         len(slot_new[b]) + (1 if b in pend else 0)
                         < max_new[slot_req[b]] for b in active)
                     if any(slot_pending[b] for b in active):
                         # a prompt is mid-ingest: this tick runs the
                         # MIXED program — its chunk advances WHILE the
-                        # decode slots take their normal token step
+                        # decode slots take their normal token step.
+                        # Sampled decode slots PAUSE for the tick (the
+                        # mixed program has no sampling operands): they
+                        # re-dispatch their committed token
+                        # idempotently and resume after the ingest.
+                        paused = [b for b in active
+                                  if not slot_pending[b]
+                                  and self._wants_sampling(
+                                      samp_of[slot_req[b]])]
+                        for b in paused:
+                            override[b] = True
                         cur = self._dispatch_mixed_step(
                             active, slot_req, slot_pending,
                             slot_ingested, tables, ctx, last_tok_host,
-                            override, builder, inflight, req_sp)
+                            override, builder, inflight, req_sp,
+                            paused=paused)
                     elif useful:
-                        cur = self._dispatch_step(active, slot_req,
-                                                  tables, ctx,
-                                                  last_tok_host,
-                                                  override, builder,
-                                                  inflight)
+                        if spec_mode:
+                            sv = samp_vec(set()) \
+                                if self.sampling_enabled else None
+                            cur = self._dispatch_spec_step(
+                                active, slot_req, slot_hist, tables,
+                                ctx, last_tok_host, override, builder,
+                                sv, max_new, slot_new, req_sp)
+                        else:
+                            sv = samp_vec(pend) \
+                                if self.sampling_enabled else None
+                            cur = self._dispatch_step(
+                                active, slot_req, tables, ctx,
+                                last_tok_host, override, builder,
+                                inflight, sv)
+                if cur is not None:
+                    # slots awaiting their first SAMPLED token resolve
+                    # it this step — ride the chunk_final first-token
+                    # machinery in the resolver (TTFT lands there).
+                    # Paused slots (mixed tick) keep waiting.
+                    firsts = {b for b in active if slot_await_first[b]
+                              and b not in (cur.get("chunk_mid") or ())}
+                    if firsts:
+                        cur["chunk_final"] = set(
+                            cur.get("chunk_final") or ()) | firsts
+                        for b in firsts:
+                            slot_await_first[b] = False
+                    # sampled requests' FINAL chunks: reroute from the
+                    # argmax first-token path to first-token replay
+                    cfs = {b for b in (cur.get("chunk_final") or ())
+                           if b not in firsts and slot_req[b] >= 0
+                           and self._wants_sampling(
+                               samp_of[slot_req[b]])}
+                    if cfs:
+                        cur["chunk_final"] = \
+                            set(cur["chunk_final"]) - cfs
+                        cur["chunk_final_sampled"] = cfs
                 prev, inflight = inflight, cur
                 if prev is not None:
-                    try:
-                        self._resolve_step(prev, slot_req, slot_new,
-                                           last_tok_host, max_new,
-                                           evict, req_sp, emit,
-                                           chunk_first_token)
-                    except DecodeWedgedError:
-                        # wedged decode: fail everything still pending
-                        # instead of hanging. Pages of the wedged step
-                        # are NOT reclaimed (the in-flight program owns
-                        # the pool arrays) — the predictor should be
-                        # rebuilt.
-                        self.stats["watchdog_trips"] += 1
-                        self._m_wedge.inc(**mlbl)
-                        for b in range(self.B):
-                            r = slot_req[b]
-                            if r >= 0:
-                                results[r] = slot_new[b]
-                                status[r] = "watchdog"
-                                slot_req[b] = -1
-                                req_sp[r].event("watchdog",
-                                                stage="decoding",
-                                                tokens=len(slot_new[b]))
-                                req_sp[r].end(status="watchdog")
-                                self._m_done.inc(status="watchdog",
-                                                 **mlbl)
-                                emit(r, "end", st="watchdog")
-                        for r in list(q.ids()):
-                            q.remove(r)
-                            finish_queued(r, "watchdog",
-                                          {"stage": "queued"})
-                        gen_sp.event("decode_wedged")
-                        gen_sp.end(status="watchdog")
-                        # crash-time forensics: the dump carries the
-                        # wedged requests' spans
-                        _obstr.flight_dump(reason="decode_wedged")
+                    if not resolve(prev):
                         break
                 elif cur is None:
                     if closed:
@@ -1739,7 +2102,8 @@ class ContinuousBatchingPredictor:
             prompt = plan["prompt"]
             L = len(prompt)
             firsts[plan["r"]] = int(nexts[i, -1])
-            if self.prefix_cache is not None:
+            if self.prefix_cache is not None \
+                    and not plan.get("no_cache"):
                 toks = [int(t) for t in nexts[i, bucket - L:]]
                 npages = -(-L // self.page)
                 self.prefix_cache.insert(prompt,
@@ -1788,11 +2152,16 @@ class ContinuousBatchingPredictor:
 
     # ------------------------------------------------------- decode ops --
     def _dispatch_step(self, active, slot_req, tables, ctx,
-                       last_tok_host, override, builder, inflight):
+                       last_tok_host, override, builder, inflight,
+                       samp=None):
         """Dispatch one decode step WITHOUT waiting for the previous
         step's token: continuing slots chain the device-resident next
         token straight back in; only newly admitted slots inject their
-        host-known first token."""
+        host-known first token. With `samp` (the per-slot sampling
+        operand bundle — temperature/top-k/top-p/seed/counter vectors)
+        the SAMPLING program variant runs instead: same cache write and
+        attention, next token drawn on device (greedy slots select the
+        raw argmax in-graph, token-identical to the plain program)."""
         import time as _time
         t0 = _time.perf_counter()
         meta_args = ()
@@ -1813,11 +2182,21 @@ class ContinuousBatchingPredictor:
         # the device buffer, and the host mutates tables/ctx/meta in
         # place while this step is still in flight (double buffering) —
         # snapshot them at dispatch
-        nxt, done, new_k, new_v = self._jit_call(
-            ("decode", tables.shape,
-             tuple(np.shape(m) for m in meta_args)), self._decode_jit,
-            self._p_vals, self._b_vals, self.pool.k, self.pool.v,
-            tables.copy(), ctx.copy(), tok_in, *meta_args)
+        if samp is not None:
+            st, sk, sp_, ss, sc = samp
+            nxt, done, new_k, new_v = self._jit_call(
+                ("decode_sample", tables.shape,
+                 tuple(np.shape(m) for m in meta_args)),
+                self._decode_sample_jit,
+                self._p_vals, self._b_vals, self.pool.k, self.pool.v,
+                tables.copy(), ctx.copy(), tok_in, st, sk, sp_, ss, sc,
+                *meta_args)
+        else:
+            nxt, done, new_k, new_v = self._jit_call(
+                ("decode", tables.shape,
+                 tuple(np.shape(m) for m in meta_args)), self._decode_jit,
+                self._p_vals, self._b_vals, self.pool.k, self.pool.v,
+                tables.copy(), ctx.copy(), tok_in, *meta_args)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
         snap = [(b, slot_req[b]) for b in active]
         ctx[active] += 1
@@ -1842,13 +2221,21 @@ class ContinuousBatchingPredictor:
 
     def _dispatch_mixed_step(self, active, slot_req, slot_pending,
                              slot_ingested, tables, ctx, last_tok_host,
-                             override, builder, inflight, req_sp):
+                             override, builder, inflight, req_sp,
+                             paused=()):
         """Dispatch one MIXED prefill+decode step: every slot with a
         pending prompt tail ingests its next chunk (page-aligned, up to
         this tick's adaptive bucket) while the decode slots take their
         normal single-token step — ONE compiled program, chained off
         the in-flight step exactly like `_dispatch_step` (the chunk
         tokens are host-known, so chunk ticks pipeline sync-free too).
+
+        `paused` slots (sampled-mode decodes — the mixed program has no
+        sampling operands, so their argmax output would be wrong)
+        re-dispatch their committed token without advancing: the K/V
+        rewrite at their frozen position is byte-identical, the output
+        is discarded (they ride the chunk_mid no-token path), and they
+        resume sampling decode once the chunk ingest finishes.
         """
         import time as _time
         t0 = _time.perf_counter()
@@ -1859,7 +2246,7 @@ class ContinuousBatchingPredictor:
             max(len(slot_pending[b]) for b in chunk_slots), n_dec)
         span_ids = np.full((self.B, qb), self.pad_token_id, np.int32)
         q_lens = np.ones((self.B,), np.int32)
-        mid, final = set(), set()
+        mid, final = set(paused), set()
         for b in chunk_slots:
             take = min(len(slot_pending[b]), qb)
             chunk = slot_pending[b][:take]
@@ -1881,6 +2268,8 @@ class ContinuousBatchingPredictor:
         meta_args = ()
         if builder is not None:
             for b in active:
+                if b in mid and b not in chunk_slots:
+                    continue   # paused: position frozen, meta unchanged
                 builder.advance_slot(b, int(ctx[b]) + int(q_lens[b]))
             m = builder.meta()
             from ..kernels.paged_attention import RaggedMetaBuilder
@@ -1903,16 +2292,202 @@ class ContinuousBatchingPredictor:
             *meta_args)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
         snap = [(b, slot_req[b]) for b in active]
-        ctx[active] += q_lens[active]
+        adv = [b for b in active if b not in paused]
+        ctx[adv] += q_lens[adv]
         self.stats["decode_steps"] += 1
         self.stats["mixed_steps"] += 1
         self._m_steps.inc(**mlbl)
         return {"tok": nxt, "done": done, "snap": snap, "t": t0,
                 "chunk_mid": mid, "chunk_final": final}
 
+    def _dispatch_spec_step(self, active, slot_req, slot_hist, tables,
+                            ctx, last_tok_host, override, builder,
+                            samp, max_new, slot_new, req_sp):
+        """Dispatch one SPECULATIVE multi-token decode step: each
+        slot's prompt-lookup drafter matches the request's recent token
+        suffix against its own prompt+generation history and proposes
+        up to spec_draft_tokens continuations; the committed last token
+        plus the drafts enter as a q_lens = 1+k span through the
+        variable-query ragged kernel, verified on device in ONE
+        compiled program (`_raw_spec_step`). ctx and the ragged meta
+        advance optimistically over the whole span — the resolver
+        rewinds them to the accepted prefix. A tick where no slot drew
+        drafts falls back to the plain (or sampling) decode program —
+        the spec span width is not paid for nothing.
+
+        Spec mode runs resolve-before-dispatch (the drafter needs the
+        resolved history), so there is never an in-flight step here:
+        tok_in comes entirely from the host-committed last tokens."""
+        import time as _time
+        from ..generation.sampling import (propose_ngram_drafts,
+                                           sampling_operands)
+        t0 = _time.perf_counter()
+        mlbl = self._mlbl
+        qs = self._spec_k + 1
+        span_ids = np.full((self.B, qs), self.pad_token_id, np.int32)
+        q_lens = np.ones((self.B,), np.int32)
+        drafts = {}
+        proposed = 0
+        for b in active:
+            r = slot_req[b]
+            room = max_new[r] - len(slot_new[b]) - 1
+            kb = min(self._spec_k, max(0, room))
+            d = propose_ngram_drafts(slot_hist[b], kb,
+                                     self._ngram_max) if kb > 0 else []
+            if d:
+                span_ids[b, 1:1 + len(d)] = d
+                q_lens[b] = 1 + len(d)
+                drafts[b] = list(d)
+                proposed += len(d)
+        if not drafts:
+            return self._dispatch_step(active, slot_req, tables, ctx,
+                                       last_tok_host, override,
+                                       builder, None, samp)
+        meta_args = ()
+        if builder is not None:
+            for b in active:
+                builder.advance_slot(b, int(ctx[b]) + int(q_lens[b]))
+            m = builder.meta()
+            from ..kernels.paged_attention import RaggedMetaBuilder
+            meta_args = tuple(m[k].copy()
+                              for k in RaggedMetaBuilder.FIELDS)
+        tok_in = jnp.asarray(last_tok_host.copy())
+        override[:] = False
+        if samp is None:
+            # sampling disabled: constant greedy operands — one spec
+            # program serves both modes (temperature 0 == argmax)
+            ops = sampling_operands([None] * self.B)
+            samp = (ops["temperature"], ops["top_k"], ops["top_p"],
+                    ops["seed"],
+                    np.fromiter((len(slot_new[b])
+                                 for b in range(self.B)),
+                                np.int32, self.B))
+        st, sk, sp_, ss, sc = samp
+        # .copy() on every host operand: the resolver mutates
+        # tables/ctx/meta before this step's buffers are read back
+        bonus, accepted, done, new_k, new_v = self._jit_call(
+            ("spec", qs, tables.shape,
+             tuple(np.shape(m) for m in meta_args)), self._spec_jit,
+            self._p_vals, self._b_vals, self.pool.k, self.pool.v,
+            tables.copy(), ctx.copy(), span_ids, q_lens.copy(), tok_in,
+            st, sk, sp_, ss, sc, *meta_args)
+        self.pool.k, self.pool.v = list(new_k), list(new_v)
+        snap = [(b, slot_req[b]) for b in active]
+        ctx0 = {b: int(ctx[b]) for b in active}
+        ctx[active] += q_lens[active]   # optimistic; resolve rewinds
+        self.stats["decode_steps"] += 1
+        self.stats["spec_ticks"] += 1
+        self.stats["spec_proposed"] += proposed
+        self._m_steps.inc(**mlbl)
+        self._m_spec_prop.inc(proposed, **mlbl)
+        return {"spec": True, "tok": bonus, "acc": accepted,
+                "done": done, "snap": snap, "t": t0, "ctx0": ctx0,
+                "drafts": drafts,
+                "qlen": {b: int(q_lens[b]) for b in active}}
+
+    def _resolve_spec_step(self, step, slot_req, slot_new, slot_hist,
+                           last_tok_host, max_new, ctx, override,
+                           builder, evict, req_sp, emit, first_cb):
+        """Sync one speculative verify step — three [B] vectors, the
+        decode loop's one designed sync point — and commit each slot's
+        accepted drafts plus the bonus/correction token: tokens append
+        (eos/budget truncate and evict exactly like plain decode), ctx
+        and the ragged meta REWIND to the kept prefix (rejected
+        positions' K/V was already rolled back in-graph by the
+        program), the drafting history extends, and the whole tick
+        streams as ONE multi-token StreamEvent span. Slots marked
+        chunk_final are resolving their first (sampled) token — TTFT
+        lands here via `first_cb`."""
+        import time as _time
+        self._await_step(step, (step["tok"], step["acc"],
+                                step["done"]))
+        # graft-lint: ok[GL102] — THE decode-loop sync point: three [B]
+        # vectors of the verify step (spec mode resolves before the
+        # next dispatch; the multi-token step replaces the one-step
+        # pipeline at the same one sync per tick)
+        bonus = np.asarray(step["tok"])
+        acc = np.asarray(step["acc"])    # graft-lint: ok[GL102] (ditto)
+        self._m_tok.observe(_time.perf_counter() - step["t"],
+                            **self._mlbl)
+        firsts = step.get("chunk_final") or ()
+        accepted_total = 0
+        for b, r in step["snap"]:
+            if slot_req[b] != r:
+                continue             # evicted (and maybe re-admitted)
+            drafts = step["drafts"].get(b, [])
+            a = min(int(acc[b]), len(drafts))
+            emitted = drafts[:a] + [int(bonus[b])]
+            new_ctx = step["ctx0"][b] + a + 1
+            ctx[b] = new_ctx
+            if builder is not None and a + 1 < step["qlen"][b]:
+                builder.rollback_slot(b, new_ctx)
+            if drafts:
+                accepted_total += a
+                req_sp[r].event("spec", proposed=len(drafts),
+                                accepted=a)
+            if b in firsts:
+                first_cb(b, r)       # first (sampled) token resolves
+            span_toks = []
+            ended = False
+            for t in emitted:
+                if self.eos_token_id is not None \
+                        and t == self.eos_token_id:
+                    ended = True     # parity: eos is stripped
+                    break
+                slot_new[b].append(t)
+                span_toks.append(t)
+                req_sp[r].event("token", i=len(slot_new[b]))
+                if len(slot_new[b]) >= max_new[r]:
+                    break
+            if span_toks:
+                slot_hist[b].extend(span_toks)
+                last_tok_host[b] = span_toks[-1]
+                override[b] = True
+                emit(r, "token", token=span_toks[-1],
+                     index=len(slot_new[b]), span=tuple(span_toks))
+            if ended or len(slot_new[b]) >= max_new[r]:
+                evict(b)
+        if accepted_total:
+            self.stats["spec_accepted"] += accepted_total
+            self._m_spec_acc.inc(accepted_total, **self._mlbl)
+        if self.stats["spec_proposed"]:
+            self._m_spec_rate.set(
+                self.stats["spec_accepted"]
+                / self.stats["spec_proposed"], **self._mlbl)
+
+    def _await_step(self, step, arrays):
+        """Watchdog-aware wait for a dispatched step's result buffers.
+        With the watchdog armed (self._wd_cur), polls the buffers'
+        is_ready() against a deadline instead of blocking
+        unconditionally — no thread spawn on the hot decode path; a
+        step that never resolves raises DecodeWedgedError. (The
+        decode_wedge fault holds is_ready 'false' for its sleep=
+        duration to drive this path in CI.)"""
+        import time as _time
+        wd = getattr(self, "_wd_cur", None)
+        if not wd:
+            return
+        fa = _faults.check("decode_wedge")
+        wedged_until = (_time.perf_counter()
+                        + float(fa.params.get("sleep", 2 * wd))) \
+            if fa is not None else 0.0
+        deadline = _time.perf_counter() + wd
+
+        def _ready(a):
+            return getattr(a, "is_ready", lambda: True)()
+
+        while True:
+            now = _time.perf_counter()
+            if now >= wedged_until and all(_ready(a) for a in arrays):
+                break
+            if now >= deadline:
+                raise DecodeWedgedError(
+                    f"decode step did not resolve within {wd}s")
+            _time.sleep(min(0.002, wd / 100.0))
+
     def _resolve_step(self, step, slot_req, slot_new, last_tok_host,
                       max_new, evict, req_sp=None, emit=None,
-                      first_cb=None):
+                      first_cb=None, sampled_first=None, hist=None):
         """Sync a PREVIOUSLY dispatched step (the next one is already in
         flight) and apply its tokens: append, detect completion, evict,
         and stream each applied token through `emit` (request-indexed
@@ -1924,35 +2499,15 @@ class ContinuousBatchingPredictor:
         mid-prompt chunk slots produce no token this tick; a slot whose
         FINAL chunk just resolved treats the step's argmax as its first
         generated token (`first_cb(b, r)` records TTFT/first_token
-        before the append/eos/budget handling).
-
-        With the watchdog armed (self._wd_cur), the sync polls the
-        device buffers' is_ready() against a deadline instead of
-        blocking unconditionally — no thread spawn on the hot decode
-        path; a step that never resolves raises DecodeWedgedError.
-        (The decode_wedge fault holds is_ready 'false' for its sleep=
-        duration to drive this path in CI.)"""
+        before the append/eos/budget handling). A SAMPLED request's
+        final chunk instead routes to `sampled_first(b, r)` — the
+        argmax is discarded and the serve loop switches the slot to
+        first-token replay. Decode ticks of slots awaiting that first
+        sampled token ride the same chunk_final path (the serve loop
+        marks them at dispatch). Committed tokens are appended to
+        `hist` (the prompt-lookup drafting history) when given."""
         import time as _time
-        wd = getattr(self, "_wd_cur", None)
-        if wd:
-            fa = _faults.check("decode_wedge")
-            wedged_until = (_time.perf_counter()
-                            + float(fa.params.get("sleep", 2 * wd))) \
-                if fa is not None else 0.0
-            deadline = _time.perf_counter() + wd
-
-            def _ready(a):
-                return getattr(a, "is_ready", lambda: True)()
-
-            while True:
-                now = _time.perf_counter()
-                if now >= wedged_until and _ready(step["tok"]) \
-                        and _ready(step["done"]):
-                    break
-                if now >= deadline:
-                    raise DecodeWedgedError(
-                        f"decode step did not resolve within {wd}s")
-                _time.sleep(min(0.002, wd / 100.0))
+        self._await_step(step, (step["tok"], step["done"]))
         # graft-lint: ok[GL102] — THE decode-loop sync point (and the
         # only one): two [B] vectors of a step whose successor is
         # already dispatched (double buffering)
@@ -1962,6 +2517,7 @@ class ContinuousBatchingPredictor:
                             **self._mlbl)
         chunk_mid = step.get("chunk_mid") or ()
         chunk_final = step.get("chunk_final") or ()
+        chunk_final_sampled = step.get("chunk_final_sampled") or ()
         if "chunk_mid" in step:
             self._m_mixed.observe(_time.perf_counter() - step["t"],
                                   **self._mlbl)
@@ -1970,6 +2526,12 @@ class ContinuousBatchingPredictor:
                 continue             # evicted (and maybe re-admitted)
             if b in chunk_mid:
                 continue             # mid-prompt chunk: no token yet
+            if b in chunk_final_sampled:
+                # sampled request finished ingesting: discard the
+                # argmax, hand the slot to first-token replay
+                if sampled_first is not None:
+                    sampled_first(b, r)
+                continue
             if b in chunk_final:
                 # the prompt just finished ingesting: this step's
                 # argmax is the request's FIRST generated token
@@ -1981,6 +2543,8 @@ class ContinuousBatchingPredictor:
                     continue
                 slot_new[b].append(t)
                 last_tok_host[b] = t
+                if hist is not None:
+                    hist[b].append(t)
                 if req_sp is not None:
                     req_sp[r].event("token", i=1)
                 if emit is not None:
@@ -1993,6 +2557,8 @@ class ContinuousBatchingPredictor:
             t = int(nxt[b])
             slot_new[b].append(t)
             last_tok_host[b] = t
+            if hist is not None:
+                hist[b].append(t)
             if req_sp is not None:
                 # decode tick: per-token latency reconstructable from
                 # consecutive event timestamps (capped per span) — the

@@ -120,6 +120,12 @@ class EngineBuilder:
         # pure-default config, so this stays 0 without an explicit
         # runtime_config.)
         g.setdefault("prefill_chunk_tokens", rc.prefill_chunk_tokens)
+        # program variants, pinned explicitly for the same reason as
+        # the chunk threshold: a build-host FLAGS_serve_spec_draft_
+        # tokens / FLAGS_serve_sampling must not silently reshape what
+        # the manifest claims was calibrated
+        g.setdefault("spec_draft_tokens", rc.spec_draft_tokens)
+        g.setdefault("sampling_enabled", rc.sampling_enabled)
         return g
 
     def effective_runtime_config(self):
@@ -134,6 +140,8 @@ class EngineBuilder:
             max_seq_len=int(g["max_seq_len"]),
             num_pages=g.get("num_pages"),
             prefill_chunk_tokens=int(g["prefill_chunk_tokens"]),
+            spec_draft_tokens=int(g["spec_draft_tokens"]),
+            sampling_enabled=bool(g["sampling_enabled"]),
             prompt_buckets=tuple(self.prompt_buckets))
 
     def build(self, path: str, wire_cache: bool = True,
@@ -179,6 +187,10 @@ class EngineBuilder:
                     sp.event("bucket", prompt_bucket=pb, batch=n)
             if geometry.get("prefill_chunk_tokens"):
                 self._capture_mixed(cb, rng, vocab, sp)
+            if geometry.get("spec_draft_tokens"):
+                self._compile_spec_sig(cb)
+                sp.event("spec", draft_tokens=int(
+                    geometry["spec_draft_tokens"]))
             if self.capture_forward:
                 self._capture_forward(engine, rng, vocab, sp)
             for name, fn, args in self._extra:
@@ -257,6 +269,49 @@ class EngineBuilder:
         _, _, new_k, new_v = cb._jit_call(
             sig, cb._mixed_jit, cb._p_vals, cb._b_vals, cb.pool.k,
             cb.pool.v, tables, ctx, span_ids, q_lens, tok_in,
+            *meta_args)
+        cb.pool.k, cb.pool.v = list(new_k), list(new_v)
+
+    def _compile_spec_sig(self, cb):
+        """Compile the ("spec", k+1, ...) speculative-verify signature
+        directly with dispatch-shaped operands (every slot idle over
+        the trash page, one-token spans, greedy sampling operands).
+        Calibration traffic cannot reliably steer the drafter — whether
+        a prompt-lookup match fires depends on the synthetic tokens —
+        but the signature is dispatchable whenever ANY request's
+        history matches, so warm start must carry it. The sampling
+        decode variant needs no special handling: with
+        ``sampling_enabled`` in the geometry the calibration serve
+        loop dispatches ("decode_sample", ...) instead of ("decode",
+        ...) on every tick. Keep the sig tuple and operand dtypes in
+        lockstep with `_dispatch_spec_step`."""
+        import jax.numpy as jnp
+        cb._ensure_ready()
+        qs = cb._spec_k + 1
+        tables = np.full((cb.B, cb.pages_per_seq), cb._trash, np.int32)
+        ctx = np.ones((cb.B,), np.int32)
+        span_ids = np.full((cb.B, qs), cb.pad_token_id, np.int32)
+        q_lens = np.ones((cb.B,), np.int32)
+        tok_in = jnp.asarray(np.zeros((cb.B,), np.int32))
+        from ...generation.sampling import sampling_operands
+        ops = sampling_operands([None] * cb.B)
+        samp = (ops["temperature"], ops["top_k"], ops["top_p"],
+                ops["seed"], np.zeros((cb.B,), np.int32))
+        meta_args = ()
+        if cb.use_ragged:
+            from ...kernels.paged_attention import RaggedMetaBuilder
+            mb = RaggedMetaBuilder(cb.B, cb.pages_per_seq, cb.page,
+                                   cb._trash)
+            for b in range(cb.B):
+                mb.clear_slot(b)
+            m = mb.meta()
+            meta_args = tuple(m[k].copy()
+                              for k in RaggedMetaBuilder.FIELDS)
+        sig = ("spec", qs, tables.shape,
+               tuple(np.shape(x) for x in meta_args))
+        _, _, _, new_k, new_v = cb._jit_call(
+            sig, cb._spec_jit, cb._p_vals, cb._b_vals, cb.pool.k,
+            cb.pool.v, tables, ctx, span_ids, q_lens, tok_in, *samp,
             *meta_args)
         cb.pool.k, cb.pool.v = list(new_k), list(new_v)
 

@@ -61,6 +61,12 @@ COMPILED_GEOMETRY_KEYS = frozenset({
     # chunked prefill: the mixed-step programs' span buckets derive
     # from it, so a different threshold means different executables
     "prefill_chunk_tokens",
+    # speculative decoding + on-device sampling are program VARIANTS:
+    # the verify span width is spec_draft_tokens + 1 and
+    # sampling_enabled switches decode to the batched-operand sampling
+    # program (spec_ngram_max is host-side drafting policy — runtime-
+    # only, never invalidates)
+    "spec_draft_tokens", "sampling_enabled",
 })
 
 

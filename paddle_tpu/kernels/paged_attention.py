@@ -316,6 +316,17 @@ class RaggedMetaBuilder:
         row = np.full(self.pps, self.trash, np.int32)
         self.set_slot(b, row, 1)
 
+    def rollback_slot(self, b, post_len):
+        """Speculative-verify rewind: the dispatch advanced the segment
+        optimistically to cover the whole drafted span; after the
+        on-device verify resolves, rejected positions may leave the
+        slot shorter than advertised. Shrink the segment back to cover
+        exactly `post_len` written tokens (the kept prefix) — the
+        inverse of `advance_slot`, rebuilt from the stored table row so
+        first/last/valid return to what a never-speculated slot of
+        that length would carry."""
+        self.set_slot(b, self._tables[b], post_len)
+
     def advance_slot(self, b, post_len):
         """ctx grew by one: extend the segment only when the new length
         crosses into a fresh page — O(1) host work per decode step."""

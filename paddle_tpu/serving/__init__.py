@@ -42,6 +42,7 @@ from .scheduler import (  # noqa: F401
 from .streaming import (  # noqa: F401
     ServeRequest, StreamEvent, TokenStream,
 )
+from ..generation.sampling import SamplingParams  # noqa: F401
 from .router import (  # noqa: F401
     Replica, Router, RequestHandle,
 )
@@ -51,6 +52,6 @@ from .autoscale import (  # noqa: F401
 
 __all__ = [
     "FifoQueue", "WeightedFairScheduler", "ServeRequest", "StreamEvent",
-    "TokenStream", "Replica", "Router", "RequestHandle",
-    "autoscale_signals", "publish_autoscale",
+    "TokenStream", "SamplingParams", "Replica", "Router",
+    "RequestHandle", "autoscale_signals", "publish_autoscale",
 ]

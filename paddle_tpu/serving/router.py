@@ -89,13 +89,29 @@ class RequestHandle:
 
     # ------------------------------------------------- replica-side API --
     def _push_token(self, ev: StreamEvent):
-        if ev.index <= self._pushed_max:
+        """Exactly-once token delivery across re-admissions. One event
+        covers a whole decode TICK: `ev.span` carries every token the
+        tick committed (speculative ticks commit several; `ev.index` is
+        the LAST one's ordinal). A re-admitted request re-decodes its
+        prefix on the new replica, and a re-decoded tick may OVERLAP
+        the already-delivered ordinals mid-span — only the fresh tail
+        is appended/forwarded, trimmed to a consistent event."""
+        toks = tuple(ev.span) or \
+            ((ev.token,) if ev.token is not None else ())
+        base = ev.index - len(toks)      # ordinal of toks[0] is base+1
+        fresh = [(base + 1 + i, t) for i, t in enumerate(toks)
+                 if base + 1 + i > self._pushed_max]
+        if not fresh:
             return          # re-decoded prefix after a re-admission
-        self._pushed_max = ev.index
-        self.tokens.append(ev.token)
+        self._pushed_max = fresh[-1][0]
+        for _, t in fresh:
+            self.tokens.append(t)
         if self.first_token_ts is None:
             self.first_token_ts = ev.ts
             self.span.event("first_token")
+        if len(fresh) < len(toks):       # partial overlap: trim
+            ev = ev._replace(span=tuple(t for _, t in fresh),
+                             token=fresh[-1][1], index=fresh[-1][0])
         self._q.put(ev)
 
     def _finish(self, status: str, ts: Optional[float] = None):

@@ -38,7 +38,15 @@ class StreamEvent(NamedTuple):
     wall-clock timestamp when tracing is enabled, else time.time() at
     emission; `status` is the terminal status on "end" events (ok /
     deadline / shed / cancelled / watchdog / rejected_*); `meta` is the
-    ServeRequest.meta passthrough (None for the list-based APIs)."""
+    ServeRequest.meta passthrough (None for the list-based APIs).
+
+    **Token spans.** One "token" event is emitted per DECODE TICK, not
+    per token: with speculative decoding a tick commits several tokens
+    at once, and `span` carries the whole tuple in order. `token` is
+    the span's LAST token and `index` its ordinal, so single-token
+    consumers keep working unchanged (`span == (token,)` on ordinary
+    ticks). Consumers that must see every token iterate `span`; the
+    first span token's ordinal is ``index - len(span) + 1``."""
     request: int
     kind: str                      # "token" | "end"
     token: Optional[int] = None
@@ -46,18 +54,24 @@ class StreamEvent(NamedTuple):
     ts: float = 0.0
     status: Optional[str] = None
     meta: object = None
+    span: tuple = ()
 
 
 class ServeRequest(NamedTuple):
     """Dynamic-intake work item for ContinuousBatchingPredictor
     .serve_stream: one request with its own budget/tier/deadline.
     `deadline_s` is seconds from the moment the serve loop first sees
-    the request. `meta` rides through to every StreamEvent."""
+    the request. `meta` rides through to every StreamEvent.
+    `sampling` is an optional generation.sampling.SamplingParams —
+    per-request temperature/top-k/top-p/seed served as batched operands
+    by the on-device sampling decode program (the predictor must be
+    constructed with ``sampling_enabled=True``; None = greedy)."""
     prompt: List[int]
     max_new_tokens: int = 32
     tier: Optional[str] = None
     deadline_s: Optional[float] = None
     meta: object = None
+    sampling: object = None
 
 
 class TokenStream:

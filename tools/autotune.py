@@ -66,6 +66,9 @@ CONFIG_DEFAULTS: Dict = {
     "max_seq_len": 512,
     "prompt_buckets": [],
     "prefill_chunk_tokens": 0,
+    "spec_draft_tokens": 0,
+    "spec_ngram_max": 3,
+    "sampling_enabled": False,
     "max_queue": None,
     "shed_policy": "newest",
     "decode_watchdog_s": 0.0,
@@ -499,6 +502,46 @@ def propose_comm(rep: Replay, base: Dict) -> List[dict]:
     return out
 
 
+def propose_spec(rep: Replay, base: Dict) -> List[dict]:
+    """Speculative-decode sizing from the MEASURED acceptance rate
+    (``serving.spec.proposed_tokens`` / ``serving.spec.accepted_tokens``
+    — the serve loop exports both, plus the running
+    ``serve.spec.accept_rate`` gauge). The drafter is free (host-side
+    prompt lookup), but every drafted token widens the verify span: a
+    high accept rate says the workload is predictable enough to draft
+    DEEPER; a low one says the span width is wasted compute — turn it
+    off. No proposal fires while speculation has never run (rate
+    unmeasurable) or the sample is an anecdote."""
+    proposed_t = rep.counter_total("serving.spec.proposed_tokens")
+    accepted_t = rep.counter_total("serving.spec.accepted_tokens")
+    if proposed_t < MIN_SAMPLES:
+        return []
+    rate = accepted_t / proposed_t
+    cur = int(base.get("spec_draft_tokens") or 0)
+    window = rep.window_s()
+    if cur > 0 and rate >= 0.7 and cur < 8:
+        return [_proposal(
+            "spec_draft_tokens", cur, min(cur * 2, 8),
+            "the target model accepts most drafted tokens "
+            "(accept rate >= 0.7): the workload is predictable enough "
+            "to draft deeper — each extra accepted token is one fewer "
+            "compiled decode step",
+            series="serving.spec.accepted_tokens", n=int(proposed_t),
+            window_s=window, value=round(rate, 4), threshold=0.7,
+            accepted_tokens=int(accepted_t))]
+    if cur > 0 and rate < 0.25:
+        return [_proposal(
+            "spec_draft_tokens", cur, 0,
+            "drafts are mostly rejected (accept rate < 0.25): every "
+            "verify span pays k+1 positions of attention and K/V "
+            "rollback for ~1 committed token — plain decode is "
+            "cheaper on this workload",
+            series="serving.spec.accepted_tokens", n=int(proposed_t),
+            window_s=window, value=round(rate, 4), threshold=0.25,
+            accepted_tokens=int(accepted_t))]
+    return []
+
+
 # memory-pressure thresholds for the zero_stage proposal: below these
 # the sharding's extra collectives buy nothing worth their latency
 _ZERO1_OPT_BYTES = 64 << 20
@@ -557,6 +600,7 @@ def analyze(paths: List[str], base: Optional[Dict] = None,
     proposals += propose_pool(rep, cfg)
     proposals += propose_queue(rep, cfg, slo_ttft_s)
     proposals += propose_quantum(rep, cfg)
+    proposals += propose_spec(rep, cfg)
     proposals += propose_comm(rep, cfg)
     proposals += propose_zero(rep, cfg)
     tuned = dict(cfg)

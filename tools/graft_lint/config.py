@@ -35,6 +35,17 @@ HOT_PATH_FUNCTIONS = (
      "ContinuousBatchingPredictor._dispatch_mixed_step"),
     ("paddle_tpu/inference/__init__.py",
      "ContinuousBatchingPredictor._chunk_bucket"),
+    # speculative decoding: draft/dispatch/verify-resolve run once per
+    # multi-token tick — a stray sync there forfeits the whole point
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._dispatch_spec_step"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._resolve_spec_step"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._await_step"),
+    # host-side prompt-lookup drafter: pure-python list matching, runs
+    # per spec tick per slot
+    ("paddle_tpu/generation/sampling.py", "propose_ngram_drafts"),
     # serving front end: router / scheduler / streaming are host-side
     # by design — ANY device sync there stalls every tenant
     ("paddle_tpu/serving/*.py", "*"),
@@ -125,6 +136,9 @@ RUNTIME_CONFIG_HOME = "paddle_tpu/framework/runtime_config.py"
 RUNTIME_CONFIG_KNOBS = frozenset({
     "serve_prefill_chunk_tokens",
     "serve_decode_watchdog_s",
+    "serve_spec_draft_tokens",
+    "serve_spec_ngram_max",
+    "serve_sampling",
     "grad_bucket_bytes",
     "quantized_grad_comm",
 })

@@ -273,6 +273,15 @@ class Request:
                        if e.get("name") == "prefill_chunk"]
         self.ingest = (ft["ts"] - self.chunks[0]["ts"]) \
             if ft and self.chunks else None
+        # speculative decoding (docs/SERVING.md): one spec event per
+        # verify tick carrying proposed/accepted draft counts — the
+        # accepted column and the accept-rate summary read these
+        self.spec = [e for e in span.get("events") or []
+                     if e.get("name") == "spec"]
+        self.spec_proposed = sum(int(e.get("proposed") or 0)
+                                 for e in self.spec)
+        self.spec_accepted = sum(int(e.get("accepted") or 0)
+                                 for e in self.spec)
 
     @property
     def per_token(self) -> List[float]:
@@ -315,6 +324,7 @@ def render(spans: List[dict], top_requests: int = 5,
               + (f", {len(r.chunks)} prefill chunks" if r.chunks
                  else "") + ") ==")
             chunk_i = 0
+            spec_i = 0
             for e in r.span.get("events") or []:
                 rel = (e["ts"] - r.start) * 1e3
                 name = e["name"]
@@ -323,6 +333,11 @@ def render(spans: List[dict], top_requests: int = 5,
                     # of a chunked request reads chunk-by-chunk
                     name = f"prefill_chunk[{chunk_i}]"
                     chunk_i += 1
+                elif name == "spec":
+                    # number the verify ticks so multi-token decode
+                    # progress reads tick-by-tick
+                    name = f"spec[{spec_i}]"
+                    spec_i += 1
                 attrs = ", ".join(f"{k}={v}" for k, v in e.items()
                                   if k not in ("ts", "name"))
                 w(f"  +{rel:9.3f}ms  {name}"
@@ -384,13 +399,23 @@ def render(spans: List[dict], top_requests: int = 5,
         w("== requests ==")
         w("  outcomes        " + "  ".join(
             f"{k}={v}" for k, v in sorted(outcomes.items())))
+        sp_prop = sum(r.spec_proposed for r in reqs)
+        sp_acc = sum(r.spec_accepted for r in reqs)
+        if sp_prop:
+            sp_ticks = sum(len(r.spec) for r in reqs)
+            w(f"  speculation     proposed={sp_prop}  accepted={sp_acc}"
+              f"  accept_rate={sp_acc / sp_prop:.3f}"
+              f"  tokens/verify-tick="
+              f"{(sp_acc + sp_ticks) / max(sp_ticks, 1):.2f}")
         w(f"  {'request':<10}{'status':<12}{'prompt':>7}{'tokens':>7}"
-          f"{'chunks':>7}{'wait ms':>9}{'ttft ms':>9}{'e2e ms':>10}")
+          f"{'chunks':>7}{'spec':>7}{'wait ms':>9}{'ttft ms':>9}"
+          f"{'e2e ms':>10}")
         for r in sorted(reqs, key=lambda r: -r.e2e)[:top_requests]:
             w(f"  {r.id:<10}{r.status:<12}"
               f"{r.prompt_len if r.prompt_len is not None else '?':>7}"
               f"{r.tokens if r.tokens is not None else '?':>7}"
               f"{len(r.chunks) if r.chunks else '-':>7}"
+              f"{r.spec_accepted if r.spec else '-':>7}"
               f"{r.queue_wait * 1e3 if r.queue_wait is not None else 0:>9.2f}"
               f"{r.ttft * 1e3 if r.ttft is not None else 0:>9.2f}"
               f"{r.e2e * 1e3:>10.2f}")

@@ -428,6 +428,21 @@ def serve_bench(argv=None):
                          "accepted-tokens/step and tokens/s asserted "
                          "from the JSONL, plus a zero-compile warm "
                          "start of the spec+sampling program variants")
+    ap.add_argument("--replay", action="store_true",
+                    help="run the trace-driven control-loop scenario "
+                         "instead: production-shaped traffic "
+                         "(tools/trace_replay.py) with a prefill load "
+                         "spike, controller-enabled pool vs static "
+                         "pool, SLO verdicts and the control-decision "
+                         "audit asserted from the JSONL")
+    ap.add_argument("--trace", default=None,
+                    help="[replay] trace JSONL to replay (default: "
+                         "synthesize one; with --smoke, the checked-in "
+                         "tests/fixtures/trace_smoke.jsonl)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="[replay] fast tier-1 mode: tiny fixture "
+                         "trace, controller arm only, no SLO-verdict "
+                         "claims")
     ap.add_argument("--engine-dir", default=None,
                     help="[coldstart] engine bundle directory (default: "
                          "a temp dir; pass a persistent path to measure "
@@ -439,6 +454,8 @@ def serve_bench(argv=None):
     ap.add_argument("--flood", type=int, default=None,
                     help="[mt] low-tier flood size for the fairness arm")
     a = ap.parse_args(argv)
+    if a.replay:
+        return serve_replay_bench(a)
     if a.multitenant:
         return serve_mt_bench(a)
     if a.coldstart:
@@ -1709,6 +1726,432 @@ def serve_mt_bench(a):
             "bench_code_sha": _bench_code_sha(),
         },
     }
+    print(json.dumps(result))
+    return 0
+
+
+def serve_replay_bench(a):
+    """Trace-driven control-loop scenario (`--serve --replay`): the
+    first telemetry->action acceptance. A production-shaped trace
+    (tools/trace_replay.py: zipf sessions, diurnal ramp, tenant mix,
+    lognormal lengths) with a prefill-heavy load spike is replayed
+    against the full router twice:
+
+    1. **static** — a fixed single-replica pool (the pre-controller
+       deployment).
+    2. **controller** — the same pool fronted by
+       serving.PoolController: an SLO engine (slo.py) burns on the
+       declared TTFT target, and the control loop revives/spawns
+       pre-warmed spare replicas, shifts WFS quanta, and sheds at the
+       admission edge; every decision lands as a ``{"kind":
+       "control"}`` JSONL record.
+
+    The declared SLO (p99 TTFT <= 4x the measured unloaded p99) is the
+    claim: under the spike the controller arm must hold it while the
+    static arm breaches, decode inter-token p99 must stay flat, and
+    the whole decision history must replay cleanly from the JSONL
+    (trace_replay.rebuild_timeline == the live end state — the test in
+    tests/test_trace_replay.py asserts all of it from the file alone).
+
+    ``--smoke`` is the tier-1 arm: the checked-in fixture trace, the
+    controller arm only, no SLO-verdict claims — the loop is exercised
+    on every CI run without the slow spike measurement.
+    """
+    import math
+    import threading
+
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import runtime as obs_rt
+    from paddle_tpu.observability.slo import SLOEngine, SLOSpec
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ContinuousBatchingPredictor
+    from paddle_tpu.serving import (Router, PoolController,
+                                    ControllerConfig)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import trace_replay as tr
+    finally:
+        sys.path.pop(0)
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        batch, page, max_seq = 8, 16, 1024
+        n_requests, duration_s = 160, 30.0
+        plen_p50, plen_max, max_new_p50, max_new_max = 80, 512, 24, 48
+    else:
+        # CPU arm: usually ONE core, so extra replica loops cannot add
+        # capacity (they steal it) — the controller's winnable levers
+        # here are the per-tenant ones, quantum shifting and admission
+        # shed. max_batch_size=1 makes per-replica service sequential
+        # and long decodes make the service time large enough that the
+        # interactive tenant needs MORE than its naive fair share
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        batch, page, max_seq = 1, 8, 192
+        # n_requests is calibrated to the measured service time after
+        # the unloaded probe runs (below)
+        n_requests, duration_s = 0, 10.0
+        plen_p50, plen_max, max_new_p50, max_new_max = 16, 32, 64, 96
+
+    # the deliberately NEUTRAL baseline: both arms declare equal
+    # weights; discovering that the interactive tenant needs priority
+    # under load is the controller's job (shift_quantum), not the
+    # operator's foresight
+    weights = {"interactive": 1, "batch": 1}
+    smoke = bool(a.smoke)
+    spares = 1 if smoke else (2 if on_tpu else 0)
+
+    # ---- the trace ---------------------------------------------------
+    if a.trace:
+        header, reqs = tr.load_trace(a.trace)
+        spec = (header or {}).get("spec", {})
+    elif smoke:
+        header, reqs = tr.load_trace(
+            os.path.join(repo, "tests", "fixtures", "trace_smoke.jsonl"))
+        spec = (header or {}).get("spec", {})
+    else:
+        # a steady interactive tenant that needs more than half the
+        # pool's capacity, plus a batch-tier flood across the middle
+        # of the trace — under neutral weights the flood starves the
+        # interactive tenant; the acceptance regime from the issue
+        spike = ({"start_frac": 0.35, "dur_frac": 0.25, "factor": 3.0,
+                  "tier": "batch", "prompt_len_factor": 2.0}
+                 if on_tpu else
+                 {"start_frac": 0.35, "dur_frac": 0.5, "factor": 5.0,
+                  "tier": "batch", "prompt_len_factor": 1.0})
+        spec = {"requests": n_requests, "duration_s": duration_s,
+                "sessions": 8, "zipf_alpha": 1.1, "seed": 11,
+                "diurnal": 0.0,
+                "tiers": {"interactive": 0.85, "batch": 0.15},
+                "prompt_len_p50": plen_p50, "prompt_len_max": plen_max,
+                "max_new_p50": max_new_p50, "max_new_max": max_new_max,
+                "spike": spike}
+        # CPU: the arrival rate is calibrated to the measured service
+        # time after the unloaded probe runs (below)
+        reqs = tr.synthesize(spec) if on_tpu else None
+    if smoke:
+        # compress arrivals so the fixture replays in ~2s of wall time
+        span = max((r["t"] for r in reqs), default=1.0) or 1.0
+        time_scale = 2.0 / span
+    else:
+        time_scale = 1.0
+    def _clamp(rs):
+        for r in rs:
+            r["prompt_len"] = min(int(r["prompt_len"]),
+                                  max_seq - int(r["max_new"]) - 1)
+        return rs
+
+    if reqs is not None:
+        _clamp(reqs)
+
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(repo, "output", "telemetry_serve_replay.jsonl")
+    was_enabled = obs.enabled()
+    obs.enabled(True)
+    obs_rt.configure(path)
+    reg = obs.get_registry()
+    kw = dict(max_batch_size=batch, page_size=page, max_seq_len=max_seq)
+    vocab = cfg.vocab_size
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+
+    def warmed(name):
+        """Pre-warm one predictor on EVERY prefill shape the replay can
+        see (each power-of-two prompt bucket, each admission group
+        size), so neither arm ever pays jit tracing mid-measurement —
+        compile caches are per-instance, so an asymmetric warmup would
+        bias whichever arm runs first."""
+        p = ContinuousBatchingPredictor(model, name=name, **kw)
+        rng = np.random.RandomState(abs(hash(name)) % 2**31)
+        top = min(plen_max, max_seq - max_new_max - 1)
+        buckets, b = [], 8
+        while b < top:
+            buckets.append(b)
+            b *= 2
+        buckets.append(b)
+        for ln in buckets:
+            ln = min(ln, top)
+            for group in {1, batch}:
+                w = [rng.randint(2, vocab, (ln,)).tolist()
+                     for _ in range(group)]
+                p.generate(w, max_new_tokens=2)
+        return p
+
+    def replay(router, controller=None, tick_interval=0.05):
+        """Pace the trace against the router in (scaled) real time; a
+        background ticker drives the control loop the way a sidecar
+        would. Returns the (trace_request, handle) pairs."""
+        stop = threading.Event()
+
+        def ticker():
+            while not stop.is_set():
+                controller.tick()
+                stop.wait(tick_interval)
+
+        th = None
+        if controller is not None:
+            th = threading.Thread(target=ticker, daemon=True)
+            th.start()
+        pairs = []
+        t0 = time.perf_counter()
+        try:
+            for r in reqs:
+                delay = r["t"] * time_scale \
+                    - (time.perf_counter() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                prompt = tr.session_prompt(int(r["session"]),
+                                           int(r["prompt_len"]), vocab)
+                pairs.append((r, router.submit(
+                    prompt, max_new_tokens=int(r["max_new"]),
+                    tier=r["tier"])))
+            for _, h in pairs:
+                h.result(timeout=600)
+        finally:
+            if th is not None:
+                stop.set()
+                th.join(timeout=5)
+        return pairs
+
+    def p99(xs):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(int(math.ceil(0.99 * len(xs))) - 1, len(xs) - 1)]
+
+    def arm_stats(arm, pairs, router):
+        ttft = {"base": [], "spike": []}
+        ttft_int = {"base": [], "spike": []}  # the protected tenant
+        itl = {"base": [], "spike": []}
+        statuses = {}
+        for r, h in pairs:
+            statuses[h.status] = statuses.get(h.status, 0) + 1
+            ph = r.get("phase", "base")
+            if h.first_token_ts is not None:
+                ttft[ph].append(h.first_token_ts - h.submit_ts)
+                if r.get("tier") == "interactive":
+                    ttft_int[ph].append(h.first_token_ts - h.submit_ts)
+            # the handle's queue still holds every StreamEvent: the
+            # per-tick timestamps give inter-token gaps post hoc
+            last = None
+            for ev in h.stream(timeout=1.0):
+                if ev.kind != "token":
+                    continue
+                if last is not None:
+                    itl[ph].append(ev.ts - last)
+                last = ev.ts
+        rec = {"kind": "serve_replay_arm", "ts": time.time(),
+               "arm": arm, "requests": len(pairs),
+               "statuses": statuses,
+               "ttft_p99_base_s": round(p99(ttft["base"]), 6),
+               "ttft_p99_spike_s": round(p99(ttft["spike"]), 6),
+               "ttft_int_p99_base_s": round(p99(ttft_int["base"]), 6),
+               "ttft_int_p99_spike_s": round(p99(ttft_int["spike"]), 6),
+               "itl_p99_base_s": round(p99(itl["base"]), 6),
+               "itl_p99_spike_s": round(p99(itl["spike"]), 6),
+               "pool_end": len(router.healthy())}
+        obs_rt.export_record(rec)
+        _log(f"replay[{arm}]: spike interactive ttft p99 "
+             f"{rec['ttft_int_p99_spike_s'] * 1e3:.1f}ms (all tiers "
+             f"{rec['ttft_p99_spike_s'] * 1e3:.1f}ms), pool end "
+             f"{rec['pool_end']}, statuses {statuses}")
+        return rec
+
+    summary = {}
+    try:
+        base_pred = warmed("replica0")
+        spare_preds = [warmed(f"spare{i}") for i in range(spares)]
+
+        # ---- declare the SLO from an unloaded measurement ------------
+        # spike-shaped prompts through the single warm replica, one at
+        # a time: the target is 4x the p99 an unloaded pool delivers,
+        # declared BEFORE either arm runs
+        reg.reset()
+        rng = np.random.RandomState(23)
+        with Router([base_pred], tier_weights=weights, seed=0) as r0:
+            hs = [r0.submit(rng.randint(
+                2, vocab,
+                (min(2 * plen_p50, max_seq - max_new_max - 1),)
+            ).tolist(), max_new_tokens=max_new_p50,
+                tier="interactive") for _ in range(6)]
+            unloaded = []
+            for h in hs:
+                h.result(timeout=600)
+                if h.first_token_ts is not None:
+                    unloaded.append(h.first_token_ts - h.submit_ts)
+        if reqs is None:
+            # calibrate the load to the measured machine: the probe is
+            # 6 serial requests through one warm replica, so its p99
+            # TTFT is ~5 queued services -> service_s ~= p99/5. Aim
+            # the interactive tier's offered load at ~0.7 of the one
+            # core: above its 50% fair share under the neutral 1:1
+            # weights (so the static arm starves it behind the flood),
+            # below capacity (so a controller that re-weights and
+            # sheds can hold its SLO)
+            service_s = max(p99(unloaded) / 5.0, 0.01)
+            spk = spec["spike"]
+            rate = 0.65 / service_s / spec["tiers"]["interactive"]
+            weight_time = duration_s * (
+                1.0 + float(spk["dur_frac"])
+                * (float(spk["factor"]) - 1.0))
+            spec["requests"] = n_requests = int(
+                min(max(rate * weight_time, 80), 1000))
+            reqs = _clamp(tr.synthesize(spec))
+            obs_rt.export_record(
+                {"kind": "serve_replay_calibration", "ts": time.time(),
+                 "service_s": round(service_s, 6),
+                 "requests": n_requests})
+        # the declared target sits where the scenario's physics put it:
+        # an unloaded pool clears it trivially (4x margin on the
+        # no-queue p99), a starved tenant behind a batch flood cannot
+        # (its queue wait overflows it by seconds), and a tenant the
+        # controller re-weights within its reaction time can — the
+        # floor absorbs the detect+act transient
+        slo_ttft_s = max(4.0 * p99(unloaded),
+                         0.25 if on_tpu else 1.0)
+        # the engine alerts on a tighter internal target (SRE style:
+        # page while there is still budget to save) so the controller
+        # acts BEFORE the declared SLO is already spent
+        alert_ttft_s = slo_ttft_s / 4.0
+        obs_rt.export_record(
+            {"kind": "serve_replay_slo", "ts": time.time(),
+             "unloaded_ttft_p99_s": round(p99(unloaded), 6),
+             "slo_ttft_s": round(slo_ttft_s, 6),
+             "smoke": smoke, "time_scale": round(time_scale, 4)})
+        _log(f"replay: declared SLO p99 TTFT <= "
+             f"{slo_ttft_s * 1e3:.1f}ms")
+
+        fast_s, slow_s = (1.0, 10.0) if smoke else (1.5, 15.0)
+
+        def make_controller(router):
+            engine = SLOEngine(
+                [SLOSpec("ttft", "serving.router.ttft_seconds",
+                         target=alert_ttft_s, objective=0.9),
+                 SLOSpec("ttft_interactive",
+                         "serving.router.ttft_seconds",
+                         target=alert_ttft_s, objective=0.9,
+                         labels={"tier": "interactive"},
+                         tier="interactive")],
+                fast_window_s=fast_s, slow_window_s=slow_s)
+            pool = list(spare_preds)
+            return PoolController(
+                router, slo_engine=engine,
+                spawn=lambda: pool.pop() if pool else None,
+                config=ControllerConfig(
+                    slo_name="ttft",
+                    shed_burn=1.2,
+                    scale_out_cooldown_s=0.2,
+                    scale_in_cooldown_s=4.0,
+                    shift_cooldown_s=0.3,
+                    max_replicas=1 + spares,
+                    # one core: the already-admitted flood can only be
+                    # out-scheduled, so the shift lever must be able to
+                    # hand the burning tier ~the whole quantum
+                    weight_shift_factor=4.0,
+                    max_weight_factor=32.0),
+                slo_ttft_s=slo_ttft_s)
+
+        # ---- arm 1: controller-enabled pool --------------------------
+        reg.reset()
+        with Router([base_pred], tier_weights=weights,
+                    seed=0) as router:
+            ctl = make_controller(router)
+            ctl_pairs = replay(router, controller=ctl,
+                               tick_interval=0.1)
+            ctl_rec = arm_stats("controller", ctl_pairs, router)
+            end_state = {"pool_size": len(router.healthy()),
+                         "tier_weights": dict(router.tier_weights),
+                         "shed_tiers": sorted(router.shed_tiers)}
+            decisions = list(ctl.decisions)
+        timeline = tr.rebuild_timeline(decisions)
+        timeline_ok = (
+            timeline["pool_size"] == end_state["pool_size"]
+            and timeline["tier_weights"] == {
+                k: float(v)
+                for k, v in end_state["tier_weights"].items()}
+            and timeline["shed_tiers"] == end_state["shed_tiers"])
+        obs_rt.export_record(
+            {"kind": "serve_replay_timeline", "ts": time.time(),
+             "rebuilt": {k: timeline[k] for k in
+                         ("pool_size", "tier_weights", "shed_tiers",
+                          "decisions")},
+             "live": end_state, "consistent": bool(timeline_ok)})
+
+        summary = {"kind": "serve_replay_summary", "ts": time.time(),
+                   "smoke": smoke, "slo_ttft_s": round(slo_ttft_s, 6),
+                   "requests": len(reqs),
+                   "controller": ctl_rec,
+                   "control_decisions": len(decisions) - 1,
+                   "timeline_consistent": bool(timeline_ok)}
+
+        # ---- arm 2: static pool (skipped in smoke) -------------------
+        if not smoke:
+            reg.reset()
+            with Router([base_pred], tier_weights=weights,
+                        seed=0) as router:
+                static_pairs = replay(router, controller=None)
+                static_rec = arm_stats("static", static_pairs, router)
+            summary["static"] = static_rec
+            # the declared SLO is per-tenant: the interactive tier's
+            # p99 TTFT (the batch tier is the declared sacrifice —
+            # shed/deprioritized under burn)
+            summary["controller_within_slo"] = bool(
+                ctl_rec["ttft_int_p99_spike_s"] <= slo_ttft_s)
+            summary["static_breaches_slo"] = bool(
+                static_rec["ttft_int_p99_spike_s"] > slo_ttft_s)
+            itl_base = max(ctl_rec["itl_p99_base_s"], 1e-9)
+            summary["itl_p99_spike_ratio"] = round(
+                ctl_rec["itl_p99_spike_s"] / itl_base, 3)
+        obs_rt.export_record(summary)
+        obs_rt.maybe_export()
+    finally:
+        obs_rt.configure(None)
+        obs.enabled(was_enabled)
+
+    if smoke:
+        result = {
+            "metric": "serve_replay_control_decisions",
+            "value": summary.get("control_decisions", 0),
+            "unit": "decisions",
+            "aux": {"backend": jax.default_backend(), "smoke": True,
+                    "timeline_consistent":
+                        summary.get("timeline_consistent"),
+                    "telemetry": path,
+                    "bench_code_sha": _bench_code_sha()},
+        }
+    else:
+        ratio = summary["static"]["ttft_int_p99_spike_s"] \
+            / max(summary["controller"]["ttft_int_p99_spike_s"], 1e-9)
+        result = {
+            "metric": "serve_replay_static_over_controller_ttft_p99",
+            "value": round(ratio, 3),
+            "unit": "x",
+            "aux": {"backend": jax.default_backend(),
+                    "slo_ttft_s": summary["slo_ttft_s"],
+                    "controller_within_slo":
+                        summary["controller_within_slo"],
+                    "static_breaches_slo":
+                        summary["static_breaches_slo"],
+                    "itl_p99_spike_ratio":
+                        summary["itl_p99_spike_ratio"],
+                    "control_decisions":
+                        summary["control_decisions"],
+                    "timeline_consistent":
+                        summary["timeline_consistent"],
+                    "telemetry": path,
+                    "bench_code_sha": _bench_code_sha()},
+        }
     print(json.dumps(result))
     return 0
 

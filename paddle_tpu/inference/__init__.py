@@ -1206,6 +1206,15 @@ class ContinuousBatchingPredictor:
                           tier_weights, None)
         return TokenStream(gen, results, status, cancel)
 
+    def set_tier_weight(self, tier, weight):
+        """Shift this replica's live fair-queueing share for `tier`
+        (serving/controller.py quantum shifts). No-op until a tiered
+        serve loop is running; the next loop start picks weights up
+        from the router's tier_weights anyway."""
+        q = getattr(self, "_live_sched", None)
+        if q is not None:
+            q.set_weight(tier, weight)
+
     @staticmethod
     def _wants_sampling(sp):
         """True when the request needs the sampling program: an
@@ -1268,6 +1277,10 @@ class ContinuousBatchingPredictor:
         q = WeightedFairScheduler(tier_weights,
                                   quantum=float(rc.wfs_quantum)) \
             if use_tiers else FifoQueue()
+        # published so the serving controller can shift tier quanta on
+        # the LIVE scheduler (set_tier_weight) — the loop itself never
+        # reads this attribute
+        self._live_sched = q if use_tiers else None
 
         # per-request parallel state (grows under dynamic intake)
         prompts, max_new, tier_of, metas = [], [], [], []

@@ -47,6 +47,9 @@ from .runtime import (  # noqa: F401
     export_record, telemetry_path, RankHeartbeat, rank_identity,
     set_identity, export_identity,
 )
+from .slo import (  # noqa: F401
+    Ewma, SLOSpec, SLOEngine, default_serving_slos,
+)
 from .fleet import (  # noqa: F401
     FleetAggregator, StragglerDetector, RankFileTailer,
 )
@@ -63,6 +66,7 @@ __all__ = [
     "TensorBoardExporter", "jit_callback", "device_memory_stats",
     "configure", "maybe_export", "export_record", "telemetry_path",
     "RankHeartbeat", "rank_identity", "set_identity", "export_identity",
+    "Ewma", "SLOSpec", "SLOEngine", "default_serving_slos",
     "FleetAggregator",
     "StragglerDetector", "RankFileTailer",
     "Span", "NULL_SPAN", "span", "start_span",

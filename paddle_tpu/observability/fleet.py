@@ -272,6 +272,13 @@ class FleetAggregator:
         self._worst_gap: Dict[str, float] = {}
         self._completed_through = -1    # last step joined + emitted
         self.stragglers: List[dict] = []
+        # the serving autopilot's audit stream (controller.py): control
+        # decisions and SLO breaches collected fleet-side so one
+        # launcher view audits what every rank's control loop did.
+        # Bounded like the trace joins; whole records only (the tailer
+        # never yields a torn line — tests/test_fleet.py asserts it).
+        self.control_records: List[dict] = []
+        self.slo_breaches: List[dict] = []
         self._out = None
         self._warned: set = set()
 
@@ -373,6 +380,19 @@ class FleetAggregator:
                 orphans = self._orphan_comm.setdefault(rank, {})
                 orphans[trace] = orphans.get(trace, 0.0) + dur
                 self._prune(rank)
+
+    def _ingest_control(self, rank: str, rec: dict):
+        """Collect a control-loop decision (whole-record or nothing:
+        the tailer's line framing guarantees no torn audit entries)
+        and re-emit it into the fleet stream so the single launcher
+        file carries the cross-rank decision history too."""
+        keep = dict(rec, rank=rank)
+        self.control_records.append(keep)
+        del self.control_records[:-_MAX_PENDING_TRACES]
+        self._emit({"event": "control", "rank": rank,
+                    "seq": rec.get("seq"), "rule": rec.get("rule"),
+                    "action": rec.get("action"),
+                    "tier": rec.get("tier")})
 
     def _ingest_sample(self, rank: str, rec: dict):
         if rec.get("name") != "comm.bytes":
@@ -541,6 +561,11 @@ class FleetAggregator:
                         self._ingest_span(rank, rec)
                     elif kind == "heartbeat":
                         self._ingest_beat(rank, rec)
+                    elif kind == "control":
+                        self._ingest_control(rank, rec)
+                    elif kind == "slo_breach":
+                        self.slo_breaches.append(dict(rec, rank=rank))
+                        del self.slo_breaches[:-_MAX_PENDING_TRACES]
                     elif rec.get("name"):
                         # registry sample lines carry the METRIC kind
                         # (counter/gauge/histogram) in "kind"

@@ -49,9 +49,13 @@ from .router import (  # noqa: F401
 from .autoscale import (  # noqa: F401
     autoscale_signals, publish_autoscale,
 )
+from .controller import (  # noqa: F401
+    ControllerConfig, PoolController,
+)
 
 __all__ = [
     "FifoQueue", "WeightedFairScheduler", "ServeRequest", "StreamEvent",
     "TokenStream", "SamplingParams", "Replica", "Router",
     "RequestHandle", "autoscale_signals", "publish_autoscale",
+    "ControllerConfig", "PoolController",
 ]

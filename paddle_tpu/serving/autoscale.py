@@ -51,11 +51,20 @@ def _hist_quantile(metric, q: float) -> float:
 
 
 def autoscale_signals(router=None, registry=None, slo_ttft_s: float = 0.25,
-                      max_scale: int = 4) -> dict:
+                      max_scale: int = 4, smoother=None) -> dict:
     """Compute the signal dict (no side effects — `publish_autoscale`
     exports it). Works registry-only (router=None) for processes that
     run a bare predictor; the router adds inbox depth, health, and
-    slot-accurate utilization."""
+    slot-accurate utilization.
+
+    `smoother` is an observability.slo.Ewma (or anything with
+    ``update(value) -> float``) applied to the demand term before
+    sizing: queue depth is instantaneous, and a controller acting on
+    the raw value flaps a replica in and out on every burst. Callers
+    that scale on these signals should hold ONE smoother across calls
+    (Router.autoscale and serving.controller do) so the EWMA window —
+    the same half-life the SLO engine's fast window uses — actually
+    accumulates; `demand_raw` stays in the dict for dashboards."""
     reg = registry if registry is not None else _obsm.get_registry()
 
     # queued work per tier: replica admission queues (serving.tier.*
@@ -126,7 +135,9 @@ def autoscale_signals(router=None, registry=None, slo_ttft_s: float = 0.25,
     mean_util = (sum(util.values()) / len(util)) if util else 0.0
     backlog_per_slot = total_queue / max(slots, 1) if slots \
         else (1.0 if total_queue else 0.0)
-    demand = max(burn, mean_util, backlog_per_slot)
+    demand_raw = max(burn, mean_util, backlog_per_slot)
+    demand = smoother.update(demand_raw) if smoother is not None \
+        else demand_raw
     base = healthy if healthy else max(len(util), 1)
     desired = max(1, min(int(math.ceil(base * max(demand, 0.25))),
                          base * max_scale))
@@ -137,6 +148,8 @@ def autoscale_signals(router=None, registry=None, slo_ttft_s: float = 0.25,
         "queue_depth": {k: int(v) for k, v in queue_by_tier.items()},
         "ttft_p90_s": round(ttft_p90, 6),
         "ttft_burn": round(burn, 4),
+        "demand_raw": round(demand_raw, 4),
+        "demand": round(demand, 4),
         "page_pressure": {k: round(v, 4) for k, v in pressure.items()},
         "replica_utilization": {k: round(v, 4) for k, v in util.items()},
         "healthy_replicas": healthy,
@@ -163,4 +176,9 @@ def publish_autoscale(sig: dict, registry: Optional[object] = None):
             sig["healthy_replicas"])
     reg.gauge("serving.autoscale.desired_replicas").set(
         sig["desired_replicas"])
+    if "demand" in sig:
+        reg.gauge("serving.autoscale.demand").set(
+            sig["demand_raw"], view="raw")
+        reg.gauge("serving.autoscale.demand").set(
+            sig["demand"], view="smoothed")
     export_record({"kind": "autoscale", **sig})

@@ -394,6 +394,12 @@ class Router:
         self._m_e2e = _obsm.histogram("serving.router.e2e_seconds",
                                       unit="s")
         self._m_done = _obsm.counter("serving.router.completed")
+        self._m_shed = _obsm.counter("serving.router.shed")
+        self._m_pool = _obsm.counter("serving.router.pool_resizes")
+        # tiers currently refused at the admission edge (the control
+        # loop's load-shed lever, serving/controller.py). Read on every
+        # submit; mutated only via set_shed_tiers.
+        self.shed_tiers: frozenset = frozenset()
 
     # ---------------------------------------------------------- routing --
     def healthy(self) -> List[Replica]:
@@ -427,6 +433,14 @@ class Router:
             self._req_seq += 1
             rid = f"rr{self._req_seq}"
         h = RequestHandle(rid, prompt, max_new_tokens, tier, deadline_s)
+        if tier is not None and tier in self.shed_tiers:
+            # admission-edge shed: the cheapest place to refuse work —
+            # nothing was queued, no KV pages were touched, and the
+            # client gets a terminal status it can retry on
+            self._m_shed.inc(tier=tier)
+            self._m_done.inc(status="shed", tier=tier)
+            h._finish("shed")
+            return h
         self._dispatch(h)
         return h
 
@@ -491,6 +505,63 @@ class Router:
         for h in leftovers:
             self._readmit(h, rep, "replica_ejected")
 
+    # ------------------------------------------------------ pool control --
+    def add_replica(self, predictor, name: Optional[str] = None
+                    ) -> Replica:
+        """Scale out: add one ready predictor as a live replica. The
+        new worker starts serving immediately; routing sees it on the
+        next healthy() pass."""
+        with self._lock:
+            nm = name or predictor.name or f"replica{len(self.replicas)}"
+            rep = Replica(self, nm, predictor)
+            self.replicas.append(rep)
+        self._m_pool.inc(direction="up")
+        return rep
+
+    def drain_replica(self, name: Optional[str] = None
+                      ) -> Optional[Replica]:
+        """Scale in: close one replica's intake (the least-loaded
+        healthy one, or `name`), re-route its not-yet-dispatched inbox,
+        and return the parked Replica — `revive()` brings it back with
+        its predictor (and compiled programs) warm. Refuses to drain
+        the last healthy replica."""
+        healthy = self.healthy()
+        if len(healthy) <= 1:
+            return None
+        if name is not None:
+            cands = [r for r in healthy if r.name == name]
+            if not cands:
+                return None
+            rep = cands[0]
+        else:
+            rep = min(healthy, key=lambda r: r.load)
+        leftovers = rep.drain()
+        self._m_pool.inc(direction="down")
+        for h in leftovers:
+            # voluntary rebalance, not a failure: route elsewhere
+            # without burning the request's readmission budget
+            self._dispatch(h, exclude=rep, reason_label="rebalance")
+        return rep
+
+    def set_tier_weight(self, tier: str, weight: float):
+        """Shift one tier's fair-queueing share across the pool: future
+        serve loops pick it up from tier_weights, and every running
+        loop's live scheduler is updated in place (quantum grants use
+        the new weight from the next round)."""
+        w = max(float(weight), 1e-9)
+        if self.tier_weights is None:
+            self.tier_weights = {}
+        self.tier_weights[tier] = w
+        for rep in self.replicas:
+            set_w = getattr(rep.predictor, "set_tier_weight", None)
+            if set_w is not None:
+                set_w(tier, w)
+
+    def set_shed_tiers(self, tiers):
+        """Replace the set of tiers refused at admission (frozenset
+        swap: submit() reads one attribute, no lock needed)."""
+        self.shed_tiers = frozenset(tiers)
+
     # ------------------------------------------------------- convenience --
     def generate(self, prompts, max_new_tokens=32, tiers=None,
                  deadline_s=None, timeout=None):
@@ -529,9 +600,16 @@ class Router:
         return out
 
     def autoscale(self, slo_ttft_s=0.25, publish=True) -> dict:
-        """The serving.autoscale.* signal view (autoscale.py)."""
+        """The serving.autoscale.* signal view (autoscale.py). The
+        demand term is EWMA-smoothed across calls on a router-held
+        smoother so `desired_replicas` doesn't flap with every queue
+        burst."""
+        from ..observability.slo import Ewma
         from .autoscale import autoscale_signals, publish_autoscale
-        sig = autoscale_signals(self, slo_ttft_s=slo_ttft_s)
+        sm = getattr(self, "_as_smoother", None)
+        if sm is None:
+            sm = self._as_smoother = Ewma(half_life_s=10.0)
+        sig = autoscale_signals(self, slo_ttft_s=slo_ttft_s, smoother=sm)
         if publish:
             publish_autoscale(sig)
         return sig

@@ -152,6 +152,18 @@ class WeightedFairScheduler:
         t.deficit += cost
         self._n += 1
 
+    def set_weight(self, tier: str, weight: float):
+        """Live weight update (the controller's quantum shift): future
+        DRR grants to `tier` use the new weight immediately. Safe to
+        call from another thread — the grant reads a float the GIL
+        keeps coherent, and fairness converges over rounds, so a
+        mid-round change only skews the round it lands in."""
+        w = max(float(weight), 1e-9)
+        self.weights[tier] = w
+        t = self._tiers.get(tier)
+        if t is not None:
+            t.weight = w
+
     # ------------------------------------------------------------- read --
     def pop(self):
         """Next request in DRR order (None when empty). The entry stays

@@ -128,6 +128,10 @@ _SLOW_TESTS = (
     "test_sigterm_drain_deadline_bounds_exit",
     "test_serving_frontend.py::TestMultiTenantBenchSection::"
     "test_serve_mt_bench_acceptance_from_telemetry",
+    # PR 16: the full two-arm replay acceptance (controller vs static
+    # under the spike, ~3-5 min) — the --smoke arm stays tier-1
+    "test_trace_replay.py::TestReplayAcceptance::"
+    "test_replay_full_acceptance_from_telemetry",
     "test_train_fastpath.py::TestFusedEagerParity::"
     "test_matches_per_param[SGD-kw0]",
     "test_train_fastpath.py::TestQuantizedComm::"

@@ -265,6 +265,66 @@ class TestFleetAggregator:
         assert [r["step"] for r in recs if r["event"] == "step"] \
             == [3, 4]
 
+    @staticmethod
+    def _control(seq, rule, action, **params):
+        return {"kind": "control", "ts": 1000.0 + seq, "seq": seq,
+                "tick": seq, "rule": rule, "action": action,
+                "params": params, "inputs": {"burn_fast": 1.5},
+                "cooldown_s": 0.0}
+
+    def test_control_records_whole_or_nothing_under_truncation(
+            self, tmp_path):
+        """Satellite (PR 16): the controller's audit stream rides the
+        same tailers as the spans — a `{"kind": "control"}` line torn
+        mid-write must NOT be consumed (a half decision would poison
+        rebuild_timeline's seq/pool replay), then ingest exactly once
+        when the writer finishes it."""
+        agg, reg = self._mk(tmp_path)
+        p = str(tmp_path / "telemetry_rank0.jsonl")
+        _append(p, [self._control(1, "init", "observe", pool=1)])
+        full = json.dumps(self._control(
+            2, "scale_out", "spawn", pool_before=1, pool_after=2))
+        with open(p, "a") as f:
+            f.write(full[:40])           # torn mid-record, no newline
+        agg.poll()
+        assert [r["seq"] for r in agg.control_records] == [1]
+        with open(p, "a") as f:          # writer completes the line
+            f.write(full[40:] + "\n")
+        agg.poll()
+        assert [r["seq"] for r in agg.control_records] == [1, 2]
+        assert all(r["rank"] == "0" for r in agg.control_records)
+        # re-emitted into the launcher's single fleet.jsonl view
+        recs = [json.loads(l) for l in
+                open(str(tmp_path / "fleet.jsonl"))]
+        ctl = [r for r in recs if r.get("event") == "control"]
+        assert [(r["seq"], r["rule"]) for r in ctl] \
+            == [(1, "init"), (2, "scale_out")]
+
+    def test_control_records_survive_rotation(self, tmp_path):
+        """Rotation mid-stream (os.replace to .1 + fresh file) must
+        keep the decision seq numbers contiguous — the unread tail of
+        the old file drains from the sibling before the new file."""
+        agg, reg = self._mk(tmp_path)
+        p = str(tmp_path / "telemetry_rank0.jsonl")
+        _append(p, [self._control(1, "init", "observe", pool=1)])
+        agg.poll()
+        # seq 2 written but not yet polled when the file rotates
+        _append(p, [self._control(2, "shed", "shed_on",
+                                  shed_tiers=["batch"])])
+        os.replace(p, p + ".1")
+        _append(p, [self._control(3, "shed", "shed_off",
+                                  shed_tiers_before=["batch"]),
+                    self._control(4, "scale_in", "drain",
+                                  pool_before=2, pool_after=1)])
+        agg.poll()
+        assert [r["seq"] for r in agg.control_records] == [1, 2, 3, 4]
+        # breach evidence records ride the same path
+        _append(p, [{"kind": "slo_breach", "ts": 1010.0, "slo": "ttft",
+                     "burn_fast": 2.0, "burn_slow": 1.1}])
+        agg.poll()
+        assert [b["slo"] for b in agg.slo_breaches] == ["ttft"]
+        assert agg.slo_breaches[0]["rank"] == "0"
+
 
 # ===========================================================================
 # rank identity on exported lines
@@ -421,6 +481,42 @@ class TestFleetReport:
         site = [l for l in out.stdout.splitlines()
                 if l.strip().startswith("train.step ")]
         assert site and "16" in site[0]
+
+    def test_renders_slo_and_control_sections(self, tmp_path):
+        """Satellite (PR 16): the launcher view renders the SLO burn
+        timeline, breach evidence and cross-rank control-decision
+        audit from the per-rank JSONL alone, stdlib-only."""
+        self._populate(tmp_path)
+        _append(str(tmp_path / "telemetry_rank0.jsonl"), [
+            {"rank": 0, "name": "slo.burn_rate", "kind": "gauge",
+             "ts": 1001.0 + i,
+             "labels": {"slo": "ttft", "window": "fast"},
+             "value": 0.5 * i} for i in range(4)
+        ] + [
+            {"rank": 0, "kind": "slo_breach", "ts": 1004.0,
+             "slo": "ttft", "burn_fast": 1.5, "burn_slow": 1.1,
+             "events_fast": [3, 9], "evidence": [{"name": "r"}]},
+            {"rank": 0, "kind": "control", "ts": 1000.5, "seq": 1,
+             "tick": 0, "rule": "init", "action": "observe",
+             "params": {"pool": 1}, "inputs": {}, "cooldown_s": 0.0},
+            {"rank": 0, "kind": "control", "ts": 1004.5, "seq": 2,
+             "tick": 7, "rule": "shift_quantum",
+             "action": "raise_weight", "tier": "interactive",
+             "params": {"weight_before": 1.0, "weight_after": 4.0},
+             "inputs": {"burn_fast": 1.5}, "cooldown_s": 5.0},
+        ])
+        out = subprocess.run(
+            [sys.executable, "-I",
+             os.path.join(REPO, "tools", "fleet_report.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "SLO burn rate" in out.stdout
+        assert "ttft" in out.stdout
+        assert "SLO breaches" in out.stdout
+        assert "control decisions" in out.stdout
+        assert "shift_quantum" in out.stdout
+        assert "raise_weight" in out.stdout
 
 
 # ===========================================================================

@@ -1,0 +1,622 @@
+"""SLO engine + pool-controller unit tests (PR 16).
+
+Covers the telemetry->action chain in isolation, on synthetic clocks:
+
+- Ewma: time-aware half-life decay.
+- SLOEngine: multi-window burn accounting over a private registry,
+  breach episodes (one record per episode, re-armed on fast-window
+  recovery), bucket-boundary conservatism, ratio specs, registry-reset
+  re-baselining, slo.* gauge publication, and evidence-carrying
+  {"kind": "slo_breach"} records off the flight recorder.
+- PoolController: each rule against a stub router + canned engine —
+  scale-out (spawn, revive-before-spawn, max_replicas and cooldown
+  gates), scale-in (quiet-ticks gate, warm parking), shift_quantum
+  (raise/cap/restore), shed (lowest unprotected tier, recover), and
+  the audit stream (seq contiguity, init record,
+  trace_replay.rebuild_timeline parity with the live end state).
+- autoscale_signals: the EWMA flap-damping regression from the issue —
+  an alternating queue depth must not flap desired_replicas when the
+  caller holds one smoother across calls.
+- PrometheusExporter: the new slo.* / serving.controller.* families
+  render escaped, well-formed exposition lines.
+
+Everything here is host-side bookkeeping: no predictor, no device.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import metrics as obsm
+from paddle_tpu.observability import runtime as obs_rt
+from paddle_tpu.observability.exporters import PrometheusExporter
+from paddle_tpu.observability.slo import Ewma, SLOEngine, SLOSpec
+from paddle_tpu.serving.autoscale import autoscale_signals
+from paddle_tpu.serving.controller import ControllerConfig, PoolController
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_replay():
+    spec = importlib.util.spec_from_file_location(
+        "_tr_for_tests", os.path.join(REPO, "tools", "trace_replay.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------------------ Ewma --
+class TestEwma:
+    def test_first_sample_passes_through(self):
+        e = Ewma(half_life_s=10.0)
+        assert e.update(3.0, now=0.0) == 3.0
+        assert e.value == 3.0
+
+    def test_half_life_is_half_the_weight(self):
+        e = Ewma(half_life_s=10.0)
+        e.update(0.0, now=0.0)
+        assert e.update(1.0, now=10.0) == pytest.approx(0.5)
+
+    def test_converges_to_constant_input(self):
+        e = Ewma(half_life_s=5.0)
+        for i in range(200):
+            v = e.update(2.0, now=float(i))
+        assert v == pytest.approx(2.0, abs=1e-6)
+
+    def test_zero_half_life_tracks_raw(self):
+        e = Ewma(half_life_s=0.0)
+        e.update(5.0, now=0.0)
+        assert e.update(1.0, now=0.1) == 1.0
+
+
+# ------------------------------------------------------------- SLOEngine --
+def _engine(reg, specs, clk, fast=60.0, slow=600.0):
+    return SLOEngine(specs, registry=reg, fast_window_s=fast,
+                     slow_window_s=slow, now_fn=clk)
+
+
+class TestSLOEngine:
+    def test_latency_burn_and_breach_episodes(self):
+        reg = obsm.MetricRegistry()
+        h = reg.histogram("serving.router.ttft_seconds",
+                          buckets=(0.1, 0.25, 1.0))
+        clk = Clock(1000.0)
+        spec = SLOSpec("ttft", "serving.router.ttft_seconds",
+                       target=0.25, objective=0.9)
+        eng = _engine(reg, [spec], clk)
+        eng.evaluate()                    # baseline tick
+        clk.advance(1.0)
+
+        for _ in range(8):
+            h.observe(0.05)
+        for _ in range(2):
+            h.observe(0.9)
+        st = eng.evaluate()["ttft"]
+        # 2/10 bad over a 0.1 budget: burn 2.0 in both windows
+        assert st["burn"]["fast"] == pytest.approx(2.0)
+        assert st["burn"]["slow"] == pytest.approx(2.0)
+        assert st["breaching"] and st["new_breach"]
+        assert st["breaches"] == 1
+
+        # same episode on the next tick: no second breach
+        clk.advance(1.0)
+        st = eng.evaluate()["ttft"]
+        assert st["breaching"] and not st["new_breach"]
+        assert st["breaches"] == 1
+
+        # fast window expires -> episode ends, alerting re-arms
+        clk.advance(70.0)
+        st = eng.evaluate()["ttft"]
+        assert st["burn"]["fast"] == 0.0
+        assert not st["breaching"]
+
+        # fresh bad events: a NEW episode (slow window still burdened)
+        h.observe(0.9)
+        h.observe(0.9)
+        clk.advance(1.0)
+        st = eng.evaluate()["ttft"]
+        assert st["breaching"] and st["new_breach"]
+        assert st["breaches"] == 2
+
+    def test_off_boundary_target_counts_conservatively(self):
+        # 0.28s is within a 0.3s target, but the 0.25/0.5 bucket pair
+        # can't see that: the engine must count it bad, not good
+        reg = obsm.MetricRegistry()
+        h = reg.histogram("m", buckets=(0.25, 0.5))
+        clk = Clock()
+        eng = _engine(reg, [SLOSpec("x", "m", target=0.3,
+                                    objective=0.9)], clk)
+        eng.evaluate()                    # baseline tick
+        clk.advance(1.0)
+        h.observe(0.28)
+        st = eng.evaluate()["x"]
+        assert st["bad_fraction"]["fast"] == pytest.approx(1.0)
+
+    def test_ratio_spec(self):
+        reg = obsm.MetricRegistry()
+        c = reg.counter("serving.router.completed")
+        clk = Clock()
+        eng = _engine(reg, [SLOSpec(
+            "ok", "serving.router.completed", kind="ratio",
+            objective=0.95, good_labels={"status": "ok"})], clk)
+        eng.evaluate()                    # baseline tick
+        clk.advance(1.0)
+        for _ in range(18):
+            c.inc(status="ok")
+        c.inc(status="timeout")
+        c.inc(status="timeout")
+        st = eng.evaluate()["ok"]
+        # 2/20 bad over a 0.05 budget: burn 2.0
+        assert st["burn"]["fast"] == pytest.approx(2.0)
+        assert st["breaching"]
+
+    def test_per_tier_labels_scope_the_accounting(self):
+        reg = obsm.MetricRegistry()
+        h = reg.histogram("serving.router.ttft_seconds",
+                          buckets=(0.1, 0.25, 1.0))
+        clk = Clock()
+        eng = _engine(reg, [SLOSpec(
+            "ttft_gold", "serving.router.ttft_seconds", target=0.25,
+            objective=0.9, labels={"tier": "gold"}, tier="gold")], clk)
+        eng.evaluate()                    # baseline tick
+        clk.advance(1.0)
+        # bulk-tier pain must not count against the gold-tier SLO
+        for _ in range(10):
+            h.observe(0.9, tier="bulk")
+        h.observe(0.05, tier="gold")
+        st = eng.evaluate()["ttft_gold"]
+        assert st["burn"]["fast"] == 0.0
+        h.observe(0.9, tier="gold")
+        clk.advance(1.0)
+        st = eng.evaluate()["ttft_gold"]
+        assert st["bad_fraction"]["fast"] == pytest.approx(0.5)
+
+    def test_registry_reset_rebaselines_without_negative_deltas(self):
+        reg = obsm.MetricRegistry()
+        h = reg.histogram("m", buckets=(0.1, 1.0))
+        clk = Clock()
+        eng = _engine(reg, [SLOSpec("x", "m", target=0.1,
+                                    objective=0.9)], clk)
+        eng.evaluate()                    # baseline tick
+        clk.advance(1.0)
+        for _ in range(5):
+            h.observe(0.9)
+        assert eng.evaluate()["x"]["burn"]["fast"] > 0
+        reg.reset()
+        h2 = reg.histogram("m", buckets=(0.1, 1.0))
+        h2.observe(0.05)
+        clk.advance(1.0)
+        st = eng.evaluate()["x"]   # must not crash or double-count
+        g, b = st["events"]["fast"]
+        # the reset tick credits nothing: only the pre-reset events
+        # remain in the window
+        assert (g, b) == (0.0, 5.0)
+        clk.advance(1.0)
+        h2.observe(0.05)
+        st = eng.evaluate()["x"]
+        assert st["events"]["fast"] == (1.0, 5.0)
+
+    def test_publishes_slo_gauges_with_tier(self):
+        reg = obsm.MetricRegistry()
+        h = reg.histogram("m", buckets=(0.1, 1.0))
+        clk = Clock()
+        eng = _engine(reg, [SLOSpec("x", "m", target=0.1, objective=0.9,
+                                    tier="gold")], clk)
+        eng.evaluate()                    # baseline tick
+        clk.advance(1.0)
+        h.observe(0.9)
+        eng.evaluate()
+        burn = {(s.labels["slo"], s.labels["window"],
+                 s.labels.get("tier")): s.value
+                for s in reg.get("slo.burn_rate").samples()}
+        assert burn[("x", "fast", "gold")] == pytest.approx(10.0)
+        assert burn[("x", "slow", "gold")] == pytest.approx(10.0)
+        tgt = list(reg.get("slo.target").samples())
+        assert tgt[0].labels == {"slo": "x"} and tgt[0].value == 0.1
+        brc = list(reg.get("slo.breaches").samples())
+        assert brc[0].labels == {"slo": "x", "tier": "gold"}
+        assert brc[0].value == 1
+
+    def test_breach_record_carries_flight_evidence(self, tmp_path):
+        reg = obsm.MetricRegistry()
+        h = reg.histogram("m", buckets=(0.1, 1.0))
+        clk = Clock()
+        # target 0: every observation is bad, and any span with dur>0
+        # qualifies as evidence
+        eng = _engine(reg, [SLOSpec("x", "m", target=0.0,
+                                    objective=0.9)], clk)
+        path = str(tmp_path / "t.jsonl")
+        was = obs.enabled()
+        obs.enabled(True)
+        obs_rt.configure(path)
+        try:
+            eng.evaluate()                # baseline tick
+            clk.advance(1.0)
+            obs.flight_recorder().clear()
+            import time as _time
+            with obs.span("router.request", tier="gold"):
+                _time.sleep(0.002)
+            with obs.span("router.request", tier="bulk"):
+                _time.sleep(0.002)
+            h.observe(0.9)
+            eng.evaluate()
+            obs_rt.maybe_export()
+        finally:
+            obs_rt.configure(None)
+            obs.enabled(was)
+        recs = [json.loads(ln) for ln in open(path)
+                if ln.strip().startswith("{")]
+        breach = [r for r in recs if r.get("kind") == "slo_breach"]
+        assert len(breach) == 1
+        b = breach[0]
+        assert b["slo"] == "x" and b["burn_fast"] == pytest.approx(10.0)
+        assert b["events_fast"] == [0.0, 1.0]
+        assert b["evidence"], "breach record must carry spans"
+        assert all(e["name"] == "router.request" for e in b["evidence"])
+
+
+# -------------------------------------------------------- PoolController --
+class FakeEngine:
+    """Canned SLOEngine: evaluate() returns whatever the test sets."""
+
+    def __init__(self, specs=()):
+        self.specs = list(specs)
+        self.fast_window_s = 60.0
+        self.status = {}
+
+    def set_burn(self, name, fast, slow=None, tier=None):
+        self.status[name] = {
+            "slo": name, "tier": tier,
+            "burn": {"fast": fast,
+                     "slow": slow if slow is not None else fast}}
+
+    def evaluate(self, now=None, publish=True):
+        return dict(self.status)
+
+
+class StubPool:
+    def __init__(self, free=8):
+        self.free_count = free
+
+
+class StubPredictor:
+    def __init__(self, name):
+        self.name = name
+        self.B = 2
+        self.capacity = 8
+        self.pool = StubPool()
+
+
+class StubReplica:
+    def __init__(self, name):
+        self.name = name
+        self.predictor = StubPredictor(name)
+        self.pending = {}
+        self.inbox = []
+        self.revived = 0
+        self.closed = False   # real Router: drained replicas stay in
+                              # .replicas with intake closed
+
+    def revive(self):
+        self.revived += 1
+        self.closed = False
+
+
+class StubRouter:
+    def __init__(self, n=1, tier_weights=None):
+        self.replicas = [StubReplica(f"r{i}") for i in range(n)]
+        self.tier_weights = dict(tier_weights) if tier_weights else None
+        self.shed_tiers = frozenset()
+        self.weight_calls = []
+
+    def healthy(self):
+        return [r for r in self.replicas if not r.closed]
+
+    def add_replica(self, pred, name=None):
+        rep = StubReplica(pred.name)
+        rep.predictor = pred
+        self.replicas.append(rep)
+        return rep
+
+    def drain_replica(self, name=None):
+        healthy = self.healthy()
+        if len(healthy) <= 1:
+            return None
+        healthy[-1].closed = True
+        return healthy[-1]
+
+    def set_tier_weight(self, tier, weight):
+        self.tier_weights[tier] = float(weight)
+        self.weight_calls.append((tier, float(weight)))
+
+    def set_shed_tiers(self, tiers):
+        self.shed_tiers = frozenset(tiers)
+
+
+@pytest.fixture()
+def clean_global_registry():
+    reg = obsm.get_registry()
+    reg.reset()
+    yield reg
+    reg.reset()
+
+
+def _controller(router, engine, clk, spawn=None, **cfg):
+    cfg.setdefault("scale_out_cooldown_s", 1.0)
+    cfg.setdefault("scale_in_cooldown_s", 0.0)
+    cfg.setdefault("shift_cooldown_s", 1.0)
+    return PoolController(
+        router, slo_engine=engine, spawn=spawn,
+        config=ControllerConfig(**cfg),
+        registry=obsm.MetricRegistry(), now_fn=clk)
+
+
+class TestPoolController:
+    def test_init_record(self, clean_global_registry):
+        router = StubRouter(n=1, tier_weights={"gold": 1.0})
+        ctl = _controller(router, FakeEngine(), Clock())
+        assert len(ctl.decisions) == 1
+        init = ctl.decisions[0]
+        assert init["rule"] == "init" and init["seq"] == 1
+        assert init["params"]["pool"] == 1
+        assert init["params"]["tier_weights"] == {"gold": 1.0}
+        assert init["params"]["shed_tiers"] == []
+
+    def test_scale_out_spawns_then_cools_down(self,
+                                              clean_global_registry):
+        router = StubRouter(n=1)
+        eng = FakeEngine()
+        eng.set_burn("ttft", 2.0)
+        clk = Clock(0.0)
+        spawned = []
+
+        def spawn():
+            p = StubPredictor(f"spare{len(spawned)}")
+            spawned.append(p)
+            return p
+
+        ctl = _controller(router, eng, clk, spawn=spawn)
+        made = ctl.tick()
+        assert [r["action"] for r in made] == ["spawn"]
+        assert made[0]["rule"] == "scale_out"
+        assert made[0]["params"]["pool_before"] == 1
+        assert made[0]["params"]["pool_after"] == 2
+        assert len(router.replicas) == 2
+        # cooldown gates the next tick even though the burn persists
+        clk.advance(0.5)
+        assert ctl.tick() == []
+        clk.advance(1.0)
+        assert [r["action"] for r in ctl.tick()] == ["spawn"]
+        assert len(router.replicas) == 3
+
+    def test_scale_out_respects_max_replicas(self,
+                                             clean_global_registry):
+        router = StubRouter(n=1)
+        eng = FakeEngine()
+        eng.set_burn("ttft", 5.0)
+        spawned = []
+        ctl = _controller(router, eng, Clock(0.0),
+                          spawn=lambda: spawned.append(1),
+                          max_replicas=1)
+        assert ctl.tick() == []
+        assert not spawned and len(router.replicas) == 1
+
+    def test_scale_in_quiet_gate_parks_then_revives(
+            self, clean_global_registry):
+        router = StubRouter(n=2)
+        eng = FakeEngine()     # burn 0 everywhere, desired < healthy
+        clk = Clock(0.0)
+        ctl = _controller(router, eng, clk, scale_in_quiet_ticks=3)
+        assert ctl.tick() == []            # quiet tick 1
+        clk.advance(1.0)
+        assert ctl.tick() == []            # quiet tick 2
+        clk.advance(1.0)
+        made = ctl.tick()                  # quiet tick 3: drain
+        assert [r["rule"] for r in made] == ["scale_in"]
+        assert made[0]["action"] == "drain"
+        assert made[0]["params"]["parked"] is True
+        assert len(router.healthy()) == 1 and ctl.park_count() == 1
+
+        # burn returns: the parked replica is revived, not respawned
+        eng.set_burn("ttft", 2.0)
+        clk.advance(1.0)
+        made = ctl.tick()
+        assert [r["action"] for r in made] == ["revive"]
+        assert len(router.healthy()) == 2 and ctl.park_count() == 0
+        assert router.replicas[-1].revived == 1
+
+    def test_shift_quantum_raises_caps_and_restores(
+            self, clean_global_registry):
+        router = StubRouter(n=1, tier_weights={"gold": 1.0,
+                                               "bulk": 1.0})
+        eng = FakeEngine(
+            specs=[SLOSpec("ttft_gold", "m", tier="gold")])
+        eng.set_burn("ttft", 0.0)
+        eng.set_burn("ttft_gold", 2.0, tier="gold")
+        clk = Clock(0.0)
+        ctl = _controller(router, eng, clk, weight_shift_factor=2.0,
+                          max_weight_factor=4.0)
+        made = ctl.tick()
+        assert [(r["rule"], r["action"], r["tier"]) for r in made] \
+            == [("shift_quantum", "raise_weight", "gold")]
+        assert router.tier_weights["gold"] == 2.0
+        clk.advance(0.5)
+        assert ctl.tick() == []            # shift cooldown
+        clk.advance(1.0)
+        ctl.tick()
+        assert router.tier_weights["gold"] == 4.0
+        clk.advance(1.5)
+        assert ctl.tick() == []            # at cap: no-op, no record
+        assert router.tier_weights["gold"] == 4.0
+
+        # burn clears: the declared weight comes back
+        eng.set_burn("ttft_gold", 0.0, tier="gold")
+        clk.advance(1.5)
+        made = ctl.tick()
+        assert [(r["action"], r["tier"]) for r in made] \
+            == [("restore_weight", "gold")]
+        assert router.tier_weights["gold"] == 1.0
+        assert router.tier_weights["bulk"] == 1.0
+
+    def test_shed_picks_lowest_unprotected_tier(
+            self, clean_global_registry):
+        router = StubRouter(n=1, tier_weights={"gold": 1.0,
+                                               "bulk": 0.5})
+        eng = FakeEngine(
+            specs=[SLOSpec("ttft_gold", "m", tier="gold")])
+        eng.set_burn("ttft", 3.0)
+        clk = Clock(0.0)
+        ctl = _controller(router, eng, clk, shed_burn=2.0,
+                          shed_recover_burn=1.0)
+        made = ctl.tick()
+        shed = [r for r in made if r["rule"] == "shed"]
+        assert [(r["action"], r["tier"]) for r in shed] \
+            == [("shed_on", "bulk")]
+        assert router.shed_tiers == {"bulk"}
+
+        # burn recovers below the hysteresis point: re-admit
+        eng.set_burn("ttft", 0.5)
+        clk.advance(1.0)
+        made = ctl.tick()
+        shed = [r for r in made if r["rule"] == "shed"]
+        assert [r["action"] for r in shed] == ["shed_off"]
+        assert router.shed_tiers == frozenset()
+
+    def test_shed_never_drops_a_protected_only_pool(
+            self, clean_global_registry):
+        router = StubRouter(n=1, tier_weights={"gold": 1.0})
+        eng = FakeEngine(
+            specs=[SLOSpec("ttft_gold", "m", tier="gold")])
+        eng.set_burn("ttft", 9.0)
+        ctl = _controller(router, eng, Clock(0.0), shed_burn=2.0)
+        made = ctl.tick()
+        assert not [r for r in made if r["rule"] == "shed"]
+        assert router.shed_tiers == frozenset()
+
+    def test_decision_stream_replays_to_live_state(
+            self, clean_global_registry):
+        tr = _load_trace_replay()
+        router = StubRouter(n=1, tier_weights={"gold": 1.0,
+                                               "bulk": 1.0})
+        eng = FakeEngine(
+            specs=[SLOSpec("ttft_gold", "m", tier="gold")])
+        clk = Clock(0.0)
+        pool = [StubPredictor("spare0")]
+        ctl = _controller(router, eng, clk,
+                          spawn=lambda: pool.pop() if pool else None,
+                          shed_burn=2.0, weight_shift_factor=2.0,
+                          max_weight_factor=8.0)
+        eng.set_burn("ttft", 3.0)
+        eng.set_burn("ttft_gold", 3.0, tier="gold")
+        ctl.tick()                       # shed bulk + raise gold + spawn
+        clk.advance(2.0)
+        eng.set_burn("ttft", 0.4)
+        eng.set_burn("ttft_gold", 0.0, tier="gold")
+        ctl.tick()                       # shed off + restore weight
+        # every record is schema-complete and the stream is contiguous
+        for rec in ctl.decisions:
+            for key in ("kind", "ts", "seq", "tick", "rule", "action",
+                        "params", "inputs", "cooldown_s"):
+                assert key in rec, (key, rec)
+        assert [r["seq"] for r in ctl.decisions] \
+            == list(range(1, len(ctl.decisions) + 1))
+        timeline = tr.rebuild_timeline(ctl.decisions)
+        assert timeline["pool_size"] == len(router.healthy())
+        assert timeline["tier_weights"] == dict(router.tier_weights)
+        assert timeline["shed_tiers"] == sorted(router.shed_tiers)
+        assert timeline["decisions"] == len(ctl.decisions) - 1
+
+    def test_inputs_snapshot_on_records(self, clean_global_registry):
+        router = StubRouter(n=1)
+        eng = FakeEngine()
+        eng.set_burn("ttft", 2.5)
+        ctl = _controller(router, eng, Clock(0.0),
+                          spawn=lambda: StubPredictor("s"))
+        rec = ctl.tick()[0]
+        inp = rec["inputs"]
+        assert inp["slo"] == "ttft"
+        assert inp["burn_fast"] == pytest.approx(2.5)
+        assert inp["healthy"] == 1
+        assert "desired" in inp and "demand" in inp
+
+
+# ---------------------------------------------------- autoscale flapping --
+class TestAutoscaleFlapDamping:
+    def _sig(self, reg, smoother=None):
+        return autoscale_signals(registry=reg, slo_ttft_s=0.25,
+                                 smoother=smoother)
+
+    def test_instantaneous_queue_flaps_without_smoother(self):
+        reg = obsm.MetricRegistry()
+        reg.gauge("serving.slots").set(4, replica="r0")
+        q = reg.gauge("serving.queue_depth")
+        desired = []
+        for depth in (20, 0, 20, 0, 20, 0):
+            q.set(depth)
+            desired.append(self._sig(reg)["desired_replicas"])
+        flaps = sum(1 for a, b in zip(desired, desired[1:]) if a != b)
+        assert flaps >= 4, desired    # the regression: 4,1,4,1,...
+
+    def test_shared_ewma_damps_desired_replicas(self):
+        reg = obsm.MetricRegistry()
+        reg.gauge("serving.slots").set(4, replica="r0")
+        q = reg.gauge("serving.queue_depth")
+        clk = Clock(0.0)
+        sm = Ewma(half_life_s=10.0, now_fn=clk)
+        desired = []
+        for depth in (20, 0, 20, 0, 20, 0):
+            q.set(depth)
+            sig = self._sig(reg, smoother=sm)
+            desired.append(sig["desired_replicas"])
+            clk.advance(1.0)
+        flaps = sum(1 for a, b in zip(desired, desired[1:]) if a != b)
+        assert flaps == 0, desired    # holds steady across the bursts
+
+    def test_demand_views_are_published(self):
+        reg = obsm.MetricRegistry()
+        from paddle_tpu.serving.autoscale import publish_autoscale
+        reg.gauge("serving.queue_depth").set(8)
+        sig = self._sig(reg, smoother=Ewma(half_life_s=10.0,
+                                           now_fn=Clock(0.0)))
+        publish_autoscale(sig, registry=reg)
+        views = {s.labels.get("view"): s.value
+                 for s in reg.get("serving.autoscale.demand").samples()}
+        assert set(views) == {"raw", "smoothed"}
+
+
+# ------------------------------------------------------ prometheus lines --
+class TestPrometheusNewFamilies:
+    def test_slo_and_controller_families_render_escaped(self):
+        reg = obsm.MetricRegistry()
+        reg.gauge("slo.burn_rate").set(
+            2.5, slo='a"b\\c', window="fast", tier="l1\nl2")
+        reg.counter("serving.controller.actions").inc(
+            rule="shed", action="shed_on", tier="bulk")
+        reg.gauge("serving.controller.pool_size").set(3)
+        text = PrometheusExporter(reg, const_labels={}).render()
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("slo_burn_rate{")]
+        assert len(line) == 1
+        # quotes, backslashes and newlines inside label values must be
+        # escaped into ONE well-formed exposition line
+        assert 'slo="a\\"b\\\\c"' in line[0]
+        assert 'tier="l1\\nl2"' in line[0]
+        assert line[0].endswith(" 2.5")
+        assert "# TYPE serving_controller_actions counter" in text
+        assert ('serving_controller_actions{action="shed_on",'
+                'rule="shed",tier="bulk"} 1') in text
+        assert "serving_controller_pool_size 3" in text

@@ -1,0 +1,342 @@
+"""tools/trace_replay.py + the --serve --replay bench arms (PR 16).
+
+- synthesize(): deterministic production-shaped traces — zipf sessions,
+  tenant mix, the spike as EXTRA spike-tier load on top of base traffic
+  (the base mix keeps arriving through the spike window).
+- write/load round trip, torn-line tolerance, session prompts with
+  shared per-session prefixes.
+- fit_from_telemetry(): shape-only spec estimation from recorded spans.
+- rebuild_timeline(): the control-decision audit replayer, including
+  every inconsistency it must refuse.
+- CLI under `python -I` (stdlib-only, like every tools/ reader).
+- `bench.py --serve --replay --smoke`: the tier-1 loop exercise on the
+  checked-in fixture trace, asserted from the JSONL telemetry.
+- `bench.py --serve --replay` (slow): the full acceptance — under the
+  batch-tier spike the controller pool holds the declared interactive
+  p99 TTFT SLO while the static pool breaches it, decode inter-token
+  p99 stays flat, and the decision timeline reconstructs from the
+  {"kind": "control"} records alone.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TR_PATH = os.path.join(REPO, "tools", "trace_replay.py")
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tr():
+    return _load("trace_replay_mod", TR_PATH)
+
+
+SPEC = {
+    "requests": 300, "duration_s": 60.0, "sessions": 6,
+    "zipf_alpha": 1.1, "seed": 7, "diurnal": 0.0,
+    "tiers": {"interactive": 0.8, "batch": 0.2},
+    "prompt_len_p50": 32, "prompt_len_max": 128,
+    "max_new_p50": 16, "max_new_max": 64,
+    "spike": {"start_frac": 0.4, "dur_frac": 0.3, "factor": 5.0,
+              "tier": "batch", "prompt_len_factor": 1.0},
+}
+
+
+class TestSynthesize:
+    def test_deterministic_for_a_seed(self, tr):
+        a = tr.synthesize(SPEC)
+        b = tr.synthesize(SPEC)
+        assert a == b
+        c = tr.synthesize(dict(SPEC, seed=8))
+        assert a != c
+
+    def test_shape_and_bounds(self, tr):
+        reqs = tr.synthesize(SPEC)
+        assert len(reqs) == 300
+        assert reqs == sorted(reqs, key=lambda r: r["t"])
+        for r in reqs:
+            assert r["kind"] == "trace_request"
+            assert 0.0 <= r["t"] <= 60.0
+            assert 0 <= r["session"] < 6
+            assert r["tier"] in ("interactive", "batch")
+            assert 4 <= r["prompt_len"] <= 128
+            assert 1 <= r["max_new"] <= 64
+            assert r["phase"] in ("base", "spike")
+
+    def test_spike_is_extra_load_on_top_of_base_traffic(self, tr):
+        """The flood must not REPLACE the base tenants: the 1/factor
+        fraction of spike-window arrivals the base rate accounts for
+        keeps the base tier mix, so per-tenant SLO claims have spike-
+        phase samples to stand on."""
+        reqs = tr.synthesize(SPEC)
+        base = [r for r in reqs if r["phase"] == "base"]
+        spike = [r for r in reqs if r["phase"] == "spike"]
+        assert base and spike
+        # the window is rate-multiplied: it holds most of the requests
+        assert len(spike) > len(base)
+        sp_tiers = {t: sum(1 for r in spike if r["tier"] == t)
+                    for t in ("interactive", "batch")}
+        # the excess is the flood...
+        assert sp_tiers["batch"] > 0.6 * len(spike)
+        # ...but the interactive tenant keeps arriving through it
+        assert sp_tiers["interactive"] > 0.05 * len(spike)
+        # base phase keeps roughly the declared mix
+        b_int = sum(1 for r in base if r["tier"] == "interactive")
+        assert b_int > 0.6 * len(base)
+
+    def test_no_spike_no_spike_phase(self, tr):
+        reqs = tr.synthesize(dict(SPEC, spike=None))
+        assert all(r["phase"] == "base" for r in reqs)
+
+
+class TestTraceIO:
+    def test_write_load_round_trip(self, tr, tmp_path):
+        reqs = tr.synthesize(dict(SPEC, requests=20))
+        p = str(tmp_path / "t.jsonl")
+        tr.write_trace(p, reqs, SPEC)
+        header, loaded = tr.load_trace(p)
+        assert header["kind"] == "trace_header"
+        assert header["spec"]["seed"] == 7
+        assert loaded == reqs
+
+    def test_torn_final_line_tolerated(self, tr, tmp_path):
+        reqs = tr.synthesize(dict(SPEC, requests=5))
+        p = str(tmp_path / "t.jsonl")
+        tr.write_trace(p, reqs, SPEC)
+        with open(p, "a") as f:
+            f.write('{"kind": "trace_request", "t": 1.0, "trunc')
+        _, loaded = tr.load_trace(p)
+        assert len(loaded) == 5
+
+    def test_session_prompts_share_prefixes(self, tr):
+        long = tr.session_prompt(3, 32, vocab=1000)
+        short = tr.session_prompt(3, 16, vocab=1000)
+        other = tr.session_prompt(4, 32, vocab=1000)
+        assert long[:8] == short[:8]      # shared per-session prefix
+        assert long[:8] != other[:8]
+        assert len(long) == 32 and len(short) == 16
+        assert all(2 <= t < 1000 for t in long)
+
+
+class TestFitFromTelemetry:
+    def test_fit_recovers_the_shape(self, tr, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        with open(p, "w") as f:
+            for i in range(40):
+                tier = "interactive" if i % 4 else "batch"
+                f.write(json.dumps(
+                    {"kind": "span", "name": "router.request",
+                     "start": 100.0 + i * 0.5,
+                     "labels": {"tier": tier, "prompt_len": 16 + i},
+                     "events": [{"name": "finish", "tokens": 8}]}) + "\n")
+            f.write("not json\n")
+        spec = tr.fit_from_telemetry([p])
+        assert spec["requests"] == 40
+        assert spec["duration_s"] == pytest.approx(19.5)
+        assert spec["prompt_len_max"] == 55
+        assert spec["max_new_p50"] == 8
+        assert spec["tiers"]["interactive"] == pytest.approx(0.75)
+        assert spec["tiers"]["batch"] == pytest.approx(0.25)
+
+
+def _rec(seq, rule, action, params, tick=0, tier=None):
+    r = {"kind": "control", "ts": 1.0 + seq, "seq": seq, "tick": tick,
+         "rule": rule, "action": action, "params": params,
+         "inputs": {}, "cooldown_s": 0.0}
+    if tier:
+        r["tier"] = tier
+    return r
+
+
+def _init(seq=1, pool=1, weights=None, shed=()):
+    return _rec(seq, "init", "observe",
+                {"pool": pool, "tier_weights": weights or {},
+                 "shed_tiers": sorted(shed)})
+
+
+class TestRebuildTimeline:
+    def test_replays_to_end_state(self, tr):
+        recs = [
+            _init(1, pool=1, weights={"gold": 1.0, "bulk": 1.0}),
+            _rec(2, "shed", "shed_on", {"shed_tiers": ["bulk"]},
+                 tier="bulk"),
+            _rec(3, "shift_quantum", "raise_weight",
+                 {"weight_before": 1.0, "weight_after": 4.0},
+                 tier="gold"),
+            _rec(4, "scale_out", "spawn",
+                 {"pool_before": 1, "pool_after": 2}),
+            _rec(5, "shed", "shed_off", {"shed_tiers_before": ["bulk"]}),
+            _rec(6, "scale_in", "drain",
+                 {"pool_before": 2, "pool_after": 1, "parked": True}),
+        ]
+        # interleaved non-control records must be ignored
+        tl = tr.rebuild_timeline(recs + [{"kind": "autoscale"}])
+        assert tl["pool_size"] == 1
+        assert tl["tier_weights"] == {"gold": 4.0, "bulk": 1.0}
+        assert tl["shed_tiers"] == []
+        assert tl["decisions"] == 5
+        assert [a["rule"] for a in tl["actions"]] == [
+            "shed", "shift_quantum", "scale_out", "shed", "scale_in"]
+
+    def test_rejects_missing_init(self, tr):
+        with pytest.raises(ValueError, match="init"):
+            tr.rebuild_timeline([_rec(1, "shed", "shed_on",
+                                      {"shed_tiers": ["b"]}, tier="b")])
+
+    def test_rejects_empty(self, tr):
+        with pytest.raises(ValueError, match="no control records"):
+            tr.rebuild_timeline([{"kind": "autoscale"}])
+
+    def test_rejects_seq_gap(self, tr):
+        recs = [_init(1), _rec(3, "scale_out", "spawn",
+                               {"pool_before": 1, "pool_after": 2})]
+        with pytest.raises(ValueError, match="gap"):
+            tr.rebuild_timeline(recs)
+
+    def test_rejects_pool_mismatch(self, tr):
+        recs = [_init(1, pool=1),
+                _rec(2, "scale_out", "spawn",
+                     {"pool_before": 3, "pool_after": 4})]
+        with pytest.raises(ValueError, match="pool_before"):
+            tr.rebuild_timeline(recs)
+
+
+class TestCLIPythonI:
+    """Every tools/ reader must run stdlib-only under `python -I`."""
+
+    def _run(self, args):
+        return subprocess.run(
+            [sys.executable, "-I", TR_PATH] + args,
+            capture_output=True, text=True, timeout=120)
+
+    def test_synth_show_timeline(self, tr, tmp_path):
+        out = str(tmp_path / "trace.jsonl")
+        r = self._run(["synth", "--out", out, "--requests", "50",
+                       "--duration", "10", "--seed", "3",
+                       "--tiers", "interactive=0.8,batch=0.2",
+                       "--spike", "0.4,0.3,5,batch"])
+        assert r.returncode == 0, r.stderr
+        assert "trace: 50 requests" in r.stdout
+        r = self._run(["show", out])
+        assert r.returncode == 0, r.stderr
+        assert "tiers=" in r.stdout and "phases=" in r.stdout
+
+        tele = str(tmp_path / "telemetry.jsonl")
+        with open(tele, "w") as f:
+            for rec in (_init(1, pool=1, weights={"g": 1.0}),
+                        _rec(2, "scale_out", "spawn",
+                             {"pool_before": 1, "pool_after": 2})):
+                f.write(json.dumps(rec) + "\n")
+        r = self._run(["timeline", tele])
+        assert r.returncode == 0, r.stderr
+        tl = json.loads(r.stdout)
+        assert tl["pool_size"] == 2
+
+    def test_timeline_rejects_inconsistent_stream(self, tmp_path):
+        tele = str(tmp_path / "telemetry.jsonl")
+        with open(tele, "w") as f:
+            f.write(json.dumps(_rec(2, "scale_out", "spawn",
+                                    {"pool_before": 1,
+                                     "pool_after": 2})) + "\n")
+        r = self._run(["timeline", tele])
+        assert r.returncode != 0
+        assert "init" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# bench arms
+# ---------------------------------------------------------------------------
+def _bench():
+    return _load("bench_replay", os.path.join(REPO, "bench.py"))
+
+
+class TestReplaySmokeBench:
+    def test_replay_smoke_loop_and_reports(self, tmp_path, capsys):
+        """Tier-1: the fixture trace through the controller-fronted
+        router — the control loop ticks, the audit stream replays
+        consistently, and both report tools render the new sections
+        under `python -I`, all from the JSONL telemetry file."""
+        bench = _bench()
+        out = str(tmp_path / "replay.jsonl")
+        assert bench.serve_bench(
+            ["--replay", "--smoke", "--out", out]) == 0
+        line = [ln for ln in capsys.readouterr().out.splitlines()
+                if ln.startswith("{")][-1]
+        rec = json.loads(line)
+        assert rec["metric"] == "serve_replay_control_decisions"
+        assert rec["aux"]["smoke"] is True
+        assert rec["aux"]["timeline_consistent"] is True
+
+        recs = [json.loads(ln) for ln in open(out) if ln.strip()]
+        ctrl = [r for r in recs if r.get("kind") == "control"]
+        assert ctrl and ctrl[0]["rule"] == "init"
+        arm = [r for r in recs if r.get("kind") == "serve_replay_arm"]
+        assert arm and arm[0]["arm"] == "controller"
+        assert arm[0]["requests"] > 0
+        assert [r for r in recs if r.get("kind") == "autoscale"]
+
+        # the timeline replays from the file alone
+        tr_mod = _load("tr_smoke", TR_PATH)
+        tl = tr_mod.rebuild_timeline(recs)
+        assert tl["pool_size"] >= 1
+
+        # trace_report renders the control/SLO audit, stdlib-only
+        r = subprocess.run(
+            [sys.executable, "-I",
+             os.path.join(REPO, "tools", "trace_report.py"), out],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "== control decisions ==" in r.stdout
+        assert "init" in r.stdout
+
+
+class TestReplayAcceptance:
+    def test_replay_full_acceptance_from_telemetry(self, tmp_path,
+                                                   capsys):
+        """ACCEPTANCE (ISSUE 16, slow): under the batch-tier spike the
+        controller holds the declared interactive p99 TTFT SLO while
+        the identical static pool breaches it; decode inter-token p99
+        stays flat; and the whole decision history replays from the
+        {"kind": "control"} records alone."""
+        bench = _bench()
+        out = str(tmp_path / "replay_full.jsonl")
+        assert bench.serve_bench(["--replay", "--out", out]) == 0
+        line = [ln for ln in capsys.readouterr().out.splitlines()
+                if ln.startswith("{")][-1]
+        rec = json.loads(line)
+        assert rec["metric"] == \
+            "serve_replay_static_over_controller_ttft_p99"
+        aux = rec["aux"]
+        assert aux["controller_within_slo"] is True
+        assert aux["static_breaches_slo"] is True
+        assert aux["itl_p99_spike_ratio"] < 2.0
+        assert aux["control_decisions"] > 0
+        assert aux["timeline_consistent"] is True
+
+        # the audit replays from the JSONL alone and matches the live
+        # end state the bench recorded
+        recs = [json.loads(ln) for ln in open(out) if ln.strip()]
+        tr_mod = _load("tr_full", TR_PATH)
+        tl = tr_mod.rebuild_timeline(recs)
+        live = [r for r in recs
+                if r.get("kind") == "serve_replay_timeline"][-1]
+        assert tl["pool_size"] == live["live"]["pool_size"]
+        assert tl["tier_weights"] == {
+            k: float(v)
+            for k, v in live["live"]["tier_weights"].items()}
+        assert tl["shed_tiers"] == live["live"]["shed_tiers"]
+        # both arms and the SLO declaration are on the record
+        arms = {r["arm"] for r in recs
+                if r.get("kind") == "serve_replay_arm"}
+        assert arms == {"controller", "static"}
+        assert [r for r in recs if r.get("kind") == "serve_replay_slo"]

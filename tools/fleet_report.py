@@ -103,6 +103,9 @@ class Fleet:
         self.comm_bytes: Dict[str, Dict[tuple, float]] = {}
         self.beats: Dict[str, List[float]] = {}
         self.fleet_events: List[dict] = []
+        self.control: List[dict] = []        # {"kind": "control"}
+        self.breaches: List[dict] = []       # {"kind": "slo_breach"}
+        self.slo_samples: List[dict] = []    # slo.* registry lines
         self.topology: Optional[str] = None
         self._trace_step: Dict[str, Dict[str, int]] = {}
         self._orphan_comm: Dict[str, Dict[str, float]] = {}
@@ -121,6 +124,12 @@ class Fleet:
                     self.beats.setdefault(rank, []).append(float(ts))
             elif kind == "fleet":
                 self.fleet_events.append(rec)
+            elif kind == "control":
+                self.control.append(dict(rec, rank=rank))
+            elif kind == "slo_breach":
+                self.breaches.append(dict(rec, rank=rank))
+            elif str(rec.get("name") or "").startswith("slo."):
+                self.slo_samples.append(rec)
             elif rec.get("name") == "comm.bytes":
                 lab = rec.get("labels") or {}
                 ax = lab.get("axis")
@@ -332,6 +341,66 @@ def render(fleet: Fleet, waterfall_steps: int = 10,
             worst, n = gaps[r]
             flag = "   << silent window" if worst >= 5.0 else ""
             w(f"  {r:<6}{n:>7}{worst:>13.2f}{flag}")
+
+    # ---- SLO burn timelines -----------------------------------------
+    burn: Dict[tuple, List[tuple]] = {}
+    for s in fleet.slo_samples:
+        if s.get("name") != "slo.burn_rate":
+            continue
+        lb = s.get("labels") or {}
+        key = (str(lb.get("slo", "?")), str(lb.get("window", "?")))
+        burn.setdefault(key, []).append(
+            (float(s.get("ts") or 0.0), float(s.get("value") or 0.0)))
+    if burn:
+        w("== SLO burn rate (per spec x window; >1.0 = budget burning "
+          "faster than allowed) ==")
+        w(f"  {'slo':<18}{'window':>8}{'samples':>9}{'max':>8}"
+          f"{'last':>8}  timeline")
+        for (slo, win) in sorted(burn):
+            pts = sorted(burn[(slo, win)])
+            vals = [v for _, v in pts]
+            step = max(1, len(vals) // 10)
+            tl = " ".join(f"{v:.1f}" for v in vals[::step][-10:])
+            flag = "  << burning" if vals and vals[-1] >= 1.0 else ""
+            w(f"  {slo:<18}{win:>8}{len(vals):>9}{max(vals):>8.2f}"
+              f"{vals[-1]:>8.2f}  {tl}{flag}")
+    if fleet.breaches:
+        w("== SLO breaches ==")
+        for b in sorted(fleet.breaches, key=lambda r: r.get("ts") or 0):
+            ev = b.get("evidence") or []
+            w("  t=%.2f slo=%s burn fast=%.2f slow=%.2f "
+              "events(fast)=%s evidence_spans=%d"
+              % (float(b.get("ts") or 0.0), b.get("slo"),
+                 float(b.get("burn_fast") or 0.0),
+                 float(b.get("burn_slow") or 0.0),
+                 b.get("events_fast"), len(ev)))
+
+    # ---- control-decision audit log ---------------------------------
+    if fleet.control:
+        ctl = sorted(fleet.control,
+                     key=lambda r: (r.get("seq") is None,
+                                    r.get("seq") or 0,
+                                    r.get("ts") or 0))
+        w("== control decisions (from {\"kind\": \"control\"} records) ==")
+        w(f"  {'seq':>5}{'tick':>7}  {'rule':<14}{'action':<16}"
+          f"{'tier':<12}{'burn_f':>7}  params")
+        for r in ctl:
+            ins = r.get("inputs") or {}
+            bf = ins.get("burn_fast")
+            bf_s = f"{float(bf):.2f}" if bf is not None else "-"
+            params = r.get("params") or {}
+            ps = " ".join(f"{k}={params[k]}" for k in sorted(params))
+            w(f"  {str(r.get('seq', '-')):>5}{str(r.get('tick', '-')):>7}"
+              f"  {str(r.get('rule', '-')):<14}"
+              f"{str(r.get('action', '-')):<16}"
+              f"{str(r.get('tier') or '-'):<12}{bf_s:>7}  {ps}")
+        by_action: Dict[str, int] = {}
+        for r in ctl:
+            by_action[str(r.get("action"))] = \
+                by_action.get(str(r.get("action")), 0) + 1
+        w("  total: %d decisions (%s)"
+          % (len(ctl), ", ".join(f"{k}={v}"
+                                 for k, v in sorted(by_action.items()))))
 
     return "\n".join(out) if out else \
         ("(no fleet telemetry found — need telemetry_rank<k>.jsonl "

@@ -149,7 +149,8 @@ RUNTIME_CONFIG_KNOBS = frozenset({
 # trustworthy as its tools).
 TOOL_ENTRY_POINTS = ("tools/autotune.py", "tools/trace_report.py",
                      "tools/metrics_report.py", "tools/fleet_report.py",
-                     "tools/aot_report.py", "bench.py")
+                     "tools/aot_report.py", "tools/trace_replay.py",
+                     "bench.py")
 
 # --------------------------------------------------------------- GL105 --
 # Where telemetry is emitted (scanned for counter/gauge/histogram/span/
@@ -164,4 +165,5 @@ FLAG_DOC_ROOTS = ("docs", "README.md")
 # examples (myapp.*) and module paths in backticks stay out of scope.
 CATALOG_PREFIXES = ("train", "serve", "serving", "comm", "mem", "pp",
                     "robustness", "aot", "ckpt", "dist", "launch",
-                    "bench", "router", "kernels", "autotune", "fleet")
+                    "bench", "router", "kernels", "autotune", "fleet",
+                    "slo")

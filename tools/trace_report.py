@@ -107,6 +107,100 @@ def load_spans(path: str) -> List[dict]:
     return out
 
 
+def load_aux(path: str) -> dict:
+    """Control-plane records from a telemetry JSONL file: the
+    `{"kind": "control"}` decision audit log and `{"kind":
+    "slo_breach"}` evidence records the SLO engine / PoolController
+    write (docs/OBSERVABILITY.md "SLOs & the control loop"), plus
+    `slo.*` metric samples for the burn-rate timeline. Flight dumps
+    carry none of these; rotation siblings fold in like load_spans."""
+    aux = {"control": [], "breaches": [], "slo": []}
+    try:
+        with open(path) as f:
+            # a flight-recorder dump is ONE json document (multi-record
+            # JSONL fails the whole-file parse): spans only, no aux
+            try:
+                doc = json.load(f)
+                if isinstance(doc, dict) and "spans" in doc:
+                    return aux
+            except json.JSONDecodeError:
+                pass
+    except OSError:
+        return aux
+    paths = ([path + ".1"] if os.path.exists(path + ".1") else []) \
+        + [path]
+    for p in paths:
+        for rec in _jsonl_records(p):
+            kind = rec.get("kind")
+            if kind == "control":
+                aux["control"].append(rec)
+            elif kind == "slo_breach":
+                aux["breaches"].append(rec)
+            elif str(rec.get("name") or "").startswith("slo."):
+                aux["slo"].append(rec)
+    return aux
+
+
+def render_slo_control(aux: dict) -> str:
+    """The `slo` / `control` section: burn-rate timeline per SLO spec
+    and window, breach records, and the control-decision audit log
+    (chronological by controller seq)."""
+    out: List[str] = []
+    w = out.append
+    burn: Dict[tuple, List[tuple]] = {}
+    for s in aux.get("slo") or []:
+        if s.get("name") != "slo.burn_rate":
+            continue
+        lb = s.get("labels") or {}
+        burn.setdefault((str(lb.get("slo", "?")),
+                         str(lb.get("window", "?"))), []).append(
+            (float(s.get("ts") or 0.0), float(s.get("value") or 0.0)))
+    if burn:
+        w("== SLO burn rate (>1.0 = error budget burning faster than "
+          "allowed) ==")
+        w(f"  {'slo':<18}{'window':>8}{'samples':>9}{'max':>8}"
+          f"{'last':>8}  timeline")
+        for key in sorted(burn):
+            pts = sorted(burn[key])
+            vals = [v for _, v in pts]
+            step = max(1, len(vals) // 10)
+            tl = " ".join(f"{v:.1f}" for v in vals[::step][-10:])
+            flag = "  << burning" if vals[-1] >= 1.0 else ""
+            w(f"  {key[0]:<18}{key[1]:>8}{len(vals):>9}"
+              f"{max(vals):>8.2f}{vals[-1]:>8.2f}  {tl}{flag}")
+    breaches = aux.get("breaches") or []
+    if breaches:
+        w("== SLO breaches ==")
+        for b in sorted(breaches, key=lambda r: r.get("ts") or 0):
+            w("  t=%.2f slo=%s burn fast=%.2f slow=%.2f "
+              "events(fast)=%s evidence_spans=%d"
+              % (float(b.get("ts") or 0.0), b.get("slo"),
+                 float(b.get("burn_fast") or 0.0),
+                 float(b.get("burn_slow") or 0.0),
+                 b.get("events_fast"),
+                 len(b.get("evidence") or [])))
+    ctl = aux.get("control") or []
+    if ctl:
+        ctl = sorted(ctl, key=lambda r: (r.get("seq") is None,
+                                         r.get("seq") or 0,
+                                         r.get("ts") or 0))
+        w("== control decisions ==")
+        w(f"  {'seq':>5}{'tick':>7}  {'rule':<14}{'action':<16}"
+          f"{'tier':<12}{'burn_f':>7}  params")
+        for r in ctl:
+            ins = r.get("inputs") or {}
+            bf = ins.get("burn_fast")
+            bf_s = f"{float(bf):.2f}" if bf is not None else "-"
+            params = r.get("params") or {}
+            ps = " ".join(f"{k}={params[k]}" for k in sorted(params))
+            w(f"  {str(r.get('seq', '-')):>5}"
+              f"{str(r.get('tick', '-')):>7}"
+              f"  {str(r.get('rule', '-')):<14}"
+              f"{str(r.get('action', '-')):<16}"
+              f"{str(r.get('tier') or '-'):<12}{bf_s:>7}  {ps}")
+    return "\n".join(out)
+
+
 def load_heartbeats(paths: List[str]) -> List[dict]:
     """`{"kind": "heartbeat"}` lines from heartbeat.jsonl /
     heartbeat_rank*.jsonl / telemetry files (missing files skipped;
@@ -565,6 +659,18 @@ def main(argv=None) -> int:
     else:
         print(render(spans, top_requests=a.requests,
                      waterfall_steps=a.steps, request_id=a.request))
+        if a.request is None:
+            aux = {"control": [], "breaches": [], "slo": []}
+            for path in files:
+                try:
+                    one = load_aux(path)
+                except FileNotFoundError:
+                    continue
+                for k in aux:
+                    aux[k].extend(one[k])
+            sec = render_slo_control(aux)
+            if sec:
+                print(sec)
     if a.chrome:
         with open(a.chrome, "w") as f:
             json.dump(to_chrome_trace(spans), f)

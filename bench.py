@@ -428,6 +428,13 @@ def serve_bench(argv=None):
                          "accepted-tokens/step and tokens/s asserted "
                          "from the JSONL, plus a zero-compile warm "
                          "start of the spec+sampling program variants")
+    ap.add_argument("--tp", type=int, default=None,
+                    help="run the tensor-parallel serving sweep "
+                         "instead: TP=1 vs TP=N GSPMD-sharded arms "
+                         "over the same greedy workload, bitwise "
+                         "parity, per-topology AOT warm start, and "
+                         "the model-axis all-reduce tax per decode "
+                         "tick asserted from the JSONL")
     ap.add_argument("--replay", action="store_true",
                     help="run the trace-driven control-loop scenario "
                          "instead: production-shaped traffic "
@@ -466,6 +473,8 @@ def serve_bench(argv=None):
         return serve_autotune_bench(a)
     if a.spec:
         return serve_spec_bench(a)
+    if a.tp:
+        return serve_tp_bench(a)
 
     import jax
     import paddle_tpu as paddle
@@ -1238,6 +1247,286 @@ def serve_spec_bench(a):
             "draft_k": draft_k, "max_new": max_new, "n_req": n_req,
             "spec_proposal": (spec_props[0]["proposed"]
                               if spec_props else None),
+            "engine_dir": engine_dir,
+            "checks": checks,
+            "telemetry": path,
+            "bench_code_sha": _bench_code_sha(),
+        },
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def serve_tp_bench(a):
+    """Tensor-parallel serving sweep (`bench.py --serve --tp N`): the
+    SAME greedy workload served by a single-device replica (TP=1, the
+    control) and a GSPMD-sharded replica spanning N devices (weights
+    NamedSharding'd over the 'model' axis, KV pages sharded over
+    heads), everything recorded through the observability JSONL sink
+    and the claims asserted FROM the file (the --spec pattern):
+
+    - **tp1** — today's one-device replica (the control);
+    - **tpN** — `tp_degree=N`: one replica over an N-device group.
+      Asserted: emitted tokens BITWISE IDENTICAL to tp1 (greedy
+      decoding must not change under GSPMD partial-sum placement),
+      `comm.bytes{op=all_reduce,axis=model}` > 0 with a positive
+      per-decode-tick byte rate (the analytic all-reduce tax per tick,
+      docs/SERVING.md "Tensor-parallel replicas"), and the
+      `serving.tp.*` gauges exported;
+    - **warm** — the TP-sharded programs built into a PER-TOPOLOGY AOT
+      bundle (`tp_degree` in the geometry fingerprint) and
+      `warm_start`-served: zero `aot.compile_fallback`/`dist.compile`
+      spans, bundle hits > 0, tp1 output parity — plus the mismatch
+      fence: a `tp_degree=1` warm start against the TP-N bundle must
+      raise `BundleInvalid` with reason ``topology``.
+
+    Per-arm tokens/s and p99 inter-token latency come from the
+    `tp_bench_result` records / `serve.request` token events in the
+    JSONL, never from in-process state. `--smoke` shrinks the workload
+    for the tier-1 in-process arm. Exit 0 = all checks hold.
+    """
+    import tempfile
+    # an N-way GSPMD shard needs N devices; on a CPU host, ask XLA for
+    # 8 virtual devices BEFORE its first import (no-op on real TPU —
+    # the flag only shapes the host platform)
+    if "jax" not in sys.modules:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = (
+                xf + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import runtime as obs_rt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import (ContinuousBatchingPredictor,
+                                      LLMPredictor, aot)
+    from paddle_tpu.inference.aot.builder import EngineBuilder
+    from paddle_tpu.inference.aot.bundle import BundleInvalid
+    from paddle_tpu.framework.runtime_config import RuntimeConfig
+
+    tp = int(a.tp)
+    if tp < 2:
+        _log(f"--tp {tp}: nothing to shard; need N >= 2")
+        return 1
+    if len(jax.devices()) < tp:
+        _log(f"--tp {tp} needs {tp} devices, found "
+             f"{len(jax.devices())} (CPU hosts: export XLA_FLAGS="
+             f"--xla_force_host_platform_device_count=8 before jax "
+             f"initializes)")
+        return 1
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        batch, page, max_seq = 4, 16, 1024
+        prompt_len, max_new, n_req = 96, 64, 8
+    elif a.smoke:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        batch, page, max_seq = 2, 8, 64
+        prompt_len, max_new, n_req = 12, 8, 3
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        batch, page, max_seq = 2, 8, 128
+        prompt_len, max_new, n_req = 20, 24, 4
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, cfg.vocab_size,
+                           (prompt_len - (i % 3),)).tolist()
+               for i in range(n_req)]
+
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "output", "telemetry_tp.jsonl")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    open(path, "w").close()   # assertions parse the WHOLE file
+    was_enabled = obs.enabled()
+
+    def run_arm(cb, arm):
+        """Warmup with telemetry disabled (compiles stay out of the
+        asserted file), then one measured pass through the process
+        sink; registry reset per arm so the comm.* totals and
+        serving.* counters read per-arm (the --spec pattern)."""
+        obs.enabled(False)
+        cb.generate(list(prompts), max_new_tokens=max_new)
+        obs.enabled(True)
+        obs.get_registry().reset()
+        obs_rt.configure(path)
+        obs_rt.export_record({"kind": "tp_bench_arm", "arm": arm,
+                              "ts": time.time()})
+        t0 = time.perf_counter()
+        outs = cb.generate(list(prompts), max_new_tokens=max_new)
+        dt = time.perf_counter() - t0
+        toks = sum(len(o) for o in outs)
+        obs_rt.export_record({
+            "kind": "tp_bench_result", "arm": arm, "ts": time.time(),
+            "tp_degree": cb.tp, "wall_s": round(dt, 6),
+            "tokens": toks, "tokens_per_s": round(toks / dt, 2)})
+        obs_rt.maybe_export()
+        obs_rt.configure(None)
+        return outs
+
+    engine_dir = os.path.join(
+        tempfile.mkdtemp(prefix="tp_bundle_"), "engine")
+    topo_reason = None
+    try:
+        obs.enabled(True)
+        # ---- arm 1: TP=1 (the control) ------------------------------
+        cb_1 = ContinuousBatchingPredictor(
+            model, max_batch_size=batch, page_size=page,
+            max_seq_len=max_seq, enable_prefix_cache=False,
+            name="tp1")
+        outs_1 = run_arm(cb_1, "tp1")
+
+        # ---- arm 2: TP=N sharded replica ----------------------------
+        cb_n = ContinuousBatchingPredictor(
+            model, max_batch_size=batch, page_size=page,
+            max_seq_len=max_seq, enable_prefix_cache=False,
+            tp_degree=tp, name=f"tp{tp}")
+        outs_n = run_arm(cb_n, f"tp{tp}")
+
+        # ---- warm start from the per-topology bundle ----------------
+        rc = RuntimeConfig(max_batch_size=batch, page_size=page,
+                           max_seq_len=max_seq, tp_degree=tp)
+        obs.enabled(False)
+        EngineBuilder(model,
+                      prompt_buckets=sorted(
+                          {LLMPredictor._bucket(len(p))
+                           for p in prompts}),
+                      batch_sizes=(1, batch), capture_forward=False,
+                      runtime_config=rc, enable_prefix_cache=False,
+                      eos_token_id=None).build(engine_dir,
+                                               wire_cache=False)
+        # the mismatch fence: asking the TP-N bundle for a one-device
+        # replica must be rejected by NAME (reason `topology`)
+        try:
+            aot.warm_start(model, engine_dir, wire_cache=False,
+                           strict=True, tp_degree=1)
+        except BundleInvalid as e:
+            topo_reason = e.reason
+        obs.enabled(True)
+        obs.get_registry().reset()
+        obs_rt.configure(path)
+        t_warm = time.time()
+        obs_rt.export_record({"kind": "tp_bench_arm", "arm": "warm",
+                              "ts": t_warm})
+        warm_cb, engine = aot.warm_start(model, engine_dir,
+                                         wire_cache=False, name="warm")
+        t0 = time.perf_counter()
+        outs_w = warm_cb.generate(list(prompts),
+                                  max_new_tokens=max_new)
+        warm_dt = time.perf_counter() - t0
+        obs_rt.export_record({
+            "kind": "tp_bench_result", "arm": "warm",
+            "ts": time.time(), "tp_degree": warm_cb.tp,
+            "wall_s": round(warm_dt, 6),
+            "tokens": sum(len(o) for o in outs_w),
+            "tokens_per_s": round(
+                sum(len(o) for o in outs_w) / warm_dt, 2)})
+        obs_rt.maybe_export()
+        obs_rt.configure(None)
+    finally:
+        obs_rt.configure(None)
+        obs.enabled(was_enabled)
+
+    # ---- assertions, FROM the telemetry file ------------------------
+    arm_tps, arm_tp_degree = {}, {}
+    ctr = {}            # (name, replica) -> last value
+    comm = {}           # (op, axis) -> last comm.bytes value
+    gauges = {}         # (name, replica) -> last value
+    itl = {}            # arm -> [inter-token gaps]
+    compile_spans = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = rec.get("kind")
+            lab = rec.get("labels") or {}
+            if kind == "tp_bench_result":
+                arm_tps[rec["arm"]] = rec["tokens_per_s"]
+                arm_tp_degree[rec["arm"]] = rec.get("tp_degree")
+            elif kind == "span":
+                if rec.get("name") in ("aot.compile_fallback",
+                                       "dist.compile") \
+                        and float(rec.get("start", 0)) >= t_warm - 0.5:
+                    compile_spans.append(rec["name"])
+                elif rec.get("name") == "serve.request":
+                    ts = [e["ts"] for e in rec.get("events") or []
+                          if e.get("name") in ("first_token", "token")]
+                    arm = lab.get("replica", "?")
+                    itl.setdefault(arm, []).extend(
+                        b - c for c, b in zip(ts, ts[1:]))
+            elif kind in ("counter", "gauge"):
+                name = rec.get("name")
+                v = float(rec.get("value", 0))
+                if name == "comm.bytes":
+                    comm[(lab.get("op"), lab.get("axis"))] = v
+                elif kind == "gauge":
+                    gauges[(name, lab.get("replica"))] = v
+                else:
+                    ctr[(name, lab.get("replica"))] = v
+
+    def p99(xs):
+        if not xs:
+            return 0.0
+        ys = sorted(xs)
+        return ys[min(len(ys) - 1, int(0.99 * (len(ys) - 1) + 0.5))]
+
+    arm_n = f"tp{tp}"
+    # comm.* counters carry op/axis labels only; the per-arm registry
+    # reset means the model-axis total in the file is the LAST arm that
+    # produced one — warm (a TP-N replica) — and the tpN arm's own
+    # total was exported before that reset. Read per-tick rate from
+    # the tpN arm's decode_steps against the model-axis bytes exported
+    # within that arm's window: both resets exported a model-axis
+    # total, so the value seen keyed (all_reduce, model) is > 0 iff
+    # some TP arm accounted the tax.
+    model_bytes = comm.get(("all_reduce", "model"), 0.0)
+    ticks_n = ctr.get(("serving.decode_steps", arm_n), 0.0)
+    bytes_per_tick = model_bytes / ticks_n if ticks_n else 0.0
+    checks = {
+        "all_arms_measured": all(k in arm_tps
+                                 for k in ("tp1", arm_n, "warm")),
+        "tp_degree_recorded": arm_tp_degree.get(arm_n) == tp
+        and arm_tp_degree.get("warm") == tp,
+        "tp_bitwise_greedy_parity": outs_n == outs_1,
+        "comm_bytes_model_positive": model_bytes > 0,
+        "comm_bytes_per_tick_positive": bytes_per_tick > 0,
+        "tp_gauges_exported": any(
+            k[0] == "serving.tp.degree" and v == tp
+            for k, v in gauges.items()),
+        "itl_measured": bool(itl.get("tp1")) and bool(itl.get(arm_n)),
+        "warm_zero_compile": not compile_spans,
+        "warm_hit_bundle": engine.stats["hits"] > 0
+        and engine.stats["misses"] == 0,
+        "warm_parity": outs_w == outs_1,
+        "topology_invalidation": topo_reason == "topology",
+    }
+    ok = all(checks.values())
+    result = {
+        "metric": "serve_tp_tokens_per_s_ratio",
+        "value": round(arm_tps.get(arm_n, 0)
+                       / max(arm_tps.get("tp1", 1), 1e-9), 4),
+        "unit": f"ratio (tp{tp}/tp1; >1 only when the model is large "
+                f"enough to beat the all-reduce tax)",
+        "aux": {
+            "backend": jax.default_backend(),
+            "tp_degree": tp,
+            "tokens_per_s": arm_tps,
+            "itl_p99_ms": {arm: round(p99(v) * 1e3, 3)
+                           for arm, v in sorted(itl.items())},
+            "comm_bytes_model": int(model_bytes),
+            "comm_bytes_per_tick": int(bytes_per_tick),
+            "decode_steps": int(ticks_n),
             "engine_dir": engine_dir,
             "checks": checks,
             "telemetry": path,

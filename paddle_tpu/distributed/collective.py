@@ -139,6 +139,27 @@ def _account(op: str, ax: Optional[str], *vals, nbytes: Optional[int] = None):
                           bytes=int(nbytes)).end()
 
 
+def account_gspmd(op: str, axis: str, nbytes: int, calls: int = 1):
+    """Analytic accounting for COMPILER-INSERTED collectives.
+
+    GSPMD partitioning (the tensor-parallel serve loop, pjit'd train
+    steps) never routes through the facade functions — XLA inserts the
+    all-reduces itself — so the per-op/axis ``comm.*`` ledger would go
+    dark exactly where the comm tax matters most. Callers that know
+    what the partitioner must insert (e.g. the serving predictor: one
+    ``model``-axis all-reduce per row-parallel projection per decode
+    tick) declare it here; the same ``comm.calls``/``comm.bytes``
+    counters and instant-span treatment as the facade ops apply, so
+    downstream attribution (tools/autotune.py ``_comm_by_axis``,
+    trace_report comm-wait tables) needs no second code path. Bytes are
+    the logical payload per executed program, counted once per
+    DISPATCH (unlike the facade's trace-time counts) — serving
+    dispatches the same executable every tick, so per-tick accounting
+    is the honest ledger there."""
+    for _ in range(max(1, int(calls))):
+        _account(op, axis, nbytes=int(nbytes))
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"

@@ -239,6 +239,17 @@ define_flag("serve_sampling", False,
             "operands (requests without SamplingParams stay greedy — "
             "temperature 0 reduces to the argmax bitwise). Off keeps "
             "the plain argmax decode program.")
+define_flag("serve_tp_degree", 1,
+            "Tensor-parallel serving degree: each "
+            "ContinuousBatchingPredictor replica spans this many "
+            "devices — weights are NamedSharding'ed over the 'model' "
+            "mesh axis and PagedKVPool pages are sharded over KV "
+            "heads, so every serve program runs GSPMD-partitioned. "
+            "Compiled-in geometry: joins the AOT bundle topology "
+            "fingerprint (a mismatch invalidates with reason "
+            "'topology'). 1 = single-device replicas (constructor "
+            "tp_degree overrides; docs/SERVING.md 'Tensor-parallel "
+            "replicas').")
 define_flag("serve_decode_watchdog_s", 0.0,
             "ContinuousBatchingPredictor decode watchdog: if a decode "
             "step's host sync does not resolve within this many "

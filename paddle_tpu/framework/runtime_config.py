@@ -60,6 +60,11 @@ COMPILED_FIELDS = frozenset({
     # batched-operand sampling variant — different executables either
     # way (spec_ngram_max is host-side drafting policy: runtime-only)
     "spec_draft_tokens", "sampling_enabled",
+    # tensor-parallel serving degree: the GSPMD partitioning (weights
+    # over the 'model' mesh axis, KV pages over KV heads) is compiled
+    # INTO every executable — a bundle built at one degree is
+    # meaningless at another (the serve-path `topology` invalidation)
+    "tp_degree",
 })
 
 # FLAGS_* knobs that migrated INTO RuntimeConfig: reading any of these
@@ -72,6 +77,7 @@ MIGRATED_FLAG_KNOBS = {
     "serve_spec_draft_tokens": "spec_draft_tokens",
     "serve_spec_ngram_max": "spec_ngram_max",
     "serve_sampling": "sampling_enabled",
+    "serve_tp_degree": "tp_degree",
     "grad_bucket_bytes": "grad_bucket_bytes",
     "quantized_grad_comm": "quantized_grad_comm",
 }
@@ -107,6 +113,11 @@ class RuntimeConfig:
     # request's own prompt+generation history (runtime-only policy)
     spec_ngram_max: int = 3
     sampling_enabled: bool = False
+    # tensor-parallel serving: one replica spans tp_degree devices —
+    # weights NamedSharding'ed over the 'model' mesh axis, PagedKVPool
+    # pages sharded over KV heads, every serve program GSPMD-partitioned
+    # (docs/SERVING.md "Tensor-parallel replicas"). 1 = single-device.
+    tp_degree: int = 1
 
     # -- serving robustness / fairness (runtime-only) --------------------
     max_queue: Optional[int] = None        # None = unbounded backlog
@@ -145,6 +156,9 @@ class RuntimeConfig:
                 "spec_draft_tokens must be >= 0 and spec_ngram_max "
                 f">= 1, got {self.spec_draft_tokens!r}/"
                 f"{self.spec_ngram_max!r}")
+        if self.tp_degree < 1:
+            raise ValueError(
+                f"tp_degree must be >= 1, got {self.tp_degree!r}")
         # normalize buckets: sorted unique ints (hash stability)
         object.__setattr__(
             self, "prompt_buckets",
@@ -172,6 +186,7 @@ class RuntimeConfig:
             spec_draft_tokens=int(_fv("serve_spec_draft_tokens", 0)),
             spec_ngram_max=int(_fv("serve_spec_ngram_max", 3)),
             sampling_enabled=bool(_fv("serve_sampling", False)),
+            tp_degree=int(_fv("serve_tp_degree", 1)),
             grad_bucket_bytes=int(_fv("grad_bucket_bytes", 32 << 20)),
             quantized_grad_comm=bool(_fv("quantized_grad_comm", False)),
         )

@@ -89,13 +89,32 @@ class PagedKVPool:
     """
 
     def __init__(self, n_layers, num_pages, page_size, n_kv_heads,
-                 head_dim, dtype="float32"):
+                 head_dim, dtype="float32", mesh=None):
         import jax.numpy as jnp
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         shape = (num_pages, page_size, n_kv_heads, head_dim)
         self.k = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
         self.v = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        # tensor-parallel serving: pages shard over the KV-head axis of
+        # a 'model' mesh (the paged kernels are head-parallel by
+        # construction, so every program variant composes). The host-
+        # side bookkeeping — free list, refcounts, page ids — is
+        # layout-blind and identical either way; only the device
+        # placement of the page arrays changes.
+        self.kv_sharding = None
+        if mesh is not None and mesh.shape.get("model", 1) > 1:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            tp = int(mesh.shape["model"])
+            if n_kv_heads % tp:
+                raise ValueError(
+                    f"cannot shard {n_kv_heads} KV heads over "
+                    f"model={tp} (head count must divide)")
+            self.kv_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, "model", None))
+            self.k = [jax.device_put(a, self.kv_sharding) for a in self.k]
+            self.v = [jax.device_put(a, self.kv_sharding) for a in self.v]
         self._free = list(range(num_pages))
         self._refs = {}
         self.reclaimer = None

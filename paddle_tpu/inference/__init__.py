@@ -469,7 +469,8 @@ class ContinuousBatchingPredictor:
                  shed_policy=None, decode_watchdog_s=None,
                  name=None, engine=None, prefill_chunk_tokens=None,
                  runtime_config=None, spec_draft_tokens=None,
-                 spec_ngram_max=None, sampling_enabled=None):
+                 spec_ngram_max=None, sampling_enabled=None,
+                 tp_degree=None, devices=None):
         import math as _m
         import time as _time
         from ..framework.runtime_config import RuntimeConfig
@@ -512,6 +513,46 @@ class ContinuousBatchingPredictor:
         # per-replica cache hits/utilization are separable downstream
         self.name = name
         self._mlbl = {"replica": name} if name else {}
+        # tensor-parallel serving (docs/SERVING.md "Tensor-parallel
+        # replicas"): tp_degree > 1 runs every serve program under
+        # GSPMD over a 'model' mesh spanning this replica's device
+        # group — weights NamedSharding'ed over 'model', KV pages
+        # sharded over KV heads. `devices` pins the group (the router
+        # partitions the host's devices across replicas); default: the
+        # first tp_degree devices.
+        if tp_degree is None:
+            tp_degree = int(getattr(rc, "tp_degree", 1) or 1)
+        self.tp = max(1, int(tp_degree))
+        self._tp_mesh = None
+        self._tp_plan = None
+        self.tp_devices = []
+        self.tp_topology = "replicated"
+        if self.tp > 1:
+            from ..distributed.fleet.hybrid.plan import HybridParallelPlan
+            devs = list(devices) if devices is not None else jax.devices()
+            if len(devs) < self.tp:
+                raise ValueError(
+                    f"tp_degree={self.tp} needs {self.tp} devices, got "
+                    f"{len(devs)}")
+            self.tp_devices = devs[:self.tp]
+            self._tp_plan = HybridParallelPlan.from_spec(
+                f"model={self.tp}", zero_stage=0)
+            self._tp_mesh = self._tp_plan.build_mesh(
+                devices=self.tp_devices)
+            self.tp_topology = self._tp_plan.topology()
+            # the Pallas tiling gates must judge PER-SHARD head counts
+            # from here on (kernels._common / _paged_gate)
+            from ..kernels._common import set_tp_shard_degree
+            set_tp_shard_degree(self.tp)
+            # device-group label: per-replica report views group the
+            # utilization table by it so a 2-device replica reads as
+            # one row spanning "0-1", not two phantom replicas
+            ids = [getattr(d, "id", i)
+                   for i, d in enumerate(self.tp_devices)]
+            self._mlbl["devices"] = (
+                f"{ids[0]}-{ids[-1]}"
+                if ids == list(range(ids[0], ids[-1] + 1))
+                else ",".join(str(i) for i in ids))
         # replicas of one model run in separate threads (serving/
         # router.py) but TRACE through the same model object: jax
         # tracing executes the Python forward with jit.bridge
@@ -548,9 +589,18 @@ class ContinuousBatchingPredictor:
         self.pad_token_id = pad_token_id
         self.eos_token_id = eos_token_id
         head_dim = cfg.hidden_size // cfg.num_attention_heads
+        # head-sharded paged KV: pages shard over the KV-head axis of
+        # the TP mesh when the head count divides; an indivisible model
+        # keeps replicated pages (still served, fast path lost) and the
+        # downgrade is recorded like any other lost kernel path
+        kv_mesh = self._tp_mesh
+        if kv_mesh is not None and cfg.num_key_value_heads % self.tp:
+            from ..kernels._common import note_fallback
+            note_fallback("paged_kv_pool", "tp_head_shard")
+            kv_mesh = None
         self.pool = PagedKVPool(cfg.num_hidden_layers, num_pages + 1,
                                 page_size, cfg.num_key_value_heads,
-                                head_dim, dtype=kv_dtype)
+                                head_dim, dtype=kv_dtype, mesh=kv_mesh)
         # inactive slots need somewhere harmless to point their block
         # table (the decode step writes one K/V row for EVERY slot):
         # a dedicated trash page absorbs those writes
@@ -599,6 +649,21 @@ class ContinuousBatchingPredictor:
         # static capacity, exported so a registry-only autoscaler can
         # normalize serving.in_flight into a utilization (autoscale.py)
         _obsm.gauge("serving.slots").set(self.B, **self._mlbl)
+        # TP shape of this replica + the analytic per-token all-reduce
+        # payload: GSPMD inserts the model-axis all-reduces itself (two
+        # row-parallel projections per layer — attention output and MLP
+        # down-projection), so the predictor declares them to the comm
+        # ledger per dispatch (collective.account_gspmd). Bytes per
+        # token = 2 * layers * hidden * itemsize.
+        self._tp_tok_bytes = 0
+        if self.tp > 1:
+            _obsm.gauge("serving.tp.degree").set(self.tp, **self._mlbl)
+            _obsm.gauge("serving.tp.kv_shards").set(
+                self.tp if self.pool.kv_sharding is not None else 1,
+                **self._mlbl)
+            self._tp_tok_bytes = (
+                2 * int(cfg.num_hidden_layers) * int(cfg.hidden_size)
+                * np.dtype(kv_dtype).itemsize)
         # ragged-grid paged attention: only valid (slot, page) pairs
         # enter the decode kernel's grid. "auto" enables it when the
         # kernel's constraints hold (H == Hkv, D % 128 == 0, H % 8 == 0)
@@ -607,10 +672,12 @@ class ContinuousBatchingPredictor:
         if use_ragged == "auto":
             from ..kernels._common import (use_pallas as _use_pallas,
                                            pallas_interpret)
+            # under TP the kernel sees H / tp heads per shard, so the
+            # head-count tiling constraint applies to the SHARD
             use_ragged = (
                 (cfg.num_attention_heads == cfg.num_key_value_heads)
                 and head_dim % 128 == 0
-                and cfg.num_attention_heads % 8 == 0
+                and cfg.num_attention_heads % (8 * self.tp) == 0
                 and (_use_pallas() or pallas_interpret()))
         self.use_ragged = bool(use_ragged)
         # chunked prefill (docs/SERVING.md "Chunked prefill"): prompts
@@ -723,18 +790,65 @@ class ContinuousBatchingPredictor:
                 self._raw_decode_sample_step, donate_argnums=dn)
             self._spec_jit = jax.jit(self._raw_spec_step,
                                      donate_argnums=dn)
-            self._p_vals = [t._value for t in self._p_tensors]
-            self._b_vals = [t._value for t in self._b_tensors]
+            # identity snapshot of the RAW tensor values: the sharded
+            # device_put copies below are different objects, so change
+            # detection must compare against what the model holds, not
+            # what we serve
+            self._p_src = [t._value for t in self._p_tensors]
+            self._b_src = [t._value for t in self._b_tensors]
+            self._p_vals = self._tp_shard_all(self._p_src)
+            self._b_vals = self._tp_shard_all(self._b_src)
             self._ready = True
             return
         p_vals = [t._value for t in self._p_tensors]
         b_vals = [t._value for t in self._b_tensors]
-        changed = any(a is not b for a, b in zip(p_vals, self._p_vals)) \
-            or any(a is not b for a, b in zip(b_vals, self._b_vals))
+        changed = any(a is not b for a, b in zip(p_vals, self._p_src)) \
+            or any(a is not b for a, b in zip(b_vals, self._b_src))
         if changed:
-            self._p_vals, self._b_vals = p_vals, b_vals
+            self._p_src, self._b_src = p_vals, b_vals
+            self._p_vals = self._tp_shard_all(p_vals)
+            self._b_vals = self._tp_shard_all(b_vals)
             if self.prefix_cache is not None:
                 self.prefix_cache.clear(self.pool)
+
+    def _tp_shard_all(self, vals):
+        """Commit weight arrays onto the TP mesh. NamedSharding rule
+        (the SNIPPETS-[2] naive-sharding idiom): shard the TRAILING
+        axis over 'model' when divisible by tp — the column-parallel
+        orientation, so head/output dims split and no contraction runs
+        over a sharded dim — else the leading axis (embedding tables:
+        vocab rows), else replicate. 1-D tensors (bias/norm vectors)
+        stay replicated: every shard needs them whole and they are
+        cheap. GSPMD propagates the rest of the partitioning through
+        the jitted serve programs."""
+        if self._tp_mesh is None:
+            return vals
+        from jax.sharding import NamedSharding, PartitionSpec
+        out = []
+        for v in vals:
+            shape = getattr(v, "shape", ())
+            spec = [None] * len(shape)
+            if len(shape) >= 2:
+                for ax in (len(shape) - 1, 0):
+                    if shape[ax] % self.tp == 0 and shape[ax] >= self.tp:
+                        spec[ax] = "model"
+                        break
+            out.append(jax.device_put(
+                v, NamedSharding(self._tp_mesh, PartitionSpec(*spec))))
+        return out
+
+    def _tp_account(self, n_tokens):
+        """Declare one dispatch's compiler-inserted model-axis
+        all-reduces to the comm ledger (collective.account_gspmd):
+        per-tick ``comm.bytes{op=all_reduce,axis=model}`` is the
+        all-reduce tax attribution the bench and autotune read. No-op
+        at tp=1. Analytic host arithmetic only — nothing here touches
+        the device."""
+        if not self._tp_tok_bytes:
+            return
+        from ..distributed.collective import account_gspmd
+        account_gspmd("all_reduce", "model",
+                      self._tp_tok_bytes * max(1, int(n_tokens)))
 
     def _jit_call(self, sig, fn, *args):
         """Dispatch a jitted program, holding the shared per-model
@@ -2106,6 +2220,7 @@ class ContinuousBatchingPredictor:
             self._p_vals, self._b_vals, self.pool.k, self.pool.v,
             ids, pos, lens, rows)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
+        self._tp_account(nb * bucket)
         # graft-lint: ok[GL102] — the ONLY admission download: [nb,
         # bucket] small ints (every position's argmax, for the prefix
         # cache's cached-continuation tokens)
@@ -2151,6 +2266,7 @@ class ContinuousBatchingPredictor:
             self._p_vals, self._b_vals, self.pool.k, self.pool.v,
             ids, pos, np.int32(covered), np.int32(sl), past_rows, row)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
+        self._tp_account(sb)
         # graft-lint: ok[GL102] — the suffix-prefill admission
         # download, same contract as _batch_prefill's
         nexts = np.asarray(nexts)
@@ -2211,6 +2327,7 @@ class ContinuousBatchingPredictor:
                 self._p_vals, self._b_vals, self.pool.k, self.pool.v,
                 tables.copy(), ctx.copy(), tok_in, *meta_args)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
+        self._tp_account(self.B)
         snap = [(b, slot_req[b]) for b in active]
         ctx[active] += 1
         self.stats["decode_steps"] += 1
@@ -2304,6 +2421,7 @@ class ContinuousBatchingPredictor:
             tables.copy(), ctx.copy(), span_ids, q_lens.copy(), tok_in,
             *meta_args)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
+        self._tp_account(self.B * qb)
         snap = [(b, slot_req[b]) for b in active]
         adv = [b for b in active if b not in paused]
         ctx[adv] += q_lens[adv]
@@ -2385,6 +2503,7 @@ class ContinuousBatchingPredictor:
             tables.copy(), ctx.copy(), span_ids, q_lens.copy(), tok_in,
             st, sk, sp_, ss, sc, *meta_args)
         self.pool.k, self.pool.v = list(new_k), list(new_v)
+        self._tp_account(self.B * qs)
         snap = [(b, slot_req[b]) for b in active]
         ctx0 = {b: int(ctx[b]) for b in active}
         ctx[active] += q_lens[active]   # optimistic; resolve rewinds

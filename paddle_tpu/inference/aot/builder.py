@@ -126,6 +126,13 @@ class EngineBuilder:
         # the manifest claims was calibrated
         g.setdefault("spec_draft_tokens", rc.spec_draft_tokens)
         g.setdefault("sampling_enabled", rc.sampling_enabled)
+        # per-topology bundles: the tensor-parallel degree is compiled
+        # into every executable (GSPMD partitioning), and the manifest
+        # records the canonical topology string alongside it so
+        # warm_start can reject a topology mismatch by name
+        g.setdefault("tp_degree", rc.tp_degree)
+        from .engine import _serve_topology
+        g.setdefault("mesh_topology", _serve_topology(g["tp_degree"]))
         return g
 
     def effective_runtime_config(self):
@@ -142,6 +149,7 @@ class EngineBuilder:
             prefill_chunk_tokens=int(g["prefill_chunk_tokens"]),
             spec_draft_tokens=int(g["spec_draft_tokens"]),
             sampling_enabled=bool(g["sampling_enabled"]),
+            tp_degree=int(g["tp_degree"]),
             prompt_buckets=tuple(self.prompt_buckets))
 
     def build(self, path: str, wire_cache: bool = True,
@@ -169,9 +177,11 @@ class EngineBuilder:
             # manifest records (bucket table included), so every
             # signature it dispatches is a signature a warm-started
             # replica of this bundle will dispatch
+            ctor_geo = {k: v for k, v in geometry.items()
+                        if k != "mesh_topology"}   # manifest-only field
             cb = ContinuousBatchingPredictor(self.model, engine=engine,
                                              runtime_config=eff_rc,
-                                             **geometry)
+                                             **ctor_geo)
             rng = np.random.RandomState(seed)
             vocab = int(getattr(getattr(self.model, "config", None),
                                 "vocab_size", 0) or 256)

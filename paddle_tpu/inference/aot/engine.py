@@ -67,7 +67,22 @@ COMPILED_GEOMETRY_KEYS = frozenset({
     # program (spec_ngram_max is host-side drafting policy — runtime-
     # only, never invalidates)
     "spec_draft_tokens", "sampling_enabled",
+    # tensor-parallel degree: the GSPMD partitioning (weights over the
+    # 'model' axis, KV pages over heads) is compiled into every
+    # executable — checked FIRST at warm start as the serve-path
+    # `topology` invalidation (mirror of hybrid/aot.py's train-step
+    # topology gate)
+    "tp_degree",
 })
+
+
+def _serve_topology(tp) -> str:
+    """Canonical serve-bundle topology string for a TP degree — the
+    same rendering HybridParallelPlan.topology() produces for a pure
+    'model' mesh, so serve and train-step bundles fingerprint their
+    partitioning in one vocabulary."""
+    tp = int(tp or 1)
+    return f"model={tp}" if tp > 1 else "replicated"
 
 
 def default_engine_dir() -> Optional[str]:
@@ -361,6 +376,25 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
     try:
         engine = load_engine(path, model=model, wire_cache=wire_cache)
         geometry = dict(engine.bundle.manifest().get("geometry", {}))
+        # topology FIRST (mirror of hybrid/aot.py's train-step gate):
+        # the GSPMD partitioning is compiled into every executable, so
+        # a bundle built for one device topology must never serve
+        # another — the mismatch gets its own `topology` reason rather
+        # than drowning in the generic geometry diff
+        want_tp = cb_kwargs.get("tp_degree")
+        if want_tp is None and runtime_config is not None:
+            want_tp = runtime_config.tp_degree
+        if want_tp is not None:
+            got_topo = geometry.get(
+                "mesh_topology",
+                _serve_topology(geometry.get("tp_degree", 1)))
+            want_topo = _serve_topology(want_tp)
+            if got_topo != want_topo:
+                raise BundleInvalid(
+                    "topology",
+                    f"bundle partitioned for {got_topo!r}, requested "
+                    f"{want_topo!r} — per-topology bundles: rebuild "
+                    f"(or point at the bundle built) for this mesh")
         # only COMPILED-IN geometry invalidates (these are baked into
         # the executables' shapes/semantics); runtime knobs — name,
         # enable_prefix_cache, max_queue, shed_policy, watchdog — are
@@ -431,7 +465,7 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
     except BundleInvalid as e:
         if strict:
             raise
-        if e.reason in ("geometry", "runtime_config"):
+        if e.reason in ("geometry", "runtime_config", "topology"):
             _invalidate(e.reason, e.detail)  # load_engine counted others
         geometry = {}
         bundle = EngineBundle.create(
@@ -453,6 +487,8 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
         for field in sorted(set(drift) & set(MIGRATED_FLAG_KNOBS.values())):
             _obsm.counter("aot.config_drift").inc(key=field)
     kw = {**geometry, **cb_kwargs}
+    # manifest-only fingerprint field, not a predictor kwarg
+    kw.pop("mesh_topology", None)
     predictor = ContinuousBatchingPredictor(model, engine=engine,
                                             runtime_config=eff_rc, **kw)
     if not geometry:
@@ -467,6 +503,8 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
                 "num_pages": predictor.capacity,
                 "pad_token_id": predictor.pad_token_id,
                 "eos_token_id": predictor.eos_token_id,
+                "tp_degree": predictor.tp,
+                "mesh_topology": predictor.tp_topology,
                 **{k: v for k, v in cb_kwargs.items()
                    if isinstance(v, (int, float, str, bool,
                                      type(None)))}})

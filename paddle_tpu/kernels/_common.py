@@ -47,6 +47,23 @@ def pallas_dtype_ok(*arrays) -> bool:
     return True
 
 
+# Tensor-parallel shard degree the paged kernels are being traced
+# under: with GSPMD sharding the head axis over 'model', each shard
+# sees only H / tp heads, so the Pallas tiling constraints must hold
+# PER SHARD. The serving predictor declares its degree here (trace-time
+# state, like the gate itself); 1 = unsharded.
+_tp_shard_degree = 1
+
+
+def set_tp_shard_degree(n: int) -> None:
+    global _tp_shard_degree
+    _tp_shard_degree = max(1, int(n))
+
+
+def tp_shard_degree() -> int:
+    return _tp_shard_degree
+
+
 # one log line per (kernel, reason) per process — production losing the
 # fast path must be visible without drowning the log at trace frequency
 _fallbacks_noted = set()

@@ -27,18 +27,24 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import (_Z, _NEG_INF, use_pallas as _use_pallas,
-                      pallas_dtype_ok, pallas_interpret, note_fallback)
+                      pallas_dtype_ok, pallas_interpret, note_fallback,
+                      tp_shard_degree)
 
 
-def _paged_gate(kernel, q, k_pages, v_pages, interpret):
+def _paged_gate(kernel, q, k_pages, v_pages, interpret, tp_degree=None):
     """Shared Pallas-vs-XLA gate for the paged kernels: returns True
     when the Pallas path runs; a wanted-but-lost fast path is recorded
     via ``kernels.pallas_fallbacks{kernel,reason}`` (docs/
     OBSERVABILITY.md) so production silently dropping to plain XLA is
-    observable."""
+    observable. Under tensor-parallel serving (``tp_degree`` > 1, else
+    the ambient ``_common.tp_shard_degree()``) the head axes are GSPMD-
+    sharded over 'model', so the tiling constraints must hold for the
+    PER-SHARD head count H / tp — a global H that tiles but a shard
+    that doesn't is recorded as reason ``tp_head_shard``."""
     h = q.shape[-2]
     hkv = k_pages.shape[2]
     d = q.shape[-1]
+    tp = int(tp_degree) if tp_degree is not None else tp_shard_degree()
     wanted = interpret or _use_pallas()
     if not wanted:
         return False
@@ -50,6 +56,10 @@ def _paged_gate(kernel, q, k_pages, v_pages, interpret):
         return False
     if h % 8 != 0:
         note_fallback(kernel, "head_count_tiling")
+        return False
+    if tp > 1 and (h % tp != 0 or hkv % tp != 0
+                   or (h // tp) % 8 != 0):
+        note_fallback(kernel, "tp_head_shard")
         return False
     if not interpret and not pallas_dtype_ok(q, k_pages, v_pages):
         note_fallback(kernel, "dtype")

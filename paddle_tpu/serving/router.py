@@ -372,11 +372,32 @@ class Router:
         self._lock = threading.Lock()
         self._req_seq = 0
         self.replicas: List[Replica] = []
+        # tensor-parallel replicas occupy a device GROUP, not one
+        # device: partition the visible devices into disjoint groups
+        # of tp so replica i's GSPMD programs never contend with
+        # replica j's for a chip
+        tp = int(predictor_kw.get("tp_degree") or 0)
+        device_groups = None
+        if tp > 1 and any(not hasattr(p, "serve_stream")
+                          for p in predictors):
+            import jax
+            devs = jax.devices()
+            need = tp * sum(1 for p in predictors
+                            if not hasattr(p, "serve_stream"))
+            if len(devs) < need:
+                raise ValueError(
+                    f"tp_degree={tp} x {need // tp} replicas needs "
+                    f"{need} devices, got {len(devs)}")
+            device_groups = [devs[j * tp:(j + 1) * tp]
+                             for j in range(need // tp)]
         for i, p in enumerate(predictors):
             if not hasattr(p, "serve_stream"):   # a model: wrap it
                 from ..inference import ContinuousBatchingPredictor
+                kw = dict(predictor_kw)
+                if device_groups is not None:
+                    kw["devices"] = device_groups.pop(0)
                 p = ContinuousBatchingPredictor(
-                    p, name=f"replica{i}", **predictor_kw)
+                    p, name=f"replica{i}", **kw)
             name = p.name or f"replica{i}"
             self.replicas.append(Replica(self, name, p))
         if not self.replicas:

@@ -69,6 +69,7 @@ CONFIG_DEFAULTS: Dict = {
     "spec_draft_tokens": 0,
     "spec_ngram_max": 3,
     "sampling_enabled": False,
+    "tp_degree": 1,
     "max_queue": None,
     "shed_policy": "newest",
     "decode_watchdog_s": 0.0,
@@ -586,6 +587,73 @@ def propose_zero(rep: Replay, base: Dict) -> List[dict]:
     return []
 
 
+# tensor-parallel thresholds: the per-device parameter budget past
+# which a replica must split over more chips, and the share of total
+# collective bytes on the 'model' axis past which the per-tick
+# all-reduce tax says the replica is over-split
+_TP_PARAM_BYTES = 8 << 30
+_TP_COMM_SHARE = 0.4
+
+
+def propose_tp(rep: Replay, base: Dict) -> List[dict]:
+    """Tensor-parallel serving degree from memory pressure vs the
+    per-tick model-axis all-reduce tax. Raise when the per-replica
+    parameter footprint (``mem.params_bytes{scope=per_replica}``)
+    exceeds one device's budget — or the page pool starves (evictions/
+    over-capacity rejections) while the pool already fills the device —
+    so the GSPMD shard divides both params and KV pages over more
+    chips. Lower when the model-axis share of ``comm.bytes`` dominates
+    total collective traffic AND the halved footprint still fits: at
+    that point each decode tick pays more in all-reduce latency than
+    the extra chips return (docs/SERVING.md 'Tensor-parallel
+    replicas')."""
+    cur = int(base.get("tp_degree") or 1)
+    par_r = rep.counter_total("mem.params_bytes", scope="per_replica")
+    evictions = rep.counter_total("serving.page_evictions")
+    rejected = rep.counter_total("serving.rejected_requests",
+                                 reason="over_pool_capacity")
+    ticks = rep.counter_total("serving.decode_steps")
+    ax_bytes = _comm_by_axis(rep, "comm.bytes")
+    model_bytes = ax_bytes.get("model", 0.0)
+    total_bytes = sum(ax_bytes.values())
+    share = model_bytes / total_bytes if total_bytes > 0 else 0.0
+    window = rep.window_s()
+    per_device = par_r / max(cur, 1)
+    starved = evictions > 0 or rejected > 0
+    if per_device > _TP_PARAM_BYTES or (starved and per_device >
+                                        _TP_PARAM_BYTES / 2):
+        return [_proposal(
+            "tp_degree", cur, cur * 2,
+            "replica memory pressure: the per-device share of the "
+            "parameter footprint exceeds the budget (or the page pool "
+            "starves with params already filling the chip) — doubling "
+            "the tensor-parallel degree halves both the weight shard "
+            "and the per-device KV page footprint",
+            series="mem.params_bytes", n=int(max(ticks, 1)),
+            window_s=window, value=int(par_r),
+            threshold=_TP_PARAM_BYTES, scope="per_replica",
+            per_device_bytes=int(per_device),
+            page_evictions=int(evictions),
+            rejected_over_capacity=int(rejected))]
+    if cur > 1 and share > _TP_COMM_SHARE and model_bytes > 0 \
+            and par_r / (cur // 2) <= _TP_PARAM_BYTES:
+        return [_proposal(
+            "tp_degree", cur, cur // 2,
+            "the model-axis all-reduce tax dominates collective "
+            "traffic and the halved weight shard still fits the "
+            "device: each decode tick pays more in partial-sum "
+            "reduction latency than the extra chips return — shrink "
+            "the replica and spend the freed chips on data-parallel "
+            "replicas instead",
+            series="comm.bytes", n=int(max(ticks, 1)),
+            window_s=window, value=round(share, 4),
+            threshold=_TP_COMM_SHARE, axis="model",
+            model_axis_bytes=int(model_bytes),
+            bytes_per_tick=int(model_bytes / ticks) if ticks else None,
+            params_bytes=int(par_r))]
+    return []
+
+
 # ----------------------------------------------------------------- driver --
 def analyze(paths: List[str], base: Optional[Dict] = None,
             slo_ttft_s: float = 0.25) -> dict:
@@ -601,6 +669,7 @@ def analyze(paths: List[str], base: Optional[Dict] = None,
     proposals += propose_queue(rep, cfg, slo_ttft_s)
     proposals += propose_quantum(rep, cfg)
     proposals += propose_spec(rep, cfg)
+    proposals += propose_tp(rep, cfg)
     proposals += propose_comm(rep, cfg)
     proposals += propose_zero(rep, cfg)
     tuned = dict(cfg)

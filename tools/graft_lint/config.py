@@ -43,6 +43,14 @@ HOT_PATH_FUNCTIONS = (
      "ContinuousBatchingPredictor._resolve_spec_step"),
     ("paddle_tpu/inference/__init__.py",
      "ContinuousBatchingPredictor._await_step"),
+    # tensor-parallel dispatch plumbing: the analytic model-axis
+    # all-reduce accounting runs once per dispatched tick, and the
+    # weight re-shard check runs per generate — a host transfer in
+    # either stalls every GSPMD program in flight
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._tp_account"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor._tp_shard_all"),
     # host-side prompt-lookup drafter: pure-python list matching, runs
     # per spec tick per slot
     ("paddle_tpu/generation/sampling.py", "propose_ngram_drafts"),
@@ -139,6 +147,7 @@ RUNTIME_CONFIG_KNOBS = frozenset({
     "serve_spec_draft_tokens",
     "serve_spec_ngram_max",
     "serve_sampling",
+    "serve_tp_degree",
     "grad_bucket_bytes",
     "quantized_grad_comm",
 })

@@ -263,20 +263,38 @@ def render(last, spans=None) -> str:
         util = _series(last, "serving.autoscale.replica_utilization")
         pfx = _series(last, "serving.prefix_cache_hits")
         if per_rep:
-            w(f"  {'replica':<12}{'routed':>8}{'affinity':>9}"
-              f"{'pfx hits':>9}{'depth':>7}{'load':>8}{'util':>7}")
+            # tensor-parallel replicas label their samples with the
+            # device GROUP they occupy (devices="0-1"); collect it from
+            # any per-replica series so the table shows one row
+            # spanning N chips — and read gauges as single values (max
+            # over matching label sets), never sums: a replica whose
+            # gauge appears under both {replica} and {replica,devices}
+            # label sets must not double-count its utilization
+            devmap = {}
+            for (name, labels), _rec in last.items():
+                lab = dict(labels)
+                if lab.get("replica") and lab.get("devices"):
+                    devmap.setdefault(lab["replica"], lab["devices"])
+
+            def _gauge_for(series, rep):
+                return max((r.get("value", 0.0)
+                            for labels, r in series.items()
+                            if dict(labels).get("replica") == rep),
+                           default=0.0)
+
+            w(f"  {'replica':<12}{'devices':>9}{'routed':>8}"
+              f"{'affinity':>9}{'pfx hits':>9}{'depth':>7}{'load':>8}"
+              f"{'util':>7}")
             for rep in sorted(per_rep):
                 d = per_rep[rep]
                 n_hits = sum(
                     int(r.get("value", 0)) for labels, r in pfx.items()
                     if dict(labels).get("replica") == rep)
-                dep = sum(r.get("value", 0) for labels, r in depth.items()
-                          if dict(labels).get("replica") == rep)
-                ld = sum(r.get("value", 0) for labels, r in load.items()
-                         if dict(labels).get("replica") == rep)
-                ut = sum(r.get("value", 0) for labels, r in util.items()
-                         if dict(labels).get("replica") == rep)
-                w(f"  {rep:<12}{d['routed']:>8}{d['affinity']:>9}"
+                dep = _gauge_for(depth, rep)
+                ld = _gauge_for(load, rep)
+                ut = _gauge_for(util, rep)
+                w(f"  {rep:<12}{devmap.get(rep, '-'):>9}"
+                  f"{d['routed']:>8}{d['affinity']:>9}"
                   f"{n_hits:>9}{int(dep):>7}{ld:>8.0f}"
                   f"{100.0 * ut:>6.1f}%")
         # --- per-tier: the fairness claim, from telemetry alone -------

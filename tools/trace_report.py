@@ -345,6 +345,10 @@ class Request:
         self.prompt_len = labels.get("prompt_len")
         self.tier = labels.get("tier")
         self.replica = labels.get("replica")
+        # tensor-parallel replicas carry the device GROUP they occupy
+        # ("0-1" / "0,2"); per-replica views render it so a 2-device
+        # replica reads as one row spanning two chips, not one chip
+        self.devices = labels.get("devices")
         self.status = span.get("status", "?")
         self.start = float(span.get("start", 0.0))
         self.e2e = float(span.get("dur") or 0.0)
@@ -474,14 +478,17 @@ def render(spans: List[dict], top_requests: int = 5,
     replicas = sorted({r.replica for r in reqs if r.replica is not None})
     if replicas:
         w("== per-replica ==")
-        w(f"  {'replica':<12}{'requests':>9}{'tokens':>8}{'busy ms':>10}"
-          f"{'ttft p99':>11}{'e2e p99':>11}")
+        w(f"  {'replica':<12}{'devices':>9}{'requests':>9}{'tokens':>8}"
+          f"{'busy ms':>10}{'ttft p99':>11}{'e2e p99':>11}")
         for rep in replicas:
             sub = [r for r in reqs if r.replica == rep]
             toks = sum(r.tokens or 0 for r in sub)
             busy = sum(r.e2e for r in sub)
             r_ttft = [r.ttft for r in sub if r.ttft is not None]
-            w(f"  {rep:<12}{len(sub):>9}{toks:>8}{busy * 1e3:>10.1f}"
+            devs = next((r.devices for r in sub
+                         if r.devices is not None), "-")
+            w(f"  {rep:<12}{devs:>9}{len(sub):>9}{toks:>8}"
+              f"{busy * 1e3:>10.1f}"
               f"{percentile(r_ttft, 0.99) * 1e3:>9.2f}ms"
               f"{percentile([r.e2e for r in sub], 0.99) * 1e3:>9.2f}ms")
 

@@ -442,14 +442,24 @@ def serve_bench(argv=None):
                          "spike, controller-enabled pool vs static "
                          "pool, SLO verdicts and the control-decision "
                          "audit asserted from the JSONL")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated prefill/decode "
+                         "scenario instead: 1 prefill + 1 decode "
+                         "replica with KV page-span handoff vs a "
+                         "2-replica unified pool under a long-prompt "
+                         "prefill spike — decode inter-token p99 "
+                         "flatness, aggregate tokens/s, and handoff "
+                         "latency/bytes asserted from the JSONL "
+                         "(--smoke: tier-1 structural arm, greedy "
+                         "parity vs unified, no comparative claims)")
     ap.add_argument("--trace", default=None,
                     help="[replay] trace JSONL to replay (default: "
                          "synthesize one; with --smoke, the checked-in "
                          "tests/fixtures/trace_smoke.jsonl)")
     ap.add_argument("--smoke", action="store_true",
-                    help="[replay] fast tier-1 mode: tiny fixture "
-                         "trace, controller arm only, no SLO-verdict "
-                         "claims")
+                    help="[replay/disagg] fast tier-1 mode: tiny "
+                         "workload, structural claims only (no "
+                         "SLO-verdict / comparative-latency claims)")
     ap.add_argument("--engine-dir", default=None,
                     help="[coldstart] engine bundle directory (default: "
                          "a temp dir; pass a persistent path to measure "
@@ -463,6 +473,8 @@ def serve_bench(argv=None):
     a = ap.parse_args(argv)
     if a.replay:
         return serve_replay_bench(a)
+    if a.disagg:
+        return serve_disagg_bench(a)
     if a.multitenant:
         return serve_mt_bench(a)
     if a.coldstart:
@@ -2438,6 +2450,339 @@ def serve_replay_bench(a):
                         summary["control_decisions"],
                     "timeline_consistent":
                         summary["timeline_consistent"],
+                    "telemetry": path,
+                    "bench_code_sha": _bench_code_sha()},
+        }
+    print(json.dumps(result))
+    return 0
+
+
+def serve_disagg_bench(a):
+    """Disaggregated prefill/decode scenario (`--serve --disagg`): the
+    KV page-span handoff acceptance. Three arms over one workload — a
+    steady decode-heavy stream with a burst of long prefill-heavy
+    prompts landing mid-stream:
+
+    1. **disagg_baseline** — 1 prefill + 1 decode replica
+       (role-overlaid RuntimeConfigs, two-stage dispatch, page-span
+       handoff at first token), NO spike: the decode fleet's unloaded
+       inter-token p99.
+    2. **disagg_spike** — the same fleet under the prefill burst: the
+       burst lands on the prefill replica, so decode inter-token p99
+       must stay within a bounded factor of the no-spike baseline.
+    3. **unified_spike** — 2 unified replicas (chunked prefill ON, the
+       strongest unified mitigation), same spiked workload: the burst
+       shares step time with every in-flight decode, and its decode
+       p99 bounds what disaggregation must beat. The strictly-better
+       and aggregate-throughput claims are asserted on TPU only —
+       on a shared CPU box both fleets contend for the same cores, so
+       role separation cannot buy hardware isolation there.
+
+    Every arm lands one ``{"kind": "disagg_arm"}`` JSONL record
+    (tokens/s, calm/spike inter-token p99, the serving.handoff.*
+    summary — count, p50/p99 ms, bytes, fallbacks) and every claim is
+    asserted from the file, not from in-process state. ``--smoke`` is
+    the tier-1 arm: tiny workload, disagg + unified (no spike), the
+    structural claims only — handoffs happened, bytes moved, zero
+    fallbacks, and greedy token-parity with the unified pool.
+    """
+    import math
+
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import runtime as obs_rt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ContinuousBatchingPredictor
+    from paddle_tpu.serving import Router
+    from paddle_tpu.framework.runtime_config import RuntimeConfig
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    on_tpu = jax.default_backend() != "cpu"
+    smoke = bool(a.smoke)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048,
+                          tensor_parallel=False)
+        batch, page, max_seq = 8, 16, 1024
+        n_base, max_new = 48, a.max_new or 48
+        short_len, long_len, n_spike = 48, 512, 24
+        chunk = 64
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False)
+        batch, page, max_seq = 2, 8, 192
+        if smoke:
+            n_base, max_new = 4, a.max_new or 6
+            short_len, long_len, n_spike = 12, 64, 2
+        else:
+            n_base, max_new = 12, a.max_new or 24
+            short_len, long_len, n_spike = 12, 96, 8
+        chunk = 16
+    # the page pool must cover the whole offered load CONCURRENTLY:
+    # handoff spans import at replica intake (ahead of slot admission),
+    # so a queued burst holds its pages while it waits — an undersized
+    # pool turns the burst into alloc fallbacks (full re-prefills on
+    # the decode replica), which is exactly the contention this
+    # scenario exists to remove
+    pages_per_req = -(-(long_len + max_new) // page)
+    pool_pages = (n_base + n_spike + 4) * pages_per_req
+    rc = RuntimeConfig(max_batch_size=batch, page_size=page,
+                       max_seq_len=max_seq, num_pages=pool_pages)
+
+    path = a.out or os.environ.get("PADDLE_TPU_TELEMETRY_JSONL") \
+        or os.path.join(repo, "output", "telemetry_serve_disagg.jsonl")
+    if os.path.exists(path):
+        os.remove(path)
+    was_enabled = obs.enabled()
+    obs.enabled(True)
+    obs_rt.configure(path)
+    reg = obs.get_registry()
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    rng = np.random.RandomState(7)
+    vocab = cfg.vocab_size
+    base_prompts = [rng.randint(2, vocab, (short_len,)).tolist()
+                    for _ in range(n_base)]
+    spike_prompts = [rng.randint(2, vocab, (long_len,)).tolist()
+                     for _ in range(n_spike)]
+
+    def predictor(name, role=None, chunked=False):
+        """One pool member, pre-warmed on every prefill shape this
+        workload dispatches so no arm pays jit tracing mid-measurement
+        (compile caches are per-instance)."""
+        r = rc.for_role(role) if role else rc
+        if chunked:
+            r = r.replace(prefill_chunk_tokens=chunk)
+        p = ContinuousBatchingPredictor(
+            model, name=name, runtime_config=r,
+            max_batch_size=batch, page_size=page, max_seq_len=max_seq)
+        wr = np.random.RandomState(abs(hash(name)) % 2**31)
+        lens = {short_len, long_len} if p.role != "decode" \
+            else {short_len, long_len, page}
+        for ln in lens:
+            p.generate([wr.randint(2, vocab, (ln,)).tolist()],
+                       max_new_tokens=2)
+        return p
+
+    def p99(xs):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[min(int(math.ceil(0.99 * len(xs))) - 1, len(xs) - 1)]
+
+    def handoff_summary():
+        out = {"count": 0, "bytes": 0, "fallbacks": 0,
+               "p50_ms": None, "p99_ms": None}
+        m = reg.get("serving.handoff.requests")
+        if m is not None:
+            out["count"] = int(sum(s.value for s in m.samples()))
+        m = reg.get("serving.handoff.bytes")
+        if m is not None:
+            out["bytes"] = int(sum(s.value for s in m.samples()))
+        m = reg.get("serving.handoff.fallbacks")
+        if m is not None:
+            out["fallbacks"] = int(sum(s.value for s in m.samples()))
+        m = reg.get("serving.handoff.seconds")
+        if m is not None:
+            ss = [s for s in m.series() if s.count]
+            if ss:
+                out["p50_ms"] = round(
+                    max(s.quantile(0.5) for s in ss) * 1e3, 3)
+                out["p99_ms"] = round(
+                    max(s.quantile(0.99) for s in ss) * 1e3, 3)
+        return out
+
+    def run_arm(arm, roles, spiked, chunked=False):
+        """One pool, one pass over the workload. The spike burst is
+        released once the stream is established (first base request
+        done), so it lands while decodes are in flight."""
+        reg.reset()
+        preds = [predictor(f"{arm}-r{i}", role, chunked=chunked)
+                 for i, role in enumerate(roles)]
+        # untimed warm pass through the SAME pool: the span-import
+        # scatter compiles per page-count shape, and that one-time
+        # trace must not sit inside the measured window (same reason
+        # the predictors pre-warm their prefill shapes)
+        wrng = np.random.RandomState(abs(hash(arm)) % 2**31)
+        with Router(preds, seed=0) as wrouter:
+            whs = [wrouter.submit(
+                wrng.randint(2, vocab, (short_len,)).tolist(),
+                max_new_tokens=2)]
+            if spiked:
+                whs.append(wrouter.submit(
+                    wrng.randint(2, vocab, (long_len,)).tolist(),
+                    max_new_tokens=2))
+            for h in whs:
+                h.result(timeout=600)
+        reg.reset()
+        with Router(preds, seed=0) as router:
+            t0 = time.perf_counter()
+            handles = [("base", router.submit(p, max_new_tokens=max_new))
+                       for p in base_prompts]
+            if spiked:
+                handles[0][1].result(timeout=600)
+                for sp in spike_prompts:
+                    handles.append(
+                        ("spike", router.submit(sp, max_new_tokens=2)))
+            for _, h in handles:
+                h.result(timeout=600)
+            dur = time.perf_counter() - t0
+            # spike window from the burst's own event timestamps:
+            # decode gaps inside it are the contended measurement
+            span = [math.inf, -math.inf]
+            for tag, h in handles:
+                if tag != "spike":
+                    continue
+                span[0] = min(span[0], h.submit_ts)
+                for ev in h.stream(timeout=1.0):
+                    if ev.kind == "token":
+                        span[1] = max(span[1], ev.ts)
+            itl = {"calm": [], "spike": []}
+            statuses = {}
+            tokens = 0
+            for tag, h in handles:
+                statuses[h.status] = statuses.get(h.status, 0) + 1
+                tokens += len(h.tokens)
+                if tag != "base":
+                    continue
+                last, gap_i = None, 0
+                for ev in h.stream(timeout=1.0):
+                    if ev.kind != "token":
+                        continue
+                    if last is not None:
+                        gap_i += 1
+                        # gap 1 spans the prefill->decode boundary
+                        # (admission on a unified pool, the page-span
+                        # handoff on a disaggregated one — reported
+                        # separately as serving.handoff.seconds);
+                        # inter-token latency here means STEADY-STATE
+                        # decode, uniformly across arms
+                        if gap_i > 1:
+                            ph = "spike" \
+                                if span[0] <= ev.ts <= span[1] \
+                                else "calm"
+                            itl[ph].append(ev.ts - last)
+                    last = ev.ts
+            rec = {"kind": "disagg_arm", "ts": time.time(),
+                   "arm": arm, "roles": [p.role for p in preds],
+                   "spiked": bool(spiked), "requests": len(handles),
+                   "statuses": statuses, "tokens": tokens,
+                   "tokens_per_s": round(tokens / max(dur, 1e-9), 3),
+                   "itl_p99_calm_s": round(p99(itl["calm"]), 6),
+                   "itl_p99_spike_s": round(p99(itl["spike"]), 6),
+                   "handoff": handoff_summary(),
+                   "base_tokens": [[int(t) for t in h.tokens]
+                                   for tag, h in handles
+                                   if tag == "base"] if smoke else None}
+            obs_rt.export_record(rec)
+            obs_rt.maybe_export()
+        _log(f"disagg[{arm}]: {rec['tokens_per_s']} tok/s, itl p99 "
+             f"calm {rec['itl_p99_calm_s'] * 1e3:.1f}ms / spike "
+             f"{rec['itl_p99_spike_s'] * 1e3:.1f}ms, handoffs "
+             f"{rec['handoff']['count']} "
+             f"({rec['handoff']['bytes']} B, fallbacks "
+             f"{rec['handoff']['fallbacks']})")
+        return rec
+
+    try:
+        if smoke:
+            run_arm("disagg", ["prefill", "decode"], spiked=True)
+            run_arm("unified", [None], spiked=True)
+        else:
+            run_arm("disagg_baseline", ["prefill", "decode"],
+                    spiked=False)
+            run_arm("disagg_spike", ["prefill", "decode"], spiked=True)
+            run_arm("unified_spike", [None, None], spiked=True,
+                    chunked=True)
+    finally:
+        obs_rt.configure(None)
+        obs.enabled(was_enabled)
+
+    # ---- claims, asserted from the JSONL alone -----------------------
+    arms = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("kind") == "disagg_arm":
+                arms[rec["arm"]] = rec
+    if smoke:
+        dis, uni = arms["disagg"], arms["unified"]
+        assert dis["handoff"]["count"] >= 1, \
+            f"no handoffs recorded: {dis['handoff']}"
+        assert dis["handoff"]["bytes"] > 0, \
+            f"handoff moved no bytes: {dis['handoff']}"
+        assert dis["handoff"]["fallbacks"] == 0, \
+            f"handoff fell back: {dis['handoff']}"
+        assert dis["statuses"] == uni["statuses"], \
+            f"status mix diverged: {dis['statuses']} vs {uni['statuses']}"
+        assert dis["base_tokens"] == uni["base_tokens"], \
+            "greedy parity: disaggregated decode diverged from unified"
+        result = {
+            "metric": "serve_disagg_handoffs",
+            "value": dis["handoff"]["count"],
+            "unit": "handoffs",
+            "aux": {"backend": jax.default_backend(), "smoke": True,
+                    "handoff_bytes": dis["handoff"]["bytes"],
+                    "handoff_p99_ms": dis["handoff"]["p99_ms"],
+                    "greedy_parity": True, "telemetry": path,
+                    "bench_code_sha": _bench_code_sha()},
+        }
+    else:
+        base = arms["disagg_baseline"]
+        dis = arms["disagg_spike"]
+        uni = arms["unified_spike"]
+        assert dis["handoff"]["count"] >= n_base, \
+            f"expected a handoff per base request: {dis['handoff']}"
+        assert dis["handoff"]["bytes"] > 0
+        assert dis["handoff"]["fallbacks"] == 0, \
+            (f"handoff fell back under the sized pool: "
+             f"{dis['handoff']}")
+        assert all(set(arms[k]["statuses"]) == {"ok"} for k in arms)
+        # the tentpole claim: decode p99 inter-token stays flat under
+        # the prefill spike — bounded vs the no-spike baseline
+        floor = 1e-3 if on_tpu else 5e-3   # noise floor for tiny ITLs
+        ref = max(base["itl_p99_calm_s"], floor)
+        flat_factor = dis["itl_p99_spike_s"] / ref
+        bound = 2.0 if on_tpu else 6.0
+        assert dis["itl_p99_spike_s"] <= max(bound * ref, floor), \
+            (f"decode itl p99 not flat under spike: "
+             f"{dis['itl_p99_spike_s']:.6f}s vs baseline "
+             f"{base['itl_p99_calm_s']:.6f}s ({flat_factor:.2f}x)")
+        if on_tpu:
+            # the comparative claims need real hardware isolation —
+            # on a shared CPU box both "fleets" contend for the same
+            # cores, so the prefill burst taxes decode either way and
+            # one decode replica cannot out-decode two unified ones.
+            # On TPU, each replica owns its chips: strictly better
+            # spike ITL than the unified pool, and aggregate
+            # throughput within a bounded factor
+            assert dis["itl_p99_spike_s"] < uni["itl_p99_spike_s"], \
+                (f"disagg not better than unified under spike: "
+                 f"{dis['itl_p99_spike_s']:.6f}s vs "
+                 f"{uni['itl_p99_spike_s']:.6f}s")
+            assert dis["tokens_per_s"] >= 0.6 * uni["tokens_per_s"], \
+                (f"aggregate tokens/s regressed: "
+                 f"{dis['tokens_per_s']} vs unified "
+                 f"{uni['tokens_per_s']}")
+        result = {
+            "metric": "serve_disagg_itl_p99_spike_over_baseline",
+            "value": round(flat_factor, 3),
+            "unit": "x",
+            "aux": {"backend": jax.default_backend(),
+                    "disagg_itl_p99_spike_s": dis["itl_p99_spike_s"],
+                    "unified_itl_p99_spike_s": uni["itl_p99_spike_s"],
+                    "baseline_itl_p99_s": base["itl_p99_calm_s"],
+                    "disagg_tokens_per_s": dis["tokens_per_s"],
+                    "unified_tokens_per_s": uni["tokens_per_s"],
+                    "handoffs": dis["handoff"],
                     "telemetry": path,
                     "bench_code_sha": _bench_code_sha()},
         }

@@ -250,6 +250,16 @@ define_flag("serve_tp_degree", 1,
             "'topology'). 1 = single-device replicas (constructor "
             "tp_degree overrides; docs/SERVING.md 'Tensor-parallel "
             "replicas').")
+define_flag("serve_role", "unified",
+            "Disaggregated serving role of this replica: 'unified' "
+            "(prefill+decode on one device group, the historical "
+            "default), 'prefill' (fills KV pages and hands off at "
+            "first token), or 'decode' (resumes the sync-free loop "
+            "from an imported KV page span). Joins the AOT bundle "
+            "fingerprint next to topology (mismatch invalidates with "
+            "reason 'role'); per-role RuntimeConfig overlays apply via "
+            "RuntimeConfig.for_role (docs/SERVING.md 'Disaggregated "
+            "prefill/decode').")
 define_flag("serve_decode_watchdog_s", 0.0,
             "ContinuousBatchingPredictor decode watchdog: if a decode "
             "step's host sync does not resolve within this many "

@@ -41,7 +41,8 @@ from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 __all__ = ["RuntimeConfig", "CONFIG_VERSION", "config_hash",
-           "MIGRATED_FLAG_KNOBS", "COMPILED_FIELDS"]
+           "MIGRATED_FLAG_KNOBS", "COMPILED_FIELDS", "ROLE_OVERLAYS",
+           "SERVE_ROLES"]
 
 CONFIG_VERSION = 1
 
@@ -80,6 +81,24 @@ MIGRATED_FLAG_KNOBS = {
     "serve_tp_degree": "tp_degree",
     "grad_bucket_bytes": "grad_bucket_bytes",
     "quantized_grad_comm": "quantized_grad_comm",
+    "serve_role": "serve_role",
+}
+
+# Disaggregated serving roles (docs/SERVING.md "Disaggregated
+# prefill/decode"). "unified" is the historical do-everything replica
+# and stays the default everywhere.
+SERVE_ROLES = ("unified", "prefill", "decode")
+
+# Per-role RuntimeConfig overlays: the field deltas `for_role()` lays
+# over a base config. Prefill replicas never run the spec/sampling
+# decode programs (they stop at the first token), decode replicas
+# never chunk-ingest a prompt (they resume from an imported span) —
+# dropping those program variants is what shrinks the per-role AOT
+# bundle and its cold start.
+ROLE_OVERLAYS = {
+    "unified": {},
+    "prefill": {"spec_draft_tokens": 0, "sampling_enabled": False},
+    "decode": {"prefill_chunk_tokens": 0},
 }
 
 
@@ -118,6 +137,14 @@ class RuntimeConfig:
     # pages sharded over KV heads, every serve program GSPMD-partitioned
     # (docs/SERVING.md "Tensor-parallel replicas"). 1 = single-device.
     tp_degree: int = 1
+    # disaggregated serving role of the replica this config drives:
+    # "unified" (prefill+decode, the historical default), "prefill"
+    # (fills pages, hands off at first token), or "decode" (resumes
+    # from an imported KV span). NOT a COMPILED_FIELD — the AOT layer
+    # bakes the role into the bundle fingerprint next to topology and
+    # invalidates with its own reason ("role") so per-role bundle sets
+    # stay distinguishable from generic config drift.
+    serve_role: str = "unified"
 
     # -- serving robustness / fairness (runtime-only) --------------------
     max_queue: Optional[int] = None        # None = unbounded backlog
@@ -159,6 +186,10 @@ class RuntimeConfig:
         if self.tp_degree < 1:
             raise ValueError(
                 f"tp_degree must be >= 1, got {self.tp_degree!r}")
+        if self.serve_role not in SERVE_ROLES:
+            raise ValueError(
+                f"serve_role must be one of {SERVE_ROLES}, got "
+                f"{self.serve_role!r}")
         # normalize buckets: sorted unique ints (hash stability)
         object.__setattr__(
             self, "prompt_buckets",
@@ -189,7 +220,23 @@ class RuntimeConfig:
             tp_degree=int(_fv("serve_tp_degree", 1)),
             grad_bucket_bytes=int(_fv("grad_bucket_bytes", 32 << 20)),
             quantized_grad_comm=bool(_fv("quantized_grad_comm", False)),
+            serve_role=str(_fv("serve_role", "unified")),
         )
+
+    # -------------------------------------------------------------- role --
+    def for_role(self, role: str, **extra) -> "RuntimeConfig":
+        """The per-role specialization of this config: lays the
+        ``ROLE_OVERLAYS[role]`` field deltas (and any explicit ``extra``
+        overrides, which win) over the base, with ``serve_role`` pinned
+        to ``role``. ``for_role("unified")`` is the identity apart from
+        the pin — a unified fleet keeps its exact historical config."""
+        if role not in SERVE_ROLES:
+            raise ValueError(
+                f"serve_role must be one of {SERVE_ROLES}, got {role!r}")
+        kw = dict(ROLE_OVERLAYS[role])
+        kw.update(extra)
+        kw["serve_role"] = role
+        return self.replace(**kw)
 
     # -------------------------------------------------------- serialize --
     def to_dict(self) -> Dict:

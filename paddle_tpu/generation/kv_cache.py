@@ -102,7 +102,11 @@ class PagedKVPool:
         # side bookkeeping — free list, refcounts, page ids — is
         # layout-blind and identical either way; only the device
         # placement of the page arrays changes.
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = str(dtype)
         self.kv_sharding = None
+        self.topology = "single"
         if mesh is not None and mesh.shape.get("model", 1) > 1:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
@@ -115,6 +119,7 @@ class PagedKVPool:
                 mesh, PartitionSpec(None, None, "model", None))
             self.k = [jax.device_put(a, self.kv_sharding) for a in self.k]
             self.v = [jax.device_put(a, self.kv_sharding) for a in self.v]
+            self.topology = f"tp{tp}"
         self._free = list(range(num_pages))
         self._refs = {}
         self.reclaimer = None
@@ -171,6 +176,219 @@ class PagedKVPool:
         self.k, self.v = self._copy_jit(self.k, self.v,
                                         np.int32(src), np.int32(dst))
         self.k, self.v = list(self.k), list(self.v)
+
+    # ------------------------------------------------ disaggregation --
+    def export_span(self, prompt, page_ids, next_token=None):
+        """Serialize the pages holding `prompt`'s K/V into a
+        transferable :class:`KVPageSpan` (the prefill→decode handoff of
+        docs/SERVING.md "Disaggregated prefill/decode"). `page_ids` is
+        the request's own block-table prefix — ``ceil(len(prompt)/page)``
+        entries; `next_token` is the greedy first token the prefill side
+        resolved, carried so the decode side can resume without a
+        suffix prefill.
+
+        Transport is serialized host memory for now; the span payload
+        is plain per-layer numpy, so an ICI/DMA device-to-device path
+        can replace the gather/scatter endpoints without changing the
+        interface. TP head-sharded pools export the UNSHARDED view (the
+        host gather assembles shards); the import side reshards to its
+        own layout and records a fallback when layouts differ.
+        """
+        import numpy as np
+        page = self.page_size
+        n = len(prompt)
+        n_full = n // page
+        partial_len = n % page
+        want = n_full + (1 if partial_len else 0)
+        if want == 0 or len(page_ids) < want:
+            raise ValueError(
+                f"export_span: need {want} pages for a {n}-token prompt, "
+                f"got {len(page_ids)} page ids")
+        sel = np.asarray(list(page_ids[:want]), dtype=np.int32)  # graft-lint: ok[GL102] host-side page-id list, no device transfer
+        # host gather: np.array on a (possibly sharded) device array
+        # fetches and assembles shards — the designed sync point of the
+        # serialized-host transport.
+        k_pages = [np.array(k[sel]) for k in self.k]   # graft-lint: ok[GL102] designed host-transfer gather of the KV handoff span
+        v_pages = [np.array(v[sel]) for v in self.v]   # graft-lint: ok[GL102] designed host-transfer gather of the KV handoff span
+        if partial_len:
+            # zero the stale tail of the trailing partial page so the
+            # checksum (and bitwise round-trip equality) is a function
+            # of the prompt's K/V only, not of prior page tenants
+            for a in k_pages:
+                a[-1, partial_len:] = 0
+            for a in v_pages:
+                a[-1, partial_len:] = 0
+        return KVPageSpan(
+            prompt=tuple(int(t) for t in prompt),
+            next_token=(None if next_token is None else int(next_token)),
+            page_size=page, n_kv_heads=self.n_kv_heads,
+            head_dim=self.head_dim, dtype=self.dtype,
+            topology=self.topology, k_pages=k_pages, v_pages=v_pages)
+
+    def import_span(self, span, prefix_cache=None):
+        """Materialize a :class:`KVPageSpan` into this pool, deduping
+        against pages already resident in `prefix_cache` (only missing
+        pages are allocated and scattered). Returns a stats dict:
+        ``page_ids`` (full table prefix covering the span's prompt, in
+        order), ``imported``/``reused`` page counts, ``bytes`` actually
+        transferred, and ``resharded`` (True when the span came from a
+        different KV layout and was laid out anew on import — also
+        recorded via kernels fallback telemetry).
+
+        Raises ``ValueError`` on checksum mismatch (corrupted span) or
+        geometry disagreement. When `prefix_cache` is given the
+        imported pages are inserted into the trie (which then holds
+        their references — the serve loop's full-prefix-hit path picks
+        them up); without one the caller owns the returned refs.
+        """
+        import numpy as np
+        if not span.verify():
+            raise ValueError("KVPageSpan checksum mismatch (corrupted "
+                             "or torn handoff payload)")
+        if (span.page_size != self.page_size
+                or span.n_kv_heads != self.n_kv_heads
+                or span.head_dim != self.head_dim
+                or span.dtype != self.dtype
+                or len(span.k_pages) != len(self.k)):
+            raise ValueError(
+                "KVPageSpan geometry mismatch: span "
+                f"(page={span.page_size}, heads={span.n_kv_heads}, "
+                f"dim={span.head_dim}, dtype={span.dtype}, "
+                f"layers={len(span.k_pages)}) vs pool "
+                f"(page={self.page_size}, heads={self.n_kv_heads}, "
+                f"dim={self.head_dim}, dtype={self.dtype}, "
+                f"layers={len(self.k)})")
+        resharded = span.topology != self.topology
+        if resharded:
+            # cross-layout handoff: the span was gathered from another
+            # sharding; scattering below lays it out for THIS pool.
+            # Recorded as a fallback so autotune/reports can see
+            # reshard traffic on the handoff path.
+            from ..kernels._common import note_fallback
+            note_fallback("kv_span_import", "reshard")
+        page = self.page_size
+        prompt = span.prompt
+        n = len(prompt)
+        n_full = n // page
+        partial_len = n % page
+        total = n_full + (1 if partial_len else 0)
+        reused = []
+        if prefix_cache is not None:
+            pages, covered, partial, _nt = prefix_cache.lookup(prompt)
+            reused = list(pages)
+            if covered == n or (partial is not None
+                                and covered + partial[1] == n):
+                # fully resident: nothing to transfer
+                return {"page_ids": reused + (
+                            [partial[0]] if partial is not None else []),
+                        "imported": 0, "reused": total, "bytes": 0,
+                        "resharded": resharded}
+        missing = list(range(len(reused), total))
+        ids = self.alloc(len(missing))
+        if ids is None:
+            raise MemoryError(
+                f"import_span: pool cannot hold {len(missing)} pages "
+                f"(free={self.free_count})")
+        sel = np.asarray(missing, dtype=np.int32)  # graft-lint: ok[GL102] host-side page-index list, no device transfer
+        dst = np.asarray(ids, dtype=np.int32)      # graft-lint: ok[GL102] host-side page-index list, no device transfer
+        nbytes = 0
+        import jax
+        import jax.numpy as jnp
+        for layer in range(len(self.k)):
+            upd_k = np.ascontiguousarray(span.k_pages[layer][sel])
+            upd_v = np.ascontiguousarray(span.v_pages[layer][sel])
+            nbytes += upd_k.nbytes + upd_v.nbytes
+            jk, jv = jnp.asarray(upd_k), jnp.asarray(upd_v)
+            if self.kv_sharding is not None:
+                # reshard-on-import: lay the replicated host pages out
+                # on this pool's head-sharded mesh before the scatter
+                from jax.sharding import NamedSharding, PartitionSpec
+                upd_sh = NamedSharding(self.kv_sharding.mesh,
+                                       PartitionSpec(None, None,
+                                                     "model", None))
+                jk = jax.device_put(jk, upd_sh)
+                jv = jax.device_put(jv, upd_sh)
+            self.k[layer] = self.k[layer].at[dst].set(
+                jk.astype(self.k[layer].dtype))
+            self.v[layer] = self.v[layer].at[dst].set(
+                jv.astype(self.v[layer].dtype))
+        all_ids = reused + ids
+        if prefix_cache is not None:
+            next_tokens = None
+            if span.next_token is not None:
+                next_tokens = [None] * (n - 1) + [span.next_token]
+            prefix_cache.insert(prompt, all_ids, next_tokens, self)
+            # the trie holds the surviving references; drop the alloc
+            # refs so imported pages are reclaimable like any cached
+            # prefix once unused
+            self.release(ids)
+        return {"page_ids": all_ids, "imported": len(ids),
+                "reused": len(reused), "bytes": nbytes,
+                "resharded": resharded}
+
+
+class KVPageSpan:
+    """One request's prefilled KV pages, serialized for transfer between
+    replicas (prefill→decode handoff). Pages are keyed by the same
+    content hashes as the PrefixCache trie (`prefix_page_keys`), so the
+    import side dedups against already-resident prefixes instead of
+    re-transferring them.
+
+    The payload is per-layer numpy — `k_pages[l]`/`v_pages[l]` are
+    [n_pages, page_size, n_kv_heads, head_dim] host arrays covering the
+    prompt (trailing partial page zero-padded past its valid tokens).
+    `checksum` is a SHA-256 over header + payload, verified on import
+    (a corrupted span is rejected, never half-materialized).
+    """
+
+    __slots__ = ("prompt", "next_token", "page_size", "n_kv_heads",
+                 "head_dim", "dtype", "topology", "k_pages", "v_pages",
+                 "checksum")
+
+    def __init__(self, prompt, next_token, page_size, n_kv_heads,
+                 head_dim, dtype, topology, k_pages, v_pages,
+                 checksum=None):
+        self.prompt = tuple(prompt)
+        self.next_token = next_token
+        self.page_size = int(page_size)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = str(dtype)
+        self.topology = str(topology)
+        self.k_pages = list(k_pages)
+        self.v_pages = list(v_pages)
+        self.checksum = (checksum if checksum is not None
+                         else self.compute_checksum())
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.k_pages[0].shape[0]) if self.k_pages else 0
+
+    @property
+    def nbytes(self) -> int:
+        return (sum(a.nbytes for a in self.k_pages)
+                + sum(a.nbytes for a in self.v_pages))
+
+    @property
+    def keys(self):
+        """The trie keys of the span's FULL pages (the dedup join key)."""
+        return prefix_page_keys(self.prompt, self.page_size)
+
+    def compute_checksum(self) -> str:
+        import hashlib
+        import numpy as np
+        h = hashlib.sha256()
+        h.update(repr((self.prompt, self.next_token, self.page_size,
+                       self.n_kv_heads, self.head_dim,
+                       self.dtype)).encode())
+        for a in self.k_pages:
+            h.update(np.ascontiguousarray(a).tobytes())
+        for a in self.v_pages:
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()
+
+    def verify(self) -> bool:
+        return self.checksum == self.compute_checksum()
 
 
 def prefix_page_keys(prompt, page_size):

@@ -470,7 +470,7 @@ class ContinuousBatchingPredictor:
                  name=None, engine=None, prefill_chunk_tokens=None,
                  runtime_config=None, spec_draft_tokens=None,
                  spec_ngram_max=None, sampling_enabled=None,
-                 tp_degree=None, devices=None):
+                 tp_degree=None, devices=None, role=None):
         import math as _m
         import time as _time
         from ..framework.runtime_config import RuntimeConfig
@@ -513,6 +513,22 @@ class ContinuousBatchingPredictor:
         # per-replica cache hits/utilization are separable downstream
         self.name = name
         self._mlbl = {"replica": name} if name else {}
+        # disaggregated serving role (docs/SERVING.md "Disaggregated
+        # prefill/decode"): "prefill" replicas fill KV pages and hand
+        # off at first token, "decode" replicas resume the sync-free
+        # loop from an imported KVPageSpan, "unified" (the default)
+        # keeps the historical do-everything behavior — including the
+        # exact metric label sets (role joins labels only when set, so
+        # unified fleets stay byte-identical downstream).
+        if role is None:
+            role = str(getattr(rc, "serve_role", "unified") or "unified")
+        from ..framework.runtime_config import SERVE_ROLES
+        if role not in SERVE_ROLES:
+            raise ValueError(
+                f"role must be one of {SERVE_ROLES}, got {role!r}")
+        self.role = role
+        if role != "unified":
+            self._mlbl["role"] = role
         # tensor-parallel serving (docs/SERVING.md "Tensor-parallel
         # replicas"): tp_degree > 1 runs every serve program under
         # GSPMD over a 'model' mesh spanning this replica's device
@@ -752,6 +768,54 @@ class ContinuousBatchingPredictor:
         from ..framework.runtime_config import RuntimeConfig
         return RuntimeConfig.from_flags()
 
+    # ---------------------------------------------------- disaggregation --
+    def export_request_span(self, prompt):
+        """Serialize the KV pages covering `prompt` into a KVPageSpan
+        for prefill→decode handoff (docs/SERVING.md "Disaggregated
+        prefill/decode"). The pages and the first generated token come
+        from the prefix-cache trie — the prefill serve loop inserts
+        every finished ingest there (chunked prompts included on a
+        prefill-role replica). Returns None when the span is not
+        exportable (pages evicted, sampled request, prefix cache off,
+        or the first token unknown) — the router records that as an
+        `export_miss` handoff fallback and dispatches without a span.
+
+        Runs on the replica worker thread between serve-generator
+        ticks, so the pool/trie bookkeeping is touched single-threaded.
+        """
+        if self.prefix_cache is None or not len(prompt):
+            return None
+        prompt = list(prompt)
+        pages, covered, partial, next_token = \
+            self.prefix_cache.lookup(prompt)
+        ids = list(pages)
+        if partial is not None and covered + partial[1] == len(prompt):
+            ids.append(partial[0])
+            covered += partial[1]
+        if covered != len(prompt) or next_token is None:
+            return None
+        return self.pool.export_span(prompt, ids, next_token)
+
+    def import_request_span(self, span):
+        """Materialize a handoff KVPageSpan into this replica's pool +
+        prefix trie (decode side), deduping against already-resident
+        prefix pages. Returns the pool's import stats dict; raises on a
+        corrupted span (checksum) or geometry mismatch — the caller
+        falls back to a plain prefill. After a successful import the
+        serve loop's full-prefix-hit admission path resumes the request
+        with no prefill forward pass.
+
+        Runs on the replica worker thread between serve-generator
+        ticks (same single-threaded bookkeeping contract as
+        `export_request_span`).
+        """
+        if self.prefix_cache is None:
+            raise ValueError(
+                "import_request_span needs the prefix cache "
+                "(enable_prefix_cache=True) — the imported span is "
+                "handed to the serve loop through the trie")
+        return self.pool.import_span(span, self.prefix_cache)
+
     def _bucket_len(self, n):
         """Admission prompt bucket: smallest tuned-table entry covering
         n (RuntimeConfig.prompt_buckets), else the historical
@@ -766,9 +830,22 @@ class ContinuousBatchingPredictor:
     def _ensure_ready(self):
         """Refresh the model's parameter/buffer array snapshot and (on
         first use) build the jitted admission/decode programs. Called at
-        every generate() so weight updates between calls are honored —
-        and since cached prefix K/V was computed with the OLD weights,
-        a weight change flushes the prefix cache."""
+        every generate() / serve-loop start so weight updates between
+        calls are honored — and since cached prefix K/V was computed
+        with the OLD weights, a weight change flushes the prefix cache.
+
+        Runs under the shared per-model trace lock: while ANOTHER
+        replica of the same model is inside its first trace, bound_state
+        has the shared parameter Tensors rebound to tracers — a
+        snapshot read outside the lock would see those tracers as a
+        "weight update" and commit them into _p_vals (leaked-tracer
+        dispatch + a spurious prefix-cache flush). The lock holder
+        restores the real arrays before releasing, so a locked read
+        only ever sees concrete values."""
+        with self._trace_lock:
+            self._ensure_ready_locked()
+
+    def _ensure_ready_locked(self):
         if not self._ready:
             self._p_tensors = [p for _, p in self.model.named_parameters()]
             self._b_tensors = [b for _, b in self.model.named_buffers()]
@@ -1767,15 +1844,28 @@ class ContinuousBatchingPredictor:
             if tier_of[r] is not None:
                 self._m_tier_adm.inc(tier=tier_of[r], **mlbl)
 
-        def chunk_first_token(b, r):
+        def chunk_first_token(b, r, first=None):
             """The final chunk resolved: its last-position argmax is
             the request's FIRST generated token — the TTFT sample and
-            first_token span event land here."""
+            first_token span event land here. On a PREFILL-role replica
+            the finished ingest is additionally inserted into the
+            prefix trie (chunked prompts bypass it on admission), so
+            the handoff span export finds the pages and the first token
+            resident."""
             req_sp[r].event("first_token")
             note_cold_start()
             tl = {"tier": tier_of[r]} if tier_of[r] is not None else {}
             self._m_ttft.observe(_time.perf_counter() - arrival[r],
                                  **tl, **mlbl)
+            if (self.role == "prefill" and first is not None
+                    and self.prefix_cache is not None
+                    and not self._wants_sampling(samp_of[r])):
+                L = len(prompts[r])
+                npages = -(-L // self.page)
+                nts = [None] * (L - 1) + [int(first)]
+                self.prefix_cache.insert(prompts[r],
+                                         slot_pages[b][:npages], nts,
+                                         self.pool)
 
         def place(b, plan, first):
             """Install an admitted request into slot b. `first` is the
@@ -2669,7 +2759,7 @@ class ContinuousBatchingPredictor:
                 # argmax is the request's FIRST generated token
                 t = int(nxt[b])
                 if first_cb is not None:
-                    first_cb(b, r)
+                    first_cb(b, r, t)
                 if bool(done[b]):    # first token is eos: stripped,
                     evict(b)         # parity with place()
                     continue

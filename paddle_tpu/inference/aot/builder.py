@@ -81,6 +81,12 @@ class EngineBuilder:
         self.prompt_buckets = sorted(set(int(b) for b in prompt_buckets))
         self.cb_kwargs = dict(cb_kwargs)
         self.max_new_tokens = int(max_new_tokens)
+        # a prefill-role bundle (disaggregated fleets) serves exactly
+        # one token per request — TTFT, then the KV span hands off —
+        # so calibration drives max_new=1 and the bundle carries no
+        # multi-token decode programs it would never dispatch
+        if self.cb_kwargs.get("role", self._rc.serve_role) == "prefill":
+            self.max_new_tokens = 1
         self.capture_forward = bool(capture_forward)
         bmax = int(self.cb_kwargs.get("max_batch_size",
                                       self._rc.max_batch_size))
@@ -133,6 +139,12 @@ class EngineBuilder:
         g.setdefault("tp_degree", rc.tp_degree)
         from .engine import _serve_topology
         g.setdefault("mesh_topology", _serve_topology(g["tp_degree"]))
+        # per-role bundles: the serve role rides the manifest next to
+        # the topology string so warm_start can reject a role mismatch
+        # by name ("role" invalidation). The program-set differences
+        # fall out of the role overlay (runtime_config.for_role) and
+        # the prefill max_new clamp above — this field just names them.
+        g.setdefault("role", rc.serve_role)
         return g
 
     def effective_runtime_config(self):
@@ -150,6 +162,7 @@ class EngineBuilder:
             spec_draft_tokens=int(g["spec_draft_tokens"]),
             sampling_enabled=bool(g["sampling_enabled"]),
             tp_degree=int(g["tp_degree"]),
+            serve_role=str(g["role"]),
             prompt_buckets=tuple(self.prompt_buckets))
 
     def build(self, path: str, wire_cache: bool = True,

@@ -73,6 +73,12 @@ COMPILED_GEOMETRY_KEYS = frozenset({
     # `topology` invalidation (mirror of hybrid/aot.py's train-step
     # topology gate)
     "tp_degree",
+    # disaggregated serve role: a per-role bundle carries a per-role
+    # PROGRAM SET (a prefill bundle calibrates max_new=1 and never
+    # compiles multi-token decode; a decode bundle drops the chunked
+    # mixed programs), so role rides the fingerprint next to topology
+    # and gets its own warm-start gate / `role` invalidation reason
+    "role",
 })
 
 
@@ -395,6 +401,23 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
                     f"bundle partitioned for {got_topo!r}, requested "
                     f"{want_topo!r} — per-topology bundles: rebuild "
                     f"(or point at the bundle built) for this mesh")
+        # role SECOND (per-role bundles, docs/DEPLOYMENT.md): a
+        # disaggregated fleet builds one bundle per (role, topology) —
+        # the calibrated PROGRAM SET differs (a prefill bundle never
+        # compiled multi-token decode), so serving a decode fleet from
+        # a prefill bundle must invalidate by name, not limp through
+        # bucket misses
+        want_role = cb_kwargs.get("role")
+        if want_role is None and runtime_config is not None:
+            want_role = runtime_config.serve_role
+        if want_role is not None:
+            got_role = geometry.get("role", "unified")
+            if got_role != want_role:
+                raise BundleInvalid(
+                    "role",
+                    f"bundle built for role {got_role!r}, requested "
+                    f"{want_role!r} — per-role bundles: rebuild (or "
+                    f"point at the bundle built) for this role")
         # only COMPILED-IN geometry invalidates (these are baked into
         # the executables' shapes/semantics); runtime knobs — name,
         # enable_prefix_cache, max_queue, shed_policy, watchdog — are
@@ -465,7 +488,8 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
     except BundleInvalid as e:
         if strict:
             raise
-        if e.reason in ("geometry", "runtime_config", "topology"):
+        if e.reason in ("geometry", "runtime_config", "topology",
+                        "role"):
             _invalidate(e.reason, e.detail)  # load_engine counted others
         geometry = {}
         bundle = EngineBundle.create(
@@ -505,6 +529,7 @@ def warm_start(model, path: Optional[str] = None, strict: bool = False,
                 "eos_token_id": predictor.eos_token_id,
                 "tp_degree": predictor.tp,
                 "mesh_topology": predictor.tp_topology,
+                "role": getattr(predictor, "role", "unified"),
                 **{k: v for k, v in cb_kwargs.items()
                    if isinstance(v, (int, float, str, bool,
                                      type(None)))}})

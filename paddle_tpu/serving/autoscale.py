@@ -142,7 +142,44 @@ def autoscale_signals(router=None, registry=None, slo_ttft_s: float = 0.25,
     desired = max(1, min(int(math.ceil(base * max(demand, 0.25))),
                          base * max_scale))
 
+    # role-scoped signals (disaggregated prefill/decode fleets): one
+    # block per role so the PoolController can size each fleet
+    # independently — a prefill spike must grow the prefill fleet, not
+    # N copies of everything. Present only when the pool actually has
+    # non-unified roles; unified pools keep the exact legacy dict.
+    role_sig = {}
+    if router is not None:
+        # getattr: duck-typed external routers (and the controller's
+        # test stubs) predate roles — role-less replicas read as a
+        # unified pool and keep the exact legacy signal dict
+        role_names = {getattr(rep, "role", None) or "unified"
+                      for rep in router.replicas}
+        if role_names - {"unified"}:
+            for role in sorted(role_names):
+                hr = [r for r in router.healthy()
+                      if (getattr(r, "role", None) or "unified") == role]
+                u = [util[r.name] for r in hr if r.name in util]
+                p = [pressure[r.name] for r in hr if r.name in pressure]
+                qd = sum(r.queue_depth() for r in hr)
+                rslots = sum(r.predictor.B for r in hr)
+                mean_u = sum(u) / len(u) if u else 0.0
+                backlog = qd / max(rslots, 1) if rslots \
+                    else (1.0 if qd else 0.0)
+                d_raw = max(mean_u, backlog, max(p, default=0.0))
+                base_r = max(len(hr), 1)
+                role_sig[role] = {
+                    "healthy": len(hr),
+                    "queue_depth": int(qd),
+                    "utilization": round(mean_u, 4),
+                    "page_pressure": round(max(p, default=0.0), 4),
+                    "demand": round(d_raw, 4),
+                    "desired": max(1, min(
+                        int(math.ceil(base_r * max(d_raw, 0.25))),
+                        base_r * max_scale)),
+                }
+
     return {
+        **({"roles": role_sig} if role_sig else {}),
         "ts": round(time.time(), 3),
         "slo_ttft_s": slo_ttft_s,
         "queue_depth": {k: int(v) for k, v in queue_by_tier.items()},
@@ -181,4 +218,18 @@ def publish_autoscale(sig: dict, registry: Optional[object] = None):
             sig["demand_raw"], view="raw")
         reg.gauge("serving.autoscale.demand").set(
             sig["demand"], view="smoothed")
+    # role-scoped fleet signals ride DISTINCT gauge names (role_*), all
+    # labeled {role} — never the unlabeled pool totals above, so a
+    # report summing one family cannot double-count the other
+    for role, rs in (sig.get("roles") or {}).items():
+        reg.gauge("serving.autoscale.role_healthy").set(
+            rs["healthy"], role=role)
+        reg.gauge("serving.autoscale.role_queue_depth").set(
+            rs["queue_depth"], role=role)
+        reg.gauge("serving.autoscale.role_utilization").set(
+            rs["utilization"], role=role)
+        reg.gauge("serving.autoscale.role_page_pressure").set(
+            rs["page_pressure"], role=role)
+        reg.gauge("serving.autoscale.role_desired").set(
+            rs["desired"], role=role)
     export_record({"kind": "autoscale", **sig})

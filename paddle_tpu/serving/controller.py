@@ -35,6 +35,7 @@ docs/SERVING.md wires it into a serving deployment.
 """
 from __future__ import annotations
 
+import inspect
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -97,6 +98,16 @@ class PoolController:
         self.cfg = config or ControllerConfig()
         self.engine = slo_engine if slo_engine is not None else SLOEngine()
         self.spawn = spawn
+        # role-aware spawn: a factory that declares a parameter gets the
+        # role it is spawning FOR (disaggregated fleets build different
+        # per-role configs/bundles); a zero-arg legacy factory is called
+        # as before. Decided once here, not per call — a TypeError from
+        # inside the factory must not silently flip the calling style.
+        try:
+            self._spawn_takes_role = spawn is not None and \
+                len(inspect.signature(spawn).parameters) >= 1
+        except (TypeError, ValueError):
+            self._spawn_takes_role = False
         self.slo_ttft_s = float(slo_ttft_s)
         self._now = now_fn
         self._reg = registry if registry is not None \
@@ -110,6 +121,7 @@ class PoolController:
         self._demand_ewma = Ewma(
             half_life_s=self.engine.fast_window_s / 4.0)
         self._quiet_ticks = 0
+        self._quiet_ticks_role: Dict[str, int] = {}
         self._parked: List[object] = []    # drained Replicas, warm
         self._base_weights = dict(router.tier_weights or {})
         self._seq = 0
@@ -122,8 +134,35 @@ class PoolController:
                              "shed_tiers": sorted(router.shed_tiers)})
 
     # ---------------------------------------------------------- helpers --
-    def _pool_size(self) -> int:
-        return len(self.router.healthy())
+    def _pool_size(self, role: Optional[str] = None) -> int:
+        if role is None:
+            return len(self.router.healthy())
+        return sum(1 for r in self.router.healthy()
+                   if getattr(r, "role", "unified") == role)
+
+    def _grow(self, role: Optional[str] = None):
+        """Revive the most recently parked replica (matching `role` when
+        given — a parked prefill replica's compiled programs are useless
+        to the decode fleet) or spawn a fresh one via the factory.
+        Returns ``(how, replica)``, or ``(None, None)`` when neither
+        lever is available."""
+        for i in range(len(self._parked) - 1, -1, -1):
+            rep = self._parked[i]
+            if role is None or getattr(rep, "role", "unified") == role:
+                del self._parked[i]
+                rep.revive()
+                return "revive", rep
+        if self.spawn is None:
+            return None, None
+        pred = self.spawn(role) if self._spawn_takes_role \
+            else self.spawn()
+        if pred is None:
+            return None, None
+        if role is None:
+            # keyword-free call: duck-typed routers (and the test
+            # stubs) predate the role parameter
+            return "spawn", self.router.add_replica(pred)
+        return "spawn", self.router.add_replica(pred, role=role)
 
     def _cooling(self, rule: str, now: float) -> bool:
         return now < self._cooldown_until.get(rule, 0.0)
@@ -149,6 +188,11 @@ class PoolController:
                "demand_raw": sig.get("demand_raw"),
                "demand": sig.get("demand"),
                "queue_depth": sig.get("queue_depth")}
+        if sig.get("roles"):
+            inp["roles"] = {role: {"healthy": rs.get("healthy"),
+                                   "desired": rs.get("desired"),
+                                   "demand": rs.get("demand")}
+                            for role, rs in sig["roles"].items()}
         for g in ("fleet.step_time_seconds", "fleet.comm_wait_share",
                   "fleet.heartbeat_gap_seconds"):
             m = self._reg.get(g)
@@ -202,6 +246,9 @@ class PoolController:
             .get("burn", {}).get(window, 0.0)
 
     def _rule_scale_out(self, slo, sig, inputs, now) -> List[dict]:
+        roles = sig.get("roles")
+        if roles:
+            return self._rule_scale_out_role(slo, roles, inputs, now)
         healthy = self._pool_size()
         desired = int(sig.get("desired_replicas") or healthy)
         burning = self._burn(slo, "fast") >= self.cfg.scale_out_burn
@@ -209,17 +256,8 @@ class PoolController:
                 or (desired <= healthy and not burning) \
                 or self._cooling("scale_out", now):
             return []
-        how, rep = "revive", None
-        if self._parked:
-            rep = self._parked.pop()
-            rep.revive()
-        elif self.spawn is not None:
-            pred = self.spawn()
-            if pred is None:
-                return []
-            rep = self.router.add_replica(pred)
-            how = "spawn"
-        else:
+        how, rep = self._grow()
+        if rep is None:
             return []
         self._arm("scale_out", now, self.cfg.scale_out_cooldown_s)
         self._quiet_ticks = 0
@@ -229,7 +267,43 @@ class PoolController:
                     "pool_after": self._pool_size()},
             cooldown_s=self.cfg.scale_out_cooldown_s)]
 
+    def _rule_scale_out_role(self, slo, roles, inputs, now
+                             ) -> List[dict]:
+        """Disaggregated scale-out: each role's fleet is sized from its
+        own autoscale block so a prefill spike grows the prefill fleet,
+        not N copies of everything. Most-starved role first; still at
+        most one pool action per tick; cooldowns are keyed per
+        (rule, role) so growing one fleet never blocks the other."""
+        if self._pool_size() >= self.cfg.max_replicas:
+            return []
+        burning = self._burn(slo, "fast") >= self.cfg.scale_out_burn
+        order = sorted(roles.items(), reverse=True,
+                       key=lambda kv: (kv[1].get("desired", 0)
+                                       - kv[1].get("healthy", 0)))
+        for role, rs in order:
+            healthy_r = self._pool_size(role)
+            desired_r = int(rs.get("desired") or healthy_r)
+            if (desired_r <= healthy_r and not burning) \
+                    or self._cooling(f"scale_out:{role}", now):
+                continue
+            how, rep = self._grow(role)
+            if rep is None:
+                continue
+            self._arm(f"scale_out:{role}", now,
+                      self.cfg.scale_out_cooldown_s)
+            self._quiet_ticks_role[role] = 0
+            return [self._record(
+                "scale_out", how, inputs,
+                params={"replica": rep.name, "role": role,
+                        "pool_before": healthy_r,
+                        "pool_after": self._pool_size(role)},
+                cooldown_s=self.cfg.scale_out_cooldown_s)]
+        return []
+
     def _rule_scale_in(self, slo, sig, inputs, now) -> List[dict]:
+        roles = sig.get("roles")
+        if roles:
+            return self._rule_scale_in_role(slo, roles, inputs, now)
         healthy = self._pool_size()
         desired = int(sig.get("desired_replicas") or healthy)
         quiet = desired < healthy \
@@ -250,6 +324,42 @@ class PoolController:
             params={"replica": rep.name, "pool_before": healthy,
                     "pool_after": self._pool_size(), "parked": True},
             cooldown_s=self.cfg.scale_in_cooldown_s)]
+
+    def _rule_scale_in_role(self, slo, roles, inputs, now) -> List[dict]:
+        """Disaggregated scale-in: per-role quiet-tick counters (a calm
+        decode fleet can shrink while prefill is still hot), drain via
+        the role-scoped selector (which refuses the last replica of a
+        role — a disaggregated pool must keep both stages alive). All
+        counters advance every tick before any action fires."""
+        calm = self._burn(slo, "fast") <= self.cfg.scale_in_burn
+        eligible: List[str] = []
+        for role, rs in sorted(roles.items()):
+            healthy_r = self._pool_size(role)
+            desired_r = int(rs.get("desired") or healthy_r)
+            quiet = calm and desired_r < healthy_r
+            q = self._quiet_ticks_role.get(role, 0) + 1 if quiet else 0
+            self._quiet_ticks_role[role] = q
+            if quiet and healthy_r > 1 \
+                    and q >= self.cfg.scale_in_quiet_ticks \
+                    and not self._cooling(f"scale_in:{role}", now):
+                eligible.append(role)
+        for role in eligible:
+            healthy_r = self._pool_size(role)
+            rep = self.router.drain_replica(role=role)
+            if rep is None:
+                continue
+            self._parked.append(rep)
+            self._arm(f"scale_in:{role}", now,
+                      self.cfg.scale_in_cooldown_s)
+            self._quiet_ticks_role[role] = 0
+            return [self._record(
+                "scale_in", "drain", inputs,
+                params={"replica": rep.name, "role": role,
+                        "pool_before": healthy_r,
+                        "pool_after": self._pool_size(role),
+                        "parked": True},
+                cooldown_s=self.cfg.scale_in_cooldown_s)]
+        return []
 
     def _rule_shift(self, slo, inputs, now) -> List[dict]:
         """Per-tenant fairness lever: a tier-scoped SLO burning means

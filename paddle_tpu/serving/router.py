@@ -46,6 +46,7 @@ from typing import Dict, List, Optional
 from ..generation.kv_cache import prefix_page_keys
 from ..observability import metrics as _obsm
 from ..observability import tracing as _obstr
+from .scheduler import stage_cost
 from .streaming import ServeRequest, StreamEvent
 
 __all__ = ["Router", "Replica", "RequestHandle"]
@@ -72,6 +73,15 @@ class RequestHandle:
         self.tier = tier
         self.deadline_s = deadline_s
         self.cost = len(self.prompt) + self.max_new_tokens
+        # disaggregated two-stage dispatch state: `stage` is None on a
+        # unified pool, else "prefill" (filling pages, handing off at
+        # first token) then "decode" (resuming from the exported span);
+        # `handoff_span` carries the KVPageSpan between the stages and
+        # stays attached so a decode replica dying mid-request can
+        # replay the import elsewhere.
+        self.stage: Optional[str] = None
+        self.handoff_span = None
+        self._handoff_t0: Optional[float] = None
         self.replica: Optional[str] = None
         self.status = "queued"
         self.tokens: List[int] = []
@@ -148,12 +158,23 @@ class RequestHandle:
 
 
 class Replica:
-    """One predictor + its worker thread running `serve_stream`."""
+    """One predictor + its worker thread running `serve_stream`.
 
-    def __init__(self, router: "Router", name: str, predictor):
+    `role` is the replica's disaggregated serving role — "unified"
+    (the default: prefill+decode, every historical path unchanged),
+    "prefill" (serves each request's ingest + first token, then hands
+    the KV page span to the decode fleet), or "decode" (imports the
+    span and runs the remaining token budget). Defaults to the
+    predictor's own role so a role-configured predictor needs nothing
+    extra here."""
+
+    def __init__(self, router: "Router", name: str, predictor,
+                 role: Optional[str] = None):
         self.router = router
         self.name = name
         self.predictor = predictor
+        self.role = (role or getattr(predictor, "role", None)
+                     or "unified")
         self.lock = threading.Condition()
         self.inbox: collections.deque = collections.deque()
         self.pending: Dict[str, RequestHandle] = {}  # dispatched, not ended
@@ -238,9 +259,55 @@ class Replica:
                 self.router._request_done(h, "cancelled", None)
                 continue
             self.pending[h.id] = h
-            out.append(ServeRequest(h.prompt, h.max_new_tokens, h.tier,
+            if h.stage == "decode" and h.handoff_span is not None:
+                # decode stage: materialize the handed-off span into
+                # this replica's pool/trie BEFORE the serve loop sees
+                # the request — admission then takes the full-prefix-
+                # hit path (no prefill forward). Import failures fall
+                # back to a plain prefill (counted, never fatal).
+                self._import_handoff(h)
+            mn = h.max_new_tokens
+            if self.role == "prefill" and h.stage == "prefill":
+                # prefill stage serves the ingest + FIRST token only
+                # (TTFT is measured here); the rest of the budget runs
+                # on the decode fleet after the span handoff
+                mn = 1
+            out.append(ServeRequest(h.prompt, mn, h.tier,
                                     h.deadline_s, h))
         return out
+
+    def _import_handoff(self, h: RequestHandle):
+        """Import a handoff span (worker thread, between serve-loop
+        ticks). serving.handoff.seconds measures prefill-side export →
+        decode-side pages resident; failures record a reason and leave
+        the request to prefill from scratch."""
+        r = self.router
+        try:
+            stats = self.predictor.import_request_span(h.handoff_span)
+        except MemoryError:
+            r._m_handoff_fb.inc(reason="alloc", replica=self.name)
+            h.span.event("handoff_import_failed", reason="alloc")
+            return
+        except Exception as e:
+            reason = "corrupt" if "checksum" in str(e) else "import_error"
+            r._m_handoff_fb.inc(reason=reason, replica=self.name)
+            h.span.event("handoff_import_failed", reason=reason,
+                         error=f"{type(e).__name__}: {e}")
+            return
+        if h._handoff_t0 is not None:
+            r._m_handoff_s.observe(time.perf_counter() - h._handoff_t0,
+                                   replica=self.name)
+            h._handoff_t0 = None     # a replayed import times nothing
+        r._m_handoff_bytes.inc(int(stats["bytes"]), replica=self.name)
+        r._m_handoff_pages.inc(int(stats["imported"]), kind="imported",
+                               replica=self.name)
+        if stats["reused"]:
+            r._m_handoff_pages.inc(int(stats["reused"]), kind="reused",
+                                   replica=self.name)
+        if stats.get("resharded"):
+            r._m_handoff_fb.inc(reason="reshard", replica=self.name)
+        h.span.event("handoff_imported", imported=stats["imported"],
+                     reused=stats["reused"], bytes=stats["bytes"])
 
     # ----------------------------------------------------------- worker --
     def _run(self):
@@ -297,6 +364,15 @@ class Replica:
             return
         self.consecutive_failures = 0
         self.served += 1
+        if (self.role == "prefill" and h.stage == "prefill"
+                and status == "ok" and not h.cancelled and h.tokens
+                and len(h.tokens) < h.max_new_tokens):
+            # prefill stage done (first token streamed, budget
+            # remains): hand the KV span to the decode fleet instead
+            # of finishing. An eos-first or budget-of-1 request has
+            # nothing left to decode and completes normally above.
+            self.router._handoff(h, self)
+            return
         self.router._request_done(h, status, ts)
 
     def _on_failure(self, reason: str, fatal: bool = False):
@@ -360,9 +436,13 @@ class Router:
 
     def __init__(self, predictors, tier_weights=None, policy="affinity",
                  eject_after=2, max_readmissions=1, seed=0,
-                 affinity_capacity=4096, **predictor_kw):
+                 affinity_capacity=4096, roles=None, **predictor_kw):
         if policy not in ("affinity", "least_loaded", "random"):
             raise ValueError(f"unknown routing policy {policy!r}")
+        if roles is not None and len(roles) != len(predictors):
+            raise ValueError(
+                f"roles ({len(roles)}) must parallel predictors "
+                f"({len(predictors)})")
         self.policy = policy
         self.tier_weights = dict(tier_weights) if tier_weights else None
         self.eject_after = int(eject_after)
@@ -391,15 +471,25 @@ class Router:
             device_groups = [devs[j * tp:(j + 1) * tp]
                              for j in range(need // tp)]
         for i, p in enumerate(predictors):
+            role = roles[i] if roles is not None else None
             if not hasattr(p, "serve_stream"):   # a model: wrap it
                 from ..inference import ContinuousBatchingPredictor
                 kw = dict(predictor_kw)
                 if device_groups is not None:
                     kw["devices"] = device_groups.pop(0)
+                if role is not None:
+                    # per-role specialization: the role's RuntimeConfig
+                    # overlay applies to an explicit config (chunk
+                    # thresholds for prefill, spec/sampling programs
+                    # for decode — framework/runtime_config.py)
+                    kw["role"] = role
+                    if kw.get("runtime_config") is not None:
+                        kw["runtime_config"] = \
+                            kw["runtime_config"].for_role(role)
                 p = ContinuousBatchingPredictor(
                     p, name=f"replica{i}", **kw)
             name = p.name or f"replica{i}"
-            self.replicas.append(Replica(self, name, p))
+            self.replicas.append(Replica(self, name, p, role=role))
         if not self.replicas:
             raise ValueError("Router needs at least one replica")
         self.page = self.replicas[0].predictor.page
@@ -417,6 +507,17 @@ class Router:
         self._m_done = _obsm.counter("serving.router.completed")
         self._m_shed = _obsm.counter("serving.router.shed")
         self._m_pool = _obsm.counter("serving.router.pool_resizes")
+        # disaggregated handoff accounting (docs/OBSERVABILITY.md):
+        # requests handed prefill→decode, end-to-end handoff latency
+        # (export → pages resident on the decode side), transferred
+        # bytes, imported/reused page counts, and fallbacks by reason
+        # (export_miss / corrupt / alloc / reshard / import_error)
+        self._m_handoff = _obsm.counter("serving.handoff.requests")
+        self._m_handoff_s = _obsm.histogram("serving.handoff.seconds",
+                                            unit="s")
+        self._m_handoff_bytes = _obsm.counter("serving.handoff.bytes")
+        self._m_handoff_pages = _obsm.counter("serving.handoff.pages")
+        self._m_handoff_fb = _obsm.counter("serving.handoff.fallbacks")
         # tiers currently refused at the admission edge (the control
         # loop's load-shed lever, serving/controller.py). Read on every
         # submit; mutated only via set_shed_tiers.
@@ -426,8 +527,30 @@ class Router:
     def healthy(self) -> List[Replica]:
         return [r for r in self.replicas if not r.ejected and not r.closed]
 
+    @property
+    def disaggregated(self) -> bool:
+        """True when the pool actually runs two-stage dispatch: at
+        least one prefill AND one decode replica. A pool of unified
+        replicas (the default) never stages."""
+        roles = {r.role for r in self.replicas}
+        return "prefill" in roles and "decode" in roles
+
+    def _target_role(self, h: RequestHandle) -> Optional[str]:
+        if not self.disaggregated:
+            return None
+        return "decode" if h.stage == "decode" else "prefill"
+
     def _route(self, h: RequestHandle, exclude=()):
         cands = [r for r in self.healthy() if r not in exclude]
+        role = self._target_role(h)
+        if role is not None:
+            # role-scoped dispatch: prefer the stage's own fleet
+            # (unified replicas can serve either stage); when the
+            # whole target fleet is down, ANY healthy replica beats
+            # failing the request — the off-role fallback serves it
+            # end-to-end (docs/SERVING.md failure semantics)
+            scoped = [r for r in cands if r.role in (role, "unified")]
+            cands = scoped or cands
         if not cands:
             return None, "none"
         if self.policy == "random":
@@ -475,6 +598,22 @@ class Router:
                 self._m_done.inc(status="error_no_replica",
                                  **({"tier": h.tier} if h.tier else {}))
                 return
+            if self.disaggregated:
+                # two-stage dispatch: a fresh request landing on the
+                # prefill fleet enters the prefill stage (handoff at
+                # first token); a decode-stage request keeps its stage
+                # wherever it lands. Off-role fallback (unified/prefill
+                # absorbing a stage when a fleet is down) clears the
+                # stage so the request serves end-to-end.
+                if h.stage != "decode":
+                    h.stage = "prefill" if rep.role == "prefill" else None
+                h.cost = stage_cost(len(h.prompt), h.max_new_tokens,
+                                    h.stage)
+            # assign BEFORE submit: the worker thread may pick up,
+            # serve, and finish the request before this thread runs
+            # again — a client reading h.replica after result() must
+            # never see the previous dispatch's name
+            h.replica = rep.name
             if rep.submit(h):
                 break
             # the replica closed between healthy() and submit (a drain/
@@ -483,7 +622,6 @@ class Router:
         if self.policy == "affinity":
             # future same-prefix requests chase these pages here
             rep.affinity_add(prefix_page_keys(h.prompt, self.page))
-        h.replica = rep.name
         h.span.set_label(replica=rep.name)
         h.span.event("routed", replica=rep.name,
                      reason=reason_label or reason)
@@ -502,10 +640,42 @@ class Router:
         self._m_done.inc(status=status, **tl)
         h._finish(status, ts)
 
+    def _handoff(self, h: RequestHandle, rep: Replica):
+        """Prefill stage finished: export the request's KV page span
+        from the prefill replica and re-dispatch to the decode fleet.
+        An export miss (pages already evicted, or the first token never
+        recorded) dispatches WITHOUT a span — the decode side prefills
+        from scratch, correct but unaccelerated — and is counted under
+        serving.handoff.fallbacks{reason=export_miss}."""
+        h._handoff_t0 = time.perf_counter()
+        span = None
+        try:
+            span = rep.predictor.export_request_span(h.prompt)
+        except Exception as e:
+            h.span.event("handoff_export_failed",
+                         error=f"{type(e).__name__}: {e}")
+        if span is None:
+            self._m_handoff_fb.inc(reason="export_miss",
+                                   replica=rep.name)
+        h.handoff_span = span
+        h.stage = "decode"
+        self._m_handoff.inc(replica=rep.name,
+                            **({"tier": h.tier} if h.tier else {}))
+        h.span.event("handoff", from_replica=rep.name,
+                     bytes=(span.nbytes if span is not None else 0),
+                     pages=(span.n_pages if span is not None else 0))
+        self._dispatch(h, reason_label="handoff")
+
     def _readmit(self, h: RequestHandle, failed: Replica, why: str):
         """Re-admit a request its replica failed — exactly once. A
         second failure fails the request for real (the client retries
-        above us; endless internal bouncing would hide a sick pool)."""
+        above us; endless internal bouncing would hide a sick pool).
+
+        A request that dies AFTER handoff keeps ``stage == "decode"``
+        and its exported span, so it re-dispatches to the decode role
+        (never back to prefill) and replays the span import on the new
+        replica — already-delivered tokens dedup via the handle's
+        ordinal guard."""
         if h.attempts >= self.max_readmissions:
             self._m_done.inc(status=why,
                              **({"tier": h.tier} if h.tier else {}))
@@ -527,26 +697,34 @@ class Router:
             self._readmit(h, rep, "replica_ejected")
 
     # ------------------------------------------------------ pool control --
-    def add_replica(self, predictor, name: Optional[str] = None
-                    ) -> Replica:
+    def add_replica(self, predictor, name: Optional[str] = None,
+                    role: Optional[str] = None) -> Replica:
         """Scale out: add one ready predictor as a live replica. The
         new worker starts serving immediately; routing sees it on the
-        next healthy() pass."""
+        next healthy() pass. `role` scopes it to one disaggregated
+        fleet (defaults to the predictor's own role)."""
         with self._lock:
             nm = name or predictor.name or f"replica{len(self.replicas)}"
-            rep = Replica(self, nm, predictor)
+            rep = Replica(self, nm, predictor, role=role)
             self.replicas.append(rep)
-        self._m_pool.inc(direction="up")
+        self._m_pool.inc(direction="up",
+                         **({"role": rep.role}
+                            if rep.role != "unified" else {}))
         return rep
 
-    def drain_replica(self, name: Optional[str] = None
-                      ) -> Optional[Replica]:
+    def drain_replica(self, name: Optional[str] = None,
+                      role: Optional[str] = None) -> Optional[Replica]:
         """Scale in: close one replica's intake (the least-loaded
-        healthy one, or `name`), re-route its not-yet-dispatched inbox,
-        and return the parked Replica — `revive()` brings it back with
-        its predictor (and compiled programs) warm. Refuses to drain
-        the last healthy replica."""
+        healthy one, or `name`, optionally scoped to one `role`),
+        re-route its not-yet-dispatched inbox, and return the parked
+        Replica — `revive()` brings it back with its predictor (and
+        compiled programs) warm. Refuses to drain the last healthy
+        replica — and, in a disaggregated pool, the last healthy
+        replica of the victim's role (a fleet must never scale to
+        zero while the other stage still feeds it)."""
         healthy = self.healthy()
+        if role is not None:
+            healthy = [r for r in healthy if r.role == role]
         if len(healthy) <= 1:
             return None
         if name is not None:
@@ -556,6 +734,9 @@ class Router:
             rep = cands[0]
         else:
             rep = min(healthy, key=lambda r: r.load)
+        if self.disaggregated and sum(
+                1 for r in self.healthy() if r.role == rep.role) <= 1:
+            return None
         leftovers = rep.drain()
         self._m_pool.inc(direction="down")
         for h in leftovers:
@@ -616,7 +797,7 @@ class Router:
                      served=rep.served, ejected=rep.ejected,
                      consecutive_failures=rep.consecutive_failures,
                      last_failure=rep.last_failure,
-                     affinity_keys=len(rep.affinity))
+                     affinity_keys=len(rep.affinity), role=rep.role)
             out[rep.name] = s
         return out
 

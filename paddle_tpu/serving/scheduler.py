@@ -35,9 +35,31 @@ from __future__ import annotations
 import collections
 from typing import Dict, List, Optional
 
-__all__ = ["FifoQueue", "WeightedFairScheduler", "DEFAULT_TIER"]
+__all__ = ["FifoQueue", "WeightedFairScheduler", "DEFAULT_TIER",
+           "stage_cost"]
 
 DEFAULT_TIER = "default"
+
+
+def stage_cost(prompt_len: int, max_new: int, stage: Optional[str]
+               ) -> float:
+    """THE load/cost estimate for one request at one dispatch stage —
+    the single place router load accounting and queue-discipline costs
+    agree on what a request weighs. A unified dispatch (`stage` None)
+    keeps the historical ``prompt_len + max_new`` estimate; under
+    disaggregated two-stage dispatch (docs/SERVING.md) the prefill
+    stage carries the prompt ingest plus its single first token, and
+    the decode stage carries only the remaining token budget plus one
+    page-order term for the imported span it attends over."""
+    if stage == "prefill":
+        return float(prompt_len + 1)
+    if stage == "decode":
+        # the span import is cheap next to decoding, but a decode
+        # replica still pays attention bandwidth over the prompt's
+        # pages every tick — keep a fractional prompt term so a
+        # long-context decode is not booked as free
+        return float(max_new + prompt_len / 8.0)
+    return float(prompt_len + max_new)
 
 
 class FifoQueue:

@@ -70,6 +70,7 @@ CONFIG_DEFAULTS: Dict = {
     "spec_ngram_max": 3,
     "sampling_enabled": False,
     "tp_degree": 1,
+    "serve_role": "unified",
     "max_queue": None,
     "shed_policy": "newest",
     "decode_watchdog_s": 0.0,
@@ -82,6 +83,15 @@ CONFIG_DEFAULTS: Dict = {
 # minimum samples before a distribution-shaped proposal may fire —
 # three requests are an anecdote, not a workload
 MIN_SAMPLES = 8
+
+# Mirror of runtime_config.ROLE_OVERLAYS (parity is structural, not
+# pinned: an overlay key here means "this field is PINNED for that
+# role, so a global proposal for it does not apply there").
+ROLE_OVERLAYS: Dict[str, Dict] = {
+    "unified": {},
+    "prefill": {"spec_draft_tokens": 0, "sampling_enabled": False},
+    "decode": {"prefill_chunk_tokens": 0},
+}
 
 
 def config_hash(d: Dict) -> str:
@@ -140,6 +150,22 @@ class Replay:
                    label_filter.items()):
                 total += v
         return total
+
+    def roles_seen(self) -> List[str]:
+        """Non-unified serve roles present anywhere in the telemetry's
+        label sets — the signal that this was a disaggregated fleet
+        and proposals should split per role."""
+        roles = set()
+        for (_, labels) in list(self.counters) + list(self.hists):
+            for k, v in labels:
+                if k == "role":
+                    roles.add(v)
+        for recs in self.gauges.values():
+            for _, labels, _ in recs:
+                for k, v in labels:
+                    if k == "role":
+                        roles.add(v)
+        return sorted(r for r in roles if r and r != "unified")
 
 
 _GAUGE_HISTORY = {
@@ -675,7 +701,7 @@ def analyze(paths: List[str], base: Optional[Dict] = None,
     tuned = dict(cfg)
     for p in proposals:
         tuned[p["field"]] = p["proposed"]
-    return {
+    report = {
         "kind": "autotune",
         "inputs": [os.path.abspath(p) for p in paths],
         "window_s": rep.window_s(),
@@ -686,6 +712,33 @@ def analyze(paths: List[str], base: Optional[Dict] = None,
         "runtime_config": tuned,
         "runtime_config_hash": config_hash(tuned),
     }
+    # disaggregated telemetry: split the output per role. Each
+    # proposal is tagged with the roles it applies to (a field an
+    # overlay PINS for a role — e.g. prefill_chunk_tokens on decode —
+    # is not up for tuning there), and the report grows one tuned
+    # config per observed role (overlay applied on top of the global
+    # tuned config) so each fleet's EngineBuilder gets its own
+    # role-stamped, independently hashed payload.
+    roles = rep.roles_seen()
+    if roles:
+        all_roles = ["unified"] + roles
+        for p in proposals:
+            p["roles"] = [r for r in all_roles
+                          if p["field"] not in ROLE_OVERLAYS.get(r, {})]
+        role_configs = {}
+        for role in roles:
+            rc_d = dict(tuned)
+            rc_d.update(ROLE_OVERLAYS.get(role, {}))
+            rc_d["serve_role"] = role
+            role_configs[role] = {
+                "runtime_config": rc_d,
+                "runtime_config_hash": config_hash(rc_d),
+                "handoffs": int(rep.counter_total(
+                    "serving.handoff.requests")),
+            }
+        report["roles"] = roles
+        report["role_configs"] = role_configs
+    return report
 
 
 def render(report: dict) -> str:
@@ -696,7 +749,10 @@ def render(report: dict) -> str:
                    "the current config)")
     for p in report["proposals"]:
         ev = p["evidence"]
-        out.append(f"  {p['field']}: {p['current']} -> {p['proposed']}")
+        tag = f" [roles: {','.join(p['roles'])}]" if p.get("roles") \
+            else ""
+        out.append(f"  {p['field']}: {p['current']} -> "
+                   f"{p['proposed']}{tag}")
         out.append(f"      evidence: series={ev.get('series')} "
                    f"n={ev.get('n')} window={ev.get('window_s')}s"
                    + (f" {ev.get('percentile')}="
@@ -705,6 +761,9 @@ def render(report: dict) -> str:
                       and ev.get("value") is not None else ""))
         out.append(f"      why: {p['reason']}")
     out.append(f"  config hash: {report['runtime_config_hash'][:16]}...")
+    for role, rc in sorted((report.get("role_configs") or {}).items()):
+        out.append(f"  role config [{role}]: hash "
+                   f"{rc['runtime_config_hash'][:16]}...")
     return "\n".join(out)
 
 

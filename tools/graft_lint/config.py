@@ -57,9 +57,19 @@ HOT_PATH_FUNCTIONS = (
     # serving front end: router / scheduler / streaming are host-side
     # by design — ANY device sync there stalls every tenant
     ("paddle_tpu/serving/*.py", "*"),
-    # paged KV bookkeeping runs once per decode tick
+    # paged KV bookkeeping runs once per decode tick; the disaggregated
+    # span export/import (PagedKVPool.export_span / import_span) is
+    # covered by the PagedKVPool.* row — its host gather/scatter is the
+    # DESIGNED transport sync and carries explicit sanctions
     ("paddle_tpu/generation/kv_cache.py", "RaggedMetaBuilder.*"),
     ("paddle_tpu/generation/kv_cache.py", "PagedKVPool.*"),
+    # prefill→decode handoff endpoints on the predictor: run on the
+    # replica worker thread between serve-loop ticks — any sync beyond
+    # the span payload itself stalls that replica's decode clock
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor.export_request_span"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor.import_request_span"),
     # eager (dygraph) generation decode loop + seq2seq beam decode
     ("paddle_tpu/generation/__init__.py",
      "GenerationMixin._generate_eager_batch"),
@@ -148,6 +158,7 @@ RUNTIME_CONFIG_KNOBS = frozenset({
     "serve_spec_ngram_max",
     "serve_sampling",
     "serve_tp_degree",
+    "serve_role",
     "grad_bucket_bytes",
     "quantized_grad_comm",
 })

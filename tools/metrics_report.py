@@ -271,10 +271,17 @@ def render(last, spans=None) -> str:
             # gauge appears under both {replica} and {replica,devices}
             # label sets must not double-count its utilization
             devmap = {}
+            # disaggregated fleets: a role-configured replica labels
+            # its predictor-side samples with role=prefill|decode —
+            # collect it the same way so the table says which fleet
+            # each replica belongs to (unified replicas show "-")
+            rolemap = {}
             for (name, labels), _rec in last.items():
                 lab = dict(labels)
                 if lab.get("replica") and lab.get("devices"):
                     devmap.setdefault(lab["replica"], lab["devices"])
+                if lab.get("replica") and lab.get("role"):
+                    rolemap.setdefault(lab["replica"], lab["role"])
 
             def _gauge_for(series, rep):
                 return max((r.get("value", 0.0)
@@ -282,7 +289,7 @@ def render(last, spans=None) -> str:
                             if dict(labels).get("replica") == rep),
                            default=0.0)
 
-            w(f"  {'replica':<12}{'devices':>9}{'routed':>8}"
+            w(f"  {'replica':<12}{'role':<9}{'devices':>9}{'routed':>8}"
               f"{'affinity':>9}{'pfx hits':>9}{'depth':>7}{'load':>8}"
               f"{'util':>7}")
             for rep in sorted(per_rep):
@@ -293,7 +300,8 @@ def render(last, spans=None) -> str:
                 dep = _gauge_for(depth, rep)
                 ld = _gauge_for(load, rep)
                 ut = _gauge_for(util, rep)
-                w(f"  {rep:<12}{devmap.get(rep, '-'):>9}"
+                w(f"  {rep:<12}{rolemap.get(rep, '-'):<9}"
+                  f"{devmap.get(rep, '-'):>9}"
                   f"{d['routed']:>8}{d['affinity']:>9}"
                   f"{n_hits:>9}{int(dep):>7}{ld:>8.0f}"
                   f"{100.0 * ut:>6.1f}%")
@@ -324,6 +332,40 @@ def render(last, spans=None) -> str:
                   f"{tt.get('p99', 0) * 1e3:>8.1f}ms"
                   f"{ee.get('p99', 0) * 1e3:>8.1f}ms")
 
+    # --- disaggregated prefill/decode handoff -------------------------
+    # each counter inc lands in exactly one (replica, tier) series, so
+    # summing the series is double-count-free; latency histograms stay
+    # per-replica (quantiles across series cannot be merged exactly)
+    ho = _series(last, "serving.handoff.requests")
+    if ho:
+        w("== disaggregated handoff ==")
+        n_ho = sum(int(r.get("value", 0)) for r in ho.values())
+        hb = _series(last, "serving.handoff.bytes")
+        n_bytes = sum(r.get("value", 0) for r in hb.values())
+        pg = _series(last, "serving.handoff.pages")
+        imported = sum(int(r.get("value", 0)) for lb, r in pg.items()
+                       if dict(lb).get("kind") == "imported")
+        reused = sum(int(r.get("value", 0)) for lb, r in pg.items()
+                     if dict(lb).get("kind") == "reused")
+        w(f"  requests        {n_ho}   bytes {_fmt_bytes(n_bytes)}"
+          f"   pages imported {imported} / reused {reused}")
+        sec = _series(last, "serving.handoff.seconds")
+        for labels, rec in sorted(sec.items()):
+            if not rec.get("count"):
+                continue
+            rep = dict(labels).get("replica", "?")
+            w(f"  latency[{rep}]   p50 {rec.get('p50', 0) * 1e3:.1f}ms"
+              f"   p99 {rec.get('p99', 0) * 1e3:.1f}ms"
+              f"   n={rec['count']}")
+        fb = _series(last, "serving.handoff.fallbacks")
+        if fb:
+            by = {}
+            for labels, rec in fb.items():
+                rs = dict(labels).get("reason", "?")
+                by[rs] = by.get(rs, 0) + int(rec.get("value", 0))
+            w("  fallbacks       " + "  ".join(
+                f"{k}={v}" for k, v in sorted(by.items())))
+
     asc = {k: rec for k, rec in last.items()
            if k[0].startswith("serving.autoscale.")}
     if asc:
@@ -345,6 +387,30 @@ def render(last, spans=None) -> str:
                 f"{dict(lb).get('replica', '?')}="
                 f"{100.0 * r.get('value', 0):.1f}%"
                 for lb, r in sorted(pp.items())))
+        # role-scoped signals (disaggregated fleets): one row per role
+        # so the PoolController's independent scaling is legible
+        r_des = _series(last, "serving.autoscale.role_desired")
+        if r_des:
+            r_heal = _series(last, "serving.autoscale.role_healthy")
+            r_q = _series(last, "serving.autoscale.role_queue_depth")
+            r_u = _series(last, "serving.autoscale.role_utilization")
+            r_p = _series(last, "serving.autoscale.role_page_pressure")
+
+            def _role_val(series, role):
+                return next((r.get("value", 0.0)
+                             for lb, r in series.items()
+                             if dict(lb).get("role") == role), 0.0)
+
+            w(f"  {'role':<12}{'healthy':>8}{'desired':>8}"
+              f"{'queue':>7}{'util':>7}{'pages':>7}")
+            for role in sorted(dict(lb).get("role", "?")
+                               for lb in r_des):
+                w(f"  {role:<12}"
+                  f"{int(_role_val(r_heal, role)):>8}"
+                  f"{int(_role_val(r_des, role)):>8}"
+                  f"{int(_role_val(r_q, role)):>7}"
+                  f"{100.0 * _role_val(r_u, role):>6.1f}%"
+                  f"{100.0 * _role_val(r_p, role):>6.1f}%")
 
     # recovery SLOs: gauges, not counters — formatted as measurements
     _SLO = ("robustness.mttr_seconds", "robustness.goodput",
@@ -395,7 +461,10 @@ def render(last, spans=None) -> str:
              "serving.router.replica_load", "serving.router.ttft_seconds",
              "serving.router.e2e_seconds", "serving.tier.queue_depth",
              "serving.tier.admissions", "serving.tier.shed_requests",
-             "serving.cancelled_requests", "serving.in_flight"}
+             "serving.cancelled_requests", "serving.in_flight",
+             "serving.handoff.requests", "serving.handoff.seconds",
+             "serving.handoff.bytes", "serving.handoff.pages",
+             "serving.handoff.fallbacks"}
     known_prefixes = ("robustness.", "serving.autoscale.")
     rest = sorted(k for k in last if k[0] not in known
                   and not k[0].startswith(known_prefixes))

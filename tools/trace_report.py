@@ -345,6 +345,9 @@ class Request:
         self.prompt_len = labels.get("prompt_len")
         self.tier = labels.get("tier")
         self.replica = labels.get("replica")
+        # disaggregated fleets: role-configured replicas label their
+        # serve.request spans role=prefill|decode (unified: absent)
+        self.role = labels.get("role")
         # tensor-parallel replicas carry the device GROUP they occupy
         # ("0-1" / "0,2"); per-replica views render it so a 2-device
         # replica reads as one row spanning two chips, not one chip
@@ -387,6 +390,41 @@ class Request:
         return [b - a for a, b in zip(ts, ts[1:])]
 
 
+def _handoffs(spans: List[dict]) -> List[dict]:
+    """Prefill→decode handoffs decoded from router.request spans: one
+    entry per `handoff` event (a readmit REPLAYS the import but never
+    re-hands-off, so counting handoff events is double-count-free),
+    with the export→pages-resident latency taken from the FIRST
+    handoff_imported event and any export/import failures kept as
+    fallback reasons."""
+    out = []
+    for s in spans:
+        if s.get("name") != "router.request":
+            continue
+        evs = s.get("events") or []
+        ho = next((e for e in evs if e.get("name") == "handoff"), None)
+        if ho is None:
+            continue
+        imp = next((e for e in evs
+                    if e.get("name") == "handoff_imported"), None)
+        reasons = [e.get("reason", "export_miss") for e in evs
+                   if e.get("name") in ("handoff_import_failed",
+                                        "handoff_export_failed")]
+        out.append({
+            "request": (s.get("labels") or {}).get("request_id", "?"),
+            "from": ho.get("from_replica", "?"),
+            "bytes": int(ho.get("bytes") or 0),
+            "pages": int(ho.get("pages") or 0),
+            "imported": int(imp.get("imported") or 0) if imp else 0,
+            "reused": int(imp.get("reused") or 0) if imp else 0,
+            "latency": (imp["ts"] - ho["ts"]) if imp else None,
+            "fallbacks": reasons,
+            "readmitted": any(e.get("name") == "readmitted"
+                              for e in evs),
+        })
+    return out
+
+
 def analyze(spans: List[dict]) -> dict:
     reqs = [Request(s) for s in spans if s.get("name") == "serve.request"]
     steps = [s for s in spans if s.get("name") == "train.step"]
@@ -400,7 +438,7 @@ def analyze(spans: List[dict]) -> dict:
         sites.setdefault(s.get("name", "?"), []).append(
             float(s.get("dur") or 0.0))
     return {"requests": reqs, "steps": steps, "children": by_parent,
-            "sites": sites}
+            "sites": sites, "handoffs": _handoffs(spans)}
 
 
 # --------------------------------------------------------------- rendering --
@@ -478,8 +516,8 @@ def render(spans: List[dict], top_requests: int = 5,
     replicas = sorted({r.replica for r in reqs if r.replica is not None})
     if replicas:
         w("== per-replica ==")
-        w(f"  {'replica':<12}{'devices':>9}{'requests':>9}{'tokens':>8}"
-          f"{'busy ms':>10}{'ttft p99':>11}{'e2e p99':>11}")
+        w(f"  {'replica':<12}{'role':<9}{'devices':>9}{'requests':>9}"
+          f"{'tokens':>8}{'busy ms':>10}{'ttft p99':>11}{'e2e p99':>11}")
         for rep in replicas:
             sub = [r for r in reqs if r.replica == rep]
             toks = sum(r.tokens or 0 for r in sub)
@@ -487,10 +525,33 @@ def render(spans: List[dict], top_requests: int = 5,
             r_ttft = [r.ttft for r in sub if r.ttft is not None]
             devs = next((r.devices for r in sub
                          if r.devices is not None), "-")
-            w(f"  {rep:<12}{devs:>9}{len(sub):>9}{toks:>8}"
+            role = next((r.role for r in sub if r.role is not None), "-")
+            w(f"  {rep:<12}{role:<9}{devs:>9}{len(sub):>9}{toks:>8}"
               f"{busy * 1e3:>10.1f}"
               f"{percentile(r_ttft, 0.99) * 1e3:>9.2f}ms"
               f"{percentile([r.e2e for r in sub], 0.99) * 1e3:>9.2f}ms")
+
+    # ---- disaggregated handoffs (router.request spans) --------------
+    hos = a["handoffs"]
+    if hos:
+        w("== disaggregated handoff ==")
+        n_bytes = sum(h["bytes"] for h in hos)
+        imported = sum(h["imported"] for h in hos)
+        reused = sum(h["reused"] for h in hos)
+        w(f"  handoffs        {len(hos)}"
+          f"   bytes {n_bytes}   pages imported {imported}"
+          f" / reused {reused}"
+          f"   readmitted {sum(1 for h in hos if h['readmitted'])}")
+        lat = [h["latency"] for h in hos if h["latency"] is not None]
+        if lat:
+            w(_pct_row("handoff latency", lat))
+        by_reason: Dict[str, int] = {}
+        for h in hos:
+            for rs in h["fallbacks"]:
+                by_reason[rs] = by_reason.get(rs, 0) + 1
+        if by_reason:
+            w("  fallbacks       " + "  ".join(
+                f"{k}={v}" for k, v in sorted(by_reason.items())))
 
     # ---- request outcomes + slowest table --------------------------
     if reqs:

@@ -3507,6 +3507,269 @@ def _chaos_hang_scenario(hang_timeout_s, max_steps=8, hang_step=5):
     return checks, details
 
 
+def _chaos_straggler_scenario(mttr_budget, total_steps=12, step_s=1.0,
+                              slow_rank=2, factor=8.0):
+    """Straggler-mitigation arm of the chaos bench: a PERSISTENT slow
+    rank (rank_slow fault, armed every epoch — a degraded host does not
+    heal on restart) through the REAL launcher, twice:
+
+    - toleration arm (``--mitigation off``): the job limps to the slow
+      rank's pace — the fleet detector logs the straggler but nothing
+      acts;
+    - mitigation arm (``--mitigation exclude``): the detector's
+      incident drives the MitigationController, the slow rank is
+      SIGKILLed, and the pod elastically restarts WITHOUT it; the
+      survivors pick up its share of the fixed step budget
+      (``my_steps = total / WORLD_SIZE``) and resume from their own
+      verified checkpoints.
+
+    Goodput per arm = useful-step-seconds / (provisioned_slots x
+    stepping wall), stepping wall measured first-step-start to
+    last-step-end across epochs from the per-rank result files — worker
+    boot is excluded, but the mitigation arm's restart gap (its real
+    MTTR cost) is inside the window. The assertion is strict:
+    mitigation must BEAT toleration on goodput, not just match it."""
+    import glob as _glob
+    import tempfile
+    import textwrap
+    import paddle_tpu.observability as obs
+    from paddle_tpu.distributed.launch.main import parse_args, launch
+
+    base = tempfile.mkdtemp(prefix="chaos_straggler_")
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(base, "worker.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(f"""
+            import json, os, time
+            hb_path = os.environ.get("PADDLE_RANK_HEARTBEAT")
+
+            def boot_beat(phase):
+                # raw early beats: progress signal before paddle_tpu's
+                # RankHeartbeat is importable (the recovery window must
+                # close on first observable progress, which is boot)
+                if hb_path:
+                    with open(hb_path, "a") as f:
+                        f.write(json.dumps(
+                            {{"ts": time.time(), "kind": "heartbeat",
+                              "phase": phase, "pid": os.getpid(),
+                              "rank": os.environ.get("RANK", "0")}})
+                            + chr(10))
+
+            boot_beat("boot")
+            import sys
+            sys.path.insert(0, {repo_root!r})   # the script runs from
+            import jax                          # a temp dir
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import paddle_tpu as paddle
+            import paddle_tpu.nn.functional as F
+            from paddle_tpu import nn
+            from paddle_tpu.trainer import Trainer, TrainingArguments
+            boot_beat("imports_done")
+            rank = int(os.environ.get("RANK", "0"))
+            world = int(os.environ.get("WORLD_SIZE", "1"))
+            epoch = int(os.environ.get("PADDLE_RESTART_EPOCH", "0"))
+            # persistent hardware fault: rank {slow_rank}'s host pays
+            # (factor-1)x its own measured step work, EVERY epoch
+            paddle.set_flags({{"fault_injection":
+                "rank_slow:times=0:rank={slow_rank}:factor={factor}"}})
+            # work redistribution: the JOB's step budget is fixed; each
+            # live rank takes an equal share, so the shrunk
+            # post-exclusion world does more steps per survivor
+            my_steps = {total_steps} // world
+            paddle.seed(rank)
+            model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(),
+                                  nn.Linear(32, 4))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=model.parameters())
+            boot_beat("model_built")
+
+            def data_fn(start):
+                def gen():
+                    s = start
+                    while True:
+                        time.sleep({step_s})   # the step's base work
+                        rs = np.random.RandomState(s)
+                        yield (paddle.to_tensor(
+                                   rs.randn(16, 8).astype(np.float32)),
+                               paddle.to_tensor(
+                                   rs.randn(16, 4).astype(np.float32)))
+                        s += 1
+                return gen()
+
+            out_dir = os.path.join({base!r},
+                                   "arm_" + os.environ["CHAOS_ARM"],
+                                   "rank%d" % rank)
+            args = TrainingArguments(output_dir=out_dir,
+                                     max_steps=my_steps,
+                                     logging_steps=1, save_steps=1)
+            t0 = time.time()
+            res = Trainer(model, opt, lambda o, y: F.mse_loss(o, y),
+                          args, data_fn, tokens_per_batch=16
+                          ).train(resume=True)
+            with open(os.path.join(out_dir,
+                                   "result_e%d.json" % epoch), "w") as f:
+                json.dump({{"rank": rank, "world": world,
+                           "start_step": res["start_step"],
+                           "final_step": res["final_step"],
+                           "t_start": t0, "t_end": time.time()}}, f)
+        """))
+
+    def run_arm(name, mitigation):
+        os.environ["CHAOS_ARM"] = name
+        log_dir = os.path.join(base, f"log_{name}")
+        argv = ["--nproc_per_node", "3", "--max_restart", "2",
+                "--heartbeat_interval", "0.25",
+                "--restart_backoff", "0.05",
+                "--straggler_factor", "2.0", "--straggler_steps", "2",
+                "--log_dir", log_dir]
+        if mitigation:
+            argv += ["--mitigation", "exclude",
+                     "--mitigation_cooldown", "5"]
+        argv.append(script)
+        t0 = time.time()
+        rc = launch(parse_args(argv))
+        wall = time.time() - t0
+        results = []
+        for p in sorted(_glob.glob(os.path.join(
+                base, f"arm_{name}", "rank*", "result_e*.json"))):
+            with open(p) as rf:
+                results.append(json.load(rf))
+        # useful steps retained by the job: each surviving rank's
+        # furthest step (the excluded rank's partial work is discarded
+        # with it — that loss is priced into the goodput, not hidden)
+        per_rank = {}
+        for r in results:
+            per_rank[r["rank"]] = max(per_rank.get(r["rank"], 0),
+                                      r["final_step"])
+        useful = sum(per_rank.values())
+        if results:
+            stepping = max(r["t_end"] for r in results) \
+                - min(r["t_start"] for r in results)
+        else:
+            stepping = float("inf")
+        goodput = (useful * step_s) / (3 * max(stepping, 1e-6))
+        return {"rc": rc, "wall_s": round(wall, 2),
+                "stepping_wall_s": round(stepping, 3),
+                "useful_steps": useful,
+                "goodput": round(goodput, 4),
+                "worlds": sorted({r["world"] for r in results}),
+                "log_dir": log_dir, "results": results}
+
+    tol = run_arm("toleration", mitigation=False)
+    mit = run_arm("mitigation", mitigation=True)
+    os.environ.pop("CHAOS_ARM", None)
+
+    reg = obs.get_registry()
+
+    def ctr(name):
+        m = reg.get(name)
+        return sum(s.value for s in m.samples()) if m else 0.0
+
+    # the audit stream: every controller decision (including holds) as
+    # {"kind": "control"} records with contiguous seq — the incident is
+    # replayable by `tools/trace_report.py --recovery --dir <log_dir>`
+    audit = []
+    control_path = os.path.join(mit["log_dir"], "control.jsonl")
+    if os.path.exists(control_path):
+        with open(control_path) as f:
+            for line in f:
+                try:
+                    audit.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    seqs = [r.get("seq") for r in audit]
+    actions = [r.get("action") for r in audit]
+    mttr = _gauge_last(reg, "robustness.mttr_seconds")
+
+    obs.gauge("robustness.goodput").set(tol["goodput"], arm="toleration")
+    obs.gauge("robustness.goodput").set(mit["goodput"], arm="mitigation")
+
+    checks = {
+        "straggler_rc0": tol["rc"] == 0 and mit["rc"] == 0,
+        "straggler_detected":
+            ctr("robustness.stragglers_detected") >= 1,
+        # the exclusion actually happened: an exclude_restart audit
+        # record AND a post-restart result written under a shrunk world
+        "straggler_excluded": "exclude_restart" in actions
+        and any(r["world"] == 2 and r["start_step"] > 0
+                for r in mit["results"]),
+        "straggler_work_conserved":
+            tol["useful_steps"] == total_steps
+            and mit["useful_steps"] == total_steps,
+        "straggler_goodput_gain": mit["goodput"] > tol["goodput"],
+        "straggler_mttr_under_budget": mttr is not None
+        and mttr < mttr_budget,
+        "straggler_audit_contiguous": len(audit) >= 2
+        and seqs == list(range(1, len(seqs) + 1))
+        and all(r.get("kind") == "control" for r in audit),
+    }
+    details = {"toleration": {k: v for k, v in tol.items()
+                              if k != "results"},
+               "mitigation": {k: v for k, v in mit.items()
+                              if k != "results"},
+               "mttr_s": round(mttr, 3) if mttr is not None else None,
+               "audit_actions": actions, "control_jsonl": control_path,
+               "output_dir": base, "factor": factor,
+               "step_s": step_s, "total_steps": total_steps}
+    return checks, details
+
+
+def _mitigation_smoke_scenario():
+    """Tier-1-safe variant of the straggler scenario: the SAME
+    MitigationController the launcher wires, driven as a pure state
+    machine on a fake clock — no subprocesses, no sleeps, sub-second.
+    Covers the decision sequence the full arm proves end-to-end:
+    persistent skew -> exclude_restart, cooldown hold, audit stream
+    contiguity."""
+    from paddle_tpu.distributed.launch.mitigate import \
+        MitigationController
+    import paddle_tpu.observability as obs
+
+    clock = {"t": 1000.0}
+    audit = []
+    mit = MitigationController(
+        world_size=3, mode="exclude", cooldown_s=30.0,
+        flap_window_s=10.0, now_fn=lambda: clock["t"],
+        emit=audit.append)
+
+    def incident(rank, dur, med, step):
+        return {"rank": str(rank), "step": step, "dur_s": dur,
+                "median_s": med, "ratio": dur / med, "consecutive": 2,
+                "dominant_span": "train.straggle"}
+
+    # cost model: a few joined fleet steps with rank 2 inflated
+    for step in range(1, 4):
+        mit.note_step(step, {"0": 1.0, "1": 1.1, "2": 8.0})
+        clock["t"] += 1.0
+    d1 = mit.offer(incident(2, 8.0, 1.0, 3), now=clock["t"])
+    clock["t"] += 1.0
+    # inside the cooldown window: a second incident must HOLD — a
+    # restart's own transient skew cannot trigger a second restart
+    d2 = mit.offer(incident(2, 6.0, 1.0, 4), now=clock["t"])
+    seqs = [r.get("seq") for r in audit]
+    reg = obs.get_registry()
+
+    def ctr(name):
+        m = reg.get(name)
+        return sum(s.value for s in m.samples()) if m else 0.0
+
+    checks = {
+        "smoke_excluded": d1.get("action") == "exclude_restart"
+        and mit.excluded == [2],
+        "smoke_cooldown_held": d2.get("action") == "hold_cooldown",
+        "smoke_audit_contiguous":
+            seqs == list(range(1, len(seqs) + 1))
+            and all(r.get("kind") == "control" for r in audit),
+        "smoke_metrics": ctr("robustness.mitigation.actions") >= 3
+        and _gauge_last(reg,
+                        "robustness.mitigation.excluded_ranks") == 1,
+    }
+    details = {"decisions": [r.get("action") for r in audit],
+               "excluded": list(mit.excluded)}
+    return checks, details
+
+
 def chaos_bench(argv=None):
     """Chaos section: tier-1-safe fault-injection smoke (PR 4 + PR 7).
 
@@ -3528,6 +3791,16 @@ def chaos_bench(argv=None):
     measured `robustness.mttr_seconds` must land in the JSONL sink
     under --mttr-budget.
 
+    Scenario 3 (through the real launcher, twice): a PERSISTENT
+    straggler — the fleet detector's incident must drive the
+    mitigation actuator (exclude-and-elastic-restart), and the
+    mitigation arm must strictly BEAT the no-mitigation control arm on
+    goodput, with the whole decision chain auditable in control.jsonl.
+    `--smoke` swaps it for a clock-driven state-machine drive of the
+    same controller (tier-1-safe: no subprocesses, no sleeps).
+
+    `--scenario {all,trainer,hang,straggler}` runs a subset.
+
     Exit 0 = recovered; 1 = a recovery invariant failed.
     """
     import argparse
@@ -3544,7 +3817,17 @@ def chaos_bench(argv=None):
     ap.add_argument("--mttr-budget", type=float, default=120.0,
                     help="assert detection->restart->progress MTTR "
                          "under this many seconds")
+    ap.add_argument("--scenario", default="all",
+                    choices=("all", "trainer", "hang", "straggler"),
+                    help="run one chaos scenario instead of the suite")
+    ap.add_argument("--smoke", action="store_true",
+                    help="straggler scenario only: drive the mitigation "
+                         "controller clock-only (no subprocesses) — the "
+                         "tier-1 variant of the slow launcher arm")
     a = ap.parse_args(argv)
+    run_trainer = a.scenario in ("all", "trainer")
+    run_hang = a.scenario in ("all", "hang")
+    run_straggler = a.scenario in ("all", "straggler")
 
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
@@ -3565,80 +3848,113 @@ def chaos_bench(argv=None):
     obs.enabled(True)
     obs.get_registry().reset()
     try:
-        # fault 1: the step-2 checkpoint save fails once (transient I/O);
-        # fault 2: step index 3's loss is NaN (one anomalous step);
-        # fault 3: EVERY checkpoint write stalls 0.25s (slow store) —
-        # the async drain must keep that off the train step
-        paddle.set_flags({
-            "fault_injection": "ckpt_save:step=2:err,nan_loss:step=3,"
-                               "ckpt_slow:times=0:sleep=0.25",
-            "ckpt_retry_backoff_s": 0.05, "anomaly_guard": True})
-        paddle.seed(0)
-        model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(),
-                              nn.Linear(32, 4))
-        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
-                                     parameters=model.parameters())
+        checks = {}
+        res = None
+        stall = None
+        hang_details = None
+        straggler_details = None
+        need_evidence = set()
+        if run_trainer:
+            # fault 1: the step-2 checkpoint save fails once (transient
+            # I/O); fault 2: step index 3's loss is NaN (one anomalous
+            # step); fault 3: EVERY checkpoint write stalls 0.25s (slow
+            # store) — the async drain must keep that off the train step
+            paddle.set_flags({
+                "fault_injection": "ckpt_save:step=2:err,nan_loss:step=3,"
+                                   "ckpt_slow:times=0:sleep=0.25",
+                "ckpt_retry_backoff_s": 0.05, "anomaly_guard": True})
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(),
+                                  nn.Linear(32, 4))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=model.parameters())
 
-        def data_fn(start):
-            def gen():
-                s = start
-                while True:
-                    rs = np.random.RandomState(s)
-                    yield (paddle.to_tensor(
-                               rs.randn(16, 8).astype(np.float32)),
-                           paddle.to_tensor(
-                               rs.randn(16, 4).astype(np.float32)))
-                    s += 1
-            return gen()
+            def data_fn(start):
+                def gen():
+                    s = start
+                    while True:
+                        rs = np.random.RandomState(s)
+                        yield (paddle.to_tensor(
+                                   rs.randn(16, 8).astype(np.float32)),
+                               paddle.to_tensor(
+                                   rs.randn(16, 4).astype(np.float32)))
+                        s += 1
+                return gen()
 
-        args = TrainingArguments(output_dir=out_dir, max_steps=steps,
-                                 logging_steps=1, save_steps=2)
-        res = Trainer(model, opt, lambda o, y: F.mse_loss(o, y), args,
-                      data_fn, tokens_per_batch=16).train(resume=False)
+            args = TrainingArguments(output_dir=out_dir, max_steps=steps,
+                                     logging_steps=1, save_steps=2)
+            res = Trainer(model, opt, lambda o, y: F.mse_loss(o, y), args,
+                          data_fn, tokens_per_batch=16).train(resume=False)
 
-        reg = obs.get_registry()
+            reg = obs.get_registry()
 
-        def ctr(name):
-            m = reg.get(name)
-            return sum(s.value for s in m.samples()) if m else 0.0
+            def ctr(name):
+                m = reg.get(name)
+                return sum(s.value for s in m.samples()) if m else 0.0
 
-        ckpt = VerifiedCheckpointer(os.path.join(out_dir, "checkpoints"))
-        latest = ckpt.latest_verified()
-        restored = ckpt.restore_latest()
-        last_save = (steps // 2) * 2  # newest save_steps=2 boundary
+            ckpt = VerifiedCheckpointer(os.path.join(out_dir,
+                                                     "checkpoints"))
+            latest = ckpt.latest_verified()
+            restored = ckpt.restore_latest()
+            last_save = (steps // 2) * 2  # newest save_steps=2 boundary
 
-        stall = _gauge_last(reg, "robustness.ckpt_stall_seconds")
-        checks = {
-            "completed": res["final_step"] == steps,
-            "loss_finite": bool(math.isfinite(res["final_loss"])),
-            "ckpt_retried": ctr("robustness.ckpt_retries") >= 1,
-            "nan_skipped": ctr("robustness.anomalies_skipped") >= 1,
-            "anomaly_counted": res["anomalous_steps"] >= 1,
-            "latest_verifies": latest == last_save,
-            "restorable": restored is not None
-            and int(np.asarray(restored[1]["step"])) == last_save,
-            # every write stalled 0.25s, but the step boundary paid only
-            # the device->host snapshot: async save is non-blocking
-            "async_save_nonblocking": stall is not None and stall < 0.1,
-        }
+            stall = _gauge_last(reg, "robustness.ckpt_stall_seconds")
+            checks.update({
+                "completed": res["final_step"] == steps,
+                "loss_finite": bool(math.isfinite(res["final_loss"])),
+                "ckpt_retried": ctr("robustness.ckpt_retries") >= 1,
+                "nan_skipped": ctr("robustness.anomalies_skipped") >= 1,
+                "anomaly_counted": res["anomalous_steps"] >= 1,
+                "latest_verifies": latest == last_save,
+                "restorable": restored is not None
+                and int(np.asarray(restored[1]["step"])) == last_save,
+                # every write stalled 0.25s, but the step boundary paid
+                # only the device->host snapshot: async save is
+                # non-blocking
+                "async_save_nonblocking": stall is not None
+                and stall < 0.1,
+            })
+            need_evidence |= {"robustness.ckpt_retries",
+                              "robustness.anomalies_skipped"}
 
         # ---- scenario 2: mid-run hang through the real launcher ------
-        paddle.set_flags({"fault_injection": ""})
-        hang_checks, hang_details = _chaos_hang_scenario(a.hang_timeout,
-                                                         max_steps=8)
-        checks.update(hang_checks)
-        mttr = hang_details["mttr_s"]
-        checks["mttr_under_budget"] = (mttr is not None
-                                       and mttr < a.mttr_budget)
+        if run_hang:
+            paddle.set_flags({"fault_injection": ""})
+            hang_checks, hang_details = _chaos_hang_scenario(
+                a.hang_timeout, max_steps=8)
+            checks.update(hang_checks)
+            mttr = hang_details["mttr_s"]
+            checks["mttr_under_budget"] = (mttr is not None
+                                           and mttr < a.mttr_budget)
+            need_evidence |= {"robustness.hangs_detected",
+                              "robustness.mttr_seconds",
+                              "robustness.goodput"}
+
+        # ---- scenario 3: persistent straggler vs the mitigation ------
+        if run_straggler:
+            paddle.set_flags({"fault_injection": ""})
+            if a.smoke:
+                strag_checks, straggler_details = \
+                    _mitigation_smoke_scenario()
+            else:
+                strag_checks, straggler_details = \
+                    _chaos_straggler_scenario(a.mttr_budget)
+                need_evidence |= {"robustness.stragglers_detected",
+                                  "robustness.mttr_seconds",
+                                  "robustness.goodput"}
+            checks.update(strag_checks)
+            need_evidence.add("robustness.mitigation.actions")
         ok = all(checks.values())
 
         with obs.JsonlExporter(path) as sink:
             sink.write_record({"kind": "chaos_bench", "ts": time.time(),
                                "recovered": ok, "checks": checks,
                                "steps": steps,
-                               "final_loss": res["final_loss"],
+                               "final_loss": res["final_loss"]
+                               if res else None,
                                "ckpt_stall_s": stall,
-                               "hang": hang_details})
+                               "hang": hang_details,
+                               "straggler": straggler_details})
             sink.export()  # robustness.* counters flow through the sink
         # the recovery evidence must be readable back out of the sink
         sunk = set()
@@ -3651,11 +3967,7 @@ def chaos_bench(argv=None):
                 if str(rec.get("name", "")).startswith("robustness.") \
                         and rec.get("value", 0) > 0:
                     sunk.add(rec["name"])
-        checks["sink_has_evidence"] = {"robustness.ckpt_retries",
-                                       "robustness.anomalies_skipped",
-                                       "robustness.hangs_detected",
-                                       "robustness.mttr_seconds",
-                                       "robustness.goodput"} <= sunk
+        checks["sink_has_evidence"] = need_evidence <= sunk
         ok = ok and checks["sink_has_evidence"]
     finally:
         paddle.set_flags({"fault_injection": prev["fault_injection"],

@@ -17,6 +17,7 @@ jax.distributed.initialize (the coordination service).
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -31,6 +32,15 @@ from ..ops.creation import _coerce
 from ..framework import faults as _faults
 from ..observability import metrics as _obsm
 from ..observability import tracing as _obstr
+
+
+def _env_rank() -> int:
+    """This process's global rank under the launcher (0 standalone)."""
+    try:
+        return int(os.environ.get(
+            "RANK", os.environ.get("PADDLE_TRAINER_ID", "0")))
+    except ValueError:
+        return 0
 
 
 class CollectiveTimeoutError(RuntimeError):
@@ -66,8 +76,26 @@ def sync_with_deadline(value, timeout_s: Optional[float] = None,
     # spans, so ad-hoc host syncs stay span-spam-free
     wait_sp = _obstr.span("comm.wait", site=what) \
         if _obstr.current_span() is not None else _obstr.NULL_SPAN
+    # comm_degraded: inflated per-byte collective latency on ONE rank
+    # (rank=K, per_mb=S seconds per MiB of payload; plus/or a fixed
+    # sleep=S floor). The extra wait is paid INSIDE the comm.wait span,
+    # so fleet-side it presents exactly as a degraded interconnect
+    # does: comm-wait skew on the afflicted rank, not step-time skew —
+    # the signal the mitigation controller classifies as comm_degraded
+    # (docs/ROBUSTNESS.md "Mitigation").
+    degraded_s = 0.0
+    fa = _faults.check("comm_degraded")
+    if fa is not None:
+        target = fa.params.get("rank")
+        if target is None or int(target) == _env_rank():
+            nbytes = float(getattr(arr, "nbytes", 0) or 0)
+            degraded_s = float(fa.params.get("per_mb", 0.001)) \
+                * (nbytes / 2.0 ** 20) \
+                + float(fa.params.get("sleep", 0.0))
     if timeout_s <= 0:
         with wait_sp:
+            if degraded_s > 0:
+                time.sleep(degraded_s)
             if block is not None:
                 block()
         return value
@@ -75,6 +103,12 @@ def sync_with_deadline(value, timeout_s: Optional[float] = None,
     wedged_until = (time.perf_counter()
                     + float(fa.params.get("sleep", 2 * timeout_s))) \
         if fa is not None else 0.0
+    if degraded_s > 0:
+        # degraded interconnect: readiness held false for the inflated
+        # wait (still subject to the deadline — a NIC degraded past the
+        # collective timeout legitimately trips the watchdog)
+        wedged_until = max(wedged_until,
+                           time.perf_counter() + degraded_s)
     deadline = time.perf_counter() + timeout_s
     ready = getattr(arr, "is_ready", lambda: True)
     with wait_sp:

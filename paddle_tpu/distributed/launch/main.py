@@ -39,6 +39,10 @@ class Context:
     topology: Optional[str] = None       # mesh spec stamped on telemetry
     straggler_factor: float = 2.0        # fleet skew detector; <=0 off
     straggler_steps: int = 3             # consecutive slow steps to flag
+    mitigation: str = "off"              # straggler actuator: off|
+    #   exclude|reassign|auto (docs/ROBUSTNESS.md "Mitigation")
+    mitigation_cooldown_s: float = 60.0  # min seconds between actions
+    pipeline_stages: int = 1             # stage count for reassignment
 
     @property
     def world_size(self) -> int:
@@ -121,6 +125,33 @@ def parse_args(argv=None) -> Context:
                         "over-threshold steps before a rank is flagged "
                         "(counted in robustness.stragglers_detected "
                         "and logged with its dominant span)")
+    p.add_argument("--mitigation", type=str, default="off",
+                   choices=("off", "exclude", "reassign", "auto"),
+                   help="straggler MITIGATION actuator: act on the "
+                        "fleet detector's persistent-skew incidents "
+                        "instead of only logging them. 'exclude' "
+                        "kills the slow rank and elastically restarts "
+                        "the pod without it (world shrinks, survivors "
+                        "resume from the last verified checkpoint); "
+                        "'reassign' restarts with a permuted "
+                        "stage->device map so the slow rank hosts the "
+                        "lightest pipeline stage (needs "
+                        "--pipeline_stages > 1); 'auto' prefers "
+                        "exclusion and falls back to reassignment. "
+                        "Every decision — including holds — is an "
+                        "auditable {\"kind\": \"control\"} record in "
+                        "<log_dir>/control.jsonl "
+                        "(docs/ROBUSTNESS.md 'Mitigation')")
+    p.add_argument("--mitigation_cooldown", type=float, default=60.0,
+                   help="minimum seconds between mitigation actions — "
+                        "a restart's own transient skew (cold caches, "
+                        "recompiles) must not trigger a second "
+                        "restart")
+    p.add_argument("--pipeline_stages", type=int, default=1,
+                   help="pipeline stage count the stage-reassignment "
+                        "mitigation permutes over (exported to "
+                        "workers via PADDLE_TPU_STAGE_MAP on a "
+                        "reassign restart)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     a = p.parse_args(argv)
@@ -137,27 +168,48 @@ def parse_args(argv=None) -> Context:
         restart_backoff_max_s=a.restart_backoff_max,
         hang_timeout_s=a.hang_timeout, engine_dir=a.engine_dir,
         topology=a.topology, straggler_factor=a.straggler_factor,
-        straggler_steps=a.straggler_steps)
+        straggler_steps=a.straggler_steps, mitigation=a.mitigation,
+        mitigation_cooldown_s=a.mitigation_cooldown,
+        pipeline_stages=a.pipeline_stages)
 
 
-def restart_delay(restarts: int, base_s: float, cap_s: float) -> float:
+def restart_delay(restarts: int, base_s: float, cap_s: float,
+                  rng=None) -> float:
     """Jittered exponential backoff for restart N (1-based): base * 2^(N-1),
     capped, with +/-50% jitter so a multi-pod job's restarts decorrelate
-    instead of re-stampeding the coordinator in lockstep."""
-    import random
+    instead of re-stampeding the coordinator in lockstep. ``rng`` is an
+    injectable uniform-[0,1) source (tests pin the jitter; the chaos
+    harness runs clock-driven instead of sleeping through it)."""
     if base_s <= 0 or restarts <= 0:
         return 0.0
+    if rng is None:
+        import random
+        rng = random.random
     return min(cap_s, base_s * (2 ** (restarts - 1))) \
-        * (0.5 + random.random())
+        * (0.5 + rng())
 
 
 class PodController:
-    """Spawns and babysits this node's worker processes (one 'pod')."""
+    """Spawns and babysits this node's worker processes (one 'pod').
 
-    def __init__(self, ctx: Context):
+    ``exclude`` names GLOBAL ranks evicted by a mitigation
+    (exclude-and-restart): their slots are simply not spawned. The
+    surviving workers keep their ORIGINAL rank ids — checkpoint
+    directories, telemetry/heartbeat file names, and the fleet join
+    all key on the rank, and renumbering mid-job would orphan every
+    one of them — while ``WORLD_SIZE`` shrinks to the live count and
+    ``PADDLE_TPU_EXCLUDED_RANKS`` names the holes."""
+
+    def __init__(self, ctx: Context, exclude=(), stage_map=None):
         self.ctx = ctx
+        self.exclude = frozenset(int(r) for r in exclude)
+        self.stage_map = list(stage_map) if stage_map else None
         self.procs: List[subprocess.Popen] = []
+        self.local_ranks: List[int] = []   # procs[i] runs local rank
         self.logs = []
+
+    def _live_world(self) -> int:
+        return self.ctx.world_size - len(self.exclude)
 
     def _rank_env(self, local_rank: int, restart_epoch: int) -> dict:
         ctx = self.ctx
@@ -166,8 +218,8 @@ class PodController:
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
             "RANK": str(rank),
-            "PADDLE_TRAINERS_NUM": str(ctx.world_size),
-            "WORLD_SIZE": str(ctx.world_size),
+            "PADDLE_TRAINERS_NUM": str(self._live_world()),
+            "WORLD_SIZE": str(self._live_world()),
             "PADDLE_LOCAL_RANK": str(local_rank),
             "LOCAL_RANK": str(local_rank),
             "PADDLE_JOB_ID": ctx.job_id,
@@ -188,6 +240,15 @@ class PodController:
             # view exists to replace (docs/OBSERVABILITY.md)
             "PADDLE_TPU_TELEMETRY_JSONL": self._telemetry_path(rank),
         })
+        if self.exclude:
+            env["PADDLE_TPU_EXCLUDED_RANKS"] = ",".join(
+                str(r) for r in sorted(self.exclude))
+        if self.stage_map:
+            # reassign_stages mitigation: the permuted stage->device
+            # map every worker's mesh build consumes
+            # (distributed.mesh._apply_stage_map)
+            env["PADDLE_TPU_STAGE_MAP"] = ",".join(
+                str(g) for g in self.stage_map)
         if ctx.topology:
             # stamped onto every telemetry line via rank_identity()
             env["PADDLE_TPU_TOPOLOGY"] = ctx.topology
@@ -211,8 +272,11 @@ class PodController:
     def start(self, restart_epoch: int = 0):
         ctx = self.ctx
         os.makedirs(ctx.log_dir, exist_ok=True)
-        self.procs, self.logs = [], []
+        self.procs, self.local_ranks, self.logs = [], [], []
         for lr in range(ctx.nproc_per_node):
+            rank = ctx.node_rank * ctx.nproc_per_node + lr
+            if rank in self.exclude:
+                continue
             log_path = os.path.join(ctx.log_dir, f"workerlog.{lr}")
             logf = open(log_path, "ab")
             cmd = [sys.executable, "-u", ctx.script] + ctx.script_args
@@ -220,6 +284,7 @@ class PodController:
                                                             restart_epoch),
                                     stdout=logf, stderr=subprocess.STDOUT)
             self.procs.append(proc)
+            self.local_ranks.append(lr)
             self.logs.append(logf)
 
     def poll(self) -> Optional[int]:
@@ -255,7 +320,7 @@ class PodController:
         signature (five TPU bench rounds died undiagnosable without
         this; see BENCH_r0*.json)."""
         out = []
-        for lr, p in enumerate(self.procs):
+        for lr, p in zip(self.local_ranks, self.procs):
             path = os.path.join(self.ctx.log_dir, f"workerlog.{lr}")
             try:
                 log_bytes = os.path.getsize(path)
@@ -284,7 +349,10 @@ class PodController:
         """SIGKILL one wedged worker (SIGTERM would be swallowed by a
         rank stuck inside a native call); poll() then reports the pod
         failed and the normal elastic restart path takes over."""
-        p = self.procs[local_rank]
+        try:
+            p = self.procs[self.local_ranks.index(local_rank)]
+        except ValueError:
+            return  # excluded or never spawned this epoch
         if p.poll() is None:
             try:
                 p.kill()
@@ -314,7 +382,7 @@ class PodController:
         return None
 
     def tail_logs(self, n: int = 20):
-        for lr in range(len(self.procs)):
+        for lr in self.local_ranks:
             path = os.path.join(self.ctx.log_dir, f"workerlog.{lr}")
             try:
                 with open(path, "rb") as f:
@@ -428,8 +496,15 @@ class ElasticManager:
             self.store.close()
 
 
-def launch(ctx: Context) -> int:
-    """Run the pod until success, failure, or restart budget exhausted."""
+def launch(ctx: Context, now_fn=time.time, sleep_fn=time.sleep,
+           rng=None) -> int:
+    """Run the pod until success, failure, or restart budget exhausted.
+
+    ``now_fn``/``sleep_fn``/``rng`` make every launcher timing path —
+    fleet/detector polling cadence, recovery MTTR stamps, and the
+    jittered restart backoff — clock-injectable, so chaos tests drive
+    the babysit loop with a fake clock instead of sleeping through
+    real backoff windows."""
     from ...observability import RankHeartbeat, tracing as _tr
     from ...observability import metrics as _obsm
     from ...observability.fleet import FleetAggregator
@@ -437,6 +512,38 @@ def launch(ctx: Context) -> int:
     hb = RankHeartbeat(os.path.join(ctx.log_dir, "heartbeat.jsonl"),
                        interval=ctx.heartbeat_interval)
     os.makedirs(ctx.log_dir, exist_ok=True)
+    # straggler mitigation actuator (docs/ROBUSTNESS.md "Mitigation"):
+    # consumes the fleet detector's incidents, decides exclude/reassign/
+    # hold under cooldown + flap damping, and audits EVERY decision to
+    # <log_dir>/control.jsonl; this loop executes what it decides
+    mit = None
+    mit_pending: List[dict] = []    # comm-wait-inversion incidents
+    mit_consumed = 0                # fleet.stragglers read cursor
+    if ctx.mitigation != "off":
+        from .mitigate import MitigationController
+        control_path = os.path.join(ctx.log_dir, "control.jsonl")
+
+        def _emit_control(rec, _path=control_path):
+            import json
+            with open(_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+        mit = MitigationController(
+            world_size=ctx.world_size, mode=ctx.mitigation,
+            num_stages=ctx.pipeline_stages,
+            cooldown_s=ctx.mitigation_cooldown_s,
+            now_fn=now_fn, emit=_emit_control)
+
+    def _on_step(step, durs, share):
+        # fleet-joined step stats feed the mitigation cost model and
+        # its comm-wait-inversion detector (a synchronous straggler
+        # shows NO dur skew — the others absorb it as comm-wait)
+        if mit is None:
+            return
+        inc = mit.note_step(step, durs, share, now=now_fn())
+        if inc is not None:
+            mit_pending.append(inc)
+
     # fleet view: tail every rank's telemetry/heartbeat file, join
     # train.step spans on the global step index, flag persistent
     # stragglers (slow-but-alive ranks the stale-heartbeat detector
@@ -447,11 +554,12 @@ def launch(ctx: Context) -> int:
     fleet = FleetAggregator(ctx.log_dir,
                             straggler_factor=ctx.straggler_factor,
                             straggler_steps=ctx.straggler_steps,
-                            expected_ranks=ctx.nproc_per_node)
+                            expected_ranks=ctx.nproc_per_node,
+                            now_fn=now_fn, on_step=_on_step)
     fleet_interval = max(0.25, min(1.0, ctx.heartbeat_interval))
     next_fleet = 0.0
-    det = HangDetector(ctx.hang_timeout_s) if ctx.hang_timeout_s > 0 \
-        else None
+    det = HangDetector(ctx.hang_timeout_s, now_fn=now_fn) \
+        if ctx.hang_timeout_s > 0 else None
     det_interval = max(0.2, min(1.0, ctx.hang_timeout_s / 4.0)) \
         if det is not None else 0.0
     next_det = 0.0
@@ -464,13 +572,14 @@ def launch(ctx: Context) -> int:
         nonlocal recovery
         if recovery is None:
             return
-        mttr = time.time() - recovery["t"]
+        mttr = now_fn() - recovery["t"]
         if status == "ok":
-            # the recovery-time SLO: hang declared -> restarted rank
-            # observably making progress again
+            # the recovery-time SLO: incident declared (hang detected
+            # OR mitigation triggered) -> restarted rank observably
+            # making progress again
             _obsm.gauge("robustness.mttr_seconds", unit="s").set(mttr)
-            print(f"[launch] recovered {mttr:.2f}s after hang detection "
-                  f"(MTTR; first progress from rank {via})",
+            print(f"[launch] recovered {mttr:.2f}s after incident "
+                  f"detection (MTTR; first progress from rank {via})",
                   file=sys.stderr)
         recovery["span"].end(status=status, mttr_s=round(mttr, 3))
         recovery = None
@@ -483,7 +592,10 @@ def launch(ctx: Context) -> int:
                                    epoch=epoch, restarts=restarts,
                                    node=ctx.node_rank)
             elastic.register(epoch)
-            pod = PodController(ctx)
+            pod = PodController(
+                ctx,
+                exclude=(mit.excluded if mit is not None else ()),
+                stage_map=(mit.stage_map if mit is not None else None))
             pod.start(restart_epoch=epoch)
             # post-restart progress baseline: logs/heartbeats append
             # across epochs, so "recovered" = any alive rank's files
@@ -501,34 +613,84 @@ def launch(ctx: Context) -> int:
                         peer_restart = True
                         break
                     elastic.heartbeat()
-                    if time.time() >= next_fleet:
-                        next_fleet = time.time() + fleet_interval
+                    if now_fn() >= next_fleet:
+                        next_fleet = now_fn() + fleet_interval
                         try:
                             fleet.poll()
                         except Exception:
                             # observability must never kill the pod
                             # supervision that hosts it
                             pass
+                        if mit is not None:
+                            incidents = list(
+                                fleet.stragglers[mit_consumed:])
+                            mit_consumed = len(fleet.stragglers)
+                            incidents.extend(mit_pending)
+                            mit_pending.clear()
+                            for inc in incidents:
+                                dec = mit.offer(inc, now=now_fn())
+                                act = dec.get("action")
+                                if act not in ("exclude_restart",
+                                               "reassign_stages"):
+                                    continue
+                                mrank = int(dec["params"]["rank"])
+                                ep_sp.event("mitigation", action=act,
+                                            rank=mrank,
+                                            rule=dec.get("rule"))
+                                print(
+                                    f"[launch] mitigation: {act} rank "
+                                    f"{mrank} (seq {dec.get('seq')}; "
+                                    "restarting pod — see "
+                                    "control.jsonl)", file=sys.stderr)
+                                if recovery is None:
+                                    recovery = {
+                                        "t": now_fn(),
+                                        "span": _tr.start_span(
+                                            "launch.recovery",
+                                            parent=None, rank=mrank,
+                                            phase="mitigation",
+                                            action=act)}
+                                if act == "exclude_restart":
+                                    # stop joining on the evicted
+                                    # rank's files: it will never
+                                    # report another step
+                                    fleet.retire_rank(str(mrank))
+                                if det is not None:
+                                    det.forget(mrank)
+                                lr = mrank \
+                                    - ctx.node_rank * ctx.nproc_per_node
+                                if 0 <= lr < ctx.nproc_per_node:
+                                    # the kill surfaces as a pod
+                                    # failure; the elastic restart
+                                    # re-spawns with the new
+                                    # exclude/stage_map
+                                    pod.kill_rank(lr)
                     states = None
                     if hb.due():  # rank_states stats N files: build it
                         states = pod.rank_states()
                         hb.beat(node=ctx.node_rank, epoch=epoch,  # 1x per
                                 restarts=restarts,                # interval
                                 ranks=states)
-                    if (det is not None
-                            and time.time() >= next_det):
-                        next_det = time.time() + det_interval
+                    if baseline is not None:
+                        # recovery closes on first observed progress —
+                        # runs with or without the hang detector (a
+                        # mitigation restart must close its MTTR
+                        # window even when --hang_timeout is off)
                         if states is None:
                             states = pod.rank_states()
-                        if baseline is not None:
-                            for st in states:
-                                base = baseline.get(st["rank"], (0, 0))
-                                if st["alive"] and (
-                                        st["log_bytes"] > base[0]
-                                        or st["hb_bytes"] > base[1]):
-                                    finish_recovery("ok", via=st["rank"])
-                                    baseline = None
-                                    break
+                        for st in states:
+                            base = baseline.get(st["rank"], (0, 0))
+                            if st["alive"] and (
+                                    st["log_bytes"] > base[0]
+                                    or st["hb_bytes"] > base[1]):
+                                finish_recovery("ok", via=st["rank"])
+                                baseline = None
+                                break
+                    if (det is not None
+                            and now_fn() >= next_det):
+                        next_det = now_fn() + det_interval
+                        if states is None:
+                            states = pod.rank_states()
                         for st in det.observe(states):
                             phase = pod.last_phase(st["rank"]) or {}
                             silent = det.silence_s(st["rank"])
@@ -551,14 +713,14 @@ def launch(ctx: Context) -> int:
                                         step=phase.get("step"))
                             if recovery is None:
                                 recovery = {
-                                    "t": time.time(),
+                                    "t": now_fn(),
                                     "span": _tr.start_span(
                                         "launch.recovery", parent=None,
                                         rank=st["rank"],
                                         phase=phase.get("phase"))}
                             det.forget(st["rank"])
                             pod.kill_rank(st["local_rank"])
-                    time.sleep(0.2)
+                    sleep_fn(0.2)
             except KeyboardInterrupt:
                 pod.stop(signal.SIGINT)
                 ep_sp.end(status="interrupted")
@@ -599,12 +761,12 @@ def launch(ctx: Context) -> int:
                 break
             ep_sp.end(status="restart")
             delay = restart_delay(restarts, ctx.restart_backoff_s,
-                                  ctx.restart_backoff_max_s)
+                                  ctx.restart_backoff_max_s, rng=rng)
             if delay > 0:
                 print(f"[launch] backing off {delay:.2f}s before restart "
                       f"epoch {epoch + 1} (restart {restarts}/"
                       f"{ctx.max_restart})", file=sys.stderr)
-                time.sleep(delay)
+                sleep_fn(delay)
             epoch += 1
         return rc if rc is not None else 1
     finally:

@@ -1,0 +1,416 @@
+"""Straggler *mitigation*: the training-fleet actuator.
+
+PR 13 detects persistent stragglers (``FleetAggregator`` +
+``StragglerDetector``) and PR 7 can kill and elastically restart ranks
+(``PodController.kill_rank`` → restart from the last verified
+checkpoint), but nothing connected detection to action: a degraded
+host dragged the whole job until an operator noticed. This module is
+the missing link — the robustness analogue of the serving
+``PoolController`` (PR 16), built on the same contract:
+
+- **evidence-carrying audit records**: every decision (including the
+  decision to do nothing) is a ``{"kind": "control"}`` record with a
+  contiguous ``seq``, the full input snapshot that drove it, and the
+  chosen action's parameters — replayable by ``tools/trace_report.py
+  --recovery`` and ingested fleet-side by ``FleetAggregator``;
+- **flap damping**: incidents naming *different* ranks inside one flap
+  window cancel each other (alternating skew means the median moved,
+  not that one host degraded) — the actuator holds instead of
+  thrashing restarts;
+- **cooldown gating**: at most one mitigation per cooldown window, so
+  a restart's own transient skew (cold caches, recompile) cannot
+  trigger a second restart.
+
+Two failure classes, two actions (docs/ROBUSTNESS.md "Mitigation"):
+
+``exclude_restart``
+    SIGKILL the slow rank and elastically restart the pod *without
+    it*: the survivors resume from the last verified checkpoint with
+    the world shrunk (``WORLD_SIZE`` drops, the original rank ids are
+    kept so checkpoint/telemetry file names stay stable, and
+    ``PADDLE_TPU_EXCLUDED_RANKS`` names the hole).
+
+``reassign_stages``
+    Pipeline jobs cannot drop a stage's only host; instead the restart
+    carries a permuted stage→device-group map
+    (``PADDLE_TPU_STAGE_MAP``, consumed by ``distributed.mesh
+    .build_mesh``) so the slow rank hosts the *lightest* stage — the
+    per-rank step stats the fleet view already collects are the cost
+    model (:func:`reassign_stage_map`).
+
+Detection inputs, both from the PR-13 fleet view:
+
+- **dur skew** incidents (``StragglerDetector``): a rank whose step
+  wall exceeds ``factor`` × the cross-rank median — the signature of a
+  slow host when ranks run unsynchronized phases;
+- **comm-wait inversion** (:meth:`MitigationController.note_step`):
+  under synchronous training a slow rank does NOT show dur skew — the
+  collectives equalize step walls and the *other* ranks absorb the
+  slowness as comm-wait (T3, arxiv 2401.16677). The tell is inverted
+  share: the fleet's median comm-wait share is high while exactly one
+  rank's stays near zero (everyone waits on it). ``note_step`` runs
+  that persistent-inversion state machine and synthesizes incidents.
+
+Pure state machine: injectable clock, injectable emit sink, no
+subprocesses, no sleeps — tests drive it entirely with synthetic
+incidents (tests/test_mitigation.py).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from ...observability import metrics as _obsm
+from ...observability.runtime import export_record
+
+__all__ = ["MitigationController", "reassign_stage_map", "stage_of_rank"]
+
+
+def stage_of_rank(rank: int, world_size: int, num_stages: int) -> int:
+    """Stage hosted by ``rank`` under the contiguous grouping the mesh
+    uses (stage s owns ranks [s*g, (s+1)*g) with g = world/stages)."""
+    if num_stages <= 1 or world_size <= 0:
+        return 0
+    group = max(1, world_size // num_stages)
+    return min(num_stages - 1, rank // group)
+
+
+def reassign_stage_map(stage_costs: List[float], slow_stage: int) -> \
+        Optional[List[int]]:
+    """Stage→device-group permutation that hands the slow host the
+    lightest stage.
+
+    ``stage_costs[s]`` is the relative step cost of stage ``s`` (from
+    the fleet per-rank step stats, with the slow rank's own inflation
+    excluded — see :meth:`MitigationController._stage_costs`).
+    ``slow_stage`` is the stage the slow device group currently hosts.
+    Returns ``m`` with ``m[s]`` = device-group index that should host
+    stage ``s`` (the ``PADDLE_TPU_STAGE_MAP`` wire format): a swap of
+    the lightest stage onto the slow group, every other assignment
+    untouched (minimal disruption — only two groups reload weights).
+    ``None`` when the slow group already hosts the lightest stage
+    (nothing to gain; the caller tolerates instead).
+    """
+    if not stage_costs or not (0 <= slow_stage < len(stage_costs)):
+        return None
+    lightest = min(range(len(stage_costs)),
+                   key=lambda s: (stage_costs[s], s))
+    if lightest == slow_stage:
+        return None
+    m = list(range(len(stage_costs)))
+    m[lightest], m[slow_stage] = m[slow_stage], m[lightest]
+    return m
+
+
+class MitigationController:
+    """Decide (and audit) the mitigation for persistent-straggler
+    incidents. ``offer()`` consumes one detector incident and returns
+    the decision record; every call emits exactly one ``{"kind":
+    "control"}`` record (action or hold — the audit stream has no
+    silent paths). The *caller* (the launcher babysit loop) executes
+    the returned action; this class never touches processes, so tests
+    drive it as a pure state machine.
+    """
+
+    #: decision actions (the record's ``action`` field)
+    ACTIONS = ("exclude_restart", "reassign_stages", "tolerate",
+               "hold_flap", "hold_cooldown", "observe")
+
+    def __init__(self, world_size: int, mode: str = "auto",
+                 num_stages: int = 1,
+                 cooldown_s: float = 60.0,
+                 flap_window_s: float = 120.0,
+                 min_world: int = 2,
+                 comm_share_floor: float = 0.4,
+                 comm_share_ratio: float = 0.5,
+                 comm_share_steps: int = 3,
+                 registry=None, now_fn=time.time,
+                 emit: Optional[Callable[[dict], None]] = None):
+        if mode not in ("exclude", "reassign", "auto"):
+            raise ValueError(f"unknown mitigation mode {mode!r} "
+                             "(exclude|reassign|auto)")
+        self.world_size = int(world_size)
+        self.mode = mode
+        self.num_stages = max(1, int(num_stages))
+        self.cooldown_s = float(cooldown_s)
+        self.flap_window_s = float(flap_window_s)
+        self.min_world = max(1, int(min_world))
+        # comm-wait inversion thresholds: the fleet median share must
+        # clear the floor (everyone is genuinely waiting) AND the
+        # suspect's share must be under ratio * median, for
+        # comm_share_steps consecutive joined steps
+        self.comm_share_floor = float(comm_share_floor)
+        self.comm_share_ratio = float(comm_share_ratio)
+        self.comm_share_steps = max(1, int(comm_share_steps))
+        self._now = now_fn
+        self._emit_cb = emit
+        self._reg = registry if registry is not None \
+            else _obsm.get_registry()
+        self._m_incidents = self._reg.counter(
+            "robustness.mitigation.incidents",
+            help="straggler incidents offered to the mitigation "
+                 "controller, by classification")
+        self._m_actions = self._reg.counter(
+            "robustness.mitigation.actions",
+            help="mitigation decisions, by action (holds included)")
+        self._m_excluded = self._reg.gauge(
+            "robustness.mitigation.excluded_ranks",
+            help="ranks currently excluded from the world by "
+                 "exclude-and-restart mitigations")
+        self.excluded: List[int] = []
+        self.stage_map: Optional[List[int]] = None
+        self.decisions: List[dict] = []      # in-memory audit mirror
+        self._seq = 0
+        self._tick_no = 0
+        self._cooldown_until = 0.0
+        self._last_incident: Optional[dict] = None   # (rank, ts)
+        # comm-wait inversion state: rank -> consecutive inverted steps
+        self._low_share: Dict[int, int] = {}
+        self._share_flagged: set = set()
+        # per-rank running mean step duration (the stage cost model)
+        self._dur_sum: Dict[int, float] = {}
+        self._dur_n: Dict[int, int] = {}
+        self._record("init", "observe", inputs={}, params={
+            "mode": mode, "world_size": self.world_size,
+            "num_stages": self.num_stages,
+            "cooldown_s": self.cooldown_s,
+            "flap_window_s": self.flap_window_s})
+
+    # ----------------------------------------------------------- audit --
+    def _record(self, rule: str, action: str, inputs: dict,
+                params: dict, cooldown_s: float = 0.0) -> dict:
+        self._seq += 1
+        rec = {"kind": "control", "ts": round(self._now(), 6),
+               "seq": self._seq, "tick": self._tick_no, "rule": rule,
+               "action": action, "params": params, "inputs": inputs,
+               "cooldown_s": cooldown_s}
+        export_record(rec)
+        if self._emit_cb is not None:
+            try:
+                self._emit_cb(rec)
+            except Exception:
+                pass   # the audit sink must never kill the actuator
+        self.decisions.append(rec)
+        self._m_actions.inc(rule=rule, action=action)
+        return rec
+
+    # ------------------------------------------------------ cost model --
+    def note_step(self, step: int, durs: Dict[str, float],
+                  comm_share: Optional[Dict[str, float]] = None,
+                  now: Optional[float] = None) -> Optional[dict]:
+        """Feed one joined fleet step (the aggregator's per-step durs
+        and comm-wait shares). Maintains the per-rank mean-duration
+        cost model and runs the comm-wait-inversion detector; returns
+        a synthesized incident dict when the inversion persists (the
+        caller passes it to :meth:`offer`), else None."""
+        for r, d in durs.items():
+            try:
+                ri = int(r)
+            except (TypeError, ValueError):
+                continue
+            self._dur_sum[ri] = self._dur_sum.get(ri, 0.0) + float(d)
+            self._dur_n[ri] = self._dur_n.get(ri, 0) + 1
+        if not comm_share or len(comm_share) < 2:
+            return None
+        shares = {}
+        for r, s in comm_share.items():
+            try:
+                shares[int(r)] = float(s)
+            except (TypeError, ValueError):
+                continue
+        if len(shares) < 2:
+            return None
+        import statistics
+        med = statistics.median(shares.values())
+        incident = None
+        for rank, share in shares.items():
+            inverted = med >= self.comm_share_floor \
+                and share <= self.comm_share_ratio * med
+            if inverted:
+                c = self._low_share.get(rank, 0) + 1
+                self._low_share[rank] = c
+                if c >= self.comm_share_steps \
+                        and rank not in self._share_flagged:
+                    self._share_flagged.add(rank)
+                    incident = {
+                        "rank": rank, "step": int(step),
+                        "dur_s": durs.get(str(rank), durs.get(rank)),
+                        "median_s": med, "ratio": None,
+                        "consecutive": c,
+                        "comm_wait_share": round(share, 4),
+                        "median_share": round(med, 4),
+                        "dominant_span": None,
+                        "source": "comm_wait_inversion"}
+            else:
+                self._low_share[rank] = 0
+                self._share_flagged.discard(rank)
+        return incident
+
+    def mean_step_s(self, rank: int) -> Optional[float]:
+        n = self._dur_n.get(rank, 0)
+        return (self._dur_sum[rank] / n) if n else None
+
+    def _stage_costs(self, slow_rank: int) -> Optional[List[float]]:
+        """Per-stage relative cost from the per-rank mean durations,
+        with the slow rank excluded from its own stage's mean (its
+        inflation is the *host's* fault, not the stage's). A stage
+        whose only sample is the slow rank falls back to the fleet
+        median. None when no rank has stats yet."""
+        world = self.world_size
+        means = {r: self.mean_step_s(r) for r in range(world)
+                 if self.mean_step_s(r) is not None}
+        if not means:
+            return None
+        import statistics
+        fleet_med = statistics.median(means.values())
+        costs = []
+        for s in range(self.num_stages):
+            vals = [m for r, m in means.items()
+                    if r != slow_rank
+                    and stage_of_rank(r, world, self.num_stages) == s]
+            costs.append(sum(vals) / len(vals) if vals else fleet_med)
+        return costs
+
+    # -------------------------------------------------------- decision --
+    def _inputs(self, incident: dict, classification: str,
+                rank: Optional[int] = None) -> dict:
+        inp = {"rank": rank if rank is not None
+               else incident.get("rank"),
+               "step": incident.get("step"),
+               "dur_s": incident.get("dur_s"),
+               "median_s": incident.get("median_s"),
+               "ratio": incident.get("ratio"),
+               "consecutive": incident.get("consecutive"),
+               "dominant_span": incident.get("dominant_span"),
+               "comm_wait_share": incident.get("comm_wait_share"),
+               "source": incident.get("source", "dur_skew"),
+               "classification": classification,
+               "world_size": self.world_size,
+               "excluded": list(self.excluded)}
+        means = {r: round(self.mean_step_s(r), 6)
+                 for r in range(self.world_size)
+                 if self.mean_step_s(r) is not None}
+        if means:
+            inp["mean_step_s"] = means
+        return inp
+
+    def _classify(self, incident: dict) -> str:
+        """comm_degraded: the rank's OWN interconnect is slow — it
+        spends its step waiting in comm.* (high share / comm-dominant
+        span). compute_slow: the host computes slowly (low share; the
+        others wait on it)."""
+        dom = incident.get("dominant_span") or ""
+        share = incident.get("comm_wait_share")
+        if dom.startswith("comm."):
+            return "comm_degraded"
+        if incident.get("source") == "comm_wait_inversion":
+            return "compute_slow"
+        if share is not None and float(share) >= self.comm_share_floor:
+            return "comm_degraded"
+        return "compute_slow"
+
+    def offer(self, incident: dict, now: Optional[float] = None) -> dict:
+        """One detector incident in, one audited decision out. The
+        returned record's ``action`` tells the caller what to execute:
+        ``exclude_restart`` (params carry the rank and the shrunk
+        world), ``reassign_stages`` (params carry the stage map), or
+        a hold (``hold_flap`` / ``hold_cooldown`` / ``tolerate``)."""
+        t = self._now() if now is None else float(now)
+        self._tick_no += 1
+        try:
+            rank = int(incident.get("rank"))
+        except (TypeError, ValueError):
+            rank = -1
+        classification = self._classify(incident)
+        self._m_incidents.inc(classification=classification,
+                              rank=str(rank))
+        inputs = self._inputs(incident, classification, rank=rank)
+
+        # flap damping: a DIFFERENT rank flagged inside the window
+        # means the skew is moving around (median shift, noisy box) —
+        # acting would thrash restarts chasing a phantom
+        last = self._last_incident
+        self._last_incident = {"rank": rank, "ts": t}
+        if last is not None and last["rank"] != rank \
+                and t - last["ts"] <= self.flap_window_s:
+            return self._record(
+                "mitigate", "hold_flap", inputs,
+                params={"rank": rank, "previous_rank": last["rank"],
+                        "since_s": round(t - last["ts"], 3),
+                        "flap_window_s": self.flap_window_s})
+        # cooldown: one mitigation per window — a restart's own
+        # transient skew must not trigger a second restart
+        if t < self._cooldown_until:
+            return self._record(
+                "mitigate", "hold_cooldown", inputs,
+                params={"rank": rank,
+                        "remaining_s": round(self._cooldown_until - t,
+                                             3)})
+        return self._decide(rank, inputs, t)
+
+    def _decide(self, rank: int, inputs: dict, t: float) -> dict:
+        world_after = self.world_size - len(self.excluded) - 1
+        stage = stage_of_rank(rank, self.world_size, self.num_stages)
+        alive_in_stage = sum(
+            1 for r in range(self.world_size)
+            if r not in self.excluded and r != rank
+            and stage_of_rank(r, self.world_size, self.num_stages)
+            == stage)
+        # exclusion is legal when the coordinator survives (rank 0
+        # hosts the store/master — killing it kills the job, not the
+        # straggler), the world stays big enough to keep training, and
+        # the slow rank is not its stage's only host (a pipeline with a
+        # missing stage cannot run at all)
+        can_exclude = (rank > 0 and world_after >= self.min_world
+                       and (self.num_stages <= 1 or alive_in_stage > 0))
+        stage_map = None
+        if self.num_stages > 1:
+            costs = self._stage_costs(rank)
+            if costs is not None:
+                stage_map = reassign_stage_map(costs, stage)
+        can_reassign = stage_map is not None
+
+        if self.mode == "exclude":
+            order = ["exclude"]
+        elif self.mode == "reassign":
+            order = ["reassign"]
+        else:
+            order = ["exclude", "reassign"]
+        for choice in order:
+            if choice == "exclude" and can_exclude:
+                self.excluded.append(rank)
+                self._cooldown_until = t + self.cooldown_s
+                self._m_excluded.set(len(self.excluded))
+                return self._record(
+                    "mitigate", "exclude_restart", inputs,
+                    params={"rank": rank, "stage": stage,
+                            "world_before": self.world_size
+                            - len(self.excluded) + 1,
+                            "world_after": world_after,
+                            "excluded": list(self.excluded)},
+                    cooldown_s=self.cooldown_s)
+            if choice == "reassign" and can_reassign:
+                self.stage_map = stage_map
+                self._cooldown_until = t + self.cooldown_s
+                costs = self._stage_costs(rank) or []
+                return self._record(
+                    "mitigate", "reassign_stages", inputs,
+                    params={"rank": rank, "slow_stage": stage,
+                            "stage_map": stage_map,
+                            "stage_costs": [round(c, 6)
+                                            for c in costs]},
+                    cooldown_s=self.cooldown_s)
+        # nothing legal: audit WHY (rank-0 protection, min-world floor,
+        # sole stage host, or a stage map with nothing to gain)
+        reasons = []
+        if rank <= 0:
+            reasons.append("rank0_protected")
+        if world_after < self.min_world:
+            reasons.append("min_world")
+        if self.num_stages > 1 and alive_in_stage == 0:
+            reasons.append("sole_stage_host")
+        if self.num_stages > 1 and not can_reassign:
+            reasons.append("no_lighter_stage")
+        return self._record(
+            "mitigate", "tolerate", inputs,
+            params={"rank": rank, "reasons": reasons or ["mode"]})

@@ -50,7 +50,33 @@ def build_mesh(dp: int = 1, pp: int = 1, cp: int = 1, ep: int = 1,
     # sub-mesh over the first `prod` devices is allowed (e.g. single-device
     # reference runs on a multi-device host)
     arr = np.asarray(devices[:prod]).reshape([degrees[a] for a in AXES])
+    arr = _apply_stage_map(arr, degrees["stage"])
     return Mesh(arr, AXES)
+
+
+def _apply_stage_map(arr: np.ndarray, pp: int) -> np.ndarray:
+    """Permute device groups along the 'stage' axis per
+    ``PADDLE_TPU_STAGE_MAP`` (comma-separated: entry ``s`` names the
+    device group that hosts stage ``s``). Exported by the launcher's
+    mitigation controller on a reassign_stages restart so a degraded
+    host carries the lightest pipeline stage
+    (distributed.launch.mitigate.reassign_stage_map). A map that is
+    not a permutation of range(pp) is ignored with a warning — a
+    stale env var must never wedge an otherwise-valid mesh."""
+    import os
+    import sys
+    spec = os.environ.get("PADDLE_TPU_STAGE_MAP")
+    if not spec or pp <= 1:
+        return arr
+    try:
+        m = [int(t) for t in spec.split(",")]
+    except ValueError:
+        m = []
+    if sorted(m) != list(range(pp)):
+        print(f"[mesh] ignoring PADDLE_TPU_STAGE_MAP={spec!r}: not a "
+              f"permutation of range({pp})", file=sys.stderr)
+        return arr
+    return np.take(arr, m, axis=AXES.index("stage"))
 
 
 def set_mesh(mesh: Mesh):

@@ -50,13 +50,25 @@ prey), ``slow_rank`` (a per-step injected sleep on ONE rank of a
 multi-rank job: pass ``rank=K`` and the Trainer applies the sleep only
 on that rank — the persistent-skew straggler the launcher's
 ``FleetAggregator`` exists to flag, invisible to the stale-heartbeat
-detector because the rank keeps beating), ``sigterm`` (in
+detector because the rank keeps beating), ``rank_slow`` (persistent
+*multiplicative* step inflation on one rank: ``rank=K`` targets it,
+``factor=F`` scales the measured step work by F — unlike ``slow_rank``'s
+fixed sleep this models a degraded host whose slowness tracks the
+workload; the mitigation actuator's canonical prey), ``comm_degraded``
+(inflated per-byte collective latency through the ``collective.py``
+facade: ``rank=K`` pays ``per_mb=S`` seconds per MiB inside the
+``comm.wait`` span, so the degradation presents as comm-wait skew in
+the fleet view — a slow NIC, not a slow core), ``sigterm`` (in
 ``trainer.Trainer``), ``decode_wedge``,
 ``serve_flood`` (in ``inference.ContinuousBatchingPredictor``),
 ``collective_stall`` (``distributed.collective`` sync deadline — holds
-buffer readiness false so the collective watchdog trips), and
+buffer readiness false so the collective watchdog trips),
 ``heartbeat_stall`` (``observability.RankHeartbeat`` stops writing
-while the process stays alive — the silent-rank signature). Sites
+while the process stays alive — the silent-rank signature), and
+``handoff_corrupt`` (``serving.router`` flips one payload byte in a
+disaggregated KV span *before* import — the checksum fence must reject
+it and the request must re-prefill from scratch, bitwise-identically,
+instead of decoding from corrupt pages). Sites
 are free-form strings — new subsystems add theirs without touching this
 module.
 
@@ -83,7 +95,8 @@ _DEFAULT_MODES = {
     "slow_step": "sleep", "sigterm": "sigterm", "decode_wedge": "sleep",
     "serve_flood": "flood", "rank_hang": "sleep", "slow_rank": "sleep",
     "collective_stall": "sleep", "ckpt_slow": "sleep",
-    "heartbeat_stall": "sleep",
+    "heartbeat_stall": "sleep", "rank_slow": "sleep",
+    "comm_degraded": "sleep", "handoff_corrupt": "corrupt",
 }
 
 

@@ -245,7 +245,7 @@ class FleetAggregator:
                  straggler_steps: int = 3,
                  expected_ranks: Optional[int] = None,
                  registry: Optional[_obsm.MetricRegistry] = None,
-                 now_fn=time.time, log=None):
+                 now_fn=time.time, log=None, on_step=None):
         self.log_dir = os.path.abspath(log_dir)
         # known world size: steps join only once every expected rank's
         # telemetry file is visible — without it, ranks that boot a few
@@ -279,6 +279,14 @@ class FleetAggregator:
         # never yields a torn line — tests/test_fleet.py asserts it).
         self.control_records: List[dict] = []
         self.slo_breaches: List[dict] = []
+        # per-joined-step feed for launcher-side consumers (the
+        # mitigation controller's cost model + comm-wait-inversion
+        # detector): on_step(step, durs, comm_wait_share)
+        self.on_step = on_step
+        # ranks evicted by an exclude-and-restart mitigation: their
+        # files stay on disk (history) but they leave the join — a
+        # dead rank must not stall every future step join
+        self._retired: set = set()
         self._out = None
         self._warned: set = set()
 
@@ -318,6 +326,22 @@ class FleetAggregator:
 
     def _rank_state(self, rank: str) -> Dict[int, dict]:
         return self._steps.setdefault(rank, {})
+
+    def retire_rank(self, rank) -> None:
+        """Drop a rank from the fleet join (exclude-and-restart
+        mitigation): its pending state is discarded and future records
+        from its files are ignored, so the survivors' steps keep
+        joining instead of waiting forever on a rank that will never
+        report again. The expected world shrinks with it."""
+        rank = str(rank)
+        self._retired.add(rank)
+        self._steps.pop(rank, None)
+        self._trace_step.pop(rank, None)
+        self._orphan_comm.pop(rank, None)
+        self._comm_bytes.pop(rank, None)
+        if self.expected_ranks and self.expected_ranks > 1:
+            self.expected_ranks -= 1
+        self._emit({"event": "rank_retired", "rank": rank})
 
     def _prune(self, rank: str):
         steps = self._steps.get(rank) or {}
@@ -475,9 +499,20 @@ class FleetAggregator:
                     "slowest_rank": slowest,
                     "comm_wait_share": {r: round(s, 4)
                                         for r, s in share.items()}})
+        if self.on_step is not None:
+            try:
+                self.on_step(step, dict(durs), dict(share))
+            except Exception:
+                pass   # consumers must never kill the aggregator
         for hit in self.detector.observe(step, durs):
             dominant = self._dominant_span(hit["rank"], step)
             hit["dominant_span"] = dominant
+            # the flagged rank's comm-wait share at the flagging step:
+            # the mitigation controller's classification evidence (a
+            # comm-dominated straggler is a degraded NIC, not a slow
+            # core)
+            hit["comm_wait_share"] = round(share.get(hit["rank"],
+                                                     0.0), 4)
             self.stragglers.append(hit)
             self._reg.counter(
                 "robustness.stragglers_detected",
@@ -548,6 +583,9 @@ class FleetAggregator:
         n = 0
         for path, tailer in self._tailers.items():
             rank = _rank_of(path)
+            if rank in self._retired:
+                tailer.poll()   # keep draining; records are ignored
+                continue
             for rec in tailer.poll():
                 n += 1
                 # per-record guard: a line that parses as JSON but has

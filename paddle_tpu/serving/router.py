@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..framework import faults as _faults
 from ..generation.kv_cache import prefix_page_keys
 from ..observability import metrics as _obsm
 from ..observability import tracing as _obstr
@@ -282,6 +283,24 @@ class Replica:
         decode-side pages resident; failures record a reason and leave
         the request to prefill from scratch."""
         r = self.router
+        fa = _faults.check("handoff_corrupt")
+        if fa is not None:
+            # bitrot-in-transit: flip one payload byte BEFORE import.
+            # The span's checksum fence must reject it (reason
+            # "corrupt" below) and the request must re-prefill from
+            # scratch — never decode from corrupt pages. The flip
+            # mutates the payload only, so the recorded checksum still
+            # describes the original bytes.
+            span = h.handoff_span
+            pages = (getattr(span, "k_pages", None) or []) \
+                + (getattr(span, "v_pages", None) or [])
+            for arr in pages:
+                if arr.size:
+                    import numpy as _np
+                    flat = arr.view(_np.uint8).reshape(-1)
+                    idx = int(fa.params.get("byte", 0)) % flat.size
+                    flat[idx] ^= 0xFF
+                    break
         try:
             stats = self.predictor.import_request_span(h.handoff_span)
         except MemoryError:

@@ -392,6 +392,19 @@ class Trainer:
                 # go silent — the launcher's stale-heartbeat detector
                 # must notice and SIGKILL this rank into a restart
                 time.sleep(float(fa.params.get("sleep", 600.0)))
+            # rank_slow: persistent MULTIPLICATIVE inflation on one
+            # rank — the checked-on-every-rank / paid-on-one pattern of
+            # slow_rank, but scaled to the step's measured work
+            # (factor=F pays (F-1)x the data+dispatch wall) so it
+            # models a degraded host rather than a fixed stall. The
+            # mitigation actuator (distributed.launch.mitigate) exists
+            # to evict exactly this.
+            fa = _faults.check("rank_slow", step=step)
+            rank_slow = fa if fa is not None and (
+                fa.params.get("rank") is None
+                or int(fa.params["rank"]) == self._env_rank()) else None
+            t_work0 = time.perf_counter() if rank_slow is not None \
+                else 0.0
             with _obs.span("train.data", parent=st_sp, step=step + 1):
                 batch = next(data)
             if not isinstance(batch, (tuple, list)):
@@ -399,6 +412,15 @@ class Trainer:
             with _obs.span("train.dispatch", parent=st_sp,
                            step=step + 1):
                 loss = self._step_obj(*batch)
+            if rank_slow is not None:
+                factor = float(rank_slow.params.get("factor", 3.0))
+                pad = max(0.0, factor - 1.0) \
+                    * (time.perf_counter() - t_work0)
+                pad = max(pad, float(rank_slow.params.get("min_s",
+                                                          0.0)))
+                with _obs.span("train.straggle", parent=st_sp,
+                               step=step + 1):
+                    time.sleep(pad)
             if _faults.check("sigterm", step=step) is not None:
                 os.kill(os.getpid(), signal.SIGTERM)  # -> preemption hook
             if self.tokens_per_batch:

@@ -228,6 +228,15 @@ _SLOW_TESTS = (
     # parity and model-axis comm bytes from JSONL)
     "test_tp_serving.py::TestTPGreedyParity::test_plain_decode_parity",
     "test_tp_serving.py::TestTPGreedyParity::test_spec_verify_parity",
+    # PR 19: the canonical body crept to ~841s of the 870s window and
+    # the mixed-bench section's p99 latency-RATIO assertions started
+    # flaking at that load margin (passes in isolation). It joins the
+    # other end-to-end bench acceptances in tier 2; tier 1 keeps the
+    # whole chunked-prefill unit/parity family in test_mixed_step.py
+    # (parity_with_unchunked_and_telemetry, bucket adaptivity, deadline
+    # page-free, zero-compile capture) plus the varq kernel tests.
+    "test_mixed_step.py::TestMixedBenchSection::"
+    "test_serve_mixed_bench_smoke",
 )
 
 

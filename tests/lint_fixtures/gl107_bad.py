@@ -1,0 +1,26 @@
+"""GL107 positive fixtures — control actions with no audit record.
+
+Three violations: a watchdog kill with no record anywhere on its path,
+a drain helper whose only caller records nothing either, and a
+module-scope shed with no decision path at all.
+"""
+
+
+class Watchdog:
+    def __init__(self, pod, router):
+        self.pod = pod
+        self.router = router
+
+    def on_hang(self, rank):
+        self.pod.kill_rank(rank)          # GL107: no record in on_hang
+
+    def _shrink(self):
+        return self.router.drain_replica()  # GL107: caller silent too
+
+    def on_idle(self):
+        rep = self._shrink()
+        return rep
+
+
+ROUTER = object()
+ROUTER.set_shed_tiers(("batch",))         # GL107: module scope

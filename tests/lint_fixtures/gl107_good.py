@@ -1,0 +1,41 @@
+"""GL107 negative fixtures — every action rides an audited path.
+
+Covers: a direct record in the acting function, a helper audited by
+its (recording) caller, and the sanction comment for a genuinely
+decision-free site.
+"""
+from obs import export_record
+
+
+class Controller:
+    def __init__(self, pod, router):
+        self.pod = pod
+        self.router = router
+
+    def _record(self, rule, action, **params):
+        rec = {"kind": "control", "rule": rule, "action": action,
+               "params": params}
+        export_record(rec)
+        return rec
+
+    def on_hang(self, rank):
+        self.pod.kill_rank(rank)
+        return self._record("hang", "kill", rank=rank)
+
+    def _grow(self):
+        # no record here: both callers audit the decision
+        return self.router.add_replica(object())
+
+    def scale_out(self):
+        rep = self._grow()
+        return self._record("scale_out", "spawn", replica=rep)
+
+    def scale_out_role(self, role):
+        rep = self._grow()
+        return self._record("scale_out", "spawn", replica=rep,
+                            role=role)
+
+
+def legacy_drain(router):
+    # pre-audit-era admin path, sanctioned pending migration
+    return router.drain_replica()  # graft-lint: ok[GL107] admin CLI

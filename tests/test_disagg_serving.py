@@ -309,6 +309,38 @@ class TestDisaggRouter:
         assert _counter_total("serving.handoff.fallbacks",
                               reason="export_miss") == before + 1
 
+    def test_handoff_corrupt_fault_reprefills_end_to_end(self):
+        """Chaos arm for the handoff wire: the handoff_corrupt fault
+        site flips one payload byte in the KV span BEFORE the decode
+        side imports it. The span's checksum fence must reject the
+        import (fallbacks{reason=corrupt}), the request must re-prefill
+        from scratch on the decode replica — never decode from corrupt
+        pages — and the greedy output must stay bitwise identical to
+        the unified predictor's."""
+        model = _serve_model()
+        prompt = _prompts(1)[0]
+        ref = _cb(model).generate([prompt], max_new_tokens=6)
+        before = _counter_total("serving.handoff.fallbacks",
+                                reason="corrupt")
+        injected = _counter_total("robustness.faults_injected",
+                                  site="handoff_corrupt")
+        paddle.set_flags(
+            {"fault_injection": "handoff_corrupt:times=1"})
+        try:
+            with Router([model, model], roles=["prefill", "decode"],
+                        seed=0, max_batch_size=2, page_size=8,
+                        max_seq_len=64) as router:
+                h = router.submit(prompt, max_new_tokens=6)
+                assert h.result(timeout=120) == ref[0]
+                assert h.status == "ok"
+                assert h.stage == "decode"
+        finally:
+            paddle.set_flags({"fault_injection": ""})
+        assert _counter_total("serving.handoff.fallbacks",
+                              reason="corrupt") == before + 1
+        assert _counter_total("robustness.faults_injected",
+                              site="handoff_corrupt") == injected + 1
+
     def test_snapshot_refresh_waits_for_concurrent_trace(self):
         """The shared-model snapshot race a disaggregated pool makes
         likely: while one replica's FIRST trace holds the per-model

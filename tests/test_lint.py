@@ -164,6 +164,48 @@ def test_gl106_knob_table_matches_runtime_config():
     raise AssertionError("MIGRATED_FLAG_KNOBS not found")
 
 
+# ---------------------------------------------------------------- GL107 --
+
+@pytest.fixture
+def control_fixture_registered(monkeypatch):
+    extra = ("tests/lint_fixtures/gl107_*.py",)
+    monkeypatch.setattr(lint_config, "CONTROL_SURFACES",
+                        lint_config.CONTROL_SURFACES + extra)
+
+
+def test_gl107_bad_fires_per_site(control_fixture_registered):
+    got = findings_for("gl107_bad.py", {"GL107"})
+    assert len(got) == 3, [f.render() for f in got]
+    msgs = " | ".join(f.message for f in got)
+    assert "kill_rank" in msgs            # no record in the function
+    assert "drain_replica" in msgs        # silent caller chain
+    assert "set_shed_tiers" in msgs and "module scope" in msgs
+    assert all(f.severity == "error" for f in got)
+
+
+def test_gl107_audited_paths_and_sanction_clean(
+        control_fixture_registered):
+    got = findings_for("gl107_good.py", {"GL107"})
+    assert got == [], [f.render() for f in got]
+
+
+def test_gl107_outside_control_surfaces_silent():
+    """Without the fixture surface registration the same file is out
+    of scope: routers/tests calling these verbs are not controllers."""
+    got = findings_for("gl107_bad.py", {"GL107"})
+    assert got == [], [f.render() for f in got]
+
+
+def test_gl107_real_controllers_are_audited():
+    """The launcher (mitigation actuator) and the SLO controller —
+    the two live control surfaces — must be GL107-clean as shipped."""
+    paths = [os.path.join(REPO, "paddle_tpu", "distributed", "launch"),
+             os.path.join(REPO, "paddle_tpu", "serving",
+                          "controller.py")]
+    got = run_passes(paths, REPO, rules={"GL107"})
+    assert got == [], [f.render() for f in got]
+
+
 # ---------------------------------------------------------------- GL105 --
 
 def _write(path, text):

@@ -163,6 +163,37 @@ RUNTIME_CONFIG_KNOBS = frozenset({
     "quantized_grad_comm",
 })
 
+# --------------------------------------------------------------- GL107 --
+# Control surfaces: modules whose functions actuate the fleet/serving
+# plane. Inside them, every call to a CONTROL_ACTIONS name must be
+# reachable only through a decision path that also emits a
+# {"kind": "control"} audit record (a CONTROL_AUDIT_EMITTERS call in
+# the same function, or in every in-module caller, transitively).
+CONTROL_SURFACES = (
+    "paddle_tpu/distributed/launch/*.py",
+    "paddle_tpu/serving/controller.py",
+)
+# Side-effecting actuator verbs (terminal callee names): process kills,
+# fleet-membership changes, pool scaling, tier weight/shed levers.
+CONTROL_ACTIONS = frozenset({
+    "kill_rank",
+    "retire_rank",
+    "add_replica",
+    "drain_replica",
+    "revive",
+    "set_tier_weight",
+    "set_shed_tiers",
+})
+# Sanctioned audit paths: the raw record sink, the SLO controller's
+# record helper, the mitigation controller's decision entry point
+# (which records internally), and the launcher's control.jsonl sink.
+CONTROL_AUDIT_EMITTERS = frozenset({
+    "export_record",
+    "_record",
+    "offer",
+    "_emit_control",
+})
+
 # Standalone tool entry points linted by the default CLI run alongside
 # paddle_tpu/ (the autotune replay engine and the other telemetry
 # readers ship code too — the closing-the-loop pipeline is only as

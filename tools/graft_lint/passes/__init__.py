@@ -6,6 +6,7 @@ from .retrace import check as _retrace
 from .locks import check as _locks
 from .catalog import check as _catalog
 from .rtconfig import check as _rtconfig
+from .control_audit import check as _control_audit
 
 FILE_PASSES = (
     ("GL101", _donation),
@@ -13,6 +14,7 @@ FILE_PASSES = (
     ("GL103", _retrace),
     ("GL104", _locks),
     ("GL106", _rtconfig),
+    ("GL107", _control_audit),
 )
 
 PROJECT_PASSES = (
@@ -34,4 +36,7 @@ RULE_DOCS = {
     "GL106": "config drift: a knob migrated into RuntimeConfig is read "
              "via the bare FLAGS registry outside "
              "framework/runtime_config.py",
+    "GL107": "unaudited control-plane action: a controller kills/"
+             "retires/scales/sheds with no {\"kind\": \"control\"} "
+             "record on its decision path",
 }

@@ -1,0 +1,126 @@
+"""GL107 — unaudited control-plane action.
+
+Every side-effecting fleet/serving action a controller takes — killing
+a worker (``PodController.kill_rank``), retiring a rank from the fleet
+join (``FleetAggregator.retire_rank``), spawning/draining/reviving a
+replica, shifting tier weights, shedding admission — must be auditable
+from the ``{"kind": "control"}`` decision stream alone (PR-16's
+contract, extended to the launcher by the mitigation actuator). An
+action call with no record on its decision path is an invisible
+actuator: the post-incident timeline (``tools/trace_report.py
+--recovery``) shows the *effect* (a rank dying, a pool shrinking) with
+no *decision* explaining it.
+
+The check is a static approximation at function granularity with a
+one-level-deep escape hatch for helpers: a call to a configured action
+name (``config.CONTROL_ACTIONS``) inside a configured controller
+surface (``config.CONTROL_SURFACES``) is clean when the enclosing
+function also calls a configured audit emitter
+(``config.CONTROL_AUDIT_EMITTERS`` — ``export_record``, the
+controllers' ``_record``/``offer`` entry points, the launcher's
+``_emit_control`` sink), or when EVERY in-module caller of that
+function (resolved by terminal name, transitively) does. Module-level
+action calls have no enclosing decision path and always fire.
+
+Suppress a genuinely decision-free site (none are known today — even
+the hang watchdog's kill rides a function that consults the
+mitigation controller) with ``# graft-lint: ok[GL107] why``.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Set, Tuple
+
+from .. import config
+from ..core import Finding, SourceFile, terminal_name, walk_functions
+
+_HINT = ("emit an evidence-carrying {\"kind\": \"control\"} record on "
+         "the same decision path (SLOController._record, "
+         "MitigationController.offer, or export_record) so the action "
+         "is explainable from the audit stream; or sanction with "
+         "`# graft-lint: ok[GL107] why`")
+
+
+def _direct_calls(node: ast.AST) -> List[ast.Call]:
+    """Call nodes lexically inside `node` but outside any nested
+    def/async def (nested functions get their own walk entry; lambdas
+    stay with their enclosing function)."""
+    calls: List[ast.Call] = []
+
+    def _walk(n: ast.AST) -> None:
+        for ch in ast.iter_child_nodes(n):
+            if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(ch, ast.Call):
+                calls.append(ch)
+            _walk(ch)
+
+    _walk(node)
+    return calls
+
+
+def check(sf: SourceFile, repo_root: str) -> List[Finding]:
+    if sf.tree is None or not any(
+            fnmatch.fnmatch(sf.relpath, pat)
+            for pat in config.CONTROL_SURFACES):
+        return []
+
+    funcs = list(walk_functions(sf.tree))
+    calls_of: Dict[str, List[ast.Call]] = {}
+    emits: Dict[str, bool] = {}
+    by_short: Dict[str, List[str]] = {}
+    for qual, fn in funcs:
+        calls = _direct_calls(fn)
+        calls_of[qual] = calls
+        emits[qual] = any(
+            terminal_name(c.func) in config.CONTROL_AUDIT_EMITTERS
+            for c in calls)
+        by_short.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)
+
+    # caller edges, resolved by the callee's terminal name (best
+    # effort: `self._grow(...)` matches every function whose last
+    # qualname segment is `_grow`)
+    callers: Dict[str, Set[str]] = {}
+    for qual, calls in calls_of.items():
+        for c in calls:
+            for target in by_short.get(terminal_name(c.func), ()):
+                callers.setdefault(target, set()).add(qual)
+
+    def _audited(qual: str, stack: frozenset) -> bool:
+        if emits.get(qual):
+            return True
+        cs = [c for c in sorted(callers.get(qual, ()))
+              if c != qual and c not in stack]
+        if not cs:
+            return False
+        nxt = stack | {qual}
+        return all(_audited(c, nxt) for c in cs)
+
+    findings: List[Finding] = []
+
+    def _flag(call: ast.Call, action: str, where: str) -> None:
+        findings.append(sf.finding(
+            "GL107", "error", call,
+            f"side-effecting control action `{action}` {where} with no "
+            f"{{\"kind\": \"control\"}} audit record on its decision "
+            f"path", _HINT))
+
+    for qual, calls in calls_of.items():
+        for c in calls:
+            action = terminal_name(c.func)
+            if action in config.CONTROL_ACTIONS \
+                    and not _audited(qual, frozenset()):
+                _flag(c, action,
+                      f"in `{qual}` (neither it nor its in-module "
+                      f"callers record)")
+
+    # module-level action calls (incl. class bodies): no decision path
+    in_func = {id(c) for calls in calls_of.values() for c in calls}
+    for c in _direct_calls(sf.tree):
+        if id(c) in in_func:
+            continue
+        action = terminal_name(c.func)
+        if action in config.CONTROL_ACTIONS:
+            _flag(c, action, "at module scope")
+    return findings

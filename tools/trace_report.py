@@ -80,6 +80,15 @@ def _jsonl_records(path: str) -> List[dict]:
     return out
 
 
+def _read_optional(path: str) -> List[dict]:
+    """JSONL records of a file that may not exist (control.jsonl /
+    fleet.jsonl are only written when their subsystem ran)."""
+    try:
+        return _jsonl_records(path)
+    except OSError:
+        return []
+
+
 def load_spans(path: str) -> List[dict]:
     """Spans from a telemetry JSONL file (kind == "span" lines) or a
     flight-recorder dump (one JSON object with spans/open_spans). A
@@ -216,14 +225,68 @@ def load_heartbeats(paths: List[str]) -> List[dict]:
     return out
 
 
-def render_recovery(spans: List[dict], beats: List[dict]) -> str:
+def render_recovery(spans: List[dict], beats: List[dict],
+                    controls: Optional[List[dict]] = None,
+                    fleet_events: Optional[List[dict]] = None,
+                    goodput: Optional[Dict[str, float]] = None) -> str:
     """Incident timeline for a hang→kill→restart→resume episode: the
     wedged rank's last heartbeat, the detector's kill, the restart
     epoch, and the resume step — one chronological view over the
     launcher spans (launch.epoch / launch.recovery) and the per-rank
-    worker heartbeats, ending with the measured MTTR."""
+    worker heartbeats, ending with the measured MTTR.
+
+    With `controls` (the mitigation controller's control.jsonl) and
+    `fleet_events` (fleet.jsonl) the same view renders the full
+    MITIGATION incident chain: skew detected → decision (or hold, with
+    the reason) → kill/reassign → restart epoch → resume → goodput
+    delta — every step of it straight from the audit records, so an
+    operator replays exactly what the actuator saw and why it acted."""
     ev = []  # (ts, text)
     mttrs = []
+    for c in controls or []:
+        ts = float(c.get("ts") or 0.0)
+        act = c.get("action")
+        params = c.get("params") or {}
+        inp = c.get("inputs") or {}
+        tag = f"seq={c.get('seq')}"
+        if act == "exclude_restart":
+            ev.append((ts, f"MITIGATION {tag}: exclude rank "
+                           f"{params.get('rank')} (stage "
+                           f"{params.get('stage')}, world "
+                           f"{params.get('world_before')} -> "
+                           f"{params.get('world_after')}; "
+                           f"{inp.get('classification')}, "
+                           f"{inp.get('consecutive')} consecutive slow "
+                           f"steps) -> SIGKILL + elastic restart"))
+        elif act == "reassign_stages":
+            ev.append((ts, f"MITIGATION {tag}: reassign stages "
+                           f"{params.get('stage_map')} (slow rank "
+                           f"{params.get('rank')} in stage "
+                           f"{params.get('slow_stage')} takes the "
+                           f"lightest) -> restart"))
+        elif act in ("hold_flap", "hold_cooldown", "tolerate"):
+            why = params.get("reasons") \
+                or (f"previous rank {params.get('previous_rank')} "
+                    f"{params.get('since_s')}s ago"
+                    if act == "hold_flap" else
+                    f"{params.get('remaining_s')}s remaining"
+                    if act == "hold_cooldown" else "")
+            ev.append((ts, f"mitigation {tag}: {act} rank "
+                           f"{inp.get('rank', params.get('rank'))} "
+                           f"({why})"))
+        # init/observe records are bookkeeping, not incidents
+    for fe in fleet_events or []:
+        e = fe.get("event")
+        ts = float(fe.get("ts") or 0.0)
+        if e == "straggler":
+            ev.append((ts, f"STRAGGLER rank={fe.get('rank')} step "
+                           f"{fe.get('step')}: {fe.get('dur_s')}s vs "
+                           f"median {fe.get('median_s')}s "
+                           f"({fe.get('consecutive')} consecutive; "
+                           f"dominant {fe.get('dominant_span')!r})"))
+        elif e == "rank_retired":
+            ev.append((ts, f"rank {fe.get('rank')} retired from the "
+                           "fleet join (excluded)"))
     for s in spans:
         name = s.get("name")
         lab = s.get("labels") or {}
@@ -303,6 +366,22 @@ def render_recovery(spans: List[dict], beats: List[dict]) -> str:
                    f"{mttrs[-1]:.3f}s"
                    + (f" (episodes: {len(mttrs)})"
                       if len(mttrs) > 1 else ""))
+    if controls:
+        seqs = [c.get("seq") for c in controls
+                if c.get("seq") is not None]
+        gaps = [(a, b) for a, b in zip(seqs, seqs[1:]) if b != a + 1]
+        out.append(f"  audit stream: {len(controls)} control records, "
+                   + ("seq contiguous"
+                      if not gaps and seqs and seqs[0] == 1
+                      else f"seq GAPS at {gaps} (tampered or torn?)"))
+    if goodput and len(goodput) >= 2 and "toleration" in goodput \
+            and "mitigation" in goodput and goodput["toleration"] > 0:
+        delta = (goodput["mitigation"] / goodput["toleration"] - 1.0) \
+            * 100.0
+        out.append("  goodput: "
+                   + ", ".join(f"{arm}={v:.4f}"
+                               for arm, v in sorted(goodput.items()))
+                   + f" ({delta:+.1f}% from mitigation)")
     return "\n".join(out)
 
 
@@ -718,12 +797,36 @@ def main(argv=None) -> int:
         spans.sort(key=lambda s: float(s.get("start") or 0.0))
     if a.recovery:
         hb_files = list(files) + list(a.heartbeat)
+        controls: List[dict] = []
+        fleet_events: List[dict] = []
+        goodput: Dict[str, float] = {}
         for d in list(a.dir) + [p for p in a.paths if os.path.isdir(p)]:
             import glob as _glob
             hb_files.extend(sorted(_glob.glob(
                 os.path.join(d, "heartbeat*.jsonl"))))
+            # the mitigation audit stream + the fleet event log live
+            # beside the heartbeats in the launcher log dir
+            for rec in _read_optional(os.path.join(d, "control.jsonl")):
+                if rec.get("kind") == "control":
+                    controls.append(rec)
+            for rec in _read_optional(os.path.join(d, "fleet.jsonl")):
+                if rec.get("kind") == "fleet":
+                    fleet_events.append(rec)
+        for path in files:
+            for rec in _read_optional(path):
+                if rec.get("kind") == "control":
+                    controls.append(rec)
+                elif rec.get("name") == "robustness.goodput":
+                    arm = (rec.get("labels") or {}).get("arm")
+                    if arm:
+                        goodput[str(arm)] = float(rec.get("value")
+                                                  or 0.0)
+        controls.sort(key=lambda c: (c.get("ts") or 0, c.get("seq")
+                                     or 0))
         beats = load_heartbeats(hb_files)
-        print(render_recovery(spans, beats))
+        print(render_recovery(spans, beats, controls=controls,
+                              fleet_events=fleet_events,
+                              goodput=goodput))
     else:
         print(render(spans, top_requests=a.requests,
                      waterfall_steps=a.steps, request_id=a.request))

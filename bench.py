@@ -2457,6 +2457,91 @@ def serve_replay_bench(a):
     return 0
 
 
+def _assert_request_traces(repo, path, spans, hist_ex):
+    """End-to-end tracing acceptance (docs/OBSERVABILITY.md "Request
+    tracing"), asserted from the JSONL sink alone: every routed request
+    is exactly ONE connected trace — a `router.request` root minted at
+    admission, every serve-loop span adopted under it, every `parent`
+    id resolving inside the trace — whose critical-path stage
+    decomposition sums to the measured TTFT/E2E within 5%; the
+    upper-quantile histogram exemplars resolve to real traces; and
+    `tools/trace_report.py --request` renders the cross-role waterfall
+    under `python -I` (stdlib-only, like the other report tools)."""
+    import subprocess
+
+    from paddle_tpu.observability import critpath
+
+    assert spans, "no span records in the sink"
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s.get("trace"), []).append(s)
+    roots = [s for s in spans if s.get("name") == "router.request"]
+    assert roots, "no router.request roots in the sink"
+    handed_off = 0
+    for r in roots:
+        tr = by_trace[r["trace"]]
+        # exactly one trace per request: this root is the trace's ONLY
+        # parent-less span (request ids restart per router instance,
+        # so uniqueness is per trace, not per rid string)
+        extra_roots = [s.get("name") for s in tr
+                       if not s.get("parent")
+                       and s.get("span") != r.get("span")]
+        assert not extra_roots, \
+            (f"trace {r['trace']} has extra roots {extra_roots} — a "
+             f"boundary re-minted instead of adopting")
+        ids = {s.get("span") for s in tr}
+        orphans = [s.get("name") for s in tr
+                   if s.get("parent") and s["parent"] not in ids]
+        assert not orphans, \
+            f"orphan spans in trace {r['trace']}: {orphans}"
+        sreqs = [s for s in tr if s.get("name") == "serve.request"]
+        assert sreqs, f"trace {r['trace']} never reached a serve loop"
+        handed_off += len(sreqs) >= 2
+        if r.get("status") != "ok":
+            continue
+        d = critpath.stage_decomposition(tr, trace_id=r["trace"])
+        total = sum(sec for _, sec in d["stages"])
+        e2e = float(r.get("dur") or 0.0)
+        assert abs(total - e2e) <= 0.05 * max(e2e, 1e-6) + 1e-6, \
+            (f"stage sum {total:.6f}s != measured e2e {e2e:.6f}s for "
+             f"{r['trace']}: {d['stages']}")
+        ft = None
+        for ev in r.get("events") or ():
+            if ev.get("name") == "first_token":
+                ft = float(ev["ts"]) - float(r["start"])
+                break
+        if ft is not None:
+            assert d["ttft"] is not None and \
+                abs(d["ttft"] - ft) <= 0.05 * max(ft, 1e-6) + 1e-6, \
+                (f"stage ttft {d['ttft']} != measured {ft:.6f}s for "
+                 f"{r['trace']}")
+    assert handed_off >= 1, \
+        "no disaggregated trace carries both role spans"
+    ex_names = set()
+    for rec in hist_ex:
+        for ex in rec["exemplars"]:
+            assert ex["trace"] in by_trace, \
+                (f"{rec['name']} exemplar {ex['trace']} resolves to no "
+                 f"exported trace")
+        ex_names.add(rec["name"])
+    assert "serving.router.ttft_seconds" in ex_names, \
+        f"ttft histogram exported no exemplars: {sorted(ex_names)}"
+    probe = next((r["trace"] for r in roots
+                  if sum(s.get("name") == "serve.request"
+                         for s in by_trace[r["trace"]]) >= 2),
+                 roots[0]["trace"])
+    rep = subprocess.run(
+        [sys.executable, "-I",
+         os.path.join(repo, "tools", "trace_report.py"),
+         path, "--request", probe],
+        capture_output=True, text=True, timeout=120)
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert probe in rep.stdout and "critical path" in rep.stdout, \
+        rep.stdout[-2000:]
+    return {"traces": len(roots), "handed_off_traces": handed_off,
+            "exemplar_series": sorted(ex_names)}
+
+
 def serve_disagg_bench(a):
     """Disaggregated prefill/decode scenario (`--serve --disagg`): the
     KV page-span handoff acceptance. Three arms over one workload — a
@@ -2705,14 +2790,22 @@ def serve_disagg_bench(a):
 
     # ---- claims, asserted from the JSONL alone -----------------------
     arms = {}
+    spans = []
+    hist_ex = []
     with open(path) as f:
         for line in f:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if rec.get("kind") == "disagg_arm":
+            k = rec.get("kind")
+            if k == "disagg_arm":
                 arms[rec["arm"]] = rec
+            elif k == "span":
+                spans.append(rec)
+            elif k == "histogram" and rec.get("exemplars"):
+                hist_ex.append(rec)
+    trace_aux = _assert_request_traces(repo, path, spans, hist_ex)
     if smoke:
         dis, uni = arms["disagg"], arms["unified"]
         assert dis["handoff"]["count"] >= 1, \
@@ -2732,7 +2825,8 @@ def serve_disagg_bench(a):
             "aux": {"backend": jax.default_backend(), "smoke": True,
                     "handoff_bytes": dis["handoff"]["bytes"],
                     "handoff_p99_ms": dis["handoff"]["p99_ms"],
-                    "greedy_parity": True, "telemetry": path,
+                    "greedy_parity": True, "tracing": trace_aux,
+                    "telemetry": path,
                     "bench_code_sha": _bench_code_sha()},
         }
     else:
@@ -2783,7 +2877,7 @@ def serve_disagg_bench(a):
                     "disagg_tokens_per_s": dis["tokens_per_s"],
                     "unified_tokens_per_s": uni["tokens_per_s"],
                     "handoffs": dis["handoff"],
-                    "telemetry": path,
+                    "tracing": trace_aux, "telemetry": path,
                     "bench_code_sha": _bench_code_sha()},
         }
     print(json.dumps(result))

@@ -339,15 +339,22 @@ class KVPageSpan:
     prompt (trailing partial page zero-padded past its valid tokens).
     `checksum` is a SHA-256 over header + payload, verified on import
     (a corrupted span is rejected, never half-materialized).
+
+    `trace` is an optional plain-dict TraceContext
+    (observability.tracing.TraceContext.to_dict) stamped by the router
+    at handoff so the decode side's spans join the request's trace.
+    Like `topology`, it is transport metadata — NOT part of the
+    checksum (the same KV payload re-handed with a different trace
+    must still verify).
     """
 
     __slots__ = ("prompt", "next_token", "page_size", "n_kv_heads",
                  "head_dim", "dtype", "topology", "k_pages", "v_pages",
-                 "checksum")
+                 "checksum", "trace")
 
     def __init__(self, prompt, next_token, page_size, n_kv_heads,
                  head_dim, dtype, topology, k_pages, v_pages,
-                 checksum=None):
+                 checksum=None, trace=None):
         self.prompt = tuple(prompt)
         self.next_token = next_token
         self.page_size = int(page_size)
@@ -357,6 +364,7 @@ class KVPageSpan:
         self.topology = str(topology)
         self.k_pages = list(k_pages)
         self.v_pages = list(v_pages)
+        self.trace = dict(trace) if trace else None
         self.checksum = (checksum if checksum is not None
                          else self.compute_checksum())
 

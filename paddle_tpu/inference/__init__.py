@@ -769,7 +769,7 @@ class ContinuousBatchingPredictor:
         return RuntimeConfig.from_flags()
 
     # ---------------------------------------------------- disaggregation --
-    def export_request_span(self, prompt):
+    def export_page_span(self, prompt):
         """Serialize the KV pages covering `prompt` into a KVPageSpan
         for prefill→decode handoff (docs/SERVING.md "Disaggregated
         prefill/decode"). The pages and the first generated token come
@@ -796,7 +796,7 @@ class ContinuousBatchingPredictor:
             return None
         return self.pool.export_span(prompt, ids, next_token)
 
-    def import_request_span(self, span):
+    def import_page_span(self, span):
         """Materialize a handoff KVPageSpan into this replica's pool +
         prefix trie (decode side), deduping against already-resident
         prefix pages. Returns the pool's import stats dict; raises on a
@@ -807,14 +807,33 @@ class ContinuousBatchingPredictor:
 
         Runs on the replica worker thread between serve-generator
         ticks (same single-threaded bookkeeping contract as
-        `export_request_span`).
+        `export_page_span`).
         """
         if self.prefix_cache is None:
             raise ValueError(
-                "import_request_span needs the prefix cache "
+                "import_page_span needs the prefix cache "
                 "(enable_prefix_cache=True) — the imported span is "
                 "handed to the serve loop through the trie")
         return self.pool.import_span(span, self.prefix_cache)
+
+    def export_request_span(self, prompt):
+        """Deprecated alias for :meth:`export_page_span`. The method
+        serializes a KV *page* span; it was renamed so request tracing
+        *spans* (observability.tracing) don't collide with it."""
+        import warnings
+        warnings.warn(
+            "export_request_span is renamed export_page_span",
+            DeprecationWarning, stacklevel=2)
+        return self.export_page_span(prompt)
+
+    def import_request_span(self, span):
+        """Deprecated alias for :meth:`import_page_span` (see
+        :meth:`export_request_span` for the rename rationale)."""
+        import warnings
+        warnings.warn(
+            "import_request_span is renamed import_page_span",
+            DeprecationWarning, stacklevel=2)
+        return self.import_page_span(span)
 
     def _bucket_len(self, n):
         """Admission prompt bucket: smallest tuned-table entry covering
@@ -1363,6 +1382,7 @@ class ContinuousBatchingPredictor:
                         "Raise max_seq_len/num_pages, shorten the "
                         "prompt, or pass strict=False to reject it and "
                         "serve the rest.")
+        # graft-lint: ok[GL108] local list API: roots under serve.generate
         reqs = [ServeRequest(list(p), int(max_new_tokens),
                              tiers[r] if tiers is not None else None,
                              per_dl[r], None, per_sp[r])
@@ -1526,8 +1546,15 @@ class ContinuousBatchingPredictor:
                 status.append("queued")
             self._req_seq += 1
             tl = {"tier": sreq.tier} if sreq.tier is not None else {}
+            # cross-boundary trace adoption: a ServeRequest carrying a
+            # TraceContext (the router's admission-minted identity)
+            # parents this span on it, so the replica's spans join the
+            # submitter's trace; without one the span roots locally
+            # under this call's serve.generate span
+            tr = getattr(sreq, "trace", None)
             req_sp.append(_obstr.start_span(
-                "serve.request", parent=gen_sp,
+                "serve.request", parent=(tr if tr is not None
+                                         else gen_sp),
                 request_id=f"req{self._req_seq}", idx=r,
                 prompt_len=len(p), **tl, **mlbl))
             uns = self._unservable(p, mn)

@@ -54,9 +54,12 @@ from .fleet import (  # noqa: F401
     FleetAggregator, StragglerDetector, RankFileTailer,
 )
 from .tracing import (  # noqa: F401
-    Span, NULL_SPAN, span, start_span, traced, current_span,
-    FlightRecorder, flight_recorder, flight_dump, flight_dir,
-    set_flight_dir, to_chrome_trace, write_chrome_trace,
+    Span, TraceContext, NULL_SPAN, span, start_span, traced,
+    current_span, FlightRecorder, flight_recorder, flight_dump,
+    flight_dir, set_flight_dir, to_chrome_trace, write_chrome_trace,
+)
+from .critpath import (  # noqa: F401
+    stage_decomposition, trace_tree,
 )
 
 __all__ = [
@@ -69,8 +72,8 @@ __all__ = [
     "Ewma", "SLOSpec", "SLOEngine", "default_serving_slos",
     "FleetAggregator",
     "StragglerDetector", "RankFileTailer",
-    "Span", "NULL_SPAN", "span", "start_span",
+    "Span", "TraceContext", "NULL_SPAN", "span", "start_span",
     "traced", "current_span", "FlightRecorder", "flight_recorder",
     "flight_dump", "flight_dir", "set_flight_dir", "to_chrome_trace",
-    "write_chrome_trace",
+    "write_chrome_trace", "stage_decomposition", "trace_tree",
 ]

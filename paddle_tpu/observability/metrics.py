@@ -38,6 +38,7 @@ DEFAULT_BUCKETS = (
 )
 
 _RAW_CAP = 2048  # per-series reservoir for exact quantiles
+_EXEMPLAR_CAP = 4  # per-series tail exemplars (largest observations)
 
 
 class _State:
@@ -231,7 +232,7 @@ class Gauge(_Metric):
 
 class _HistogramSeries:
     __slots__ = ("_buckets", "_counts", "_count", "_sum", "_min", "_max",
-                 "_raw", "_labels", "_lock")
+                 "_raw", "_exemplars", "_labels", "_lock")
 
     def __init__(self, buckets):
         self._buckets = buckets
@@ -241,9 +242,13 @@ class _HistogramSeries:
         self._min = math.inf
         self._max = -math.inf
         self._raw: List[float] = []
+        # tail exemplars: the _EXEMPLAR_CAP largest observations that
+        # carried a trace id — the forensic bridge from an aggregate
+        # upper quantile to the exact requests behind it
+        self._exemplars: List[Tuple[float, str]] = []
         self._lock = threading.Lock()
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[str] = None):
         if not _state.enabled:
             return
         v = float(value)
@@ -260,6 +265,18 @@ class _HistogramSeries:
                 # decimate rather than slide: old+new samples both survive
                 del raw[::2]
             raw.append(v)
+            if exemplar is not None:
+                ex = self._exemplars
+                if len(ex) < _EXEMPLAR_CAP or v > ex[-1][0]:
+                    ex.append((v, str(exemplar)))
+                    ex.sort(key=lambda p: -p[0])
+                    del ex[_EXEMPLAR_CAP:]
+
+    def exemplars(self) -> List[Tuple[float, str]]:
+        """(value, trace_id) pairs for the retained tail, largest
+        first."""
+        with self._lock:
+            return list(self._exemplars)
 
     @property
     def count(self):
@@ -303,23 +320,40 @@ class Histogram(_Metric):
     def _new_series(self):
         return _HistogramSeries(self.buckets)
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels):
         if not _state.enabled:
             return
-        self.labels(**labels).observe(value)
+        self.labels(**labels).observe(value, exemplar=exemplar)
 
     def quantile(self, q: float, **labels) -> float:
         s = self._peek(labels)
         return s.quantile(q) if s is not None else 0.0
 
+    def exemplars(self, **labels) -> List[Tuple[float, str]]:
+        """Tail exemplars of one series (largest first); every series'
+        pooled tail when no labels are given."""
+        if labels:
+            s = self._peek(labels)
+            return s.exemplars() if s is not None else []
+        out: List[Tuple[float, str]] = []
+        for s in self.series():
+            out.extend(s.exemplars())
+        out.sort(key=lambda p: -p[0])
+        return out[:_EXEMPLAR_CAP]
+
     def samples(self):
         for s in self.series():
-            yield Sample(
-                self.name, self.kind, s._labels, s.mean,
-                extra={"count": s._count, "sum": s._sum,
-                       "min": None if s._count == 0 else s._min,
-                       "max": None if s._count == 0 else s._max,
-                       "p50": s.quantile(0.5), "p99": s.quantile(0.99)})
+            extra = {"count": s._count, "sum": s._sum,
+                     "min": None if s._count == 0 else s._min,
+                     "max": None if s._count == 0 else s._max,
+                     "p50": s.quantile(0.5), "p99": s.quantile(0.99)}
+            ex = s.exemplars()
+            if ex:
+                extra["exemplars"] = [
+                    {"value": round(v, 6), "trace": t} for v, t in ex]
+            yield Sample(self.name, self.kind, s._labels, s.mean,
+                         extra=extra)
 
 
 class MetricRegistry:

@@ -360,7 +360,8 @@ class SLOEngine:
                "window_slow_s": self.slow_window_s,
                "events_fast": list(status["events"]["fast"]),
                "events_slow": list(status["events"]["slow"]),
-               "evidence": self._evidence(spec)}
+               "evidence": self._evidence(spec),
+               "exemplars": self._exemplars(spec)}
         export_record(rec)
 
     def _evidence(self, spec: SLOSpec) -> List[dict]:
@@ -385,6 +386,19 @@ class SLOEngine:
                         "dur": round(sp.get("dur", 0.0), 6),
                         "status": sp.get("status"), "labels": labels})
         return out
+
+    def _exemplars(self, spec: SLOSpec) -> List[dict]:
+        """Tail exemplars off the spec's bound histogram: the trace
+        ids of its largest observations, so a burn page links straight
+        to renderable traces (tools/trace_report.py --request)."""
+        m = self._metric_for(spec)
+        if m is None or not hasattr(m, "exemplars"):
+            return []     # ratio specs bind counters: no exemplars
+        try:
+            ex = m.exemplars(**spec.labels) or m.exemplars()
+        except Exception:
+            return []
+        return [{"value": round(v, 6), "trace": t} for v, t in ex]
 
     # ------------------------------------------------------ convenience --
     def burn(self, name: str, window: str = "fast") -> float:

@@ -43,9 +43,10 @@ from typing import Dict, List, Optional
 from .metrics import enabled, get_registry
 
 __all__ = [
-    "Span", "NULL_SPAN", "span", "start_span", "traced", "current_span",
-    "FlightRecorder", "flight_recorder", "flight_dump", "flight_dir",
-    "set_flight_dir", "to_chrome_trace", "write_chrome_trace",
+    "Span", "TraceContext", "NULL_SPAN", "span", "start_span", "traced",
+    "current_span", "FlightRecorder", "flight_recorder", "flight_dump",
+    "flight_dir", "set_flight_dir", "to_chrome_trace",
+    "write_chrome_trace",
 ]
 
 # own RNG: span ids must not perturb (or be perturbed by) user-level
@@ -101,6 +102,9 @@ class _NullSpan:
     def end(self, status=None, **labels):
         return self
 
+    def context(self, **baggage):
+        return None   # disabled: nothing to propagate
+
     def __enter__(self):
         return self
 
@@ -114,6 +118,53 @@ class _NullSpan:
 NULL_SPAN = _NullSpan()
 
 
+class TraceContext:
+    """Serializable trace identity for crossing a boundary the span
+    object itself cannot cross (another thread's serve loop, a queue, a
+    KV page-span handoff record, another process).
+
+    A context names a parent: a span created with ``parent=ctx`` joins
+    ``ctx.trace_id`` with ``parent_id = ctx.span_id``, so the receiving
+    side's spans chain under the sender's without sharing memory.
+    ``baggage`` carries request-scoped attribution (tenant/tier/role)
+    that boundaries may stamp onto their own spans' labels.
+
+    The dict form (:meth:`to_dict`/:meth:`from_dict`) is plain JSON
+    and is what rides records like the serving handoff payload."""
+
+    __slots__ = ("trace_id", "span_id", "baggage")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 baggage: Optional[Dict] = None):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id)
+        self.baggage = dict(baggage) if baggage else {}
+
+    def to_dict(self) -> dict:
+        d = {"trace": self.trace_id, "span": self.span_id}
+        if self.baggage:
+            d["baggage"] = dict(self.baggage)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "Optional[TraceContext]":
+        """None-tolerant: a record without a context decodes to None
+        (the receiver then falls back to its local root)."""
+        if not d or "trace" not in d or "span" not in d:
+            return None
+        return cls(d["trace"], d["span"], d.get("baggage"))
+
+    def __repr__(self):
+        return (f"TraceContext(trace={self.trace_id!r}, "
+                f"span={self.span_id!r}, baggage={self.baggage!r})")
+
+    def __eq__(self, other):
+        return (isinstance(other, TraceContext)
+                and other.trace_id == self.trace_id
+                and other.span_id == self.span_id
+                and other.baggage == self.baggage)
+
+
 class Span:
     """One timed operation. Create via :func:`span` (context manager,
     joins the thread-local stack) or :func:`start_span` (explicit
@@ -125,10 +176,14 @@ class Span:
 
     recording = True
 
-    def __init__(self, name: str, parent: Optional["Span"] = None,
+    def __init__(self, name: str,
+                 parent: "Optional[Span | TraceContext]" = None,
                  trace_id: Optional[str] = None,
                  labels: Optional[Dict] = None):
         self.name = name
+        # `parent` may be a live Span (same thread) or a TraceContext
+        # carried across a boundary — either way the child joins the
+        # parent's trace with a resolvable parent_id
         self.parent_id = parent.span_id if parent else None
         self.trace_id = trace_id or (parent.trace_id if parent
                                      else _new_id())
@@ -171,6 +226,12 @@ class Span:
     def set_label(self, **labels):
         self.labels.update(labels)
         return self
+
+    def context(self, **baggage) -> "TraceContext":
+        """Mint a :class:`TraceContext` naming this span as the parent
+        for spans created across a boundary (thread, queue, handoff
+        record, process)."""
+        return TraceContext(self.trace_id, self.span_id, baggage)
 
     def end(self, status: Optional[str] = None, **labels):
         """Finish the span (idempotent): records duration, moves it from
@@ -231,7 +292,8 @@ class Span:
 def span(name: str, parent=_UNSET, trace_id: Optional[str] = None,
          **labels) -> "Span | _NullSpan":
     """Context-manager span: nests under the current thread-local span
-    unless an explicit ``parent`` (or ``parent=None`` for a root) is
+    unless an explicit ``parent`` (a Span, a :class:`TraceContext`
+    carried across a boundary, or ``parent=None`` for a root) is
     given. No-op when telemetry is disabled."""
     if not enabled():
         return NULL_SPAN

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 from ..framework import faults as _faults
 from ..generation.kv_cache import prefix_page_keys
+from ..observability import critpath as _critpath
 from ..observability import metrics as _obsm
 from ..observability import tracing as _obstr
 from .scheduler import stage_cost
@@ -97,6 +98,13 @@ class RequestHandle:
             "router.request", parent=None, request_id=rid,
             prompt_len=len(self.prompt),
             **({"tier": tier} if tier else {}))
+        # the request's TraceContext, minted once at admission and
+        # carried on EVERY boundary (ServeRequest intake, the KV
+        # page-span handoff record, re-admissions) so spans on other
+        # threads/replicas join this trace instead of minting fresh
+        # ones. None when telemetry is disabled.
+        self.trace = self.span.context(
+            request_id=rid, **({"tier": tier} if tier else {}))
 
     # ------------------------------------------------- replica-side API --
     def _push_token(self, ev: StreamEvent):
@@ -274,7 +282,7 @@ class Replica:
                 # on the decode fleet after the span handoff
                 mn = 1
             out.append(ServeRequest(h.prompt, mn, h.tier,
-                                    h.deadline_s, h))
+                                    h.deadline_s, h, trace=h.trace))
         return out
 
     def _import_handoff(self, h: RequestHandle):
@@ -283,6 +291,11 @@ class Replica:
         decode-side pages resident; failures record a reason and leave
         the request to prefill from scratch."""
         r = self.router
+        # marks decode-side arrival: the gap from the prefill side's
+        # "handoff" event to here is the transfer leg of the critical
+        # path (critpath stage "handoff_transfer"); from here to
+        # "handoff_imported" is the import leg
+        h.span.event("handoff_import_start", replica=self.name)
         fa = _faults.check("handoff_corrupt")
         if fa is not None:
             # bitrot-in-transit: flip one payload byte BEFORE import.
@@ -302,7 +315,7 @@ class Replica:
                     flat[idx] ^= 0xFF
                     break
         try:
-            stats = self.predictor.import_request_span(h.handoff_span)
+            stats = self.predictor.import_page_span(h.handoff_span)
         except MemoryError:
             r._m_handoff_fb.inc(reason="alloc", replica=self.name)
             h.span.event("handoff_import_failed", reason="alloc")
@@ -523,6 +536,11 @@ class Router:
                                        unit="s")
         self._m_e2e = _obsm.histogram("serving.router.e2e_seconds",
                                       unit="s")
+        # per-stage critical-path decomposition (critpath.py): one
+        # observation per stage per completed request, telescoping so
+        # a request's stage values sum to its e2e latency
+        self._m_stage = _obsm.histogram("serve.request.stage.seconds",
+                                        unit="s")
         self._m_done = _obsm.counter("serving.router.completed")
         self._m_shed = _obsm.counter("serving.router.shed")
         self._m_pool = _obsm.counter("serving.router.pool_resizes")
@@ -653,11 +671,37 @@ class Router:
     # -------------------------------------------------- replica feedback --
     def _request_done(self, h: RequestHandle, status: str, ts: float):
         tl = {"tier": h.tier} if h.tier else {}
+        # tail exemplars: the latency histograms keep the trace ids of
+        # their largest observations, so a p99 on the dashboard links
+        # straight to a renderable trace (tools/trace_report.py)
+        ex = h.span.trace_id
         if h.first_token_ts is not None:
-            self._m_ttft.observe(h.first_token_ts - h.submit_ts, **tl)
-        self._m_e2e.observe((ts or time.time()) - h.submit_ts, **tl)
+            self._m_ttft.observe(h.first_token_ts - h.submit_ts,
+                                 exemplar=ex, **tl)
+        self._m_e2e.observe((ts or time.time()) - h.submit_ts,
+                            exemplar=ex, **tl)
         self._m_done.inc(status=status, **tl)
         h._finish(status, ts)
+        self._observe_stages(h)
+
+    def _observe_stages(self, h: RequestHandle):
+        """Export the finished request's critical-path decomposition as
+        serve.request.stage.seconds{stage=...} observations (with the
+        trace id as exemplar). Telemetry must never break serving —
+        any failure here is swallowed."""
+        if not h.span.recording:
+            return
+        try:
+            spans = [s for s in _obstr.flight_recorder().spans()
+                     if s.get("trace") == h.span.trace_id]
+            d = _critpath.stage_decomposition(
+                spans, trace_id=h.span.trace_id)
+            tl = {"tier": h.tier} if h.tier else {}
+            for stage, secs in d["stages"]:
+                self._m_stage.observe(secs, exemplar=h.span.trace_id,
+                                      stage=stage, **tl)
+        except Exception:
+            pass
 
     def _handoff(self, h: RequestHandle, rep: Replica):
         """Prefill stage finished: export the request's KV page span
@@ -669,10 +713,15 @@ class Router:
         h._handoff_t0 = time.perf_counter()
         span = None
         try:
-            span = rep.predictor.export_request_span(h.prompt)
+            span = rep.predictor.export_page_span(h.prompt)
         except Exception as e:
             h.span.event("handoff_export_failed",
                          error=f"{type(e).__name__}: {e}")
+        if span is not None and h.trace is not None:
+            # the handoff record carries the trace across the
+            # prefill->decode process boundary (plain dict: the record
+            # may be serialized); checksum excludes it by design
+            span.trace = h.trace.to_dict()
         if span is None:
             self._m_handoff_fb.inc(reason="export_miss",
                                    replica=rep.name)

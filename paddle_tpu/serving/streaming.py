@@ -65,13 +65,18 @@ class ServeRequest(NamedTuple):
     `sampling` is an optional generation.sampling.SamplingParams —
     per-request temperature/top-k/top-p/seed served as batched operands
     by the on-device sampling decode program (the predictor must be
-    constructed with ``sampling_enabled=True``; None = greedy)."""
+    constructed with ``sampling_enabled=True``; None = greedy).
+    `trace` is an optional observability.TraceContext: the serve loop
+    parents its ``serve.request`` span on it so the replica's spans
+    join the submitter's trace instead of minting a fresh one (None =
+    local root under ``serve.generate``)."""
     prompt: List[int]
     max_new_tokens: int = 32
     tier: Optional[str] = None
     deadline_s: Optional[float] = None
     meta: object = None
     sampling: object = None
+    trace: object = None
 
 
 class TokenStream:

@@ -237,6 +237,38 @@ _SLOW_TESTS = (
     # page-free, zero-compile capture) plus the varq kernel tests.
     "test_mixed_step.py::TestMixedBenchSection::"
     "test_serve_mixed_bench_smoke",
+    # PR 20: the full two-role disaggregated waterfall (its synthetic
+    # stage/waterfall twins and the unified-pool propagation test stay
+    # tier-1, and the bench --disagg --smoke arm asserts the same
+    # one-trace/stage-sum invariants end-to-end)
+    "test_request_tracing.py::TestDisaggWaterfallSlow::"
+    "test_two_role_pool_one_trace_with_handoff_stages",
+    # PR 20 window trim (the canonical body crept to ~908s vs the 870s
+    # budget): the heaviest remaining parity/round-trip tests, each
+    # leaving a fast sibling or an end-to-end bench smoke in tier 1 —
+    # TP serving keeps telemetry/comm accounting, the head-sharded pool
+    # invariants, topology invalidation, and the bench --tp 2 --smoke
+    # arm (bitwise parity re-asserted from JSONL); chunked prefill
+    # keeps parity_with_unchunked_and_telemetry; serving fastpath keeps
+    # the queue-policy + prefix-cache + admission families; MoE keeps
+    # [gshard]; pallas keeps mask_fast_path + grad_parity_interpret;
+    # lint keeps the zero-findings gate + the CLI subprocess smoke;
+    # diffusion keeps text_encoder_shapes + ddim_step; hybrid keeps
+    # model_axis_comm + the bench mesh smoke
+    "test_tp_serving.py::TestTPGreedyParity::test_serve_stream_parity",
+    "test_tp_serving.py::TestTPGreedyParity::test_chunked_prefill_parity",
+    "test_mixed_step.py::TestChunkedPrefill::"
+    "test_parity_on_interpret_ragged_route",
+    "test_serving_fastpath.py::TestRaggedMetaBuilder::"
+    "test_matches_from_scratch_flatten_through_kernel",
+    "test_moe.py::test_moe_layer_forward_backward[switch",
+    "test_nn.py::TestLayers::test_rnn_lstm_gru",
+    "test_pallas_train.py::test_flash_bf16_headdim64_pad_path",
+    "test_lint.py::test_baseline_cli_round_trip",
+    "test_lint.py::test_write_baseline_preserves_notes_and_scope",
+    "test_diffusion.py::TestVAE::test_roundtrip_shapes",
+    "test_hybrid.py::TestExplicit1F1B::"
+    "test_schedule_bitwise_output_and_grad_parity",
 )
 
 

@@ -1,0 +1,24 @@
+"""GL108 positive fixtures — boundaries that drop the trace context.
+
+Four violations: a dispatch building the serve-loop record without its
+context, a handoff constructing the KV page-span record bare, a
+replica adoption re-minting a parent-less root mid-request, and a
+module-scope carrier construction (no enclosing function can attach).
+"""
+
+
+class Router:
+    def dispatch(self, h):
+        return ServeRequest(h.prompt, h.max_new, h.tier)  # GL108
+
+    def handoff(self, h):
+        return KVPageSpan(h.prompt, h.tok, 16, 2, 8,      # GL108
+                          "f32", "cpu", [], [])
+
+
+def adopt(sreq, obstr):
+    return obstr.start_span("serve.request",              # GL108
+                            parent=None, request_id="r1")
+
+
+WARMUP = ServeRequest([1, 2, 3], 4)                       # GL108

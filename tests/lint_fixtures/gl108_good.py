@@ -1,0 +1,42 @@
+"""GL108 negative fixtures — every boundary carries the context.
+
+Covers: the carrier keyword, the attach-after-construction idiom
+(`<record>.trace = ...` in the same function), adoption that parents
+on the carried context with a local-root fallback, an allowlisted mint
+site, and the sanction comment for a genuinely trace-free path.
+"""
+
+
+class RequestHandle:
+    def __init__(self, obstr, rid):
+        self.span = obstr.start_span("router.request", parent=None,
+                                     request_id=rid)  # allowlisted mint
+        self.trace = self.span.context(request_id=rid)
+
+
+class Router:
+    def dispatch(self, h):
+        return ServeRequest(h.prompt, h.max_new, h.tier, None, h,
+                            trace=h.trace)
+
+    def handoff(self, pool, h):
+        span = pool.export_span(h.prompt)
+        span.trace = h.trace.to_dict()        # attach-after idiom
+        return span
+
+    def handoff_rebuild(self, h):
+        rec = KVPageSpan(h.prompt, h.tok, 16, 2, 8, "f32", "cpu",
+                         [], [])
+        rec.trace = h.trace.to_dict()         # same function attaches
+        return rec
+
+
+def adopt(sreq, obstr, gen_sp):
+    tr = getattr(sreq, "trace", None)
+    return obstr.start_span("serve.request",
+                            parent=(tr if tr is not None else gen_sp))
+
+
+def legacy_enqueue(prompt):
+    # local list-API path: never crosses a process boundary
+    return ServeRequest(prompt, 8)  # graft-lint: ok[GL108] local call

@@ -206,6 +206,48 @@ def test_gl107_real_controllers_are_audited():
     assert got == [], [f.render() for f in got]
 
 
+# ---------------------------------------------------------------- GL108 --
+
+@pytest.fixture
+def trace_fixture_registered(monkeypatch):
+    extra = ("tests/lint_fixtures/gl108_*.py",)
+    monkeypatch.setattr(lint_config, "TRACE_BOUNDARIES",
+                        lint_config.TRACE_BOUNDARIES + extra)
+
+
+def test_gl108_bad_fires_per_site(trace_fixture_registered):
+    got = findings_for("gl108_bad.py", {"GL108"})
+    assert len(got) == 4, [f.render() for f in got]
+    msgs = " | ".join(f.message for f in got)
+    assert "`ServeRequest`" in msgs           # bare dispatch record
+    assert "`KVPageSpan`" in msgs             # bare handoff record
+    assert "parent-less root span" in msgs    # re-mint in adopt()
+    assert "module scope" in msgs             # WARMUP constant
+    assert all(f.severity == "error" for f in got)
+
+
+def test_gl108_carried_attached_and_sanctioned_clean(
+        trace_fixture_registered):
+    got = findings_for("gl108_good.py", {"GL108"})
+    assert got == [], [f.render() for f in got]
+
+
+def test_gl108_outside_trace_boundaries_silent():
+    """Without the fixture boundary registration the same file is out
+    of scope: tests/benches constructing carrier records locally are
+    not request boundaries."""
+    got = findings_for("gl108_bad.py", {"GL108"})
+    assert got == [], [f.render() for f in got]
+
+
+def test_gl108_real_boundaries_are_clean():
+    """The shipped boundary files — router, streaming, the serve
+    loop — must carry the context everywhere (sanctions included)."""
+    paths = [os.path.join(REPO, p) for p in lint_config.TRACE_BOUNDARIES]
+    got = run_passes(paths, REPO, rules={"GL108"})
+    assert got == [], [f.render() for f in got]
+
+
 # ---------------------------------------------------------------- GL105 --
 
 def _write(path, text):

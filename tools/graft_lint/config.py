@@ -66,6 +66,11 @@ HOT_PATH_FUNCTIONS = (
     # prefill→decode handoff endpoints on the predictor: run on the
     # replica worker thread between serve-loop ticks — any sync beyond
     # the span payload itself stalls that replica's decode clock
+    # (export/import_request_span are the deprecated-shim aliases)
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor.export_page_span"),
+    ("paddle_tpu/inference/__init__.py",
+     "ContinuousBatchingPredictor.import_page_span"),
     ("paddle_tpu/inference/__init__.py",
      "ContinuousBatchingPredictor.export_request_span"),
     ("paddle_tpu/inference/__init__.py",
@@ -193,6 +198,33 @@ CONTROL_AUDIT_EMITTERS = frozenset({
     "offer",
     "_emit_control",
 })
+
+# --------------------------------------------------------------- GL108 --
+# Cross-boundary trace-propagation surfaces: the files where a request
+# crosses a thread/queue/process boundary (router dispatch into the
+# serve loop, prefill→decode page-span handoff, replica adoption).
+# Inside them, boundary-record constructors must carry the request's
+# TraceContext and parent-less root spans may only be minted at the
+# configured admission sites (docs/OBSERVABILITY.md "Request tracing").
+TRACE_BOUNDARIES = (
+    "paddle_tpu/serving/router.py",
+    "paddle_tpu/serving/streaming.py",
+    "paddle_tpu/inference/__init__.py",
+)
+# Boundary-crossing record constructors -> the field that carries the
+# context. A construction without the keyword (and without a
+# `<record>.trace = ...` attach in the same function) drops the trace.
+TRACE_CARRIERS = {
+    "ServeRequest": "trace",
+    "KVPageSpan": "trace",
+}
+# Functions (qualname globs) allowed to mint a parent-less root span
+# inside a boundary file: router admission (THE per-request root) and
+# the serve loop's pool-local serve.generate umbrella.
+TRACE_MINT_SITES = (
+    "RequestHandle.__init__",
+    "ContinuousBatchingPredictor._serve",
+)
 
 # Standalone tool entry points linted by the default CLI run alongside
 # paddle_tpu/ (the autotune replay engine and the other telemetry

@@ -7,6 +7,7 @@ from .locks import check as _locks
 from .catalog import check as _catalog
 from .rtconfig import check as _rtconfig
 from .control_audit import check as _control_audit
+from .trace_propagation import check as _trace_propagation
 
 FILE_PASSES = (
     ("GL101", _donation),
@@ -15,6 +16,7 @@ FILE_PASSES = (
     ("GL104", _locks),
     ("GL106", _rtconfig),
     ("GL107", _control_audit),
+    ("GL108", _trace_propagation),
 )
 
 PROJECT_PASSES = (
@@ -39,4 +41,8 @@ RULE_DOCS = {
     "GL107": "unaudited control-plane action: a controller kills/"
              "retires/scales/sheds with no {\"kind\": \"control\"} "
              "record on its decision path",
+    "GL108": "dropped trace context: a cross-boundary handoff "
+             "constructs its carrier record without the request's "
+             "TraceContext, or re-mints a parent-less root span "
+             "mid-request",
 }

@@ -1,0 +1,137 @@
+"""GL108 — dropped trace context at a cross-boundary handoff site.
+
+End-to-end request tracing (docs/OBSERVABILITY.md "Request tracing")
+only works if EVERY boundary a request crosses carries its
+TraceContext: the router's dispatch into a replica's serve loop
+(``ServeRequest.trace``), the prefill→decode KV handoff record
+(``KVPageSpan.trace``), and the receiving side's span adoption
+(``parent=<carried context>``). One silent drop splits the request
+into disconnected traces — the waterfall ends at the boundary and the
+critical-path stage table loses every stage past it. This is exactly
+the regression class that is invisible in unit tests (each side works
+alone) and only shows up as orphan spans in production traces.
+
+Two checks, scoped to the configured boundary files
+(``config.TRACE_BOUNDARIES``):
+
+- **Carrier construction**: a call to a boundary-record constructor in
+  ``config.TRACE_CARRIERS`` (ServeRequest, KVPageSpan) must pass its
+  trace keyword, or the enclosing function must attach it afterwards
+  (an ``<x>.trace = ...`` assignment — the router stamps the exported
+  page span this way). A bare construction drops the context at the
+  boundary.
+- **Root re-mint**: a ``span(...)``/``start_span(...)`` call with an
+  explicit ``parent=None`` mints a NEW trace. Inside a boundary file
+  that is only legitimate at the configured admission/root sites
+  (``config.TRACE_MINT_SITES`` — the router handle's admission span,
+  the serve loop's pool-local ``serve.generate``); anywhere else it
+  severs the chain mid-request.
+
+Suppress a genuinely trace-free site (a local list-API call that never
+crosses a process, an admin path) with ``# graft-lint: ok[GL108] why``.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List
+
+from .. import config
+from ..core import Finding, SourceFile, kwarg, terminal_name, \
+    walk_functions
+
+_SPAN_CTORS = ("span", "start_span")
+
+_HINT_CARRIER = ("pass the boundary record's trace context "
+                 "(`trace=<handle>.trace` / "
+                 "`trace=<ctx>.to_dict()`), or attach it in this "
+                 "function (`<record>.trace = ...`); or sanction with "
+                 "`# graft-lint: ok[GL108] why`")
+_HINT_MINT = ("parent the span on the carried context "
+              "(`parent=sreq.trace` with a local-root fallback) "
+              "instead of minting a fresh trace; roots belong only to "
+              "the admission sites in config.TRACE_MINT_SITES; or "
+              "sanction with `# graft-lint: ok[GL108] why`")
+
+
+def _calls_outside_nested(node: ast.AST) -> List[ast.Call]:
+    """Call nodes lexically inside `node` but outside any nested
+    def/async def (same scoping rule as GL107)."""
+    calls: List[ast.Call] = []
+
+    def _walk(n: ast.AST) -> None:
+        for ch in ast.iter_child_nodes(n):
+            if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(ch, ast.Call):
+                calls.append(ch)
+            _walk(ch)
+
+    _walk(node)
+    return calls
+
+
+def _assigns_trace(fn: ast.AST) -> bool:
+    """True when the function contains an ``<expr>.trace = ...``
+    assignment — the attach-after-construction idiom."""
+    for n in ast.walk(fn):
+        targets = ()
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, ast.AnnAssign):
+            targets = (n.target,)
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr == "trace":
+                return True
+    return False
+
+
+def _is_none(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def check(sf: SourceFile, repo_root: str) -> List[Finding]:
+    if sf.tree is None or not any(
+            fnmatch.fnmatch(sf.relpath, pat)
+            for pat in config.TRACE_BOUNDARIES):
+        return []
+
+    findings: List[Finding] = []
+    calls_of: Dict[str, List[ast.Call]] = {}
+    fn_of: Dict[str, ast.AST] = {}
+    for qual, fn in walk_functions(sf.tree):
+        calls_of[qual] = _calls_outside_nested(fn)
+        fn_of[qual] = fn
+    in_func = {id(c) for calls in calls_of.values() for c in calls}
+    # module-scope calls get an empty pseudo-function: no trace
+    # assignment can save them, and no mint site matches ""
+    calls_of[""] = [c for c in _calls_outside_nested(sf.tree)
+                    if id(c) not in in_func]
+
+    for qual, calls in calls_of.items():
+        fn = fn_of.get(qual)
+        attaches = fn is not None and _assigns_trace(fn)
+        minter = any(fnmatch.fnmatch(qual, pat)
+                     for pat in config.TRACE_MINT_SITES)
+        for c in calls:
+            name = terminal_name(c.func)
+            if name in config.TRACE_CARRIERS:
+                field = config.TRACE_CARRIERS[name]
+                if kwarg(c, field) is None and not attaches:
+                    findings.append(sf.finding(
+                        "GL108", "error", c,
+                        f"boundary record `{name}` constructed without "
+                        f"its `{field}` context "
+                        + (f"in `{qual}`" if qual else
+                           "at module scope")
+                        + " — the request's trace stops at this "
+                          "handoff", _HINT_CARRIER))
+            elif name in _SPAN_CTORS and _is_none(kwarg(c, "parent")) \
+                    and not minter:
+                findings.append(sf.finding(
+                    "GL108", "error", c,
+                    f"parent-less root span minted "
+                    + (f"in `{qual}`" if qual else "at module scope")
+                    + " — a boundary must adopt the carried trace "
+                      "context, not start a new trace", _HINT_MINT))
+    return findings

@@ -11,8 +11,14 @@ flight-recorder dump (`flight_<pid>.json`, written to
   latency (from `serve.request` spans and their events) and train step
   time (from `train.step` spans): p50 / p90 / p99 / max.
 - **Per-request timelines** — the slowest N requests with queue wait,
-  TTFT, token count, status; `--request ID` prints one request's full
-  event timeline (queued → admitted → prefill → decode ticks → finish).
+  TTFT, token count, status; `--request ID` takes a trace id OR a
+  request_id label and renders the request's full cross-role waterfall
+  (every span of the trace — router admission, prefill replica, decode
+  replica — indented under its parent, events inline) plus the
+  critical-path stage decomposition (admission / queue / prefill /
+  handoff legs / decode / flush, telescoping so the stages sum to the
+  measured TTFT and E2E). Falls back to the flat serve.request event
+  timeline when the id doesn't resolve to a trace.
 - **Per-step waterfalls** — train.step spans with their data / dispatch
   / loss-sync child phases as aligned bars.
 - **Site table** — duration stats per span name (every instrumented
@@ -50,6 +56,28 @@ import json
 import os
 import sys
 from typing import Dict, List, Optional
+
+
+def _load_critpath():
+    """The stage-decomposition analyzer, loaded straight off its file
+    (paddle_tpu/observability/critpath.py is stdlib-only by contract)
+    so this tool never imports the paddle_tpu package (which pulls
+    jax). Returns None when the file isn't beside this checkout —
+    the waterfall still renders, just without the stage table."""
+    import importlib.util
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), "paddle_tpu",
+                        "observability", "critpath.py")
+    if not os.path.exists(path):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_pt_critpath", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
 
 
 # ---------------------------------------------------------------- loading --
@@ -121,9 +149,11 @@ def load_aux(path: str) -> dict:
     `{"kind": "control"}` decision audit log and `{"kind":
     "slo_breach"}` evidence records the SLO engine / PoolController
     write (docs/OBSERVABILITY.md "SLOs & the control loop"), plus
-    `slo.*` metric samples for the burn-rate timeline. Flight dumps
-    carry none of these; rotation siblings fold in like load_spans."""
-    aux = {"control": [], "breaches": [], "slo": []}
+    `slo.*` metric samples for the burn-rate timeline, plus histogram
+    samples carrying tail exemplars (trace ids of the largest
+    observations). Flight dumps carry none of these; rotation siblings
+    fold in like load_spans."""
+    aux = {"control": [], "breaches": [], "slo": [], "exemplars": []}
     try:
         with open(path) as f:
             # a flight-recorder dump is ONE json document (multi-record
@@ -145,6 +175,8 @@ def load_aux(path: str) -> dict:
                 aux["control"].append(rec)
             elif kind == "slo_breach":
                 aux["breaches"].append(rec)
+            elif kind == "histogram" and rec.get("exemplars"):
+                aux["exemplars"].append(rec)
             elif str(rec.get("name") or "").startswith("slo."):
                 aux["slo"].append(rec)
     return aux
@@ -182,12 +214,38 @@ def render_slo_control(aux: dict) -> str:
         w("== SLO breaches ==")
         for b in sorted(breaches, key=lambda r: r.get("ts") or 0):
             w("  t=%.2f slo=%s burn fast=%.2f slow=%.2f "
-              "events(fast)=%s evidence_spans=%d"
+              "events(fast)=%s evidence_spans=%d exemplars=%d"
               % (float(b.get("ts") or 0.0), b.get("slo"),
                  float(b.get("burn_fast") or 0.0),
                  float(b.get("burn_slow") or 0.0),
                  b.get("events_fast"),
-                 len(b.get("evidence") or [])))
+                 len(b.get("evidence") or []),
+                 len(b.get("exemplars") or [])))
+            for e in b.get("exemplars") or []:
+                w(f"    exemplar {float(e.get('value') or 0) * 1e3:.2f}"
+                  f"ms -> trace {e.get('trace')} "
+                  "(tools/trace_report.py --request <trace>)")
+    ex_recs = aux.get("exemplars") or []
+    if ex_recs:
+        # a long run exports each family many times: keep the LAST
+        # sample per (name, labels) — exemplars are cumulative tails
+        last: Dict[tuple, dict] = {}
+        for r in ex_recs:
+            key = (str(r.get("name")),
+                   tuple(sorted((r.get("labels") or {}).items())))
+            last[key] = r
+        w("== tail exemplars (largest observations -> traces) ==")
+        for key in sorted(last, key=str):
+            r = last[key]
+            lbl = ",".join(f"{k}={v}"
+                           for k, v in sorted(
+                               (r.get("labels") or {}).items()))
+            pairs = "  ".join(
+                f"{float(e.get('value') or 0) * 1e3:.2f}ms"
+                f"->{e.get('trace')}"
+                for e in r.get("exemplars") or [])
+            w(f"  {r.get('name')}"
+              + (f"{{{lbl}}}" if lbl else "") + f"  {pairs}")
     ctl = aux.get("control") or []
     if ctl:
         ctl = sorted(ctl, key=lambda r: (r.get("seq") is None,
@@ -520,6 +578,100 @@ def analyze(spans: List[dict]) -> dict:
             "sites": sites, "handoffs": _handoffs(spans)}
 
 
+# ----------------------------------------------------- request waterfall --
+def resolve_trace(spans: List[dict], ident: str) -> Optional[str]:
+    """Resolve a --request identifier to a trace id: an exact trace id
+    match, else the trace of any span labeled request_id=ident (router
+    handles mint rr<N>, serve loops req<N>)."""
+    for s in spans:
+        if s.get("trace") == ident:
+            return ident
+    for s in spans:
+        if (s.get("labels") or {}).get("request_id") == ident \
+                and s.get("trace"):
+            return s["trace"]
+    return None
+
+
+def render_waterfall(spans: List[dict], trace_id: str,
+                     critpath=None) -> str:
+    """One request's cross-role waterfall: every span of the trace
+    indented under its parent (router admission at the root, the
+    prefill and decode replicas' serve.request spans below it), events
+    inline at their timeline offsets, then the critical-path stage
+    decomposition whose telescoping stages sum to the measured E2E
+    (and, up to the prefill stage, to TTFT)."""
+    tspans = sorted((s for s in spans if s.get("trace") == trace_id),
+                    key=lambda s: float(s.get("start") or 0.0))
+    if not tspans:
+        return f"no spans for trace {trace_id!r}"
+    ids = {s.get("span"): s for s in tspans}
+
+    def depth(s: dict) -> int:
+        d = 0
+        p = s.get("parent")
+        seen = set()
+        while p and p in ids and p not in seen:
+            seen.add(p)
+            d += 1
+            p = ids[p].get("parent")
+        return d
+
+    t0 = min(float(s.get("start") or 0.0) for s in tspans)
+    root = next((s for s in tspans if not s.get("parent")), tspans[0])
+    rl = root.get("labels") or {}
+    out: List[str] = []
+    w = out.append
+    w(f"== trace {trace_id} (request "
+      f"{rl.get('request_id', '?')}, status "
+      f"{root.get('status', '?')}, {len(tspans)} spans) ==")
+    orphan_ids = {s.get("span") for s in tspans
+                  if s.get("parent") and s["parent"] not in ids}
+    for s in tspans:
+        lab = s.get("labels") or {}
+        ind = "  " * depth(s)
+        rel = (float(s.get("start") or 0.0) - t0) * 1e3
+        extras = " ".join(
+            f"{k}={lab[k]}" for k in ("request_id", "replica", "role",
+                                      "tier")
+            if lab.get(k) is not None)
+        mark = "  ORPHAN (parent unresolved in trace)" \
+            if s.get("span") in orphan_ids else ""
+        w(f"  +{rel:9.3f}ms  {ind}{s.get('name', '?')}"
+          f"  [{float(s.get('dur') or 0.0) * 1e3:.3f}ms"
+          f" {s.get('status', '?')}]"
+          + (f"  {extras}" if extras else "") + mark)
+        for e in s.get("events") or []:
+            erel = (float(e.get("ts") or 0.0) - t0) * 1e3
+            attrs = ", ".join(f"{k}={v}" for k, v in e.items()
+                              if k not in ("ts", "name"))
+            w(f"  +{erel:9.3f}ms  {ind}  . {e.get('name')}"
+              + (f"  ({attrs})" if attrs else ""))
+    cp = critpath if critpath is not None else _load_critpath()
+    if cp is not None:
+        d = cp.stage_decomposition(tspans, trace_id=trace_id)
+        w("  -- critical path (stages sum to E2E; the prefix up to")
+        w("     'prefill' sums to TTFT) --")
+        cum = 0.0
+        for stage, secs in d["stages"]:
+            cum += secs
+            w(f"  {stage:<18}{secs * 1e3:>11.3f}ms"
+              f"   cum {cum * 1e3:>11.3f}ms")
+        ttft = d.get("ttft")
+        w("  TTFT "
+          + (f"{ttft * 1e3:.3f}ms" if ttft is not None else "-")
+          + f"   E2E {d['e2e'] * 1e3:.3f}ms")
+        aux = d.get("aux") or {}
+        if aux.get("orphans"):
+            w(f"  ORPHAN SPANS: {aux['orphans']} "
+              "(broken trace-propagation chain)")
+        if aux.get("spec_ticks"):
+            w(f"  speculation: {aux['spec_ticks']} verify ticks, "
+              f"{aux['spec_accepted']} drafts accepted "
+              "(folded into the decode stage)")
+    return "\n".join(out)
+
+
 # --------------------------------------------------------------- rendering --
 def render(spans: List[dict], top_requests: int = 5,
            waterfall_steps: int = 8, request_id: Optional[str] = None) \
@@ -530,6 +682,9 @@ def render(spans: List[dict], top_requests: int = 5,
     w = out.append
 
     if request_id is not None:
+        tid = resolve_trace(spans, request_id)
+        if tid is not None:
+            return render_waterfall(spans, tid)
         match = [r for r in reqs if r.id == request_id]
         if not match:
             return f"no serve.request span with request_id={request_id!r}"
@@ -831,7 +986,8 @@ def main(argv=None) -> int:
         print(render(spans, top_requests=a.requests,
                      waterfall_steps=a.steps, request_id=a.request))
         if a.request is None:
-            aux = {"control": [], "breaches": [], "slo": []}
+            aux = {"control": [], "breaches": [], "slo": [],
+                   "exemplars": []}
             for path in files:
                 try:
                     one = load_aux(path)
